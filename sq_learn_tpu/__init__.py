@@ -1,0 +1,64 @@
+"""sq_learn_tpu — a TPU-native simulated fault-tolerant-quantum ML framework.
+
+Capabilities of the reference (federicomegler/sq-learn — quantum PCA, q-means
+clustering, quantum LS-SVM, and the quantum-routine simulation library they
+share), re-designed JAX-first: jit'd, vmap-able, key-threaded kernels on XLA,
+sharded over device meshes via ``shard_map`` + collectives. See SURVEY.md for
+the structural map of the reference this build follows.
+"""
+
+from ._config import config_context, default_dtype, get_config, resolve_device, set_config
+from .base import (
+    BaseEstimator,
+    ClassifierMixin,
+    ClusterMixin,
+    NotFittedError,
+    TransformerMixin,
+    check_is_fitted,
+    clone,
+)
+
+__version__ = "0.1.0"
+
+# Submodules are imported lazily-but-eagerly here; keep this list in sync with
+# the component inventory in SURVEY.md §2.
+from . import ops, utils  # noqa: E402
+
+try:  # models / parallel / datasets / metrics land incrementally
+    from . import parallel  # noqa: E402
+except ImportError:  # pragma: no cover
+    parallel = None
+try:
+    from . import metrics  # noqa: E402
+except ImportError:  # pragma: no cover
+    metrics = None
+try:
+    from . import datasets  # noqa: E402
+except ImportError:  # pragma: no cover
+    datasets = None
+try:
+    from . import models  # noqa: E402
+    from .models import QPCA, QKMeans, QLSSVC, KMeans, PCA  # noqa: E402
+except ImportError:  # pragma: no cover
+    models = None
+
+__all__ = [
+    "config_context",
+    "default_dtype",
+    "get_config",
+    "resolve_device",
+    "set_config",
+    "BaseEstimator",
+    "ClassifierMixin",
+    "ClusterMixin",
+    "NotFittedError",
+    "TransformerMixin",
+    "check_is_fitted",
+    "clone",
+    "ops",
+    "utils",
+    "parallel",
+    "metrics",
+    "datasets",
+    "models",
+]

@@ -1,0 +1,116 @@
+"""Global configuration for sq_learn_tpu.
+
+Mirrors the two-level config system of the reference (``sklearn/_config.py:6-110``):
+a module-level config dict with ``get_config`` / ``set_config`` / ``config_context``,
+extended with the ``device`` switch that BASELINE designates for TPU dispatch and a
+default dtype knob (TPUs natively prefer float32/bfloat16).
+"""
+
+import threading
+from contextlib import contextmanager
+
+_global_config = {
+    "device": "auto",  # 'auto' | 'tpu' | 'cpu'
+    "default_dtype": "float32",
+    "assume_finite": False,
+    "interactive_checks": True,
+}
+
+_threadlocal = threading.local()
+
+
+def _get_threadlocal_config():
+    """Per-thread view of the config (so config_context is thread-safe)."""
+    if not hasattr(_threadlocal, "config"):
+        _threadlocal.config = _global_config.copy()
+    return _threadlocal.config
+
+
+def get_config():
+    """Retrieve current values for configuration set by :func:`set_config`.
+
+    Returns
+    -------
+    config : dict
+        Keys are parameter names that can be passed to :func:`set_config`.
+    """
+    return _get_threadlocal_config().copy()
+
+
+def set_config(device=None, default_dtype=None, assume_finite=None,
+               interactive_checks=None):
+    """Set global sq_learn_tpu configuration.
+
+    Parameters
+    ----------
+    device : {'auto', 'tpu', 'cpu'}, optional
+        Backend selector. 'auto' uses JAX's default backend (TPU when one is
+        attached, otherwise CPU). 'cpu' forces the XLA CPU backend — this is
+        the NumPy-parity path: identical code, deterministic given the key.
+    default_dtype : {'float32', 'float64', 'bfloat16'}, optional
+        Default floating dtype for estimator inputs.
+    assume_finite : bool, optional
+        Skip finiteness validation of input arrays.
+    interactive_checks : bool, optional
+        Enable the warnings the reference emits on purely-classical paths.
+    """
+    local_config = _get_threadlocal_config()
+    if device is not None:
+        if device not in ("auto", "tpu", "cpu"):
+            raise ValueError(f"device must be 'auto', 'tpu' or 'cpu', got {device!r}")
+        local_config["device"] = device
+    if default_dtype is not None:
+        if default_dtype not in ("float32", "float64", "bfloat16"):
+            raise ValueError(f"unsupported default_dtype {default_dtype!r}")
+        local_config["default_dtype"] = default_dtype
+        if default_dtype == "float64":
+            # Without x64, jnp silently downcasts float64 inputs to float32 —
+            # honoring the user's opt-in requires flipping the global flag.
+            import jax
+
+            jax.config.update("jax_enable_x64", True)
+    if assume_finite is not None:
+        local_config["assume_finite"] = bool(assume_finite)
+    if interactive_checks is not None:
+        local_config["interactive_checks"] = bool(interactive_checks)
+
+
+@contextmanager
+def config_context(**new_config):
+    """Context manager that temporarily overrides the global configuration."""
+    old_config = get_config()
+    set_config(**new_config)
+    try:
+        yield
+    finally:
+        local_config = _get_threadlocal_config()
+        local_config.clear()
+        local_config.update(old_config)
+
+
+def resolve_device():
+    """Return the concrete :class:`jax.Device` selected by the config.
+
+    'auto' prefers an accelerator if JAX has one, falling back to CPU.
+    """
+    import jax
+
+    device = _get_threadlocal_config()["device"]
+    if device == "cpu":
+        return jax.devices("cpu")[0]
+    if device == "tpu":
+        for d in jax.devices():
+            if d.platform != "cpu":
+                return d
+        raise RuntimeError("device='tpu' requested but no accelerator is attached")
+    return jax.devices()[0]
+
+
+def default_dtype():
+    import jax.numpy as jnp
+
+    return {
+        "float32": jnp.float32,
+        "float64": jnp.float64,
+        "bfloat16": jnp.bfloat16,
+    }[_get_threadlocal_config()["default_dtype"]]

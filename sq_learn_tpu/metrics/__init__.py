@@ -1,0 +1,32 @@
+"""Evaluation metrics and pairwise kernels (reference layer L5 slice:
+``sklearn/metrics`` — ARI at ``metrics/cluster/_supervised.py:302``,
+``accuracy_score``, and the ``metrics.pairwise`` kernels the quantum LS-SVM
+uses at ``svm/_qSVM.py:4,375-389``). All jnp, all jit-able."""
+
+from .pairwise import (
+    euclidean_distances,
+    linear_kernel,
+    pairwise_kernels,
+    polynomial_kernel,
+    rbf_kernel,
+    sigmoid_kernel,
+)
+from .scores import (
+    accuracy_score,
+    adjusted_rand_score,
+    explained_variance_ratio,
+    inertia,
+)
+
+__all__ = [
+    "accuracy_score",
+    "adjusted_rand_score",
+    "euclidean_distances",
+    "explained_variance_ratio",
+    "inertia",
+    "linear_kernel",
+    "pairwise_kernels",
+    "polynomial_kernel",
+    "rbf_kernel",
+    "sigmoid_kernel",
+]

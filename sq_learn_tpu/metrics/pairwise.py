@@ -1,0 +1,63 @@
+"""Pairwise kernels and distances (reference ``sklearn/metrics/pairwise.py``
+slice used by QLSSVC at ``svm/_qSVM.py:375-389`` and q-means transform at
+``_dmeans.py:1351``). Pure GEMM + elementwise — exactly what the MXU wants."""
+
+import jax.numpy as jnp
+
+from ..ops.linalg import pairwise_sq_distances
+
+
+def euclidean_distances(X, Y=None, squared=False):
+    X = jnp.asarray(X)
+    Y = X if Y is None else jnp.asarray(Y)
+    d2 = pairwise_sq_distances(X, Y)
+    return d2 if squared else jnp.sqrt(d2)
+
+
+def linear_kernel(X, Y=None):
+    X = jnp.asarray(X)
+    Y = X if Y is None else jnp.asarray(Y)
+    return X @ Y.T
+
+
+def polynomial_kernel(X, Y=None, degree=3, gamma=None, coef0=1.0):
+    X = jnp.asarray(X)
+    Y = X if Y is None else jnp.asarray(Y)
+    if gamma is None:
+        gamma = 1.0 / X.shape[1]
+    return (gamma * (X @ Y.T) + coef0) ** degree
+
+
+def rbf_kernel(X, Y=None, gamma=None):
+    X = jnp.asarray(X)
+    Y = X if Y is None else jnp.asarray(Y)
+    if gamma is None:
+        gamma = 1.0 / X.shape[1]
+    return jnp.exp(-gamma * pairwise_sq_distances(X, Y))
+
+
+def sigmoid_kernel(X, Y=None, gamma=None, coef0=1.0):
+    X = jnp.asarray(X)
+    Y = X if Y is None else jnp.asarray(Y)
+    if gamma is None:
+        gamma = 1.0 / X.shape[1]
+    return jnp.tanh(gamma * (X @ Y.T) + coef0)
+
+
+KERNELS = {
+    "linear": linear_kernel,
+    "poly": polynomial_kernel,
+    "polynomial": polynomial_kernel,
+    "rbf": rbf_kernel,
+    "sigmoid": sigmoid_kernel,
+}
+
+
+def pairwise_kernels(X, Y=None, metric="linear", **kwds):
+    try:
+        fn = KERNELS[metric]
+    except KeyError:
+        raise ValueError(
+            f"unknown kernel {metric!r}; available: {sorted(set(KERNELS))}"
+        ) from None
+    return fn(X, Y, **kwds)

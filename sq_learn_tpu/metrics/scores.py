@@ -1,0 +1,59 @@
+"""Clustering / classification scores."""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def accuracy_score(y_true, y_pred):
+    """Fraction of exact label matches."""
+    y_true = jnp.asarray(y_true)
+    y_pred = jnp.asarray(y_pred)
+    return jnp.mean((y_true == y_pred).astype(jnp.float32))
+
+
+def _contingency(labels_true, labels_pred):
+    """Dense contingency table via one-hot GEMM (MXU-friendly; replaces the
+    reference's sparse COO build in ``metrics/cluster/_supervised.py``)."""
+    labels_true = np.asarray(labels_true)
+    labels_pred = np.asarray(labels_pred)
+    _, ti = np.unique(labels_true, return_inverse=True)
+    _, pi = np.unique(labels_pred, return_inverse=True)
+    n_t = int(ti.max()) + 1
+    n_p = int(pi.max()) + 1
+    onehot_t = jnp.zeros((len(ti), n_t)).at[jnp.arange(len(ti)), jnp.asarray(ti)].set(1.0)
+    onehot_p = jnp.zeros((len(pi), n_p)).at[jnp.arange(len(pi)), jnp.asarray(pi)].set(1.0)
+    return onehot_t.T @ onehot_p
+
+
+def adjusted_rand_score(labels_true, labels_pred):
+    """Adjusted Rand Index (reference ``metrics/cluster/_supervised.py:302``):
+    ARI = (RI − E[RI]) / (max(RI) − E[RI]) via the contingency-table pair
+    counts."""
+    c = _contingency(labels_true, labels_pred)
+    n = jnp.sum(c)
+    sum_comb_c = jnp.sum(c * (c - 1)) / 2.0
+    a = jnp.sum(c, axis=1)
+    b = jnp.sum(c, axis=0)
+    sum_comb_a = jnp.sum(a * (a - 1)) / 2.0
+    sum_comb_b = jnp.sum(b * (b - 1)) / 2.0
+    total = n * (n - 1) / 2.0
+    expected = sum_comb_a * sum_comb_b / total
+    max_index = (sum_comb_a + sum_comb_b) / 2.0
+    denom = max_index - expected
+    return jnp.where(denom == 0, 1.0, (sum_comb_c - expected) / denom)
+
+
+def inertia(X, centers, labels):
+    """Sum of squared distances of samples to their assigned center."""
+    X = jnp.asarray(X)
+    centers = jnp.asarray(centers)
+    diffs = X - centers[jnp.asarray(labels)]
+    return jnp.sum(diffs * diffs)
+
+
+def explained_variance_ratio(singular_values, n_samples, total_variance=None):
+    """Per-component explained-variance ratios from singular values
+    (reference ``_qPCA.py:589-591``)."""
+    ev = jnp.asarray(singular_values) ** 2 / (n_samples - 1)
+    total = jnp.sum(ev) if total_variance is None else total_variance
+    return ev / total

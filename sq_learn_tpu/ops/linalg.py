@@ -1,0 +1,140 @@
+"""XLA linear algebra (reference layer L1, ``sklearn/utils/extmath.py``).
+
+Everything here is jit-able and shaped for the MXU: tall-skinny SVDs go
+through an m×m Gram eigendecomposition (SURVEY §7: "full-SVD of 70k×784 on
+TPU → compute Gram 784×784 eigh"), randomized SVD follows Halko et al. as in
+``extmath.py:161-392`` (range finder + power iterations + small SVD), and
+pairwise distances use the ‖x‖²+‖c‖²−2XCᵀ GEMM trick that the reference's
+Cython Lloyd kernel uses (``_k_means_lloyd.pyx:196-203``).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def row_norms(X, squared=False):
+    """Row-wise L2 norms (reference ``extmath.py:49``)."""
+    X = jnp.asarray(X)
+    norms = jnp.sum(X * X, axis=1)
+    return norms if squared else jnp.sqrt(norms)
+
+
+def svd_flip(u, v):
+    """Sign correction for deterministic SVD output (reference
+    ``extmath.py:522``): the largest-|.|-entry column of u is made positive."""
+    max_abs_cols = jnp.argmax(jnp.abs(u), axis=0)
+    signs = jnp.sign(u[max_abs_cols, jnp.arange(u.shape[1])])
+    signs = jnp.where(signs == 0, 1.0, signs)
+    return u * signs, v * signs[:, None]
+
+
+@functools.partial(jax.jit, static_argnames=("method",))
+def thin_svd(X, method="auto"):
+    """Thin SVD X = U·diag(S)·Vt with U (n,r), S (r,), Vt (r,m), r=min(n,m).
+
+    method 'gram' squares the shorter side (fast on the MXU for very
+    rectangular matrices, costs some accuracy for tiny singular values);
+    'direct' calls the XLA SVD; 'auto' picks 'gram' when the aspect ratio
+    is ≥ 8.
+    """
+    X = jnp.asarray(X)
+    n, m = X.shape
+    if method == "auto":
+        method = "gram" if max(n, m) >= 8 * min(n, m) else "direct"
+    if method == "direct":
+        U, S, Vt = jnp.linalg.svd(X, full_matrices=False)
+        return U, S, Vt
+    if n >= m:
+        G = X.T @ X  # (m, m) — one big MXU GEMM
+        evals, V = jnp.linalg.eigh(G)  # ascending
+        evals = jnp.flip(evals, 0)
+        V = jnp.flip(V, 1)
+        S = jnp.sqrt(jnp.maximum(evals, 0.0))
+        safe = jnp.where(S > 0, S, 1.0)
+        U = (X @ V) / safe[None, :]
+        return U, S, V.T
+    G = X @ X.T  # (n, n)
+    evals, U = jnp.linalg.eigh(G)
+    evals = jnp.flip(evals, 0)
+    U = jnp.flip(U, 1)
+    S = jnp.sqrt(jnp.maximum(evals, 0.0))
+    safe = jnp.where(S > 0, S, 1.0)
+    Vt = (U.T @ X) / safe[:, None]
+    return U, S, Vt
+
+
+def centered_svd(X, method="auto"):
+    """Column-center X and return (mean, U, S, Vt) with deterministic signs —
+    the core of every PCA fit (reference ``_qPCA.py:578-583``)."""
+    X = jnp.asarray(X)
+    mean = jnp.mean(X, axis=0)
+    U, S, Vt = thin_svd(X - mean, method=method)
+    U, Vt = svd_flip(U, Vt)
+    return mean, U, S, Vt
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_components", "n_oversamples", "n_iter", "flip")
+)
+def randomized_svd(key, X, n_components, n_oversamples=10, n_iter=4, flip=True):
+    """Randomized truncated SVD (Halko et al.; reference
+    ``extmath.py:246-392``): Gaussian range finder, QR-normalized subspace
+    power iterations, exact SVD of the small projected matrix.
+
+    All dense GEMMs — this is the covertype benchmark kernel (BASELINE #4).
+    """
+    X = jnp.asarray(X)
+    n, m = X.shape
+    size = min(n_components + n_oversamples, min(n, m))
+    transpose = n < m
+    A = X.T if transpose else X  # ensure tall
+
+    Q = jax.random.normal(key, (A.shape[1], size), dtype=X.dtype)
+    Q = A @ Q
+    for _ in range(n_iter):
+        Q, _ = jnp.linalg.qr(A.T @ Q)
+        Q = A @ Q
+    Q, _ = jnp.linalg.qr(Q)
+    B = Q.T @ A  # (size, min_dim)
+    Uhat, S, Vt = jnp.linalg.svd(B, full_matrices=False)
+    U = Q @ Uhat
+    if flip:
+        U, Vt = svd_flip(U, Vt)
+    if transpose:
+        U, S, Vt = Vt.T, S, U.T
+    return U[:, :n_components], S[:n_components], Vt[:n_components]
+
+
+def pairwise_sq_distances(X, C, x_sq_norms=None):
+    """Squared Euclidean distances via ‖x‖² + ‖c‖² − 2·X·Cᵀ
+    (the GEMM trick of ``_k_means_lloyd.pyx:191-203``), clipped at 0."""
+    X = jnp.asarray(X)
+    C = jnp.asarray(C)
+    if x_sq_norms is None:
+        x_sq_norms = jnp.sum(X * X, axis=1)
+    c_sq = jnp.sum(C * C, axis=1)
+    d2 = x_sq_norms[:, None] + c_sq[None, :] - 2.0 * (X @ C.T)
+    return jnp.maximum(d2, 0.0)
+
+
+def stable_cumsum(arr, axis=None):
+    """Cumulative sum with float64 accumulation, cast back to the input
+    dtype — reference ``extmath.py:829``. When x64 is disabled (the TPU
+    default) this is a plain cumsum; enable x64 via
+    ``set_config(default_dtype='float64')`` for stable accumulation."""
+    arr = jnp.asarray(arr)
+    if jax.config.jax_enable_x64 and arr.dtype != jnp.float64:
+        return jnp.cumsum(arr.astype(jnp.float64), axis=axis).astype(arr.dtype)
+    return jnp.cumsum(arr, axis=axis)
+
+
+def smallest_singular_value(X):
+    """σ_min via Gram eigh — replaces the reference's wasteful full SVD just
+    for the condition number (``_dmeans.py:1244-1245``, SURVEY §3.2)."""
+    X = jnp.asarray(X)
+    n, m = X.shape
+    G = X.T @ X if n >= m else X @ X.T
+    evals = jnp.linalg.eigvalsh(G)
+    return jnp.sqrt(jnp.maximum(evals[0], 0.0))

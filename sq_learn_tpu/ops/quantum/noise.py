@@ -1,0 +1,55 @@
+"""Truncated-Gaussian noise injectors.
+
+These are the reference's fast-path error models (``Utility.py:68-73,88-104``):
+instead of running full tomography, an estimate is approximated by adding
+truncnorm(−b, b) noise per component. They double as the framework's
+fault-injection system (SURVEY §5). All samplers are key-threaded and batched.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def truncated_noise(key, bound, shape, dtype=jnp.float32):
+    """Standard-normal noise truncated to [−bound, bound] (scipy
+    ``truncnorm.rvs(-b, b)`` equivalent). ``bound`` may be an array
+    broadcastable to ``shape``; bound == 0 yields exactly 0."""
+    bound = jnp.asarray(bound, dtype=dtype)
+    safe = jnp.where(bound > 0, bound, 1.0)
+    noise = jax.random.truncated_normal(key, -safe, safe, shape, dtype=dtype)
+    return jnp.where(bound > 0, noise, 0.0)
+
+
+def introduce_error(key, value, epsilon):
+    """value + truncnorm(−ε, ε) noise (reference ``introduce_error``, :68).
+
+    Batched: ``value`` and ``epsilon`` broadcast together.
+    """
+    value = jnp.asarray(value)
+    eps = jnp.broadcast_to(jnp.asarray(epsilon, value.dtype), value.shape)
+    return value + truncated_noise(key, eps, value.shape, value.dtype)
+
+
+def introduce_error_array(key, array, norm_error):
+    """Add truncnorm noise bounded by ``norm_error/√d`` per component
+    (reference ``introduce_error_array``, :71) so the L2 perturbation is
+    ≤ ``norm_error``."""
+    array = jnp.asarray(array)
+    d = array.shape[-1]
+    bound = jnp.asarray(norm_error) / jnp.sqrt(d)
+    bound = jnp.broadcast_to(bound[..., None] if jnp.ndim(bound) else bound, array.shape)
+    return array + truncated_noise(key, bound, array.shape, array.dtype)
+
+
+def gaussian_estimate(key, vec, noise):
+    """Gaussian-noise approximation of tomography (reference
+    ``make_gaussian_est``, :88): adds truncnorm(±noise/√d) per component.
+
+    Unlike the reference — which returns an undefined variable when
+    noise == 0 (``Utility.py:97-104``, latent bug) — noise == 0 returns the
+    input unchanged.
+    """
+    vec = jnp.asarray(vec)
+    d = vec.shape[-1]
+    per_component = jnp.asarray(noise, vec.dtype) / jnp.sqrt(d)
+    return vec + truncated_noise(key, per_component, vec.shape, vec.dtype)

@@ -1,0 +1,64 @@
+"""μ(A) quantum-memory-model norm search.
+
+μ_p(A) = √(s_{2p}(A) · s_{2(1−p)}(Aᵀ)) with s_q(A) = max_i ‖A_i‖_q^q, grid
+minimized over p ∈ [0,1] and compared against the Frobenius norm. This is the
+data-structure parameter entering every quantum runtime formula (reference
+``__mu``/``linear_search``/``best_mu``, ``Utility.py:196-231``).
+
+TPU-first: each μ_p is a pair of row-wise power-sum reductions (one over A,
+one over Aᵀ) — all grid points are evaluated in a single jit'd sweep instead
+of the reference's 21 Python-loop passes over the matrix.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnums=1)
+def _mu_grid(A, grid):
+    """Evaluate μ_p for every p in the (static) grid in one fused sweep."""
+    A = jnp.asarray(A)
+    absA = jnp.abs(A)
+
+    def s(q, M):
+        # s_q(M) = max_i Σ_j |M_ij|^q ; q == 0 counts nonzeros (reference
+        # Utility.py:198-203).
+        if q == 0:
+            return jnp.max(jnp.sum((M != 0).astype(M.dtype), axis=1))
+        return jnp.max(jnp.sum(M**q, axis=1))
+
+    vals = [jnp.sqrt(s(2 * p, absA) * s(2 * (1 - p), absA.T)) for p in grid]
+    return jnp.stack(vals)
+
+
+def mu(A, p):
+    """μ_p(A) for a single p."""
+    return _mu_grid(A, (float(p),))[0]
+
+
+def linear_search(A, start=0.0, end=1.0, step=0.05):
+    """Grid-minimize μ_p over p ∈ [start, end] (reference ``linear_search``,
+    ``Utility.py:215-219``). Returns (best_p, best_value)."""
+    grid = tuple(float(p) for p in np.arange(start, end, step)) + (float(end),)
+    vals = np.asarray(_mu_grid(jnp.asarray(A), grid))
+    idx = int(np.argmin(vals))
+    return grid[idx], float(vals[idx])
+
+
+def best_mu(A, start=0.0, end=1.0, step=0.05):
+    """Best of grid-searched μ_p and the Frobenius norm (reference
+    ``best_mu``, ``Utility.py:222-231``).
+
+    Returns
+    -------
+    (description, value) : (str, float)
+        description is ``"p=<best_p>"`` or ``"Frobenius"``.
+    """
+    p, val = linear_search(A, start=start, end=end, step=step)
+    frob = float(jnp.linalg.norm(jnp.asarray(A)))
+    if val <= frob:
+        return f"p={p}", val
+    return "Frobenius", frob

@@ -1,0 +1,106 @@
+"""Measurement-count sampling primitives.
+
+The reference simulates quantum measurement by materializing N draws from
+``np.random.choice`` and counting them (``Utility.py:51-54,61-64``) — at the
+tomography sample complexity N = 36·d·ln d/δ² that is ~2e7 draws per vector.
+On TPU we never materialize draws: outcome *counts* are sampled directly from
+a multinomial (one fused XLA op), which is statistically identical.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def multinomial_counts(key, n, probs):
+    """Sample outcome counts of ``n`` categorical draws.
+
+    Parameters
+    ----------
+    key : jax key
+    n : int or array broadcastable to the batch of ``probs``
+        Number of measurements.
+    probs : (..., d) array
+        Outcome probabilities along the last axis (need not be exactly
+        normalized; they are renormalized).
+
+    Returns
+    -------
+    counts : (..., d) float array summing to ``n`` along the last axis.
+    """
+    probs = jnp.asarray(probs)
+    probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+    n = jnp.broadcast_to(jnp.asarray(n, dtype=probs.dtype), probs.shape[:-1])
+    return jax.random.multinomial(key, n, probs)
+
+
+def estimate_wald(counts, n):
+    """Wald (empirical frequency) estimator from measurement counts.
+
+    Equivalent to the reference's ``estimate_wald`` (``Utility.py:61``) which
+    builds a Counter over materialized draws.
+    """
+    return jnp.asarray(counts) / n
+
+
+def fejer_probs(delta, M):
+    """Pointwise Fejér-kernel probability |sin(MΔπ) / (M·sin(Δπ))|².
+
+    This is the exact output distribution of both amplitude estimation
+    (``Utility.py:498-506``) and phase estimation (``Utility.py:642-650``)
+    at grid distance Δ from the true value, with the removable singularity
+    at Δ ∈ ℤ taken to 1.
+    """
+    delta = jnp.asarray(delta)
+    sin_d = jnp.sin(jnp.pi * delta)
+    singular = jnp.abs(sin_d) < 1e-12
+    safe = jnp.where(singular, 1.0, sin_d)
+    p = (jnp.sin(jnp.pi * M * delta) / (M * safe)) ** 2
+    return jnp.where(singular, 1.0, p)
+
+
+def fejer_grid_sample(key, pos, M, window, sample_shape=()):
+    """Sample grid indices from the Fejér measurement distribution.
+
+    Draws j ∈ {0, …, M−1} (mod-M wrapped) with
+    P(j) ∝ |sin(π(pos−j)) / (M·sin(π(pos−j)/M))|², i.e. the exact
+    amplitude/phase-estimation output distribution for a register of M grid
+    points whose true value sits at fractional grid position ``pos``.
+
+    TPU-first design: instead of materializing the M-point pmf per element
+    (the reference builds it in a Python loop per call — ``Utility.py:498``,
+    ``:642``), we enumerate only the ``2·window+1`` grid points nearest
+    ``pos``. Entries are masked to at most M unique residues, so when
+    M ≤ 2·window+1 the sampler is *exact*; otherwise it truncates a tail of
+    total mass O(1/window) (≈0.3% at window=64). This makes M a *traced*
+    per-element quantity — whole batches of estimations with different
+    precisions run as one kernel.
+
+    Parameters
+    ----------
+    key : jax key
+    pos : (...,) float array — true value in grid units (value·M).
+    M : (...,) float array or scalar — grid size per element (may be traced).
+    window : static int — half-width of the enumerated window.
+    sample_shape : tuple — leading shape of independent samples per element.
+
+    Returns
+    -------
+    j : float array of shape ``sample_shape + pos.shape`` — sampled grid
+        indices in [0, M).
+    """
+    pos = jnp.asarray(pos)
+    M = jnp.broadcast_to(jnp.asarray(M, dtype=pos.dtype), pos.shape)
+    offs = jnp.arange(-window, window + 1, dtype=pos.dtype)
+    base = jnp.floor(pos)
+    j = base[..., None] + offs  # (..., 2W+1) candidate (unwrapped) indices
+    delta = (pos[..., None] - j) / M[..., None]
+    p = fejer_probs(delta, M[..., None])
+    # Keep exactly min(2W+1, M) unique residues mod M: offsets in (−M/2, M/2].
+    centered = j - base[..., None]
+    valid = (centered > -M[..., None] / 2) & (centered <= M[..., None] / 2)
+    logits = jnp.where(valid, jnp.log(jnp.maximum(p, 1e-38)), -jnp.inf)
+    idx = jax.random.categorical(key, logits, shape=sample_shape + pos.shape)
+    j_sel = jnp.take_along_axis(
+        jnp.broadcast_to(j, sample_shape + j.shape), idx[..., None], axis=-1
+    )[..., 0]
+    return jnp.mod(j_sel, M)

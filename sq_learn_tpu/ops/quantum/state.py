@@ -1,0 +1,109 @@
+"""Quantum register simulation.
+
+TPU-native counterpart of the reference's ``QuantumState``
+(``Utility.py:25-58``): registers + L2-normalized amplitudes, measured by
+sampling register indices with probability amplitude². Measurement is
+key-threaded ``jax.random`` (the reference spins up a fresh
+``np.random.RandomState()`` per call for process safety — explicit keys make
+that a non-issue) and large-N measurement returns multinomial *counts*
+instead of materialized draws.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .sampling import estimate_wald, multinomial_counts
+
+
+class QuantumState:
+    """A minimal simulated quantum register.
+
+    Parameters
+    ----------
+    registers : array-like of shape (d,) or (d, ...)
+        Values (or vectors) attached to each basis state.
+    amplitudes : array-like of shape (d,)
+        Amplitudes; normalized internally so probabilities sum to 1.
+    """
+
+    def __init__(self, registers, amplitudes):
+        amplitudes = jnp.asarray(amplitudes)
+        if amplitudes.ndim != 1:
+            raise ValueError("amplitudes must be 1-D")
+        self.norm_factor = jnp.linalg.norm(amplitudes)
+        self.amplitudes = amplitudes / self.norm_factor
+        self.probabilities = self.amplitudes**2
+        self.registers = jnp.asarray(registers) if not isinstance(registers, list) else registers
+        n_reg = len(self.registers) if isinstance(self.registers, list) else self.registers.shape[0]
+        if n_reg != amplitudes.shape[0]:
+            raise ValueError("registers and amplitudes must have the same length")
+        if not isinstance(self.probabilities, jax.core.Tracer):
+            np.testing.assert_allclose(
+                float(jnp.sum(self.probabilities)), 1.0, atol=1e-7
+            )
+
+    def measure_indices(self, key, n_times=1):
+        """Sample ``n_times`` basis-state *indices* (jit-friendly)."""
+        logits = jnp.log(jnp.maximum(self.probabilities, 1e-38))
+        return jax.random.categorical(key, logits, shape=(n_times,))
+
+    def measure(self, key, n_times=1):
+        """Sample ``n_times`` register values (reference ``measure``, :51)."""
+        idx = self.measure_indices(key, n_times)
+        if isinstance(self.registers, list):
+            idx = np.asarray(idx)
+            return [self.registers[int(i)] for i in idx]
+        return jnp.take(self.registers, idx, axis=0)
+
+    def measure_counts(self, key, n_times):
+        """Outcome counts of ``n_times`` measurements — O(d) memory
+        regardless of N (never materializes draws)."""
+        return multinomial_counts(key, n_times, self.probabilities)
+
+    def measure_frequencies(self, key, n_times):
+        """Wald frequency estimates per basis state."""
+        return estimate_wald(self.measure_counts(key, n_times), n_times)
+
+    def get_state(self):
+        """Dict {register: probability} (reference ``get_state``, :57)."""
+        probs = np.asarray(self.probabilities)
+        if isinstance(self.registers, list):
+            return {
+                _hashable(r): float(probs[i]) for i, r in enumerate(self.registers)
+            }
+        regs = np.asarray(self.registers)
+        return {_hashable(regs[i]): float(probs[i]) for i in range(len(probs))}
+
+
+def _hashable(value):
+    arr = np.asarray(value)
+    if arr.ndim == 0:
+        return arr.item()
+    return tuple(arr.ravel().tolist())
+
+
+def coupon_collect(key, quantum_state, max_draws=1_000_000):
+    """Number of measurements until every basis state has been observed.
+
+    Reference ``coupon_collect`` (``Utility.py:75-85``), re-expressed as a
+    ``lax.while_loop`` with a key carry instead of unbounded Python sampling.
+    """
+    probs = quantum_state.probabilities
+    d = probs.shape[0]
+    logits = jnp.log(jnp.maximum(probs, 1e-38))
+
+    def cond(carry):
+        _, seen, count = carry
+        return jnp.logical_and(~jnp.all(seen), count < max_draws)
+
+    def body(carry):
+        k, seen, count = carry
+        k, sub = jax.random.split(k)
+        idx = jax.random.categorical(sub, logits)
+        return k, seen.at[idx].set(True), count + 1
+
+    _, _, count = jax.lax.while_loop(
+        cond, body, (key, jnp.zeros(d, dtype=bool), jnp.asarray(0))
+    )
+    return count

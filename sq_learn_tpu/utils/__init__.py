@@ -1,0 +1,19 @@
+"""Numeric and plumbing utilities (reference layer L1, ``sklearn/utils/``)."""
+
+from .keys import as_key, key_iter, split
+from .validation import (
+    check_array,
+    check_random_state,
+    check_sample_weight,
+    check_X_y,
+)
+
+__all__ = [
+    "as_key",
+    "key_iter",
+    "split",
+    "check_array",
+    "check_random_state",
+    "check_sample_weight",
+    "check_X_y",
+]

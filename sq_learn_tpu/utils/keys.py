@@ -1,0 +1,47 @@
+"""PRNG key discipline.
+
+The reference mixes global ``random.seed`` (``_dmeans.py:22``), per-call
+``np.random.RandomState()`` (``Utility.py:53``) and seeded RandomState objects.
+On TPU the whole framework threads explicit ``jax.random`` keys instead: every
+stochastic routine takes a key, splits it for sub-routines, and never touches
+global state. These helpers bridge sklearn-style ``random_state`` arguments to
+that discipline so parity tests can still seed deterministically.
+"""
+
+import numpy as np
+import jax
+
+
+def as_key(random_state):
+    """Coerce a ``random_state``-style argument to a ``jax.random`` key.
+
+    Parameters
+    ----------
+    random_state : None, int, jax key array, or np.random.RandomState
+        ``None`` draws fresh OS entropy (the analogue of the reference's
+        per-call ``np.random.RandomState()``); an int seeds deterministically.
+    """
+    if random_state is None:
+        return jax.random.PRNGKey(np.random.SeedSequence().entropy % (2**63))
+    if isinstance(random_state, (int, np.integer)):
+        return jax.random.PRNGKey(int(random_state))
+    if isinstance(random_state, np.random.RandomState):
+        return jax.random.PRNGKey(int(random_state.randint(0, 2**31 - 1)))
+    if isinstance(random_state, jax.Array):
+        return random_state
+    raise ValueError(
+        f"random_state must be None, an int, a RandomState or a jax key; "
+        f"got {type(random_state).__name__}"
+    )
+
+
+def split(key, num=2):
+    """Alias for :func:`jax.random.split` kept here for import hygiene."""
+    return jax.random.split(key, num)
+
+
+def key_iter(key):
+    """Infinite generator of fresh subkeys (host-side driver loops only)."""
+    while True:
+        key, sub = jax.random.split(key)
+        yield sub

@@ -1,0 +1,113 @@
+"""Input validation utilities.
+
+A TPU-first re-implementation of the slice of ``sklearn/utils/validation.py``
+the quantum estimators rely on (``check_array``, ``check_is_fitted`` — see
+``base.py`` — and sample-weight checks). Validation happens on host in NumPy
+before arrays are shipped to the device; everything returned is a plain
+``np.ndarray`` ready for ``jnp.asarray``.
+"""
+
+import numbers
+
+import numpy as np
+
+from .._config import get_config
+
+
+def check_array(X, *, dtype="float", ensure_2d=True, allow_nd=False, copy=False,
+                ensure_min_samples=1, ensure_min_features=1, force_finite=None):
+    """Validate an input array (dense only — sparse input is rejected like
+    the reference's qPCA does at ``_qPCA.py:517``).
+
+    Returns a C-contiguous ndarray of float32/float64 per the global config.
+    """
+    if hasattr(X, "toarray"):
+        raise TypeError(
+            "sparse input is not supported by the quantum estimators; "
+            "densify with .toarray() first"
+        )
+    if dtype == "float":
+        cfg = get_config()["default_dtype"]
+        np_dtype = np.float64 if cfg == "float64" else np.float32
+        X = np.asarray(X)
+        if X.dtype not in (np.float32, np.float64):
+            X = X.astype(np_dtype)
+    elif dtype is not None:
+        X = np.asarray(X, dtype=dtype)
+    else:
+        X = np.asarray(X)
+
+    if copy:
+        X = np.array(X, copy=True)
+
+    if ensure_2d:
+        if X.ndim == 1:
+            raise ValueError(
+                f"Expected 2D array, got 1D array instead:\narray={X!r}.\n"
+                "Reshape your data either using array.reshape(-1, 1) if your "
+                "data has a single feature or array.reshape(1, -1) if it "
+                "contains a single sample."
+            )
+        if X.ndim != 2 and not allow_nd:
+            raise ValueError(f"Found array with dim {X.ndim}, expected 2.")
+
+    if force_finite is None:
+        force_finite = not get_config()["assume_finite"]
+    if force_finite and X.dtype.kind == "f" and not np.isfinite(X).all():
+        raise ValueError("Input contains NaN or infinity.")
+
+    if ensure_2d and X.ndim == 2:
+        n_samples, n_features = X.shape
+        if n_samples < ensure_min_samples:
+            raise ValueError(
+                f"Found array with {n_samples} sample(s) while a minimum of "
+                f"{ensure_min_samples} is required."
+            )
+        if n_features < ensure_min_features:
+            raise ValueError(
+                f"Found array with {n_features} feature(s) while a minimum of "
+                f"{ensure_min_features} is required."
+            )
+    return np.ascontiguousarray(X)
+
+
+def check_X_y(X, y, **kwargs):
+    X = check_array(X, **kwargs)
+    y = np.asarray(y)
+    if y.ndim != 1:
+        y = np.ravel(y)
+    if len(y) != X.shape[0]:
+        raise ValueError(
+            f"Found input variables with inconsistent numbers of samples: "
+            f"[{X.shape[0]}, {len(y)}]"
+        )
+    return X, y
+
+
+def check_sample_weight(sample_weight, X, dtype=None):
+    """Validate sample weights (reference ``_check_sample_weight``)."""
+    n_samples = X.shape[0]
+    if dtype is None:
+        dtype = X.dtype if X.dtype in (np.float32, np.float64) else np.float64
+    if sample_weight is None:
+        return np.ones(n_samples, dtype=dtype)
+    if isinstance(sample_weight, numbers.Number):
+        return np.full(n_samples, sample_weight, dtype=dtype)
+    sample_weight = np.asarray(sample_weight, dtype=dtype)
+    if sample_weight.ndim != 1 or sample_weight.shape[0] != n_samples:
+        raise ValueError(
+            f"sample_weight.shape == {sample_weight.shape}, "
+            f"expected ({n_samples},)"
+        )
+    return sample_weight
+
+
+def check_random_state(seed):
+    """Turn seed into an ``np.random.RandomState`` (host-side init paths)."""
+    if seed is None or seed is np.random:
+        return np.random.mtrand._rand
+    if isinstance(seed, numbers.Integral):
+        return np.random.RandomState(int(seed))
+    if isinstance(seed, np.random.RandomState):
+        return seed
+    raise ValueError(f"{seed!r} cannot be used to seed a RandomState instance")

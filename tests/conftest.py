@@ -1,0 +1,44 @@
+"""Test configuration.
+
+Tests run on the XLA CPU backend with 8 virtual devices
+(``--xla_force_host_platform_device_count=8``) so multi-chip sharding is
+exercised without a pod — SURVEY §4's "test multi-node without a cluster"
+answer.
+
+NOTE: jax may already be imported (and JAX_PLATFORMS may point at an
+accelerator) by the time pytest starts, so the platform override must go
+through ``jax.config.update`` — env vars would be read too late. XLA_FLAGS
+is read at backend-init time, which has not happened yet here.
+"""
+
+import os
+
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    return jax.devices("cpu")
+
+
+@pytest.fixture(scope="session")
+def mesh8(cpu_devices):
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(cpu_devices[:8]), ("data",))
+
+
+@pytest.fixture
+def key():
+    return jax.random.PRNGKey(0)

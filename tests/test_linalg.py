@@ -1,0 +1,116 @@
+"""Linalg parity tests vs scipy/sklearn ground truth."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.linalg
+import scipy.spatial.distance
+
+from sq_learn_tpu.ops.linalg import (
+    centered_svd,
+    pairwise_sq_distances,
+    randomized_svd,
+    row_norms,
+    smallest_singular_value,
+    svd_flip,
+    thin_svd,
+)
+
+
+@pytest.fixture
+def tall():
+    return np.random.RandomState(0).randn(200, 12).astype(np.float32)
+
+
+@pytest.fixture
+def wide():
+    return np.random.RandomState(1).randn(10, 80).astype(np.float32)
+
+
+class TestThinSVD:
+    # the gram path squares the condition number: float32 tolerance is looser
+    @pytest.mark.parametrize("method,atol", [("gram", 5e-2), ("direct", 2e-3)])
+    def test_tall(self, tall, method, atol):
+        U, S, Vt = thin_svd(jnp.asarray(tall), method=method)
+        S_ref = scipy.linalg.svd(tall, compute_uv=False)
+        np.testing.assert_allclose(np.asarray(S), S_ref, rtol=2e-3)
+        recon = np.asarray(U) * np.asarray(S) @ np.asarray(Vt)
+        np.testing.assert_allclose(recon, tall, atol=atol)
+
+    @pytest.mark.parametrize("method,atol", [("gram", 5e-2), ("direct", 2e-3)])
+    def test_wide(self, wide, method, atol):
+        U, S, Vt = thin_svd(jnp.asarray(wide), method=method)
+        S_ref = scipy.linalg.svd(wide, compute_uv=False)
+        np.testing.assert_allclose(np.asarray(S), S_ref, rtol=2e-3)
+        recon = np.asarray(U) * np.asarray(S) @ np.asarray(Vt)
+        np.testing.assert_allclose(recon, wide, atol=atol)
+
+    def test_orthonormal(self, tall):
+        U, S, Vt = thin_svd(jnp.asarray(tall), method="gram")
+        np.testing.assert_allclose(
+            np.asarray(U.T @ U), np.eye(12), atol=5e-3
+        )
+
+
+class TestCenteredSVD:
+    def test_matches_sklearn_pca(self, tall):
+        from sklearn.decomposition import PCA
+
+        mean, U, S, Vt = centered_svd(jnp.asarray(tall))
+        pca = PCA(svd_solver="full").fit(tall)
+        np.testing.assert_allclose(np.asarray(mean), tall.mean(0), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(S), pca.singular_values_, rtol=2e-3)
+        # components match up to the shared svd_flip sign convention
+        np.testing.assert_allclose(
+            np.abs(np.asarray(Vt)), np.abs(pca.components_), atol=2e-2
+        )
+
+
+class TestRandomizedSVD:
+    def test_recovers_low_rank(self, key):
+        rng = np.random.RandomState(2)
+        A = (rng.randn(300, 40) @ np.diag(np.geomspace(100, 0.01, 40)) @
+             rng.randn(40, 30)).astype(np.float32)
+        U, S, Vt = randomized_svd(key, jnp.asarray(A), n_components=10, n_iter=6)
+        S_ref = scipy.linalg.svd(A, compute_uv=False)[:10]
+        np.testing.assert_allclose(np.asarray(S), S_ref, rtol=1e-2)
+
+    def test_wide_input(self, key):
+        A = np.random.RandomState(3).randn(20, 200).astype(np.float32)
+        U, S, Vt = randomized_svd(key, jnp.asarray(A), n_components=5, n_iter=6)
+        assert U.shape == (20, 5) and Vt.shape == (5, 200)
+        S_ref = scipy.linalg.svd(A, compute_uv=False)[:5]
+        np.testing.assert_allclose(np.asarray(S), S_ref, rtol=2e-2)
+
+
+class TestPairwise:
+    def test_matches_cdist(self):
+        X = np.random.RandomState(4).randn(50, 7).astype(np.float32)
+        C = np.random.RandomState(5).randn(4, 7).astype(np.float32)
+        d2 = pairwise_sq_distances(jnp.asarray(X), jnp.asarray(C))
+        ref = scipy.spatial.distance.cdist(X, C, "sqeuclidean")
+        # ‖x‖²+‖c‖²−2x·c cancels catastrophically in float32: ~1% tolerance
+        np.testing.assert_allclose(np.asarray(d2), ref, rtol=2e-2, atol=1e-2)
+
+    def test_row_norms(self):
+        X = np.random.RandomState(6).randn(30, 5).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(row_norms(jnp.asarray(X), squared=True)),
+            (X**2).sum(1),
+            rtol=1e-5,
+        )
+
+
+class TestMisc:
+    def test_svd_flip_deterministic(self, tall):
+        U, S, Vt = thin_svd(jnp.asarray(tall))
+        U1, Vt1 = svd_flip(U, Vt)
+        U2, Vt2 = svd_flip(-U, -Vt)
+        np.testing.assert_allclose(np.asarray(U1), np.asarray(U2), atol=1e-6)
+
+    def test_smallest_singular_value(self, tall):
+        ref = scipy.linalg.svd(tall, compute_uv=False)[-1]
+        np.testing.assert_allclose(
+            float(smallest_singular_value(jnp.asarray(tall))), ref, rtol=5e-2
+        )

@@ -1,0 +1,93 @@
+"""Metrics parity vs sklearn."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import sklearn.metrics
+import sklearn.metrics.pairwise
+
+from sq_learn_tpu.metrics import (
+    accuracy_score,
+    adjusted_rand_score,
+    euclidean_distances,
+    linear_kernel,
+    pairwise_kernels,
+    polynomial_kernel,
+    rbf_kernel,
+    sigmoid_kernel,
+)
+
+
+class TestScores:
+    def test_ari_matches_sklearn(self):
+        rng = np.random.RandomState(0)
+        for _ in range(5):
+            a = rng.randint(0, 5, 100)
+            b = rng.randint(0, 4, 100)
+            np.testing.assert_allclose(
+                float(adjusted_rand_score(a, b)),
+                sklearn.metrics.adjusted_rand_score(a, b),
+                atol=1e-5,
+            )
+
+    def test_ari_perfect_and_permuted(self):
+        labels = np.array([0, 0, 1, 1, 2, 2])
+        assert float(adjusted_rand_score(labels, labels)) == pytest.approx(1.0)
+        permuted = np.array([2, 2, 0, 0, 1, 1])
+        assert float(adjusted_rand_score(labels, permuted)) == pytest.approx(1.0)
+
+    def test_accuracy(self):
+        assert float(accuracy_score([1, 1, -1], [1, -1, -1])) == pytest.approx(2 / 3)
+
+
+class TestKernels:
+    @pytest.fixture
+    def data(self):
+        rng = np.random.RandomState(1)
+        return rng.randn(20, 6).astype(np.float32), rng.randn(8, 6).astype(np.float32)
+
+    def test_linear(self, data):
+        X, Y = data
+        np.testing.assert_allclose(
+            np.asarray(linear_kernel(X, Y)),
+            sklearn.metrics.pairwise.linear_kernel(X, Y),
+            rtol=1e-4,
+        )
+
+    def test_rbf(self, data):
+        X, Y = data
+        np.testing.assert_allclose(
+            np.asarray(rbf_kernel(X, Y, gamma=0.3)),
+            sklearn.metrics.pairwise.rbf_kernel(X, Y, gamma=0.3),
+            rtol=1e-3,
+        )
+
+    def test_poly(self, data):
+        X, Y = data
+        np.testing.assert_allclose(
+            np.asarray(polynomial_kernel(X, Y, degree=2, gamma=0.1, coef0=1.5)),
+            sklearn.metrics.pairwise.polynomial_kernel(X, Y, degree=2, gamma=0.1, coef0=1.5),
+            rtol=1e-3,
+        )
+
+    def test_sigmoid(self, data):
+        X, Y = data
+        np.testing.assert_allclose(
+            np.asarray(sigmoid_kernel(X, Y, gamma=0.05, coef0=0.2)),
+            sklearn.metrics.pairwise.sigmoid_kernel(X, Y, gamma=0.05, coef0=0.2),
+            rtol=1e-3,
+            atol=1e-5,
+        )
+
+    def test_euclidean(self, data):
+        X, Y = data
+        np.testing.assert_allclose(
+            np.asarray(euclidean_distances(X, Y)),
+            sklearn.metrics.pairwise.euclidean_distances(X, Y),
+            rtol=1e-3,
+            atol=1e-3,
+        )
+
+    def test_dispatch_unknown(self, data):
+        with pytest.raises(ValueError, match="unknown kernel"):
+            pairwise_kernels(data[0], metric="nope")

@@ -1,0 +1,170 @@
+"""Tests for QuantumState, noise injectors, tomography and μ-norm search."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sq_learn_tpu.ops.quantum import (
+    QuantumState,
+    best_mu,
+    coupon_collect,
+    estimate_wald,
+    gaussian_estimate,
+    introduce_error,
+    introduce_error_array,
+    linear_search,
+    mu,
+    multinomial_counts,
+    real_tomography,
+    tomography,
+    tomography_incremental,
+    tomography_n_measurements,
+)
+
+
+def random_unit(seed, d):
+    v = np.random.RandomState(seed).randn(d)
+    return v / np.linalg.norm(v)
+
+
+class TestQuantumState:
+    def test_normalizes(self):
+        qs = QuantumState(jnp.arange(4), jnp.array([1.0, 2.0, 3.0, 4.0]))
+        np.testing.assert_allclose(float(jnp.sum(qs.probabilities)), 1.0, atol=1e-6)
+
+    def test_measure_counts(self, key):
+        amps = jnp.array([3.0, 4.0])  # probs 9/25, 16/25
+        qs = QuantumState(jnp.array([0, 1]), amps)
+        counts = qs.measure_counts(key, 100000)
+        freq = np.asarray(counts) / 100000
+        np.testing.assert_allclose(freq, [0.36, 0.64], atol=0.01)
+
+    def test_measure_values(self, key):
+        qs = QuantumState(jnp.array([10.0, 20.0]), jnp.array([1.0, 1.0]))
+        vals = np.asarray(qs.measure(key, 100))
+        assert set(np.unique(vals)) <= {10.0, 20.0}
+
+    def test_get_state(self):
+        qs = QuantumState(jnp.array([5, 6]), jnp.array([1.0, 1.0]))
+        state = qs.get_state()
+        np.testing.assert_allclose(list(state.values()), [0.5, 0.5], atol=1e-6)
+
+    def test_length_mismatch_raises(self):
+        with pytest.raises(ValueError):
+            QuantumState(jnp.arange(3), jnp.array([1.0, 1.0]))
+
+    def test_wald(self, key):
+        counts = multinomial_counts(key, 1000, jnp.array([0.5, 0.5]))
+        est = estimate_wald(counts, 1000)
+        np.testing.assert_allclose(float(est.sum()), 1.0, atol=1e-6)
+
+    def test_coupon_collect(self, key):
+        qs = QuantumState(jnp.arange(5), jnp.ones(5))
+        n = int(coupon_collect(key, qs))
+        assert n >= 5  # needs at least d draws
+
+
+class TestNoise:
+    def test_introduce_error_bounded(self, key):
+        vals = jnp.zeros(1000)
+        out = introduce_error(key, vals, 0.1)
+        assert np.abs(np.asarray(out)).max() <= 0.1 + 1e-6
+
+    def test_introduce_error_array_l2(self, key):
+        arr = jnp.zeros(100)
+        out = introduce_error_array(key, arr, 0.5)
+        assert float(jnp.linalg.norm(out)) <= 0.5 + 1e-5
+
+    def test_gaussian_estimate_l2_bound(self, key):
+        v = jnp.asarray(random_unit(0, 64))
+        est = gaussian_estimate(key, v, 0.3)
+        assert float(jnp.linalg.norm(est - v)) <= 0.3 + 1e-5
+
+    def test_zero_noise_identity(self, key):
+        # reference bug: make_gaussian_est returns undefined var at noise==0
+        v = jnp.asarray(random_unit(1, 16))
+        np.testing.assert_array_equal(np.asarray(gaussian_estimate(key, v, 0.0)), np.asarray(v))
+
+
+class TestTomography:
+    def test_n_formula(self):
+        d, delta = 784, 0.1
+        assert tomography_n_measurements(d, delta, "L2") == int(36 * d * np.log(d) / delta**2)
+        assert tomography_n_measurements(d, delta, "inf") == int(36 * np.log(d) / delta**2)
+
+    def test_l2_error_bound(self, key):
+        d, delta = 50, 0.3
+        v = jnp.asarray(random_unit(2, d))
+        est = real_tomography(key, v, delta=delta)
+        assert float(jnp.linalg.norm(est - v)) <= delta
+
+    def test_sign_resolution(self, key):
+        # components with non-negligible mass must come back with right sign
+        v = jnp.asarray(random_unit(3, 20))
+        est = np.asarray(real_tomography(key, v, delta=0.1))
+        big = np.abs(np.asarray(v)) > 0.15
+        assert (np.sign(est[big]) == np.sign(np.asarray(v)[big])).all()
+
+    def test_preserves_norm_by_default(self, key):
+        v = 5.0 * jnp.asarray(random_unit(4, 30))
+        est = real_tomography(key, v, delta=0.2)
+        np.testing.assert_allclose(float(jnp.linalg.norm(est)), 5.0, rtol=0.05)
+        raw = real_tomography(key, v, delta=0.2, preserve_norm=False)
+        np.testing.assert_allclose(float(jnp.linalg.norm(raw)), 1.0, rtol=0.05)
+
+    def test_matrix_vmap(self, key):
+        A = jnp.asarray(np.vstack([random_unit(s, 16) for s in range(4)]))
+        est = tomography(key, A, 0.3)
+        assert est.shape == A.shape
+        errs = np.linalg.norm(np.asarray(est - A), axis=1)
+        assert (errs <= 0.3).all()
+
+    def test_zero_noise_identity(self, key):
+        A = jnp.asarray(np.random.RandomState(0).randn(3, 5))
+        out = tomography(key, A, 0.0)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(A))
+
+    def test_gaussian_path_matrix(self, key):
+        A = jnp.asarray(np.random.RandomState(1).randn(4, 6))
+        out = tomography(key, A, 0.2, true_tomography=False)
+        # flat-reshape semantics: total perturbation ≤ noise in Frobenius
+        assert float(jnp.linalg.norm(out - A)) <= 0.2 + 1e-5
+
+    def test_incremental_early_stop(self, key):
+        v = jnp.asarray(random_unit(5, 12))
+        res = tomography_incremental(key, v, delta=0.4)
+        ns = list(res.keys())
+        assert ns == sorted(ns)
+        final = res[ns[-1]]
+        assert np.linalg.norm(final - np.asarray(v)) <= 0.4 * 1.5
+
+
+class TestMuNorms:
+    @staticmethod
+    def numpy_mu(p, A):
+        # straight transcription of the μ_p definition (Utility.py:196-212)
+        def s(q, M):
+            if q == 0:
+                return max(np.count_nonzero(M[i]) for i in range(len(M)))
+            return np.max(np.sum(np.abs(M) ** q, axis=1))
+
+        return np.sqrt(s(2 * p, A) * s(2 * (1 - p), A.T))
+
+    def test_matches_definition(self):
+        A = np.random.RandomState(0).randn(10, 6)
+        for p in (0.0, 0.3, 0.5, 1.0):
+            np.testing.assert_allclose(float(mu(A, p)), self.numpy_mu(p, A), rtol=1e-5)
+
+    def test_linear_search_minimizes(self):
+        A = np.random.RandomState(1).randn(12, 8)
+        best_p, best_val = linear_search(A, 0.0, 1.0, 0.1)
+        grid = list(np.arange(0.0, 1.0, 0.1)) + [1.0]
+        vals = [self.numpy_mu(p, A) for p in grid]
+        np.testing.assert_allclose(best_val, min(vals), rtol=1e-5)
+
+    def test_best_mu_vs_frobenius(self):
+        A = np.eye(8)
+        desc, val = best_mu(A)
+        assert val <= np.linalg.norm(A) + 1e-6
+        assert desc.startswith("p=") or desc == "Frobenius"

@@ -1,0 +1,203 @@
+"""Property tests for the quantum estimation routines (SURVEY §4 test plan:
+error bounds hold with the advertised probability, vectorized over many seeds
+so they're cheap on accelerators)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sq_learn_tpu.ops.quantum import (
+    amplitude_estimation,
+    amplitude_estimation_M,
+    amplitude_estimation_per_eps,
+    consistent_phase_estimation,
+    inner_product_estimates,
+    ipe,
+    median_evaluation,
+    median_q,
+    phase_estimation,
+    phase_estimation_m,
+)
+from sq_learn_tpu.ops.quantum.sampling import fejer_grid_sample, fejer_probs
+
+
+def exact_fejer_pmf(pos, M):
+    """Reference pmf: p(j) ∝ |sin(π(pos−j))/(M sin(π(pos−j)/M))|², j=0..M−1,
+    circular — mirrors Utility.py:498-506 built in numpy."""
+    j = np.arange(M)
+    diff = (pos - j) / M
+    diff = diff - np.round(diff)  # circular distance in grid-fraction units
+    p = np.empty(M)
+    for i, d in enumerate(diff):
+        if abs(np.sin(np.pi * d)) < 1e-15:
+            p[i] = 1.0
+        else:
+            p[i] = (np.sin(np.pi * M * d) / (M * np.sin(np.pi * d))) ** 2
+    return p / p.sum()
+
+
+class TestFejerSampler:
+    def test_matches_exact_pmf_small_M(self, key):
+        M = 32
+        pos = 7.3
+        n = 40000
+        j = fejer_grid_sample(key, jnp.full((n,), pos), float(M), window=64)
+        counts = np.bincount(np.asarray(j).astype(int), minlength=M)
+        emp = counts / n
+        pmf = exact_fejer_pmf(pos, M)
+        assert 0.5 * np.abs(emp - pmf).sum() < 0.02  # total variation
+
+    def test_wraps_circularly(self, key):
+        # true value at grid position 0.2 → mass on both j=0 and j=M−1 side
+        M = 64
+        j = np.asarray(fejer_grid_sample(key, jnp.full((20000,), 0.2), float(M), 32))
+        assert j.min() >= 0 and j.max() <= M - 1
+        assert (j > M / 2).mean() > 0.02  # wrapped mass present
+
+    def test_per_element_traced_M(self, key):
+        Ms = jnp.array([8.0, 64.0, 1024.0])
+        pos = jnp.array([2.2, 31.7, 512.4])
+        j = fejer_grid_sample(key, pos, Ms, window=16)
+        assert j.shape == (3,)
+        assert (np.asarray(j) < np.asarray(Ms)).all()
+
+    def test_probs_limit(self):
+        assert float(fejer_probs(0.0, 32)) == 1.0
+        assert float(fejer_probs(1.0, 32)) == 1.0  # integer distance → peak
+
+
+class TestAmplitudeEstimation:
+    def test_error_bound(self, key):
+        a = jax.random.uniform(jax.random.PRNGKey(7), (500,), minval=0.02, maxval=0.98)
+        eps = 0.01
+        est = amplitude_estimation(key, a, epsilon=eps, gamma=0.05)
+        # standard AE bound: |ã−a| ≤ 2πε√(a(1−a)) + π²ε² w.p. ≥ 1−γ
+        bound = 2 * np.pi * eps * np.sqrt(np.asarray(a * (1 - a))) + (np.pi * eps) ** 2
+        frac_ok = (np.abs(np.asarray(est - a)) <= bound).mean()
+        assert frac_ok >= 0.93
+
+    def test_exact_endpoints(self, key):
+        est = amplitude_estimation(key, jnp.array([0.0, 1.0]), epsilon=0.01, gamma=0.01)
+        np.testing.assert_allclose(np.asarray(est), [0.0, 1.0], atol=5e-3)
+
+    def test_M_formula(self):
+        # reference Utility.py:484
+        assert amplitude_estimation_M(0.01) == int(
+            np.ceil((np.pi / 0.02) * (1 + np.sqrt(1.04)))
+        )
+
+    def test_scalar_shape(self, key):
+        est = amplitude_estimation(key, 0.3, epsilon=0.05)
+        assert est.shape == ()
+
+    def test_per_eps_variant(self, key):
+        a = jnp.full((200,), 0.4)
+        eps = jnp.geomspace(0.001, 0.1, 200)
+        est = amplitude_estimation_per_eps(key, a, eps, Q=13)
+        err = np.abs(np.asarray(est) - 0.4)
+        # finer epsilon → smaller error on average
+        assert err[:50].mean() < err[-50:].mean() + 0.02
+        assert (err <= 4 * np.asarray(eps) + 1e-3).mean() > 0.9
+
+
+class TestPhaseEstimation:
+    def test_matches_pmf(self, key):
+        m, omega = 6, 0.37
+        M = 2**m
+        est = phase_estimation(key, jnp.full((30000,), omega), m=m)
+        ks = np.asarray(est * M).astype(int)
+        emp = np.bincount(ks, minlength=M) / len(ks)
+        pmf = exact_fejer_pmf(omega * M, M)
+        assert 0.5 * np.abs(emp - pmf).sum() < 0.02
+
+    def test_error_bound(self, key):
+        eps, gamma = 0.01, 0.1
+        omega = jax.random.uniform(jax.random.PRNGKey(3), (500,))
+        est = phase_estimation(key, omega, epsilon=eps, gamma=gamma)
+        err = np.abs(np.asarray(est - omega))
+        err = np.minimum(err, 1 - err)  # circular
+        assert (err <= eps).mean() >= 1 - gamma - 0.03
+
+    def test_omega_one_special_case(self, key):
+        m = 5
+        est = phase_estimation(key, jnp.array([1.0]), m=m)
+        assert float(est[0]) == (2**m - 1) / 2**m
+
+    def test_m_formula(self):
+        # Nielsen & Chuang eq. 5.35, reference Utility.py:635
+        assert phase_estimation_m(0.01, 0.1) == int(
+            np.ceil(np.log2(100)) + np.ceil(np.log2(2 + 1 / 0.2))
+        )
+
+
+class TestConsistentPhaseEstimation:
+    def test_consistency(self, key):
+        # the whole point: repeated calls agree almost always (Utility.py:770)
+        omega = 0.4321
+        keys = jax.random.split(key, 50)
+        ests = np.array([
+            float(consistent_phase_estimation(k, omega, epsilon=0.05, gamma=0.1))
+            for k in keys
+        ])
+        values, counts = np.unique(np.round(ests, 6), return_counts=True)
+        assert counts.max() / len(ests) >= 0.9
+
+    def test_accuracy(self, key):
+        omega = jax.random.uniform(jax.random.PRNGKey(11), (200,), minval=0.05, maxval=0.95)
+        est = consistent_phase_estimation(key, omega, epsilon=0.02, gamma=0.1)
+        assert (np.abs(np.asarray(est - omega)) <= 2 * 0.02).mean() > 0.95
+
+    def test_non_negative(self, key):
+        est = consistent_phase_estimation(key, jnp.array([0.001]), epsilon=0.05, gamma=0.1)
+        assert float(est[0]) >= 0.0
+
+
+class TestMedianEvaluation:
+    def test_q_odd_and_formula(self):
+        for gamma in (0.3, 0.1, 0.01, 0.001):
+            q = median_q(gamma)
+            assert q % 2 == 1
+            z = np.log(1 / gamma) / (2 * (8 / np.pi**2 - 0.5) ** 2)
+            assert q in (int(np.ceil(z)), int(np.ceil(z)) + 1)
+
+    def test_boosts_concentration(self, key):
+        noisy = lambda key: jax.random.normal(key) * 0.5 + 1.0
+        est = median_evaluation(noisy, key, gamma=0.001)
+        assert abs(float(est) - 1.0) < 0.5
+
+
+class TestIPE:
+    def test_relative_error_guarantee(self, key):
+        # RIPE: |s − ⟨x,y⟩| ≤ ε·max(1, |⟨x,y⟩|) w.p. ≥ 1−γ
+        kx, ky = jax.random.split(jax.random.PRNGKey(5))
+        x = jax.random.normal(kx, (300, 20))
+        y = jax.random.normal(ky, (300, 20))
+        true_ip = jnp.sum(x * y, axis=1)
+        eps = 0.05
+        s = ipe(
+            key,
+            jnp.sum(x * x, axis=1),
+            jnp.sum(y * y, axis=1),
+            true_ip,
+            epsilon=eps,
+            gamma=0.05,
+        )
+        tol = eps * np.maximum(1.0, np.abs(np.asarray(true_ip)))
+        assert (np.abs(np.asarray(s - true_ip)) <= tol).mean() >= 0.9
+
+    def test_matrix_pairs(self, key):
+        X = jax.random.normal(jax.random.PRNGKey(1), (40, 8))
+        C = jax.random.normal(jax.random.PRNGKey(2), (5, 8))
+        est = inner_product_estimates(key, X, C, epsilon=0.01, gamma=0.1)
+        assert est.shape == (40, 5)
+        true = np.asarray(X @ C.T)
+        tol = 0.05 * np.maximum(1.0, np.abs(true))
+        assert (np.abs(np.asarray(est) - true) <= tol).mean() > 0.9
+
+    def test_jittable(self, key):
+        f = jax.jit(
+            lambda k, x2, y2, ip: ipe(k, x2, y2, ip, epsilon=0.1, Q=5)
+        )
+        out = f(key, jnp.array(2.0), jnp.array(3.0), jnp.array(1.5))
+        assert np.isfinite(float(out))
