@@ -63,12 +63,12 @@ def set_config(device=None, default_dtype=None, assume_finite=None,
         if default_dtype not in ("float32", "float64", "bfloat16"):
             raise ValueError(f"unsupported default_dtype {default_dtype!r}")
         local_config["default_dtype"] = default_dtype
-        if default_dtype == "float64":
-            # Without x64, jnp silently downcasts float64 inputs to float32 —
-            # honoring the user's opt-in requires flipping the global flag.
-            import jax
+        # Without x64, jnp silently downcasts float64 inputs to float32 —
+        # honoring the opt-in requires flipping jax's flag. NOTE: unlike the
+        # dict config this is process-global (jax has a single x64 mode).
+        import jax
 
-            jax.config.update("jax_enable_x64", True)
+        jax.config.update("jax_enable_x64", default_dtype == "float64")
     if assume_finite is not None:
         local_config["assume_finite"] = bool(assume_finite)
     if interactive_checks is not None:
@@ -77,8 +77,12 @@ def set_config(device=None, default_dtype=None, assume_finite=None,
 
 @contextmanager
 def config_context(**new_config):
-    """Context manager that temporarily overrides the global configuration."""
+    """Context manager that temporarily overrides the global configuration
+    (including jax's process-global x64 mode, which is restored on exit)."""
+    import jax
+
     old_config = get_config()
+    old_x64 = jax.config.jax_enable_x64
     set_config(**new_config)
     try:
         yield
@@ -86,6 +90,7 @@ def config_context(**new_config):
         local_config = _get_threadlocal_config()
         local_config.clear()
         local_config.update(old_config)
+        jax.config.update("jax_enable_x64", old_x64)
 
 
 def resolve_device():

@@ -1,0 +1,15 @@
+"""Estimators (reference layer L4): quantum and classical model families."""
+
+from .qkmeans import KMeans, QKMeans, kmeans_plusplus, lloyd_single
+
+try:
+    from .qpca import PCA, QPCA
+except ImportError:  # pragma: no cover — lands incrementally
+    PCA = QPCA = None
+try:
+    from .qlssvc import QLSSVC
+except ImportError:  # pragma: no cover
+    QLSSVC = None
+
+__all__ = ["KMeans", "QKMeans", "QPCA", "PCA", "QLSSVC", "kmeans_plusplus",
+           "lloyd_single"]

@@ -1,0 +1,23 @@
+"""SPMD parallelism: device meshes, shardings, and the sharded Lloyd kernel.
+
+(The reference has no distributed backend — its collectives are OpenMP
+thread reductions; SURVEY §2.3 maps them to psum over an ICI mesh.)
+"""
+
+from .mesh import (
+    DATA_AXIS,
+    data_sharding,
+    make_mesh,
+    pad_to_multiple,
+    replicated,
+    shard_rows,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "data_sharding",
+    "make_mesh",
+    "pad_to_multiple",
+    "replicated",
+    "shard_rows",
+]

@@ -1,0 +1,52 @@
+"""Data-parallel Lloyd iteration over a device mesh.
+
+The reference parallelizes Lloyd with OpenMP threads and a GIL-guarded
+partial-centroid reduction (``_k_means_lloyd.pyx:118-154``). Here the same
+structure runs SPMD: X is sharded over the mesh's data axis, each device runs
+the fused E/M kernel on its shard, and the partial centroid sums / counts /
+inertia are combined with ``lax.psum`` over ICI inside ``shard_map``. The
+entire while-loop executes on device; convergence is decided on the
+(replicated) global center shift, so every device exits in lockstep.
+"""
+
+import functools
+
+import numpy as np
+import jax
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+from .mesh import DATA_AXIS
+from ..models.qkmeans import lloyd_single
+
+
+def lloyd_single_sharded(mesh, key, X, weights, centers_init, x_sq_norms,
+                         **static):
+    """Run :func:`~sq_learn_tpu.models.qkmeans.lloyd_single` under
+    ``shard_map`` with axis-0 sharding of X / weights / x_sq_norms.
+
+    Pads the sample axis to a device-count multiple (padded rows get weight
+    0, so they contribute nothing to sums, counts, or inertia).
+
+    Returns (labels, inertia, centers, n_iter) with labels trimmed back to
+    the original length.
+    """
+    n_dev = mesh.devices.size
+    n = int(X.shape[0])
+    pad = (-n) % n_dev
+    if pad:
+        X = jax.numpy.pad(X, ((0, pad), (0, 0)))
+        weights = jax.numpy.pad(weights, (0, pad))
+        x_sq_norms = jax.numpy.pad(x_sq_norms, (0, pad))
+
+    run = functools.partial(lloyd_single, axis_name=DATA_AXIS, **static)
+    sharded = shard_map(
+        run,
+        mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(), P(DATA_AXIS)),
+        out_specs=(P(DATA_AXIS), P(), P(), P()),
+    )
+    labels, inertia, centers, n_iter = jax.jit(sharded)(
+        key, X, weights, centers_init, x_sq_norms
+    )
+    return labels[:n], inertia, centers, n_iter

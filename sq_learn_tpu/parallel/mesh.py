@@ -1,0 +1,53 @@
+"""Device mesh and sharding helpers.
+
+The reference's parallelism is OpenMP threads + fork-join pools (SURVEY §2.3).
+The TPU-native equivalent is SPMD over a ``jax.sharding.Mesh``: data-parallel
+sharding of the sample axis with ``psum`` reductions over ICI. These helpers
+centralize mesh construction and host→device placement so estimators only
+say "shard X over the data axis".
+"""
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+
+
+def make_mesh(devices=None, axis_name=DATA_AXIS):
+    """Build a 1-D data-parallel mesh over ``devices`` (default: all devices
+    of the configured platform)."""
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(np.asarray(devices), (axis_name,))
+
+
+def data_sharding(mesh, axis_name=DATA_AXIS):
+    """Sharding that splits axis 0 over the mesh."""
+    return NamedSharding(mesh, P(axis_name))
+
+
+def replicated(mesh):
+    return NamedSharding(mesh, P())
+
+
+def pad_to_multiple(X, multiple, pad_value=0.0):
+    """Pad axis 0 to a device-count multiple (SPMD needs equal shards).
+
+    Returns (padded_array, original_length). Padding rows carry
+    ``pad_value`` and must be masked out by the caller via sample weights.
+    """
+    n = X.shape[0]
+    remainder = n % multiple
+    if remainder == 0:
+        return X, n
+    pad = multiple - remainder
+    pad_width = ((0, pad),) + ((0, 0),) * (X.ndim - 1)
+    return np.pad(np.asarray(X), pad_width, constant_values=pad_value), n
+
+
+def shard_rows(mesh, *arrays, axis_name=DATA_AXIS):
+    """Place arrays with axis 0 sharded over the mesh."""
+    sharding = data_sharding(mesh, axis_name)
+    out = tuple(jax.device_put(a, sharding) for a in arrays)
+    return out if len(out) > 1 else out[0]

@@ -1,0 +1,212 @@
+"""q-means tests: δ=0 classical parity vs sklearn, quantum noise modes,
+sharded-mesh equivalence (SURVEY §4 test plan items 2 and 4)."""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import sklearn.cluster
+import sklearn.datasets
+import sklearn.metrics
+
+from sq_learn_tpu import clone
+from sq_learn_tpu.metrics import adjusted_rand_score
+from sq_learn_tpu.models import KMeans, QKMeans, kmeans_plusplus
+from sq_learn_tpu.ops.linalg import row_norms
+
+
+@pytest.fixture(scope="module")
+def blobs():
+    X, y = sklearn.datasets.make_blobs(
+        n_samples=400, centers=4, cluster_std=0.8, random_state=7
+    )
+    return X.astype(np.float32), y
+
+
+@pytest.fixture(scope="module")
+def digits():
+    X, y = sklearn.datasets.load_digits(return_X_y=True)
+    return X.astype(np.float32), y
+
+
+class TestClassicalParity:
+    def test_matches_sklearn_with_same_init(self, blobs):
+        X, _ = blobs
+        init = X[:4].copy()
+        ours = KMeans(n_clusters=4, init=init, n_init=1, max_iter=100,
+                      random_state=0).fit(X)
+        ref = sklearn.cluster.KMeans(n_clusters=4, init=init, n_init=1,
+                                     max_iter=100, algorithm="lloyd").fit(X)
+        assert float(adjusted_rand_score(ours.labels_, ref.labels_)) == pytest.approx(1.0)
+        np.testing.assert_allclose(
+            np.sort(ours.cluster_centers_, axis=0),
+            np.sort(ref.cluster_centers_, axis=0),
+            rtol=1e-3, atol=1e-3,
+        )
+        np.testing.assert_allclose(ours.inertia_, ref.inertia_, rtol=1e-3)
+
+    def test_delta_zero_warns_classic(self, blobs):
+        X, _ = blobs
+        with pytest.warns(UserWarning, match="classic version"):
+            QKMeans(n_clusters=4, delta=0, n_init=1, random_state=0).fit(X)
+
+    def test_recovers_blobs(self, blobs):
+        X, y = blobs
+        km = KMeans(n_clusters=4, n_init=3, random_state=0).fit(X)
+        assert float(adjusted_rand_score(km.labels_, y)) > 0.95
+
+    def test_digits_ari_comparable_to_sklearn(self, digits):
+        X, y = digits
+        ours = KMeans(n_clusters=10, n_init=3, random_state=1).fit(X)
+        ref = sklearn.cluster.KMeans(n_clusters=10, n_init=3,
+                                     random_state=1).fit(X)
+        ari_ours = float(adjusted_rand_score(ours.labels_, y))
+        ari_ref = sklearn.metrics.adjusted_rand_score(ref.labels_, y)
+        assert ari_ours > ari_ref - 0.1  # same ballpark (~0.6 on digits)
+
+
+class TestQuantumModes:
+    def test_delta_means_small_noise(self, blobs):
+        X, y = blobs
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            qm = QKMeans(n_clusters=4, delta=0.5, true_distance_estimate=False,
+                         n_init=2, random_state=0).fit(X)
+        assert float(adjusted_rand_score(qm.labels_, y)) > 0.9
+
+    def test_delta_means_large_noise_degrades(self, blobs):
+        X, y = blobs
+        qm = QKMeans(n_clusters=4, delta=1e4, true_distance_estimate=False,
+                     n_init=1, max_iter=20, random_state=0).fit(X)
+        # with a huge δ-window labels are near-uniform → ARI collapses
+        assert float(adjusted_rand_score(qm.labels_, y)) < 0.5
+
+    def test_ipe_mode(self, blobs):
+        X, y = blobs
+        qm = QKMeans(n_clusters=4, delta=0.8, true_distance_estimate=True,
+                     ipe_q=5, n_init=1, max_iter=50, random_state=0).fit(X)
+        assert float(adjusted_rand_score(qm.labels_, y)) > 0.8
+
+    def test_intermediate_error_gaussian(self, blobs):
+        X, y = blobs
+        qm = QKMeans(n_clusters=4, delta=0.5, true_distance_estimate=False,
+                     intermediate_error=True, true_tomography=False,
+                     n_init=1, max_iter=50, random_state=0).fit(X)
+        assert float(adjusted_rand_score(qm.labels_, y)) > 0.8
+
+    def test_intermediate_error_requires_delta(self, blobs):
+        X, _ = blobs
+        with pytest.raises(ValueError, match="intermediate_error"):
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                QKMeans(n_clusters=4, delta=0, intermediate_error=True).fit(X)
+
+    def test_runtime_model(self, blobs):
+        X, _ = blobs
+        qm = QKMeans(n_clusters=4, delta=0.5, true_distance_estimate=False,
+                     n_init=1, random_state=0).fit(X)
+        q, c = qm.quantum_runtime_model(np.array([1e4, 1e6]), np.array([64.0, 64.0]))
+        assert (q > 0).all() and (c > 0).all()
+
+
+class TestShardedLloyd:
+    def test_mesh_fit_matches_single_device(self, blobs, mesh8):
+        X, y = blobs
+        init = X[:4].copy()
+        single = KMeans(n_clusters=4, init=init, n_init=1, random_state=0).fit(X)
+        sharded = KMeans(n_clusters=4, init=init, n_init=1, random_state=0,
+                         mesh=mesh8).fit(X)
+        assert float(adjusted_rand_score(single.labels_, sharded.labels_)) == pytest.approx(1.0)
+        np.testing.assert_allclose(single.inertia_, sharded.inertia_, rtol=1e-3)
+        np.testing.assert_allclose(
+            np.sort(single.cluster_centers_, 0),
+            np.sort(sharded.cluster_centers_, 0), rtol=1e-3, atol=1e-3)
+
+    def test_mesh_with_padding(self, mesh8):
+        # 403 samples does not divide 8 → padding path
+        X, y = sklearn.datasets.make_blobs(n_samples=403, centers=3,
+                                           random_state=3)
+        X = X.astype(np.float32)
+        km = KMeans(n_clusters=3, n_init=1, random_state=0, mesh=mesh8).fit(X)
+        assert km.labels_.shape == (403,)
+        assert float(adjusted_rand_score(km.labels_, y)) > 0.9
+
+    def test_mesh_quantum_mode(self, blobs, mesh8):
+        X, y = blobs
+        qm = QKMeans(n_clusters=4, delta=0.5, true_distance_estimate=False,
+                     n_init=1, random_state=0, mesh=mesh8).fit(X)
+        assert float(adjusted_rand_score(qm.labels_, y)) > 0.85
+
+
+class TestEstimatorAPI:
+    def test_predict_consistent_with_fit(self, blobs):
+        X, _ = blobs
+        km = KMeans(n_clusters=4, n_init=1, random_state=0).fit(X)
+        pred = km.predict(X)
+        assert float(adjusted_rand_score(pred, km.labels_)) > 0.99
+
+    def test_transform_shape(self, blobs):
+        X, _ = blobs
+        km = KMeans(n_clusters=4, n_init=1, random_state=0).fit(X)
+        d = km.transform(X[:10])
+        assert d.shape == (10, 4)
+        assert (d >= 0).all()
+
+    def test_fit_predict_and_score(self, blobs):
+        X, _ = blobs
+        km = KMeans(n_clusters=4, n_init=1, random_state=0)
+        labels = km.fit_predict(X)
+        assert labels.shape == (400,)
+        s = km.score(X)
+        assert s == pytest.approx(-km.inertia_, rel=1e-2)
+
+    def test_clone_and_params(self):
+        qm = QKMeans(n_clusters=5, delta=0.3, ipe_q=7)
+        c = clone(qm)
+        assert c.get_params()["n_clusters"] == 5
+        assert c.get_params()["delta"] == 0.3
+        assert c.get_params()["ipe_q"] == 7
+
+    def test_sample_weight_zero_excludes(self):
+        rng = np.random.RandomState(0)
+        X = np.vstack([rng.randn(50, 2), rng.randn(50, 2) + 10,
+                       rng.randn(5, 2) + 100])  # 5 outliers
+        w = np.ones(105)
+        w[100:] = 0.0  # outliers carry no weight
+        km = KMeans(n_clusters=2, n_init=2, random_state=0).fit(X, sample_weight=w)
+        # centers must be near the two weighted blobs, not dragged to 100
+        assert np.abs(km.cluster_centers_).max() < 20
+
+    def test_validation_errors(self, blobs):
+        X, _ = blobs
+        with pytest.raises(ValueError, match="n_init"):
+            KMeans(n_clusters=2, n_init=0).fit(X)
+        with pytest.raises(ValueError, match="n_samples"):
+            KMeans(n_clusters=1000).fit(X)
+        with pytest.raises(ValueError, match="init"):
+            KMeans(n_clusters=2, init="bogus").fit(X)
+
+    def test_explicit_init_array_single_run(self, blobs):
+        X, _ = blobs
+        km = KMeans(n_clusters=4, init=X[:4].copy(), random_state=0).fit(X)
+        assert km.cluster_centers_.shape == (4, 2)
+
+
+class TestKMeansPlusPlus:
+    def test_returns_distinct_points(self, key, blobs):
+        X, _ = blobs
+        Xd = jnp.asarray(X)
+        centers, idx = kmeans_plusplus(key, Xd, row_norms(Xd, squared=True), 4)
+        assert len(np.unique(np.asarray(idx))) == 4
+        for i, ind in enumerate(np.asarray(idx)):
+            np.testing.assert_allclose(np.asarray(centers)[i], X[ind])
+
+    def test_spreads_centers(self, key, blobs):
+        # k-means++ centers should land in distinct blobs most of the time
+        X, y = blobs
+        Xd = jnp.asarray(X)
+        centers, idx = kmeans_plusplus(key, Xd, row_norms(Xd, squared=True), 4)
+        blobs_hit = len(np.unique(y[np.asarray(idx)]))
+        assert blobs_hit >= 3
