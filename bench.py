@@ -1,0 +1,78 @@
+"""Headline benchmark (BASELINE.md config #1): q-means on digits 1797x64 k=10.
+
+Compares our TPU q-means (delta-means quantum mode) fit wall-clock against
+classical scikit-learn KMeans on the same data/settings, and checks ARI
+agreement. Prints ONE JSON line:
+    {"metric": ..., "value": seconds, "unit": "s", "vs_baseline": ratio}
+vs_baseline = sklearn_seconds / our_seconds (>1 means we are faster).
+"""
+
+import json
+import sys
+import time
+import warnings
+
+import numpy as np
+
+warnings.filterwarnings("ignore")
+
+
+def load_digits_data():
+    try:
+        from sklearn.datasets import load_digits
+
+        d = load_digits()
+        return d.data.astype(np.float32), d.target
+    except Exception:
+        from sq_learn_tpu.datasets import load_digits as _ld
+
+        d = _ld()
+        return d.data.astype(np.float32), d.target
+
+
+def main():
+    X, y = load_digits_data()
+    k, n_init, max_iter, seed = 10, 10, 300, 0
+
+    import jax
+    from sq_learn_tpu.models import QKMeans
+
+    est = QKMeans(n_clusters=k, n_init=n_init, max_iter=max_iter,
+                  delta=0.5, true_distance_estimate=False,  # delta-means mode
+                  random_state=seed)
+    est.fit(X)  # warm-up: compile + first run
+    t0 = time.perf_counter()
+    est.fit(X)
+    jax.block_until_ready(jax.device_put(0))
+    ours = time.perf_counter() - t0
+
+    sk_time = None
+    ari_vs_sklearn = None
+    try:
+        from sklearn.cluster import KMeans as SKKMeans
+        from sklearn.metrics import adjusted_rand_score
+
+        sk = SKKMeans(n_clusters=k, n_init=n_init, max_iter=max_iter,
+                      random_state=seed)
+        sk.fit(X)  # warm-up caches
+        t0 = time.perf_counter()
+        sk.fit(X)
+        sk_time = time.perf_counter() - t0
+        ari_vs_sklearn = float(adjusted_rand_score(sk.labels_, est.labels_))
+    except Exception as exc:  # sklearn missing: report absolute time only
+        print(f"# sklearn baseline unavailable: {exc}", file=sys.stderr)
+
+    result = {
+        "metric": "qkmeans_digits_1797x64_k10_fit_wallclock",
+        "value": round(ours, 4),
+        "unit": "s",
+        "vs_baseline": round(sk_time / ours, 3) if sk_time else 1.0,
+    }
+    if ari_vs_sklearn is not None:
+        print(f"# sklearn={sk_time:.4f}s ARI(ours,sklearn)={ari_vs_sklearn:.3f}",
+              file=sys.stderr)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

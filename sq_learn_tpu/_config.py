@@ -66,9 +66,13 @@ def set_config(device=None, default_dtype=None, assume_finite=None,
         # Without x64, jnp silently downcasts float64 inputs to float32 —
         # honoring the opt-in requires flipping jax's flag. NOTE: unlike the
         # dict config this is process-global (jax has a single x64 mode).
-        import jax
+        # Only ever *enable* it here: x64 may have been turned on
+        # independently (JAX_ENABLE_X64=1) for work outside this library,
+        # so selecting a 32-bit default must not clobber it.
+        if default_dtype == "float64":
+            import jax
 
-        jax.config.update("jax_enable_x64", default_dtype == "float64")
+            jax.config.update("jax_enable_x64", True)
     if assume_finite is not None:
         local_config["assume_finite"] = bool(assume_finite)
     if interactive_checks is not None:

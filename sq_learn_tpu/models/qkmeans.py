@@ -122,7 +122,6 @@ def lloyd_single(key, X, weights, centers_init, x_sq_norms, *, delta=0.0,
     """
     if mode not in LloydMode:
         raise ValueError(f"mode must be one of {LloydMode}, got {mode!r}")
-    n = X.shape[0]
 
     estep = functools.partial(e_step, delta=delta, mode=mode, ipe_q=ipe_q,
                               axis_name=axis_name)
@@ -338,7 +337,9 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
     def fit(self, X, y=None, sample_weight=None):
         """Compute q-means clustering (reference ``qMeans_.fit``,
         ``_dmeans.py:1211-1325``)."""
-        X = check_array(X, copy=self.copy_x)
+        # fit never mutates X in place (centering allocates), so no defensive
+        # copy is needed; copy_x is accepted for API parity only
+        X = check_array(X, copy=False)
         self._check_params(X)
         delta = 0.0 if self.delta is None else float(self.delta)
         if delta == 0:
@@ -349,12 +350,15 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
                     "intermediate_error cannot be True if delta is zero.")
         sample_weight = check_sample_weight(sample_weight, X)
 
-        # quantum runtime-model parameters (reference _dmeans.py:1242-1245;
-        # σ_min via Gram eigh instead of a full SVD)
-        self.eta_ = float(np.max(row_norms(X, squared=True)))
-        self.norm_mu_, self.mu_ = best_mu(X, 0.0, step=0.1)
-        sigma_min = float(smallest_singular_value(X))
-        self.condition_number_ = 1.0 / sigma_min if sigma_min > 0 else np.inf
+        if delta > 0:
+            # quantum runtime-model parameters (reference _dmeans.py:1242-1245;
+            # σ_min via Gram eigh instead of a full SVD). Only consumed by
+            # quantum_runtime_model, which requires delta > 0 — skip the
+            # O(n·m²) scans entirely on the classical path.
+            self.eta_ = float(np.max(row_norms(X, squared=True)))
+            self.norm_mu_, self.mu_ = best_mu(X, 0.0, step=0.1)
+            sigma_min = float(smallest_singular_value(X))
+            self.condition_number_ = 1.0 / sigma_min if sigma_min > 0 else np.inf
 
         tol_ = tolerance(X, self.tol)
         key = as_key(self.random_state)

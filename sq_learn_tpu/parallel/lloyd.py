@@ -11,13 +11,26 @@ entire while-loop executes on device; convergence is decided on the
 
 import functools
 
-import numpy as np
 import jax
 from jax.sharding import PartitionSpec as P
 from jax import shard_map
 
-from .mesh import DATA_AXIS
+from .mesh import DATA_AXIS, pad_to_multiple
 from ..models.qkmeans import lloyd_single
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_lloyd(mesh, static):
+    """Jitted shard_map'd Lloyd kernel, cached per (mesh, static-config) so
+    repeated calls (n_init restarts, refits) hit one compile cache instead of
+    retracing a fresh closure every call."""
+    run = functools.partial(lloyd_single, axis_name=DATA_AXIS, **dict(static))
+    return jax.jit(shard_map(
+        run,
+        mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(), P(DATA_AXIS)),
+        out_specs=(P(DATA_AXIS), P(), P(), P()),
+    ))
 
 
 def lloyd_single_sharded(mesh, key, X, weights, centers_init, x_sq_norms,
@@ -32,21 +45,12 @@ def lloyd_single_sharded(mesh, key, X, weights, centers_init, x_sq_norms,
     the original length.
     """
     n_dev = mesh.devices.size
-    n = int(X.shape[0])
-    pad = (-n) % n_dev
-    if pad:
-        X = jax.numpy.pad(X, ((0, pad), (0, 0)))
-        weights = jax.numpy.pad(weights, (0, pad))
-        x_sq_norms = jax.numpy.pad(x_sq_norms, (0, pad))
+    X, n = pad_to_multiple(X, n_dev)
+    weights, _ = pad_to_multiple(weights, n_dev)
+    x_sq_norms, _ = pad_to_multiple(x_sq_norms, n_dev)
 
-    run = functools.partial(lloyd_single, axis_name=DATA_AXIS, **static)
-    sharded = shard_map(
-        run,
-        mesh=mesh,
-        in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(), P(DATA_AXIS)),
-        out_specs=(P(DATA_AXIS), P(), P(), P()),
-    )
-    labels, inertia, centers, n_iter = jax.jit(sharded)(
+    run = _sharded_lloyd(mesh, tuple(sorted(static.items())))
+    labels, inertia, centers, n_iter = run(
         key, X, weights, centers_init, x_sq_norms
     )
     return labels[:n], inertia, centers, n_iter

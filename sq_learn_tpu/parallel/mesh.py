@@ -37,13 +37,15 @@ def pad_to_multiple(X, multiple, pad_value=0.0):
     Returns (padded_array, original_length). Padding rows carry
     ``pad_value`` and must be masked out by the caller via sample weights.
     """
+    import jax.numpy as jnp
+
     n = X.shape[0]
     remainder = n % multiple
     if remainder == 0:
         return X, n
     pad = multiple - remainder
     pad_width = ((0, pad),) + ((0, 0),) * (X.ndim - 1)
-    return np.pad(np.asarray(X), pad_width, constant_values=pad_value), n
+    return jnp.pad(jnp.asarray(X), pad_width, constant_values=pad_value), n
 
 
 def shard_rows(mesh, *arrays, axis_name=DATA_AXIS):
