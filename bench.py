@@ -26,8 +26,7 @@ def load_digits_data():
     except Exception:
         from sq_learn_tpu.datasets import load_digits as _ld
 
-        d = _ld()
-        return d.data.astype(np.float32), d.target
+        return _ld()
 
 
 def main():

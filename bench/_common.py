@@ -1,0 +1,31 @@
+"""Shared benchmark plumbing: timing, JSON-line emission, warm-up."""
+
+import json
+import sys
+import time
+
+
+def timed(fn, *args, warmup=1, reps=1, **kwargs):
+    """Run ``fn`` with ``warmup`` discarded calls (compile amortization),
+    return (best wall-clock of ``reps``, last result)."""
+    result = None
+    for _ in range(warmup):
+        result = fn(*args, **kwargs)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        result = fn(*args, **kwargs)
+        best = min(best, time.perf_counter() - t0)
+    return best, result
+
+
+def emit(metric, value, unit="s", vs_baseline=1.0, **extra):
+    """Print the ONE machine-readable JSON line (extras go to stderr)."""
+    if extra:
+        print("# " + json.dumps(extra), file=sys.stderr)
+    print(json.dumps({
+        "metric": metric,
+        "value": round(float(value), 4),
+        "unit": unit,
+        "vs_baseline": round(float(vs_baseline), 3),
+    }))

@@ -1,0 +1,54 @@
+"""BASELINE config #5: qKMeans δ-sweep on cicids intrusion data — the
+ARI-vs-δ accuracy/precision trade-off curve that is the framework's whole
+point (README.rst:26-44 of the reference), plus wall-clock.
+
+Emits the headline JSON line for the δ=0.5 point; the full sweep goes to
+stderr.
+"""
+
+import sys
+import warnings
+
+import numpy as np
+
+warnings.filterwarnings("ignore")
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from bench._common import emit, timed  # noqa: E402
+
+
+def main():
+    import jax
+    from sq_learn_tpu.datasets import load_cicids
+    from sq_learn_tpu.metrics import adjusted_rand_score
+    from sq_learn_tpu.models import QKMeans
+    from sq_learn_tpu.preprocessing import StandardScaler
+
+    X, y, real = load_cicids()
+    if len(X) > 50_000:
+        X, y = X[:50_000], y[:50_000]
+    X = StandardScaler().fit_transform(X)
+    k = int(len(np.unique(y)))
+
+    sweep = {}
+    headline_t = None
+    for delta in (0.0, 0.1, 0.3, 0.5, 1.0):
+        def fit():
+            est = QKMeans(n_clusters=k, n_init=3, delta=delta,
+                          true_distance_estimate=False,
+                          random_state=0).fit(X)
+            jax.block_until_ready(jax.device_put(0))
+            return est
+
+        t, est = timed(fit, warmup=1, reps=1)
+        ari = float(adjusted_rand_score(y, est.labels_))
+        sweep[delta] = {"fit_s": round(t, 4), "ari": round(ari, 4)}
+        if delta == 0.5:
+            headline_t = t
+
+    emit("qkmeans_cicids_delta_sweep_fit_wallclock", headline_t,
+         vs_baseline=1.0, sweep=sweep, real_cicids=real)
+
+
+if __name__ == "__main__":
+    main()

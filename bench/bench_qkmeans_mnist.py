@@ -1,0 +1,59 @@
+"""BASELINE config #3: quantum KMeans k=10 on full MNIST 70k×784, sharded
+over every attached device (one-chip mesh degenerates gracefully).
+
+vs_baseline = sklearn_seconds / ours (>1 ⇒ faster).
+"""
+
+import sys
+import warnings
+
+import numpy as np
+
+warnings.filterwarnings("ignore")
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from bench._common import emit, timed  # noqa: E402
+
+
+def main():
+    import jax
+    from sq_learn_tpu.datasets import load_mnist
+    from sq_learn_tpu.models import QKMeans
+    from sq_learn_tpu.parallel.mesh import make_mesh
+
+    X, y, real = load_mnist()
+    k, n_init, seed = 10, 3, 0
+    mesh = make_mesh() if len(jax.devices()) > 1 else None
+
+    def ours_fit():
+        est = QKMeans(n_clusters=k, n_init=n_init, max_iter=300,
+                      delta=0.5, true_distance_estimate=False,
+                      random_state=seed, mesh=mesh)
+        est.fit(X)
+        jax.block_until_ready(jax.device_put(0))
+        return est
+
+    ours_t, est = timed(ours_fit, warmup=1, reps=1)
+
+    sk_t, ari = None, None
+    try:
+        from sklearn.cluster import KMeans as SKKMeans
+        from sklearn.metrics import adjusted_rand_score
+
+        def sk_fit():
+            return SKKMeans(n_clusters=k, n_init=n_init, max_iter=300,
+                            random_state=seed).fit(X)
+
+        sk_t, sk = timed(sk_fit, warmup=0, reps=1)
+        ari = float(adjusted_rand_score(sk.labels_, est.labels_))
+    except Exception as exc:
+        print(f"# sklearn baseline unavailable: {exc}", file=sys.stderr)
+
+    emit("qkmeans_mnist_70kx784_k10_fit_wallclock", ours_t,
+         vs_baseline=(sk_t / ours_t) if sk_t else 1.0,
+         sklearn_s=sk_t, ari_vs_sklearn=ari,
+         devices=len(jax.devices()), real_mnist=real)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,59 @@
+"""BASELINE config #2: quantum PCA, n_components=50, MNIST 70k×784.
+
+Measures fit wall-clock vs classical sklearn PCA and explained-variance
+parity. vs_baseline = sklearn_seconds / ours (>1 ⇒ faster).
+"""
+
+import sys
+import warnings
+
+import numpy as np
+
+warnings.filterwarnings("ignore")
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from bench._common import emit, timed  # noqa: E402
+
+
+def main():
+    import jax
+    from sq_learn_tpu.datasets import load_mnist
+    from sq_learn_tpu.models import QPCA
+
+    X, y, real = load_mnist()
+    n_components = 50
+
+    def ours_fit():
+        # quantum path: full SVD + gated estimators at a realistic budget
+        pca = QPCA(n_components=n_components, svd_solver="full",
+                   random_state=0).fit(
+            X, estimate_all=True, eps=0.1, delta=0.1, theta_major=1e-9,
+            true_tomography=False)
+        jax.block_until_ready(jax.device_put(0))
+        return pca
+
+    ours_t, pca = timed(ours_fit, warmup=1, reps=1)
+
+    sk_t, ev_parity = None, None
+    try:
+        from sklearn.decomposition import PCA as SKPCA
+
+        def sk_fit():
+            return SKPCA(n_components=n_components,
+                         svd_solver="full").fit(X)
+
+        sk_t, sk = timed(sk_fit, warmup=0, reps=1)
+        ev_parity = float(
+            np.sum(pca.explained_variance_ratio_)
+            / np.sum(sk.explained_variance_ratio_))
+    except Exception as exc:
+        print(f"# sklearn baseline unavailable: {exc}", file=sys.stderr)
+
+    emit("qpca_mnist_70kx784_c50_fit_wallclock", ours_t,
+         vs_baseline=(sk_t / ours_t) if sk_t else 1.0,
+         sklearn_s=sk_t, explained_variance_parity=ev_parity,
+         real_mnist=real)
+
+
+if __name__ == "__main__":
+    main()

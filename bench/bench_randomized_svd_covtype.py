@@ -1,0 +1,56 @@
+"""BASELINE config #4: randomized SVD on covertype 581k×54 via XLA vs
+``sklearn.utils.extmath.randomized_svd`` (reference ``extmath.py:246``).
+
+vs_baseline = sklearn_seconds / ours (>1 ⇒ faster).
+"""
+
+import sys
+import warnings
+
+import numpy as np
+
+warnings.filterwarnings("ignore")
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from bench._common import emit, timed  # noqa: E402
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from sq_learn_tpu.datasets import load_covtype
+    from sq_learn_tpu.ops.linalg import randomized_svd
+
+    X, y, real = load_covtype()
+    n_components = 10
+    key = jax.random.PRNGKey(0)
+    Xd = jnp.asarray(X)
+
+    def ours_run():
+        U, S, Vt = randomized_svd(key, Xd, n_components, n_iter=4)
+        jax.block_until_ready(S)
+        return S
+
+    ours_t, S_ours = timed(ours_run, warmup=1, reps=3)
+
+    sk_t, sv_parity = None, None
+    try:
+        from sklearn.utils.extmath import randomized_svd as sk_rsvd
+
+        def sk_run():
+            return sk_rsvd(X, n_components=n_components, n_iter=4,
+                           random_state=0)
+
+        sk_t, (U, S_sk, Vt) = timed(sk_run, warmup=1, reps=1)
+        sv_parity = float(np.max(np.abs(
+            (np.asarray(S_ours) - S_sk) / S_sk)))
+    except Exception as exc:
+        print(f"# sklearn baseline unavailable: {exc}", file=sys.stderr)
+
+    emit("randomized_svd_covtype_581kx54_c10_wallclock", ours_t,
+         vs_baseline=(sk_t / ours_t) if sk_t else 1.0,
+         sklearn_s=sk_t, max_sv_rel_deviation=sv_parity, real_covtype=real)
+
+
+if __name__ == "__main__":
+    main()

@@ -24,23 +24,17 @@ __version__ = "0.1.0"
 # the component inventory in SURVEY.md §2.
 from . import ops, utils  # noqa: E402
 
-try:  # models / parallel / datasets / metrics land incrementally
-    from . import parallel  # noqa: E402
-except ImportError:  # pragma: no cover
-    parallel = None
-try:
-    from . import metrics  # noqa: E402
-except ImportError:  # pragma: no cover
-    metrics = None
-try:
-    from . import datasets  # noqa: E402
-except ImportError:  # pragma: no cover
-    datasets = None
-try:
-    from . import models  # noqa: E402
-    from .models import QPCA, QKMeans, QLSSVC, KMeans, PCA  # noqa: E402
-except ImportError:  # pragma: no cover
-    models = None
+from . import datasets, metrics, model_selection, models, parallel  # noqa: E402
+from . import pipeline, preprocessing  # noqa: E402
+from .models import (  # noqa: E402
+    KMeans,
+    KNeighborsClassifier,
+    PCA,
+    QKMeans,
+    QLSSVC,
+    QPCA,
+)
+from .pipeline import Pipeline, make_pipeline  # noqa: E402
 
 __all__ = [
     "config_context",
@@ -61,4 +55,15 @@ __all__ = [
     "metrics",
     "datasets",
     "models",
+    "model_selection",
+    "pipeline",
+    "preprocessing",
+    "KMeans",
+    "KNeighborsClassifier",
+    "PCA",
+    "Pipeline",
+    "QKMeans",
+    "QLSSVC",
+    "QPCA",
+    "make_pipeline",
 ]

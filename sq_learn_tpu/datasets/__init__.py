@@ -1,0 +1,28 @@
+"""Dataset loaders (reference layer L5 slice: ``sklearn/datasets`` — the
+loaders the quantum workloads and BASELINE configs use: ``load_digits``,
+``fetch_openml('mnist_784')`` (``datasets/_openml.py:694``), covertype
+(``datasets/_covtype.py``), plus the cicids CSV loader BASELINE #5 requires
+that the reference lacks).
+
+Offline-first: every fetcher degrades to a clearly-flagged deterministic
+synthetic surrogate when the real data is neither bundled nor cached —
+benchmark hosts have zero egress.
+"""
+
+from ._loaders import (
+    load_cicids,
+    load_covtype,
+    load_digits,
+    load_mnist,
+    make_blobs,
+    synthetic_surrogate,
+)
+
+__all__ = [
+    "load_cicids",
+    "load_covtype",
+    "load_digits",
+    "load_mnist",
+    "make_blobs",
+    "synthetic_surrogate",
+]

@@ -1,0 +1,247 @@
+"""Model selection: CV splitters, cross-validation, grid search.
+
+Re-implements the slice of the reference's ``sklearn/model_selection`` that
+the quantum workloads use (``MnistTrial.py:20-22`` runs
+``cross_validate(KNN, ..., cv=StratifiedKFold(10))``): K-fold and stratified
+K-fold splitters, ``train_test_split``, ``cross_validate`` /
+``cross_val_score``, and an exhaustive ``GridSearchCV``.
+
+Parallelism note: the reference fans folds out with joblib ``n_jobs``
+(SURVEY §2.3). Here fits run sequentially on host while each fit's compute
+is device-parallel — ``n_jobs`` is accepted for API compatibility and
+ignored, which is the honest TPU answer (one accelerator, XLA owns it).
+"""
+
+import numbers
+import time
+
+import numpy as np
+
+from .base import clone
+from .utils import check_random_state
+
+
+class KFold:
+    """K-fold splitter (reference ``model_selection/_split.py`` semantics)."""
+
+    def __init__(self, n_splits=5, *, shuffle=False, random_state=None):
+        if n_splits < 2:
+            raise ValueError(f"n_splits must be >= 2, got {n_splits}")
+        self.n_splits = n_splits
+        self.shuffle = shuffle
+        self.random_state = random_state
+
+    def get_n_splits(self, X=None, y=None, groups=None):
+        return self.n_splits
+
+    def split(self, X, y=None, groups=None):
+        n = len(X)
+        indices = np.arange(n)
+        if self.shuffle:
+            check_random_state(self.random_state).shuffle(indices)
+        fold_sizes = np.full(self.n_splits, n // self.n_splits, dtype=int)
+        fold_sizes[: n % self.n_splits] += 1
+        current = 0
+        for size in fold_sizes:
+            test = indices[current:current + size]
+            train = np.concatenate(
+                [indices[:current], indices[current + size:]])
+            yield train, test
+            current += size
+
+
+class StratifiedKFold(KFold):
+    """Stratified K-fold: folds preserve class proportions (the splitter of
+    the reference MNIST pipeline, ``MnistTrial.py:21``)."""
+
+    def split(self, X, y, groups=None):
+        y = np.asarray(y)
+        n = len(y)
+        rng = check_random_state(self.random_state)
+        # assign each class's members round-robin to folds (shuffled within
+        # class when requested) — preserves per-fold class balance
+        fold_of = np.empty(n, dtype=int)
+        for cls in np.unique(y):
+            idx = np.flatnonzero(y == cls)
+            if self.shuffle:
+                rng.shuffle(idx)
+            fold_of[idx] = np.arange(len(idx)) % self.n_splits
+        indices = np.arange(n)
+        for f in range(self.n_splits):
+            test = indices[fold_of == f]
+            train = indices[fold_of != f]
+            yield train, test
+
+
+def train_test_split(*arrays, test_size=None, train_size=None,
+                     random_state=None, shuffle=True, stratify=None):
+    """Split arrays into random train/test subsets (reference
+    ``model_selection/_split.py`` ``train_test_split`` semantics)."""
+    n = len(arrays[0])
+    if test_size is None and train_size is None:
+        test_size = 0.25
+    if isinstance(test_size, float):
+        n_test = int(np.ceil(n * test_size))
+    elif isinstance(test_size, numbers.Integral):
+        n_test = int(test_size)
+    else:
+        n_test = n - (int(np.floor(n * train_size))
+                      if isinstance(train_size, float) else int(train_size))
+    n_train = n - n_test
+
+    rng = check_random_state(random_state)
+    if stratify is not None:
+        stratify = np.asarray(stratify)
+        test_idx = []
+        for cls in np.unique(stratify):
+            idx = np.flatnonzero(stratify == cls)
+            if shuffle:
+                rng.shuffle(idx)
+            k = int(round(len(idx) * n_test / n))
+            test_idx.append(idx[:k])
+        test_idx = np.concatenate(test_idx)
+        mask = np.zeros(n, dtype=bool)
+        mask[test_idx] = True
+        train_idx = np.flatnonzero(~mask)
+        test_idx = np.flatnonzero(mask)
+        if shuffle:
+            rng.shuffle(train_idx)
+            rng.shuffle(test_idx)
+    elif shuffle:
+        perm = rng.permutation(n)
+        test_idx, train_idx = perm[:n_test], perm[n_test:]
+    else:
+        train_idx = np.arange(n_train)
+        test_idx = np.arange(n_train, n)
+
+    out = []
+    for a in arrays:
+        a = np.asarray(a)
+        out.extend([a[train_idx], a[test_idx]])
+    return out
+
+
+def _score(estimator, X, y, scoring):
+    if callable(scoring):
+        return float(scoring(estimator, X, y))
+    if scoring in (None, "accuracy"):
+        return float(estimator.score(X, y))
+    if scoring == "adjusted_rand_score":
+        from .metrics import adjusted_rand_score
+
+        return float(adjusted_rand_score(y, estimator.fit_predict(X)))
+    raise ValueError(f"unknown scoring {scoring!r}")
+
+
+def cross_validate(estimator, X, y=None, *, cv=5, scoring=None, n_jobs=None,
+                   return_train_score=False, fit_params=None):
+    """Evaluate by cross-validation (reference ``cross_validate``; used at
+    ``MnistTrial.py:22``). ``n_jobs`` accepted for compatibility — see
+    module docstring."""
+    X = np.asarray(X)
+    if isinstance(cv, numbers.Integral):
+        # sklearn semantics: an int cv stratifies for classifiers
+        if (y is not None
+                and getattr(estimator, "_estimator_type", "") == "classifier"):
+            cv = StratifiedKFold(n_splits=int(cv))
+        else:
+            cv = KFold(n_splits=int(cv))
+    fit_params = fit_params or {}
+    results = {"fit_time": [], "score_time": [], "test_score": []}
+    if return_train_score:
+        results["train_score"] = []
+    for train, test in cv.split(X, y):
+        est = clone(estimator)
+        y_tr = None if y is None else np.asarray(y)[train]
+        y_te = None if y is None else np.asarray(y)[test]
+        t0 = time.perf_counter()
+        if y_tr is None:
+            est.fit(X[train], **fit_params)
+        else:
+            est.fit(X[train], y_tr, **fit_params)
+        t1 = time.perf_counter()
+        results["fit_time"].append(t1 - t0)
+        results["test_score"].append(_score(est, X[test], y_te, scoring))
+        results["score_time"].append(time.perf_counter() - t1)
+        if return_train_score:
+            results["train_score"].append(
+                _score(est, X[train], y_tr, scoring))
+    return {k: np.asarray(v) for k, v in results.items()}
+
+
+def cross_val_score(estimator, X, y=None, *, cv=5, scoring=None, n_jobs=None):
+    return cross_validate(estimator, X, y, cv=cv, scoring=scoring)[
+        "test_score"]
+
+
+class ParameterGrid:
+    """Iterate over all combinations of a param grid (reference
+    ``model_selection/_search.py`` ``ParameterGrid``)."""
+
+    def __init__(self, param_grid):
+        if isinstance(param_grid, dict):
+            param_grid = [param_grid]
+        self.param_grid = param_grid
+
+    def __iter__(self):
+        import itertools
+
+        for grid in self.param_grid:
+            keys = sorted(grid)
+            for values in itertools.product(*(grid[k] for k in keys)):
+                yield dict(zip(keys, values))
+
+    def __len__(self):
+        import math
+
+        return sum(
+            math.prod(len(v) for v in grid.values()) or 1
+            for grid in self.param_grid)
+
+
+class GridSearchCV:
+    """Exhaustive parameter search over cross-validation (reference
+    ``GridSearchCV`` essentials: fit → ``best_params_``/``best_score_``/
+    ``best_estimator_``/``cv_results_``)."""
+
+    def __init__(self, estimator, param_grid, *, cv=5, scoring=None,
+                 n_jobs=None, refit=True):
+        self.estimator = estimator
+        self.param_grid = param_grid
+        self.cv = cv
+        self.scoring = scoring
+        self.n_jobs = n_jobs
+        self.refit = refit
+
+    def fit(self, X, y=None, **fit_params):
+        grid = list(ParameterGrid(self.param_grid))
+        mean_scores = []
+        all_scores = []
+        for params in grid:
+            est = clone(self.estimator).set_params(**params)
+            scores = cross_val_score(est, X, y, cv=self.cv,
+                                     scoring=self.scoring)
+            all_scores.append(scores)
+            mean_scores.append(float(np.mean(scores)))
+        best = int(np.argmax(mean_scores))
+        self.best_params_ = grid[best]
+        self.best_score_ = mean_scores[best]
+        self.cv_results_ = {
+            "params": grid,
+            "mean_test_score": np.asarray(mean_scores),
+            "split_test_scores": np.asarray(all_scores),
+        }
+        if self.refit:
+            self.best_estimator_ = clone(self.estimator).set_params(
+                **self.best_params_)
+            if y is None:
+                self.best_estimator_.fit(X, **fit_params)
+            else:
+                self.best_estimator_.fit(X, y, **fit_params)
+        return self
+
+    def predict(self, X):
+        return self.best_estimator_.predict(X)
+
+    def score(self, X, y=None):
+        return _score(self.best_estimator_, X, y, self.scoring)

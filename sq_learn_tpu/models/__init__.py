@@ -1,15 +1,9 @@
 """Estimators (reference layer L4): quantum and classical model families."""
 
+from .neighbors import KNeighborsClassifier
 from .qkmeans import KMeans, QKMeans, kmeans_plusplus, lloyd_single
+from .qlssvc import QLSSVC
+from .qpca import PCA, QPCA
 
-try:
-    from .qpca import PCA, QPCA
-except ImportError:  # pragma: no cover — lands incrementally
-    PCA = QPCA = None
-try:
-    from .qlssvc import QLSSVC
-except ImportError:  # pragma: no cover
-    QLSSVC = None
-
-__all__ = ["KMeans", "QKMeans", "QPCA", "PCA", "QLSSVC", "kmeans_plusplus",
-           "lloyd_single"]
+__all__ = ["KMeans", "KNeighborsClassifier", "QKMeans", "QPCA", "PCA",
+           "QLSSVC", "kmeans_plusplus", "lloyd_single"]

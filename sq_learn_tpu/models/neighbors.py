@@ -1,0 +1,93 @@
+"""K-nearest-neighbors classification — brute-force on the MXU.
+
+The reference's KNN (used downstream of qPCA in the MNIST pipeline,
+``MnistTrial.py:18-22``) rides ball/KD trees
+(``neighbors/_ball_tree.pyx``, ``_kd_tree.pyx`` — 2356 LoC of Cython).
+Spatial trees are pointer-chasing and data-dependent — exactly what a TPU
+can't use; the idiomatic equivalent (SURVEY §2.2 "neighbors" row) is one
+‖x‖²+‖c‖²−2XCᵀ GEMM + ``lax.top_k`` per query block, which wins on the MXU
+for the dimensionalities these pipelines touch.
+"""
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import BaseEstimator, ClassifierMixin, check_is_fitted
+from ..ops.linalg import pairwise_sq_distances
+from ..utils import check_array, check_X_y
+
+
+@functools.partial(jax.jit, static_argnames=("k", "block"))
+def knn_indices(X_train, X_query, k, block=4096):
+    """Indices + squared distances of the k nearest training rows per query.
+
+    Blocks over queries with ``lax.map`` so the (n_query, n_train) distance
+    matrix never fully materializes for large query sets.
+    """
+    nq = X_query.shape[0]
+    pad = (-nq) % block
+    Xq = jnp.pad(X_query, ((0, pad), (0, 0)))
+
+    def one_block(q):
+        d2 = pairwise_sq_distances(q, X_train)
+        neg, idx = lax.top_k(-d2, k)
+        return idx, -neg
+
+    blocks = Xq.reshape(-1, block, Xq.shape[1])
+    idx, d2 = lax.map(one_block, blocks)
+    return (idx.reshape(-1, k)[:nq], d2.reshape(-1, k)[:nq])
+
+
+class KNeighborsClassifier(ClassifierMixin, BaseEstimator):
+    """Brute-force KNN classifier (API surface of the reference's
+    ``neighbors/_classification.py`` used by the MNIST pipeline).
+
+    ``weights`` ∈ {'uniform', 'distance'}; ``algorithm`` accepted for
+    compatibility — everything dispatches to the fused GEMM+top_k kernel.
+    """
+
+    def __init__(self, n_neighbors=5, *, weights="uniform",
+                 algorithm="brute", p=2, n_jobs=None):
+        self.n_neighbors = n_neighbors
+        self.weights = weights
+        self.algorithm = algorithm
+        self.p = p
+        self.n_jobs = n_jobs
+
+    def fit(self, X, y):
+        X, y = check_X_y(X, y)
+        self.classes_, y_enc = np.unique(y, return_inverse=True)
+        self._X = jnp.asarray(X)
+        self._y = jnp.asarray(y_enc.astype(np.int32))
+        self.n_samples_fit_ = len(X)
+        return self
+
+    def kneighbors(self, X, n_neighbors=None, return_distance=True):
+        check_is_fitted(self, "n_samples_fit_")
+        X = check_array(X)
+        k = n_neighbors or self.n_neighbors
+        idx, d2 = knn_indices(self._X, jnp.asarray(X), k)
+        if return_distance:
+            return np.sqrt(np.asarray(d2)), np.asarray(idx)
+        return np.asarray(idx)
+
+    def predict_proba(self, X):
+        check_is_fitted(self, "n_samples_fit_")
+        X = check_array(X)
+        idx, d2 = knn_indices(self._X, jnp.asarray(X), self.n_neighbors)
+        votes = self._y[idx]  # (n, k)
+        n_classes = len(self.classes_)
+        onehot = jax.nn.one_hot(votes, n_classes)
+        if self.weights == "distance":
+            w = 1.0 / jnp.maximum(jnp.sqrt(d2), 1e-12)
+            onehot = onehot * w[..., None]
+        counts = jnp.sum(onehot, axis=1)
+        return np.asarray(counts / jnp.sum(counts, axis=1, keepdims=True))
+
+    def predict(self, X):
+        proba = self.predict_proba(X)
+        return self.classes_[np.argmax(proba, axis=1)]

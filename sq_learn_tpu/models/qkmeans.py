@@ -110,13 +110,17 @@ def m_step(key, X, weights, labels, old_centers, *, delta,
 def lloyd_single(key, X, weights, centers_init, x_sq_norms, *, delta=0.0,
                  mode="classic", max_iter=300, tol=1e-4,
                  intermediate_error=False, true_tomography=True, ipe_q=5,
-                 axis_name=None):
+                 axis_name=None, use_pallas=False, pallas_interpret=False):
     """One full q-means run (reference ``_kmeans_single_lloyd``,
     ``_dmeans.py:534-671``) as a single on-device ``lax.while_loop``.
 
     Tracks the best (inertia, centers) across iterations — with quantum noise
     the inertia is not monotone — and re-runs the E-step on the best centers
     at the end so labels are consistent with the returned centers.
+
+    ``use_pallas`` routes the classical (δ=0) iteration through the fused
+    hand-tiled kernel (:mod:`~sq_learn_tpu.ops.pallas_kernels`) — one HBM
+    sweep per iteration instead of two.
 
     Returns (labels, inertia, centers, n_iter).
     """
@@ -129,6 +133,7 @@ def lloyd_single(key, X, weights, centers_init, x_sq_norms, *, delta=0.0,
                               intermediate_error=intermediate_error,
                               true_tomography=true_tomography,
                               axis_name=axis_name)
+    fused = use_pallas and mode == "classic" and not intermediate_error
 
     def cond(state):
         _, _, it, shift, _, _ = state
@@ -137,8 +142,22 @@ def lloyd_single(key, X, weights, centers_init, x_sq_norms, *, delta=0.0,
     def body(state):
         key, centers, it, _, best_inertia, best_centers = state
         key, k1, k2 = jax.random.split(key, 3)
-        labels, inertia, _ = estep(k1, X, weights, centers, x_sq_norms)
-        new_centers = mstep(k2, X, weights, labels, centers)
+        if fused:
+            from ..ops.pallas_kernels import lloyd_step_pallas
+
+            labels, sums, counts, inertia = lloyd_step_pallas(
+                X, weights, centers, x_sq_norms,
+                interpret=pallas_interpret)
+            if axis_name is not None:
+                sums = lax.psum(sums, axis_name)
+                counts = lax.psum(counts, axis_name)
+                inertia = lax.psum(inertia, axis_name)
+            safe = jnp.where(counts > 0, counts, 1.0)
+            new_centers = jnp.where((counts > 0)[:, None],
+                                    sums / safe[:, None], centers)
+        else:
+            labels, inertia, _ = estep(k1, X, weights, centers, x_sq_norms)
+            new_centers = mstep(k2, X, weights, labels, centers)
         # best-tracking pairs each inertia with the centers it was measured
         # on (the reference pairs it with the post-update centers,
         # _dmeans.py:646-649 — a mismatch under noise we don't replicate)
@@ -220,7 +239,8 @@ lloyd_single_jit = jax.jit(
     lloyd_single,
     static_argnames=(
         "delta", "mode", "max_iter", "intermediate_error",
-        "true_tomography", "ipe_q", "axis_name",
+        "true_tomography", "ipe_q", "axis_name", "use_pallas",
+        "pallas_interpret",
     ),
 )
 
@@ -261,7 +281,8 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
                  copy_x=True, algorithm="auto", delta=None,
                  intermediate_error=False, true_tomography=True,
                  stop_when_reached_accuracy=True, multiprocess=False,
-                 true_distance_estimate=True, ipe_q=5, mesh=None):
+                 true_distance_estimate=True, ipe_q=5, mesh=None,
+                 use_pallas="auto"):
         self.n_clusters = n_clusters
         self.init = init
         self.n_init = n_init
@@ -279,6 +300,7 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         self.true_distance_estimate = true_distance_estimate
         self.ipe_q = ipe_q
         self.mesh = mesh
+        self.use_pallas = use_pallas
 
     # -- validation ---------------------------------------------------------
 
@@ -393,9 +415,17 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
     def _run_lloyd(self, key, Xc, sample_weight, init, n_init, delta, mode,
                    tol_):
         """n_init restarts of the single-run kernel; keep the best inertia."""
+        from ..ops.pallas_kernels import pallas_available
+
+        if self.use_pallas == "auto":
+            use_pallas, interpret = pallas_available(), False
+        else:
+            use_pallas = bool(self.use_pallas)
+            interpret = use_pallas and not pallas_available()
         static = dict(delta=delta, mode=mode, max_iter=self.max_iter, tol=tol_,
                       intermediate_error=self.intermediate_error,
-                      true_tomography=self.true_tomography, ipe_q=self.ipe_q)
+                      true_tomography=self.true_tomography, ipe_q=self.ipe_q,
+                      use_pallas=use_pallas, pallas_interpret=interpret)
         if self.mesh is not None:
             from ..parallel.lloyd import lloyd_single_sharded
 
@@ -495,12 +525,12 @@ class KMeans(QKMeans):
 
     def __init__(self, n_clusters=8, *, init="k-means++", n_init=10,
                  max_iter=300, tol=1e-4, verbose=0, random_state=None,
-                 copy_x=True, algorithm="auto", mesh=None):
+                 copy_x=True, algorithm="auto", mesh=None, use_pallas="auto"):
         super().__init__(
             n_clusters=n_clusters, init=init, n_init=n_init,
             max_iter=max_iter, tol=tol, verbose=verbose,
             random_state=random_state, copy_x=copy_x, algorithm=algorithm,
-            delta=None, mesh=mesh)
+            delta=None, mesh=mesh, use_pallas=use_pallas)
 
     def fit(self, X, y=None, sample_weight=None):
         with warnings.catch_warnings():

@@ -1,0 +1,141 @@
+"""Pallas TPU kernels — the hand-tiled hot path.
+
+The reference's single most performance-critical native kernel is the
+chunked fused Lloyd iteration (``cluster/_k_means_lloyd.pyx:29``:
+GEMM distances → argmin → per-thread partial centroid sums → reduction).
+This module is its TPU twin: one ``pallas_call`` sweeps sample tiles held in
+VMEM, computes ‖x‖²+‖c‖²−2XCᵀ on the MXU, takes the argmin on the VPU, and
+accumulates the partial centroid sums / counts / inertia across grid steps
+in-place — X is read from HBM exactly once per Lloyd iteration (the XLA
+path reads it twice: once for the E-step GEMM, once for the M-step one-hot
+GEMM).
+
+Off-TPU the kernel runs in interpreter mode so tests cover it on CPU.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_BIG = 1e30  # masking distance for padded centroid rows
+
+
+def _round_up(x, m):
+    return (x + m - 1) // m * m
+
+
+def _lloyd_kernel(x_ref, xsq_ref, w_ref, c_ref, csq_ref,
+                  labels_ref, sums_ref, counts_ref, inertia_ref):
+    """One sample tile: fused E-step + M-step partials.
+
+    Grid dim 0 walks sample tiles; sums/counts/inertia map to the same
+    output block every step, so `+=` accumulates across the (sequential)
+    TPU grid. Padded samples carry weight 0; padded centroids carry
+    c_sq = _BIG so no sample ever selects them.
+    """
+    i = pl.program_id(0)
+
+    x = x_ref[:]                      # (T, m)
+    c = c_ref[:]                      # (k, m)
+    # MXU: the ‖x‖²+‖c‖²−2xcᵀ trick of _k_means_lloyd.pyx:196-203
+    d2 = (xsq_ref[:] + csq_ref[:]
+          - 2.0 * jnp.dot(x, c.T, preferred_element_type=jnp.float32))
+    min_d2 = jnp.min(d2, axis=1, keepdims=True)       # (T, 1)
+    labels = jnp.argmin(d2, axis=1)                   # (T,)
+    labels_ref[:] = labels[:, None].astype(jnp.int32)
+
+    k = c.shape[0]
+    col_ids = jax.lax.broadcasted_iota(jnp.int32, (x.shape[0], k), 1)
+    onehot = jnp.where(labels[:, None] == col_ids, 1.0, 0.0) * w_ref[:]
+
+    @pl.when(i == 0)
+    def _():
+        sums_ref[:] = jnp.zeros_like(sums_ref)
+        counts_ref[:] = jnp.zeros_like(counts_ref)
+        inertia_ref[:] = jnp.zeros_like(inertia_ref)
+
+    # MXU again: partial centroid sums, accumulated in-place across tiles
+    sums_ref[:] += jnp.dot(onehot.T, x, preferred_element_type=jnp.float32)
+    counts_ref[:] += jnp.sum(onehot, axis=0, keepdims=True)
+    inertia_ref[:] += jnp.sum(min_d2 * w_ref[:], keepdims=True).reshape(1, 1)
+
+
+@functools.partial(jax.jit, static_argnames=("tile_n", "interpret"))
+def lloyd_step_pallas(X, weights, centers, x_sq_norms, *, tile_n=512,
+                      interpret=False):
+    """Fused classical Lloyd iteration statistics in one pallas sweep.
+
+    Parameters
+    ----------
+    X : (n, m) float32 — samples (may carry zero-weight padding rows).
+    weights : (n,) — sample weights; 0 masks a row out entirely.
+    centers : (k, m) — current centroids.
+    x_sq_norms : (n,) — precomputed row norms.
+    tile_n : static — samples per VMEM tile.
+    interpret : static — run in interpreter mode (CPU tests).
+
+    Returns
+    -------
+    (labels (n,) int32, sums (k, m), counts (k,), inertia scalar)
+    where ``sums``/``counts`` are the weighted per-cluster partials — the
+    caller divides (and psums across a mesh, if sharded).
+    """
+    n, m = X.shape
+    k = centers.shape[0]
+    # hardware alignment: lanes are 128 wide, f32 sublanes 8 deep
+    m_p = _round_up(m, 128)
+    k_p = _round_up(k, 8)
+    n_p = _round_up(n, tile_n)
+
+    Xp = jnp.zeros((n_p, m_p), jnp.float32).at[:n, :m].set(X)
+    wp = jnp.zeros((n_p, 1), jnp.float32).at[:n, 0].set(weights)
+    xsqp = jnp.zeros((n_p, 1), jnp.float32).at[:n, 0].set(x_sq_norms)
+    Cp = jnp.zeros((k_p, m_p), jnp.float32).at[:k, :m].set(centers)
+    csqp = jnp.full((1, k_p), _BIG, jnp.float32).at[0, :k].set(
+        jnp.sum(centers * centers, axis=1))
+
+    grid = (n_p // tile_n,)
+    labels, sums, counts, inertia = pl.pallas_call(
+        _lloyd_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile_n, m_p), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile_n, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile_n, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((k_p, m_p), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k_p), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((tile_n, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((k_p, m_p), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k_p), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n_p, 1), jnp.int32),
+            jax.ShapeDtypeStruct((k_p, m_p), jnp.float32),
+            jax.ShapeDtypeStruct((1, k_p), jnp.float32),
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(Xp, xsqp, wp, Cp, csqp)
+
+    return (labels[:n, 0], sums[:k, :m], counts[0, :k], inertia[0, 0])
+
+
+def pallas_available():
+    """True when a real TPU backend is attached (otherwise callers should
+    pass interpret=True or use the XLA path)."""
+    return jax.default_backend() == "tpu"

@@ -1,0 +1,108 @@
+"""Preprocessing transformers (reference ``sklearn/preprocessing`` slice
+used ahead of PCA/k-means — SURVEY §2.4 "scaling before PCA/k-means").
+
+All statistics are single-pass jnp reductions; transforms are elementwise
+XLA ops that fuse into whatever consumes them.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from .base import BaseEstimator, TransformerMixin, check_is_fitted
+from .utils import check_array
+
+
+class StandardScaler(TransformerMixin, BaseEstimator):
+    """Standardize features to zero mean / unit variance."""
+
+    def __init__(self, *, with_mean=True, with_std=True, copy=True):
+        self.with_mean = with_mean
+        self.with_std = with_std
+        self.copy = copy
+
+    def fit(self, X, y=None):
+        X = jnp.asarray(check_array(X))
+        self.mean_ = (np.asarray(jnp.mean(X, axis=0))
+                      if self.with_mean else np.zeros(X.shape[1]))
+        if self.with_std:
+            var = np.asarray(jnp.var(X, axis=0))
+            self.var_ = var
+            scale = np.sqrt(var)
+            scale[scale == 0.0] = 1.0
+            self.scale_ = scale
+        else:
+            self.var_ = None
+            self.scale_ = np.ones(X.shape[1])
+        self.n_samples_seen_ = X.shape[0]
+        return self
+
+    def transform(self, X):
+        check_is_fitted(self, "scale_")
+        X = jnp.asarray(check_array(X))
+        return np.asarray((X - jnp.asarray(self.mean_))
+                          / jnp.asarray(self.scale_))
+
+    def inverse_transform(self, X):
+        check_is_fitted(self, "scale_")
+        X = jnp.asarray(X)
+        return np.asarray(X * jnp.asarray(self.scale_)
+                          + jnp.asarray(self.mean_))
+
+
+class MinMaxScaler(TransformerMixin, BaseEstimator):
+    """Scale features to a [min, max] range."""
+
+    def __init__(self, feature_range=(0, 1), *, copy=True):
+        self.feature_range = feature_range
+        self.copy = copy
+
+    def fit(self, X, y=None):
+        X = jnp.asarray(check_array(X))
+        lo, hi = self.feature_range
+        data_min = np.asarray(jnp.min(X, axis=0))
+        data_max = np.asarray(jnp.max(X, axis=0))
+        rng = data_max - data_min
+        rng[rng == 0.0] = 1.0
+        self.data_min_ = data_min
+        self.data_max_ = data_max
+        self.scale_ = (hi - lo) / rng
+        self.min_ = lo - data_min * self.scale_
+        return self
+
+    def transform(self, X):
+        check_is_fitted(self, "scale_")
+        X = jnp.asarray(check_array(X))
+        return np.asarray(X * jnp.asarray(self.scale_)
+                          + jnp.asarray(self.min_))
+
+    def inverse_transform(self, X):
+        check_is_fitted(self, "scale_")
+        X = jnp.asarray(X)
+        return np.asarray((X - jnp.asarray(self.min_))
+                          / jnp.asarray(self.scale_))
+
+
+class Normalizer(TransformerMixin, BaseEstimator):
+    """Scale rows to unit norm (the quantum-state preparation convention —
+    amplitudes are L2-normalized, ``Utility.py:43-44``)."""
+
+    def __init__(self, norm="l2", *, copy=True):
+        self.norm = norm
+        self.copy = copy
+
+    def fit(self, X, y=None):
+        check_array(X)
+        self.n_features_in_ = np.asarray(X).shape[1]
+        return self
+
+    def transform(self, X):
+        X = jnp.asarray(check_array(X))
+        if self.norm == "l2":
+            norms = jnp.linalg.norm(X, axis=1, keepdims=True)
+        elif self.norm == "l1":
+            norms = jnp.sum(jnp.abs(X), axis=1, keepdims=True)
+        elif self.norm == "max":
+            norms = jnp.max(jnp.abs(X), axis=1, keepdims=True)
+        else:
+            raise ValueError(f"unknown norm {self.norm!r}")
+        return np.asarray(X / jnp.where(norms == 0, 1.0, norms))

@@ -1,0 +1,200 @@
+"""Composition layer tests: model_selection, pipeline, preprocessing, KNN —
+plus the end-to-end MnistTrial-style quantum pipeline (reference
+``MnistTrial.py:10-28`` is the parity target)."""
+
+import numpy as np
+import pytest
+
+from sq_learn_tpu import Pipeline, clone, make_pipeline
+from sq_learn_tpu.datasets import load_digits, make_blobs
+from sq_learn_tpu.model_selection import (
+    GridSearchCV,
+    KFold,
+    StratifiedKFold,
+    cross_val_score,
+    cross_validate,
+    train_test_split,
+)
+from sq_learn_tpu.models import (
+    KMeans,
+    KNeighborsClassifier,
+    PCA,
+    QPCA,
+)
+from sq_learn_tpu.preprocessing import MinMaxScaler, Normalizer, StandardScaler
+
+
+@pytest.fixture(scope="module")
+def digits():
+    return load_digits()
+
+
+class TestSplitters:
+    def test_kfold_partitions(self):
+        X = np.arange(23).reshape(-1, 1)
+        seen = []
+        for train, test in KFold(5).split(X):
+            assert len(np.intersect1d(train, test)) == 0
+            seen.extend(test.tolist())
+        assert sorted(seen) == list(range(23))
+
+    def test_stratified_kfold_balance(self):
+        y = np.array([0] * 40 + [1] * 10)
+        X = np.zeros((50, 2))
+        for train, test in StratifiedKFold(5).split(X, y):
+            # each fold holds ~1/5 of each class
+            assert np.sum(y[test] == 0) == 8
+            assert np.sum(y[test] == 1) == 2
+
+    def test_train_test_split_stratified(self):
+        X = np.arange(100).reshape(-1, 1)
+        y = np.array([0] * 80 + [1] * 20)
+        X_tr, X_te, y_tr, y_te = train_test_split(
+            X, y, test_size=0.25, stratify=y, random_state=0)
+        assert len(X_te) == pytest.approx(25, abs=1)
+        assert np.mean(y_te) == pytest.approx(0.2, abs=0.05)
+        assert len(X_tr) + len(X_te) == 100
+
+
+class TestCV:
+    def test_cross_validate_knn(self, digits):
+        X, y = digits
+        res = cross_validate(
+            KNeighborsClassifier(n_neighbors=5), X[:500], y[:500], cv=3)
+        assert len(res["test_score"]) == 3
+        assert np.mean(res["test_score"]) > 0.9
+
+    def test_int_cv_stratifies_for_classifiers(self):
+        # class-sorted labels: plain KFold would train on one class only
+        X, y = make_blobs(n_samples=100, centers=2, n_features=4,
+                          cluster_std=0.5, random_state=3)
+        order = np.argsort(y)
+        X, y = X[order], y[order]
+        scores = cross_val_score(
+            KNeighborsClassifier(n_neighbors=3), X, y, cv=2)
+        assert np.mean(scores) > 0.9
+
+    def test_grid_search(self, digits):
+        X, y = digits
+        gs = GridSearchCV(
+            KNeighborsClassifier(), {"n_neighbors": [1, 5]}, cv=3,
+        ).fit(X[:300], y[:300])
+        assert gs.best_params_["n_neighbors"] in (1, 5)
+        assert 0.8 < gs.best_score_ <= 1.0
+        assert gs.predict(X[:10]).shape == (10,)
+
+
+class TestKNN:
+    def test_matches_sklearn(self, digits):
+        import sklearn.neighbors
+
+        X, y = digits
+        X_tr, X_te = X[:1000], X[1000:1200]
+        y_tr = y[:1000]
+        ours = KNeighborsClassifier(n_neighbors=5).fit(X_tr, y_tr)
+        ref = sklearn.neighbors.KNeighborsClassifier(n_neighbors=5).fit(
+            X_tr, y_tr)
+        agree = np.mean(ours.predict(X_te) == ref.predict(X_te))
+        assert agree > 0.97  # distance ties can break differently
+
+    def test_distance_weights(self, digits):
+        X, y = digits
+        clf = KNeighborsClassifier(n_neighbors=5, weights="distance").fit(
+            X[:500], y[:500])
+        proba = clf.predict_proba(X[500:520])
+        np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-5)
+
+    def test_kneighbors_output(self, digits):
+        X, y = digits
+        clf = KNeighborsClassifier(n_neighbors=3).fit(X[:100], y[:100])
+        dist, idx = clf.kneighbors(X[:5])
+        assert dist.shape == (5, 3)
+        # self is the nearest neighbor at distance 0
+        np.testing.assert_array_equal(idx[:, 0], np.arange(5))
+        np.testing.assert_allclose(dist[:, 0], 0.0, atol=1e-3)
+
+
+class TestPreprocessing:
+    def test_standard_scaler(self, digits):
+        X, _ = digits
+        Xs = StandardScaler().fit_transform(X)
+        np.testing.assert_allclose(Xs.mean(axis=0), 0.0, atol=1e-4)
+        active = X.std(axis=0) > 0
+        np.testing.assert_allclose(Xs.std(axis=0)[active], 1.0, atol=1e-3)
+
+    def test_minmax_scaler_roundtrip(self):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(50, 4)).astype(np.float32)
+        sc = MinMaxScaler().fit(X)
+        Xt = sc.transform(X)
+        assert Xt.min() >= -1e-6 and Xt.max() <= 1 + 1e-6
+        np.testing.assert_allclose(sc.inverse_transform(Xt), X, atol=1e-5)
+
+    def test_normalizer(self):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(20, 6)).astype(np.float32)
+        Xn = Normalizer().fit_transform(X)
+        np.testing.assert_allclose(
+            np.linalg.norm(Xn, axis=1), 1.0, atol=1e-5)
+
+
+class TestPipeline:
+    def test_fit_predict_score(self, digits):
+        X, y = digits
+        pipe = Pipeline([
+            ("scale", StandardScaler()),
+            ("pca", PCA(n_components=20)),
+            ("knn", KNeighborsClassifier(n_neighbors=5)),
+        ])
+        pipe.fit(X[:800], y[:800])
+        assert pipe.score(X[800:1000], y[800:1000]) > 0.85
+
+    def test_nested_params(self):
+        pipe = make_pipeline(StandardScaler(), PCA(n_components=5))
+        pipe.set_params(pca__n_components=7)
+        assert pipe.named_steps["pca"].n_components == 7
+        assert pipe.get_params()["pca__n_components"] == 7
+
+    def test_clone_pipeline(self):
+        pipe = make_pipeline(StandardScaler(), PCA(n_components=5))
+        c = clone(pipe)
+        assert c.named_steps["pca"].n_components == 5
+
+
+class TestMnistTrialPipeline:
+    """The reference's flagship experiment (``MnistTrial.py:10-28``):
+    qPCA fit → quantum transform with tomography noise → KNN → stratified
+    CV — on digits here (MNIST itself is the benchmark, not a unit test)."""
+
+    def test_end_to_end_quantum_pipeline(self, digits):
+        X, y = digits
+        X, y = X[:600], y[:600]
+        # svd_solver='full' forces the quantum path (auto would dispatch
+        # >500-sample inputs to the purely-classical randomized solver,
+        # exactly as the reference does — _qPCA.py:545-553)
+        pca = QPCA(n_components=16, svd_solver="full", random_state=0).fit(
+            X, estimate_all=True, eps=0.1, delta=0.1, theta_major=1e-6,
+            true_tomography=False)
+        # quantum transform onto the tomography-estimated components
+        Xq = pca.transform(X, classic_transform=False,
+                           use_classical_components=False)
+        res = cross_validate(
+            KNeighborsClassifier(n_neighbors=7), Xq, y,
+            cv=StratifiedKFold(5))
+        assert np.mean(res["test_score"]) > 0.85
+
+    def test_noise_degrades_gracefully(self, digits):
+        X, y = digits
+        X, y = X[:400], y[:400]
+        accs = {}
+        for eps_delta in (0.05, 0.8):
+            pca = QPCA(n_components=16, random_state=0).fit(
+                X, estimate_all=True, eps=eps_delta / 2, delta=eps_delta / 2,
+                theta_major=1e-6, true_tomography=False)
+            Xq = pca.transform(X, classic_transform=False,
+                               use_classical_components=False)
+            score = np.mean(cross_val_score(
+                KNeighborsClassifier(n_neighbors=7), Xq, y,
+                cv=StratifiedKFold(3)))
+            accs[eps_delta] = score
+        assert accs[0.05] >= accs[0.8] - 0.02

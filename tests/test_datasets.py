@@ -1,0 +1,59 @@
+"""Dataset loader tests (offline paths + cicids CSV parsing)."""
+
+import numpy as np
+import pytest
+
+from sq_learn_tpu.datasets import (
+    load_cicids,
+    load_digits,
+    make_blobs,
+    synthetic_surrogate,
+)
+
+
+def test_load_digits():
+    X, y = load_digits()
+    assert X.shape == (1797, 64)
+    assert X.dtype == np.float32
+    assert set(np.unique(y)) == set(range(10))
+
+
+def test_synthetic_surrogate_deterministic():
+    X1, y1 = synthetic_surrogate(100, 8, 3, seed=1)
+    X2, y2 = synthetic_surrogate(100, 8, 3, seed=1)
+    np.testing.assert_array_equal(X1, X2)
+    np.testing.assert_array_equal(y1, y2)
+    assert X1.shape == (100, 8)
+    assert set(np.unique(y1)) <= set(range(3))
+
+
+def test_make_blobs_shapes():
+    X, y = make_blobs(n_samples=50, centers=3, n_features=4, random_state=2)
+    assert X.shape == (50, 4)
+    assert len(np.unique(y)) <= 3
+
+
+def test_cicids_csv_parsing(tmp_path):
+    csv = tmp_path / "cicids_rel.csv"
+    rows = ["f1,f2,f3,label"]
+    rng = np.random.default_rng(0)
+    for i in range(20):
+        vals = rng.normal(size=3)
+        label = "BENIGN" if i % 2 else "DoS"
+        rows.append(",".join(f"{v:.4f}" for v in vals) + f",{label}")
+    # one row with inf (CICIDS flow-rate artifact) must be dropped
+    rows.append("inf,1.0,2.0,BENIGN")
+    csv.write_text("\n".join(rows))
+    X, y, real = load_cicids(str(csv))
+    assert real
+    assert X.shape == (20, 3)
+    assert set(np.unique(y)) == {0, 1}
+    assert np.isfinite(X).all()
+
+
+def test_cicids_missing_falls_back():
+    with pytest.warns(UserWarning, match="synthetic"):
+        X, y, real = load_cicids("/nonexistent/file.csv", n_samples=500,
+                                 n_features=10)
+    assert not real
+    assert X.shape == (500, 10)

@@ -1,0 +1,86 @@
+"""Pallas fused-Lloyd kernel tests (interpreter mode on CPU; the same code
+path compiles for the MXU on a real TPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sq_learn_tpu.datasets import make_blobs
+from sq_learn_tpu.models import KMeans
+from sq_learn_tpu.models.qkmeans import e_step, m_step
+from sq_learn_tpu.ops.linalg import row_norms
+from sq_learn_tpu.ops.pallas_kernels import lloyd_step_pallas
+
+
+@pytest.fixture(scope="module")
+def problem():
+    rng = np.random.default_rng(11)
+    X = rng.normal(size=(700, 17)).astype(np.float32)  # deliberately unaligned
+    C = X[rng.choice(700, 5, replace=False)]
+    w = np.ones(700, np.float32)
+    return (jnp.asarray(X), jnp.asarray(w), jnp.asarray(C),
+            row_norms(jnp.asarray(X), squared=True))
+
+
+class TestFusedKernelEquivalence:
+    def test_matches_xla_estep_mstep(self, problem, key):
+        X, w, C, xsq = problem
+        labels_p, sums, counts, inertia_p = lloyd_step_pallas(
+            X, w, C, xsq, interpret=True)
+
+        labels_x, inertia_x, _ = e_step(
+            key, X, w, C, xsq, delta=0.0, mode="classic", ipe_q=1)
+        np.testing.assert_array_equal(np.asarray(labels_p),
+                                      np.asarray(labels_x))
+        np.testing.assert_allclose(float(inertia_p), float(inertia_x),
+                                   rtol=1e-4)
+        new_centers_x = m_step(key, X, w, labels_x, C, delta=0.0,
+                               intermediate_error=False, true_tomography=True)
+        safe = jnp.where(counts > 0, counts, 1.0)
+        new_centers_p = jnp.where((counts > 0)[:, None],
+                                  sums / safe[:, None], C)
+        np.testing.assert_allclose(np.asarray(new_centers_p),
+                                   np.asarray(new_centers_x),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_zero_weight_rows_ignored(self, problem):
+        X, w, C, xsq = problem
+        w2 = w.at[:100].set(0.0)
+        _, sums, counts, inertia = lloyd_step_pallas(
+            X, w2, C, xsq, interpret=True)
+        _, sums_ref, counts_ref, inertia_ref = lloyd_step_pallas(
+            X[100:], w[100:], C, xsq[100:], interpret=True)
+        np.testing.assert_allclose(np.asarray(sums), np.asarray(sums_ref),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(counts),
+                                   np.asarray(counts_ref), rtol=1e-5)
+        np.testing.assert_allclose(float(inertia), float(inertia_ref),
+                                   rtol=1e-4)
+
+    def test_weighted_samples(self, problem, key):
+        X, w, C, xsq = problem
+        w3 = jax.random.uniform(key, w.shape, minval=0.1, maxval=3.0)
+        labels_p, sums, counts, _ = lloyd_step_pallas(
+            X, w3, C, xsq, interpret=True)
+        onehot = jax.nn.one_hot(labels_p, C.shape[0]) * w3[:, None]
+        np.testing.assert_allclose(np.asarray(jnp.sum(onehot, axis=0)),
+                                   np.asarray(counts), rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(onehot.T @ X),
+                                   np.asarray(sums), rtol=1e-3, atol=1e-3)
+
+
+class TestEstimatorIntegration:
+    def test_kmeans_pallas_matches_xla(self):
+        X, y = make_blobs(n_samples=300, centers=4, n_features=6,
+                          cluster_std=0.6, random_state=5)
+        init = X[:4].copy()
+        km_x = KMeans(n_clusters=4, init=init, n_init=1, random_state=0,
+                      use_pallas=False).fit(X)
+        km_p = KMeans(n_clusters=4, init=init, n_init=1, random_state=0,
+                      use_pallas=True).fit(X)
+        np.testing.assert_array_equal(km_x.labels_, km_p.labels_)
+        np.testing.assert_allclose(km_x.cluster_centers_,
+                                   km_p.cluster_centers_, rtol=1e-4,
+                                   atol=1e-4)
+        np.testing.assert_allclose(km_x.inertia_, km_p.inertia_, rtol=1e-4)

@@ -1,0 +1,155 @@
+"""QLSSVC tests: LS-SVM solve correctness, kernel dispatch, quantum error
+model, complexity accounting (the reference ships zero tests — SURVEY §4)."""
+
+import numpy as np
+import pytest
+import sklearn.datasets
+
+from sq_learn_tpu import clone
+from sq_learn_tpu.models import QLSSVC
+from sq_learn_tpu.models.qlssvc import lssvc_solve, relative_error_routine
+
+
+@pytest.fixture(scope="module")
+def binary_data():
+    X, y = sklearn.datasets.make_classification(
+        n_samples=120, n_features=10, n_informative=6, random_state=5,
+        class_sep=2.0)
+    y = np.where(y == 0, -1.0, 1.0)
+    return X.astype(np.float64), y
+
+
+class TestSolve:
+    def test_saddle_system_solution(self, binary_data):
+        """b, α must satisfy the KKT system exactly (full-rank solve)."""
+        X, y = binary_data
+        import jax.numpy as jnp
+
+        K = np.asarray(X @ X.T)
+        penalty = 0.5
+        b, alpha, s, cond, normF = lssvc_solve(
+            jnp.asarray(K), y, penalty)
+        alpha = np.asarray(alpha)
+        # KKT: Σα = 0 and K·α + α/γ + b = y
+        assert abs(np.sum(alpha)) < 1e-2
+        resid = K @ alpha + alpha / penalty + float(b) - y
+        assert np.max(np.abs(resid)) < 1e-2
+        assert cond >= 1.0
+        assert normF == pytest.approx(np.max(s))
+
+    def test_low_rank_truncation(self, binary_data):
+        X, y = binary_data
+        import jax.numpy as jnp
+
+        K = jnp.asarray(X @ X.T)
+        _, _, s_full, _, _ = lssvc_solve(K, y, 0.5)
+        _, _, s_trunc, _, _ = lssvc_solve(K, y, 0.5, var=0.9)
+        assert len(s_trunc) < len(s_full)
+        np.testing.assert_allclose(s_trunc, s_full[: len(s_trunc)],
+                                   rtol=1e-4)
+
+    def test_int_var_truncation(self, binary_data):
+        X, y = binary_data
+        import jax.numpy as jnp
+
+        K = jnp.asarray(X @ X.T)
+        _, _, s, cond, _ = lssvc_solve(K, y, 0.5, var=10)
+        assert len(s) == 10
+        assert cond == pytest.approx(float(s[0] / s[9]))
+
+
+class TestClassification:
+    @pytest.mark.parametrize("kernel", ["linear", "poly", "rbf", "sigmoid"])
+    def test_kernels_classical_accuracy(self, binary_data, kernel):
+        X, y = binary_data
+        clf = QLSSVC(kernel=kernel, penalty=1.0, random_state=0).fit(X, y)
+        acc = np.mean(clf.classical_predict(X) == y)
+        assert acc > (0.9 if kernel != "sigmoid" else 0.6)
+
+    def test_quantum_predict_small_error_matches_classical(self, binary_data):
+        X, y = binary_data
+        clf = QLSSVC(kernel="rbf", penalty=1.0, absolute_error=1e-6,
+                     random_state=0).fit(X, y)
+        agree = np.mean(clf.predict(X) == clf.classical_predict(X))
+        assert agree > 0.98
+
+    def test_relative_error_mode_runs(self, binary_data):
+        X, y = binary_data
+        clf = QLSSVC(kernel="linear", penalty=1.0, error_type="relative",
+                     relative_error=0.1, random_state=0).fit(X, y)
+        preds = clf.predict(X[:20])
+        assert set(np.unique(preds)) <= {-1.0, 1.0}
+
+    def test_score_accuracy(self, binary_data):
+        X, y = binary_data
+        clf = QLSSVC(kernel="rbf", penalty=1.0, absolute_error=1e-4,
+                     random_state=0).fit(X, y)
+        assert clf.score(X, y) > 0.9
+
+    def test_linear_primal_coef(self, binary_data):
+        X, y = binary_data
+        clf = QLSSVC(kernel="linear", penalty=1.0, random_state=0).fit(X, y)
+        # primal w reproduces the decision values: h = w·x + b
+        h_primal = X @ clf.coef_ + clf.b_
+        np.testing.assert_allclose(h_primal, clf.get_h(X), rtol=1e-3,
+                                   atol=1e-3)
+
+    def test_invalid_error_type(self):
+        with pytest.raises(ValueError, match="absolute.*relative"):
+            QLSSVC(error_type="bogus")
+
+    def test_clone(self):
+        est = QLSSVC(kernel="rbf", penalty=2.0, low_rank=True, var=0.8)
+        assert clone(est).get_params() == est.get_params()
+
+
+class TestQuantumErrorModel:
+    def test_get_P_in_unit_interval(self, binary_data):
+        X, y = binary_data
+        clf = QLSSVC(kernel="rbf", penalty=1.0, random_state=0).fit(X, y)
+        P = clf.get_P(X)
+        assert np.all((P >= 0) & (P <= 1))
+        # P ≤ 0.5 ⟺ h ≥ 0 ⟺ class +1
+        np.testing.assert_array_equal(P <= 0.5, clf.get_h(X) >= 0)
+
+    def test_betas_positive_and_formula(self, binary_data):
+        X, y = binary_data
+        clf = QLSSVC(kernel="linear", penalty=1.0, random_state=0).fit(X, y)
+        betas = clf.get_betas(X)
+        N = len(X)
+        expected = np.sqrt(
+            (N * np.sum(X**2, axis=1) + 1) * clf.Nu_)
+        np.testing.assert_allclose(betas, expected, rtol=1e-4)
+
+    def test_relative_error_routine_bounds(self, key):
+        x_max = np.array([8.0, 4.0, 16.0])
+        x_real = np.array([1.0, 0.5, 2.0])
+        x_hat, delta_r, eps = relative_error_routine(
+            key, x_max, x_real, relative_error=0.2)
+        x_hat = np.asarray(x_hat)
+        # the halving search stops once the noisy estimate ≥ current scale;
+        # the final absolute ε is proportional to the final scale
+        assert np.all(np.asarray(eps) > 0)
+        assert np.all(np.abs(x_hat - x_real) <= np.asarray(eps) + 1e-6)
+
+    def test_approx_hyperplane_close(self, binary_data):
+        X, y = binary_data
+        # absolute mode must honor absolute_error (the reference reads
+        # relative_error in this branch, _qSVM.py:317) — a huge
+        # relative_error must have no effect here
+        clf = QLSSVC(kernel="linear", penalty=1.0, absolute_error=0.01,
+                     relative_error=1e6, random_state=0).fit(X, y)
+        b_approx, coef_approx = clf.get_approximated_hyperplane(X[:1])
+        rel = np.linalg.norm(coef_approx - clf.coef_) / np.linalg.norm(
+            clf.coef_)
+        assert rel < 0.1
+
+    def test_complexities_positive(self, binary_data):
+        X, y = binary_data
+        clf = QLSSVC(kernel="rbf", penalty=1.0, random_state=0).fit(X, y)
+        assert clf.get_training_complexity() > 0
+        assert np.all(clf.get_classification_complexity(X[:5]) > 0)
+        assert np.all(
+            clf.get_classification_complexity(X[:5], relative_error=True) > 0)
+        betas, hs, Ps, cond, rel_c, abs_c = clf.get_all_attributes(X[:5])
+        assert len(betas) == len(hs) == len(Ps) == 5

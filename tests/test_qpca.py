@@ -1,0 +1,325 @@
+"""qPCA tests: classical parity vs sklearn PCA, quantum estimator error
+bounds, transform/inverse round trips (SURVEY §4 test plan items 1-3)."""
+
+import numpy as np
+import pytest
+import sklearn.datasets
+import sklearn.decomposition
+
+from sq_learn_tpu import clone
+from sq_learn_tpu.models import PCA, QPCA
+from sq_learn_tpu.models.qpca import _infer_dimension, singular_value_estimates
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(42)
+    # low-rank-ish data with decaying spectrum
+    B = rng.normal(size=(200, 20)) @ rng.normal(size=(20, 30))
+    X = B + 0.05 * rng.normal(size=(200, 30))
+    return X.astype(np.float64)
+
+
+@pytest.fixture(scope="module")
+def digits():
+    X, _ = sklearn.datasets.load_digits(return_X_y=True)
+    return X.astype(np.float64)
+
+
+class TestClassicalParity:
+    def test_matches_sklearn_full(self, data):
+        ours = PCA(n_components=5, random_state=0).fit(data)
+        ref = sklearn.decomposition.PCA(
+            n_components=5, svd_solver="full").fit(data)
+        # compute happens in float32 on device — tolerances reflect that
+        np.testing.assert_allclose(
+            ours.explained_variance_, ref.explained_variance_, rtol=1e-4)
+        np.testing.assert_allclose(
+            ours.singular_values_, ref.singular_values_, rtol=1e-4)
+        np.testing.assert_allclose(
+            np.abs(ours.components_), np.abs(ref.components_), atol=1e-3)
+        np.testing.assert_allclose(ours.mean_, ref.mean_, rtol=1e-4,
+                                   atol=1e-6)
+        np.testing.assert_allclose(
+            ours.noise_variance_, ref.noise_variance_, rtol=1e-3)
+
+    def test_transform_matches_sklearn(self, data):
+        ours = PCA(n_components=4).fit(data)
+        ref = sklearn.decomposition.PCA(
+            n_components=4, svd_solver="full").fit(data)
+        # our flip follows the reference fork's u-based svd_flip
+        # (extmath.py:522); installed sklearn may use a different basis —
+        # align per-column signs before comparing
+        A, B = ours.transform(data), ref.transform(data)
+        signs = np.sign(np.sum(A * B, axis=0))
+        np.testing.assert_allclose(A * signs, B, rtol=1e-3, atol=1e-4)
+
+    def test_inverse_transform_round_trip(self, data):
+        pca = PCA(n_components=20).fit(data)
+        Xr = pca.inverse_transform(pca.transform(data))
+        # rank ~20 signal: reconstruction error limited to the noise floor
+        rel = np.linalg.norm(data - Xr) / np.linalg.norm(data)
+        assert rel < 0.05
+
+    def test_whiten(self, data):
+        pca = PCA(n_components=5, whiten=True).fit(data)
+        Xt = pca.transform(data)
+        np.testing.assert_allclose(np.var(Xt, axis=0, ddof=1),
+                                   np.ones(5), rtol=1e-3)
+        Xr = pca.inverse_transform(Xt)
+        ref = sklearn.decomposition.PCA(
+            n_components=5, whiten=True, svd_solver="full").fit(data)
+        np.testing.assert_allclose(Xr, ref.inverse_transform(ref.transform(data)),
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_fractional_n_components(self, data):
+        ours = PCA(n_components=0.9).fit(data)
+        ref = sklearn.decomposition.PCA(
+            n_components=0.9, svd_solver="full").fit(data)
+        assert ours.n_components_ == ref.n_components_
+
+    def test_mle_matches_sklearn(self, data):
+        ours = PCA(n_components="mle").fit(data)
+        ref = sklearn.decomposition.PCA(
+            n_components="mle", svd_solver="full").fit(data)
+        assert ours.n_components_ == ref.n_components_
+
+    def test_infer_dimension_matches_sklearn_internal(self, data):
+        from sklearn.decomposition._pca import (
+            _infer_dimension as sk_infer,
+        )
+
+        X = data - data.mean(axis=0)
+        S = np.linalg.svd(X, compute_uv=False)
+        spectrum = S**2 / (len(X) - 1)
+        assert _infer_dimension(spectrum, len(X)) == sk_infer(spectrum, len(X))
+
+    def test_randomized_solver_close(self, data):
+        with pytest.warns(UserWarning, match="purely classic"):
+            ours = QPCA(n_components=5, svd_solver="randomized",
+                        random_state=0).fit(data)
+        ref = sklearn.decomposition.PCA(
+            n_components=5, svd_solver="full").fit(data)
+        np.testing.assert_allclose(
+            ours.explained_variance_, ref.explained_variance_, rtol=1e-2)
+
+    def test_auto_dispatch(self, data):
+        small = QPCA(n_components=5).fit(data)  # max dim 200 ≤ 500 → full
+        assert small._fit_svd_solver == "full"
+
+    def test_clone(self, data):
+        est = QPCA(n_components=3, whiten=True, random_state=1)
+        c = clone(est)
+        assert c.get_params() == est.get_params()
+
+    def test_fit_transform_works(self, data):
+        # the reference's fit_transform crashes on stale kwargs
+        # (_qPCA.py:467-473); ours is standard fit-then-transform
+        pca = PCA(n_components=3)
+        Xt = pca.fit_transform(data)
+        np.testing.assert_allclose(Xt, pca.transform(data), rtol=1e-5,
+                                   atol=1e-6)
+
+
+class TestQuantumEstimators:
+    def test_sv_estimates_within_eps(self, key):
+        rng = np.random.default_rng(0)
+        S = np.sort(rng.uniform(0.5, 10.0, size=30))[::-1].copy()
+        scale = float(np.linalg.norm(S) * 1.2)
+        eps_scaled = 0.05
+        est = np.asarray(singular_value_estimates(
+            key, S, scale, eps_scaled, n_features=64))
+        # decoding derivative bound: |dσ/dθ| ≤ scale·(ε+π)/2; consistent PE
+        # grid width ε ⇒ σ error ≤ scale·ε·(ε+π)/2 (plus snap rounding)
+        tol = scale * eps_scaled * (eps_scaled + np.pi)
+        assert np.max(np.abs(est - S)) < tol
+
+    def test_spectral_norm_estimation(self, data):
+        pca = QPCA(n_components=10, random_state=0).fit(
+            data, spectral_norm_est=True, eps=0.5, delta=0.01)
+        true = pca.spectral_norm
+        assert abs(pca.est_spectral_norm - true) / true < 0.15
+
+    def test_condition_number_estimation(self, data):
+        pca = QPCA(random_state=0).fit(
+            data, condition_number_est=True, eps=0.1, delta=0.001, p=0.999)
+        # the estimator brackets the smallest *retained* singular value;
+        # binary search bracket width limits precision
+        sigma_min = pca.singular_values_[-1]
+        assert pca.est_sigma_min == pytest.approx(sigma_min, rel=1.0)
+        assert pca.est_cond_number == pytest.approx(
+            pca.spectral_norm / pca.est_sigma_min)
+
+    def test_factor_score_ratio_sum(self, data):
+        # full spectrum (n_components = min shape) so the ratio denominator
+        # covers everything; θ sits in the huge signal/noise spectral gap at
+        # index 20 where PE error cannot flip selections
+        pca = QPCA(n_components=30, random_state=0).fit(data)
+        S = pca.singular_values_
+        theta = 0.5 * (S[19] + S[20]) / pca.muA
+        p_est = pca.quantum_factor_score_ratio_sum(
+            eps=0.01, theta=theta, eta=0.01)
+        p_true = float(np.sum(S[:20] ** 2) / np.sum(S**2))
+        assert abs(p_est - p_true) < 0.05
+
+    def test_estimate_theta_binary_search(self, data):
+        p_target = 0.8
+        pca = QPCA(random_state=0).fit(
+            data, theta_estimate=True, eps_theta=0.05, eta=0.05, p=p_target)
+        # retained mass above est_theta should be ≈ p_target
+        S = pca.singular_values_
+        mass = np.sum(S[S >= pca.est_theta] ** 2) / np.sum(S**2)
+        assert abs(mass - p_target) < 0.15
+
+    def test_estimate_all_gaussian(self, data):
+        pca = QPCA(n_components=8, random_state=0).fit(
+            data, estimate_all=True, eps=0.01, delta=0.05,
+            theta_major=1e-6, true_tomography=False)
+        assert pca.topk == 8
+        # tomography at δ ⇒ per-row L2 error ≲ δ
+        err = np.linalg.norm(pca.estimate_right_sv - pca.components_, axis=1)
+        assert np.all(err < 0.2)
+        np.testing.assert_allclose(
+            np.sum(pca.estimate_fs_ratio),
+            np.sum(pca.explained_variance_ratio_all[:8]), atol=0.1)
+
+    def test_estimate_all_true_tomography_small(self):
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(60, 8))
+        pca = QPCA(n_components=3, random_state=0).fit(
+            X, estimate_all=True, eps=0.01, delta=0.3, theta_major=1e-6,
+            true_tomography=True)
+        err = np.linalg.norm(pca.estimate_right_sv - pca.components_, axis=1)
+        assert np.all(err < 0.45)  # δ-close w.h.p., unit-norm rows
+
+    def test_least_k_extraction(self, data):
+        pca = QPCA(random_state=0).fit(
+            data, estimate_least_k=True, eps=0.01, delta=0.05,
+            theta_minor=5.0, true_tomography=False, p=0.999)
+        S = pca.singular_values_
+        expected = int(np.sum(S[~np.isclose(S, 0)] < 5.0))
+        # PE error can move boundary σ across θ; count is approximate
+        assert abs(pca.least_k - expected) <= 2
+        assert pca.estimate_least_right_sv.shape[1] == data.shape[1]
+
+    def test_delta_eps_zero_is_classical(self, data):
+        pca = QPCA(n_components=5, random_state=0).fit(
+            data, estimate_all=True, eps=0, delta=0, theta_major=1e-9)
+        np.testing.assert_allclose(pca.estimate_right_sv, pca.components_)
+        np.testing.assert_allclose(pca.estimate_s_values,
+                                   pca.singular_values_)
+
+
+class TestQuantumTransform:
+    @pytest.fixture(scope="class")
+    def fitted(self, data):
+        return QPCA(n_components=5, random_state=0).fit(
+            data, estimate_all=True, eps=0.01, delta=0.02,
+            theta_major=1e-6, true_tomography=False)
+
+    def test_classic_transform_warns_on_quantum_args(self, fitted, data):
+        with pytest.warns(UserWarning, match="quantum parameter"):
+            fitted.transform(data, classic_transform=True, epsilon_delta=0.5)
+
+    def test_estimated_components_projection(self, fitted, data):
+        Xt_q = fitted.transform(data, classic_transform=False,
+                                use_classical_components=False)
+        Xt_c = fitted.transform(data)
+        assert Xt_q.shape == Xt_c.shape
+        # estimated components are δ-close ⇒ projections close relatively
+        rel = np.linalg.norm(Xt_q - Xt_c) / np.linalg.norm(Xt_c)
+        assert rel < 0.1
+
+    def test_quantum_representation_none(self, fitted, data):
+        Xt = fitted.transform(data, classic_transform=False,
+                              quantum_representation=True, norm="None",
+                              psi=0.1, epsilon_delta=0.1,
+                              true_tomography=False)
+        Y = Xt["quantum_representation_results"]
+        assert Y.shape == (len(data), 5)
+
+    def test_quantum_representation_est(self, fitted, data):
+        Xt = fitted.transform(data, classic_transform=False,
+                              quantum_representation=True,
+                              norm="est_representation", psi=0,
+                              epsilon_delta=0.1, true_tomography=False)
+        A_sign, eps_delta, f_norm = Xt["quantum_representation_results"]
+        assert A_sign.shape == (len(data), 5)
+        assert f_norm >= 0
+
+    def test_quantum_representation_q_state(self, fitted, data):
+        Xt = fitted.transform(data[:16], classic_transform=False,
+                              quantum_representation=True, norm="q_state",
+                              psi=0.1, epsilon_delta=0.1,
+                              true_tomography=False)
+        qs = Xt["quantum_representation_results"]
+        probs = np.asarray(qs.probabilities)
+        assert probs.shape == (16,)
+        np.testing.assert_allclose(probs.sum(), 1.0, atol=1e-5)
+
+    def test_quantum_representation_f_norm(self, fitted, data):
+        Xt = fitted.transform(data, classic_transform=False,
+                              quantum_representation=True, norm="f_norm",
+                              psi=0.1, epsilon_delta=0.1,
+                              true_tomography=False)
+        Y = Xt["quantum_representation_results"]
+        np.testing.assert_allclose(np.linalg.norm(Y), 1.0, rtol=1e-5)
+
+    def test_inverse_transform_estimated(self, fitted, data):
+        Xt = fitted.transform(data)
+        Xr_c = fitted.inverse_transform(Xt)
+        Xr_q = fitted.inverse_transform(Xt, use_classical_components=False)
+        rel = np.linalg.norm(Xr_q - Xr_c) / np.linalg.norm(Xr_c)
+        assert rel < 0.1
+
+
+class TestRuntimeModel:
+    def test_accumulate_and_compare(self, data, tmp_path):
+        pca = QPCA(n_components=5, random_state=0).fit(
+            data, estimate_all=True, theta_estimate=True,
+            quantum_retained_variance=True, eps=0.1, eps_theta=0.1,
+            eta=0.1, delta=0.1, p=0.8, true_tomography=False)
+        n, m, q_rt, c_rt = pca.runtime_comparison(
+            10_000, 1_000, saveas=str(tmp_path / "rt.png"))
+        assert q_rt.shape == (100, 100)
+        assert np.all(np.isfinite(q_rt))
+        assert (tmp_path / "rt.png").exists()
+
+    def test_q_ret_variance(self, data):
+        pca = QPCA(random_state=0).fit(data, p=0.9)
+        k = pca.q_ret_variance(100_000, 0.9)
+        assert abs(k - pca.n_components_) <= 2
+
+    def test_runtime_container_not_double_counted(self, data):
+        pca = QPCA(n_components=5, random_state=0).fit(
+            data, estimate_all=True, eps=0.1, delta=0.1, theta_major=1e-6,
+            true_tomography=False)
+        _, _, q1, _ = pca.runtime_comparison(1000, 100)
+        _, _, q2, _ = pca.runtime_comparison(1000, 100)
+        np.testing.assert_allclose(q1, q2)
+
+
+class TestValidation:
+    def test_none_components_keeps_full_spectrum(self, data):
+        # the reference collapses n_components=None without p to a single
+        # component (_qPCA.py:620-623); stock semantics keep everything
+        pca = PCA().fit(data)
+        assert pca.n_components_ == min(data.shape)
+
+    def test_estimate_all_requires_theta(self, data):
+        with pytest.raises(ValueError, match="theta_major"):
+            QPCA(n_components=3).fit(data, estimate_all=True, eps=0.1,
+                                     delta=0.1)
+
+    def test_least_k_requires_theta_minor(self, data):
+        with pytest.raises(ValueError, match="theta_minor"):
+            QPCA(n_components=3).fit(data, estimate_least_k=True, eps=0.1,
+                                     delta=0.1)
+
+    def test_eps_zero_estimators_exact(self, data):
+        pca = QPCA(n_components=5, random_state=0).fit(
+            data, spectral_norm_est=True, condition_number_est=True,
+            eps=0, delta=0)
+        assert pca.est_spectral_norm == pca.spectral_norm
+        assert pca.est_sigma_min == pytest.approx(
+            float(pca.singular_values_[-1]))
