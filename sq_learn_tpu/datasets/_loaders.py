@@ -86,6 +86,22 @@ def load_covtype(data_home=None):
 _CICIDS_CLASSES = ("BENIGN", "DoS", "PortScan", "DDoS", "Bot", "Infiltration")
 
 
+def _scan_labels(path):
+    """String labels from the trailing CSV column (header skipped) in one
+    raw-line pass. Returns None if any line contains a double quote — the
+    caller must then use the quote-aware slow path."""
+    labels = []
+    with open(path) as fh:
+        next(fh, None)
+        for line in fh:
+            if '"' in line:
+                return None
+            line = line.rstrip("\n\r")
+            if line:
+                labels.append(line.rsplit(",", 1)[-1].strip())
+    return labels
+
+
 def load_cicids(path=None, n_samples=50_000, n_features=78):
     """CICIDS intrusion-detection loader (BASELINE #5 — the reference has
     no such loader; added per SURVEY §6).
@@ -104,8 +120,25 @@ def load_cicids(path=None, n_samples=50_000, n_features=78):
         env = os.environ.get("CICIDS_CSV")
         path = env if env else None
     if path and os.path.exists(path):
-        # robust CSV ingest: header row, numeric features, label last;
+        # fast path: stream the numeric columns through the native C++
+        # parser (label column parses as NaN), recover labels separately;
         # inf/nan rows (CICIDS has them from flow-rate division) dropped
+        from ..native import csv_read_floats, native_available
+
+        # single Python pass collects labels and vetoes the fast path on
+        # quoted fields (the C parser splits on raw delimiters, so quotes
+        # would shift columns silently); then one C pass parses the floats
+        labels = _scan_labels(path)
+        if native_available() and labels is not None:
+            raw = csv_read_floats(path, skip_header=1)
+            X = raw[:, :-1]
+            if len(labels) == len(X):
+                mask = np.isfinite(X).all(axis=1)
+                X = np.ascontiguousarray(X[mask])
+                labels = np.asarray(labels)[mask]
+                classes, y = np.unique(labels, return_inverse=True)
+                return X, y.astype(np.int32), True
+
         import csv
 
         feats, labels = [], []

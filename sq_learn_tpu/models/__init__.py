@@ -1,9 +1,11 @@
 """Estimators (reference layer L4): quantum and classical model families."""
 
+from .minibatch import MiniBatchKMeans, MiniBatchQKMeans
 from .neighbors import KNeighborsClassifier
 from .qkmeans import KMeans, QKMeans, kmeans_plusplus, lloyd_single
 from .qlssvc import QLSSVC
 from .qpca import PCA, QPCA
 
-__all__ = ["KMeans", "KNeighborsClassifier", "QKMeans", "QPCA", "PCA",
+__all__ = ["KMeans", "KNeighborsClassifier", "MiniBatchKMeans",
+           "MiniBatchQKMeans", "QKMeans", "QPCA", "PCA",
            "QLSSVC", "kmeans_plusplus", "lloyd_single"]

@@ -29,6 +29,9 @@ def knn_indices(X_train, X_query, k, block=4096):
     matrix never fully materializes for large query sets.
     """
     nq = X_query.shape[0]
+    # small query sets (CV folds, interactive predicts) pad only to a lane
+    # multiple, not to a full block — avoids up to ~40x wasted GEMM work
+    block = min(block, nq + (-nq) % 8)
     pad = (-nq) % block
     Xq = jnp.pad(X_query, ((0, pad), (0, 0)))
 
@@ -61,8 +64,8 @@ class KNeighborsClassifier(ClassifierMixin, BaseEstimator):
     def fit(self, X, y):
         X, y = check_X_y(X, y)
         self.classes_, y_enc = np.unique(y, return_inverse=True)
-        self._X = jnp.asarray(X)
-        self._y = jnp.asarray(y_enc.astype(np.int32))
+        self.X_fit_ = jnp.asarray(X)
+        self.y_fit_ = jnp.asarray(y_enc.astype(np.int32))
         self.n_samples_fit_ = len(X)
         return self
 
@@ -70,7 +73,7 @@ class KNeighborsClassifier(ClassifierMixin, BaseEstimator):
         check_is_fitted(self, "n_samples_fit_")
         X = check_array(X)
         k = n_neighbors or self.n_neighbors
-        idx, d2 = knn_indices(self._X, jnp.asarray(X), k)
+        idx, d2 = knn_indices(self.X_fit_, jnp.asarray(X), k)
         if return_distance:
             return np.sqrt(np.asarray(d2)), np.asarray(idx)
         return np.asarray(idx)
@@ -78,8 +81,8 @@ class KNeighborsClassifier(ClassifierMixin, BaseEstimator):
     def predict_proba(self, X):
         check_is_fitted(self, "n_samples_fit_")
         X = check_array(X)
-        idx, d2 = knn_indices(self._X, jnp.asarray(X), self.n_neighbors)
-        votes = self._y[idx]  # (n, k)
+        idx, d2 = knn_indices(self.X_fit_, jnp.asarray(X), self.n_neighbors)
+        votes = self.y_fit_[idx]  # (n, k)
         n_classes = len(self.classes_)
         onehot = jax.nn.one_hot(votes, n_classes)
         if self.weights == "distance":

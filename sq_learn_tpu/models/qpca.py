@@ -162,11 +162,17 @@ class QPCA(TransformerMixin, BaseEstimator):
         randomized path covers the truncated use case.
     random_state : None, int, or jax key
         Seeds every quantum simulation in fit/transform.
+    compute_mu : 'auto' or bool
+        μ(A) (the quantum-memory-model norm, ``Utility.py:196-231``) feeds
+        only the QADRA estimators but costs a grid of full-matrix
+        reductions. 'auto' computes it iff a QADRA fit kwarg is set; True
+        always (needed to call the QADRA methods post-fit on a classical
+        fit); False never.
     """
 
     def __init__(self, n_components=None, *, copy=True, whiten=False,
                  svd_solver="auto", tol=0.0, iterated_power="auto",
-                 random_state=None, name=None):
+                 random_state=None, name=None, compute_mu="auto"):
         self.n_components = n_components
         self.copy = copy
         self.whiten = whiten
@@ -175,6 +181,7 @@ class QPCA(TransformerMixin, BaseEstimator):
         self.iterated_power = iterated_power
         self.random_state = random_state
         self.name = name
+        self.compute_mu = compute_mu
         self.quantum_runtime_container = []
 
     # -- fit ----------------------------------------------------------------
@@ -350,7 +357,15 @@ class QPCA(TransformerMixin, BaseEstimator):
 
         self.spectral_norm = float(S_np[0])
         self.frob_norm = float(np.linalg.norm(np.asarray(Xc)))
-        self.norm_muA, self.muA = best_mu(Xc, 0.0, step=0.1)
+        # μ(A) feeds only the QADRA estimators below — its grid search costs
+        # ~11 powered full-matrix reductions, so pure classical fits skip it
+        need_mu = (self.quantum_retained_variance or self.theta_estimate
+                   or self.estimate_all or self.estimate_least_k
+                   if self.compute_mu == "auto" else bool(self.compute_mu))
+        if need_mu:
+            self.norm_muA, self.muA = best_mu(Xc, 0.0, step=0.1)
+        else:
+            self.norm_muA = self.muA = None
 
         if self.condition_number_est:
             (self.est_sigma_min, self.est_cond_number) = \
@@ -363,8 +378,12 @@ class QPCA(TransformerMixin, BaseEstimator):
             self.est_theta = self.estimate_theta(
                 epsilon=self.eps_theta, eta=self.eta, p=self.ret_var)
         if self.quantum_retained_variance:
+            # quantum_factor_score_ratio_sum works in σ/μ(A) units (what
+            # estimate_theta's binary search walks); fit's kwargs are in
+            # absolute σ units, so rescale both here
             self.p = float(self.quantum_factor_score_ratio_sum(
-                eps=self.eps, theta=self.theta_major, eta=self.eta))
+                eps=self.eps / self.muA, theta=self.theta_major / self.muA,
+                eta=self.eta))
         if self.estimate_least_k:
             (self.estimate_least_right_sv, self.estimate_least_left_sv,
              self.estimate_least_s_values, self.estimate_least_fs,
@@ -497,10 +516,18 @@ class QPCA(TransformerMixin, BaseEstimator):
         cond = self.spectral_norm / sigma_min if sigma_min > 0 else np.inf
         return sigma_min, cond
 
+    def _require_mu(self):
+        if getattr(self, "muA", None) is None:
+            raise ValueError(
+                "mu(A) was not computed during fit (no QADRA estimator flag "
+                "was set); refit with a QADRA fit kwarg or construct with "
+                "compute_mu=True to use this method post-fit")
+
     def quantum_factor_score_ratio_sum(self, eps, theta, eta):
         """Theorem 9 of QADRA (reference ``_qPCA.py:982-999``): estimated
         factor-score-ratio mass p̂ of singular values ≥ θ (θ in σ/μ(A)
         units), amplitude-estimated at precision ``eta``."""
+        self._require_mu()
         if not theta:
             theta = self.est_theta / self.muA  # est_theta is stored unscaled
         S = jnp.asarray(self.singular_values_)
@@ -516,6 +543,7 @@ class QPCA(TransformerMixin, BaseEstimator):
         """Theorem 10 of QADRA (reference ``estimate_theta``,
         ``_qPCA.py:1002-1022``): binary-search the threshold θ whose
         factor-score-ratio sum matches the target retained variance p."""
+        self._require_mu()
         lo, hi = 0.0, 1.0
         if abs(lo - p) <= eta:
             return self.muA
@@ -541,6 +569,7 @@ class QPCA(TransformerMixin, BaseEstimator):
         One batched consistent-PE pass over the spectrum, host-side
         selection (the selected count is data-dependent — jit-hostile by
         nature), then one vmapped tomography call per side (U and V)."""
+        self._require_mu()
         S = np.asarray(self.singular_values_)
         if not top:
             # least-k only considers numerically nonzero σ (the reference
@@ -659,8 +688,6 @@ class QPCA(TransformerMixin, BaseEstimator):
 
         X_final = self._project(
             X, use_classical_components=use_classical_components)
-        if not use_classical_components:
-            return X_final
         if quantum_representation:
             assert psi > 0 if norm != "est_representation" else psi >= 0
             assert epsilon_delta > 0
@@ -849,6 +876,11 @@ class QPCA(TransformerMixin, BaseEstimator):
         q_runtime = self.accumulate_q_runtime(
             n_samples=n, n_features=m,
             estimate_components=estimate_components)
+        if not q_runtime:
+            raise ValueError(
+                "no quantum estimator ran during fit — runtime_comparison "
+                "needs at least one of theta_estimate, "
+                "quantum_retained_variance, estimate_all, estimate_least_k")
         q_runtime = (np.sum(q_runtime, axis=0) if len(q_runtime) > 1
                      else q_runtime[0])
         if saveas:

@@ -1,0 +1,255 @@
+"""Native host runtime (C++ via ctypes).
+
+The compute path of this framework is XLA; this package is the host-side
+native layer the reference builds in Cython/C++ (SURVEY §2.2):
+
+- :func:`lloyd_iter` — threaded fused Lloyd E+M step, the CPU-parity
+  equivalent of the reference's ``lloyd_iter_chunked_dense``
+  (``cluster/_k_means_lloyd.pyx:29``).
+- :func:`murmurhash3_32` — feature hashing (reference vendors
+  ``utils/src/MurmurHash3.cpp``; ours re-implements the public algorithm).
+- :func:`csv_read_floats` — threaded float-CSV ingest for large host-side
+  datasets (CICIDS et al.).
+
+The shared library is compiled on first use with ``g++`` and cached next to
+the source; every entry point has a NumPy fallback so the package works on
+hosts without a toolchain. ``native_available()`` reports which path is
+active.
+"""
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_HERE = os.path.dirname(__file__)
+_SRC = os.path.join(_HERE, "src", "native.cpp")
+_LIB_PATH = os.path.join(_HERE, "_native.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build():
+    cmd = ["g++", "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
+           "-pthread", _SRC, "-o", _LIB_PATH]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (subprocess.SubprocessError, FileNotFoundError):
+        # retry without -march=native (portable build)
+        try:
+            cmd.remove("-march=native")
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            return True
+        except (subprocess.SubprocessError, FileNotFoundError):
+            return False
+
+
+def _load():
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_LIB_PATH) or (
+                os.path.exists(_SRC)
+                and os.path.getmtime(_SRC) > os.path.getmtime(_LIB_PATH)):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            return None
+
+        lib.lloyd_iter_chunked.restype = ctypes.c_int
+        lib.lloyd_iter_chunked.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_double), ctypes.c_int]
+        lib.murmurhash3_x86_32.restype = ctypes.c_uint32
+        lib.murmurhash3_x86_32.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_uint32]
+        lib.murmurhash3_bulk.restype = None
+        lib.murmurhash3_bulk.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+            ctypes.c_uint32, ctypes.POINTER(ctypes.c_uint32)]
+        lib.csv_shape.restype = ctypes.c_int
+        lib.csv_shape.argtypes = [
+            ctypes.c_char_p, ctypes.c_char, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64)]
+        lib.csv_parse_floats.restype = ctypes.c_int64
+        lib.csv_parse_floats.argtypes = [
+            ctypes.c_char_p, ctypes.c_char, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_int64]
+        _lib = lib
+        return _lib
+
+
+def native_available():
+    """True when the C++ library compiled and loaded."""
+    return _load() is not None
+
+
+# ---------------------------------------------------------------------------
+# Lloyd iteration
+# ---------------------------------------------------------------------------
+
+
+def lloyd_iter(X, centers, sample_weight=None, n_threads=0):
+    """One fused Lloyd E+M step on the host.
+
+    Returns ``(labels int32 (n,), sums float64 (k, m), counts float64 (k,),
+    inertia float)``. Native path: threaded C++ chunk kernel; fallback:
+    vectorized NumPy.
+    """
+    X = np.ascontiguousarray(X, dtype=np.float32)
+    centers = np.ascontiguousarray(centers, dtype=np.float32)
+    n, m = X.shape
+    k = centers.shape[0]
+    if sample_weight is not None:
+        sample_weight = np.ascontiguousarray(sample_weight, dtype=np.float32)
+
+    lib = _load()
+    if lib is not None:
+        labels = np.empty(n, np.int32)
+        sums = np.empty((k, m), np.float64)
+        counts = np.empty(k, np.float64)
+        inertia = ctypes.c_double()
+        w_ptr = (sample_weight.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+                 if sample_weight is not None
+                 else ctypes.cast(None, ctypes.POINTER(ctypes.c_float)))
+        rc = lib.lloyd_iter_chunked(
+            X.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), w_ptr,
+            centers.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            n, m, k,
+            labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            sums.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            counts.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            ctypes.byref(inertia), int(n_threads))
+        if rc == 0:
+            return labels, sums, counts, float(inertia.value)
+
+    # NumPy fallback
+    w = np.ones(n, np.float64) if sample_weight is None else \
+        sample_weight.astype(np.float64)
+    c_sq = (centers.astype(np.float64) ** 2).sum(axis=1)
+    d = c_sq[None, :] - 2.0 * (X.astype(np.float64) @ centers.T.astype(np.float64))
+    labels = np.argmin(d, axis=1).astype(np.int32)
+    x_sq = (X.astype(np.float64) ** 2).sum(axis=1)
+    inertia = float(np.sum(w * (d[np.arange(n), labels] + x_sq)))
+    onehot = np.zeros((n, k))
+    onehot[np.arange(n), labels] = w
+    sums = onehot.T @ X.astype(np.float64)
+    counts = onehot.sum(axis=0)
+    return labels, sums, counts, inertia
+
+
+# ---------------------------------------------------------------------------
+# MurmurHash3
+# ---------------------------------------------------------------------------
+
+
+def _mm3_py(data, seed):
+    """Pure-Python MurmurHash3 x86 32-bit (fallback)."""
+    c1, c2 = 0xcc9e2d51, 0x1b873593
+    h1 = seed & 0xFFFFFFFF
+    length = len(data)
+    rounded = length & ~3
+    for i in range(0, rounded, 4):
+        k1 = int.from_bytes(data[i:i + 4], "little")
+        k1 = (k1 * c1) & 0xFFFFFFFF
+        k1 = ((k1 << 15) | (k1 >> 17)) & 0xFFFFFFFF
+        k1 = (k1 * c2) & 0xFFFFFFFF
+        h1 ^= k1
+        h1 = ((h1 << 13) | (h1 >> 19)) & 0xFFFFFFFF
+        h1 = (h1 * 5 + 0xe6546b64) & 0xFFFFFFFF
+    k1 = 0
+    tail = data[rounded:]
+    if len(tail) >= 3:
+        k1 ^= tail[2] << 16
+    if len(tail) >= 2:
+        k1 ^= tail[1] << 8
+    if len(tail) >= 1:
+        k1 ^= tail[0]
+        k1 = (k1 * c1) & 0xFFFFFFFF
+        k1 = ((k1 << 15) | (k1 >> 17)) & 0xFFFFFFFF
+        k1 = (k1 * c2) & 0xFFFFFFFF
+        h1 ^= k1
+    h1 ^= length
+    h1 ^= h1 >> 16
+    h1 = (h1 * 0x85ebca6b) & 0xFFFFFFFF
+    h1 ^= h1 >> 13
+    h1 = (h1 * 0xc2b2ae35) & 0xFFFFFFFF
+    h1 ^= h1 >> 16
+    return h1
+
+
+def murmurhash3_32(key, seed=0):
+    """MurmurHash3 x86 32-bit of ``key`` (str or bytes)."""
+    if isinstance(key, str):
+        key = key.encode("utf-8")
+    lib = _load()
+    if lib is not None:
+        return int(lib.murmurhash3_x86_32(key, len(key), seed & 0xFFFFFFFF))
+    return _mm3_py(key, seed)
+
+
+def murmurhash3_bulk(strings, seed=0):
+    """Hash a sequence of strings; returns uint32 array."""
+    encoded = [s.encode("utf-8") if isinstance(s, str) else bytes(s)
+               for s in strings]
+    lib = _load()
+    if lib is not None and encoded:
+        buf = b"".join(encoded)
+        offsets = np.zeros(len(encoded) + 1, np.int64)
+        np.cumsum([len(e) for e in encoded], out=offsets[1:])
+        out = np.empty(len(encoded), np.uint32)
+        lib.murmurhash3_bulk(
+            buf, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(encoded), seed & 0xFFFFFFFF,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)))
+        return out
+    return np.array([_mm3_py(e, seed) for e in encoded], np.uint32)
+
+
+# ---------------------------------------------------------------------------
+# CSV ingest
+# ---------------------------------------------------------------------------
+
+
+def csv_read_floats(path, delimiter=",", skip_header=1, max_rows=None):
+    """Read a numeric CSV into a float32 array (NaN for non-numeric
+    fields). Native path streams with the C parser; fallback is
+    ``np.genfromtxt``."""
+    path = os.fspath(path)
+    lib = _load()
+    if lib is not None:
+        rows = ctypes.c_int64()
+        cols = ctypes.c_int64()
+        rc = lib.csv_shape(path.encode(), delimiter.encode(),
+                           int(skip_header), ctypes.byref(rows),
+                           ctypes.byref(cols))
+        if rc == 0 and rows.value > 0:
+            n = rows.value if max_rows is None else min(rows.value, max_rows)
+            out = np.empty((n, cols.value), np.float32)
+            got = lib.csv_parse_floats(
+                path.encode(), delimiter.encode(), int(skip_header),
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                n, cols.value)
+            if got >= 0:
+                return out[:got]
+    data = np.genfromtxt(path, delimiter=delimiter, skip_header=skip_header,
+                         max_rows=max_rows, dtype=np.float32)
+    if data.ndim == 1:  # single column parses as (n,), not (1, n)
+        data = data.reshape(-1, 1)
+    return data
+
+
+__all__ = ["native_available", "lloyd_iter", "murmurhash3_32",
+           "murmurhash3_bulk", "csv_read_floats"]

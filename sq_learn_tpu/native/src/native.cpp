@@ -1,0 +1,233 @@
+// sq-learn-tpu native host runtime.
+//
+// TPU-native framework layout puts the FLOP path on XLA; this library is the
+// host-side runtime the reference implements in Cython/C++ (SURVEY §2.2):
+//
+//  - lloyd_iter_chunked: the CPU-parity fused Lloyd E+M step — chunked
+//    pairwise distances via the ||c||^2 - 2 x.c trick, argmin labels,
+//    thread-local partial centroid sums with a serial reduction. This is the
+//    same algorithm as the reference's `lloyd_iter_chunked_dense`
+//    (cluster/_k_means_lloyd.pyx:29): OpenMP prange becomes std::thread.
+//  - murmurhash3_x86_32 (+ bulk variant): feature hashing, re-implemented
+//    from the public MurmurHash3 algorithm (reference vendors
+//    utils/src/MurmurHash3.cpp).
+//  - csv_count_rows / csv_parse_floats: a threaded float-CSV ingest path for
+//    host-side data loading (the reference leans on numpy/pandas; our
+//    loaders stream large CSVs like CICIDS through this).
+//
+// Exposed as plain C symbols consumed via ctypes (no pybind11 in the image).
+
+#include <atomic>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Lloyd iteration (CPU parity kernel)
+// ---------------------------------------------------------------------------
+
+// X: (n, m) row-major float32; centers: (k, m); sample_weight: (n)
+// out_labels: (n) int32; out_sums: (k, m) float64; out_counts: (k) float64;
+// out_inertia: scalar float64. Returns 0 on success.
+int lloyd_iter_chunked(const float* X, const float* sample_weight,
+                       const float* centers, int64_t n, int64_t m, int64_t k,
+                       int32_t* out_labels, double* out_sums,
+                       double* out_counts, double* out_inertia,
+                       int n_threads) {
+  if (n <= 0 || m <= 0 || k <= 0) return -1;
+  if (n_threads <= 0) {
+    n_threads = (int)std::thread::hardware_concurrency();
+    if (n_threads <= 0) n_threads = 1;
+  }
+  if ((int64_t)n_threads > n) n_threads = (int)n;
+
+  // ||c||^2 once
+  std::vector<double> c_sq(k);
+  for (int64_t j = 0; j < k; ++j) {
+    double s = 0.0;
+    const float* c = centers + j * m;
+    for (int64_t f = 0; f < m; ++f) s += (double)c[f] * c[f];
+    c_sq[j] = s;
+  }
+
+  const int64_t chunk = 256;  // reference CHUNK_SIZE (_k_means_fast.pyx:31)
+  std::atomic<int64_t> next_chunk{0};
+  const int64_t n_chunks = (n + chunk - 1) / chunk;
+
+  std::vector<std::vector<double>> t_sums((size_t)n_threads,
+                                          std::vector<double>(k * m, 0.0));
+  std::vector<std::vector<double>> t_counts((size_t)n_threads,
+                                            std::vector<double>(k, 0.0));
+  std::vector<double> t_inertia((size_t)n_threads, 0.0);
+
+  auto worker = [&](int tid) {
+    std::vector<double>& sums = t_sums[tid];
+    std::vector<double>& counts = t_counts[tid];
+    double inertia = 0.0;
+    for (;;) {
+      int64_t c0 = next_chunk.fetch_add(1);
+      if (c0 >= n_chunks) break;
+      int64_t lo = c0 * chunk, hi = std::min(n, lo + chunk);
+      for (int64_t i = lo; i < hi; ++i) {
+        const float* x = X + i * m;
+        double best = 1e300;
+        int32_t best_j = 0;
+        for (int64_t j = 0; j < k; ++j) {
+          const float* c = centers + j * m;
+          double dot = 0.0;
+          for (int64_t f = 0; f < m; ++f) dot += (double)x[f] * c[f];
+          double d = c_sq[j] - 2.0 * dot;  // ||x||^2 constant in argmin
+          if (d < best) { best = d; best_j = (int32_t)j; }
+        }
+        out_labels[i] = best_j;
+        double w = sample_weight ? (double)sample_weight[i] : 1.0;
+        double x_sq = 0.0;
+        for (int64_t f = 0; f < m; ++f) {
+          x_sq += (double)x[f] * x[f];
+          sums[best_j * m + f] += w * x[f];
+        }
+        counts[best_j] += w;
+        inertia += w * (best + x_sq);
+      }
+    }
+    t_inertia[tid] = inertia;
+  };
+
+  std::vector<std::thread> threads;
+  for (int t = 0; t < n_threads; ++t) threads.emplace_back(worker, t);
+  for (auto& th : threads) th.join();
+
+  // serial reduction (the GIL-guarded reduction of _k_means_lloyd.pyx:145)
+  std::memset(out_sums, 0, sizeof(double) * k * m);
+  std::memset(out_counts, 0, sizeof(double) * k);
+  double inertia = 0.0;
+  for (int t = 0; t < n_threads; ++t) {
+    for (int64_t e = 0; e < k * m; ++e) out_sums[e] += t_sums[t][e];
+    for (int64_t j = 0; j < k; ++j) out_counts[j] += t_counts[t][j];
+    inertia += t_inertia[t];
+  }
+  *out_inertia = inertia;
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// MurmurHash3 x86 32-bit (public domain algorithm, Austin Appleby)
+// ---------------------------------------------------------------------------
+
+static inline uint32_t rotl32(uint32_t x, int8_t r) {
+  return (x << r) | (x >> (32 - r));
+}
+
+uint32_t murmurhash3_x86_32(const void* key, int len, uint32_t seed) {
+  const uint8_t* data = (const uint8_t*)key;
+  const int nblocks = len / 4;
+  uint32_t h1 = seed;
+  const uint32_t c1 = 0xcc9e2d51, c2 = 0x1b873593;
+
+  for (int i = 0; i < nblocks; ++i) {
+    uint32_t k1;
+    std::memcpy(&k1, data + i * 4, 4);
+    k1 *= c1; k1 = rotl32(k1, 15); k1 *= c2;
+    h1 ^= k1; h1 = rotl32(h1, 13); h1 = h1 * 5 + 0xe6546b64;
+  }
+
+  const uint8_t* tail = data + nblocks * 4;
+  uint32_t k1 = 0;
+  switch (len & 3) {
+    case 3: k1 ^= (uint32_t)tail[2] << 16; [[fallthrough]];
+    case 2: k1 ^= (uint32_t)tail[1] << 8; [[fallthrough]];
+    case 1: k1 ^= tail[0];
+      k1 *= c1; k1 = rotl32(k1, 15); k1 *= c2; h1 ^= k1;
+  }
+
+  h1 ^= (uint32_t)len;
+  h1 ^= h1 >> 16; h1 *= 0x85ebca6b; h1 ^= h1 >> 13;
+  h1 *= 0xc2b2ae35; h1 ^= h1 >> 16;
+  return h1;
+}
+
+// Hash `count` NUL-separated strings from a packed buffer; offsets has
+// count+1 entries into buf.
+void murmurhash3_bulk(const char* buf, const int64_t* offsets, int64_t count,
+                      uint32_t seed, uint32_t* out) {
+  for (int64_t i = 0; i < count; ++i) {
+    out[i] = murmurhash3_x86_32(buf + offsets[i],
+                                (int)(offsets[i + 1] - offsets[i]), seed);
+  }
+}
+
+// ---------------------------------------------------------------------------
+// CSV float ingest
+// ---------------------------------------------------------------------------
+
+// Count data rows and columns of a delimiter-separated numeric file.
+// Returns 0 on success; n_rows excludes `skip_header` lines.
+int csv_shape(const char* path, char delim, int skip_header, int64_t* n_rows,
+              int64_t* n_cols) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  char* line = nullptr;
+  size_t cap = 0;
+  int64_t rows = 0, cols = 0;
+  int skipped = 0;
+  ssize_t len;
+  while ((len = getline(&line, &cap, f)) != -1) {
+    if (skipped < skip_header) { ++skipped; continue; }
+    if (len <= 1 && (line[0] == '\n' || line[0] == '\0')) continue;
+    if (rows == 0) {
+      cols = 1;
+      for (ssize_t i = 0; i < len; ++i)
+        if (line[i] == delim) ++cols;
+    }
+    ++rows;
+  }
+  std::free(line);
+  std::fclose(f);
+  *n_rows = rows;
+  *n_cols = cols;
+  return 0;
+}
+
+// Parse the file into a preallocated (n_rows, n_cols) float32 row-major
+// buffer. Non-numeric fields parse as NaN (strtof stops at junk; empty
+// fields / text labels -> NaN, caller decides). Returns number of rows
+// parsed, or -1 on IO error.
+int64_t csv_parse_floats(const char* path, char delim, int skip_header,
+                         float* out, int64_t max_rows, int64_t n_cols) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  char* line = nullptr;
+  size_t cap = 0;
+  int64_t row = 0;
+  int skipped = 0;
+  ssize_t len;
+  while (row < max_rows && (len = getline(&line, &cap, f)) != -1) {
+    if (skipped < skip_header) { ++skipped; continue; }
+    if (len <= 1 && (line[0] == '\n' || line[0] == '\0')) continue;
+    char* p = line;
+    for (int64_t c = 0; c < n_cols; ++c) {
+      char* end = p;
+      float v = strtof(p, &end);
+      if (end == p) {  // non-numeric field
+        v = NAN;
+        while (*end && *end != delim && *end != '\n') ++end;
+      }
+      out[row * n_cols + c] = v;
+      p = end;
+      while (*p && *p != delim && *p != '\n') ++p;
+      if (*p == delim) ++p;
+    }
+    ++row;
+  }
+  std::free(line);
+  std::fclose(f);
+  return row;
+}
+
+}  // extern "C"

@@ -1,5 +1,6 @@
 """Numeric and plumbing utilities (reference layer L1, ``sklearn/utils/``)."""
 
+from .checkpoint import load_estimator, load_pytree, save_estimator, save_pytree
 from .keys import as_key, key_iter, split
 from .validation import (
     check_array,
@@ -16,4 +17,8 @@ __all__ = [
     "check_random_state",
     "check_sample_weight",
     "check_X_y",
+    "save_estimator",
+    "load_estimator",
+    "save_pytree",
+    "load_pytree",
 ]

@@ -1,0 +1,165 @@
+"""Checkpoint / resume.
+
+The reference has no checkpoint story beyond "pickle the fitted estimator"
+(sklearn convention, ``doc/modules/model_persistence.rst``) and the
+incremental ``MiniBatchKMeans.partial_fit`` state (``_dmeans.py:2139``).
+This module gives both a first-class, pickle-free form:
+
+- :func:`save_estimator` / :func:`load_estimator` — fitted estimators as a
+  directory of ``meta.json`` (class path + hyperparams) plus ``state.npz``
+  (every public non-hyperparameter attribute). Survives process and host
+  boundaries; no code execution on load beyond importing the estimator
+  class.
+- :func:`save_pytree` / :func:`load_pytree` — arbitrary JAX pytrees (e.g.
+  mid-run Lloyd state ``(key, centers, counts)``) flattened to npz as
+  positional leaves, restored against a same-structure template tree. This
+  is the infra-failure recovery hook
+  SURVEY §5 calls for: a q-means run interrupted between Lloyd iterations
+  resumes from the last saved state.
+
+Orbax is the natural backend for multi-host async checkpointing; these
+helpers intentionally share its layout philosophy (tree → flat keypaths) so
+swapping the IO layer for ``orbax.checkpoint`` is mechanical. We keep the
+std-lib implementation as the default because single-host estimator state is
+kilobytes, not terabytes.
+"""
+
+import importlib
+import json
+import os
+
+import numpy as np
+import jax
+
+
+_SCALARS = (int, float, bool, str, type(None))
+
+
+def _class_path(obj):
+    cls = type(obj)
+    return f"{cls.__module__}.{cls.__qualname__}"
+
+
+def _import_class(path):
+    module, _, name = path.rpartition(".")
+    mod = importlib.import_module(module)
+    obj = mod
+    for part in name.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+def save_estimator(estimator, path):
+    """Serialize a fitted estimator to directory ``path``.
+
+    Hyperparameters come from ``get_params(deep=False)``; fitted state is
+    every other public instance attribute (private ``_*`` attributes are
+    transient by convention). Attributes that are neither arrays nor JSON
+    scalars are recorded in ``skipped_state`` so a dropped attribute is
+    visible in the checkpoint, not silent. Returns ``path``.
+    """
+    os.makedirs(path, exist_ok=True)
+    hyper = estimator.get_params(deep=False)
+    params = {}
+    skipped_params = []
+    for k, v in hyper.items():
+        if isinstance(v, _SCALARS):
+            params[k] = v
+        elif isinstance(v, (list, tuple)) and all(
+                isinstance(x, _SCALARS) for x in v):
+            params[k] = list(v)
+        elif isinstance(v, (np.ndarray, jax.Array)):
+            params[k] = {"__array__": f"param_{k}"}
+        else:
+            skipped_params.append(k)  # e.g. a Mesh — not serializable
+
+    arrays = {}
+    state_scalars = {}
+    state_arrays = []
+    skipped_state = []
+    for k, v in vars(estimator).items():
+        if k.startswith("_") or k in hyper:
+            continue
+        if isinstance(v, (np.ndarray, jax.Array)):
+            arrays[f"state_{k}"] = np.asarray(v)
+            state_arrays.append(k)
+        elif isinstance(v, _SCALARS):
+            state_scalars[k] = v
+        elif isinstance(v, (np.floating, np.integer, np.bool_)):
+            state_scalars[k] = v.item()
+        else:
+            skipped_state.append(k)
+
+    for k, v in hyper.items():
+        if isinstance(v, (np.ndarray, jax.Array)):
+            arrays[f"param_{k}"] = np.asarray(v)
+
+    meta = {
+        "format": "sq-learn-tpu-estimator-v1",
+        "class": _class_path(estimator),
+        "params": params,
+        "skipped_params": skipped_params,
+        "state_scalars": state_scalars,
+        "state_arrays": state_arrays,
+        "skipped_state": skipped_state,
+    }
+    with open(os.path.join(path, "meta.json"), "w") as f:
+        json.dump(meta, f, indent=1, default=str)
+    np.savez(os.path.join(path, "state.npz"), **arrays)
+    return path
+
+
+def load_estimator(path):
+    """Reconstruct an estimator saved by :func:`save_estimator`."""
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    if meta.get("format") != "sq-learn-tpu-estimator-v1":
+        raise ValueError(f"not an estimator checkpoint: {path}")
+    npz = np.load(os.path.join(path, "state.npz"))
+    params = {}
+    for k, v in meta["params"].items():
+        if isinstance(v, dict) and "__array__" in v:
+            params[k] = npz[v["__array__"]]
+        else:
+            params[k] = v
+    cls = _import_class(meta["class"])
+    est = cls(**params)
+    for k, v in meta["state_scalars"].items():
+        setattr(est, k, v)
+    for k in meta["state_arrays"]:
+        setattr(est, k, npz[f"state_{k}"])
+    return est
+
+
+# ---------------------------------------------------------------------------
+# pytree checkpointing (mid-run state)
+# ---------------------------------------------------------------------------
+
+
+def save_pytree(path, tree, step=None):
+    """Save a JAX pytree to ``path`` (an ``.npz`` file). ``step`` is an
+    optional integer recorded alongside (e.g. the Lloyd iteration)."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    arrays = {f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)}
+    arrays["__treedef__"] = np.asarray(str(treedef))
+    if step is not None:
+        arrays["__step__"] = np.asarray(int(step))
+    np.savez(path, **arrays)
+    return path
+
+
+def load_pytree(path, like):
+    """Load a pytree saved by :func:`save_pytree`. ``like`` is a pytree with
+    the same structure (its leaf values are ignored). Returns
+    ``(tree, step)``; ``step`` is None if not recorded."""
+    npz = np.load(path if str(path).endswith(".npz") else str(path) + ".npz",
+                  allow_pickle=False)
+    n = sum(1 for k in npz.files if k.startswith("leaf_"))
+    leaves = [npz[f"leaf_{i}"] for i in range(n)]
+    treedef = jax.tree_util.tree_structure(like)
+    if treedef.num_leaves != n:
+        raise ValueError(
+            f"checkpoint has {n} leaves; template has {treedef.num_leaves}")
+    tree = jax.tree_util.tree_unflatten(treedef, leaves)
+    step = int(npz["__step__"]) if "__step__" in npz.files else None
+    return tree, step

@@ -1,0 +1,95 @@
+"""Checkpoint / resume tests (SURVEY §5: fitted-state serialization +
+mid-run Lloyd state recovery)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from sq_learn_tpu.datasets import make_blobs
+from sq_learn_tpu.models import KMeans, MiniBatchQKMeans, QPCA
+from sq_learn_tpu.utils import (
+    load_estimator,
+    load_pytree,
+    save_estimator,
+    save_pytree,
+)
+
+
+@pytest.fixture(scope="module")
+def blobs():
+    return make_blobs(n_samples=300, centers=3, n_features=6,
+                      cluster_std=0.5, random_state=11)
+
+
+def test_estimator_roundtrip_kmeans(tmp_path, blobs):
+    X, _ = blobs
+    km = KMeans(n_clusters=3, n_init=2, random_state=0).fit(X)
+    path = save_estimator(km, str(tmp_path / "km"))
+    km2 = load_estimator(path)
+    assert type(km2) is KMeans
+    np.testing.assert_allclose(km2.cluster_centers_, km.cluster_centers_)
+    np.testing.assert_array_equal(km2.labels_, km.labels_)
+    assert km2.inertia_ == pytest.approx(km.inertia_)
+    # loaded estimator predicts without refit
+    np.testing.assert_array_equal(km2.predict(X[:20]), km.predict(X[:20]))
+
+
+def test_estimator_roundtrip_qpca(tmp_path, blobs):
+    X, _ = blobs
+    p = QPCA(n_components=3, random_state=0).fit(X)
+    p2 = load_estimator(save_estimator(p, str(tmp_path / "qpca")))
+    np.testing.assert_allclose(p2.components_, p.components_, rtol=1e-6)
+    np.testing.assert_allclose(p2.transform(X[:5]), p.transform(X[:5]),
+                               rtol=1e-5)
+
+
+def test_partial_fit_resume_across_checkpoint(tmp_path, blobs):
+    """The streaming-state API survives save/load mid-stream."""
+    X, y = blobs
+    mb = MiniBatchQKMeans(n_clusters=3, random_state=0)
+    rng = np.random.default_rng(0)
+    for _ in range(10):
+        mb.partial_fit(X[rng.choice(len(X), 64, replace=False)])
+    path = save_estimator(mb, str(tmp_path / "mb"))
+    mb2 = load_estimator(path)
+    np.testing.assert_allclose(mb2.cluster_centers_, mb.cluster_centers_)
+    np.testing.assert_allclose(mb2.counts_, mb.counts_)
+    for _ in range(10):
+        mb2.partial_fit(X[rng.choice(len(X), 64, replace=False)])
+    assert mb2.n_steps_ == 20
+
+
+def test_pytree_roundtrip(tmp_path):
+    key = jax.random.PRNGKey(0)
+    tree = {"centers": jnp.arange(12.0).reshape(3, 4),
+            "counts": jnp.ones(3),
+            "key": jax.random.key_data(key)}
+    f = str(tmp_path / "state.npz")
+    save_pytree(f, tree, step=17)
+    tree2, step = load_pytree(f, tree)
+    assert step == 17
+    np.testing.assert_allclose(tree2["centers"], tree["centers"])
+    np.testing.assert_allclose(tree2["counts"], tree["counts"])
+
+
+def test_pytree_structure_mismatch_raises(tmp_path):
+    f = str(tmp_path / "state.npz")
+    save_pytree(f, {"a": jnp.zeros(2)})
+    with pytest.raises(ValueError, match="leaves"):
+        load_pytree(f, {"a": jnp.zeros(2), "b": jnp.zeros(2)})
+
+
+def test_estimator_roundtrip_knn(blobs):
+    """KNN keeps its training data in trailing-underscore attrs so the
+    checkpoint captures the full fitted state (regression: _X/_y were
+    private and silently dropped)."""
+    import tempfile
+
+    from sq_learn_tpu.models import KNeighborsClassifier
+
+    X, y = blobs
+    knn = KNeighborsClassifier(n_neighbors=3).fit(X, y)
+    with tempfile.TemporaryDirectory() as td:
+        knn2 = load_estimator(save_estimator(knn, td))
+    np.testing.assert_array_equal(knn2.predict(X[:25]), knn.predict(X[:25]))

@@ -1,0 +1,76 @@
+"""Mini-batch q-means tests (reference MiniBatchKMeans intent,
+``_dmeans.py:1587``; minibatch-vs-batch consistency pattern from
+``cluster/tests/test_k_means.py:176``)."""
+
+import numpy as np
+import pytest
+
+from sq_learn_tpu.datasets import make_blobs
+from sq_learn_tpu.metrics import adjusted_rand_score
+from sq_learn_tpu.models import KMeans, MiniBatchKMeans, MiniBatchQKMeans
+
+
+@pytest.fixture(scope="module")
+def blobs():
+    return make_blobs(n_samples=600, centers=4, n_features=8,
+                      cluster_std=0.6, random_state=3)
+
+
+def test_minibatch_matches_batch_on_blobs(blobs):
+    X, y = blobs
+    mb = MiniBatchKMeans(n_clusters=4, batch_size=128, max_iter=30,
+                         n_init=3, random_state=0).fit(X)
+    assert adjusted_rand_score(y, mb.labels_) > 0.95
+    full = KMeans(n_clusters=4, n_init=3, random_state=0).fit(X)
+    # within 10% of full-batch inertia (Sculley-style guarantee in practice)
+    assert mb.inertia_ <= full.inertia_ * 1.10
+
+
+def test_minibatch_quantum_delta_mode(blobs):
+    X, y = blobs
+    mb = MiniBatchQKMeans(n_clusters=4, batch_size=128, max_iter=20,
+                          n_init=2, delta=0.05,
+                          random_state=0).fit(X)
+    assert adjusted_rand_score(y, mb.labels_) > 0.8
+    assert mb.predict(X[:10]).shape == (10,)
+
+
+def test_partial_fit_incremental(blobs):
+    X, y = blobs
+    mb = MiniBatchQKMeans(n_clusters=4, random_state=0)
+    rng = np.random.default_rng(0)
+    for _ in range(30):
+        idx = rng.choice(X.shape[0], 128, replace=False)
+        mb.partial_fit(X[idx])
+    assert mb.n_steps_ == 30
+    labels = mb.predict(X)
+    assert adjusted_rand_score(y, labels) > 0.9
+
+
+def test_partial_fit_weights_and_counts(blobs):
+    X, _ = blobs
+    mb = MiniBatchQKMeans(n_clusters=4, random_state=1)
+    mb.partial_fit(X[:200], sample_weight=np.ones(200))
+    total = float(mb.counts_.sum())
+    assert total == pytest.approx(200.0)
+    mb.partial_fit(X[200:400])
+    assert float(mb.counts_.sum()) == pytest.approx(400.0)
+
+
+def test_minibatch_transform_score(blobs):
+    X, _ = blobs
+    mb = MiniBatchKMeans(n_clusters=4, random_state=0, max_iter=10,
+                         n_init=1).fit(X)
+    T = mb.transform(X[:5])
+    assert T.shape == (5, 4)
+    assert mb.score(X) == pytest.approx(-mb.inertia_, rel=1e-5)
+
+
+def test_batch_padding_zero_weight():
+    # n not divisible by batch_size: padded duplicate rows must not shift
+    # centers (their weight is zeroed)
+    X, y = make_blobs(n_samples=130, centers=3, n_features=4,
+                      cluster_std=0.3, random_state=7)
+    mb = MiniBatchKMeans(n_clusters=3, batch_size=64, max_iter=20,
+                         n_init=2, random_state=0).fit(X)
+    assert adjusted_rand_score(y, mb.labels_) > 0.95

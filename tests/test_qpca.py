@@ -154,7 +154,7 @@ class TestQuantumEstimators:
         # full spectrum (n_components = min shape) so the ratio denominator
         # covers everything; θ sits in the huge signal/noise spectral gap at
         # index 20 where PE error cannot flip selections
-        pca = QPCA(n_components=30, random_state=0).fit(data)
+        pca = QPCA(n_components=30, random_state=0, compute_mu=True).fit(data)
         S = pca.singular_values_
         theta = 0.5 * (S[19] + S[20]) / pca.muA
         p_est = pca.quantum_factor_score_ratio_sum(
