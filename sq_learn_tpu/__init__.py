@@ -24,15 +24,19 @@ __version__ = "0.1.0"
 # the component inventory in SURVEY.md §2.
 from . import ops, utils  # noqa: E402
 
-from . import datasets, metrics, model_selection, models, parallel  # noqa: E402
-from . import pipeline, preprocessing  # noqa: E402
+from . import datasets, metrics, model_selection, models, native, parallel  # noqa: E402
+from . import feature_extraction, pipeline, preprocessing  # noqa: E402
+from .feature_extraction import FeatureHasher  # noqa: E402
 from .models import (  # noqa: E402
     KMeans,
     KNeighborsClassifier,
+    MiniBatchKMeans,
+    MiniBatchQKMeans,
     PCA,
     QKMeans,
     QLSSVC,
     QPCA,
+    TruncatedSVD,
 )
 from .pipeline import Pipeline, make_pipeline  # noqa: E402
 
@@ -51,19 +55,25 @@ __all__ = [
     "clone",
     "ops",
     "utils",
+    "native",
     "parallel",
     "metrics",
     "datasets",
     "models",
     "model_selection",
+    "feature_extraction",
     "pipeline",
     "preprocessing",
+    "FeatureHasher",
     "KMeans",
     "KNeighborsClassifier",
+    "MiniBatchKMeans",
+    "MiniBatchQKMeans",
     "PCA",
     "Pipeline",
     "QKMeans",
     "QLSSVC",
     "QPCA",
+    "TruncatedSVD",
     "make_pipeline",
 ]

@@ -1,0 +1,84 @@
+"""Feature hashing (the hashing trick) on the native MurmurHash3.
+
+The reference vendors MurmurHash3 (``utils/src/MurmurHash3.cpp``) and builds
+``feature_extraction/_hashing_fast.pyx`` on it. Here the hashing itself is
+the host-side native C++ bulk kernel (:mod:`sq_learn_tpu.native`) and the
+output is a dense float32 matrix ready for the TPU estimators (dense is the
+TPU-idiomatic layout; sparse CSR has no XLA fast path for the downstream
+GEMMs these pipelines run).
+"""
+
+import numbers
+
+import numpy as np
+
+from .base import BaseEstimator, TransformerMixin
+from .native import murmurhash3_bulk
+
+
+class FeatureHasher(TransformerMixin, BaseEstimator):
+    """Hash string/(token, value) features into a fixed-width dense matrix.
+
+    ``input_type='dict'`` consumes mappings {feature_name: numeric_value};
+    ``'pair'`` consumes iterables of (token, value); ``'string'`` consumes
+    token iterables with implicit value 1. The sign bit of the hash
+    decorrelates collisions (``alternate_sign``), as in the reference's
+    hasher.
+    """
+
+    def __init__(self, n_features=1024, *, input_type="dict",
+                 dtype=np.float32, alternate_sign=True):
+        self.n_features = n_features
+        self.input_type = input_type
+        self.dtype = dtype
+        self.alternate_sign = alternate_sign
+
+    def fit(self, X=None, y=None):
+        if not isinstance(self.n_features, numbers.Integral) or \
+                self.n_features < 1:
+            raise ValueError(
+                f"n_features must be a positive integer, got "
+                f"{self.n_features!r}")
+        if self.input_type not in ("dict", "pair", "string"):
+            raise ValueError(
+                f"input_type must be 'dict', 'pair' or 'string', got "
+                f"{self.input_type!r}")
+        return self
+
+    def transform(self, raw_X):
+        self.fit()
+        rows = list(raw_X)
+        tokens = []
+        values = []
+        row_idx = []
+        for i, row in enumerate(rows):
+            if self.input_type == "dict":
+                items = row.items()
+            elif self.input_type == "pair":
+                items = row
+            else:
+                items = ((tok, 1.0) for tok in row)
+            for tok, val in items:
+                if isinstance(val, str):
+                    # categorical value: hash "name=value" with weight 1
+                    # (the reference hasher's convention)
+                    tok, val = f"{tok}={val}", 1.0
+                if val == 0:
+                    continue
+                tokens.append(tok)
+                values.append(float(val))
+                row_idx.append(i)
+
+        out = np.zeros((len(rows), self.n_features), self.dtype)
+        if tokens:
+            h = murmurhash3_bulk(tokens, seed=0).astype(np.int64)
+            cols = (h % self.n_features).astype(np.int64)
+            vals = np.asarray(values, self.dtype)
+            if self.alternate_sign:
+                # bit 31 of the hash supplies the sign
+                vals = np.where((h >> 31) & 1, -vals, vals)
+            np.add.at(out, (np.asarray(row_idx), cols), vals)
+        return out
+
+    def fit_transform(self, X, y=None):
+        return self.transform(X)

@@ -2,10 +2,12 @@
 
 from .minibatch import MiniBatchKMeans, MiniBatchQKMeans
 from .neighbors import KNeighborsClassifier
-from .qkmeans import KMeans, QKMeans, kmeans_plusplus, lloyd_single
+from .qkmeans import KMeans, QKMeans, k_means, kmeans_plusplus, lloyd_single
 from .qlssvc import QLSSVC
 from .qpca import PCA, QPCA
+from .truncated_svd import TruncatedSVD
 
 __all__ = ["KMeans", "KNeighborsClassifier", "MiniBatchKMeans",
            "MiniBatchQKMeans", "QKMeans", "QPCA", "PCA",
-           "QLSSVC", "kmeans_plusplus", "lloyd_single"]
+           "QLSSVC", "TruncatedSVD", "k_means", "kmeans_plusplus",
+           "lloyd_single"]

@@ -519,6 +519,29 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         return np.broadcast_to(quantum, n_samples.shape), classical
 
 
+def k_means(X, n_clusters, *, sample_weight=None, init="k-means++",
+            n_init=10, max_iter=300, tol=1e-4, random_state=None,
+            delta=None, true_distance_estimate=True, ipe_q=5,
+            verbose=0, return_n_iter=False):
+    """Functional q-means (reference module-level ``k_means``,
+    ``_dmeans.py:265-401``): fit once, return the arrays.
+
+    Returns (centers, labels, inertia) — plus n_iter when
+    ``return_n_iter`` — instead of an estimator object.
+    """
+    est = QKMeans(
+        n_clusters=n_clusters, init=init, n_init=n_init, max_iter=max_iter,
+        tol=tol, verbose=verbose, random_state=random_state, delta=delta,
+        true_distance_estimate=true_distance_estimate, ipe_q=ipe_q)
+    with warnings.catch_warnings():
+        warnings.filterwarnings(
+            "ignore", message="Attention! You are running the classic")
+        est.fit(X, sample_weight=sample_weight)
+    if return_n_iter:
+        return est.cluster_centers_, est.labels_, est.inertia_, est.n_iter_
+    return est.cluster_centers_, est.labels_, est.inertia_
+
+
 class KMeans(QKMeans):
     """Classical k-means: the δ=0 path of :class:`QKMeans` (stock
     ``cluster/_kmeans.py`` parity surface)."""

@@ -258,15 +258,26 @@ class QPCA(TransformerMixin, BaseEstimator):
             n_components = self.n_components
 
         # solver dispatch (reference _qPCA.py:538-553)
+        quantum_requested = (quantum_retained_variance or theta_estimate
+                             or estimate_all or estimate_least_k
+                             or spectral_norm_est or condition_number_est)
         solver = self.svd_solver
         if solver == "auto":
-            if max(X.shape) <= 500 or n_components == "mle":
+            if quantum_requested:
+                # the QADRA estimators need the full spectrum; the truncated
+                # path would silently drop every quantum kwarg
+                solver = "full"
+            elif max(X.shape) <= 500 or n_components == "mle":
                 solver = "full"
             elif isinstance(n_components, numbers.Integral) and \
                     1 <= n_components < 0.8 * min(X.shape):
                 solver = "randomized"
             else:
                 solver = "full"
+        elif solver != "full" and quantum_requested:
+            raise ValueError(
+                f"quantum estimators require svd_solver='full' (or 'auto'); "
+                f"got svd_solver={solver!r} with quantum fit kwargs set")
         self._fit_svd_solver = solver
 
         if solver == "full":

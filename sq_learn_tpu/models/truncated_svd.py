@@ -1,0 +1,83 @@
+"""Truncated SVD (LSA) — classical parity surface.
+
+The reference exports ``TruncatedSVD`` next to PCA
+(``decomposition/__init__.py``, stock ``decomposition/_truncated_svd.py``):
+SVD on the *uncentered* matrix, the standard LSA transform. TPU-native form:
+the Halko randomized range finder from :func:`sq_learn_tpu.ops.linalg.
+randomized_svd` (one jit'd kernel), with a full-SVD fallback for
+``algorithm='arpack'`` requests (no ARPACK on XLA — the exact thin SVD is
+the equivalent here and is exact rather than iterative).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..base import BaseEstimator, TransformerMixin, check_is_fitted
+from ..ops.linalg import randomized_svd, svd_flip, thin_svd
+from ..utils import as_key, check_array
+
+
+class TruncatedSVD(TransformerMixin, BaseEstimator):
+    """Dimensionality reduction by truncated SVD without centering.
+
+    Parameters follow the reference surface: ``algorithm`` ∈ {'randomized',
+    'arpack'} ('arpack' dispatches to an exact thin SVD — no ARPACK on
+    XLA), ``n_iter`` power iterations for the randomized range finder.
+    """
+
+    def __init__(self, n_components=2, *, algorithm="randomized", n_iter=5,
+                 random_state=None, tol=0.0):
+        self.n_components = n_components
+        self.algorithm = algorithm
+        self.n_iter = n_iter
+        self.random_state = random_state
+        self.tol = tol
+
+    def fit(self, X, y=None):
+        self.fit_transform(X)
+        return self
+
+    def fit_transform(self, X, y=None):
+        X = check_array(X)
+        n_samples, n_features = X.shape
+        k = self.n_components
+        if not 1 <= k < n_features or k > n_samples:
+            raise ValueError(
+                f"n_components must be in [1, n_features={n_features}) and "
+                f"<= n_samples={n_samples}; got {k}")
+        Xd = jnp.asarray(X)
+        if self.algorithm == "randomized":
+            U, S, Vt = randomized_svd(as_key(self.random_state), Xd, k,
+                                      n_iter=self.n_iter)
+        elif self.algorithm == "arpack":
+            U, S, Vt = thin_svd(Xd)
+            U, Vt = svd_flip(U, Vt)
+            U, S, Vt = U[:, :k], S[:k], Vt[:k]
+        else:
+            raise ValueError(
+                f"algorithm must be 'randomized' or 'arpack', got "
+                f"{self.algorithm!r}")
+
+        self.components_ = np.asarray(Vt)
+        self.singular_values_ = np.asarray(S)
+        Xt = np.asarray(U) * self.singular_values_[None, :]
+
+        # explained variance of the transformed data (reference semantics:
+        # variance of the projected columns, ratio vs total input variance)
+        self.explained_variance_ = np.var(Xt, axis=0)
+        total_var = float(np.var(np.asarray(X), axis=0).sum())
+        self.explained_variance_ratio_ = (
+            self.explained_variance_ / total_var if total_var > 0
+            else np.zeros_like(self.explained_variance_))
+        self.n_features_in_ = n_features
+        return Xt
+
+    def transform(self, X):
+        check_is_fitted(self, "components_")
+        X = check_array(X)
+        return np.asarray(jnp.asarray(X) @ jnp.asarray(self.components_).T)
+
+    def inverse_transform(self, X):
+        check_is_fitted(self, "components_")
+        X = check_array(X)
+        return np.asarray(jnp.asarray(X) @ jnp.asarray(self.components_))

@@ -1,0 +1,77 @@
+"""Tracing / profiling utilities.
+
+The reference has no profiling infrastructure beyond ad-hoc ``time.time()``
+in scripts and ``# cython: profile=True`` on the Lloyd kernel (SURVEY §5);
+its *theoretical* runtime accountants live on the estimators
+(``QPCA.accumulate_q_runtime``, ``QKMeans.quantum_runtime_model``). This
+module supplies the real-measurement side:
+
+- :func:`trace` — context manager around ``jax.profiler`` emitting an XLA
+  trace viewable in TensorBoard/Perfetto.
+- :class:`Timer` — wall-clock scope timer that blocks on device work, so
+  async dispatch doesn't fake instant results.
+- :func:`benchmark` — median-of-repeats timing of a jitted callable with a
+  compile warm-up, the measurement discipline ``bench.py`` uses.
+"""
+
+import time
+from contextlib import contextmanager
+
+import jax
+
+
+@contextmanager
+def trace(log_dir, create_perfetto_link=False):
+    """Capture a device trace of the enclosed block into ``log_dir``."""
+    jax.profiler.start_trace(log_dir,
+                             create_perfetto_link=create_perfetto_link)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class Timer:
+    """Wall-clock scope timer that waits for device completion.
+
+    >>> with Timer() as t:
+    ...     out = step(...)  # doctest: +SKIP
+    >>> t.elapsed  # doctest: +SKIP
+    """
+
+    def __init__(self, block_on=None):
+        self._block_on = block_on
+        self.elapsed = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if self._block_on is not None:
+            jax.block_until_ready(self._block_on)
+        else:
+            # barrier on every live array: a fresh device_put would NOT be
+            # ordered behind pending compute (JAX only orders through data
+            # dependencies), so this is the only sound default
+            for a in jax.live_arrays():
+                a.block_until_ready()
+        self.elapsed = time.perf_counter() - self._t0
+        return False
+
+
+def benchmark(fn, *args, repeats=5, warmup=1, **kwargs):
+    """Median wall-clock of ``fn(*args, **kwargs)`` with device sync.
+
+    Runs ``warmup`` untimed calls first (compile + cache), then ``repeats``
+    timed ones. Returns (median_seconds, all_times).
+    """
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args, **kwargs))
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args, **kwargs))
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return times[len(times) // 2], times

@@ -93,3 +93,20 @@ def test_estimator_roundtrip_knn(blobs):
     with tempfile.TemporaryDirectory() as td:
         knn2 = load_estimator(save_estimator(knn, td))
     np.testing.assert_array_equal(knn2.predict(X[:25]), knn.predict(X[:25]))
+
+
+def test_profiling_benchmark_and_timer():
+    """Timer/benchmark block on device work (SURVEY §5 tracing layer)."""
+    import jax.numpy as jnp
+
+    from sq_learn_tpu.utils.profiling import Timer, benchmark
+
+    def step(x):
+        return (x @ x.T).sum()
+
+    x = jnp.ones((64, 64))
+    med, times = benchmark(step, x, repeats=3, warmup=1)
+    assert med > 0 and len(times) == 3
+    with Timer() as t:
+        step(x)
+    assert t.elapsed > 0

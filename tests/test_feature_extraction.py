@@ -1,0 +1,55 @@
+"""FeatureHasher tests (reference ``feature_extraction/_hashing_fast.pyx``
+capability on the native MurmurHash3)."""
+
+import numpy as np
+import pytest
+
+from sq_learn_tpu import FeatureHasher
+
+
+def test_dict_input_shape_and_determinism():
+    X = [{"cat": 1.0, "dog": 2.0}, {"cat": 3.0}]
+    h = FeatureHasher(n_features=64)
+    out1 = h.transform(X)
+    out2 = h.transform(X)
+    assert out1.shape == (2, 64)
+    np.testing.assert_array_equal(out1, out2)
+    # same token hashes to the same column across rows
+    col = np.nonzero(out2[1])[0]
+    assert len(col) == 1
+    assert out1[0, col[0]] != 0
+
+
+def test_string_and_pair_inputs_agree():
+    docs = [["a", "b", "a"], ["c"]]
+    pairs = [[("a", 2.0), ("b", 1.0)], [("c", 1.0)]]
+    hs = FeatureHasher(n_features=32, input_type="string")
+    hp = FeatureHasher(n_features=32, input_type="pair")
+    np.testing.assert_allclose(hs.transform(docs), hp.transform(pairs))
+
+
+def test_alternate_sign_balances_collisions():
+    h = FeatureHasher(n_features=16, alternate_sign=True)
+    out = h.transform([{f"tok{i}": 1.0 for i in range(1000)}])
+    # signed sums concentrate near zero; unsigned would sum to 1000
+    assert abs(out.sum()) < 1000 * 0.5
+
+
+def test_zero_values_dropped():
+    out = FeatureHasher(n_features=8).transform([{"a": 0.0}])
+    assert not out.any()
+
+
+def test_input_validation():
+    with pytest.raises(ValueError, match="n_features"):
+        FeatureHasher(n_features=0).fit()
+    with pytest.raises(ValueError, match="input_type"):
+        FeatureHasher(input_type="bogus").fit()
+
+
+def test_string_values_hash_as_categorical():
+    # {"color": "red"} hashes token "color=red" with weight 1
+    h = FeatureHasher(n_features=64, alternate_sign=False)
+    out = h.transform([{"color": "red"}, {"color": "blue"}])
+    assert out[0].sum() == 1.0 and out[1].sum() == 1.0
+    assert not np.array_equal(out[0], out[1])

@@ -210,3 +210,21 @@ class TestKMeansPlusPlus:
         centers, idx = kmeans_plusplus(key, Xd, row_norms(Xd, squared=True), 4)
         blobs_hit = len(np.unique(y[np.asarray(idx)]))
         assert blobs_hit >= 3
+
+
+def test_functional_k_means():
+    """Module-level k_means wrapper (reference _dmeans.py:265-401)."""
+    from sq_learn_tpu.datasets import make_blobs
+    from sq_learn_tpu.metrics import adjusted_rand_score
+    from sq_learn_tpu.models import k_means
+
+    X, y = make_blobs(n_samples=300, centers=3, n_features=6,
+                      cluster_std=0.5, random_state=5)
+    centers, labels, inertia, n_iter = k_means(
+        X, 3, n_init=3, random_state=0, return_n_iter=True)
+    assert centers.shape == (3, 6)
+    assert adjusted_rand_score(y, labels) > 0.95
+    assert inertia > 0 and n_iter >= 1
+    out3 = k_means(X, 3, n_init=3, random_state=0, delta=0.1,
+                   true_distance_estimate=False)
+    assert len(out3) == 3
