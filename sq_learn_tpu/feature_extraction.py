@@ -63,6 +63,10 @@ class FeatureHasher(TransformerMixin, BaseEstimator):
                     # categorical value: hash "name=value" with weight 1
                     # (the reference hasher's convention)
                     tok, val = f"{tok}={val}", 1.0
+                if not isinstance(tok, (str, bytes)):
+                    raise TypeError(
+                        f"feature names must be str or bytes, got "
+                        f"{type(tok).__name__}")
                 if val == 0:
                     continue
                 tokens.append(tok)

@@ -244,6 +244,48 @@ lloyd_single_jit = jax.jit(
     ),
 )
 
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_init", "init", "n_clusters", "delta", "mode",
+                     "max_iter", "intermediate_error", "true_tomography",
+                     "ipe_q", "use_pallas", "pallas_interpret"),
+)
+def lloyd_restarts(key, X, weights, x_sq_norms, *, n_init, init, n_clusters,
+                   delta=0.0, mode="classic", max_iter=300, tol=1e-4,
+                   intermediate_error=False, true_tomography=True, ipe_q=5,
+                   use_pallas=False, pallas_interpret=False):
+    """All ``n_init`` restarts as ONE vmapped kernel.
+
+    The reference (and classical sklearn) loops restarts on the host; on an
+    accelerator that serializes n_init small dispatches. Here init
+    (k-means++ D² sampling or uniform random rows) and the full Lloyd
+    while-loop are batched over the restart axis — one compile, one
+    dispatch — and the best restart is selected on device by inertia.
+
+    Returns (labels, inertia, centers, n_iter) of the winning restart.
+    """
+    keys = jax.random.split(key, 2 * n_init)
+    init_keys, run_keys = keys[:n_init], keys[n_init:]
+    if init == "k-means++":
+        centers0 = jax.vmap(
+            lambda k: kmeans_plusplus(k, X, x_sq_norms, n_clusters,
+                                      weights=weights)[0])(init_keys)
+    else:  # "random": weight-proportional rows without replacement
+        p = weights / jnp.sum(weights)
+        centers0 = jax.vmap(
+            lambda k: X[jax.random.choice(k, X.shape[0], (n_clusters,),
+                                          replace=False, p=p)])(init_keys)
+    run = functools.partial(
+        lloyd_single, delta=delta, mode=mode, max_iter=max_iter, tol=tol,
+        intermediate_error=intermediate_error,
+        true_tomography=true_tomography, ipe_q=ipe_q,
+        use_pallas=use_pallas, pallas_interpret=pallas_interpret)
+    labels, inertia, centers, n_iter = jax.vmap(
+        lambda k, c0: run(k, X, weights, c0, x_sq_norms))(run_keys, centers0)
+    best = jnp.argmin(inertia)
+    return labels[best], inertia[best], centers[best], n_iter[best]
+
 # module-level jitted E-step for inference (one compile cache per process)
 e_step_jit = jax.jit(
     e_step, static_argnames=("delta", "mode", "ipe_q", "axis_name")
@@ -426,6 +468,25 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
                       intermediate_error=self.intermediate_error,
                       true_tomography=self.true_tomography, ipe_q=self.ipe_q,
                       use_pallas=use_pallas, pallas_interpret=interpret)
+        Xd = jnp.asarray(Xc)
+        w = jnp.asarray(sample_weight, Xd.dtype)
+        xsq = row_norms(Xd, squared=True)
+
+        # fast path: all restarts batched into one vmapped kernel (string
+        # inits only; the pallas kernel and the shard_map path keep the host
+        # loop — their batching rules are the respective kernels' own).
+        # Accelerators win from one large dispatch; the CPU backend wins
+        # from per-restart early stopping, so it keeps the loop — as do
+        # verbose fits, whose per-init reporting needs the loop.
+        if (self.mesh is None and not use_pallas and not self.verbose
+                and isinstance(init, str) and n_init > 1
+                and jax.default_backend() != "cpu"):
+            return lloyd_restarts(
+                key, Xd, w, xsq, n_init=n_init, init=init,
+                n_clusters=self.n_clusters, tol=tol_,
+                **{k: v for k, v in static.items()
+                   if k not in ("use_pallas", "pallas_interpret", "tol")})
+
         if self.mesh is not None:
             from ..parallel.lloyd import lloyd_single_sharded
 
@@ -433,9 +494,6 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         else:
             run = functools.partial(lloyd_single_jit, **static)
 
-        Xd = jnp.asarray(Xc)
-        w = jnp.asarray(sample_weight, Xd.dtype)
-        xsq = row_norms(Xd, squared=True)
         best = None
         for _ in range(n_init):
             key, ki, kr = jax.random.split(key, 3)

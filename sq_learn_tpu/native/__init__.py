@@ -201,9 +201,18 @@ def murmurhash3_32(key, seed=0):
 
 
 def murmurhash3_bulk(strings, seed=0):
-    """Hash a sequence of strings; returns uint32 array."""
-    encoded = [s.encode("utf-8") if isinstance(s, str) else bytes(s)
-               for s in strings]
+    """Hash a sequence of str/bytes tokens; returns uint32 array."""
+    encoded = []
+    for s in strings:
+        if isinstance(s, str):
+            encoded.append(s.encode("utf-8"))
+        elif isinstance(s, (bytes, bytearray)):
+            encoded.append(bytes(s))
+        else:
+            # bytes(int) would allocate an int-sized zero buffer — never
+            # what a hashing caller means
+            raise TypeError(
+                f"tokens must be str or bytes, got {type(s).__name__}")
     lib = _load()
     if lib is not None and encoded:
         buf = b"".join(encoded)

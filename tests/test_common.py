@@ -1,0 +1,132 @@
+"""Common estimator-contract checks.
+
+The reference runs every estimator through sklearn's
+``parametrize_with_checks`` battery (``sklearn/tests/test_common.py:19,42``,
+SURVEY §4). This is the equivalent for our estimator zoo: every public
+estimator obeys the contract — hyperparams stored verbatim, ``get_params``/
+``set_params`` round-trip, ``clone`` yields an unfitted copy, ``fit``
+returns self, fitted state in persistable attributes, checkpoint
+round-trips reproduce predictions.
+"""
+
+import numpy as np
+import pytest
+
+import sq_learn_tpu as sq
+from sq_learn_tpu.datasets import make_blobs
+from sq_learn_tpu.utils import load_estimator, save_estimator
+
+# (constructor, needs_y, prediction_method) for every public estimator
+ESTIMATORS = [
+    (lambda: sq.KMeans(n_clusters=3, n_init=2, random_state=0),
+     False, "predict"),
+    (lambda: sq.QKMeans(n_clusters=3, n_init=2, delta=0.1,
+                        true_distance_estimate=False, random_state=0),
+     False, "predict"),
+    (lambda: sq.MiniBatchKMeans(n_clusters=3, n_init=2, max_iter=10,
+                                random_state=0),
+     False, "predict"),
+    (lambda: sq.MiniBatchQKMeans(n_clusters=3, n_init=2, max_iter=10,
+                                 delta=0.1, random_state=0),
+     False, "predict"),
+    (lambda: sq.PCA(n_components=3, random_state=0), False, "transform"),
+    (lambda: sq.QPCA(n_components=3, random_state=0), False, "transform"),
+    (lambda: sq.TruncatedSVD(n_components=3, random_state=0),
+     False, "transform"),
+    (lambda: sq.QLSSVC(kernel="linear", random_state=0), True, "predict"),
+    (lambda: sq.KNeighborsClassifier(n_neighbors=3), True, "predict"),
+    (lambda: sq.preprocessing.StandardScaler(), False, "transform"),
+    (lambda: sq.preprocessing.MinMaxScaler(), False, "transform"),
+    (lambda: sq.preprocessing.Normalizer(), False, "transform"),
+]
+
+IDS = [make().__class__.__name__ for make, _, _ in ESTIMATORS]
+
+
+@pytest.fixture(scope="module")
+def data():
+    X, y = make_blobs(n_samples=150, centers=3, n_features=8,
+                      cluster_std=0.8, random_state=0)
+    y_pm = np.where(y == 0, 1, -1)  # QLSSVC is a binary ±1 classifier
+    return X, y, y_pm
+
+
+def _fit(make, needs_y, data):
+    X, y, y_pm = data
+    est = make()
+    if needs_y:
+        target = y_pm if est.__class__.__name__ == "QLSSVC" else y
+        return est.fit(X, target), X
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return est.fit(X), X
+
+
+@pytest.mark.parametrize("make,needs_y,method", ESTIMATORS, ids=IDS)
+def test_get_set_params_roundtrip(make, needs_y, method):
+    est = make()
+    params = est.get_params(deep=False)
+    est.set_params(**params)
+    assert est.get_params(deep=False) == params
+
+
+@pytest.mark.parametrize("make,needs_y,method", ESTIMATORS, ids=IDS)
+def test_clone_is_unfitted_copy(make, needs_y, method, data):
+    est, _ = _fit(make, needs_y, data)
+    c = sq.clone(est)
+    assert type(c) is type(est)
+    assert c.get_params(deep=False) == est.get_params(deep=False)
+    with pytest.raises(sq.NotFittedError):
+        sq.check_is_fitted(c)
+
+
+@pytest.mark.parametrize("make,needs_y,method", ESTIMATORS, ids=IDS)
+def test_fit_returns_self_and_sets_state(make, needs_y, method, data):
+    est = make()
+    fitted, X = _fit(make, needs_y, data)
+    assert fitted is est or type(fitted) is type(est)
+    sq.check_is_fitted(fitted)  # must not raise
+    out = getattr(fitted, method)(X[:10])
+    assert out.shape[0] == 10
+
+
+@pytest.mark.parametrize("make,needs_y,method", ESTIMATORS, ids=IDS)
+def test_hyperparams_stored_verbatim(make, needs_y, method):
+    # the sklearn contract: __init__ stores args unchanged (base.py:142)
+    est = make()
+    for k, v in est.get_params(deep=False).items():
+        assert getattr(est, k) is v or getattr(est, k) == v
+
+
+@pytest.mark.parametrize("make,needs_y,method", ESTIMATORS, ids=IDS)
+def test_checkpoint_roundtrip_preserves_predictions(make, needs_y, method,
+                                                    data, tmp_path):
+    fitted, X = _fit(make, needs_y, data)
+    loaded = load_estimator(save_estimator(fitted, str(tmp_path / "est")))
+    a = getattr(fitted, method)(X[:20])
+    b = getattr(loaded, method)(X[:20])
+    np.testing.assert_allclose(np.asarray(a, dtype=np.float64),
+                               np.asarray(b, dtype=np.float64),
+                               rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("make,needs_y,method", ESTIMATORS, ids=IDS)
+def test_refit_overwrites_state(make, needs_y, method, data):
+    import warnings
+
+    X, y, y_pm = data
+    fitted, _ = _fit(make, needs_y, data)
+    first = np.asarray(getattr(fitted, method)(X[:5]), dtype=np.float64)
+    # refit the SAME instance: stale state must be overwritten, and the
+    # result must match a fresh fit (key discipline, no global state)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        if needs_y:
+            target = y_pm if fitted.__class__.__name__ == "QLSSVC" else y
+            fitted.fit(X, target)
+        else:
+            fitted.fit(X)
+    again = np.asarray(getattr(fitted, method)(X[:5]), dtype=np.float64)
+    np.testing.assert_allclose(first, again, rtol=1e-5, atol=1e-6)

@@ -53,3 +53,11 @@ def test_string_values_hash_as_categorical():
     out = h.transform([{"color": "red"}, {"color": "blue"}])
     assert out[0].sum() == 1.0 and out[1].sum() == 1.0
     assert not np.array_equal(out[0], out[1])
+
+
+def test_non_string_tokens_raise_type_error():
+    with pytest.raises(TypeError, match="str or bytes"):
+        FeatureHasher(input_type="pair").transform([[(5, 1.0)]])
+    from sq_learn_tpu.native import murmurhash3_bulk
+    with pytest.raises(TypeError, match="str or bytes"):
+        murmurhash3_bulk([42])
