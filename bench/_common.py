@@ -29,3 +29,21 @@ def emit(metric, value, unit="s", vs_baseline=1.0, **extra):
         "unit": unit,
         "vs_baseline": round(float(vs_baseline), 3),
     }))
+
+
+def smoke_mode():
+    """True when invoked with --smoke or SQ_BENCH_SMOKE=1: scripts subsample
+    their dataset so the full code path can be validated quickly."""
+    import os
+
+    return "--smoke" in sys.argv or os.environ.get("SQ_BENCH_SMOKE") == "1"
+
+
+def maybe_subsample(X, y=None, n=4000, seed=0):
+    """Subsample rows in smoke mode; pass through otherwise."""
+    if not smoke_mode() or X.shape[0] <= n:
+        return (X, y) if y is not None else X
+    import numpy as _np
+
+    idx = _np.random.default_rng(seed).choice(X.shape[0], n, replace=False)
+    return (X[idx], y[idx]) if y is not None else X[idx]

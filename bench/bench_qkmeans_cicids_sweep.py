@@ -14,7 +14,7 @@ import numpy as np
 warnings.filterwarnings("ignore")
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
-from bench._common import emit, timed  # noqa: E402
+from bench._common import emit, maybe_subsample, timed  # noqa: E402
 
 
 def main():
@@ -25,6 +25,7 @@ def main():
     from sq_learn_tpu.preprocessing import StandardScaler
 
     X, y, real = load_cicids()
+    X, y = maybe_subsample(X, y)
     if len(X) > 50_000:
         X, y = X[:50_000], y[:50_000]
     X = StandardScaler().fit_transform(X)

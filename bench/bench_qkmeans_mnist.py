@@ -12,7 +12,7 @@ import numpy as np
 warnings.filterwarnings("ignore")
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
-from bench._common import emit, timed  # noqa: E402
+from bench._common import emit, maybe_subsample, timed  # noqa: E402
 
 
 def main():
@@ -22,6 +22,7 @@ def main():
     from sq_learn_tpu.parallel.mesh import make_mesh
 
     X, y, real = load_mnist()
+    X, y = maybe_subsample(X, y)
     k, n_init, seed = 10, 3, 0
     mesh = make_mesh() if len(jax.devices()) > 1 else None
 

@@ -12,7 +12,7 @@ import numpy as np
 warnings.filterwarnings("ignore")
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
-from bench._common import emit, timed  # noqa: E402
+from bench._common import emit, maybe_subsample, timed  # noqa: E402
 
 
 def main():
@@ -21,6 +21,7 @@ def main():
     from sq_learn_tpu.models import QPCA
 
     X, y, real = load_mnist()
+    X, y = maybe_subsample(X, y)
     n_components = 50
 
     def ours_fit():

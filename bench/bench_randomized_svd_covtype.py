@@ -12,7 +12,7 @@ import numpy as np
 warnings.filterwarnings("ignore")
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
-from bench._common import emit, timed  # noqa: E402
+from bench._common import emit, maybe_subsample, timed  # noqa: E402
 
 
 def main():
@@ -22,6 +22,7 @@ def main():
     from sq_learn_tpu.ops.linalg import randomized_svd
 
     X, y, real = load_covtype()
+    X, y = maybe_subsample(X, y)
     n_components = 10
     key = jax.random.PRNGKey(0)
     Xd = jnp.asarray(X)

@@ -4,6 +4,7 @@
 thread reductions; SURVEY §2.3 maps them to psum over an ICI mesh.)
 """
 
+from . import distributed
 from .mesh import (
     DATA_AXIS,
     data_sharding,
@@ -16,6 +17,7 @@ from .mesh import (
 __all__ = [
     "DATA_AXIS",
     "data_sharding",
+    "distributed",
     "make_mesh",
     "pad_to_multiple",
     "replicated",

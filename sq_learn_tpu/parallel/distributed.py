@@ -1,0 +1,74 @@
+"""Multi-host execution plumbing.
+
+The reference is single-process (SURVEY §2.3: OpenMP threads +
+multiprocessing, no NCCL/MPI/Gloo anywhere); its scale-out story is ours to
+define. The design (docs/design.md): one SPMD program, data axis sharded
+over *all* global devices, XLA collectives riding ICI within a host and DCN
+across hosts. This module is the thin host-boundary layer — everything else
+(the shard_map kernels) is topology-agnostic.
+
+Typical multi-host launch (same script on every host)::
+
+    from sq_learn_tpu.parallel import distributed as dist
+
+    dist.initialize()               # env-driven (TPU pods auto-detect)
+    mesh = dist.global_mesh()       # all devices across all hosts
+    est = QKMeans(n_clusters=10, mesh=mesh, ...).fit(local_shard)
+"""
+
+import numpy as np
+import jax
+
+from .mesh import DATA_AXIS
+
+
+def initialize(coordinator_address=None, num_processes=None, process_id=None,
+               **kwargs):
+    """Initialize :mod:`jax.distributed` for multi-host execution.
+
+    On TPU pods every argument auto-detects from the environment; on other
+    platforms pass the coordinator host:port and process indices. Safe to
+    call once per process, before any backend use. No-op if the runtime is
+    already initialized (re-initialization raises in JAX; this wrapper
+    makes idempotent use possible in launcher scripts).
+    """
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id, **kwargs)
+    except RuntimeError as exc:
+        # jax raises "distributed.initialize should only be called once."
+        # (wording has varied across versions — match both forms)
+        msg = str(exc)
+        if "only be called once" not in msg and "already initialized" not in msg:
+            raise
+
+
+def global_mesh(axis_name=DATA_AXIS):
+    """1-D mesh over every device across every participating host."""
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(jax.devices()), (axis_name,))
+
+
+def process_info():
+    """(process_index, process_count, local_device_count) of this host."""
+    return (jax.process_index(), jax.process_count(),
+            jax.local_device_count())
+
+
+def host_shard_bounds(n_rows):
+    """(lo, hi, per): row range of the global dataset this host loads, and
+    the uniform per-host shard size.
+
+    The standard multi-host input pattern: each host reads rows [lo, hi)
+    from storage and pads its slice up to ``per`` rows with zero-weight
+    padding (``mesh.pad_to_multiple``) — JAX requires equal per-process
+    shard shapes on the data axis, so tail hosts MUST pad, not just load
+    fewer rows. With the zero weights the padded rows contribute nothing
+    to any reduction.
+    """
+    p, np_, _ = process_info()
+    per = -(-n_rows // np_)
+    lo = min(p * per, n_rows)
+    return lo, min(lo + per, n_rows), per

@@ -198,3 +198,22 @@ class TestMnistTrialPipeline:
                 cv=StratifiedKFold(3)))
             accs[eps_delta] = score
         assert accs[0.05] >= accs[0.8] - 0.02
+
+
+class TestDistributed:
+    """Single-process checks of the multi-host plumbing layer."""
+
+    def test_process_info_and_mesh(self):
+        from sq_learn_tpu.parallel import distributed as dist
+
+        p, n, local = dist.process_info()
+        assert p == 0 and n == 1 and local >= 1
+        mesh = dist.global_mesh()
+        assert mesh.devices.size == local
+
+    def test_host_shard_bounds_cover_dataset(self):
+        from sq_learn_tpu.parallel import distributed as dist
+
+        lo, hi, per = dist.host_shard_bounds(1000)
+        assert (lo, hi) == (0, 1000)  # single process owns everything
+        assert per == 1000
