@@ -43,13 +43,18 @@ def main():
     estimates = jax.vmap(
         lambda k: real_tomography(k, v, delta=args.delta, norm=args.norm)
     )(keys)
-    errors = np.asarray(jnp.linalg.norm(estimates - v[None, :], axis=1))
+    diff = estimates - v[None, :]
+    # measure the error in the norm whose guarantee N was sized for
+    if args.norm == "L2":
+        errors = np.asarray(jnp.linalg.norm(diff, axis=1))
+    else:
+        errors = np.asarray(jnp.max(jnp.abs(diff), axis=1))
     wall = time.perf_counter() - t0
 
     within = float((errors <= args.delta).mean())
     print(f"{args.trials} trials in {wall:.2f}s: "
-          f"mean L2 err {errors.mean():.4f}, max {errors.max():.4f}, "
-          f"P(err <= delta) = {within:.2%}")
+          f"mean {args.norm} err {errors.mean():.4f}, "
+          f"max {errors.max():.4f}, P(err <= delta) = {within:.2%}")
 
     if args.save:
         import matplotlib
@@ -60,7 +65,7 @@ def main():
         plt.hist(errors, bins=30)
         plt.axvline(args.delta, color="red", linestyle="--",
                     label=f"delta={args.delta}")
-        plt.xlabel("L2 tomography error")
+        plt.xlabel(f"{args.norm} tomography error")
         plt.ylabel("trials")
         plt.legend()
         plt.savefig(args.save, dpi=120)

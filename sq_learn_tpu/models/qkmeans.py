@@ -74,11 +74,17 @@ def e_step(key, X, weights, centers, x_sq_norms, *, delta, mode, ipe_q,
         window = delta if mode == "delta" else 0.0
 
     min_d2 = jnp.min(d2, axis=1)
-    # uniform pick among centroids within `window` of the min (δ-means
-    # tie-break; for window=0 this is argmin with uniform tie-breaking)
-    mask = d2 <= (min_d2[:, None] + window)
-    logits = jnp.where(mask, 0.0, -jnp.inf)
-    labels = jax.random.categorical(key, logits, axis=1).astype(jnp.int32)
+    if mode == "classic":
+        # deterministic argmin (the reference's classical path) — skips the
+        # per-iteration Gumbel sampling entirely
+        labels = jnp.argmin(d2, axis=1).astype(jnp.int32)
+    else:
+        # uniform pick among centroids within `window` of the min (δ-means
+        # tie-break; for the ipe mode window=0 picks uniformly among exact
+        # ties of the estimated distances)
+        mask = d2 <= (min_d2[:, None] + window)
+        logits = jnp.where(mask, 0.0, -jnp.inf)
+        labels = jax.random.categorical(key, logits, axis=1).astype(jnp.int32)
     inertia = jnp.sum(min_d2 * weights)
     if axis_name is not None:
         inertia = lax.psum(inertia, axis_name)
@@ -118,9 +124,10 @@ def lloyd_single(key, X, weights, centers_init, x_sq_norms, *, delta=0.0,
     the inertia is not monotone — and re-runs the E-step on the best centers
     at the end so labels are consistent with the returned centers.
 
-    ``use_pallas`` routes the classical (δ=0) iteration through the fused
-    hand-tiled kernel (:mod:`~sq_learn_tpu.ops.pallas_kernels`) — one HBM
-    sweep per iteration instead of two.
+    ``use_pallas`` routes the classical (δ=0) and δ-means iterations
+    through the fused hand-tiled kernel
+    (:mod:`~sq_learn_tpu.ops.pallas_kernels`) — one HBM sweep per
+    iteration instead of two, with the δ-window Gumbel pick fused in.
 
     Returns (labels, inertia, centers, n_iter).
     """
@@ -133,7 +140,8 @@ def lloyd_single(key, X, weights, centers_init, x_sq_norms, *, delta=0.0,
                               intermediate_error=intermediate_error,
                               true_tomography=true_tomography,
                               axis_name=axis_name)
-    fused = use_pallas and mode == "classic" and not intermediate_error
+    fused = (use_pallas and mode in ("classic", "delta")
+             and not intermediate_error)
 
     def cond(state):
         _, _, it, shift, _, _ = state
@@ -145,8 +153,13 @@ def lloyd_single(key, X, weights, centers_init, x_sq_norms, *, delta=0.0,
         if fused:
             from ..ops.pallas_kernels import lloyd_step_pallas
 
+            if axis_name is not None:
+                # decorrelate the δ-window Gumbel draws across shards,
+                # exactly as e_step does for the non-fused path
+                k1 = jax.random.fold_in(k1, lax.axis_index(axis_name))
             labels, sums, counts, inertia = lloyd_step_pallas(
-                X, weights, centers, x_sq_norms,
+                X, weights, centers, x_sq_norms, key=k1,
+                window=delta if mode == "delta" else 0.0,
                 interpret=pallas_interpret)
             if axis_name is not None:
                 sums = lax.psum(sums, axis_name)

@@ -27,46 +27,66 @@ def _round_up(x, m):
     return (x + m - 1) // m * m
 
 
-def _lloyd_kernel(x_ref, xsq_ref, w_ref, c_ref, csq_ref,
-                  labels_ref, sums_ref, counts_ref, inertia_ref):
-    """One sample tile: fused E-step + M-step partials.
+def _make_lloyd_kernel(window):
+    """Build the tile kernel; ``window`` > 0 adds the δ-means noisy label
+    pick (uniform among centroids within ``window`` of the min squared
+    distance, implemented as Gumbel-argmax over pre-sampled noise — RNG
+    stays outside the kernel, the selection fuses inside)."""
+    delta_mode = window > 0
 
-    Grid dim 0 walks sample tiles; sums/counts/inertia map to the same
-    output block every step, so `+=` accumulates across the (sequential)
-    TPU grid. Padded samples carry weight 0; padded centroids carry
-    c_sq = _BIG so no sample ever selects them.
-    """
-    i = pl.program_id(0)
+    def kernel(x_ref, xsq_ref, w_ref, c_ref, csq_ref, *refs):
+        """One sample tile: fused E-step + M-step partials.
 
-    x = x_ref[:]                      # (T, m)
-    c = c_ref[:]                      # (k, m)
-    # MXU: the ‖x‖²+‖c‖²−2xcᵀ trick of _k_means_lloyd.pyx:196-203
-    d2 = (xsq_ref[:] + csq_ref[:]
-          - 2.0 * jnp.dot(x, c.T, preferred_element_type=jnp.float32))
-    min_d2 = jnp.min(d2, axis=1, keepdims=True)       # (T, 1)
-    labels = jnp.argmin(d2, axis=1)                   # (T,)
-    labels_ref[:] = labels[:, None].astype(jnp.int32)
+        Grid dim 0 walks sample tiles; sums/counts/inertia map to the same
+        output block every step, so `+=` accumulates across the
+        (sequential) TPU grid. Padded samples carry weight 0; padded
+        centroids carry c_sq = _BIG so no sample ever selects them.
+        """
+        if delta_mode:
+            gum_ref, labels_ref, sums_ref, counts_ref, inertia_ref = refs
+        else:
+            labels_ref, sums_ref, counts_ref, inertia_ref = refs
+        i = pl.program_id(0)
 
-    k = c.shape[0]
-    col_ids = jax.lax.broadcasted_iota(jnp.int32, (x.shape[0], k), 1)
-    onehot = jnp.where(labels[:, None] == col_ids, 1.0, 0.0) * w_ref[:]
+        x = x_ref[:]                      # (T, m)
+        c = c_ref[:]                      # (k, m)
+        # MXU: the ‖x‖²+‖c‖²−2xcᵀ trick of _k_means_lloyd.pyx:196-203
+        d2 = (xsq_ref[:] + csq_ref[:]
+              - 2.0 * jnp.dot(x, c.T, preferred_element_type=jnp.float32))
+        min_d2 = jnp.min(d2, axis=1, keepdims=True)       # (T, 1)
+        if delta_mode:
+            mask = d2 <= min_d2 + window
+            logits = jnp.where(mask, gum_ref[:], -_BIG)
+            labels = jnp.argmax(logits, axis=1)           # (T,)
+        else:
+            labels = jnp.argmin(d2, axis=1)               # (T,)
+        labels_ref[:] = labels[:, None].astype(jnp.int32)
 
-    @pl.when(i == 0)
-    def _():
-        sums_ref[:] = jnp.zeros_like(sums_ref)
-        counts_ref[:] = jnp.zeros_like(counts_ref)
-        inertia_ref[:] = jnp.zeros_like(inertia_ref)
+        k = c.shape[0]
+        col_ids = jax.lax.broadcasted_iota(jnp.int32, (x.shape[0], k), 1)
+        onehot = jnp.where(labels[:, None] == col_ids, 1.0, 0.0) * w_ref[:]
 
-    # MXU again: partial centroid sums, accumulated in-place across tiles
-    sums_ref[:] += jnp.dot(onehot.T, x, preferred_element_type=jnp.float32)
-    counts_ref[:] += jnp.sum(onehot, axis=0, keepdims=True)
-    inertia_ref[:] += jnp.sum(min_d2 * w_ref[:], keepdims=True).reshape(1, 1)
+        @pl.when(i == 0)
+        def _():
+            sums_ref[:] = jnp.zeros_like(sums_ref)
+            counts_ref[:] = jnp.zeros_like(counts_ref)
+            inertia_ref[:] = jnp.zeros_like(inertia_ref)
+
+        # MXU again: partial centroid sums, accumulated across tiles
+        sums_ref[:] += jnp.dot(onehot.T, x,
+                               preferred_element_type=jnp.float32)
+        counts_ref[:] += jnp.sum(onehot, axis=0, keepdims=True)
+        inertia_ref[:] += jnp.sum(
+            min_d2 * w_ref[:], keepdims=True).reshape(1, 1)
+
+    return kernel
 
 
-@functools.partial(jax.jit, static_argnames=("tile_n", "interpret"))
-def lloyd_step_pallas(X, weights, centers, x_sq_norms, *, tile_n=512,
-                      interpret=False):
-    """Fused classical Lloyd iteration statistics in one pallas sweep.
+@functools.partial(jax.jit,
+                   static_argnames=("tile_n", "interpret", "window"))
+def lloyd_step_pallas(X, weights, centers, x_sq_norms, *, key=None,
+                      window=0.0, tile_n=512, interpret=False):
+    """Fused Lloyd iteration statistics in one pallas sweep.
 
     Parameters
     ----------
@@ -74,6 +94,9 @@ def lloyd_step_pallas(X, weights, centers, x_sq_norms, *, tile_n=512,
     weights : (n,) — sample weights; 0 masks a row out entirely.
     centers : (k, m) — current centroids.
     x_sq_norms : (n,) — precomputed row norms.
+    key : jax key — required when ``window`` > 0 (δ-means label sampling).
+    window : static float — δ-means window on squared distances; 0 is the
+        classical argmin path.
     tile_n : static — samples per VMEM tile.
     interpret : static — run in interpreter mode (CPU tests).
 
@@ -97,25 +120,37 @@ def lloyd_step_pallas(X, weights, centers, x_sq_norms, *, tile_n=512,
     csqp = jnp.full((1, k_p), _BIG, jnp.float32).at[0, :k].set(
         jnp.sum(centers * centers, axis=1))
 
+    tile_spec = pl.BlockSpec((tile_n, 1), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM)
+    in_specs = [
+        pl.BlockSpec((tile_n, m_p), lambda i: (i, 0),
+                     memory_space=pltpu.VMEM),
+        tile_spec,
+        tile_spec,
+        pl.BlockSpec((k_p, m_p), lambda i: (0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, k_p), lambda i: (0, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    operands = [Xp, xsqp, wp, Cp, csqp]
+    window = float(window)
+    if window > 0:
+        if key is None:
+            raise ValueError("window > 0 requires a PRNG key")
+        # Gumbel noise sampled outside the kernel (one XLA op); the
+        # masked argmax inside is the uniform δ-window pick
+        gum = jax.random.gumbel(key, (n_p, k_p), jnp.float32)
+        in_specs.append(pl.BlockSpec((tile_n, k_p), lambda i: (i, 0),
+                                     memory_space=pltpu.VMEM))
+        operands.append(gum)
+
     grid = (n_p // tile_n,)
     labels, sums, counts, inertia = pl.pallas_call(
-        _lloyd_kernel,
+        _make_lloyd_kernel(window),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((tile_n, m_p), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((tile_n, 1), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((tile_n, 1), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((k_p, m_p), lambda i: (0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, k_p), lambda i: (0, 0),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((tile_n, 1), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
+            tile_spec,
             pl.BlockSpec((k_p, m_p), lambda i: (0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, k_p), lambda i: (0, 0),
@@ -130,7 +165,7 @@ def lloyd_step_pallas(X, weights, centers, x_sq_norms, *, tile_n=512,
             jax.ShapeDtypeStruct((1, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(Xp, xsqp, wp, Cp, csqp)
+    )(*operands)
 
     return (labels[:n, 0], sums[:k, :m], counts[0, :k], inertia[0, 0])
 

@@ -46,9 +46,9 @@ def initialize(coordinator_address=None, num_processes=None, process_id=None,
 
 def global_mesh(axis_name=DATA_AXIS):
     """1-D mesh over every device across every participating host."""
-    from jax.sharding import Mesh
+    from .mesh import make_mesh
 
-    return Mesh(np.asarray(jax.devices()), (axis_name,))
+    return make_mesh(axis_name=axis_name)
 
 
 def process_info():

@@ -84,3 +84,67 @@ class TestEstimatorIntegration:
                                    km_p.cluster_centers_, rtol=1e-4,
                                    atol=1e-4)
         np.testing.assert_allclose(km_x.inertia_, km_p.inertia_, rtol=1e-4)
+
+
+def test_lloyd_step_pallas_delta_mode_interpret():
+    """δ-means fused kernel: labels stay inside the δ-window of the min,
+    partials are consistent with the sampled labels, inertia still uses
+    the true min distances."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sq_learn_tpu.datasets import make_blobs
+    from sq_learn_tpu.ops.linalg import pairwise_sq_distances, row_norms
+    from sq_learn_tpu.ops.pallas_kernels import lloyd_step_pallas
+
+    X, _ = make_blobs(n_samples=300, centers=4, n_features=8,
+                      cluster_std=1.5, random_state=1)
+    X = jnp.asarray(X)
+    w = jnp.ones(300, X.dtype)
+    centers = X[:4]
+    xsq = row_norms(X, squared=True)
+    delta = 5.0
+
+    labels, sums, counts, inertia = lloyd_step_pallas(
+        X, w, centers, xsq, key=jax.random.PRNGKey(0), window=delta,
+        interpret=True)
+
+    d2 = np.asarray(pairwise_sq_distances(X, centers, xsq))
+    min_d2 = d2.min(axis=1)
+    labels = np.asarray(labels)
+    # every sampled label is within the δ-window of its row minimum
+    sel = d2[np.arange(300), labels]
+    assert (sel <= min_d2 + delta + 1e-4).all()
+    # with a wide window some rows must deviate from pure argmin
+    assert (labels != d2.argmin(axis=1)).any()
+    # partials consistent with the sampled labels; inertia from true mins
+    assert float(counts.sum()) == pytest.approx(300.0)
+    for j in range(4):
+        np.testing.assert_allclose(np.asarray(sums)[j],
+                                   np.asarray(X)[labels == j].sum(0),
+                                   rtol=1e-4, atol=1e-4)
+    assert float(inertia) == pytest.approx(float(min_d2.sum()), rel=1e-5)
+
+
+def test_lloyd_single_fused_delta_matches_quality():
+    """Full fused δ-means run (interpret mode) clusters blobs correctly."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sq_learn_tpu.datasets import make_blobs
+    from sq_learn_tpu.metrics import adjusted_rand_score
+    from sq_learn_tpu.models.qkmeans import lloyd_single
+    from sq_learn_tpu.ops.linalg import row_norms
+
+    X, y = make_blobs(n_samples=300, centers=4, n_features=8,
+                      cluster_std=0.5, random_state=2)
+    Xd = jnp.asarray(X - X.mean(0))
+    w = jnp.ones(300, Xd.dtype)
+    xsq = row_norms(Xd, squared=True)
+    centers0 = Xd[np.asarray([5, 80, 160, 240])]
+    labels, inertia, centers, n_iter = lloyd_single(
+        jax.random.PRNGKey(0), Xd, w, centers0, xsq, delta=0.5,
+        mode="delta", max_iter=50, use_pallas=True, pallas_interpret=True)
+    assert adjusted_rand_score(y, np.asarray(labels)) > 0.95
