@@ -1,5 +1,6 @@
 """Clustering / classification scores."""
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -57,3 +58,101 @@ def explained_variance_ratio(singular_values, n_samples, total_variance=None):
     ev = jnp.asarray(singular_values) ** 2 / (n_samples - 1)
     total = jnp.sum(ev) if total_variance is None else total_variance
     return ev / total
+
+
+def normalized_mutual_info_score(labels_true, labels_pred):
+    """NMI with arithmetic-mean normalization (the capability surface of
+    ``metrics/cluster/_supervised.py``). Host-side float64 — label metrics
+    are integer bookkeeping, not FLOPs, and float32 drifts at scale."""
+    c = np.asarray(_contingency(labels_true, labels_pred), dtype=np.float64)
+    n = c.sum()
+    pi = c.sum(axis=1)
+    pj = c.sum(axis=0)
+    outer = pi[:, None] * pj[None, :]
+    nz = c > 0
+    with np.errstate(divide="ignore", invalid="ignore"):
+        mi = np.sum(np.where(nz, (c / n) * np.log((c * n)
+                                                  / np.where(nz, outer, 1.0)),
+                             0.0))
+
+    def entropy(p):
+        p = p[p > 0] / n
+        return -np.sum(p * np.log(p))
+
+    denom = (entropy(pi) + entropy(pj)) / 2
+    return float(mi / denom) if denom > 0 else 1.0
+
+
+def confusion_matrix(y_true, y_pred):
+    """Dense confusion matrix over the sorted union of observed labels
+    (sklearn semantics — negative labels included). Exact int64 counts via
+    bincount."""
+    y_true = np.asarray(y_true).ravel()
+    y_pred = np.asarray(y_pred).ravel()
+    classes, inv = np.unique(np.concatenate([y_true, y_pred]),
+                             return_inverse=True)
+    k = len(classes)
+    yt, yp = inv[: len(y_true)], inv[len(y_true):]
+    return np.bincount(k * yt + yp, minlength=k * k).reshape(k, k)
+
+
+def f1_score(y_true, y_pred, average="binary", pos_label=1):
+    """F1 = 2·P·R/(P+R); ``average`` ∈ {'binary', 'macro', 'micro'}.
+    Binary mode scores ``pos_label`` (sklearn semantics)."""
+    classes, inv = np.unique(
+        np.concatenate([np.asarray(y_true).ravel(),
+                        np.asarray(y_pred).ravel()]), return_inverse=True)
+    n = np.asarray(y_true).size
+    yt, yp = inv[:n], inv[n:]
+    k = len(classes)
+    C = np.bincount(k * yt + yp, minlength=k * k).reshape(k, k).astype(
+        np.float64)
+    tp = np.diag(C)
+    fp = C.sum(axis=0) - tp
+    fn = C.sum(axis=1) - tp
+    if average == "micro":
+        p = tp.sum() / max(tp.sum() + fp.sum(), 1e-12)
+        r = tp.sum() / max(tp.sum() + fn.sum(), 1e-12)
+        return float(2 * p * r / max(p + r, 1e-12))
+    with np.errstate(divide="ignore", invalid="ignore"):
+        p = np.where(tp + fp > 0, tp / (tp + fp), 0.0)
+        r = np.where(tp + fn > 0, tp / (tp + fn), 0.0)
+        f1 = np.where(p + r > 0, 2 * p * r / (p + r), 0.0)
+    if average == "macro":
+        return float(f1.mean())
+    if average == "binary":
+        where = np.flatnonzero(classes == pos_label)
+        if len(where) == 0:
+            raise ValueError(
+                f"pos_label={pos_label!r} is not a valid label; observed "
+                f"labels are {classes.tolist()}")
+        return float(f1[where[0]])
+    raise ValueError(f"unknown average {average!r}")
+
+
+def silhouette_score(X, labels, sample_size=None, random_state=0):
+    """Mean silhouette coefficient — one fused jnp computation over the
+    full (or subsampled) pairwise distance matrix."""
+    X = np.asarray(X)
+    labels = np.asarray(labels)
+    if sample_size is not None and sample_size < len(X):
+        rng = np.random.default_rng(random_state)
+        idx = rng.choice(len(X), sample_size, replace=False)
+        X, labels = X[idx], labels[idx]
+    classes, y = np.unique(labels, return_inverse=True)
+    if len(classes) < 2 or len(classes) >= len(X):
+        raise ValueError(
+            "silhouette requires 2 <= n_labels <= n_samples - 1")
+    from .pairwise import euclidean_distances
+
+    D = jnp.asarray(euclidean_distances(X, X))
+    onehot = jax.nn.one_hot(jnp.asarray(y), len(classes), dtype=D.dtype)
+    counts = jnp.sum(onehot, axis=0)                      # (k,)
+    sums = D @ onehot                                     # (n, k)
+    own = counts[y]
+    # a: mean intra-cluster distance excluding self; singletons get a=0
+    a = jnp.where(own > 1, sums[jnp.arange(len(y)), y] / jnp.maximum(own - 1, 1), 0.0)
+    other = jnp.where(onehot > 0, jnp.inf, sums / counts[None, :])
+    b = jnp.min(other, axis=1)
+    s = jnp.where(own > 1, (b - a) / jnp.maximum(jnp.maximum(a, b), 1e-12), 0.0)
+    return float(jnp.mean(s))

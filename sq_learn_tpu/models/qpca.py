@@ -290,6 +290,27 @@ class QPCA(TransformerMixin, BaseEstimator):
             raise ValueError(f"Unrecognized svd_solver={solver!r}")
         return self
 
+    def fit_transform(self, X, y=None, *, classic_transform=True,
+                      epsilon_delta=0, quantum_representation=False,
+                      norm="None", psi=0, use_classical_components=True,
+                      **fit_kwargs):
+        """Fit with the quantum kwargs, then transform.
+
+        The reference's ``fit_transform`` forwards stale kwargs to ``_fit``
+        and crashes (``_qPCA.py:467-473``, SURVEY §2.1); this implements
+        the documented intent: every ``fit`` quantum kwarg passes through,
+        and the transform-side knobs select the classical or quantum
+        projection of the training data.
+        """
+        self.fit(X, **fit_kwargs)
+        return self.transform(
+            X, classic_transform=classic_transform,
+            epsilon_delta=epsilon_delta,
+            quantum_representation=quantum_representation, norm=norm,
+            psi=psi,
+            true_tomography=fit_kwargs.get("true_tomography", True),
+            use_classical_components=use_classical_components)
+
     def _next_key(self):
         self._key, sub = jax.random.split(self._key)
         return sub
@@ -919,6 +940,9 @@ class PCA(QPCA):
 
     def transform(self, X):
         return self._project(X)
+
+    def fit_transform(self, X, y=None):
+        return self.fit(X).transform(X)
 
     def inverse_transform(self, X):
         return super().inverse_transform(X)

@@ -91,3 +91,90 @@ class TestKernels:
     def test_dispatch_unknown(self, data):
         with pytest.raises(ValueError, match="unknown kernel"):
             pairwise_kernels(data[0], metric="nope")
+
+
+class TestExtendedScores:
+    """NMI / confusion matrix / F1 / silhouette vs sklearn references."""
+
+    def setup_method(self):
+        rng = np.random.default_rng(0)
+        self.yt = rng.integers(0, 4, 200)
+        self.yp = np.where(rng.random(200) < 0.8, self.yt,
+                           rng.integers(0, 4, 200))
+
+    def test_nmi_matches_sklearn(self):
+        from sklearn.metrics import normalized_mutual_info_score as sk_nmi
+
+        from sq_learn_tpu.metrics import normalized_mutual_info_score
+
+        ours = normalized_mutual_info_score(self.yt, self.yp)
+        assert ours == pytest.approx(sk_nmi(self.yt, self.yp), abs=1e-6)
+        assert normalized_mutual_info_score(self.yt, self.yt) == \
+            pytest.approx(1.0)
+
+    def test_confusion_matrix_matches_sklearn(self):
+        from sklearn.metrics import confusion_matrix as sk_cm
+
+        from sq_learn_tpu.metrics import confusion_matrix
+
+        np.testing.assert_array_equal(confusion_matrix(self.yt, self.yp),
+                                      sk_cm(self.yt, self.yp))
+
+    @pytest.mark.parametrize("average", ["macro", "micro"])
+    def test_f1_matches_sklearn(self, average):
+        from sklearn.metrics import f1_score as sk_f1
+
+        from sq_learn_tpu.metrics import f1_score
+
+        ours = f1_score(self.yt, self.yp, average=average)
+        assert ours == pytest.approx(
+            sk_f1(self.yt, self.yp, average=average), abs=1e-9)
+
+    def test_f1_binary(self):
+        from sklearn.metrics import f1_score as sk_f1
+
+        from sq_learn_tpu.metrics import f1_score
+
+        yt, yp = self.yt % 2, self.yp % 2
+        assert f1_score(yt, yp) == pytest.approx(sk_f1(yt, yp), abs=1e-9)
+
+    def test_silhouette_matches_sklearn(self):
+        from sklearn.metrics import silhouette_score as sk_sil
+
+        from sq_learn_tpu.datasets import make_blobs
+        from sq_learn_tpu.metrics import silhouette_score
+
+        X, y = make_blobs(n_samples=200, centers=3, n_features=6,
+                          cluster_std=1.0, random_state=4)
+        ours = silhouette_score(X, y)
+        assert ours == pytest.approx(sk_sil(X, y), abs=1e-4)
+
+    def test_silhouette_validations(self):
+        from sq_learn_tpu.metrics import silhouette_score
+
+        X = np.random.default_rng(0).normal(size=(10, 3))
+        with pytest.raises(ValueError, match="n_labels"):
+            silhouette_score(X, np.zeros(10, dtype=int))
+
+
+class TestScoreEdgeCases:
+    def test_confusion_matrix_negative_labels(self):
+        from sklearn.metrics import confusion_matrix as sk_cm
+
+        from sq_learn_tpu.metrics import confusion_matrix
+
+        yt = np.array([-1, 0, 1, -1])
+        yp = np.array([0, 0, 1, -1])
+        np.testing.assert_array_equal(confusion_matrix(yt, yp),
+                                      sk_cm(yt, yp))
+        assert confusion_matrix(yt, yp).sum() == 4
+
+    def test_f1_binary_pos_label_semantics(self):
+        from sklearn.metrics import f1_score as sk_f1
+
+        from sq_learn_tpu.metrics import f1_score
+
+        yt, yp = np.array([1, 1, 2]), np.array([1, 1, 1])
+        assert f1_score(yt, yp) == pytest.approx(sk_f1(yt, yp))
+        with pytest.raises(ValueError, match="pos_label"):
+            f1_score(np.array([0, 2]), np.array([0, 2]))

@@ -323,3 +323,22 @@ class TestValidation:
         assert pca.est_spectral_norm == pca.spectral_norm
         assert pca.est_sigma_min == pytest.approx(
             float(pca.singular_values_[-1]))
+
+
+def test_fit_transform_forwards_quantum_kwargs():
+    """The reference's fit_transform crashes on stale kwargs
+    (_qPCA.py:467-473); ours forwards everything (documented intent)."""
+    from sq_learn_tpu.datasets import make_blobs
+
+    X, _ = make_blobs(n_samples=200, centers=3, n_features=16,
+                      cluster_std=0.8, random_state=0)
+    pca = QPCA(n_components=4, random_state=0)
+    Xt = pca.fit_transform(
+        X, estimate_all=True, theta_major=1e-9, eps=0.05, delta=0.05,
+        true_tomography=False, classic_transform=False,
+        use_classical_components=False)
+    assert Xt.shape == (200, 4)
+    assert hasattr(pca, "estimate_right_sv")
+    # classical default path still works
+    Xt2 = QPCA(n_components=4, random_state=0).fit_transform(X)
+    assert Xt2.shape == (200, 4)
