@@ -1,0 +1,95 @@
+"""QuantumUtility — reference-namespace facade (``sklearn/QuantumUtility``).
+
+The reference re-exports its whole routine library from this package
+(``QuantumUtility/__init__.py:5-6``). Same surface here, with the
+TPU-native implementations behind the reference's names
+(``Utility.py`` symbol → ours):
+
+- ``QuantumState`` (:25), ``tomography`` (:107), ``real_tomography``
+  (:259), ``amplitude_estimation`` (:442), ``phase_estimation`` (:591),
+  ``consistent_phase_estimation`` (:740), ``ipe`` (:697),
+  ``median_evaluation`` (:534) — same names.
+- ``introduce_error`` (:68) / ``introduce_error_array`` (:71) — same
+  names; ``make_gaussian_est`` (:88) → :func:`gaussian_estimate` (alias
+  kept).
+- ``best_mu`` (:222) / ``linear_search`` (:215) / ``mu`` (:196) — same.
+- ``estimate_wald`` (:61), ``coupon_collect`` (:75),
+  ``create_rand_vec`` (:183) — same names.
+- ``wrapper_phase_est_arguments`` (:575) / ``unwrap_phase_est_arguments``
+  (:584) → :func:`sv_to_theta` / :func:`theta_to_sv` (aliases kept).
+
+``check_division`` (:425) has no equivalent: it splits work across a
+``multiprocessing.Pool``, which the batched kernels replace outright
+(SURVEY §2.3). ``check_measure`` (:414) lives inside
+:func:`~sq_learn_tpu.ops.quantum.tomography_incremental`'s schedule
+handling.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.quantum import (
+    QuantumState,
+    amplitude_estimation,
+    best_mu,
+    consistent_phase_estimation,
+    coupon_collect,
+    estimate_wald,
+    gaussian_estimate,
+    introduce_error,
+    introduce_error_array,
+    ipe,
+    linear_search,
+    median_evaluation,
+    mu,
+    phase_estimation,
+    real_tomography,
+    tomography,
+    tomography_incremental,
+)
+from ..ops.quantum.estimation import sv_to_theta, theta_to_sv
+
+# reference aliases
+make_gaussian_est = gaussian_estimate
+wrapper_phase_est_arguments = sv_to_theta
+unwrap_phase_est_arguments = theta_to_sv
+
+
+def create_rand_vec(key, n_vec, len_vec, scale=1.0, type="uniform"):
+    """Random (possibly unnormalized) vectors (reference ``create_rand_vec``,
+    ``Utility.py:183``): ``n_vec`` vectors of length ``len_vec``."""
+    if type == "uniform":
+        v = jax.random.uniform(key, (n_vec, len_vec),
+                               minval=-scale, maxval=scale)
+    elif type == "normal":
+        v = scale * jax.random.normal(key, (n_vec, len_vec))
+    else:
+        raise ValueError(f"type must be 'uniform' or 'normal', got {type!r}")
+    return v
+
+
+__all__ = [
+    "QuantumState",
+    "amplitude_estimation",
+    "best_mu",
+    "consistent_phase_estimation",
+    "coupon_collect",
+    "create_rand_vec",
+    "estimate_wald",
+    "gaussian_estimate",
+    "introduce_error",
+    "introduce_error_array",
+    "ipe",
+    "linear_search",
+    "make_gaussian_est",
+    "median_evaluation",
+    "mu",
+    "phase_estimation",
+    "real_tomography",
+    "sv_to_theta",
+    "theta_to_sv",
+    "tomography",
+    "tomography_incremental",
+    "unwrap_phase_est_arguments",
+    "wrapper_phase_est_arguments",
+]

@@ -26,6 +26,9 @@ from . import ops, utils  # noqa: E402
 
 from . import datasets, metrics, model_selection, models, native, parallel  # noqa: E402
 from . import feature_extraction, pipeline, preprocessing  # noqa: E402
+# reference-namespace facades (sklearn/cluster, decomposition, svm,
+# neighbors, QuantumUtility) so reference users find familiar paths
+from . import QuantumUtility, cluster, decomposition, neighbors, svm  # noqa: E402
 from .feature_extraction import FeatureHasher  # noqa: E402
 from .models import (  # noqa: E402
     KMeans,
@@ -57,6 +60,11 @@ __all__ = [
     "utils",
     "native",
     "parallel",
+    "cluster",
+    "decomposition",
+    "svm",
+    "neighbors",
+    "QuantumUtility",
     "metrics",
     "datasets",
     "models",

@@ -1,0 +1,30 @@
+"""Clustering — reference-namespace facade (``sklearn/cluster``).
+
+A reference user imports ``from sklearn.cluster import qMeans_, KMeans``;
+here the same names resolve to the TPU-native implementations
+(``qMeans_`` → :class:`~sq_learn_tpu.models.qkmeans.QKMeans`, the fixed
+working form of ``cluster/_dmeans.py:833``).
+"""
+
+from ..models.minibatch import MiniBatchKMeans, MiniBatchQKMeans
+from ..models.qkmeans import (
+    KMeans,
+    QKMeans,
+    k_means,
+    kmeans_plusplus,
+    lloyd_single,
+)
+
+# the reference's class name (``_dmeans.py:833``)
+qMeans_ = QKMeans
+
+__all__ = [
+    "KMeans",
+    "MiniBatchKMeans",
+    "MiniBatchQKMeans",
+    "QKMeans",
+    "qMeans_",
+    "k_means",
+    "kmeans_plusplus",
+    "lloyd_single",
+]

@@ -1,0 +1,14 @@
+"""Decomposition — reference-namespace facade (``sklearn/decomposition``).
+
+``qPCA`` → :class:`~sq_learn_tpu.models.qpca.QPCA` (the working form of
+``decomposition/_qPCA.py:113``); stock ``PCA``/``TruncatedSVD`` parity
+classes ride the same XLA linear algebra.
+"""
+
+from ..models.qpca import PCA, QPCA
+from ..models.truncated_svd import TruncatedSVD
+
+# the reference's class name (``_qPCA.py:113``)
+qPCA = QPCA
+
+__all__ = ["PCA", "QPCA", "qPCA", "TruncatedSVD"]

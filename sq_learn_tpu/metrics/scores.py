@@ -13,17 +13,17 @@ def accuracy_score(y_true, y_pred):
 
 
 def _contingency(labels_true, labels_pred):
-    """Dense contingency table via one-hot GEMM (MXU-friendly; replaces the
-    reference's sparse COO build in ``metrics/cluster/_supervised.py``)."""
+    """Dense contingency table — exact int64 bincount (label metrics are
+    integer bookkeeping; a float32 GEMM stops counting exactly at 2^24,
+    which the TPU-scale datasets this library targets can exceed)."""
     labels_true = np.asarray(labels_true)
     labels_pred = np.asarray(labels_pred)
     _, ti = np.unique(labels_true, return_inverse=True)
     _, pi = np.unique(labels_pred, return_inverse=True)
     n_t = int(ti.max()) + 1
     n_p = int(pi.max()) + 1
-    onehot_t = jnp.zeros((len(ti), n_t)).at[jnp.arange(len(ti)), jnp.asarray(ti)].set(1.0)
-    onehot_p = jnp.zeros((len(pi), n_p)).at[jnp.arange(len(pi)), jnp.asarray(pi)].set(1.0)
-    return onehot_t.T @ onehot_p
+    return np.bincount(n_p * ti + pi,
+                       minlength=n_t * n_p).reshape(n_t, n_p)
 
 
 def adjusted_rand_score(labels_true, labels_pred):

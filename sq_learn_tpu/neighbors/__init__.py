@@ -1,0 +1,9 @@
+"""Neighbors — reference-namespace facade (``sklearn/neighbors``).
+
+Brute-force GEMM + ``lax.top_k`` replaces the reference's ball/KD trees
+(pointer-chasing is TPU-hostile; SURVEY §2.2 "neighbors" row).
+"""
+
+from ..models.neighbors import KNeighborsClassifier, knn_indices
+
+__all__ = ["KNeighborsClassifier", "knn_indices"]

@@ -1,0 +1,11 @@
+"""SVM — reference-namespace facade (``sklearn/svm``).
+
+``QLSSVC`` (``svm/_qSVM.py:10``) is the quantum least-squares SVM; the
+classical libsvm/liblinear SMO solvers are out of the quantum capability
+surface (SURVEY §2.2) — the LS-SVM formulation is a dense SVD solve that
+maps to the MXU.
+"""
+
+from ..models.qlssvc import QLSSVC, lssvc_solve
+
+__all__ = ["QLSSVC", "lssvc_solve"]

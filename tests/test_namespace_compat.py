@@ -1,0 +1,69 @@
+"""Reference-namespace facade tests: a sq-learn user's import paths resolve
+to the TPU-native implementations (SURVEY §0 surface)."""
+
+import numpy as np
+import jax
+import pytest
+
+
+def test_cluster_namespace():
+    from sq_learn_tpu.cluster import KMeans, MiniBatchKMeans, qMeans_
+    from sq_learn_tpu.models import QKMeans
+
+    assert qMeans_ is QKMeans
+    assert KMeans is not None and MiniBatchKMeans is not None
+
+
+def test_decomposition_namespace():
+    from sq_learn_tpu.decomposition import PCA, TruncatedSVD, qPCA
+    from sq_learn_tpu.models import QPCA
+
+    assert qPCA is QPCA
+    assert PCA is not None and TruncatedSVD is not None
+
+
+def test_svm_and_neighbors_namespaces():
+    from sq_learn_tpu.neighbors import KNeighborsClassifier
+    from sq_learn_tpu.svm import QLSSVC
+
+    assert QLSSVC is not None and KNeighborsClassifier is not None
+
+
+def test_quantum_utility_namespace_smoke(key=jax.random.PRNGKey(0)):
+    from sq_learn_tpu import QuantumUtility as QU
+
+    # the reference names resolve and run
+    v = QU.create_rand_vec(key, 2, 8)
+    assert v.shape == (2, 8)
+    est = QU.make_gaussian_est(key, v[0] / np.linalg.norm(v[0]), 0.1)
+    assert est.shape == (8,)
+    a = QU.amplitude_estimation(key, 0.3, epsilon=0.05)
+    assert abs(float(a) - 0.3) < 0.1
+    e = QU.introduce_error(key, 1.0, 0.01)
+    assert abs(float(e) - 1.0) <= 0.01 + 1e-6
+    norm_name, best = QU.best_mu(np.eye(4, dtype=np.float32), 0.0, step=0.5)
+    assert best > 0
+
+
+def test_mnist_trial_style_pipeline_with_compat_imports():
+    """The reference's MnistTrial pattern, written with reference-style
+    imports, runs unmodified (small data)."""
+    import warnings
+
+    from sq_learn_tpu.datasets import make_blobs
+    from sq_learn_tpu.decomposition import qPCA
+    from sq_learn_tpu.model_selection import StratifiedKFold, cross_validate
+    from sq_learn_tpu.neighbors import KNeighborsClassifier
+
+    X, y = make_blobs(n_samples=200, centers=3, n_features=16,
+                      cluster_std=1.0, random_state=0)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        pca = qPCA(n_components=4, random_state=0)
+        pca.fit(X, estimate_all=True, theta_major=1e-9, eps=0.1, delta=0.1,
+                true_tomography=False)
+        Xt = pca.transform(X, classic_transform=False,
+                           use_classical_components=False)
+    res = cross_validate(KNeighborsClassifier(n_neighbors=5), Xt, y,
+                         cv=StratifiedKFold(n_splits=3))
+    assert np.mean(res["test_score"]) > 0.9
