@@ -1,0 +1,46 @@
+"""Pin the driver contract in ``__graft_entry__.py``.
+
+The round-1 multichip gate failed because ``dryrun_multichip`` touched the
+default (accelerator) backend before falling back to the CPU mesh — so a
+wedged tunnel failed the round artifact. These tests run both entry points
+under the conftest (CPU backend, 8 virtual devices) so the contract can
+never silently regress again.
+"""
+
+import pathlib
+import sys
+
+import jax
+import pytest
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import __graft_entry__  # noqa: E402
+
+
+def test_entry_compiles_and_runs():
+    fn, args = __graft_entry__.entry()
+    out = jax.jit(fn)(*args)
+    jax.block_until_ready(out)
+    centers, inertia = out
+    assert centers.shape == args[3].shape  # (k, m)
+    assert float(inertia) >= 0.0
+
+
+@pytest.mark.parametrize("n_devices", [1, 2, 8])
+def test_dryrun_multichip(n_devices):
+    __graft_entry__.dryrun_multichip(n_devices)
+
+
+def test_dryrun_multichip_never_asks_for_accelerator(monkeypatch):
+    """dryrun_multichip must only ever request the CPU backend."""
+    real_devices = jax.devices
+
+    def guarded(backend=None):
+        assert backend == "cpu", (
+            "dryrun_multichip queried a non-CPU backend: "
+            f"jax.devices({backend!r})")
+        return real_devices(backend)
+
+    monkeypatch.setattr(jax, "devices", guarded)
+    __graft_entry__.dryrun_multichip(4)
