@@ -31,7 +31,7 @@ from jax import lax
 
 from ..base import BaseEstimator, ClusterMixin, TransformerMixin, check_is_fitted
 from ..ops.linalg import pairwise_sq_distances, row_norms, smallest_singular_value
-from ..ops.quantum import best_mu, tomography
+from ..ops.quantum import tomography
 from ..ops.quantum.estimation import ipe
 from ..utils import as_key, check_array, check_sample_weight
 
@@ -44,6 +44,32 @@ def tolerance(X, tol):
     if tol == 0:
         return 0.0
     return float(tol * np.mean(np.var(np.asarray(X), axis=0)))
+
+
+@functools.partial(jax.jit, static_argnames=("quantum", "mu_grid"))
+def fit_prestats(X, *, quantum=False, mu_grid=()):
+    """Every pre-fit statistic in ONE dispatch — on a tunneled accelerator
+    each separate launch pays a host↔device round-trip, so the mean /
+    centering / centered row norms / tol variance scale, and (δ>0 only) the
+    quantum runtime-model parameters — η = max‖xᵢ‖² , the μ_p(A) grid and
+    Frobenius norm (reference ``Utility.py:215-231``), σ_min (reference
+    ``_dmeans.py:1242-1245``) — are fused into a single jit."""
+    mean = jnp.mean(X, axis=0)
+    Xc = X - mean
+    out = {
+        "mean": mean,
+        "Xc": Xc,
+        "xsq": row_norms(Xc, squared=True),
+        "var_mean": jnp.mean(jnp.var(X, axis=0)),
+    }
+    if quantum:
+        from ..ops.quantum.norms import _mu_grid
+
+        out["eta"] = jnp.max(row_norms(X, squared=True))
+        out["mu_vals"] = _mu_grid(X, mu_grid)
+        out["frob"] = jnp.linalg.norm(X)
+        out["sigma_min"] = smallest_singular_value(X)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -91,13 +117,10 @@ def e_step(key, X, weights, centers, x_sq_norms, *, delta, mode, ipe_q,
     return labels, inertia, min_d2
 
 
-def m_step(key, X, weights, labels, old_centers, *, delta,
-           intermediate_error, true_tomography, axis_name=None):
-    """Update step: weighted per-cluster means via one-hot GEMM; the
-    per-thread partial-sum reduction of ``_k_means_lloyd.pyx:145-150``
-    becomes a ``psum`` over the mesh. Empty clusters keep their old center.
-    Optional tomography noise at δ/2 (``_dmeans.py:825-828``)."""
-    k = old_centers.shape[0]
+def _cluster_partials(X, weights, labels, k, axis_name=None):
+    """Weighted per-cluster sums/counts via one-hot GEMM; the per-thread
+    partial-sum reduction of ``_k_means_lloyd.pyx:145-150`` becomes a
+    ``psum`` over the mesh."""
     onehot = (labels[:, None] == jnp.arange(k)[None, :]).astype(X.dtype)
     onehot = onehot * weights[:, None]
     sums = onehot.T @ X  # (k, m) MXU
@@ -105,6 +128,62 @@ def m_step(key, X, weights, labels, old_centers, *, delta,
     if axis_name is not None:
         sums = lax.psum(sums, axis_name)
         counts = lax.psum(counts, axis_name)
+    return sums, counts
+
+
+def relocate_empty_clusters(X, weights, labels, min_d2, sums, counts,
+                            axis_name=None):
+    """Reassign empty clusters to the samples farthest from their assigned
+    centroids (reference ``cluster/_k_means_fast.pyx:162``
+    ``_relocate_empty_clusters_dense``, called from the Lloyd loop): the
+    i-th empty cluster's partials become the i-th farthest sample, and the
+    donor cluster's partial sums lose that sample.
+
+    Fully vectorized and jit-safe — exact no-op when nothing is empty.
+    ``sums``/``counts`` must already be globally reduced; under
+    ``axis_name`` the per-shard farthest-sample candidates are
+    ``all_gather``-ed and re-ranked so every device relocates identically.
+    """
+    k, m = sums.shape
+    # zero-weight rows (padding) must never be chosen as a relocation target
+    score = jnp.where(weights > 0, min_d2, -jnp.inf)
+    # a shard may hold fewer rows than k (small n over many devices); the
+    # gathered global candidate pool still has ≥ k rows because fit
+    # validates n_samples ≥ n_clusters
+    vals, idx = lax.top_k(score, min(k, score.shape[0]))
+    cand_X, cand_w, cand_l = X[idx], weights[idx], labels[idx]
+    if axis_name is not None:
+        vals = lax.all_gather(vals, axis_name).reshape(-1)
+        cand_X = lax.all_gather(cand_X, axis_name).reshape(-1, m)
+        cand_w = lax.all_gather(cand_w, axis_name).reshape(-1)
+        cand_l = lax.all_gather(cand_l, axis_name).reshape(-1)
+        _, order = lax.top_k(vals, min(k, vals.shape[0]))
+        cand_X, cand_w, cand_l = cand_X[order], cand_w[order], cand_l[order]
+    empty = counts <= 0
+    rank = jnp.where(empty, jnp.cumsum(empty) - 1, 0)
+    rank = jnp.clip(rank, 0, cand_w.shape[0] - 1)
+    pt_X = cand_X[rank]                          # (k, m)
+    pt_w = jnp.where(empty, cand_w[rank], 0.0)   # 0 masks non-empty rows
+    pt_l = cand_l[rank]
+    sums = sums.at[pt_l].add(-pt_w[:, None] * pt_X)
+    counts = counts.at[pt_l].add(-pt_w)
+    sums = jnp.where(empty[:, None], pt_w[:, None] * pt_X, sums)
+    counts = jnp.where(empty, pt_w, counts)
+    return sums, counts
+
+
+def m_step(key, X, weights, labels, old_centers, *, delta,
+           intermediate_error, true_tomography, axis_name=None, min_d2=None):
+    """Update step: weighted per-cluster means (``_centers_update``,
+    ``_dmeans.py:780-830``). When ``min_d2`` is given, empty clusters are
+    relocated to the farthest samples (sklearn semantics); otherwise — or
+    when a cluster stays empty after relocation — the old center is kept.
+    Optional tomography noise at δ/2 (``_dmeans.py:825-828``)."""
+    k = old_centers.shape[0]
+    sums, counts = _cluster_partials(X, weights, labels, k, axis_name)
+    if min_d2 is not None:
+        sums, counts = relocate_empty_clusters(
+            X, weights, labels, min_d2, sums, counts, axis_name)
     safe = jnp.where(counts > 0, counts, 1.0)
     centers = jnp.where((counts > 0)[:, None], sums / safe[:, None], old_centers)
     if intermediate_error and delta > 0:
@@ -114,7 +193,7 @@ def m_step(key, X, weights, labels, old_centers, *, delta,
 
 
 def lloyd_single(key, X, weights, centers_init, x_sq_norms, *, delta=0.0,
-                 mode="classic", max_iter=300, tol=1e-4,
+                 mode="classic", max_iter=300, tol=1e-4, patience=None,
                  intermediate_error=False, true_tomography=True, ipe_q=5,
                  axis_name=None, use_pallas=False, pallas_interpret=False):
     """One full q-means run (reference ``_kmeans_single_lloyd``,
@@ -124,31 +203,40 @@ def lloyd_single(key, X, weights, centers_init, x_sq_norms, *, delta=0.0,
     the inertia is not monotone — and re-runs the E-step on the best centers
     at the end so labels are consistent with the returned centers.
 
+    ``patience`` adds the noisy-mode stopping rule the reference lacks:
+    stop once the best inertia has not improved for ``patience`` iterations
+    (with δ > 0 the center shift keeps jittering above ``tol``, so the
+    classical rule alone burns every ``max_iter`` iteration). ``None``
+    disables it.
+
     ``use_pallas`` routes the classical (δ=0) and δ-means iterations
     through the fused hand-tiled kernel
     (:mod:`~sq_learn_tpu.ops.pallas_kernels`) — one HBM sweep per
     iteration instead of two, with the δ-window Gumbel pick fused in.
 
-    Returns (labels, inertia, centers, n_iter).
+    Returns (labels, inertia, centers, n_iter, history) where history is
+    ``{"inertia": (max_iter,), "center_shift": (max_iter,)}`` per-iteration
+    traces, NaN beyond ``n_iter`` (SURVEY §5 observability; the reference
+    only prints inertia under ``verbose``, ``_dmeans.py:643-644``).
     """
     if mode not in LloydMode:
         raise ValueError(f"mode must be one of {LloydMode}, got {mode!r}")
 
     estep = functools.partial(e_step, delta=delta, mode=mode, ipe_q=ipe_q,
                               axis_name=axis_name)
-    mstep = functools.partial(m_step, delta=delta,
-                              intermediate_error=intermediate_error,
-                              true_tomography=true_tomography,
-                              axis_name=axis_name)
-    fused = (use_pallas and mode in ("classic", "delta")
-             and not intermediate_error)
+    fused = use_pallas and mode in ("classic", "delta")
+    k = centers_init.shape[0]
 
     def cond(state):
-        _, _, it, shift, _, _ = state
-        return jnp.logical_and(it < max_iter, shift > tol)
+        it, shift, best_it = state[2], state[3], state[6]
+        keep = jnp.logical_and(it < max_iter, shift > tol)
+        if patience is not None:
+            keep = jnp.logical_and(keep, it - best_it <= patience)
+        return keep
 
     def body(state):
-        key, centers, it, _, best_inertia, best_centers = state
+        (key, centers, it, _, best_inertia, best_centers, best_it,
+         inertia_tr, shift_tr) = state
         key, k1, k2 = jax.random.split(key, 3)
         if fused:
             from ..ops.pallas_kernels import lloyd_step_pallas
@@ -157,7 +245,7 @@ def lloyd_single(key, X, weights, centers_init, x_sq_norms, *, delta=0.0,
                 # decorrelate the δ-window Gumbel draws across shards,
                 # exactly as e_step does for the non-fused path
                 k1 = jax.random.fold_in(k1, lax.axis_index(axis_name))
-            labels, sums, counts, inertia = lloyd_step_pallas(
+            labels, min_d2, sums, counts, inertia = lloyd_step_pallas(
                 X, weights, centers, x_sq_norms, key=k1,
                 window=delta if mode == "delta" else 0.0,
                 interpret=pallas_interpret)
@@ -165,26 +253,37 @@ def lloyd_single(key, X, weights, centers_init, x_sq_norms, *, delta=0.0,
                 sums = lax.psum(sums, axis_name)
                 counts = lax.psum(counts, axis_name)
                 inertia = lax.psum(inertia, axis_name)
-            safe = jnp.where(counts > 0, counts, 1.0)
-            new_centers = jnp.where((counts > 0)[:, None],
-                                    sums / safe[:, None], centers)
         else:
-            labels, inertia, _ = estep(k1, X, weights, centers, x_sq_norms)
-            new_centers = mstep(k2, X, weights, labels, centers)
+            labels, inertia, min_d2 = estep(k1, X, weights, centers,
+                                            x_sq_norms)
+            sums, counts = _cluster_partials(X, weights, labels, k, axis_name)
+        sums, counts = relocate_empty_clusters(
+            X, weights, labels, min_d2, sums, counts, axis_name)
+        safe = jnp.where(counts > 0, counts, 1.0)
+        new_centers = jnp.where((counts > 0)[:, None],
+                                sums / safe[:, None], centers)
+        if intermediate_error and delta > 0:
+            new_centers = tomography(k2, new_centers, delta / 2,
+                                     true_tomography=true_tomography)
         # best-tracking pairs each inertia with the centers it was measured
         # on (the reference pairs it with the post-update centers,
         # _dmeans.py:646-649 — a mismatch under noise we don't replicate)
         better = inertia < best_inertia
+        best_it = jnp.where(better, it, best_it)
         best_inertia = jnp.minimum(inertia, best_inertia)
         best_centers = jnp.where(better, centers, best_centers)
         shift = jnp.sum((new_centers - centers) ** 2)
-        return key, new_centers, it + 1, shift, best_inertia, best_centers
+        inertia_tr = inertia_tr.at[it].set(inertia)
+        shift_tr = shift_tr.at[it].set(shift)
+        return (key, new_centers, it + 1, shift, best_inertia, best_centers,
+                best_it, inertia_tr, shift_tr)
 
+    nan_trace = jnp.full((max_iter,), jnp.nan, X.dtype)
     init = (key, centers_init, jnp.asarray(0), jnp.asarray(jnp.inf, X.dtype),
-            jnp.asarray(jnp.inf, X.dtype), centers_init)
-    key, centers, n_iter, _, best_inertia, best_centers = lax.while_loop(
-        cond, body, init
-    )
+            jnp.asarray(jnp.inf, X.dtype), centers_init, jnp.asarray(0),
+            nan_trace, nan_trace)
+    (key, centers, n_iter, _, best_inertia, best_centers, _, inertia_tr,
+     shift_tr) = lax.while_loop(cond, body, init)
     # the final post-update centers may beat every evaluated iterate
     # (classical convergence); re-evaluate both and return a consistent
     # (labels, inertia, centers) triple
@@ -195,7 +294,8 @@ def lloyd_single(key, X, weights, centers_init, x_sq_norms, *, delta=0.0,
     labels = jnp.where(last_wins, labels_l, labels_b)
     inertia = jnp.where(last_wins, inertia_l, inertia_b)
     out_centers = jnp.where(last_wins, centers, best_centers)
-    return labels, inertia, out_centers, n_iter
+    history = {"inertia": inertia_tr, "center_shift": shift_tr}
+    return labels, inertia, out_centers, n_iter, history
 
 
 @functools.partial(
@@ -251,7 +351,7 @@ def kmeans_plusplus(key, X, x_sq_norms, n_clusters, n_local_trials=None,
 lloyd_single_jit = jax.jit(
     lloyd_single,
     static_argnames=(
-        "delta", "mode", "max_iter", "intermediate_error",
+        "delta", "mode", "max_iter", "patience", "intermediate_error",
         "true_tomography", "ipe_q", "axis_name", "use_pallas",
         "pallas_interpret",
     ),
@@ -261,22 +361,27 @@ lloyd_single_jit = jax.jit(
 @functools.partial(
     jax.jit,
     static_argnames=("n_init", "init", "n_clusters", "delta", "mode",
-                     "max_iter", "intermediate_error", "true_tomography",
-                     "ipe_q", "use_pallas", "pallas_interpret"),
+                     "max_iter", "patience", "intermediate_error",
+                     "true_tomography", "ipe_q", "use_pallas",
+                     "pallas_interpret"),
 )
 def lloyd_restarts(key, X, weights, x_sq_norms, *, n_init, init, n_clusters,
                    delta=0.0, mode="classic", max_iter=300, tol=1e-4,
-                   intermediate_error=False, true_tomography=True, ipe_q=5,
-                   use_pallas=False, pallas_interpret=False):
+                   patience=None, intermediate_error=False,
+                   true_tomography=True, ipe_q=5, use_pallas=False,
+                   pallas_interpret=False):
     """All ``n_init`` restarts as ONE vmapped kernel.
 
     The reference (and classical sklearn) loops restarts on the host; on an
     accelerator that serializes n_init small dispatches. Here init
     (k-means++ D² sampling or uniform random rows) and the full Lloyd
     while-loop are batched over the restart axis — one compile, one
-    dispatch — and the best restart is selected on device by inertia.
+    dispatch — and the best restart is selected on device by inertia. The
+    pallas fused kernel composes with the batching (its ``pallas_call``
+    gains a restart grid axis under ``vmap``).
 
-    Returns (labels, inertia, centers, n_iter) of the winning restart.
+    Returns (labels, inertia, centers, n_iter, history) of the winning
+    restart.
     """
     keys = jax.random.split(key, 2 * n_init)
     init_keys, run_keys = keys[:n_init], keys[n_init:]
@@ -291,13 +396,14 @@ def lloyd_restarts(key, X, weights, x_sq_norms, *, n_init, init, n_clusters,
                                           replace=False, p=p)])(init_keys)
     run = functools.partial(
         lloyd_single, delta=delta, mode=mode, max_iter=max_iter, tol=tol,
-        intermediate_error=intermediate_error,
+        patience=patience, intermediate_error=intermediate_error,
         true_tomography=true_tomography, ipe_q=ipe_q,
         use_pallas=use_pallas, pallas_interpret=pallas_interpret)
-    labels, inertia, centers, n_iter = jax.vmap(
+    labels, inertia, centers, n_iter, history = jax.vmap(
         lambda k, c0: run(k, X, weights, c0, x_sq_norms))(run_keys, centers0)
     best = jnp.argmin(inertia)
-    return labels[best], inertia[best], centers[best], n_iter[best]
+    return (labels[best], inertia[best], centers[best], n_iter[best],
+            jax.tree.map(lambda a: a[best], history))
 
 # module-level jitted E-step for inference (one compile cache per process)
 e_step_jit = jax.jit(
@@ -329,11 +435,17 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
 
     ``mesh`` (a 1-D ``jax.sharding.Mesh``) runs the Lloyd loop data-parallel
     with psum centroid reductions over ICI.
+
+    ``patience`` ('auto' | None | int) is the noisy-mode stopping rule: stop
+    a run once the best inertia has not improved for that many iterations
+    ('auto' = 20 on noisy fits, disabled on classical ones, where shift≤tol
+    terminates). After ``fit``, ``fit_history_`` holds the winning restart's
+    per-iteration ``{"inertia", "center_shift"}`` traces.
     """
 
     def __init__(self, n_clusters=8, *, init="k-means++", n_init=10,
-                 max_iter=300, tol=1e-4, verbose=0, random_state=None,
-                 copy_x=True, algorithm="auto", delta=None,
+                 max_iter=300, tol=1e-4, patience="auto", verbose=0,
+                 random_state=None, copy_x=True, algorithm="auto", delta=None,
                  intermediate_error=False, true_tomography=True,
                  stop_when_reached_accuracy=True, multiprocess=False,
                  true_distance_estimate=True, ipe_q=5, mesh=None,
@@ -343,6 +455,7 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         self.n_init = n_init
         self.max_iter = max_iter
         self.tol = tol
+        self.patience = patience
         self.verbose = verbose
         self.random_state = random_state
         self.copy_x = copy_x
@@ -427,33 +540,45 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
                     "intermediate_error cannot be True if delta is zero.")
         sample_weight = check_sample_weight(sample_weight, X)
 
-        if delta > 0:
-            # quantum runtime-model parameters (reference _dmeans.py:1242-1245;
-            # σ_min via Gram eigh instead of a full SVD). Only consumed by
-            # quantum_runtime_model, which requires delta > 0 — skip the
-            # O(n·m²) scans entirely on the classical path.
-            self.eta_ = float(np.max(row_norms(X, squared=True)))
-            self.norm_mu_, self.mu_ = best_mu(X, 0.0, step=0.1)
-            sigma_min = float(smallest_singular_value(X))
-            self.condition_number_ = 1.0 / sigma_min if sigma_min > 0 else np.inf
+        # one fused dispatch for centering + norms + quantum runtime-model
+        # parameters (reference _dmeans.py:1242-1266; σ_min via Gram eigh
+        # instead of a full SVD). The quantum stats are only consumed by
+        # quantum_runtime_model, which requires delta > 0 — the classical
+        # path skips those O(n·m²) scans entirely.
+        quantum = delta > 0
+        mu_grid = (tuple(float(p) for p in np.arange(0.0, 1.0, 0.1)) + (1.0,)
+                   if quantum else ())
+        stats = fit_prestats(jnp.asarray(X), quantum=quantum, mu_grid=mu_grid)
+        if quantum:
+            from ..ops.quantum.norms import select_mu
 
-        tol_ = tolerance(X, self.tol)
+            # fetch all scalars in one transfer
+            var_mean, eta, frob, sigma_min = np.asarray(jnp.stack(
+                [stats["var_mean"], stats["eta"], stats["frob"],
+                 stats["sigma_min"]])).astype(float)
+            self.eta_ = float(eta)
+            self.norm_mu_, self.mu_ = select_mu(mu_grid, stats["mu_vals"],
+                                                frob)
+            self.condition_number_ = (
+                1.0 / sigma_min if sigma_min > 0 else np.inf)
+        else:
+            var_mean = float(stats["var_mean"])
+        tol_ = 0.0 if self.tol == 0 else float(self.tol * var_mean)
         key = as_key(self.random_state)
 
-        # center for more accurate distances (reference _dmeans.py:1263-1266)
-        X_mean = X.mean(axis=0)
-        Xc = X - X_mean
+        # centered for more accurate distances (reference _dmeans.py:1263-1266)
+        Xc, xsq = stats["Xc"], stats["xsq"]
         init = self.init
         if hasattr(init, "__array__"):
-            init = np.asarray(init, dtype=X.dtype) - X_mean
+            init = np.asarray(init, dtype=X.dtype) - np.asarray(stats["mean"])
         n_init = 1 if hasattr(init, "__array__") else self.n_init
 
         mode = self._mode(delta)
-        results = self._run_lloyd(key, Xc, sample_weight, init, n_init, delta,
-                                  mode, tol_)
-        best_labels, best_inertia, best_centers, best_n_iter = results
+        results = self._run_lloyd(key, Xc, xsq, sample_weight, init, n_init,
+                                  delta, mode, tol_)
+        best_labels, best_inertia, best_centers, best_n_iter, history = results
 
-        centers = np.asarray(best_centers) + np.asarray(X_mean)
+        centers = np.asarray(best_centers) + np.asarray(stats["mean"])
         labels = np.asarray(best_labels)
         distinct = len(np.unique(labels))
         if distinct < self.n_clusters:
@@ -465,10 +590,34 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         self.labels_ = labels
         self.inertia_ = float(best_inertia)
         self.n_iter_ = int(best_n_iter)
+        # per-iteration observability out of the jit'd loop (SURVEY §5):
+        # traces of the winning restart, trimmed to the iterations that
+        # ran. Stored as flat ndarray attributes so utils/checkpoint.py
+        # round-trips them; fit_history_ presents them as a dict.
+        self.inertia_history_ = np.asarray(history["inertia"])[: self.n_iter_]
+        self.center_shift_history_ = np.asarray(
+            history["center_shift"])[: self.n_iter_]
         return self
 
-    def _run_lloyd(self, key, Xc, sample_weight, init, n_init, delta, mode,
-                   tol_):
+    @property
+    def fit_history_(self):
+        """Dict view of the per-iteration traces of the winning restart."""
+        check_is_fitted(self, "inertia_history_")
+        return {"inertia": self.inertia_history_,
+                "center_shift": self.center_shift_history_}
+
+    def _resolved_patience(self, mode):
+        """'auto' enables the best-inertia plateau rule only where the
+        classical shift≤tol rule cannot fire (noisy fits)."""
+        if self.patience == "auto":
+            noisy = mode != "classic" or self.intermediate_error
+            return 20 if noisy else None
+        if self.patience is None:
+            return None
+        return int(self.patience)
+
+    def _run_lloyd(self, key, Xc, xsq, sample_weight, init, n_init, delta,
+                   mode, tol_):
         """n_init restarts of the single-run kernel; keep the best inertia."""
         from ..ops.pallas_kernels import pallas_available
 
@@ -478,27 +627,26 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
             use_pallas = bool(self.use_pallas)
             interpret = use_pallas and not pallas_available()
         static = dict(delta=delta, mode=mode, max_iter=self.max_iter, tol=tol_,
+                      patience=self._resolved_patience(mode),
                       intermediate_error=self.intermediate_error,
                       true_tomography=self.true_tomography, ipe_q=self.ipe_q,
                       use_pallas=use_pallas, pallas_interpret=interpret)
         Xd = jnp.asarray(Xc)
         w = jnp.asarray(sample_weight, Xd.dtype)
-        xsq = row_norms(Xd, squared=True)
 
         # fast path: all restarts batched into one vmapped kernel (string
-        # inits only; the pallas kernel and the shard_map path keep the host
-        # loop — their batching rules are the respective kernels' own).
-        # Accelerators win from one large dispatch; the CPU backend wins
-        # from per-restart early stopping, so it keeps the loop — as do
-        # verbose fits, whose per-init reporting needs the loop.
-        if (self.mesh is None and not use_pallas and not self.verbose
+        # inits only; under vmap the pallas kernel's grid gains a restart
+        # axis, so the fused path batches too). Accelerators win from one
+        # large dispatch; the CPU backend wins from per-restart early
+        # stopping, so it keeps the loop — as do verbose fits, whose
+        # per-init reporting needs the loop, and the shard_map path, whose
+        # batching is the mesh's own.
+        if (self.mesh is None and not self.verbose
                 and isinstance(init, str) and n_init > 1
                 and jax.default_backend() != "cpu"):
             return lloyd_restarts(
                 key, Xd, w, xsq, n_init=n_init, init=init,
-                n_clusters=self.n_clusters, tol=tol_,
-                **{k: v for k, v in static.items()
-                   if k not in ("use_pallas", "pallas_interpret", "tol")})
+                n_clusters=self.n_clusters, **static)
 
         if self.mesh is not None:
             from ..parallel.lloyd import lloyd_single_sharded
@@ -512,11 +660,17 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
             key, ki, kr = jax.random.split(key, 3)
             centers0 = self._init_centroids(ki, Xd, xsq, init, Xd.shape[0],
                                             weights=w)
-            labels, inertia, centers, n_iter = run(kr, Xd, w, centers0, xsq)
+            labels, inertia, centers, n_iter, history = run(
+                kr, Xd, w, centers0, xsq)
             if self.verbose:
+                # reference-parity per-iteration reporting
+                # (_dmeans.py:643-644), fed from the jit'd loop's trace
+                trace = np.asarray(history["inertia"])[: int(n_iter)]
+                for i, v in enumerate(trace):
+                    print(f"Iteration {i}, inertia {v:.3f}.")
                 print(f"init done, inertia {float(inertia):.3f}")
             if best is None or float(inertia) < float(best[1]):
-                best = (labels, inertia, centers, n_iter)
+                best = (labels, inertia, centers, n_iter, history)
         return best
 
     # -- inference ----------------------------------------------------------

