@@ -56,8 +56,7 @@ def set_config(device=None, default_dtype=None, assume_finite=None,
     """
     local_config = _get_threadlocal_config()
     if device is not None:
-        if device not in ("auto", "tpu", "cpu"):
-            raise ValueError(f"device must be 'auto', 'tpu' or 'cpu', got {device!r}")
+        _parse_device(device)  # validate eagerly, not at resolve time
         local_config["device"] = device
     if default_dtype is not None:
         if default_dtype not in ("float32", "float64", "bfloat16"):
@@ -97,22 +96,106 @@ def config_context(**new_config):
         jax.config.update("jax_enable_x64", old_x64)
 
 
+def _parse_device(device):
+    """Validate a device string; returns (name, index). 'auto' carries no
+    index; 'cpu'/'tpu' accept an optional non-negative integer ('cpu:1')."""
+    err = ValueError(
+        f"device must be 'auto', 'tpu' or 'cpu' (the latter two optionally "
+        f"with a non-negative index, e.g. 'cpu:1'), got {device!r}")
+    if not isinstance(device, str):
+        raise err
+    name, sep, idx = device.partition(":")
+    if name == "auto":
+        if sep:
+            raise err
+        return name, 0
+    if name not in ("tpu", "cpu"):
+        raise err
+    if not sep:
+        return name, 0
+    if not idx.isdigit():
+        raise err
+    return name, int(idx)
+
+
 def resolve_device():
     """Return the concrete :class:`jax.Device` selected by the config.
 
     'auto' prefers an accelerator if JAX has one, falling back to CPU.
+    'cpu'/'tpu' may carry a device index ('cpu:1') to pin a specific chip.
     """
     import jax
 
     device = _get_threadlocal_config()["device"]
-    if device == "cpu":
-        return jax.devices("cpu")[0]
-    if device == "tpu":
-        for d in jax.devices():
-            if d.platform != "cpu":
-                return d
-        raise RuntimeError("device='tpu' requested but no accelerator is attached")
-    return jax.devices()[0]
+    name, i = _parse_device(device)
+    if name == "auto":
+        return jax.devices()[0]
+    if name == "cpu":
+        pool = jax.devices("cpu")
+    else:
+        pool = [d for d in jax.devices() if d.platform != "cpu"]
+        if not pool:
+            raise RuntimeError(
+                "device='tpu' requested but no accelerator is attached")
+    if i >= len(pool):
+        raise RuntimeError(
+            f"device {device!r} requested but only {len(pool)} "
+            f"{name} devices exist")
+    return pool[i]
+
+
+def device_scope():
+    """Context manager scoping computation to the configured device.
+
+    Under 'auto' this is a no-op. Otherwise ``resolve_device()`` becomes
+    jax's default device for the scope, so even implicitly created arrays
+    (PRNG keys, ``jnp.ones`` companions, eager casts) never touch the
+    default backend — with a wedged accelerator tunnel and
+    ``set_config(device='cpu')``, nothing can hang on the tunnel.
+    """
+    import contextlib
+
+    if _get_threadlocal_config()["device"] == "auto":
+        return contextlib.nullcontext()
+    import jax
+
+    return jax.default_device(resolve_device())
+
+
+def with_device_scope(method):
+    """Decorator running an estimator method under :func:`device_scope`."""
+    import functools
+
+    @functools.wraps(method)
+    def wrapper(*args, **kwargs):
+        with device_scope():
+            return method(*args, **kwargs)
+
+    return wrapper
+
+
+def as_device_array(x):
+    """``jnp.asarray`` honoring ``set_config(device=...)`` — the dispatch
+    hook BASELINE designates on the reference's config system
+    (``sklearn/_config.py:6-110``).
+
+    Under 'auto' the array stays uncommitted (JAX's default placement).
+    Otherwise it is **committed** to :func:`resolve_device`, which pins
+    every downstream jit that consumes it to that device — this is the
+    CPU-parity dispatch of SURVEY §7 step 1: identical code, selectable
+    backend. Host data is converted with numpy first so a wedged default
+    accelerator is never touched when a CPU device is requested.
+    """
+    import jax
+    import numpy as np
+
+    if _get_threadlocal_config()["device"] == "auto":
+        import jax.numpy as jnp
+
+        return jnp.asarray(x)
+    if not isinstance(x, jax.Array):
+        x = np.asarray(x)
+    return jax.device_put(x, resolve_device())
 
 
 def default_dtype():

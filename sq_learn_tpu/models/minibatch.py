@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .._config import as_device_array, with_device_scope
 from ..base import BaseEstimator, ClusterMixin, TransformerMixin, check_is_fitted
 from ..ops.linalg import pairwise_sq_distances, row_norms
 from ..utils import as_key, check_array, check_sample_weight
@@ -116,7 +117,7 @@ class MiniBatchQKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
     # -- streaming state ---------------------------------------------------
 
     def _init_state(self, key, X, sample_weight):
-        Xd = jnp.asarray(X)
+        Xd = as_device_array(X)  # set_config(device=...) placement
         xsq = row_norms(Xd, squared=True)
         w = jnp.asarray(sample_weight, Xd.dtype)
         if isinstance(self.init, str) and self.init == "k-means++":
@@ -144,7 +145,7 @@ class MiniBatchQKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         perm = np.asarray(jax.random.permutation(key, n))
         pad = n_batches * b - n
         idx = np.concatenate([perm, perm[:pad]]) if pad else perm
-        Xs = jnp.asarray(X)[idx].reshape(n_batches, b, X.shape[1])
+        Xs = as_device_array(X)[idx].reshape(n_batches, b, X.shape[1])
         w = np.asarray(sample_weight, dtype=X.dtype)[idx].copy()
         if pad:
             w[n:] = 0.0  # duplicated padding rows must not contribute
@@ -153,6 +154,7 @@ class MiniBatchQKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
 
     # -- API ---------------------------------------------------------------
 
+    @with_device_scope
     def fit(self, X, y=None, sample_weight=None):
         X = check_array(X)
         if X.shape[0] < self.n_clusters:
@@ -224,6 +226,7 @@ class MiniBatchQKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
             prev_centers = centers
         return centers, counts, it, float(ewa if ewa is not None else np.inf)
 
+    @with_device_scope
     def partial_fit(self, X, y=None, sample_weight=None):
         """Incremental update from one batch — the checkpointable streaming
         API (reference ``_dmeans.py:2139``)."""
@@ -242,7 +245,7 @@ class MiniBatchQKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
             centers = jnp.asarray(self.cluster_centers_, X.dtype)
             counts = jnp.asarray(self.counts_, X.dtype)
         centers, counts, inertia = minibatch_step_jit(
-            kb, jnp.asarray(X), jnp.asarray(sample_weight, X.dtype),
+            kb, as_device_array(X), jnp.asarray(sample_weight, X.dtype),
             centers, counts, delta=delta, mode=mode, ipe_q=self.ipe_q)
         self.cluster_centers_ = np.asarray(centers)
         self.counts_ = np.asarray(counts)
@@ -258,6 +261,7 @@ class MiniBatchQKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
                                 * jnp.asarray(sample_weight, X.dtype)))
         return labels, inertia
 
+    @with_device_scope
     def predict(self, X, sample_weight=None):
         check_is_fitted(self, "cluster_centers_")
         X = check_array(X)
@@ -265,6 +269,7 @@ class MiniBatchQKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
             jnp.asarray(X), jnp.asarray(self.cluster_centers_, X.dtype))
         return np.asarray(jnp.argmin(d2, axis=1))
 
+    @with_device_scope
     def transform(self, X):
         check_is_fitted(self, "cluster_centers_")
         X = check_array(X)
@@ -298,6 +303,7 @@ class MiniBatchKMeans(MiniBatchQKMeans):
             random_state=random_state,
             reassignment_ratio=reassignment_ratio, delta=None)
 
+    @with_device_scope
     def fit(self, X, y=None, sample_weight=None):
         with warnings.catch_warnings():
             warnings.filterwarnings(

@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .._config import as_device_array, with_device_scope
 from ..base import BaseEstimator, ClassifierMixin, check_is_fitted
 from ..ops.linalg import pairwise_sq_distances
 from ..utils import check_array, check_X_y
@@ -61,14 +62,16 @@ class KNeighborsClassifier(ClassifierMixin, BaseEstimator):
         self.p = p
         self.n_jobs = n_jobs
 
+    @with_device_scope
     def fit(self, X, y):
         X, y = check_X_y(X, y)
         self.classes_, y_enc = np.unique(y, return_inverse=True)
-        self.X_fit_ = jnp.asarray(X)
+        self.X_fit_ = as_device_array(X)  # set_config(device=...) placement
         self.y_fit_ = jnp.asarray(y_enc.astype(np.int32))
         self.n_samples_fit_ = len(X)
         return self
 
+    @with_device_scope
     def kneighbors(self, X, n_neighbors=None, return_distance=True):
         check_is_fitted(self, "n_samples_fit_")
         X = check_array(X)
@@ -78,6 +81,7 @@ class KNeighborsClassifier(ClassifierMixin, BaseEstimator):
             return np.sqrt(np.asarray(d2)), np.asarray(idx)
         return np.asarray(idx)
 
+    @with_device_scope
     def predict_proba(self, X):
         check_is_fitted(self, "n_samples_fit_")
         X = check_array(X)

@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .._config import as_device_array, with_device_scope
 from ..base import BaseEstimator, ClusterMixin, TransformerMixin, check_is_fitted
 from ..ops.linalg import pairwise_sq_distances, row_norms, smallest_singular_value
 from ..ops.quantum import tomography
@@ -141,34 +142,45 @@ def relocate_empty_clusters(X, weights, labels, min_d2, sums, counts,
 
     Fully vectorized and jit-safe — exact no-op when nothing is empty.
     ``sums``/``counts`` must already be globally reduced; under
-    ``axis_name`` the per-shard farthest-sample candidates are
-    ``all_gather``-ed and re-ranked so every device relocates identically.
+    ``axis_name`` the per-shard farthest-sample candidates are psum-gathered
+    and re-ranked so every device relocates identically.
     """
     k, m = sums.shape
     # zero-weight rows (padding) must never be chosen as a relocation target
     score = jnp.where(weights > 0, min_d2, -jnp.inf)
     # a shard may hold fewer rows than k (small n over many devices); the
-    # gathered global candidate pool still has ≥ k rows because fit
-    # validates n_samples ≥ n_clusters
+    # combined global candidate pool still has ≥ k rows whenever fit's
+    # n_samples ≥ n_clusters validation ran
     vals, idx = lax.top_k(score, min(k, score.shape[0]))
     cand_X, cand_w, cand_l = X[idx], weights[idx], labels[idx]
     if axis_name is not None:
-        vals = lax.all_gather(vals, axis_name).reshape(-1)
-        cand_X = lax.all_gather(cand_X, axis_name).reshape(-1, m)
-        cand_w = lax.all_gather(cand_w, axis_name).reshape(-1)
-        cand_l = lax.all_gather(cand_l, axis_name).reshape(-1)
+        # replicate the per-shard candidates: each shard writes its slice
+        # of a zero buffer and psums — equivalent to all_gather over
+        # disjoint slots, but psum's output is provably axis-invariant so
+        # shard_map's varying-manual-axes check stays enabled
+        def gathered(x):
+            buf = jnp.zeros((lax.axis_size(axis_name),) + x.shape, x.dtype)
+            buf = buf.at[lax.axis_index(axis_name)].set(x)
+            return lax.psum(buf, axis_name).reshape((-1,) + x.shape[1:])
+
+        vals, cand_X = gathered(vals), gathered(cand_X)
+        cand_w, cand_l = gathered(cand_w), gathered(cand_l)
         _, order = lax.top_k(vals, min(k, vals.shape[0]))
         cand_X, cand_w, cand_l = cand_X[order], cand_w[order], cand_l[order]
     empty = counts <= 0
-    rank = jnp.where(empty, jnp.cumsum(empty) - 1, 0)
-    rank = jnp.clip(rank, 0, cand_w.shape[0] - 1)
+    rank = jnp.cumsum(empty) - 1
+    # an empty cluster beyond the candidate pool (only reachable when
+    # n_samples < n_clusters through the functional API) is left unserved —
+    # it keeps its old center — rather than double-donating a candidate
+    served = jnp.logical_and(empty, rank < cand_w.shape[0])
+    rank = jnp.clip(jnp.where(served, rank, 0), 0, cand_w.shape[0] - 1)
     pt_X = cand_X[rank]                          # (k, m)
-    pt_w = jnp.where(empty, cand_w[rank], 0.0)   # 0 masks non-empty rows
+    pt_w = jnp.where(served, cand_w[rank], 0.0)  # 0 masks unserved rows
     pt_l = cand_l[rank]
     sums = sums.at[pt_l].add(-pt_w[:, None] * pt_X)
     counts = counts.at[pt_l].add(-pt_w)
-    sums = jnp.where(empty[:, None], pt_w[:, None] * pt_X, sums)
-    counts = jnp.where(empty, pt_w, counts)
+    sums = jnp.where(served[:, None], pt_w[:, None] * pt_X, sums)
+    counts = jnp.where(served, pt_w, counts)
     return sums, counts
 
 
@@ -524,6 +536,7 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
 
     # -- fitting ------------------------------------------------------------
 
+    @with_device_scope
     def fit(self, X, y=None, sample_weight=None):
         """Compute q-means clustering (reference ``qMeans_.fit``,
         ``_dmeans.py:1211-1325``)."""
@@ -548,17 +561,23 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         quantum = delta > 0
         mu_grid = (tuple(float(p) for p in np.arange(0.0, 1.0, 0.1)) + (1.0,)
                    if quantum else ())
-        stats = fit_prestats(jnp.asarray(X), quantum=quantum, mu_grid=mu_grid)
+        # set_config(device=...) placement — except under an explicit mesh,
+        # whose sharding owns placement (committed single-device operands
+        # would conflict with the mesh's device set)
+        Xin = jnp.asarray(X) if self.mesh is not None else as_device_array(X)
+        stats = fit_prestats(Xin, quantum=quantum, mu_grid=mu_grid)
         if quantum:
             from ..ops.quantum.norms import select_mu
 
-            # fetch all scalars in one transfer
-            var_mean, eta, frob, sigma_min = np.asarray(jnp.stack(
-                [stats["var_mean"], stats["eta"], stats["frob"],
-                 stats["sigma_min"]])).astype(float)
-            self.eta_ = float(eta)
-            self.norm_mu_, self.mu_ = select_mu(mu_grid, stats["mu_vals"],
-                                                frob)
+            # fetch every host-needed scalar (incl. the μ grid) in ONE
+            # device→host transfer
+            fetched = np.asarray(jnp.concatenate([
+                jnp.stack([stats["var_mean"], stats["eta"], stats["frob"],
+                           stats["sigma_min"]]),
+                stats["mu_vals"].astype(stats["var_mean"].dtype)]))
+            var_mean, eta, frob, sigma_min = map(float, fetched[:4])
+            self.eta_ = eta
+            self.norm_mu_, self.mu_ = select_mu(mu_grid, fetched[4:], frob)
             self.condition_number_ = (
                 1.0 / sigma_min if sigma_min > 0 else np.inf)
         else:
@@ -675,6 +694,7 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
 
     # -- inference ----------------------------------------------------------
 
+    @with_device_scope
     def predict(self, X, sample_weight=None, delta=None):
         """Closest-center assignment, with optional quantum error δ.
 
@@ -686,13 +706,15 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         X = check_array(X)
         delta = 0.0 if delta is None else float(delta)
         key = as_key(self.random_state)
+        Xd = as_device_array(X)
         labels, _, _ = e_step_jit(
-            key, jnp.asarray(X), jnp.ones(X.shape[0], X.dtype),
-            jnp.asarray(self.cluster_centers_, X.dtype),
-            row_norms(jnp.asarray(X), squared=True),
+            key, Xd, jnp.ones(X.shape[0], X.dtype),
+            as_device_array(np.asarray(self.cluster_centers_, X.dtype)),
+            row_norms(Xd, squared=True),
             delta=delta, mode=self._mode(delta), ipe_q=self.ipe_q)
         return np.asarray(labels)
 
+    @with_device_scope
     def transform(self, X):
         """Distances to cluster centers (purely classical, as the reference
         warns at ``_dmeans.py:1341-1347``)."""
@@ -705,14 +727,16 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
     def fit_transform(self, X, y=None, sample_weight=None):
         return self.fit(X, sample_weight=sample_weight).transform(X)
 
+    @with_device_scope
     def score(self, X, y=None, sample_weight=None):
         """Negative inertia of X under the fitted centers (fixes the
         reference's stale-signature ``score``, ``_dmeans.py:1401-1402``)."""
         check_is_fitted(self, "cluster_centers_")
         X = check_array(X)
         sample_weight = check_sample_weight(sample_weight, X)
-        d2 = pairwise_sq_distances(jnp.asarray(X),
-                                   jnp.asarray(self.cluster_centers_, X.dtype))
+        d2 = pairwise_sq_distances(
+            as_device_array(X),
+            as_device_array(np.asarray(self.cluster_centers_, X.dtype)))
         return -float(jnp.sum(jnp.min(d2, axis=1) * jnp.asarray(sample_weight)))
 
     # -- theoretical runtime (reference runtime_comparison,
@@ -780,6 +804,7 @@ class KMeans(QKMeans):
             random_state=random_state, copy_x=copy_x, algorithm=algorithm,
             delta=None, mesh=mesh, use_pallas=use_pallas)
 
+    @with_device_scope
     def fit(self, X, y=None, sample_weight=None):
         with warnings.catch_warnings():
             warnings.filterwarnings(

@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .._config import as_device_array, with_device_scope
 from ..base import BaseEstimator, ClassifierMixin, check_is_fitted
 from ..metrics.pairwise import (
     linear_kernel,
@@ -183,6 +184,7 @@ class QLSSVC(ClassifierMixin, BaseEstimator):
 
     # -- fit ------------------------------------------------------------------
 
+    @with_device_scope
     def fit(self, X, y):
         """Fit the LS-SVM (reference ``fit``, ``_qSVM.py:133-176``).
 
@@ -192,7 +194,7 @@ class QLSSVC(ClassifierMixin, BaseEstimator):
         X, y = check_X_y(X, y)
         self.X_ = X
         self.n_features_in_ = X.shape[1]
-        Xd = jnp.asarray(X)
+        Xd = as_device_array(X)  # set_config(device=...) placement
 
         K = self.get_kernel(Xd)
         var = None
@@ -225,6 +227,7 @@ class QLSSVC(ClassifierMixin, BaseEstimator):
 
     # -- decision pieces ------------------------------------------------------
 
+    @with_device_scope
     def get_h(self, X, approx=False):
         """Decision values h(x) = α·K(X_train, x) + b for all x in one GEMM
         (reference ``get_h``, ``_qSVM.py:263-276``)."""
@@ -275,6 +278,7 @@ class QLSSVC(ClassifierMixin, BaseEstimator):
 
     # -- predict --------------------------------------------------------------
 
+    @with_device_scope
     def predict(self, X):
         """Quantum-error-model classification (reference ``predict``,
         ``_qSVM.py:178-215``): threshold the noisy P at ½ → ±1."""

@@ -38,6 +38,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .._config import as_device_array, with_device_scope
 from ..base import BaseEstimator, TransformerMixin, check_is_fitted
 from ..ops.linalg import centered_svd, randomized_svd, stable_cumsum
 from ..ops.quantum import (
@@ -186,6 +187,7 @@ class QPCA(TransformerMixin, BaseEstimator):
 
     # -- fit ----------------------------------------------------------------
 
+    @with_device_scope
     def fit(self, X, y=None, *, quantum_retained_variance=False, eps=0,
             theta_major=0, theta_minor=0, eta=0, theta_estimate=False,
             eps_theta=0, p=0, estimate_all=False, delta=0,
@@ -247,6 +249,9 @@ class QPCA(TransformerMixin, BaseEstimator):
         self.faster_measure_increment = faster_measure_increment
 
         X = check_array(X, copy=self.copy)
+        # set_config(device=...) placement: committing the input here pins
+        # every downstream jit (SVD, quantum estimators) to that device
+        X = as_device_array(X)
         self._key = as_key(self.random_state)
 
         # n_components handling (reference _qPCA.py:527-536)
@@ -698,6 +703,7 @@ class QPCA(TransformerMixin, BaseEstimator):
                 Xt = Xt / jnp.sqrt(jnp.asarray(self.estimate_fs))
         return np.asarray(Xt)
 
+    @with_device_scope
     def transform(self, X, classic_transform=True, epsilon_delta=0,
                   quantum_representation=False, norm="None", psi=0,
                   true_tomography=True, use_classical_components=True):
@@ -731,6 +737,7 @@ class QPCA(TransformerMixin, BaseEstimator):
         # is the transformed matrix
         return X_final
 
+    @with_device_scope
     def inverse_transform(self, X, use_classical_components=True):
         """Map back to feature space (reference ``_base.py:130-164``)."""
         check_is_fitted(self, "components_")
@@ -935,14 +942,17 @@ class PCA(QPCA):
     """Classical PCA: the all-quantum-flags-off path of :class:`QPCA`
     (stock ``decomposition/_pca.py`` parity surface)."""
 
+    @with_device_scope
     def fit(self, X, y=None):
         return super().fit(X)
 
+    @with_device_scope
     def transform(self, X):
         return self._project(X)
 
     def fit_transform(self, X, y=None):
         return self.fit(X).transform(X)
 
+    @with_device_scope
     def inverse_transform(self, X):
         return super().inverse_transform(X)

@@ -12,6 +12,7 @@ the equivalent here and is exact rather than iterative).
 import numpy as np
 import jax.numpy as jnp
 
+from .._config import as_device_array, with_device_scope
 from ..base import BaseEstimator, TransformerMixin, check_is_fitted
 from ..ops.linalg import randomized_svd, svd_flip, thin_svd
 from ..utils import as_key, check_array
@@ -37,6 +38,7 @@ class TruncatedSVD(TransformerMixin, BaseEstimator):
         self.fit_transform(X)
         return self
 
+    @with_device_scope
     def fit_transform(self, X, y=None):
         X = check_array(X)
         n_samples, n_features = X.shape
@@ -45,7 +47,7 @@ class TruncatedSVD(TransformerMixin, BaseEstimator):
             raise ValueError(
                 f"n_components must be in [1, n_features={n_features}) and "
                 f"<= n_samples={n_samples}; got {k}")
-        Xd = jnp.asarray(X)
+        Xd = as_device_array(X)  # set_config(device=...) placement
         if self.algorithm == "randomized":
             U, S, Vt = randomized_svd(as_key(self.random_state), Xd, k,
                                       n_iter=self.n_iter)
@@ -72,11 +74,13 @@ class TruncatedSVD(TransformerMixin, BaseEstimator):
         self.n_features_in_ = n_features
         return Xt
 
+    @with_device_scope
     def transform(self, X):
         check_is_fitted(self, "components_")
         X = check_array(X)
         return np.asarray(jnp.asarray(X) @ jnp.asarray(self.components_).T)
 
+    @with_device_scope
     def inverse_transform(self, X):
         check_is_fitted(self, "components_")
         X = check_array(X)

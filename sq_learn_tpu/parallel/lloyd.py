@@ -33,12 +33,6 @@ def _sharded_lloyd(mesh, static):
         # per-iteration history traces are replicated (P() is a pytree
         # prefix covering the history dict's leaves)
         out_specs=(P(DATA_AXIS), P(), P(), P(), P()),
-        # empty-cluster relocation builds its replicated candidate set with
-        # all_gather, whose output jax's varying-manual-axes checker cannot
-        # prove invariant (there is no to='invariant' pcast) — the values
-        # ARE device-identical (gather + identical re-ranking), so the
-        # check is disabled rather than restructured around it
-        check_vma=False,
     ))
 
 

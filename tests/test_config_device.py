@@ -1,0 +1,114 @@
+"""set_config(device=...) dispatch wiring (VERDICT round 1 weak #3).
+
+Under the conftest the process has 8 virtual CPU devices, so 'cpu:N'
+placement is observable: committed arrays land on a specific device and
+every downstream jit executes there. Parity contract: a δ=0 fit under
+device='cpu' must equal the default-placement fit bit-for-bit.
+"""
+
+import numpy as np
+import jax
+import pytest
+import sklearn.datasets
+
+from sq_learn_tpu import config_context, resolve_device
+from sq_learn_tpu._config import as_device_array
+from sq_learn_tpu.models import KMeans, QKMeans
+
+
+@pytest.fixture(scope="module")
+def blobs():
+    X, y = sklearn.datasets.make_blobs(
+        n_samples=300, centers=4, cluster_std=0.7, random_state=2)
+    return X.astype(np.float32), y
+
+
+def test_as_device_array_commits_to_configured_device():
+    cpus = jax.devices("cpu")
+    with config_context(device="cpu:3"):
+        arr = as_device_array(np.ones(8, np.float32))
+        assert arr.devices() == {cpus[3]}
+    with config_context(device="cpu"):
+        arr = as_device_array(np.ones(8, np.float32))
+        assert arr.devices() == {cpus[0]}
+
+
+def test_auto_leaves_placement_uncommitted():
+    with config_context(device="auto"):
+        arr = as_device_array(np.ones(8, np.float32))
+    # uncommitted default placement — jit may move it freely
+    assert arr.devices() == {jax.devices()[0]}
+
+
+def test_resolve_device_variants():
+    cpus = jax.devices("cpu")
+    with config_context(device="cpu:2"):
+        assert resolve_device() == cpus[2]
+    with config_context(device="cpu"):
+        assert resolve_device() == cpus[0]
+    with config_context(device="tpu"):
+        with pytest.raises(RuntimeError, match="no accelerator"):
+            resolve_device()
+    with config_context(device="cpu:99"):
+        with pytest.raises(RuntimeError, match="only"):
+            resolve_device()
+
+
+def test_set_config_rejects_bogus_device():
+    from sq_learn_tpu import set_config
+
+    for bogus in ("gpu", "auto:1", "cpu:abc", "cpu:-1", "cpu:", 3):
+        with pytest.raises(ValueError, match="device must be"):
+            set_config(device=bogus)
+
+
+def test_fit_computation_runs_on_configured_device(blobs):
+    """The committed input pins the fused prestats jit to the chosen chip."""
+    from sq_learn_tpu.models.qkmeans import fit_prestats
+
+    X, _ = blobs
+    with config_context(device="cpu:5"):
+        stats = fit_prestats(as_device_array(X))
+    assert stats["Xc"].devices() == {jax.devices("cpu")[5]}
+
+
+def test_delta_zero_fit_parity_across_devices(blobs):
+    """VERDICT task 4 'done' criterion: δ=0 fit under device='cpu' equals
+    the default-placement fit."""
+    X, _ = blobs
+    base = KMeans(n_clusters=4, n_init=2, random_state=0).fit(X)
+    with config_context(device="cpu:1"):
+        pinned = KMeans(n_clusters=4, n_init=2, random_state=0).fit(X)
+    np.testing.assert_array_equal(base.labels_, pinned.labels_)
+    np.testing.assert_allclose(base.cluster_centers_,
+                               pinned.cluster_centers_, rtol=1e-6)
+    assert base.inertia_ == pytest.approx(pinned.inertia_, rel=1e-6)
+
+
+def test_quantum_fit_works_under_pinned_device(blobs):
+    import warnings
+
+    X, y = blobs
+    from sq_learn_tpu.metrics import adjusted_rand_score
+
+    with config_context(device="cpu:2"), warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        qm = QKMeans(n_clusters=4, delta=0.5, true_distance_estimate=False,
+                     n_init=1, random_state=0).fit(X)
+    assert float(adjusted_rand_score(qm.labels_, y)) > 0.9
+
+
+def test_other_estimators_respect_device(blobs):
+    X, y = blobs
+    from sq_learn_tpu.models import QPCA, TruncatedSVD
+    from sq_learn_tpu.models.neighbors import KNeighborsClassifier
+
+    X6 = np.random.RandomState(0).randn(120, 6).astype(np.float32)
+    with config_context(device="cpu:4"):
+        pca = QPCA(n_components=2).fit(X)
+        assert pca.explained_variance_.shape == (2,)
+        tsvd = TruncatedSVD(n_components=3).fit(X6)
+        assert tsvd.components_.shape == (3, 6)
+        knn = KNeighborsClassifier(n_neighbors=3).fit(X, (y % 2))
+        assert knn.X_fit_.devices() == {jax.devices("cpu")[4]}
+        assert knn.score(X[:50], (y % 2)[:50]) > 0.5
