@@ -1,0 +1,41 @@
+# Development targets (reference: Makefile:22-27 `make inplace` + `test-code`;
+# there is no native build step here — the C++ helper builds itself on first
+# import via sq_learn_tpu/native).
+
+PYTHON ?= python
+
+.PHONY: test test-fast lint bench bench-smoke multichip all
+
+all: lint test
+
+# Full suite on the XLA CPU backend with 8 virtual devices (the conftest
+# forces this, so sharding paths run without hardware). CI gate.
+test:
+	$(PYTHON) -m pytest tests/ -q
+
+# Quick signal: the flagship-model and driver-contract tests only.
+test-fast:
+	$(PYTHON) -m pytest tests/test_qkmeans.py tests/test_pallas.py \
+	    tests/test_graft_entry.py -q
+
+# Syntax/bytecode check of every tree (no third-party linter is baked into
+# the runtime image; flake8 runs in CI where installable).
+lint:
+	$(PYTHON) -m compileall -q sq_learn_tpu tests bench examples \
+	    bench.py __graft_entry__.py
+
+# Headline benchmark (BASELINE.md config #1) — one JSON line.
+bench:
+	$(PYTHON) bench.py
+
+# All five BASELINE configs in smoke mode (tiny shapes, CPU-safe).
+bench-smoke:
+	SQ_BENCH_SMOKE=1 $(PYTHON) -m bench.bench_qpca_mnist
+	SQ_BENCH_SMOKE=1 $(PYTHON) -m bench.bench_qkmeans_mnist
+	SQ_BENCH_SMOKE=1 $(PYTHON) -m bench.bench_randomized_svd_covtype
+	SQ_BENCH_SMOKE=1 $(PYTHON) -m bench.bench_qkmeans_cicids_sweep
+
+# The driver's multichip gate, runnable locally.
+multichip:
+	$(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8); \
+	    print('dryrun_multichip(8) ok')"

@@ -35,12 +35,58 @@ from ..utils import as_key, check_array, check_sample_weight
 from .qkmeans import e_step, kmeans_plusplus, tolerance
 
 
-def minibatch_step(key, Xb, wb, centers, counts, *, delta, mode, ipe_q):
+def _random_reassign(key, Xb, wb, centers, counts, step_idx,
+                     reassignment_ratio):
+    """Low-count center reassignment (reference ``_mini_batch_step``,
+    ``_dmeans.py:1590-1618``): every ``(step+1) % (10 + min_count) == 0``
+    steps (the cadence at ``_dmeans.py:2086-2087``), centers whose
+    accumulated weight is below ``reassignment_ratio · max(counts)`` jump to
+    uniformly-drawn batch rows — capped at half the batch — and their counts
+    reset to the smallest non-reassigned count ("don't reset them too small
+    to avoid instant reassignment", ``_dmeans.py:1615-1618``).
+
+    Fully traced: the trigger is a data-dependent mask, not Python control
+    flow, so the whole schedule lives inside the scanned kernel.
+    """
+    k = centers.shape[0]
+    b = Xb.shape[0]
+    due = ((step_idx + 1)
+           % (10 + jnp.floor(jnp.min(counts)).astype(jnp.int32))) == 0
+    low = counts < reassignment_ratio * jnp.max(counts)
+    # cap at .5·batch: keep the highest-count centers (reference :1595-1598)
+    rank = jnp.empty_like(counts, jnp.int32).at[jnp.argsort(counts)].set(
+        jnp.arange(k, dtype=jnp.int32))
+    low = jnp.logical_and(low, rank < jnp.int32(0.5 * b))
+    low = jnp.logical_and(low, due)
+    # uniform draw among real (weight > 0) batch rows, without replacement
+    n_pick = min(k, b)
+    p = (wb > 0).astype(Xb.dtype)
+    picks = jax.random.choice(key, b, (n_pick,), replace=False,
+                              p=p / jnp.maximum(jnp.sum(p), 1.0))
+    order = jnp.cumsum(low) - 1
+    served = jnp.logical_and(low, order < n_pick)
+    # fewer positive-weight rows than picks (heavily masked or padded
+    # batches) ties the -inf Gumbels and returns weight-0 rows — a center
+    # must never teleport onto one, so those picks serve nobody
+    served = jnp.logical_and(
+        served, wb[picks[jnp.clip(order, 0, n_pick - 1)]] > 0)
+    rows = Xb[picks[jnp.clip(order, 0, n_pick - 1)]]
+    keep_min = jnp.min(jnp.where(low, jnp.inf, counts))
+    keep_min = jnp.where(jnp.isfinite(keep_min), keep_min, jnp.max(counts))
+    centers = jnp.where(served[:, None], rows, centers)
+    counts = jnp.where(served, keep_min, counts)
+    return centers, counts
+
+
+def minibatch_step(key, Xb, wb, centers, counts, step_idx=0, *, delta, mode,
+                   ipe_q, reassignment_ratio=0.0):
     """One streaming update from batch ``Xb``.
 
     Returns (new_centers, new_counts, batch_inertia). ``wb`` carries sample
-    weights and masks padded rows with 0.
+    weights and masks padded rows with 0. ``step_idx`` drives the periodic
+    low-count reassignment schedule when ``reassignment_ratio`` > 0.
     """
+    key, kr = jax.random.split(key)
     xsq = row_norms(Xb, squared=True)
     labels, inertia, _ = e_step(key, Xb, wb, centers, xsq,
                                 delta=delta, mode=mode, ipe_q=ipe_q)
@@ -54,28 +100,37 @@ def minibatch_step(key, Xb, wb, centers, counts, *, delta, mode, ipe_q):
     safe = jnp.where(new_counts > 0, new_counts, 1.0)
     step = (batch_sums - batch_counts[:, None] * centers) / safe[:, None]
     new_centers = jnp.where((batch_counts > 0)[:, None], centers + step, centers)
+    if reassignment_ratio > 0:
+        new_centers, new_counts = _random_reassign(
+            kr, Xb, wb, new_centers, new_counts, step_idx,
+            reassignment_ratio)
     return new_centers, new_counts, inertia
 
 
 minibatch_step_jit = jax.jit(
-    minibatch_step, static_argnames=("delta", "mode", "ipe_q"))
+    minibatch_step,
+    static_argnames=("delta", "mode", "ipe_q", "reassignment_ratio"))
 
 
-@functools.partial(jax.jit, static_argnames=("delta", "mode", "ipe_q"))
-def _epoch_scan(key, batches, wbatches, centers, counts, delta, mode, ipe_q):
+@functools.partial(
+    jax.jit,
+    static_argnames=("delta", "mode", "ipe_q", "reassignment_ratio"))
+def _epoch_scan(key, batches, wbatches, centers, counts, step0, delta, mode,
+                ipe_q, reassignment_ratio=0.0):
     """scan the streaming update over a (n_batches, b, m) batch stack."""
 
     def body(carry, xs):
-        centers, counts = carry
+        centers, counts, step_idx = carry
         kb, Xb, wb = xs
         centers, counts, inertia = minibatch_step(
-            kb, Xb, wb, centers, counts, delta=delta, mode=mode, ipe_q=ipe_q)
-        return (centers, counts), inertia
+            kb, Xb, wb, centers, counts, step_idx, delta=delta, mode=mode,
+            ipe_q=ipe_q, reassignment_ratio=reassignment_ratio)
+        return (centers, counts, step_idx + 1), inertia
 
     keys = jax.random.split(key, batches.shape[0])
-    (centers, counts), inertias = lax.scan(
-        body, (centers, counts), (keys, batches, wbatches))
-    return centers, counts, inertias
+    (centers, counts, step), inertias = lax.scan(
+        body, (centers, counts, step0), (keys, batches, wbatches))
+    return centers, counts, step, inertias
 
 
 class MiniBatchQKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
@@ -84,7 +139,14 @@ class MiniBatchQKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
 
     ``delta`` selects the quantum error model exactly as in
     :class:`~sq_learn_tpu.models.qkmeans.QKMeans`; δ=0 is classical
-    mini-batch k-means (Sculley 2010).
+    mini-batch k-means (Sculley 2010). ``reassignment_ratio`` periodically
+    teleports centers whose accumulated weight fell below that fraction of
+    the max to random batch rows (reference ``_dmeans.py:1590-1618``).
+
+    Dense-only by design: the reference's CSR streaming kernel
+    (``_k_means_fast.pyx:291``) exists for CPU cache efficiency on sparse
+    text workloads; on TPU, sparse gathers defeat the MXU and the dense
+    batch GEMM is the idiomatic equivalent (see docs/design.md non-goals).
     """
 
     def __init__(self, n_clusters=8, *, init="k-means++", max_iter=100,
@@ -174,16 +236,18 @@ class MiniBatchQKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         for _ in range(max(1, self.n_init)):
             key, ki, kf = jax.random.split(key, 3)
             centers, counts = self._init_state(ki, X, sample_weight)
-            centers, counts, n_iter, ewa = self._fit_loop(
+            centers, counts, n_iter, n_steps, ewa = self._fit_loop(
                 kf, X, sample_weight, centers, counts, delta, mode, tol_)
-            if best is None or ewa < best[3]:
-                best = (centers, counts, n_iter, ewa)
-        centers, counts, n_iter, _ = best
+            if best is None or ewa < best[4]:
+                best = (centers, counts, n_iter, n_steps, ewa)
+        centers, counts, n_iter, n_steps, _ = best
 
         self.cluster_centers_ = np.asarray(centers)
         self.counts_ = np.asarray(counts)
+        # n_iter_ counts full epochs; n_steps_ counts minibatches (sklearn
+        # semantics) and seeds partial_fit's reassignment cadence
         self.n_iter_ = int(n_iter)
-        self.n_steps_ = int(n_iter)
+        self.n_steps_ = int(n_steps)
         labels, inertia = self._full_assign(X, sample_weight)
         self.labels_ = labels
         self.inertia_ = inertia
@@ -201,11 +265,13 @@ class MiniBatchQKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         best_ewa = np.inf
         prev_centers = None
         it = 0
+        step = jnp.asarray(0)
         for epoch in range(self.max_iter):
             key, ks, ke = jax.random.split(key, 3)
             Xs, ws = self._batch_stack(ks, X, sample_weight)
-            centers, counts, inertias = _epoch_scan(
-                ke, Xs, ws, centers, counts, delta, mode, self.ipe_q)
+            centers, counts, step, inertias = _epoch_scan(
+                ke, Xs, ws, centers, counts, step, delta, mode, self.ipe_q,
+                float(self.reassignment_ratio))
             it = epoch + 1
             for bi in np.asarray(inertias):
                 ewa = bi if ewa is None else ewa * (1 - alpha) + bi * alpha
@@ -224,7 +290,8 @@ class MiniBatchQKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
                 if shift <= tol_:
                     break
             prev_centers = centers
-        return centers, counts, it, float(ewa if ewa is not None else np.inf)
+        return (centers, counts, it, int(step),
+                float(ewa if ewa is not None else np.inf))
 
     @with_device_scope
     def partial_fit(self, X, y=None, sample_weight=None):
@@ -246,7 +313,9 @@ class MiniBatchQKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
             counts = jnp.asarray(self.counts_, X.dtype)
         centers, counts, inertia = minibatch_step_jit(
             kb, as_device_array(X), jnp.asarray(sample_weight, X.dtype),
-            centers, counts, delta=delta, mode=mode, ipe_q=self.ipe_q)
+            centers, counts, jnp.asarray(getattr(self, "n_steps_", 0)),
+            delta=delta, mode=mode, ipe_q=self.ipe_q,
+            reassignment_ratio=float(self.reassignment_ratio))
         self.cluster_centers_ = np.asarray(centers)
         self.counts_ = np.asarray(counts)
         self.inertia_ = float(inertia)
@@ -303,7 +372,6 @@ class MiniBatchKMeans(MiniBatchQKMeans):
             random_state=random_state,
             reassignment_ratio=reassignment_ratio, delta=None)
 
-    @with_device_scope
     def fit(self, X, y=None, sample_weight=None):
         with warnings.catch_warnings():
             warnings.filterwarnings(

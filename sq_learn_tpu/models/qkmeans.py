@@ -804,7 +804,6 @@ class KMeans(QKMeans):
             random_state=random_state, copy_x=copy_x, algorithm=algorithm,
             delta=None, mesh=mesh, use_pallas=use_pallas)
 
-    @with_device_scope
     def fit(self, X, y=None, sample_weight=None):
         with warnings.catch_warnings():
             warnings.filterwarnings(

@@ -86,47 +86,65 @@ def singular_value_estimates(key, singular_values, scale_norm, eps_scaled,
 
 
 def _assess_dimension(spectrum, rank, n_samples):
-    """Log-likelihood of a given PCA rank under Minka's Bayesian model
-    ("Automatic Choice of Dimensionality for PCA", NIPS 2000) — the stock
-    estimator the reference carries at ``_qPCA.py:30-98``.
+    """Log-evidence of PCA rank ``q`` under Minka's Laplace approximation
+    ("Automatic Choice of Dimensionality for PCA", NIPS 2000, eq. 77),
+    assembled from the five standard pieces: the Stiefel-manifold prior
+    ln p(U) = −q·ln2 + Σᵢ[lnΓ((p−i+1)/2) − ((p−i+1)/2)·lnπ]; the retained
+    log-likelihood −(N/2)·Σᵢ≤q ln λᵢ; the tail term −(N(p−q)/2)·ln v̄ with
+    v̄ the mean discarded eigenvalue; the parameter-count term
+    ((m+q)/2)·ln 2π with m = pq − q(q+1)/2; and −½·Σᵢ≤q Σⱼ>ᵢ
+    ln[N·(λᵢ−λⱼ)(λ̃ⱼ⁻¹−λ̃ᵢ⁻¹)] from the Hessian determinant — raw
+    eigenvalue gaps with curvatures from λ̃, the spectrum whose discarded
+    tail is collapsed to v̄; minus the q·lnN/2 volume factor.
+
+    Same estimator the reference carries (``_qPCA.py:30-98``), re-derived
+    from the paper with the O(q·p) Hessian double loop vectorized into one
+    masked (q, p) outer difference.
     """
     from scipy.special import gammaln
 
-    spectrum = np.asarray(spectrum, dtype=np.float64)
-    n_features = spectrum.shape[0]
-    if not 1 <= rank < n_features:
+    lam = np.asarray(spectrum, dtype=np.float64)
+    p = lam.shape[0]
+    q = int(rank)
+    if not 1 <= q < p:
         raise ValueError("the tested rank should be in [1, n_features - 1]")
     eps = 1e-15
-    if spectrum[rank - 1] < eps:
+    if lam[q - 1] < eps:
+        # a retained eigenvalue is numerically zero: this rank explains no
+        # more variance than a smaller one — never the argmax
         return -np.inf
-    pu = -rank * math.log(2.0)
-    for i in range(1, rank + 1):
-        pu += gammaln((n_features - i + 1) / 2.0) - math.log(math.pi) * (
-            n_features - i + 1
-        ) / 2.0
-    pl = np.sum(np.log(spectrum[:rank]))
-    pl = -pl * n_samples / 2.0
-    v = max(eps, np.sum(spectrum[rank:]) / (n_features - rank))
-    pv = -math.log(v) * n_samples * (n_features - rank) / 2.0
-    m = n_features * rank - rank * (rank + 1.0) / 2.0
-    pp = math.log(2.0 * math.pi) * (m + rank) / 2.0
-    pa = 0.0
-    spectrum_ = spectrum.copy()
-    spectrum_[rank:n_features] = v
-    for i in range(rank):
-        for j in range(i + 1, len(spectrum)):
-            pa += math.log(
-                (spectrum[i] - spectrum[j])
-                * (1.0 / spectrum_[j] - 1.0 / spectrum_[i])
-            ) + math.log(n_samples)
-    return pu + pl + pv + pp - pa / 2.0 - rank * math.log(n_samples) / 2.0
+    N = float(n_samples)
+
+    sizes = p - np.arange(1, q + 1) + 1                  # p−i+1 for i=1..q
+    log_p_u = -q * math.log(2.0) + np.sum(
+        gammaln(sizes / 2.0) - (sizes / 2.0) * math.log(math.pi))
+
+    log_lik_kept = -0.5 * N * np.sum(np.log(lam[:q]))
+    v_bar = max(eps, lam[q:].sum() / (p - q))
+    log_lik_tail = -0.5 * N * (p - q) * math.log(v_bar)
+
+    n_free = p * q - q * (q + 1) / 2.0
+    log_param_vol = 0.5 * (n_free + q) * math.log(2.0 * math.pi)
+
+    # Hessian log-determinant: masked outer product over pairs i<j with the
+    # discarded tail collapsed to v̄
+    lam_t = np.where(np.arange(p) < q, lam, v_bar)       # λ̃ (p,)
+    gaps = lam[:q, None] - lam[None, :]                  # λᵢ − λⱼ (raw)
+    curv = 1.0 / lam_t[None, :] - 1.0 / lam_t[:q, None]  # λ̃ⱼ⁻¹ − λ̃ᵢ⁻¹
+    pair = np.arange(p)[None, :] > np.arange(q)[:, None]
+    log_hess = np.sum(np.where(pair, np.log(gaps * curv * N,
+                                            where=pair,
+                                            out=np.zeros_like(gaps)), 0.0))
+
+    return (log_p_u + log_lik_kept + log_lik_tail + log_param_vol
+            - 0.5 * log_hess - 0.5 * q * math.log(N))
 
 
 def _infer_dimension(spectrum, n_samples):
-    """MLE rank = argmax of Minka's log-likelihood over candidate ranks
-    (reference ``_infer_dimension``, ``_qPCA.py:101-110``)."""
-    ll = np.empty_like(spectrum, dtype=np.float64)
-    ll[0] = -np.inf  # rank 0 is never selected
+    """MLE rank = argmax of Minka's log-evidence over candidate ranks
+    (reference ``_infer_dimension``, ``_qPCA.py:101-110``; rank 0 is never
+    selected)."""
+    ll = np.full(spectrum.shape[0], -np.inf)
     for rank in range(1, spectrum.shape[0]):
         ll[rank] = _assess_dimension(spectrum, rank, n_samples)
     return int(ll.argmax())
@@ -529,13 +547,18 @@ class QPCA(TransformerMixin, BaseEstimator):
         ``est_cond_number``. Here the bracket genuinely encloses σ_min:
         zero estimated mass below τ raises the lower bound.
 
+        The bracket runs over the FULL spectrum (``all_singular_values_``),
+        not the retained top-n_components slice — with small n_components
+        the latter would yield the condition number of the retained
+        subspace, not of A.
+
         Returns (σ̂_min, κ̂). ε = 0 short-circuits to the exact values.
         """
         if epsilon == 0:
-            sigma_min = float(self.singular_values_[-1])
+            sigma_min = float(self.all_singular_values_[-1])
             return sigma_min, (self.spectral_norm / sigma_min
                                if sigma_min > 0 else np.inf)
-        S = jnp.asarray(self.singular_values_)
+        S = jnp.asarray(self.all_singular_values_)
         frob = self.frob_norm
         lo, hi = 0.0, 1.0
         n_iterations = max(1, int(np.ceil(np.log(frob / epsilon))))
@@ -942,7 +965,6 @@ class PCA(QPCA):
     """Classical PCA: the all-quantum-flags-off path of :class:`QPCA`
     (stock ``decomposition/_pca.py`` parity surface)."""
 
-    @with_device_scope
     def fit(self, X, y=None):
         return super().fit(X)
 

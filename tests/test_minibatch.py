@@ -2,6 +2,8 @@
 ``_dmeans.py:1587``; minibatch-vs-batch consistency pattern from
 ``cluster/tests/test_k_means.py:176``)."""
 
+import warnings
+
 import numpy as np
 import pytest
 
@@ -74,3 +76,69 @@ def test_batch_padding_zero_weight():
     mb = MiniBatchKMeans(n_clusters=3, batch_size=64, max_iter=20,
                          n_init=2, random_state=0).fit(X)
     assert adjusted_rand_score(y, mb.labels_) > 0.95
+
+
+class TestReassignment:
+    def test_low_count_center_teleports(self):
+        """_random_reassign (reference _dmeans.py:1590-1618): a center with
+        near-zero accumulated weight jumps to a batch row and its count
+        resets to the smallest surviving count."""
+        import jax
+        import jax.numpy as jnp
+
+        from sq_learn_tpu.models.minibatch import _random_reassign
+
+        rng = np.random.RandomState(0)
+        Xb = jnp.asarray(rng.randn(64, 3).astype(np.float32))
+        wb = jnp.ones(64, jnp.float32)
+        centers = jnp.asarray(np.vstack([np.zeros(3), np.ones(3) * 100,
+                                         np.ones(3) * 5, -np.ones(3)]))
+        counts = jnp.asarray([200.0, 0.5, 150.0, 120.0])
+        # min count 0.5 → floor 0 → cadence modulo 10 → step_idx=9 fires
+        c2, n2 = _random_reassign(jax.random.PRNGKey(0), Xb, wb, centers,
+                                  counts, jnp.asarray(9), 0.01)
+        moved = np.asarray(c2[1])
+        assert not np.allclose(moved, np.asarray(centers[1]))
+        # the new center is an actual batch row
+        assert np.min(np.abs(np.asarray(Xb) - moved).sum(axis=1)) < 1e-5
+        assert float(n2[1]) == pytest.approx(120.0)  # min surviving count
+        # non-low centers untouched
+        np.testing.assert_allclose(np.asarray(c2[0]), np.asarray(centers[0]))
+        np.testing.assert_allclose(np.asarray(n2)[[0, 2, 3]],
+                                   [200.0, 150.0, 120.0])
+
+    def test_not_due_is_noop(self):
+        import jax
+        import jax.numpy as jnp
+
+        from sq_learn_tpu.models.minibatch import _random_reassign
+
+        Xb = jnp.asarray(np.random.RandomState(1).randn(32, 3).astype(
+            np.float32))
+        wb = jnp.ones(32, jnp.float32)
+        centers = jnp.asarray(np.random.RandomState(2).randn(4, 3).astype(
+            np.float32))
+        counts = jnp.asarray([200.0, 0.5, 150.0, 120.0])
+        c2, n2 = _random_reassign(jax.random.PRNGKey(0), Xb, wb, centers,
+                                  counts, jnp.asarray(3), 0.01)
+        np.testing.assert_allclose(np.asarray(c2), np.asarray(centers))
+        np.testing.assert_allclose(np.asarray(n2), np.asarray(counts))
+
+    def test_dead_center_recovers_in_fit(self):
+        """A center initialized on a far outlier (never wins a point after
+        the blobs dominate) gets reassigned during fit instead of staying
+        dead, so all clusters end up used."""
+        X, y = make_blobs(n_samples=600, centers=3, n_features=4,
+                          cluster_std=0.4, random_state=11)
+        X = np.vstack([X, np.full((1, 4), 1e3)]).astype(np.float32)
+        w = np.ones(601, np.float32)
+        w[-1] = 0.0  # the outlier row itself carries no weight
+        init = np.vstack([X[:3], X[-1:]]).astype(np.float32)  # 4th center dead
+        mb = MiniBatchQKMeans(n_clusters=4, init=init, batch_size=128,
+                              max_iter=30, n_init=1, random_state=0,
+                              reassignment_ratio=0.05)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            mb.fit(X, sample_weight=w)
+        # the dead center must have left the outlier
+        assert np.abs(mb.cluster_centers_).max() < 100.0

@@ -143,9 +143,10 @@ class TestQuantumEstimators:
     def test_condition_number_estimation(self, data):
         pca = QPCA(random_state=0).fit(
             data, condition_number_est=True, eps=0.1, delta=0.001, p=0.999)
-        # the estimator brackets the smallest *retained* singular value;
-        # binary search bracket width limits precision
-        sigma_min = pca.singular_values_[-1]
+        # the estimator brackets the genuine smallest singular value of A
+        # (the full spectrum, not the retained slice); binary search
+        # bracket width limits precision
+        sigma_min = pca.all_singular_values_[-1]
         assert pca.est_sigma_min == pytest.approx(sigma_min, rel=1.0)
         assert pca.est_cond_number == pytest.approx(
             pca.spectral_norm / pca.est_sigma_min)
@@ -322,7 +323,7 @@ class TestValidation:
             eps=0, delta=0)
         assert pca.est_spectral_norm == pca.spectral_norm
         assert pca.est_sigma_min == pytest.approx(
-            float(pca.singular_values_[-1]))
+            float(pca.all_singular_values_[-1]))
 
 
 def test_fit_transform_forwards_quantum_kwargs():
