@@ -132,9 +132,18 @@ def _assess_dimension(spectrum, rank, n_samples):
     gaps = lam[:q, None] - lam[None, :]                  # λᵢ − λⱼ (raw)
     curv = 1.0 / lam_t[None, :] - 1.0 / lam_t[:q, None]  # λ̃ⱼ⁻¹ − λ̃ᵢ⁻¹
     pair = np.arange(p)[None, :] > np.arange(q)[:, None]
-    log_hess = np.sum(np.where(pair, np.log(gaps * curv * N,
-                                            where=pair,
-                                            out=np.zeros_like(gaps)), 0.0))
+    prods = gaps * curv * N
+    if np.any(prods[pair] <= 0):
+        # an exactly tied pair zeroes a Hessian curvature and the Laplace
+        # approximation diverges (the evidence integral is +∞); fail loudly
+        # instead of returning a corrupt argmax — upstream sklearn dies here
+        # with an opaque `math domain error`
+        raise ValueError(
+            "Minka's MLE log-evidence is undefined for spectra with exactly "
+            "tied eigenvalues; perturb the data or pass an explicit "
+            "n_components instead of 'mle'")
+    log_hess = np.sum(np.where(pair, np.log(prods, where=pair,
+                                            out=np.zeros_like(prods)), 0.0))
 
     return (log_p_u + log_lik_kept + log_lik_tail + log_param_vol
             - 0.5 * log_hess - 0.5 * q * math.log(N))

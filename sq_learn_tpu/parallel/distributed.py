@@ -67,8 +67,15 @@ def host_shard_bounds(n_rows):
     shard shapes on the data axis, so tail hosts MUST pad, not just load
     fewer rows. With the zero weights the padded rows contribute nothing
     to any reduction.
+
+    ``per`` is additionally rounded up to a multiple of this host's local
+    device count so the resulting global axis (process_count · per) tiles
+    evenly over every device of the global mesh (device counts are uniform
+    across hosts on any sane deployment; a ``NamedSharding`` over the data
+    axis requires exact divisibility).
     """
-    p, np_, _ = process_info()
+    p, np_, local = process_info()
     per = -(-n_rows // np_)
+    per = -(-per // local) * local
     lo = min(p * per, n_rows)
     return lo, min(lo + per, n_rows), per

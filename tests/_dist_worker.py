@@ -1,0 +1,79 @@
+"""Worker for the simulated multi-process distributed test.
+
+Launched (twice) by tests/test_distributed_multiprocess.py with::
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=2 \
+        python tests/_dist_worker.py <process_id> <num_processes> <port>
+
+Each process contributes 2 virtual CPU devices; jax.distributed glues them
+into one 4-device global runtime over a localhost coordinator — the DCN
+story of docs/design.md exercised without a pod.
+"""
+
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+sys.path.insert(0, sys.argv[4])  # repo root
+
+from sq_learn_tpu.parallel import distributed as dist  # noqa: E402
+from sq_learn_tpu.parallel.mesh import DATA_AXIS  # noqa: E402
+
+
+def main():
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    dist.initialize(coordinator_address=f"localhost:{port}",
+                    num_processes=nproc, process_id=pid)
+    # idempotency: a second initialize must be a no-op, not a crash
+    dist.initialize(coordinator_address=f"localhost:{port}",
+                    num_processes=nproc, process_id=pid)
+
+    p, np_, local = dist.process_info()
+    assert (p, np_) == (pid, nproc), (p, np_)
+    assert local == 2, local
+    mesh = dist.global_mesh()
+    assert mesh.devices.size == 2 * nproc, mesh
+
+    # global dataset of 37 rows (not divisible): every host materializes the
+    # same array, loads only its own shard bounds, pads to the uniform
+    # per-host size with zero weights
+    n, m = 37, 5
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(n, m)).astype(np.float32)
+    lo, hi, per = dist.host_shard_bounds(n)
+    shard = np.zeros((per, m), np.float32)
+    shard[: hi - lo] = X[lo:hi]
+    w = np.zeros((per,), np.float32)
+    w[: hi - lo] = 1.0
+
+    sharding = NamedSharding(mesh, P(DATA_AXIS))
+    Xg = jax.make_array_from_process_local_data(sharding, shard)
+    wg = jax.make_array_from_process_local_data(sharding, w)
+
+    # weighted global column sums via one sharded reduction across DCN
+    @jax.jit
+    def weighted_colsum(Xg, wg):
+        return jnp.sum(Xg * wg[:, None], axis=0)
+
+    got = np.asarray(weighted_colsum(Xg, wg))
+    want = X.sum(axis=0)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    # an explicit psum inside shard_map over the global mesh
+    from jax import shard_map
+
+    @jax.jit
+    def total_weight(wg):
+        return shard_map(
+            lambda w: jax.lax.psum(jnp.sum(w), DATA_AXIS),
+            mesh=mesh, in_specs=P(DATA_AXIS), out_specs=P())(wg)
+
+    assert float(total_weight(wg)) == n, float(total_weight(wg))
+    print(f"worker {pid} OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -343,3 +343,13 @@ def test_fit_transform_forwards_quantum_kwargs():
     # classical default path still works
     Xt2 = QPCA(n_components=4, random_state=0).fit_transform(X)
     assert Xt2.shape == (200, 4)
+
+
+def test_mle_tied_eigenvalues_raise_loudly():
+    """Exactly tied eigenvalues make the Laplace evidence diverge; the
+    estimator must fail with a clear message, not pick a corrupt rank."""
+    from sq_learn_tpu.models.qpca import _assess_dimension
+
+    spec = np.array([5.0, 5.0, 2.0, 1.0, 0.5])
+    with pytest.raises(ValueError, match="tied eigenvalues"):
+        _assess_dimension(spec, 2, 100)
