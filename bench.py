@@ -19,7 +19,7 @@ import numpy as np
 warnings.filterwarnings("ignore")
 
 
-def probe_backend(timeout_s=240):
+def probe_backend(timeout_s=120):
     """Initialize the configured JAX backend in a throwaway subprocess.
 
     A wedged accelerator tunnel can hang ``jax.devices()`` indefinitely;
@@ -61,20 +61,19 @@ def main():
     X, y = load_digits_data()
     k, n_init, max_iter, seed = 10, 10, 300, 0
 
-    import jax
     from sq_learn_tpu.models import QKMeans
 
     est = QKMeans(n_clusters=k, n_init=n_init, max_iter=max_iter,
                   delta=0.5, true_distance_estimate=False,  # delta-means mode
                   random_state=seed)
     est.fit(X)  # warm-up: compile + first run
-    t0 = time.perf_counter()
-    est.fit(X)
-    jax.block_until_ready(jax.device_put(0))
-    ours = time.perf_counter() - t0
+    # fit materializes NumPy outputs (labels_, cluster_centers_), so
+    # wall-clock needs no extra device sync; min-of-3 suppresses host noise
+    ours = min(_timed(est.fit, X) for _ in range(3))
 
     sk_time = None
-    ari_vs_sklearn = None
+    ari = None
+    inertia_ratio = None
     try:
         from sklearn.cluster import KMeans as SKKMeans
         from sklearn.metrics import adjusted_rand_score
@@ -82,10 +81,18 @@ def main():
         sk = SKKMeans(n_clusters=k, n_init=n_init, max_iter=max_iter,
                       random_state=seed)
         sk.fit(X)  # warm-up caches
-        t0 = time.perf_counter()
-        sk.fit(X)
-        sk_time = time.perf_counter() - t0
-        ari_vs_sklearn = float(adjusted_rand_score(sk.labels_, est.labels_))
+        sk_time = min(_timed(sk.fit, X) for _ in range(3))
+        inertia_ratio = float(est.inertia_ / sk.inertia_)
+        # ARI between two independently-seeded k-means runs is local-optimum
+        # noise (sklearn seed-to-seed spans ~0.96-0.98 on digits); report
+        # the median over 3 of our seeds against the fixed sklearn fit
+        aris = [float(adjusted_rand_score(sk.labels_, est.labels_))]
+        for s in (1, 2):  # seed 0 is the timed fit above — reuse its labels
+            q = QKMeans(n_clusters=k, n_init=n_init, max_iter=max_iter,
+                        delta=0.5, true_distance_estimate=False,
+                        random_state=s).fit(X)
+            aris.append(float(adjusted_rand_score(sk.labels_, q.labels_)))
+        ari = sorted(aris)[1]
     except Exception as exc:  # sklearn missing: report absolute time only
         print(f"# sklearn baseline unavailable: {exc}", file=sys.stderr)
 
@@ -95,10 +102,18 @@ def main():
         "unit": "s",
         "vs_baseline": round(sk_time / ours, 3) if sk_time else 1.0,
     }
-    if ari_vs_sklearn is not None:
-        print(f"# sklearn={sk_time:.4f}s ARI(ours,sklearn)={ari_vs_sklearn:.3f}",
-              file=sys.stderr)
+    if ari is not None:
+        result["ari_vs_sklearn_median3"] = round(ari, 3)
+        result["inertia_vs_sklearn"] = round(inertia_ratio, 5)
+        print(f"# sklearn={sk_time:.4f}s ARI(median over 3 seeds)={ari:.3f} "
+              f"inertia ratio={inertia_ratio:.5f}", file=sys.stderr)
     print(json.dumps(result))
+
+
+def _timed(fn, *args):
+    t0 = time.perf_counter()
+    fn(*args)
+    return time.perf_counter() - t0
 
 
 if __name__ == "__main__":

@@ -357,6 +357,101 @@ def kmeans_plusplus(key, X, x_sq_norms, n_clusters, n_local_trials=None,
     return centers, indices
 
 
+# ---------------------------------------------------------------------------
+# Native host fast path (CPU backend)
+# ---------------------------------------------------------------------------
+
+
+def _kmeans_plusplus_np(rng, Xn, x_sq, k, weights):
+    """NumPy twin of :func:`kmeans_plusplus` (greedy best-of-trials D²
+    sampling) for the native host path."""
+    n = Xn.shape[0]
+    n_trials = 2 + int(math.log(k))
+    first = int(rng.choice(n, p=weights / weights.sum()))
+    centers = np.empty((k, Xn.shape[1]), np.float32)
+    centers[0] = Xn[first]
+    closest = np.maximum(x_sq + x_sq[first] - 2.0 * (Xn @ Xn[first]), 0.0)
+    for c in range(1, k):
+        pot = closest * weights
+        cand = np.searchsorted(np.cumsum(pot), rng.random(n_trials) * pot.sum())
+        cand = np.clip(cand, 0, n - 1)
+        d2c = np.maximum(
+            x_sq[None, :] + x_sq[cand][:, None] - 2.0 * (Xn[cand] @ Xn.T), 0.0)
+        newc = np.minimum(closest[None, :], d2c)
+        best = int(np.argmin((newc * weights[None, :]).sum(axis=1)))
+        closest = newc[best]
+        centers[c] = Xn[cand[best]]
+    return centers
+
+
+def _relocate_empty_np(Xn, wn, labels, min_d2, sums, counts):
+    """NumPy twin of :func:`relocate_empty_clusters` for the host path."""
+    empty = np.flatnonzero(counts <= 0)
+    if empty.size == 0:
+        return sums, counts
+    score = np.where(wn > 0, min_d2, -np.inf)
+    far = np.argsort(-score)[: len(empty)]
+    for c_idx, p_idx in zip(empty, far):
+        if score[p_idx] == -np.inf:
+            continue  # no candidate left — keep the old center
+        donor, wp = labels[p_idx], wn[p_idx]
+        sums[donor] -= wp * Xn[p_idx]
+        counts[donor] -= wp
+        sums[c_idx] = wp * Xn[p_idx]
+        counts[c_idx] = wp
+    return sums, counts
+
+
+def _native_lloyd_run(rng, Xn, wn, xsq, centers0, *, window, max_iter, tol,
+                      patience, use_cpp):
+    """One full q-means run on the host — the twin of :func:`lloyd_single`
+    with identical stopping semantics (shift ≤ tol, best-inertia plateau),
+    empty-cluster relocation, and history traces. The E+M step is either
+    the threaded C++ kernel (:func:`sq_learn_tpu.native.lloyd_iter_window`,
+    the reference's Cython-kernel role, ``cluster/_k_means_lloyd.pyx:29``)
+    on many-core hosts, or a BLAS sgemm step where few cores make BLAS the
+    faster engine."""
+    from .. import native
+
+    def step(centers):
+        if use_cpp:
+            seed = int(rng.integers(0, 2**63 - 1))
+            return native.lloyd_iter_window(
+                Xn, centers, sample_weight=wn, window=window, seed=seed)
+        return native.host_lloyd_step(rng, Xn, wn, xsq, centers, window)
+
+    centers = np.ascontiguousarray(centers0, np.float32)
+    best_inertia, best_centers, best_it = np.inf, centers, 0
+    inertia_tr = np.full(max_iter, np.nan, np.float32)
+    shift_tr = np.full(max_iter, np.nan, np.float32)
+    it = 0
+    while it < max_iter:
+        labels, min_d2, sums, counts, inertia = step(centers)
+        sums, counts = _relocate_empty_np(Xn, wn, labels, min_d2, sums,
+                                          counts)
+        safe = np.where(counts > 0, counts, 1.0)
+        new_centers = np.where((counts > 0)[:, None], sums / safe[:, None],
+                               centers).astype(np.float32)
+        if inertia < best_inertia:
+            best_inertia, best_centers, best_it = inertia, centers, it
+        shift = float(((new_centers - centers) ** 2).sum())
+        inertia_tr[it], shift_tr[it] = inertia, shift
+        centers = new_centers
+        it += 1
+        if shift <= tol:
+            break
+        if patience is not None and it - best_it > patience:
+            break
+    # consistent final triple: better of (last centers, best centers)
+    outs = []
+    for cand in (centers, best_centers):
+        labels, _, _, _, inertia = step(cand)
+        outs.append((labels, inertia, cand))
+    labels, inertia, out_centers = min(outs, key=lambda t: t[1])
+    history = {"inertia": inertia_tr, "center_shift": shift_tr}
+    return labels, np.float32(inertia), out_centers, it, history
+
+
 # jit'd entry for a full single run — static over everything that changes
 # the compiled program (tol is traced: it is data-dependent and only feeds a
 # scalar comparison, so it must not trigger recompiles)
@@ -627,10 +722,12 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
 
     def _resolved_patience(self, mode):
         """'auto' enables the best-inertia plateau rule only where the
-        classical shift≤tol rule cannot fire (noisy fits)."""
+        classical shift≤tol rule cannot fire (noisy fits). The default of
+        10 stale iterations follows sklearn's ``max_no_improvement=10``
+        convention for noisy minibatch optimization."""
         if self.patience == "auto":
             noisy = mode != "classic" or self.intermediate_error
-            return 20 if noisy else None
+            return 10 if noisy else None
         if self.patience is None:
             return None
         return int(self.patience)
@@ -653,6 +750,33 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         Xd = jnp.asarray(Xc)
         w = jnp.asarray(sample_weight, Xd.dtype)
 
+        # CPU backend: the threaded C++ kernel (the reference's Cython-
+        # kernel role, cluster/_k_means_lloyd.pyx:29) beats XLA's
+        # per-dispatch overhead on small hosts. Routed only when no kernel
+        # was forced (use_pallas='auto'), no mesh, and the error model is
+        # expressible (classic/δ-means without intermediate tomography).
+        from .._config import _get_threadlocal_config
+
+        on_cpu = (jax.default_backend() == "cpu"
+                  or _get_threadlocal_config()["device"].startswith("cpu"))
+        if (on_cpu and self.use_pallas == "auto" and self.mesh is None
+                and mode in ("classic", "delta")
+                and not self.intermediate_error
+                and (isinstance(init, str) or hasattr(init, "__array__"))):
+            import os
+
+            # the scalar C++ kernel scales with cores; single-threaded BLAS
+            # sgemm wins on small hosts — and needs no toolchain, so the
+            # (potentially slow) .so build is only attempted when the C++
+            # kernel would actually run
+            use_cpp = (os.cpu_count() or 1) >= 8
+            if use_cpp:
+                from ..native import native_available
+
+                use_cpp = native_available()
+            return self._run_native(key, Xd, w, init, n_init, delta, mode,
+                                    tol_, use_cpp)
+
         # fast path: all restarts batched into one vmapped kernel (string
         # inits only; under vmap the pallas kernel's grid gains a restart
         # axis, so the fused path batches too). Accelerators win from one
@@ -674,6 +798,52 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         else:
             run = functools.partial(lloyd_single_jit, **static)
 
+        return self._restart_loop(key, run, Xd, w, xsq, init, n_init)
+
+    def _run_native(self, key, Xd, w, init, n_init, delta, mode, tol_,
+                    use_cpp):
+        """Host-side restart loop over the native/BLAS kernels."""
+        Xn = np.ascontiguousarray(np.asarray(Xd), np.float32)
+        wn = np.ascontiguousarray(np.asarray(w), np.float32)
+        xsqn = (Xn**2).sum(axis=1)
+        window = delta if mode == "delta" else 0.0
+        patience = self._resolved_patience(mode)
+        # deterministic host RNG derived from the estimator's jax key
+        rng = np.random.default_rng(
+            np.asarray(jax.random.key_data(key), np.uint32).tolist())
+        best = None
+        for _ in range(n_init):
+            if hasattr(init, "__array__"):
+                centers0 = np.asarray(init, np.float32)
+                if centers0.shape != (self.n_clusters, Xn.shape[1]):
+                    raise ValueError(
+                        f"The shape of the initial centers {centers0.shape} "
+                        f"does not match (n_clusters={self.n_clusters}, "
+                        f"n_features={Xn.shape[1]}).")
+            else:
+                rinit = np.random.default_rng(int(rng.integers(0, 2**63 - 1)))
+                if init == "k-means++":
+                    centers0 = _kmeans_plusplus_np(rinit, Xn, xsqn,
+                                                   self.n_clusters, wn)
+                else:  # "random"
+                    idx = rinit.choice(Xn.shape[0], self.n_clusters,
+                                       replace=False, p=wn / wn.sum())
+                    centers0 = Xn[idx]
+            labels, inertia, centers, n_iter, history = _native_lloyd_run(
+                rng, Xn, wn, xsqn, centers0, window=window,
+                max_iter=self.max_iter, tol=tol_, patience=patience,
+                use_cpp=use_cpp)
+            if self.verbose:
+                trace = history["inertia"][:n_iter]
+                for i, v in enumerate(trace):
+                    print(f"Iteration {i}, inertia {v:.3f}.")
+                print(f"init done, inertia {float(inertia):.3f}")
+            if best is None or float(inertia) < float(best[1]):
+                best = (labels, inertia, centers, n_iter, history)
+        return best
+
+    def _restart_loop(self, key, run, Xd, w, xsq, init, n_init):
+        """n_init restarts of a jit'd single-run kernel; best inertia wins."""
         best = None
         for _ in range(n_init):
             key, ki, kr = jax.random.split(key, 3)

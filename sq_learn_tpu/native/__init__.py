@@ -72,6 +72,14 @@ def _load():
             ctypes.c_int64, ctypes.POINTER(ctypes.c_int32),
             ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
             ctypes.POINTER(ctypes.c_double), ctypes.c_int]
+        lib.lloyd_iter_window.restype = ctypes.c_int
+        lib.lloyd_iter_window.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_double, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_double), ctypes.c_int]
         lib.murmurhash3_x86_32.restype = ctypes.c_uint32
         lib.murmurhash3_x86_32.argtypes = [
             ctypes.c_char_p, ctypes.c_int, ctypes.c_uint32]
@@ -148,6 +156,93 @@ def lloyd_iter(X, centers, sample_weight=None, n_threads=0):
     sums = onehot.T @ X.astype(np.float64)
     counts = onehot.sum(axis=0)
     return labels, sums, counts, inertia
+
+
+def host_lloyd_step(rng, Xn, wn, xsq, centers, window):
+    """One fused host E+M step on BLAS: sgemm distances (the ‖c‖²−2xcᵀ
+    trick, same as the reference's chunked kernel
+    ``_k_means_lloyd.pyx:196-203``), optional δ-window uniform pick, one-hot
+    sgemm partials. On few-core hosts single-threaded BLAS beats the
+    threaded scalar C++ kernel; many-core hosts use
+    :func:`lloyd_iter_window` instead.
+
+    Returns ``(labels int32 (n,), min_d2 (n,), sums (k, m), counts (k,),
+    inertia float)`` with the same semantics as :func:`lloyd_iter_window`.
+    """
+    n, k = len(Xn), centers.shape[0]
+    rows = np.arange(n)
+    csq = (centers**2).sum(axis=1)
+    d = csq[None, :] - 2.0 * (Xn @ centers.T)        # (n, k) sgemm
+    labels = d.argmin(axis=1).astype(np.int32)
+    best = d[rows, labels]                           # one scan + gather
+    if window > 0 and k > 1:
+        # the uniform δ-window pick only matters for rows whose runner-up
+        # lies inside the window — with small δ that is a handful of rows,
+        # so the full-matrix masking/RNG runs on the ambiguous subset only
+        second = np.partition(d, 1, axis=1)[:, 1]
+        amb = np.flatnonzero(second <= best + window)
+        if amb.size:
+            sub = d[amb]
+            m2 = sub <= best[amb, None] + window
+            r = rng.random(sub.shape, dtype=np.float32)
+            labels[amb] = np.where(m2, r, -1.0).argmax(axis=1)
+    onehot = np.zeros(d.shape, np.float32)
+    onehot[rows, labels] = wn
+    sums = onehot.T @ Xn                             # (k, m) sgemm
+    counts = np.bincount(labels, weights=wn, minlength=k)
+    min_d2 = best + xsq
+    inertia = float(min_d2 @ wn)
+    return labels, min_d2, sums, counts, inertia
+
+
+def lloyd_iter_window(X, centers, sample_weight=None, window=0.0, seed=0,
+                      n_threads=0):
+    """Fused windowed (δ-means) Lloyd E+M step on the host.
+
+    ``window`` > 0 picks each row's label uniformly among centroids within
+    ``window`` of its minimum squared distance (the δ-means scrambling,
+    reference ``_dmeans.py:742-750``); 0 is the classical argmin. The pick
+    is reproducible from ``(seed, row)`` via a stateless per-row SplitMix64.
+
+    Returns ``(labels int32 (n,), min_d2 float32 (n,), sums float64 (k, m),
+    counts float64 (k,), inertia float)`` — partials follow the picked
+    labels, inertia and min_d2 use the true minima, matching the XLA
+    ``e_step``. Native path: threaded C++ kernel; fallback: NumPy.
+    """
+    X = np.ascontiguousarray(X, dtype=np.float32)
+    centers = np.ascontiguousarray(centers, dtype=np.float32)
+    n, m = X.shape
+    k = centers.shape[0]
+    if sample_weight is not None:
+        sample_weight = np.ascontiguousarray(sample_weight, dtype=np.float32)
+
+    lib = _load()
+    if lib is not None:
+        labels = np.empty(n, np.int32)
+        min_d2 = np.empty(n, np.float32)
+        sums = np.empty((k, m), np.float64)
+        counts = np.empty(k, np.float64)
+        inertia = ctypes.c_double()
+        w_ptr = (sample_weight.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+                 if sample_weight is not None
+                 else ctypes.cast(None, ctypes.POINTER(ctypes.c_float)))
+        rc = lib.lloyd_iter_window(
+            X.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), w_ptr,
+            centers.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            n, m, k, float(window), int(seed) & 0xFFFFFFFFFFFFFFFF,
+            labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            min_d2.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            sums.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            counts.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            ctypes.byref(inertia), int(n_threads))
+        if rc == 0:
+            return labels, min_d2, sums, counts, float(inertia.value)
+
+    # BLAS fallback (same semantics; numpy RNG stands in for SplitMix64)
+    w = (np.ones(n, np.float32) if sample_weight is None else sample_weight)
+    x_sq = (X**2).sum(axis=1)
+    return host_lloyd_step(np.random.default_rng(seed), X, w, x_sq, centers,
+                           float(window))
 
 
 # ---------------------------------------------------------------------------

@@ -46,6 +46,10 @@ int lloyd_iter_chunked(const float* X, const float* sample_weight,
     if (n_threads <= 0) n_threads = 1;
   }
   if ((int64_t)n_threads > n) n_threads = (int)n;
+  {
+    const int64_t nch = (n + 255) / 256;  // one chunk per thread max
+    if ((int64_t)n_threads > nch) n_threads = (int)nch;
+  }
 
   // ||c||^2 once
   std::vector<double> c_sq(k);
@@ -104,6 +108,124 @@ int lloyd_iter_chunked(const float* X, const float* sample_weight,
   for (auto& th : threads) th.join();
 
   // serial reduction (the GIL-guarded reduction of _k_means_lloyd.pyx:145)
+  std::memset(out_sums, 0, sizeof(double) * k * m);
+  std::memset(out_counts, 0, sizeof(double) * k);
+  double inertia = 0.0;
+  for (int t = 0; t < n_threads; ++t) {
+    for (int64_t e = 0; e < k * m; ++e) out_sums[e] += t_sums[t][e];
+    for (int64_t j = 0; j < k; ++j) out_counts[j] += t_counts[t][j];
+    inertia += t_inertia[t];
+  }
+  *out_inertia = inertia;
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Windowed (delta-means) Lloyd iteration
+// ---------------------------------------------------------------------------
+
+// SplitMix64: tiny stateless per-row generator so the delta-window pick is
+// reproducible from (seed, row) without any shared RNG state across threads.
+static inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9E3779B97F4A7C15ULL;
+  x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  x = (x ^ (x >> 27)) * 0x94D049BB133111EBULL;
+  return x ^ (x >> 31);
+}
+
+// The delta-means E+M step (reference `delta_means1`/`select_labels`,
+// _dmeans.py:742-750/2252): each row picks uniformly among the centroids
+// whose squared distance is within `window` of its minimum (window == 0 is
+// the classical argmin). Additionally emits per-row min squared distances
+// (out_min_d2, may be null) for empty-cluster relocation, and accumulates
+// partials for the *picked* labels while inertia uses the true minima —
+// matching the XLA e_step exactly.
+int lloyd_iter_window(const float* X, const float* sample_weight,
+                      const float* centers, int64_t n, int64_t m, int64_t k,
+                      double window, uint64_t seed, int32_t* out_labels,
+                      float* out_min_d2, double* out_sums, double* out_counts,
+                      double* out_inertia, int n_threads) {
+  if (n <= 0 || m <= 0 || k <= 0) return -1;
+  if (n_threads <= 0) {
+    n_threads = (int)std::thread::hardware_concurrency();
+    if (n_threads <= 0) n_threads = 1;
+  }
+  if ((int64_t)n_threads > n) n_threads = (int)n;
+  {
+    const int64_t nch = (n + 255) / 256;  // one chunk per thread max
+    if ((int64_t)n_threads > nch) n_threads = (int)nch;
+  }
+
+  std::vector<double> c_sq(k);
+  for (int64_t j = 0; j < k; ++j) {
+    double s = 0.0;
+    const float* c = centers + j * m;
+    for (int64_t f = 0; f < m; ++f) s += (double)c[f] * c[f];
+    c_sq[j] = s;
+  }
+
+  const int64_t chunk = 256;
+  std::atomic<int64_t> next_chunk{0};
+  const int64_t n_chunks = (n + chunk - 1) / chunk;
+
+  std::vector<std::vector<double>> t_sums((size_t)n_threads,
+                                          std::vector<double>(k * m, 0.0));
+  std::vector<std::vector<double>> t_counts((size_t)n_threads,
+                                            std::vector<double>(k, 0.0));
+  std::vector<double> t_inertia((size_t)n_threads, 0.0);
+
+  auto worker = [&](int tid) {
+    std::vector<double>& sums = t_sums[tid];
+    std::vector<double>& counts = t_counts[tid];
+    std::vector<double> d(k);
+    double inertia = 0.0;
+    for (;;) {
+      int64_t c0 = next_chunk.fetch_add(1);
+      if (c0 >= n_chunks) break;
+      int64_t lo = c0 * chunk, hi = std::min(n, lo + chunk);
+      for (int64_t i = lo; i < hi; ++i) {
+        const float* x = X + i * m;
+        double best = 1e300;
+        for (int64_t j = 0; j < k; ++j) {
+          const float* c = centers + j * m;
+          double dot = 0.0;
+          for (int64_t f = 0; f < m; ++f) dot += (double)x[f] * c[f];
+          d[j] = c_sq[j] - 2.0 * dot;  // ||x||^2 constant across centers
+          if (d[j] < best) best = d[j];
+        }
+        int32_t pick;
+        if (window > 0.0) {
+          int64_t cnt = 0;
+          for (int64_t j = 0; j < k; ++j) cnt += (d[j] <= best + window);
+          uint64_t r = splitmix64(seed ^ (uint64_t)i) % (uint64_t)cnt;
+          pick = 0;
+          for (int64_t j = 0; j < k; ++j) {
+            if (d[j] <= best + window && r-- == 0) { pick = (int32_t)j; break; }
+          }
+        } else {
+          pick = 0;
+          for (int64_t j = 0; j < k; ++j) if (d[j] == best) { pick = (int32_t)j; break; }
+        }
+        out_labels[i] = pick;
+        double w = sample_weight ? (double)sample_weight[i] : 1.0;
+        double x_sq = 0.0;
+        for (int64_t f = 0; f < m; ++f) {
+          x_sq += (double)x[f] * x[f];
+          sums[pick * m + f] += w * x[f];
+        }
+        counts[pick] += w;
+        double md2 = best + x_sq;
+        if (out_min_d2) out_min_d2[i] = (float)md2;
+        inertia += w * md2;
+      }
+    }
+    t_inertia[tid] = inertia;
+  };
+
+  std::vector<std::thread> threads;
+  for (int t = 0; t < n_threads; ++t) threads.emplace_back(worker, t);
+  for (auto& th : threads) th.join();
+
   std::memset(out_sums, 0, sizeof(double) * k * m);
   std::memset(out_counts, 0, sizeof(double) * k);
   double inertia = 0.0;

@@ -327,7 +327,8 @@ class TestStoppingAndHistory:
             warnings.simplefilter("ignore")
             qm = QKMeans(n_clusters=4, delta=50.0,
                          true_distance_estimate=False, n_init=1,
-                         max_iter=300, patience=10, random_state=0).fit(X)
+                         max_iter=300, patience=10, random_state=0,
+                         use_pallas=False).fit(X)  # pin the XLA loop
         assert qm.n_iter_ <= 60
         assert float(adjusted_rand_score(qm.labels_, y)) > 0.5
 
@@ -337,7 +338,8 @@ class TestStoppingAndHistory:
             warnings.simplefilter("ignore")
             qm = QKMeans(n_clusters=4, delta=50.0,
                          true_distance_estimate=False, n_init=1,
-                         max_iter=25, patience=None, random_state=0).fit(X)
+                         max_iter=25, patience=None, random_state=0,
+                         use_pallas=False).fit(X)  # pin the XLA loop
         assert qm.n_iter_ == 25
 
 
@@ -368,3 +370,94 @@ class TestEmptyClusterRelocation:
                                      max_iter=100, algorithm="lloyd").fit(X)
         np.testing.assert_allclose(ours.inertia_, ref.inertia_, rtol=0.05)
         assert len(np.unique(ours.labels_)) == 4
+
+
+class TestNativeHostPath:
+    """The CPU-backend host fast path (BLAS/C++ twin of lloyd_single) must
+    match the XLA path's semantics."""
+
+    def test_routed_on_cpu_and_matches_xla_classic(self, blobs):
+        X, _ = blobs
+        init = X[:4].copy()
+        host = KMeans(n_clusters=4, init=init, n_init=1, max_iter=100,
+                      random_state=0).fit(X)              # use_pallas='auto'
+        xla = KMeans(n_clusters=4, init=init, n_init=1, max_iter=100,
+                     random_state=0, use_pallas=False).fit(X)
+        assert float(adjusted_rand_score(host.labels_, xla.labels_)) == \
+            pytest.approx(1.0)
+        np.testing.assert_allclose(host.inertia_, xla.inertia_, rtol=1e-4)
+        np.testing.assert_allclose(
+            np.sort(host.cluster_centers_, 0),
+            np.sort(xla.cluster_centers_, 0), rtol=1e-3, atol=1e-3)
+
+    def test_host_step_classic_equals_cpp_kernel(self):
+        from sq_learn_tpu.native import (host_lloyd_step,
+                                         lloyd_iter_window)
+
+        rng0 = np.random.default_rng(3)
+        Xn = rng0.normal(size=(500, 13)).astype(np.float32)
+        wn = rng0.uniform(0.5, 2.0, 500).astype(np.float32)
+        C = Xn[:6].copy()
+        xsq = (Xn**2).sum(axis=1)
+        l1, m1, s1, c1, i1 = host_lloyd_step(
+            np.random.default_rng(0), Xn, wn, xsq, C, 0.0)
+        l2, m2, s2, c2, i2 = lloyd_iter_window(Xn, C, sample_weight=wn,
+                                               window=0.0, seed=0)
+        np.testing.assert_array_equal(l1, l2)
+        np.testing.assert_allclose(m1, m2, rtol=1e-3, atol=1e-2)
+        np.testing.assert_allclose(s1, s2, rtol=1e-4, atol=1e-3)
+        np.testing.assert_allclose(c1, c2, rtol=1e-6)
+        assert i1 == pytest.approx(i2, rel=1e-4)
+
+    def test_cpp_kernel_window_semantics(self):
+        from sq_learn_tpu.native import lloyd_iter_window, native_available
+
+        if not native_available():
+            pytest.skip("no native toolchain")
+        rng = np.random.default_rng(1)
+        Xn = rng.normal(size=(400, 8)).astype(np.float32)
+        wn = np.ones(400, np.float32)
+        C = Xn[:5].copy()
+        window = 5.0
+        labels, min_d2, sums, counts, inertia = lloyd_iter_window(
+            Xn, C, sample_weight=wn, window=window, seed=7)
+        csq = (C.astype(np.float64)**2).sum(1)
+        d = (Xn.astype(np.float64)**2).sum(1)[:, None] + csq[None, :] \
+            - 2.0 * (Xn.astype(np.float64) @ C.T.astype(np.float64))
+        best = d.min(axis=1)
+        sel = d[np.arange(400), labels]
+        assert (sel <= best + window + 1e-6).all()
+        assert (labels != d.argmin(axis=1)).any()  # window wide → scrambles
+        np.testing.assert_allclose(min_d2, best, rtol=1e-4, atol=1e-3)
+        assert inertia == pytest.approx(best.sum(), rel=1e-5)
+        # deterministic in (seed)
+        labels2 = lloyd_iter_window(Xn, C, sample_weight=wn, window=window,
+                                    seed=7)[0]
+        np.testing.assert_array_equal(labels, labels2)
+
+    def test_single_cluster_delta_mode(self, blobs):
+        X, _ = blobs
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            qm = QKMeans(n_clusters=1, delta=0.5,
+                         true_distance_estimate=False, n_init=1,
+                         random_state=0).fit(X)
+        assert qm.cluster_centers_.shape == (1, X.shape[1])
+        np.testing.assert_allclose(qm.cluster_centers_[0], X.mean(axis=0),
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_native_path_validates_init_shape(self, blobs):
+        X, _ = blobs
+        with pytest.raises(ValueError, match="shape of the initial centers"):
+            KMeans(n_clusters=4, init=np.zeros((3, X.shape[1]),
+                                               np.float32)).fit(X)
+
+    def test_host_noisy_fit_quality(self, blobs):
+        X, y = blobs
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            qm = QKMeans(n_clusters=4, delta=0.5,
+                         true_distance_estimate=False, n_init=2,
+                         random_state=0).fit(X)
+        assert float(adjusted_rand_score(qm.labels_, y)) > 0.9
+        assert len(qm.fit_history_["inertia"]) == qm.n_iter_
