@@ -9,7 +9,6 @@ vs_baseline = sklearn_seconds / our_seconds (>1 means we are faster).
 
 import json
 import os
-import subprocess
 import sys
 import time
 import warnings
@@ -19,29 +18,10 @@ import numpy as np
 warnings.filterwarnings("ignore")
 
 
-def probe_backend(timeout_s=120):
-    """Initialize the configured JAX backend in a throwaway subprocess.
-
-    A wedged accelerator tunnel can hang ``jax.devices()`` indefinitely;
-    probing out-of-process lets the benchmark fall back to the CPU backend
-    (with a note on stderr) instead of hanging the harness.
-    """
-    platform = os.environ.get("JAX_PLATFORMS", "")
-    if platform in ("", "cpu"):
-        return  # nothing to probe
-    try:
-        subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=timeout_s, check=True, capture_output=True)
-    except (subprocess.TimeoutExpired, subprocess.CalledProcessError) as exc:
-        print(f"# backend {platform!r} unreachable ({type(exc).__name__}); "
-              "falling back to CPU", file=sys.stderr)
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        # jax may already be imported (sitecustomize) with the env value
-        # baked in; the config update is the reliable in-process override
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
+# shared with the bench/ suite scripts — single implementation of the
+# probe-in-subprocess + CPU fallback contract
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from bench._common import probe_backend  # noqa: E402
 
 
 def load_digits_data():

@@ -31,6 +31,37 @@ def emit(metric, value, unit="s", vs_baseline=1.0, **extra):
     }))
 
 
+def probe_backend(timeout_s=120):
+    """Initialize the configured JAX backend in a throwaway subprocess and
+    fall back to the CPU backend when the accelerator tunnel is wedged
+    (same contract as the headline bench.py)."""
+    import os
+    import subprocess
+
+    platform = os.environ.get("JAX_PLATFORMS", "")
+    if platform == "cpu":
+        # the env var alone is NOT sufficient when a sitecustomize
+        # pre-imported jax against a wedged accelerator relay: backend init
+        # can still hang. The config update is the reliable override.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        return
+    if platform == "":
+        return
+    try:
+        subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s, check=True, capture_output=True)
+    except (subprocess.TimeoutExpired, subprocess.CalledProcessError) as exc:
+        print(f"# backend {platform!r} unreachable ({type(exc).__name__}); "
+              "falling back to CPU", file=sys.stderr)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+
 def smoke_mode():
     """True when invoked with --smoke or SQ_BENCH_SMOKE=1: scripts subsample
     their dataset so the full code path can be validated quickly."""

@@ -14,11 +14,12 @@ import numpy as np
 warnings.filterwarnings("ignore")
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
-from bench._common import emit, maybe_subsample, timed  # noqa: E402
+from bench._common import (emit, maybe_subsample, probe_backend,  # noqa: E402
+                           timed)
 
 
 def main():
-    import jax
+    probe_backend()
     from sq_learn_tpu.datasets import load_cicids
     from sq_learn_tpu.metrics import adjusted_rand_score
     from sq_learn_tpu.models import QKMeans
@@ -35,11 +36,9 @@ def main():
     headline_t = None
     for delta in (0.0, 0.1, 0.3, 0.5, 1.0):
         def fit():
-            est = QKMeans(n_clusters=k, n_init=3, delta=delta,
-                          true_distance_estimate=False,
-                          random_state=0).fit(X)
-            jax.block_until_ready(jax.device_put(0))
-            return est
+            return QKMeans(n_clusters=k, n_init=3, delta=delta,
+                           true_distance_estimate=False,
+                           random_state=0).fit(X)
 
         t, est = timed(fit, warmup=1, reps=1)
         ari = float(adjusted_rand_score(y, est.labels_))

@@ -12,10 +12,12 @@ import numpy as np
 warnings.filterwarnings("ignore")
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
-from bench._common import emit, maybe_subsample, timed  # noqa: E402
+from bench._common import (emit, maybe_subsample, probe_backend,  # noqa: E402
+                           timed)
 
 
 def main():
+    probe_backend()
     import jax
     from sq_learn_tpu.datasets import load_mnist
     from sq_learn_tpu.models import QKMeans
@@ -31,7 +33,6 @@ def main():
                       delta=0.5, true_distance_estimate=False,
                       random_state=seed, mesh=mesh)
         est.fit(X)
-        jax.block_until_ready(jax.device_put(0))
         return est
 
     ours_t, est = timed(ours_fit, warmup=1, reps=1)

@@ -12,11 +12,12 @@ import numpy as np
 warnings.filterwarnings("ignore")
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
-from bench._common import emit, maybe_subsample, timed  # noqa: E402
+from bench._common import (emit, maybe_subsample, probe_backend,  # noqa: E402
+                           timed)
 
 
 def main():
-    import jax
+    probe_backend()
     from sq_learn_tpu.datasets import load_mnist
     from sq_learn_tpu.models import QPCA
 
@@ -30,7 +31,6 @@ def main():
                    random_state=0).fit(
             X, estimate_all=True, eps=0.1, delta=0.1, theta_major=1e-9,
             true_tomography=False)
-        jax.block_until_ready(jax.device_put(0))
         return pca
 
     ours_t, pca = timed(ours_fit, warmup=1, reps=1)

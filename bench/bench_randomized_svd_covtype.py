@@ -12,10 +12,12 @@ import numpy as np
 warnings.filterwarnings("ignore")
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
-from bench._common import emit, maybe_subsample, timed  # noqa: E402
+from bench._common import (emit, maybe_subsample, probe_backend,  # noqa: E402
+                           timed)
 
 
 def main():
+    probe_backend()
     import jax
     import jax.numpy as jnp
     from sq_learn_tpu.datasets import load_covtype
