@@ -787,9 +787,32 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         if (self.mesh is None and not self.verbose
                 and isinstance(init, str) and n_init > 1
                 and jax.default_backend() != "cpu"):
-            return lloyd_restarts(
-                key, Xd, w, xsq, n_init=n_init, init=init,
-                n_clusters=self.n_clusters, **static)
+            batched = functools.partial(
+                lloyd_restarts, key, Xd, w, xsq, n_init=n_init, init=init,
+                n_clusters=self.n_clusters)
+            try:
+                # block inside the try: jit dispatch is asynchronous, so a
+                # runtime kernel failure would otherwise surface later,
+                # outside any except clause
+                return jax.block_until_ready(batched(**static))
+            except Exception as exc:
+                # a backend that rejects the kernel (e.g. a pallas gap on
+                # some TPU generation) must not fail the fit: retry the
+                # batched kernel without pallas, then the serial loop —
+                # both always available
+                warnings.warn(
+                    f"batched-restarts kernel failed on this backend "
+                    f"({type(exc).__name__}); retrying without the pallas "
+                    f"kernel.", RuntimeWarning)
+                static = dict(static, use_pallas=False,
+                              pallas_interpret=False)
+                try:
+                    return jax.block_until_ready(batched(**static))
+                except Exception as exc2:
+                    warnings.warn(
+                        f"batched-restarts unavailable "
+                        f"({type(exc2).__name__}); falling back to the "
+                        f"serial restart loop.", RuntimeWarning)
 
         if self.mesh is not None:
             from ..parallel.lloyd import lloyd_single_sharded

@@ -116,6 +116,10 @@ def best_mu(A, start=0.0, end=1.0, step=0.05):
     (description, value) : (str, float)
         description is ``"p=<best_p>"`` or ``"Frobenius"``.
     """
+    if not 0.0 <= start <= end <= 1.0:
+        raise ValueError(
+            f"mu grid must satisfy 0 <= start <= end <= 1, got "
+            f"[{start}, {end}]")
     grid = tuple(float(p) for p in np.arange(start, end, step)) + (float(end),)
     vals = _mu_grid(jnp.asarray(A), grid)
     frob = jnp.linalg.norm(jnp.asarray(A))
