@@ -38,6 +38,30 @@ from ..utils import as_key, check_array, check_sample_weight
 
 LloydMode = ("classic", "delta", "ipe")
 
+# μ_p(A) search grid (reference ``best_mu``'s 0.1-step default,
+# ``Utility.py:222-231``) — shared by the staged and one-dispatch fit paths
+MU_GRID = tuple(round(0.1 * i, 1) for i in range(11))
+
+# kernels structurally rejected on this process's backend: (platform, tag,
+# use_pallas) triples skipped by subsequent fits so a rejected kernel is
+# re-learned once, not once per fit in a grid search
+_failed_kernels = set()
+
+
+def _memoizable_kernel_failure(exc):
+    """Only structural rejections (unsupported lowering / compile) go into
+    ``_failed_kernels``; transient runtime failures — tunnel resets, OOM on
+    one oversized operand — must not disable the kernel for every later
+    fit in the process."""
+    if isinstance(exc, NotImplementedError):
+        return True
+    msg = str(exc).upper()
+    if "RESOURCE_EXHAUSTED" in msg or "OUT OF MEMORY" in msg:
+        return False
+    return any(s in msg for s in
+               ("UNIMPLEMENTED", "NOT SUPPORTED", "UNSUPPORTED",
+                "NOT IMPLEMENTED", "LOWERING", "MOSAIC"))
+
 
 def tolerance(X, tol):
     """Scale ``tol`` by the mean per-feature variance (reference
@@ -512,6 +536,61 @@ def lloyd_restarts(key, X, weights, x_sq_norms, *, n_init, init, n_clusters,
     return (labels[best], inertia[best], centers[best], n_iter[best],
             jax.tree.map(lambda a: a[best], history))
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_init", "init", "n_clusters", "quantum", "mu_grid",
+                     "delta", "mode", "max_iter", "patience",
+                     "intermediate_error", "true_tomography", "ipe_q",
+                     "use_pallas", "pallas_interpret"),
+)
+def fit_fused(key, X, weights, tol_factor, *, n_init, init, n_clusters,
+              quantum, mu_grid=(), delta=0.0, mode="classic", max_iter=300,
+              patience=None, intermediate_error=False, true_tomography=True,
+              ipe_q=5, use_pallas=False, pallas_interpret=False):
+    """The ENTIRE q-means fit as ONE device dispatch.
+
+    On a tunneled accelerator every launch and every device→host fetch pays
+    a full round-trip; the per-attribute transfers of the unfused path
+    (quantum stats, centers, mean, labels, inertia, n_iter, history traces)
+    dominate small-workload wall-clock. This kernel fuses pre-fit statistics
+    (:func:`fit_prestats`), the on-device tolerance scale (reference
+    ``_tolerance``, ``_dmeans.py:253`` — ``tol_factor`` stays traced so a
+    tol change never recompiles), all ``n_init`` restarts
+    (:func:`lloyd_restarts`), and output packing, so the host does exactly
+    one dispatch and two transfers.
+
+    Returns ``(labels int32 (n,), packed)`` where ``packed`` is a flat
+    X-dtype vector with layout::
+
+        [inertia, n_iter, var_mean,
+         (eta, frob, sigma_min, mu_vals[len(mu_grid)])   # iff quantum
+         mean[m], centers[k*m] (centered space),
+         inertia_trace[max_iter], center_shift_trace[max_iter]]
+    """
+    stats = fit_prestats(X, quantum=quantum, mu_grid=mu_grid)
+    # tol==0 must short-circuit (zero error budget contract) rather than
+    # multiply: 0 * var_mean is NaN when the variance overflows, which would
+    # silently disable the shift<=tol stopping rule
+    tol = jnp.where(tol_factor > 0, tol_factor * stats["var_mean"], 0.0)
+    labels, inertia, centers, n_iter, history = lloyd_restarts(
+        key, stats["Xc"], weights, stats["xsq"], n_init=n_init, init=init,
+        n_clusters=n_clusters, delta=delta, mode=mode, max_iter=max_iter,
+        tol=tol, patience=patience, intermediate_error=intermediate_error,
+        true_tomography=true_tomography, ipe_q=ipe_q, use_pallas=use_pallas,
+        pallas_interpret=pallas_interpret)
+    pdt = X.dtype
+    parts = [jnp.stack([inertia.astype(pdt), n_iter.astype(pdt),
+                        stats["var_mean"].astype(pdt)])]
+    if quantum:
+        parts.append(jnp.stack([stats["eta"], stats["frob"],
+                                stats["sigma_min"]]).astype(pdt))
+        parts.append(stats["mu_vals"].astype(pdt))
+    parts += [stats["mean"].astype(pdt), centers.ravel().astype(pdt),
+              history["inertia"].astype(pdt),
+              history["center_shift"].astype(pdt)]
+    return labels, jnp.concatenate(parts)
+
+
 # module-level jitted E-step for inference (one compile cache per process)
 e_step_jit = jax.jit(
     e_step, static_argnames=("delta", "mode", "ipe_q", "axis_name")
@@ -648,14 +727,22 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
                     "intermediate_error cannot be True if delta is zero.")
         sample_weight = check_sample_weight(sample_weight, X)
 
+        # accelerator fast path: the whole fit (prestats + restarts +
+        # packing) as ONE dispatch and two fetches — see fit_fused. Falls
+        # through to the staged path when the kernel is unavailable.
+        if self._fused_fit_ok():
+            fitted = self._fit_fused(X, sample_weight, delta,
+                                     self._mode(delta))
+            if fitted is not None:
+                return fitted
+
         # one fused dispatch for centering + norms + quantum runtime-model
         # parameters (reference _dmeans.py:1242-1266; σ_min via Gram eigh
         # instead of a full SVD). The quantum stats are only consumed by
         # quantum_runtime_model, which requires delta > 0 — the classical
         # path skips those O(n·m²) scans entirely.
         quantum = delta > 0
-        mu_grid = (tuple(float(p) for p in np.arange(0.0, 1.0, 0.1)) + (1.0,)
-                   if quantum else ())
+        mu_grid = MU_GRID if quantum else ()
         # set_config(device=...) placement — except under an explicit mesh,
         # whose sharding owns placement (committed single-device operands
         # would conflict with the mesh's device set)
@@ -693,7 +780,15 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         best_labels, best_inertia, best_centers, best_n_iter, history = results
 
         centers = np.asarray(best_centers) + np.asarray(stats["mean"])
-        labels = np.asarray(best_labels)
+        return self._set_fit_results(
+            np.asarray(best_labels), centers, float(best_inertia),
+            int(best_n_iter), np.asarray(history["inertia"]),
+            np.asarray(history["center_shift"]))
+
+    def _set_fit_results(self, labels, centers, inertia, n_iter, inertia_tr,
+                         shift_tr):
+        """Set the fitted attributes (shared by the staged and one-dispatch
+        fit paths); all inputs are host arrays/scalars."""
         distinct = len(np.unique(labels))
         if distinct < self.n_clusters:
             warnings.warn(
@@ -702,16 +797,82 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
                 f"points in X.")
         self.cluster_centers_ = centers
         self.labels_ = labels
-        self.inertia_ = float(best_inertia)
-        self.n_iter_ = int(best_n_iter)
+        self.inertia_ = inertia
+        self.n_iter_ = n_iter
         # per-iteration observability out of the jit'd loop (SURVEY §5):
         # traces of the winning restart, trimmed to the iterations that
         # ran. Stored as flat ndarray attributes so utils/checkpoint.py
         # round-trips them; fit_history_ presents them as a dict.
-        self.inertia_history_ = np.asarray(history["inertia"])[: self.n_iter_]
-        self.center_shift_history_ = np.asarray(
-            history["center_shift"])[: self.n_iter_]
+        self.inertia_history_ = inertia_tr[:n_iter]
+        self.center_shift_history_ = shift_tr[:n_iter]
         return self
+
+    def _fused_fit_ok(self):
+        """The one-dispatch path covers the common accelerator fit: string
+        init (array/callable inits are host-resolved), no explicit mesh
+        (the mesh's sharding owns placement), non-verbose (per-init
+        reporting needs the host loop). The CPU backend keeps the
+        native/serial paths — with no tunnel round-trips to amortize,
+        per-restart early exit wins there."""
+        from .._config import _get_threadlocal_config
+
+        on_cpu = (jax.default_backend() == "cpu"
+                  or _get_threadlocal_config()["device"].startswith("cpu"))
+        return (self.mesh is None and not self.verbose
+                and isinstance(self.init, str) and not on_cpu)
+
+    def _fit_fused(self, X, sample_weight, delta, mode):
+        """One-dispatch fit (see :func:`fit_fused`). Returns self, or None
+        when the kernel fails on this backend (the caller then runs the
+        staged path)."""
+        use_pallas, interpret = self._resolve_pallas()
+        quantum = delta > 0
+        mu_grid = MU_GRID if quantum else ()
+        Xd = as_device_array(X)
+        w = jnp.asarray(sample_weight, Xd.dtype)
+        key = as_key(self.random_state)
+        kw = dict(n_init=int(self.n_init), init=self.init,
+                  n_clusters=self.n_clusters, quantum=quantum,
+                  mu_grid=mu_grid, delta=delta, mode=mode,
+                  max_iter=self.max_iter,
+                  patience=self._resolved_patience(mode),
+                  intermediate_error=self.intermediate_error,
+                  true_tomography=self.true_tomography, ipe_q=self.ipe_q)
+        def run(up, itp):
+            labels_d, packed_d = fit_fused(
+                key, Xd, w, float(self.tol), use_pallas=up,
+                pallas_interpret=itp, **kw)
+            # fetches stay inside the attempt: dispatch is asynchronous, so
+            # a runtime kernel failure surfaces at transfer time
+            return np.asarray(labels_d), np.asarray(packed_d)
+
+        out = self._kernel_ladder("fused", use_pallas, interpret, run,
+                                  "falling back to the staged fit path.")
+        if out is None:
+            return None
+        labels, packed = out
+
+        k, m = self.n_clusters, X.shape[1]
+        inertia, n_iter = float(packed[0]), int(packed[1])
+        pos = 3
+        if quantum:
+            eta, frob, sigma_min = (float(v) for v in packed[3:6])
+            mu_vals = packed[6:6 + len(mu_grid)]
+            pos = 6 + len(mu_grid)
+            from ..ops.quantum.norms import select_mu
+
+            self.eta_ = eta
+            self.norm_mu_, self.mu_ = select_mu(mu_grid, mu_vals, frob)
+            self.condition_number_ = (
+                1.0 / sigma_min if sigma_min > 0 else np.inf)
+        mean = packed[pos:pos + m]
+        pos += m
+        centers = packed[pos:pos + k * m].reshape(k, m) + mean
+        pos += k * m
+        inertia_tr = packed[pos:pos + self.max_iter]
+        shift_tr = packed[pos + self.max_iter:pos + 2 * self.max_iter]
+        return self._set_fit_results(labels, centers, inertia, n_iter,
+                                     inertia_tr, shift_tr)
 
     @property
     def fit_history_(self):
@@ -732,16 +893,46 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
             return None
         return int(self.patience)
 
-    def _run_lloyd(self, key, Xc, xsq, sample_weight, init, n_init, delta,
-                   mode, tol_):
-        """n_init restarts of the single-run kernel; keep the best inertia."""
+    def _kernel_ladder(self, tag, use_pallas, interpret, run, final_msg):
+        """Attempt ``run(use_pallas, interpret)`` with the configured kernel,
+        then without pallas; return its result or None when every attempt
+        failed. Structural rejections are memoized per (backend, tag,
+        kernel) so repeated fits (e.g. a grid search) skip known-bad
+        compiles; transient failures are retried next fit."""
+        backend = jax.default_backend()
+        plans = [(up, itp) for up, itp in
+                 ([(use_pallas, interpret)]
+                  + ([(False, False)] if use_pallas else []))
+                 if (backend, tag, up) not in _failed_kernels]
+        for i, (up, itp) in enumerate(plans):
+            try:
+                return run(up, itp)
+            except Exception as exc:
+                if _memoizable_kernel_failure(exc):
+                    _failed_kernels.add((backend, tag, up))
+                nxt = ("retrying without the pallas kernel."
+                       if i + 1 < len(plans) else final_msg)
+                warnings.warn(
+                    f"{tag} fit kernel failed on this backend "
+                    f"({type(exc).__name__}: {exc}); {nxt}", RuntimeWarning)
+        return None
+
+    def _resolve_pallas(self):
+        """Resolve the ``use_pallas`` hyperparameter to (use_pallas,
+        interpret): 'auto' engages the fused kernel where pallas is lowered
+        natively; forcing it on an unsupported backend runs the interpreter
+        (slow but exact). One policy for every fit path."""
         from ..ops.pallas_kernels import pallas_available
 
         if self.use_pallas == "auto":
-            use_pallas, interpret = pallas_available(), False
-        else:
-            use_pallas = bool(self.use_pallas)
-            interpret = use_pallas and not pallas_available()
+            return pallas_available(), False
+        use_pallas = bool(self.use_pallas)
+        return use_pallas, use_pallas and not pallas_available()
+
+    def _run_lloyd(self, key, Xc, xsq, sample_weight, init, n_init, delta,
+                   mode, tol_):
+        """n_init restarts of the single-run kernel; keep the best inertia."""
+        use_pallas, interpret = self._resolve_pallas()
         static = dict(delta=delta, mode=mode, max_iter=self.max_iter, tol=tol_,
                       patience=self._resolved_patience(mode),
                       intermediate_error=self.intermediate_error,
@@ -790,29 +981,20 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
             batched = functools.partial(
                 lloyd_restarts, key, Xd, w, xsq, n_init=n_init, init=init,
                 n_clusters=self.n_clusters)
-            try:
-                # block inside the try: jit dispatch is asynchronous, so a
-                # runtime kernel failure would otherwise surface later,
-                # outside any except clause
-                return jax.block_until_ready(batched(**static))
-            except Exception as exc:
-                # a backend that rejects the kernel (e.g. a pallas gap on
-                # some TPU generation) must not fail the fit: retry the
-                # batched kernel without pallas, then the serial loop —
-                # both always available
-                warnings.warn(
-                    f"batched-restarts kernel failed on this backend "
-                    f"({type(exc).__name__}); retrying without the pallas "
-                    f"kernel.", RuntimeWarning)
-                static = dict(static, use_pallas=False,
-                              pallas_interpret=False)
-                try:
-                    return jax.block_until_ready(batched(**static))
-                except Exception as exc2:
-                    warnings.warn(
-                        f"batched-restarts unavailable "
-                        f"({type(exc2).__name__}); falling back to the "
-                        f"serial restart loop.", RuntimeWarning)
+
+            # block inside the attempt: jit dispatch is asynchronous, so a
+            # runtime kernel failure would otherwise surface later,
+            # outside the ladder. A backend that rejects a kernel (e.g. a
+            # pallas gap on some TPU generation) must not fail the fit.
+            def run(up, itp):
+                return jax.block_until_ready(batched(
+                    **dict(static, use_pallas=up, pallas_interpret=itp)))
+
+            out = self._kernel_ladder(
+                "batched-restarts", use_pallas, interpret, run,
+                "falling back to the serial restart loop.")
+            if out is not None:
+                return out
 
         if self.mesh is not None:
             from ..parallel.lloyd import lloyd_single_sharded

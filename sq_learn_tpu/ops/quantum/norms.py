@@ -73,15 +73,23 @@ def mu(A, p):
     return _mu_grid(A, (p,))[0]
 
 
-def linear_search(A, start=0.0, end=1.0, step=0.05):
-    """Grid-minimize μ_p over p ∈ [start, end] ⊆ [0, 1] (reference
-    ``linear_search``, ``Utility.py:215-219``). Returns
-    (best_p, best_value)."""
+def _search_grid(start, end, step):
+    """Validated p-grid shared by :func:`linear_search` and
+    :func:`best_mu`."""
     if not 0.0 <= start <= end <= 1.0:
         raise ValueError(
             f"mu grid must satisfy 0 <= start <= end <= 1, got "
             f"[{start}, {end}]")
-    grid = tuple(float(p) for p in np.arange(start, end, step)) + (float(end),)
+    if step <= 0:
+        raise ValueError(f"mu grid step must be > 0, got {step}")
+    return tuple(float(p) for p in np.arange(start, end, step)) + (float(end),)
+
+
+def linear_search(A, start=0.0, end=1.0, step=0.05):
+    """Grid-minimize μ_p over p ∈ [start, end] ⊆ [0, 1] (reference
+    ``linear_search``, ``Utility.py:215-219``). Returns
+    (best_p, best_value)."""
+    grid = _search_grid(start, end, step)
     vals = np.asarray(_mu_grid(jnp.asarray(A), grid))
     idx = int(np.argmin(vals))
     return grid[idx], float(vals[idx])
@@ -116,11 +124,7 @@ def best_mu(A, start=0.0, end=1.0, step=0.05):
     (description, value) : (str, float)
         description is ``"p=<best_p>"`` or ``"Frobenius"``.
     """
-    if not 0.0 <= start <= end <= 1.0:
-        raise ValueError(
-            f"mu grid must satisfy 0 <= start <= end <= 1, got "
-            f"[{start}, {end}]")
-    grid = tuple(float(p) for p in np.arange(start, end, step)) + (float(end),)
+    grid = _search_grid(start, end, step)
     vals = _mu_grid(jnp.asarray(A), grid)
     frob = jnp.linalg.norm(jnp.asarray(A))
     return select_mu(grid, vals, frob)

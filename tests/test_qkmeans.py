@@ -461,3 +461,69 @@ class TestNativeHostPath:
                          random_state=0).fit(X)
         assert float(adjusted_rand_score(qm.labels_, y)) > 0.9
         assert len(qm.fit_history_["inertia"]) == qm.n_iter_
+
+
+class TestFusedFitPath:
+    """The one-dispatch accelerator fit (fit_fused) must agree with the
+    staged path — same statistics, same quality — since the driver bench
+    exercises it whenever a real accelerator is attached."""
+
+    def _fused(self, X, **kw):
+        est = QKMeans(**kw)
+        delta = 0.0 if est.delta is None else float(est.delta)
+        w = np.ones(len(X), np.float32)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            out = est._fit_fused(X, w, delta, est._mode(delta))
+        assert out is est  # kernel ran; no staged fallback
+        return est
+
+    def test_classic_matches_staged(self, blobs):
+        X, y = blobs
+        fused = self._fused(X, n_clusters=4, n_init=5, random_state=0)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            staged = QKMeans(n_clusters=4, n_init=5, random_state=0).fit(X)
+        assert sklearn.metrics.adjusted_rand_score(
+            fused.labels_, staged.labels_) == 1.0
+        np.testing.assert_allclose(fused.inertia_, staged.inertia_, rtol=1e-4)
+        assert fused.n_iter_ == len(fused.inertia_history_)
+        assert np.all(np.isfinite(fused.inertia_history_))
+
+    def test_delta_means_quality_and_stats(self, blobs):
+        X, y = blobs
+        fused = self._fused(X, n_clusters=4, n_init=5, delta=0.5,
+                            true_distance_estimate=False, random_state=0)
+        staged = QKMeans(n_clusters=4, n_init=5, delta=0.5,
+                         true_distance_estimate=False, random_state=0).fit(X)
+        # quantum runtime-model statistics are deterministic — exact match
+        assert fused.eta_ == staged.eta_
+        np.testing.assert_allclose(fused.mu_, staged.mu_, rtol=1e-5)
+        assert fused.norm_mu_ == staged.norm_mu_
+        assert sklearn.metrics.adjusted_rand_score(y, fused.labels_) > 0.9
+        assert fused.cluster_centers_.shape == (4, X.shape[1])
+        assert len(fused.center_shift_history_) == fused.n_iter_
+
+    def test_ipe_mode_runs(self, blobs):
+        X, y = blobs
+        fused = self._fused(X, n_clusters=4, n_init=2, delta=0.5,
+                            max_iter=20, true_distance_estimate=True,
+                            random_state=0)
+        assert sklearn.metrics.adjusted_rand_score(y, fused.labels_) > 0.8
+
+    def test_fused_ok_gating(self, monkeypatch):
+        import sq_learn_tpu.models.qkmeans as qk
+
+        # CPU backend (the test conftest) must NOT route through the fused
+        # path implicitly
+        assert not QKMeans(n_clusters=4)._fused_fit_ok()
+        # on an accelerator backend the gate opens — but never for an
+        # explicit mesh (sharding owns placement), verbose fits (per-init
+        # reporting needs the host loop), or host-resolved array inits
+        monkeypatch.setattr(qk.jax, "default_backend", lambda: "tpu")
+        assert QKMeans(n_clusters=4)._fused_fit_ok()
+        mesh = jax.sharding.Mesh(np.array(jax.devices()[:1]), ("data",))
+        assert not QKMeans(n_clusters=4, mesh=mesh)._fused_fit_ok()
+        assert not QKMeans(n_clusters=4, verbose=1)._fused_fit_ok()
+        assert not QKMeans(
+            n_clusters=4, init=np.zeros((4, 2), np.float32))._fused_fit_ok()
