@@ -749,19 +749,14 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         Xin = jnp.asarray(X) if self.mesh is not None else as_device_array(X)
         stats = fit_prestats(Xin, quantum=quantum, mu_grid=mu_grid)
         if quantum:
-            from ..ops.quantum.norms import select_mu
-
             # fetch every host-needed scalar (incl. the μ grid) in ONE
             # device→host transfer
             fetched = np.asarray(jnp.concatenate([
                 jnp.stack([stats["var_mean"], stats["eta"], stats["frob"],
                            stats["sigma_min"]]),
                 stats["mu_vals"].astype(stats["var_mean"].dtype)]))
-            var_mean, eta, frob, sigma_min = map(float, fetched[:4])
-            self.eta_ = eta
-            self.norm_mu_, self.mu_ = select_mu(mu_grid, fetched[4:], frob)
-            self.condition_number_ = (
-                1.0 / sigma_min if sigma_min > 0 else np.inf)
+            var_mean = float(fetched[0])
+            self._set_quantum_stats(mu_grid, *fetched[1:4], fetched[4:])
         else:
             var_mean = float(stats["var_mean"])
         tol_ = 0.0 if self.tol == 0 else float(self.tol * var_mean)
@@ -784,6 +779,16 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
             np.asarray(best_labels), centers, float(best_inertia),
             int(best_n_iter), np.asarray(history["inertia"]),
             np.asarray(history["center_shift"]))
+
+    def _set_quantum_stats(self, mu_grid, eta, frob, sigma_min, mu_vals):
+        """Set the quantum runtime-model attributes (reference
+        ``_dmeans.py:1242-1245``) — one definition for both fit paths."""
+        from ..ops.quantum.norms import select_mu
+
+        self.eta_ = float(eta)
+        self.norm_mu_, self.mu_ = select_mu(mu_grid, mu_vals, float(frob))
+        self.condition_number_ = (
+            1.0 / float(sigma_min) if sigma_min > 0 else np.inf)
 
     def _set_fit_results(self, labels, centers, inertia, n_iter, inertia_tr,
                          shift_tr):
@@ -847,7 +852,8 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
             return np.asarray(labels_d), np.asarray(packed_d)
 
         out = self._kernel_ladder("fused", use_pallas, interpret, run,
-                                  "falling back to the staged fit path.")
+                                  "falling back to the staged fit path.",
+                                  sig=(Xd.shape, str(Xd.dtype)))
         if out is None:
             return None
         labels, packed = out
@@ -856,15 +862,9 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         inertia, n_iter = float(packed[0]), int(packed[1])
         pos = 3
         if quantum:
-            eta, frob, sigma_min = (float(v) for v in packed[3:6])
-            mu_vals = packed[6:6 + len(mu_grid)]
+            self._set_quantum_stats(mu_grid, *packed[3:6],
+                                    packed[6:6 + len(mu_grid)])
             pos = 6 + len(mu_grid)
-            from ..ops.quantum.norms import select_mu
-
-            self.eta_ = eta
-            self.norm_mu_, self.mu_ = select_mu(mu_grid, mu_vals, frob)
-            self.condition_number_ = (
-                1.0 / sigma_min if sigma_min > 0 else np.inf)
         mean = packed[pos:pos + m]
         pos += m
         centers = packed[pos:pos + k * m].reshape(k, m) + mean
@@ -893,23 +893,26 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
             return None
         return int(self.patience)
 
-    def _kernel_ladder(self, tag, use_pallas, interpret, run, final_msg):
+    def _kernel_ladder(self, tag, use_pallas, interpret, run, final_msg,
+                       sig=()):
         """Attempt ``run(use_pallas, interpret)`` with the configured kernel,
         then without pallas; return its result or None when every attempt
         failed. Structural rejections are memoized per (backend, tag,
-        kernel) so repeated fits (e.g. a grid search) skip known-bad
-        compiles; transient failures are retried next fit."""
+        kernel, operand signature) so repeated fits (e.g. a grid search)
+        skip known-bad compiles — the signature keeps an input-dependent
+        rejection from blacklisting the kernel for other inputs. Transient
+        failures are retried next fit."""
         backend = jax.default_backend()
         plans = [(up, itp) for up, itp in
                  ([(use_pallas, interpret)]
                   + ([(False, False)] if use_pallas else []))
-                 if (backend, tag, up) not in _failed_kernels]
+                 if (backend, tag, up, sig) not in _failed_kernels]
         for i, (up, itp) in enumerate(plans):
             try:
                 return run(up, itp)
             except Exception as exc:
                 if _memoizable_kernel_failure(exc):
-                    _failed_kernels.add((backend, tag, up))
+                    _failed_kernels.add((backend, tag, up, sig))
                 nxt = ("retrying without the pallas kernel."
                        if i + 1 < len(plans) else final_msg)
                 warnings.warn(
@@ -992,7 +995,8 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
 
             out = self._kernel_ladder(
                 "batched-restarts", use_pallas, interpret, run,
-                "falling back to the serial restart loop.")
+                "falling back to the serial restart loop.",
+                sig=(Xd.shape, str(Xd.dtype)))
             if out is not None:
                 return out
 

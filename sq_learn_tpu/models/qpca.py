@@ -196,11 +196,17 @@ class QPCA(TransformerMixin, BaseEstimator):
         reductions. 'auto' computes it iff a QADRA fit kwarg is set; True
         always (needed to call the QADRA methods post-fit on a classical
         fit); False never.
+    mesh : jax.sharding.Mesh or None
+        Run the full-SVD fit data-parallel over the mesh's first axis:
+        sample-sharded Gram reduction over ICI, replicated m×m eigh
+        (:func:`~sq_learn_tpu.parallel.pca.centered_svd_sharded`). The
+        scaling path for sample axes beyond one chip's HBM; None (default)
+        fits on the configured single device.
     """
 
     def __init__(self, n_components=None, *, copy=True, whiten=False,
                  svd_solver="auto", tol=0.0, iterated_power="auto",
-                 random_state=None, name=None, compute_mu="auto"):
+                 random_state=None, name=None, compute_mu="auto", mesh=None):
         self.n_components = n_components
         self.copy = copy
         self.whiten = whiten
@@ -210,6 +216,7 @@ class QPCA(TransformerMixin, BaseEstimator):
         self.random_state = random_state
         self.name = name
         self.compute_mu = compute_mu
+        self.mesh = mesh
         self.quantum_runtime_container = []
 
     # -- fit ----------------------------------------------------------------
@@ -277,8 +284,10 @@ class QPCA(TransformerMixin, BaseEstimator):
 
         X = check_array(X, copy=self.copy)
         # set_config(device=...) placement: committing the input here pins
-        # every downstream jit (SVD, quantum estimators) to that device
-        X = as_device_array(X)
+        # every downstream jit (SVD, quantum estimators) to that device —
+        # except under a mesh, whose sharding owns placement
+        if self.mesh is None:
+            X = as_device_array(X)
         self._key = as_key(self.random_state)
 
         # n_components handling (reference _qPCA.py:527-536)
@@ -310,6 +319,15 @@ class QPCA(TransformerMixin, BaseEstimator):
             raise ValueError(
                 f"quantum estimators require svd_solver='full' (or 'auto'); "
                 f"got svd_solver={solver!r} with quantum fit kwargs set")
+        if self.mesh is not None:
+            # the truncated path is single-device; silently dropping the
+            # mesh would hand a one-chip fit (and an OOM at real scale) to
+            # exactly the large-sample inputs a mesh targets
+            if self.svd_solver not in ("auto", "full"):
+                raise ValueError(
+                    f"mesh requires svd_solver='full' (or 'auto'); got "
+                    f"svd_solver={self.svd_solver!r}")
+            solver = "full"
         self._fit_svd_solver = solver
 
         if solver == "full":
@@ -366,10 +384,19 @@ class QPCA(TransformerMixin, BaseEstimator):
                 f"n_components={n_components!r} must be of type int when "
                 f">= 1, was of type={type(n_components)!r}")
 
-        mean, U, S, Vt = centered_svd(X)
+        if self.mesh is not None:
+            from ..parallel.pca import centered_svd_sharded
+
+            mean, U, S, Vt = centered_svd_sharded(self.mesh, X)
+        else:
+            mean, U, S, Vt = centered_svd(X)
         Xc = jnp.asarray(X) - mean
         self.mean_ = np.asarray(mean)
-        U_np, S_np, Vt_np = np.asarray(U), np.asarray(S), np.asarray(Vt)
+        # U stays on device: the host only ever consumes its first
+        # n_components columns (left_sv below) — fetching the full (n, m)
+        # factor is a ~220 MB device→host transfer on MNIST-sized input,
+        # paid per fit over the accelerator tunnel
+        S_np, Vt_np = np.asarray(S), np.asarray(Vt)
 
         explained_variance_ = (S_np**2) / (n_samples - 1)
         total_var = explained_variance_.sum()
@@ -416,11 +443,12 @@ class QPCA(TransformerMixin, BaseEstimator):
         self.singular_values_ = S_np[:n_components].copy()
         self.all_singular_values_ = S_np
         # left singular vectors, row-wise (deviation from the reference's
-        # U-row slicing bug — see module docstring)
-        self.left_sv = U_np.T[:n_components]
+        # U-row slicing bug — see module docstring); sliced on device so
+        # only the retained columns transfer
+        self.left_sv = np.asarray(U[:, :n_components].T)
 
         self.spectral_norm = float(S_np[0])
-        self.frob_norm = float(np.linalg.norm(np.asarray(Xc)))
+        self.frob_norm = float(jnp.linalg.norm(Xc))
         # μ(A) feeds only the QADRA estimators below — its grid search costs
         # ~11 powered full-matrix reductions, so pure classical fits skip it
         need_mu = (self.quantum_retained_variance or self.theta_estimate
@@ -462,7 +490,9 @@ class QPCA(TransformerMixin, BaseEstimator):
                 delta=self.delta, eps=self.eps, theta=self.theta_major,
                 true_tomography=self.true_tomography,
                 norm=self.tomography_norm)
-        return U_np, S_np, Vt_np
+        # U is returned as the device array (callers in this package ignore
+        # the return; fetching it would defeat the sliced transfer above)
+        return U, S_np, Vt_np
 
     def _fit_truncated(self, X, n_components):
         """Truncated randomized-SVD fit — the purely classical path

@@ -5,6 +5,7 @@ thread reductions; SURVEY §2.3 maps them to psum over an ICI mesh.)
 """
 
 from . import distributed
+from .pca import centered_svd_sharded
 from .mesh import (
     DATA_AXIS,
     data_sharding,
@@ -16,6 +17,7 @@ from .mesh import (
 
 __all__ = [
     "DATA_AXIS",
+    "centered_svd_sharded",
     "data_sharding",
     "distributed",
     "make_mesh",

@@ -1,0 +1,67 @@
+"""Data-parallel tall-skinny SVD for qPCA over a device mesh.
+
+SURVEY §2.3 names the strategy: shard the sample axis, reduce an m×m Gram
+matrix over ICI, keep the small eigendecomposition replicated. There is no
+hand-written collective here — the inputs carry ``NamedSharding``
+annotations and XLA inserts the psum for the sharded-contraction
+``Xcᵀ·Xc`` itself (the sharding/collective recipe the scaling playbook
+prescribes). The left factor U = Xc·V/σ stays row-sharded; hosts fetch
+only the slices they need (see ``qpca._fit_full``).
+
+The reference has no distributed PCA at all (its ``_qPCA.py:578-583`` is a
+single-process LAPACK call); this is the TPU-native scaling path for
+matrices whose sample axis exceeds one chip's HBM.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.linalg import svd_flip
+from .mesh import pad_to_multiple, shard_rows
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def _masked_centered_svd(X, w, n):
+    """Gram-route thin SVD of the weighted-centered rows of X.
+
+    ``w`` zeroes padding rows so they contribute to neither the mean nor
+    the Gram matrix; ``n`` is the true row count. Shardings propagate from
+    the operands: with X/w row-sharded, the row-sums and the Gram
+    contraction lower to per-shard partials + an ICI all-reduce.
+    """
+    wX = X * w[:, None]
+    mean = jnp.sum(wX, axis=0) / n
+    Xc = (X - mean) * w[:, None]
+    G = Xc.T @ Xc  # (m, m) — per-shard GEMM + psum
+    evals, V = jnp.linalg.eigh(G)  # replicated
+    # thin spectrum: the feature Gram has m eigenvalues but only
+    # min(n, m) can be nonzero; slice so the factors match the
+    # single-device thin SVD's shapes (n and m are static here)
+    r = min(n, X.shape[1])
+    evals = jnp.flip(evals, 0)[:r]
+    V = jnp.flip(V, 1)[:, :r]
+    S = jnp.sqrt(jnp.maximum(evals, 0.0))
+    safe = jnp.where(S > 0, S, 1.0)
+    U = (Xc @ V) / safe[None, :]  # row-sharded
+    U, Vt = svd_flip(U, V.T)
+    return mean, U, S, Vt
+
+
+def centered_svd_sharded(mesh, X):
+    """Column-center X and return (mean, U, S, Vt) with deterministic
+    signs, computed data-parallel over ``mesh``'s first axis.
+
+    Matches :func:`~sq_learn_tpu.ops.linalg.centered_svd` (method='gram')
+    on the same input; U's rows are returned for the unpadded samples only,
+    still sharded over the mesh.
+    """
+    X = jnp.asarray(X)
+    n = X.shape[0]
+    ndev = int(mesh.devices.size)
+    Xp, _ = pad_to_multiple(X, ndev)
+    mask = jnp.zeros((Xp.shape[0],), Xp.dtype).at[:n].set(1.0)
+    Xp, mask = shard_rows(mesh, Xp, mask)
+    mean, U, S, Vt = _masked_centered_svd(Xp, mask, n)
+    return mean, U[:n], S, Vt

@@ -1,0 +1,89 @@
+"""Data-parallel centered SVD (parallel/pca.py) vs the single-device path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sq_learn_tpu.models import QPCA
+from sq_learn_tpu.ops.linalg import centered_svd
+from sq_learn_tpu.parallel import centered_svd_sharded, make_mesh
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(jax.devices("cpu")[:8])
+
+
+@pytest.mark.parametrize("n", [160, 103])  # even and uneven shards
+def test_matches_single_device(mesh, n):
+    X = np.random.default_rng(0).normal(size=(n, 12)).astype(np.float32)
+    mean_s, U_s, S_s, Vt_s = centered_svd_sharded(mesh, X)
+    mean, U, S, Vt = centered_svd(X, method="gram")
+    np.testing.assert_allclose(np.asarray(mean_s), np.asarray(mean),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(S_s), np.asarray(S),
+                               rtol=1e-4, atol=1e-3)
+    # deterministic signs (svd_flip) -> factors comparable directly
+    np.testing.assert_allclose(np.asarray(Vt_s), np.asarray(Vt),
+                               rtol=1e-3, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(U_s), np.asarray(U),
+                               rtol=1e-3, atol=2e-3)
+    # U really is row-sharded over the mesh
+    assert len(U_s.sharding.device_set) == 8
+
+
+def test_reconstruction(mesh):
+    X = np.random.default_rng(1).normal(size=(75, 6)).astype(np.float32)
+    mean, U, S, Vt = centered_svd_sharded(mesh, X)
+    Xc = X - np.asarray(mean)
+    np.testing.assert_allclose(
+        np.asarray(U) @ np.diag(np.asarray(S)) @ np.asarray(Vt), Xc,
+        rtol=1e-3, atol=1e-3)
+
+
+class TestQPCAMesh:
+    def test_classical_fit_parity(self, mesh):
+        X = np.random.default_rng(2).normal(size=(120, 10)).astype(np.float32)
+        ref = QPCA(n_components=4, svd_solver="full").fit(X)
+        dp = QPCA(n_components=4, svd_solver="full", mesh=mesh).fit(X)
+        np.testing.assert_allclose(dp.explained_variance_,
+                                   ref.explained_variance_, rtol=1e-4)
+        np.testing.assert_allclose(dp.components_, ref.components_,
+                                   rtol=1e-3, atol=2e-3)
+        np.testing.assert_allclose(dp.left_sv, ref.left_sv,
+                                   rtol=1e-3, atol=2e-3)
+        np.testing.assert_allclose(dp.transform(X), ref.transform(X),
+                                   rtol=1e-3, atol=2e-3)
+
+    def test_quantum_fit_on_mesh(self, mesh):
+        X = np.random.default_rng(3).normal(size=(96, 8)).astype(np.float32)
+        est = QPCA(n_components=4, svd_solver="full", mesh=mesh,
+                   random_state=0)
+        est.fit(X, estimate_all=True, delta=0.1, eps=0.1, theta_major=0.5)
+        assert est.estimate_right_sv.shape[1] == X.shape[1]
+        assert np.all(np.isfinite(est.estimate_s_values))
+
+
+def test_wide_input_thin_spectrum(mesh):
+    # n < m: the mesh path must return the thin min(n, m) spectrum, not m
+    # structural eigenvalues (noise_variance_/all_* parity with the
+    # single-device fit)
+    X = np.random.default_rng(4).normal(size=(40, 96)).astype(np.float32)
+    ref = QPCA(n_components=10, svd_solver="full").fit(X)
+    dp = QPCA(n_components=10, svd_solver="full", mesh=mesh).fit(X)
+    assert dp.all_singular_values_.shape == ref.all_singular_values_.shape
+    np.testing.assert_allclose(dp.noise_variance_, ref.noise_variance_,
+                               rtol=1e-3)
+    np.testing.assert_allclose(dp.explained_variance_,
+                               ref.explained_variance_, rtol=1e-3)
+
+
+def test_mesh_forces_full_solver(mesh):
+    # 'auto' on a large-sample input would pick 'randomized' — under a mesh
+    # that would silently run single-device; the mesh must force 'full'
+    X = np.random.default_rng(5).normal(size=(900, 50)).astype(np.float32)
+    dp = QPCA(n_components=5, mesh=mesh).fit(X)
+    assert dp._fit_svd_solver == "full"
+    with pytest.raises(ValueError, match="mesh requires svd_solver"):
+        QPCA(n_components=5, svd_solver="randomized", mesh=mesh).fit(X)
