@@ -43,8 +43,9 @@ LloydMode = ("classic", "delta", "ipe")
 MU_GRID = tuple(round(0.1 * i, 1) for i in range(11))
 
 # kernels structurally rejected on this process's backend: (platform, tag,
-# use_pallas) triples skipped by subsequent fits so a rejected kernel is
-# re-learned once, not once per fit in a grid search
+# use_pallas, signature) tuples skipped by subsequent fits so a rejected
+# kernel is re-learned once per shape family, not once per fit in a grid
+# search
 _failed_kernels = set()
 
 
@@ -557,15 +558,17 @@ def fit_fused(key, X, weights, tol_factor, *, n_init, init, n_clusters,
     ``_tolerance``, ``_dmeans.py:253`` — ``tol_factor`` stays traced so a
     tol change never recompiles), all ``n_init`` restarts
     (:func:`lloyd_restarts`), and output packing, so the host does exactly
-    one dispatch and two transfers.
+    one dispatch and one fetch.
 
-    Returns ``(labels int32 (n,), packed)`` where ``packed`` is a flat
-    X-dtype vector with layout::
+    Returns ONE flat X-dtype vector (a single fetch is a single blocking
+    round-trip; labels are exactly representable — k < 2²⁴ ≪ float32's
+    integer range) with layout::
 
         [inertia, n_iter, var_mean,
          (eta, frob, sigma_min, mu_vals[len(mu_grid)])   # iff quantum
          mean[m], centers[k*m] (centered space),
-         inertia_trace[max_iter], center_shift_trace[max_iter]]
+         inertia_trace[max_iter], center_shift_trace[max_iter],
+         labels[n]]
     """
     stats = fit_prestats(X, quantum=quantum, mu_grid=mu_grid)
     # tol==0 must short-circuit (zero error budget contract) rather than
@@ -587,8 +590,8 @@ def fit_fused(key, X, weights, tol_factor, *, n_init, init, n_clusters,
         parts.append(stats["mu_vals"].astype(pdt))
     parts += [stats["mean"].astype(pdt), centers.ravel().astype(pdt),
               history["inertia"].astype(pdt),
-              history["center_shift"].astype(pdt)]
-    return labels, jnp.concatenate(parts)
+              history["center_shift"].astype(pdt), labels.astype(pdt)]
+    return jnp.concatenate(parts)
 
 
 # module-level jitted E-step for inference (one compile cache per process)
@@ -728,7 +731,7 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         sample_weight = check_sample_weight(sample_weight, X)
 
         # accelerator fast path: the whole fit (prestats + restarts +
-        # packing) as ONE dispatch and two fetches — see fit_fused. Falls
+        # packing) as ONE dispatch and ONE fetch — see fit_fused. Falls
         # through to the staged path when the kernel is unavailable.
         if self._fused_fit_ok():
             fitted = self._fit_fused(X, sample_weight, delta,
@@ -844,20 +847,21 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
                   intermediate_error=self.intermediate_error,
                   true_tomography=self.true_tomography, ipe_q=self.ipe_q)
         def run(up, itp):
-            labels_d, packed_d = fit_fused(
+            # the fetch stays inside the attempt: dispatch is asynchronous,
+            # so a runtime kernel failure surfaces at transfer time
+            return np.asarray(fit_fused(
                 key, Xd, w, float(self.tol), use_pallas=up,
-                pallas_interpret=itp, **kw)
-            # fetches stay inside the attempt: dispatch is asynchronous, so
-            # a runtime kernel failure surfaces at transfer time
-            return np.asarray(labels_d), np.asarray(packed_d)
+                pallas_interpret=itp, **kw))
 
-        out = self._kernel_ladder("fused", use_pallas, interpret, run,
-                                  "falling back to the staged fit path.",
-                                  sig=(Xd.shape, str(Xd.dtype)))
-        if out is None:
+        packed = self._kernel_ladder(
+            "fused", use_pallas, interpret, run,
+            "falling back to the staged fit path.",
+            sig=(Xd.shape, str(Xd.dtype), self.n_clusters, self.max_iter))
+        if packed is None:
             return None
-        labels, packed = out
 
+        n = X.shape[0]
+        labels = packed[-n:].astype(np.int32)
         k, m = self.n_clusters, X.shape[1]
         inertia, n_iter = float(packed[0]), int(packed[1])
         pos = 3
@@ -996,7 +1000,8 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
             out = self._kernel_ladder(
                 "batched-restarts", use_pallas, interpret, run,
                 "falling back to the serial restart loop.",
-                sig=(Xd.shape, str(Xd.dtype)))
+                sig=(Xd.shape, str(Xd.dtype), self.n_clusters,
+                     self.max_iter))
             if out is not None:
                 return out
 

@@ -390,7 +390,6 @@ class QPCA(TransformerMixin, BaseEstimator):
             mean, U, S, Vt = centered_svd_sharded(self.mesh, X)
         else:
             mean, U, S, Vt = centered_svd(X)
-        Xc = jnp.asarray(X) - mean
         self.mean_ = np.asarray(mean)
         # U stays on device: the host only ever consumes its first
         # n_components columns (left_sv below) — fetching the full (n, m)
@@ -448,13 +447,25 @@ class QPCA(TransformerMixin, BaseEstimator):
         self.left_sv = np.asarray(U[:, :n_components].T)
 
         self.spectral_norm = float(S_np[0])
-        self.frob_norm = float(jnp.linalg.norm(Xc))
+        # ‖Xc‖_F² = Σσ² — exact from the already-fetched spectrum; never
+        # materializes the centered matrix (under a mesh that would
+        # replicate (n, m) onto every device)
+        self.frob_norm = float(np.sqrt((S_np**2).sum()))
         # μ(A) feeds only the QADRA estimators below — its grid search costs
         # ~11 powered full-matrix reductions, so pure classical fits skip it
         need_mu = (self.quantum_retained_variance or self.theta_estimate
                    or self.estimate_all or self.estimate_least_k
                    if self.compute_mu == "auto" else bool(self.compute_mu))
         if need_mu:
+            if self.mesh is not None:
+                # row-sharded centered copy (padding rows exactly zero, so
+                # the power-sum reductions are unchanged) — μ is the one
+                # consumer that needs the centered matrix itself
+                from ..parallel.pca import centered_sharded
+
+                Xc = centered_sharded(self.mesh, X, mean)
+            else:
+                Xc = jnp.asarray(X) - mean
             self.norm_muA, self.muA = best_mu(Xc, 0.0, step=0.1)
         else:
             self.norm_muA = self.muA = None

@@ -30,6 +30,18 @@ def svd_flip(u, v):
     return u * signs, v * signs[:, None]
 
 
+def gram_spectrum(G):
+    """Descending singular spectrum from a Gram matrix: eigh → flip →
+    clamped sqrt. Returns (S, V, safe) with ``safe`` the zero-guarded
+    divisor for recovering the paired factor — the one definition shared
+    by the single-device and mesh-sharded SVD routes."""
+    evals, V = jnp.linalg.eigh(G)  # ascending
+    evals = jnp.flip(evals, 0)
+    V = jnp.flip(V, 1)
+    S = jnp.sqrt(jnp.maximum(evals, 0.0))
+    return S, V, jnp.where(S > 0, S, 1.0)
+
+
 @functools.partial(jax.jit, static_argnames=("method",))
 def thin_svd(X, method="auto"):
     """Thin SVD X = U·diag(S)·Vt with U (n,r), S (r,), Vt (r,m), r=min(n,m).
@@ -48,19 +60,11 @@ def thin_svd(X, method="auto"):
         return U, S, Vt
     if n >= m:
         G = X.T @ X  # (m, m) — one big MXU GEMM
-        evals, V = jnp.linalg.eigh(G)  # ascending
-        evals = jnp.flip(evals, 0)
-        V = jnp.flip(V, 1)
-        S = jnp.sqrt(jnp.maximum(evals, 0.0))
-        safe = jnp.where(S > 0, S, 1.0)
+        S, V, safe = gram_spectrum(G)
         U = (X @ V) / safe[None, :]
         return U, S, V.T
     G = X @ X.T  # (n, n)
-    evals, U = jnp.linalg.eigh(G)
-    evals = jnp.flip(evals, 0)
-    U = jnp.flip(U, 1)
-    S = jnp.sqrt(jnp.maximum(evals, 0.0))
-    safe = jnp.where(S > 0, S, 1.0)
+    S, U, safe = gram_spectrum(G)
     Vt = (U.T @ X) / safe[:, None]
     return U, S, Vt
 

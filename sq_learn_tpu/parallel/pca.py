@@ -18,7 +18,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from ..ops.linalg import svd_flip
+from ..ops.linalg import gram_spectrum, svd_flip
 from .mesh import pad_to_multiple, shard_rows
 
 
@@ -35,15 +35,12 @@ def _masked_centered_svd(X, w, n):
     mean = jnp.sum(wX, axis=0) / n
     Xc = (X - mean) * w[:, None]
     G = Xc.T @ Xc  # (m, m) — per-shard GEMM + psum
-    evals, V = jnp.linalg.eigh(G)  # replicated
+    S, V, safe = gram_spectrum(G)  # replicated
     # thin spectrum: the feature Gram has m eigenvalues but only
     # min(n, m) can be nonzero; slice so the factors match the
     # single-device thin SVD's shapes (n and m are static here)
     r = min(n, X.shape[1])
-    evals = jnp.flip(evals, 0)[:r]
-    V = jnp.flip(V, 1)[:, :r]
-    S = jnp.sqrt(jnp.maximum(evals, 0.0))
-    safe = jnp.where(S > 0, S, 1.0)
+    S, V, safe = S[:r], V[:, :r], safe[:r]
     U = (Xc @ V) / safe[None, :]  # row-sharded
     U, Vt = svd_flip(U, V.T)
     return mean, U, S, Vt
@@ -65,3 +62,19 @@ def centered_svd_sharded(mesh, X):
     Xp, mask = shard_rows(mesh, Xp, mask)
     mean, U, S, Vt = _masked_centered_svd(Xp, mask, n)
     return mean, U[:n], S, Vt
+
+
+def centered_sharded(mesh, X, mean):
+    """Row-sharded centered copy of X with padding rows exactly zero.
+
+    For reductions that must see the centered matrix (e.g. the μ(A) norm
+    grid) without ever replicating it onto one device: zero rows contribute
+    nothing to μ's power sums or the Frobenius norm, so downstream jnp
+    reductions over this array equal those over the unpadded centered X.
+    """
+    X = jnp.asarray(X)
+    n = X.shape[0]
+    Xp, _ = pad_to_multiple(X, int(mesh.devices.size))
+    mask = jnp.zeros((Xp.shape[0],), Xp.dtype).at[:n].set(1.0)
+    Xp, mask = shard_rows(mesh, Xp, mask)
+    return (Xp - jnp.asarray(mean)) * mask[:, None]
