@@ -31,7 +31,8 @@ from jax import lax
 
 from .._config import as_device_array, with_device_scope
 from ..base import BaseEstimator, ClusterMixin, TransformerMixin, check_is_fitted
-from ..ops.linalg import pairwise_sq_distances, row_norms, smallest_singular_value
+from ..ops.linalg import (inner_product, pairwise_sq_distances, row_norms,
+                          smallest_singular_value)
 from ..ops.quantum import tomography
 from ..ops.quantum.estimation import ipe
 from ..utils import as_key, check_array, check_sample_weight
@@ -104,28 +105,51 @@ def fit_prestats(X, *, quantum=False, mu_grid=()):
 
 
 def e_step(key, X, weights, centers, x_sq_norms, *, delta, mode, ipe_q,
-           axis_name=None):
+           axis_name=None, compute_dtype=None):
     """Assignment step with the quantum error model.
 
     Returns (labels, inertia, min_d2). ``weights`` masks padded rows (0) and
     carries sample weights. With ``axis_name``, X/weights/x_sq_norms are the
-    local shard and inertia is psum-reduced.
+    local shard and inertia is psum-reduced. ``compute_dtype`` (a dtype
+    name, e.g. 'bfloat16') runs the distance GEMM in that format with
+    input-dtype accumulation — the MXU-native precision trade
+    (:func:`~sq_learn_tpu.ops.linalg.pairwise_sq_distances`); selection
+    runs on the cheap distances, the selected distance is recomputed
+    exactly.
     """
+    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else None
+    reduced = cd is not None and cd != jnp.dtype(X.dtype)
     if axis_name is not None:
         key = jax.random.fold_in(key, lax.axis_index(axis_name))
     if mode == "ipe":
         c_sq = row_norms(centers, squared=True)
-        inner = X @ centers.T  # MXU
+        inner = inner_product(X, centers, cd)
         key, sub = jax.random.split(key)
         est_ip = ipe(sub, x_sq_norms[:, None], c_sq[None, :], inner,
                      epsilon=delta / 2, Q=ipe_q)
         d2 = x_sq_norms[:, None] + c_sq[None, :] - 2.0 * est_ip
         window = 0.0
     else:
-        d2 = pairwise_sq_distances(X, centers, x_sq_norms)
+        d2 = pairwise_sq_distances(X, centers, x_sq_norms, compute_dtype=cd)
         window = delta if mode == "delta" else 0.0
 
-    min_d2 = jnp.min(d2, axis=1)
+    # the window/tie mask must use the SAME precision as d2: an exact
+    # minimum can undercut every reduced-precision entry, emptying the
+    # mask (all -inf logits silently collapse to label 0)
+    noisy_min = jnp.min(d2, axis=1)
+    if reduced and mode != "ipe":
+        # reduced precision is fine for the argmin (selection is robust to
+        # bf16 noise) but NOT for the distance values: d2 cancels three
+        # O(‖x‖‖c‖) terms, so near-centroid distances inherit the absolute
+        # GEMM error and inertia would be biased ~bf16-eps·‖x‖‖c‖. One
+        # O(n·m) gather + row-dot recomputes the selected distance exactly.
+        idx = jnp.argmin(d2, axis=1)
+        c_min = centers[idx]
+        min_d2 = jnp.maximum(
+            x_sq_norms + row_norms(c_min, squared=True)
+            - 2.0 * jnp.sum(X * c_min, axis=1), 0.0)
+    else:
+        min_d2 = noisy_min
     if mode == "classic":
         # deterministic argmin (the reference's classical path) — skips the
         # per-iteration Gumbel sampling entirely
@@ -134,7 +158,7 @@ def e_step(key, X, weights, centers, x_sq_norms, *, delta, mode, ipe_q,
         # uniform pick among centroids within `window` of the min (δ-means
         # tie-break; for the ipe mode window=0 picks uniformly among exact
         # ties of the estimated distances)
-        mask = d2 <= (min_d2[:, None] + window)
+        mask = d2 <= (noisy_min[:, None] + window)
         logits = jnp.where(mask, 0.0, -jnp.inf)
         labels = jax.random.categorical(key, logits, axis=1).astype(jnp.int32)
     inertia = jnp.sum(min_d2 * weights)
@@ -232,7 +256,8 @@ def m_step(key, X, weights, labels, old_centers, *, delta,
 def lloyd_single(key, X, weights, centers_init, x_sq_norms, *, delta=0.0,
                  mode="classic", max_iter=300, tol=1e-4, patience=None,
                  intermediate_error=False, true_tomography=True, ipe_q=5,
-                 axis_name=None, use_pallas=False, pallas_interpret=False):
+                 axis_name=None, use_pallas=False, pallas_interpret=False,
+                 compute_dtype=None):
     """One full q-means run (reference ``_kmeans_single_lloyd``,
     ``_dmeans.py:534-671``) as a single on-device ``lax.while_loop``.
 
@@ -260,8 +285,14 @@ def lloyd_single(key, X, weights, centers_init, x_sq_norms, *, delta=0.0,
         raise ValueError(f"mode must be one of {LloydMode}, got {mode!r}")
 
     estep = functools.partial(e_step, delta=delta, mode=mode, ipe_q=ipe_q,
-                              axis_name=axis_name)
-    fused = use_pallas and mode in ("classic", "delta")
+                              axis_name=axis_name,
+                              compute_dtype=compute_dtype)
+    # the hand-tiled kernel computes its own fused distances in the input
+    # dtype; a REDUCED compute_dtype routes through the XLA path, whose
+    # bf16 GEMM + fusion is the equivalent bandwidth saving
+    reduced_cd = (compute_dtype is not None
+                  and jnp.dtype(compute_dtype) != jnp.dtype(X.dtype))
+    fused = use_pallas and mode in ("classic", "delta") and not reduced_cd
     k = centers_init.shape[0]
 
     def cond(state):
@@ -485,7 +516,7 @@ lloyd_single_jit = jax.jit(
     static_argnames=(
         "delta", "mode", "max_iter", "patience", "intermediate_error",
         "true_tomography", "ipe_q", "axis_name", "use_pallas",
-        "pallas_interpret",
+        "pallas_interpret", "compute_dtype",
     ),
 )
 
@@ -495,13 +526,13 @@ lloyd_single_jit = jax.jit(
     static_argnames=("n_init", "init", "n_clusters", "delta", "mode",
                      "max_iter", "patience", "intermediate_error",
                      "true_tomography", "ipe_q", "use_pallas",
-                     "pallas_interpret"),
+                     "pallas_interpret", "compute_dtype"),
 )
 def lloyd_restarts(key, X, weights, x_sq_norms, *, n_init, init, n_clusters,
                    delta=0.0, mode="classic", max_iter=300, tol=1e-4,
                    patience=None, intermediate_error=False,
                    true_tomography=True, ipe_q=5, use_pallas=False,
-                   pallas_interpret=False):
+                   pallas_interpret=False, compute_dtype=None):
     """All ``n_init`` restarts as ONE vmapped kernel.
 
     The reference (and classical sklearn) loops restarts on the host; on an
@@ -530,7 +561,8 @@ def lloyd_restarts(key, X, weights, x_sq_norms, *, n_init, init, n_clusters,
         lloyd_single, delta=delta, mode=mode, max_iter=max_iter, tol=tol,
         patience=patience, intermediate_error=intermediate_error,
         true_tomography=true_tomography, ipe_q=ipe_q,
-        use_pallas=use_pallas, pallas_interpret=pallas_interpret)
+        use_pallas=use_pallas, pallas_interpret=pallas_interpret,
+        compute_dtype=compute_dtype)
     labels, inertia, centers, n_iter, history = jax.vmap(
         lambda k, c0: run(k, X, weights, c0, x_sq_norms))(run_keys, centers0)
     best = jnp.argmin(inertia)
@@ -542,12 +574,13 @@ def lloyd_restarts(key, X, weights, x_sq_norms, *, n_init, init, n_clusters,
     static_argnames=("n_init", "init", "n_clusters", "quantum", "mu_grid",
                      "delta", "mode", "max_iter", "patience",
                      "intermediate_error", "true_tomography", "ipe_q",
-                     "use_pallas", "pallas_interpret"),
+                     "use_pallas", "pallas_interpret", "compute_dtype"),
 )
 def fit_fused(key, X, weights, tol_factor, *, n_init, init, n_clusters,
               quantum, mu_grid=(), delta=0.0, mode="classic", max_iter=300,
               patience=None, intermediate_error=False, true_tomography=True,
-              ipe_q=5, use_pallas=False, pallas_interpret=False):
+              ipe_q=5, use_pallas=False, pallas_interpret=False,
+              compute_dtype=None):
     """The ENTIRE q-means fit as ONE device dispatch.
 
     On a tunneled accelerator every launch and every device→host fetch pays
@@ -580,7 +613,7 @@ def fit_fused(key, X, weights, tol_factor, *, n_init, init, n_clusters,
         n_clusters=n_clusters, delta=delta, mode=mode, max_iter=max_iter,
         tol=tol, patience=patience, intermediate_error=intermediate_error,
         true_tomography=true_tomography, ipe_q=ipe_q, use_pallas=use_pallas,
-        pallas_interpret=pallas_interpret)
+        pallas_interpret=pallas_interpret, compute_dtype=compute_dtype)
     pdt = X.dtype
     parts = [jnp.stack([inertia.astype(pdt), n_iter.astype(pdt),
                         stats["var_mean"].astype(pdt)])]
@@ -596,7 +629,8 @@ def fit_fused(key, X, weights, tol_factor, *, n_init, init, n_clusters,
 
 # module-level jitted E-step for inference (one compile cache per process)
 e_step_jit = jax.jit(
-    e_step, static_argnames=("delta", "mode", "ipe_q", "axis_name")
+    e_step, static_argnames=("delta", "mode", "ipe_q", "axis_name",
+                             "compute_dtype")
 )
 
 
@@ -630,6 +664,14 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
     ('auto' = 20 on noisy fits, disabled on classical ones, where shift≤tol
     terminates). After ``fit``, ``fit_history_`` holds the winning restart's
     per-iteration ``{"inertia", "center_shift"}`` traces.
+
+    ``compute_dtype`` (None | 'bfloat16' | 'float16' | 'float32') is a
+    performance hint: run the E-step distance GEMM in the MXU-native
+    reduced precision (accumulation in the input dtype; norms, M-step,
+    inertia, and the selected distances stay exact). It halves the HBM
+    read of the dominant factor on large inputs; a compute_dtype equal to
+    the input dtype is a no-op. The CPU host fast path always computes in
+    float32 — a precision superset, so results remain valid.
     """
 
     def __init__(self, n_clusters=8, *, init="k-means++", n_init=10,
@@ -638,7 +680,7 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
                  intermediate_error=False, true_tomography=True,
                  stop_when_reached_accuracy=True, multiprocess=False,
                  true_distance_estimate=True, ipe_q=5, mesh=None,
-                 use_pallas="auto"):
+                 use_pallas="auto", compute_dtype=None):
         self.n_clusters = n_clusters
         self.init = init
         self.n_init = n_init
@@ -658,6 +700,7 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         self.ipe_q = ipe_q
         self.mesh = mesh
         self.use_pallas = use_pallas
+        self.compute_dtype = compute_dtype
 
     # -- validation ---------------------------------------------------------
 
@@ -845,7 +888,8 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
                   max_iter=self.max_iter,
                   patience=self._resolved_patience(mode),
                   intermediate_error=self.intermediate_error,
-                  true_tomography=self.true_tomography, ipe_q=self.ipe_q)
+                  true_tomography=self.true_tomography, ipe_q=self.ipe_q,
+                  compute_dtype=self._checked_compute_dtype())
         def run(up, itp):
             # the fetch stays inside the attempt: dispatch is asynchronous,
             # so a runtime kernel failure surfaces at transfer time
@@ -924,6 +968,19 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
                     f"({type(exc).__name__}: {exc}); {nxt}", RuntimeWarning)
         return None
 
+    def _checked_compute_dtype(self):
+        """Validate the compute_dtype hyperparameter to a dtype name (or
+        None). Only reduced-precision floats make sense — the point is the
+        MXU-native GEMM format."""
+        if self.compute_dtype is None:
+            return None
+        name = jnp.dtype(self.compute_dtype).name
+        if name not in ("bfloat16", "float16", "float32"):
+            raise ValueError(
+                f"compute_dtype must be None or a float dtype "
+                f"(bfloat16/float16/float32), got {self.compute_dtype!r}")
+        return name
+
     def _resolve_pallas(self):
         """Resolve the ``use_pallas`` hyperparameter to (use_pallas,
         interpret): 'auto' engages the fused kernel where pallas is lowered
@@ -944,7 +1001,8 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
                       patience=self._resolved_patience(mode),
                       intermediate_error=self.intermediate_error,
                       true_tomography=self.true_tomography, ipe_q=self.ipe_q,
-                      use_pallas=use_pallas, pallas_interpret=interpret)
+                      use_pallas=use_pallas, pallas_interpret=interpret,
+                      compute_dtype=self._checked_compute_dtype())
         Xd = jnp.asarray(Xc)
         w = jnp.asarray(sample_weight, Xd.dtype)
 

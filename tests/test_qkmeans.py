@@ -527,3 +527,67 @@ class TestFusedFitPath:
         assert not QKMeans(n_clusters=4, verbose=1)._fused_fit_ok()
         assert not QKMeans(
             n_clusters=4, init=np.zeros((4, 2), np.float32))._fused_fit_ok()
+
+
+class TestComputeDtype:
+    """Reduced-precision E-step GEMM (compute_dtype) — a performance hint
+    that must not change clustering outcomes on resolvable separations."""
+
+    def test_bfloat16_matches_f32_on_blobs(self, blobs):
+        X, y = blobs
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            ref = QKMeans(n_clusters=4, n_init=3, random_state=0,
+                          use_pallas=False).fit(X)
+            bf = QKMeans(n_clusters=4, n_init=3, random_state=0,
+                         use_pallas=False, compute_dtype="bfloat16").fit(X)
+        assert sklearn.metrics.adjusted_rand_score(
+            ref.labels_, bf.labels_) == 1.0
+        np.testing.assert_allclose(bf.inertia_, ref.inertia_, rtol=1e-2)
+
+    def test_fused_path_with_bfloat16(self, blobs):
+        X, y = blobs
+        est = QKMeans(n_clusters=4, n_init=3, delta=0.4,
+                      true_distance_estimate=False, random_state=0,
+                      compute_dtype="bfloat16")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            assert est._fit_fused(X, np.ones(len(X), np.float32),
+                                  0.4, "delta") is est
+        assert sklearn.metrics.adjusted_rand_score(y, est.labels_) > 0.9
+
+    def test_invalid_dtype_rejected(self, blobs):
+        X, _ = blobs
+        est = QKMeans(n_clusters=4, compute_dtype="int8")
+        with pytest.raises(ValueError, match="compute_dtype"):
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                est.fit(X)
+
+    def test_pairwise_compute_dtype(self):
+        from sq_learn_tpu.ops.linalg import pairwise_sq_distances
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(64, 32)).astype(np.float32)
+        C = rng.normal(size=(5, 32)).astype(np.float32)
+        d32 = np.asarray(pairwise_sq_distances(X, C))
+        dbf = np.asarray(pairwise_sq_distances(
+            X, C, compute_dtype=jnp.bfloat16))
+        assert dbf.dtype == np.float32
+        # bf16 mantissa is 8 bits: relative error ~1e-2 on the inner term
+        np.testing.assert_allclose(dbf, d32, rtol=0.05, atol=0.5)
+
+    def test_delta_window_survives_large_norms(self):
+        # review scenario: large-norm data makes the bf16 GEMM error exceed
+        # delta; the window must compare against the same-precision min or
+        # rows collapse into label 0
+        rng = np.random.default_rng(0)
+        centers = rng.normal(scale=50.0, size=(4, 64)).astype(np.float32)
+        X = np.vstack([c + rng.normal(scale=1.0, size=(100, 64))
+                       for c in centers]).astype(np.float32)
+        y = np.repeat(np.arange(4), 100)
+        est = QKMeans(n_clusters=4, n_init=3, delta=0.5,
+                      true_distance_estimate=False, random_state=0,
+                      compute_dtype="bfloat16", use_pallas=False).fit(X)
+        counts = np.bincount(est.labels_, minlength=4)
+        assert counts.max() < 200, counts  # no collapse into one label
+        assert sklearn.metrics.adjusted_rand_score(y, est.labels_) > 0.95
