@@ -1,0 +1,24 @@
+#!/bin/bash
+# Run the full BASELINE bench suite (headline + configs #2-#5) and collect
+# the JSON lines into one file. Each script probes the accelerator in a
+# subprocess and falls back to CPU if the tunnel is wedged, recording
+# whichever backend actually ran.
+#
+# Usage: bash bench/run_suite.sh [outfile]   (default /tmp/bench_suite_run.txt)
+set -u
+cd "$(dirname "$0")/.."
+out="${1:-/tmp/bench_suite_run.txt}"
+: > "$out"
+echo "# suite run $(date -Is)" >> "$out"
+for cmd in "python bench.py" \
+           "python -m bench.bench_qpca_mnist" \
+           "python -m bench.bench_qkmeans_mnist" \
+           "python -m bench.bench_randomized_svd_covtype" \
+           "python -m bench.bench_qkmeans_cicids_sweep"; do
+  echo "## $cmd" >> "$out"
+  timeout 1200 $cmd >> "$out" 2>/tmp/bench_last_stderr.txt
+  rc=$?
+  tail -3 /tmp/bench_last_stderr.txt | sed 's/^/# stderr: /' >> "$out"
+  echo "# rc=$rc" >> "$out"
+done
+echo "done: $out"

@@ -18,16 +18,23 @@ from jax import lax
 
 from .._config import as_device_array, with_device_scope
 from ..base import BaseEstimator, ClassifierMixin, check_is_fitted
-from ..ops.linalg import pairwise_sq_distances
+from ..ops.linalg import (check_compute_dtype, is_reduced,
+                          pairwise_sq_distances)
 from ..utils import check_array, check_X_y
 
 
-@functools.partial(jax.jit, static_argnames=("k", "block"))
-def knn_indices(X_train, X_query, k, block=4096):
+@functools.partial(jax.jit, static_argnames=("k", "block", "compute_dtype"))
+def knn_indices(X_train, X_query, k, block=4096, compute_dtype=None):
     """Indices + squared distances of the k nearest training rows per query.
 
     Blocks over queries with ``lax.map`` so the (n_query, n_train) distance
-    matrix never fully materializes for large query sets.
+    matrix never fully materializes for large query sets. ``compute_dtype``
+    makes the search approximate-then-exact: the big GEMM runs in reduced
+    precision to shortlist 4k+16 candidates (the bf16 absolute error can
+    exceed true neighbor gaps, so a bare top-k would mis-select), then the
+    candidates' distances are recomputed exactly and the true top-k among
+    them is returned. Recall is not formally 1.0 but misses need a
+    candidate displaced past 3k+16 closer rows by O(eps·‖x‖‖c‖) noise.
     """
     nq = X_query.shape[0]
     # small query sets (CV folds, interactive predicts) pad only to a lane
@@ -36,10 +43,27 @@ def knn_indices(X_train, X_query, k, block=4096):
     pad = (-nq) % block
     Xq = jnp.pad(X_query, ((0, pad), (0, 0)))
 
+    # a shortlist the size of the training set has nothing to prune: the
+    # exact single-GEMM path is strictly cheaper then, so the reduced
+    # dtype is dropped entirely
+    reduced = (is_reduced(compute_dtype, X_train.dtype)
+               and 4 * k + 16 < X_train.shape[0])
+    if not reduced:
+        compute_dtype = None
+    kc = 4 * k + 16
+
     def one_block(q):
-        d2 = pairwise_sq_distances(q, X_train)
-        neg, idx = lax.top_k(-d2, k)
-        return idx, -neg
+        d2 = pairwise_sq_distances(q, X_train, compute_dtype=compute_dtype)
+        if not reduced:
+            neg, idx = lax.top_k(-d2, k)
+            return idx, -neg
+        # shortlist in reduced precision, refine exactly
+        _, cand = lax.top_k(-d2, kc)  # (block, kc)
+        sel = X_train[cand]  # (block, kc, m)
+        d = jnp.maximum(
+            jnp.sum((q[:, None, :] - sel) ** 2, axis=-1), 0.0)
+        negk, within = lax.top_k(-d, k)
+        return jnp.take_along_axis(cand, within, 1), -negk
 
     blocks = Xq.reshape(-1, block, Xq.shape[1])
     idx, d2 = lax.map(one_block, blocks)
@@ -55,16 +79,18 @@ class KNeighborsClassifier(ClassifierMixin, BaseEstimator):
     """
 
     def __init__(self, n_neighbors=5, *, weights="uniform",
-                 algorithm="brute", p=2, n_jobs=None):
+                 algorithm="brute", p=2, n_jobs=None, compute_dtype=None):
         self.n_neighbors = n_neighbors
         self.weights = weights
         self.algorithm = algorithm
         self.p = p
         self.n_jobs = n_jobs
+        self.compute_dtype = compute_dtype
 
     @with_device_scope
     def fit(self, X, y):
         X, y = check_X_y(X, y)
+        check_compute_dtype(self.compute_dtype)
         self.classes_, y_enc = np.unique(y, return_inverse=True)
         self.X_fit_ = as_device_array(X)  # set_config(device=...) placement
         self.y_fit_ = jnp.asarray(y_enc.astype(np.int32))
@@ -76,7 +102,8 @@ class KNeighborsClassifier(ClassifierMixin, BaseEstimator):
         check_is_fitted(self, "n_samples_fit_")
         X = check_array(X)
         k = n_neighbors or self.n_neighbors
-        idx, d2 = knn_indices(self.X_fit_, jnp.asarray(X), k)
+        idx, d2 = knn_indices(self.X_fit_, jnp.asarray(X), k,
+                              compute_dtype=self.compute_dtype)
         if return_distance:
             return np.sqrt(np.asarray(d2)), np.asarray(idx)
         return np.asarray(idx)
@@ -85,7 +112,8 @@ class KNeighborsClassifier(ClassifierMixin, BaseEstimator):
     def predict_proba(self, X):
         check_is_fitted(self, "n_samples_fit_")
         X = check_array(X)
-        idx, d2 = knn_indices(self.X_fit_, jnp.asarray(X), self.n_neighbors)
+        idx, d2 = knn_indices(self.X_fit_, jnp.asarray(X), self.n_neighbors,
+                              compute_dtype=self.compute_dtype)
         votes = self.y_fit_[idx]  # (n, k)
         n_classes = len(self.classes_)
         onehot = jax.nn.one_hot(votes, n_classes)

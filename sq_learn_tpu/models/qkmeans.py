@@ -31,7 +31,8 @@ from jax import lax
 
 from .._config import as_device_array, with_device_scope
 from ..base import BaseEstimator, ClusterMixin, TransformerMixin, check_is_fitted
-from ..ops.linalg import (inner_product, pairwise_sq_distances, row_norms,
+from ..ops.linalg import (check_compute_dtype, inner_product, is_reduced,
+                          pairwise_sq_distances, row_norms,
                           smallest_singular_value)
 from ..ops.quantum import tomography
 from ..ops.quantum.estimation import ipe
@@ -118,7 +119,7 @@ def e_step(key, X, weights, centers, x_sq_norms, *, delta, mode, ipe_q,
     exactly.
     """
     cd = jnp.dtype(compute_dtype) if compute_dtype is not None else None
-    reduced = cd is not None and cd != jnp.dtype(X.dtype)
+    reduced = is_reduced(cd, X.dtype)
     if axis_name is not None:
         key = jax.random.fold_in(key, lax.axis_index(axis_name))
     if mode == "ipe":
@@ -290,9 +291,8 @@ def lloyd_single(key, X, weights, centers_init, x_sq_norms, *, delta=0.0,
     # the hand-tiled kernel computes its own fused distances in the input
     # dtype; a REDUCED compute_dtype routes through the XLA path, whose
     # bf16 GEMM + fusion is the equivalent bandwidth saving
-    reduced_cd = (compute_dtype is not None
-                  and jnp.dtype(compute_dtype) != jnp.dtype(X.dtype))
-    fused = use_pallas and mode in ("classic", "delta") and not reduced_cd
+    fused = (use_pallas and mode in ("classic", "delta")
+             and not is_reduced(compute_dtype, X.dtype))
     k = centers_init.shape[0]
 
     def cond(state):
@@ -667,11 +667,13 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
 
     ``compute_dtype`` (None | 'bfloat16' | 'float16' | 'float32') is a
     performance hint: run the E-step distance GEMM in the MXU-native
-    reduced precision (accumulation in the input dtype; norms, M-step,
-    inertia, and the selected distances stay exact). It halves the HBM
-    read of the dominant factor on large inputs; a compute_dtype equal to
-    the input dtype is a no-op. The CPU host fast path always computes in
-    float32 — a precision superset, so results remain valid.
+    reduced precision (accumulation in the input dtype). In the classic
+    and δ-means modes norms, M-step, inertia, and the selected distances
+    stay exact (selection runs on the cheap distances, the winner is
+    recomputed); in the IPE mode the reduced GEMM feeds the quantum noise
+    model directly, adding unmodeled O(eps·‖x‖‖c‖) error on top of δ/2 —
+    a warning says so. Equal to the input dtype is a no-op. The CPU host
+    fast path always computes in float32 — a precision superset.
     """
 
     def __init__(self, n_clusters=8, *, init="k-means++", n_init=10,
@@ -772,6 +774,14 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
                 raise ValueError(
                     "intermediate_error cannot be True if delta is zero.")
         sample_weight = check_sample_weight(sample_weight, X)
+        cd = self._checked_compute_dtype()
+        if cd is not None and self._mode(delta) == "ipe" \
+                and np.dtype(cd) != X.dtype:
+            warnings.warn(
+                "compute_dtype with true_distance_estimate (IPE mode) feeds "
+                "reduced-precision inner products into the quantum noise "
+                "model — an unmodeled O(eps·‖x‖‖c‖) error on top of δ/2.",
+                RuntimeWarning)
 
         # accelerator fast path: the whole fit (prestats + restarts +
         # packing) as ONE dispatch and ONE fetch — see fit_fused. Falls
@@ -969,17 +979,9 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         return None
 
     def _checked_compute_dtype(self):
-        """Validate the compute_dtype hyperparameter to a dtype name (or
-        None). Only reduced-precision floats make sense — the point is the
-        MXU-native GEMM format."""
-        if self.compute_dtype is None:
-            return None
-        name = jnp.dtype(self.compute_dtype).name
-        if name not in ("bfloat16", "float16", "float32"):
-            raise ValueError(
-                f"compute_dtype must be None or a float dtype "
-                f"(bfloat16/float16/float32), got {self.compute_dtype!r}")
-        return name
+        """Validate compute_dtype (shared rule:
+        :func:`~sq_learn_tpu.ops.linalg.check_compute_dtype`)."""
+        return check_compute_dtype(self.compute_dtype)
 
     def _resolve_pallas(self):
         """Resolve the ``use_pallas`` hyperparameter to (use_pallas,
@@ -1153,7 +1155,8 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
             key, Xd, jnp.ones(X.shape[0], X.dtype),
             as_device_array(np.asarray(self.cluster_centers_, X.dtype)),
             row_norms(Xd, squared=True),
-            delta=delta, mode=self._mode(delta), ipe_q=self.ipe_q)
+            delta=delta, mode=self._mode(delta), ipe_q=self.ipe_q,
+            compute_dtype=self._checked_compute_dtype())
         return np.asarray(labels)
 
     @with_device_scope

@@ -111,13 +111,34 @@ def randomized_svd(key, X, n_components, n_oversamples=10, n_iter=4, flip=True):
     return U[:, :n_components], S[:n_components], Vt[:n_components]
 
 
+def is_reduced(compute_dtype, dtype):
+    """True when ``compute_dtype`` actually lowers precision relative to
+    ``dtype`` (None or the same dtype is a no-op). The one predicate every
+    reduced-precision code path gates on."""
+    return compute_dtype is not None and jnp.dtype(compute_dtype) != jnp.dtype(dtype)
+
+
+def check_compute_dtype(value):
+    """Validate a ``compute_dtype`` hyperparameter to a dtype name (or
+    None). Only float formats make sense — the point is the MXU-native
+    GEMM precision; anything else silently truncates features."""
+    if value is None:
+        return None
+    name = jnp.dtype(value).name
+    if name not in ("bfloat16", "float16", "float32"):
+        raise ValueError(
+            f"compute_dtype must be None or a float dtype "
+            f"(bfloat16/float16/float32), got {value!r}")
+    return name
+
+
 def inner_product(X, C, compute_dtype=None):
     """X·Cᵀ, optionally with the operands cast to a reduced
     ``compute_dtype`` (e.g. ``jnp.bfloat16`` — the MXU's native format,
     halving the HBM read of the dominant factor) while the products
     accumulate in the input dtype (``preferred_element_type``). One
     definition for every reduced-precision GEMM in the package."""
-    if compute_dtype is None or jnp.dtype(compute_dtype) == X.dtype:
+    if not is_reduced(compute_dtype, X.dtype):
         return X @ C.T
     return jax.lax.dot_general(
         X.astype(compute_dtype), C.astype(compute_dtype),

@@ -591,3 +591,60 @@ class TestComputeDtype:
         counts = np.bincount(est.labels_, minlength=4)
         assert counts.max() < 200, counts  # no collapse into one label
         assert sklearn.metrics.adjusted_rand_score(y, est.labels_) > 0.95
+
+    def test_knn_compute_dtype(self, blobs):
+        from sq_learn_tpu.models import KNeighborsClassifier
+        X, y = blobs
+        ref = KNeighborsClassifier(n_neighbors=5).fit(X[:300], y[:300])
+        bf = KNeighborsClassifier(n_neighbors=5,
+                                  compute_dtype="bfloat16").fit(X[:300], y[:300])
+        # same predictions; shortlist-then-refine keeps near-exact recall
+        np.testing.assert_array_equal(ref.predict(X[300:]), bf.predict(X[300:]))
+        d_ref, i_ref = ref.kneighbors(X[300:])
+        d_bf, i_bf = bf.kneighbors(X[300:])
+        recall = np.mean([len(set(a) & set(b)) / 5.0
+                          for a, b in zip(i_ref, i_bf)])
+        assert recall >= 0.98, recall
+        # distances of the returned neighbors are exact (refined), so the
+        # k-th distance can only exceed the true k-th by a missed candidate
+        # (tolerance: the refine path uses the difference form, the exact
+        # path the GEMM trick — f32 noise of order eps·‖x‖² apart)
+        assert np.all(d_bf[:, -1] >= d_ref[:, -1] - 1e-3)
+        np.testing.assert_allclose(d_bf[:, 0], d_ref[:, 0], rtol=1e-3,
+                                   atol=1e-3)
+
+    def test_knn_invalid_dtype_rejected(self, blobs):
+        from sq_learn_tpu.models import KNeighborsClassifier
+        X, y = blobs
+        with pytest.raises(ValueError, match="compute_dtype"):
+            KNeighborsClassifier(compute_dtype="int8").fit(X, y)
+
+    def test_knn_tiny_train_set_exact(self):
+        # n_train <= 4k+16: the shortlist has nothing to prune; the kernel
+        # must fall through to the exact path (identical results)
+        from sq_learn_tpu.models import KNeighborsClassifier
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(30, 8)).astype(np.float32)
+        y = (rng.random(30) > 0.5).astype(int)
+        Q = rng.normal(size=(10, 8)).astype(np.float32)
+        ref = KNeighborsClassifier(n_neighbors=5).fit(X, y)
+        bf = KNeighborsClassifier(n_neighbors=5,
+                                  compute_dtype="bfloat16").fit(X, y)
+        d_ref, i_ref = ref.kneighbors(Q)
+        d_bf, i_bf = bf.kneighbors(Q)
+        np.testing.assert_array_equal(i_ref, i_bf)
+        np.testing.assert_allclose(d_ref, d_bf, rtol=1e-5)
+
+    def test_ipe_mode_warns(self, blobs):
+        X, _ = blobs
+        with pytest.warns(RuntimeWarning, match="IPE mode"):
+            QKMeans(n_clusters=4, n_init=1, delta=0.5, max_iter=5,
+                    compute_dtype="bfloat16", random_state=0).fit(X)
+
+    def test_predict_uses_compute_dtype(self, blobs):
+        # fit and predict must agree on the same (reduced) precision
+        X, _ = blobs
+        est = QKMeans(n_clusters=4, n_init=2, random_state=0,
+                      use_pallas=False, compute_dtype="bfloat16").fit(X)
+        assert sklearn.metrics.adjusted_rand_score(
+            est.predict(X), est.labels_) == 1.0
