@@ -6,8 +6,9 @@
 #
 # Usage: bash bench/run_suite.sh [outfile]   (default /tmp/bench_suite_run.txt)
 set -u
-cd "$(dirname "$0")/.."
 out="${1:-/tmp/bench_suite_run.txt}"
+case "$out" in /*) ;; *) out="$(pwd)/$out" ;; esac  # resolve before the cd
+cd "$(dirname "$0")/.."
 : > "$out"
 echo "# suite run $(date -Is)" >> "$out"
 for cmd in "python bench.py" \

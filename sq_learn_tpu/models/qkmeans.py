@@ -775,8 +775,7 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
                     "intermediate_error cannot be True if delta is zero.")
         sample_weight = check_sample_weight(sample_weight, X)
         cd = self._checked_compute_dtype()
-        if cd is not None and self._mode(delta) == "ipe" \
-                and np.dtype(cd) != X.dtype:
+        if self._mode(delta) == "ipe" and is_reduced(cd, X.dtype):
             warnings.warn(
                 "compute_dtype with true_distance_estimate (IPE mode) feeds "
                 "reduced-precision inner products into the quantum noise "

@@ -72,6 +72,19 @@ def main():
             mesh=mesh, in_specs=P(DATA_AXIS), out_specs=P())(wg)
 
     assert float(total_weight(wg)) == n, float(total_weight(wg))
+
+    # the sharded qPCA SVD kernel on the cross-process global mesh: the
+    # Gram contraction reduces across DCN; only the replicated outputs
+    # (spectrum, Vt) are fetched — U stays host-sharded
+    from sq_learn_tpu.parallel.pca import _masked_centered_svd
+
+    mean, U, S, Vt = _masked_centered_svd(Xg, wg, n)
+    Xc = X - X.mean(axis=0)
+    S_ref = np.linalg.svd(Xc, compute_uv=False)
+    np.testing.assert_allclose(np.asarray(S), S_ref, rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(mean), X.mean(axis=0),
+                               rtol=1e-5, atol=1e-5)
+
     print(f"worker {pid} OK", flush=True)
 
 
