@@ -11,6 +11,15 @@ Run: python examples/mnist_trial.py [--n-components 61] [--eps-delta 0.8]
      [--subsample 10000]
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _common import ensure_backend  # noqa: E402
+
+ensure_backend()
+
+
 import argparse
 import time
 

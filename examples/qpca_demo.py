@@ -8,6 +8,15 @@ compare quantum-vs-classical theoretical runtime surfaces.
 Run: python examples/qpca_demo.py
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _common import ensure_backend  # noqa: E402
+
+ensure_backend()
+
+
 import warnings
 
 import numpy as np

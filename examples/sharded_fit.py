@@ -19,6 +19,15 @@ first and build the mesh from ``global_mesh()`` — see
 Run: python examples/sharded_fit.py
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _common import ensure_backend  # noqa: E402
+
+ensure_backend()
+
+
 import warnings
 
 import numpy as np

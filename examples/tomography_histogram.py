@@ -10,6 +10,15 @@ Run: python examples/tomography_histogram.py [--dim 784] [--delta 0.1]
      [--trials 64] [--save hist.png]
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _common import ensure_backend  # noqa: E402
+
+ensure_backend()
+
+
 import argparse
 import time
 

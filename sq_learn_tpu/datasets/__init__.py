@@ -10,6 +10,9 @@ benchmark hosts have zero egress.
 """
 
 from ._loaders import (
+    Bunch,
+    fetch_covtype,
+    fetch_openml,
     load_cicids,
     load_covtype,
     load_digits,
@@ -19,6 +22,9 @@ from ._loaders import (
 )
 
 __all__ = [
+    "Bunch",
+    "fetch_covtype",
+    "fetch_openml",
     "load_cicids",
     "load_covtype",
     "load_digits",

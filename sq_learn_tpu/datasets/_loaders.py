@@ -180,3 +180,59 @@ def make_blobs(n_samples=400, centers=4, n_features=2, cluster_std=1.0,
     X = centers[y] + rng.normal(scale=cluster_std,
                                 size=(n_samples, centers.shape[1]))
     return X.astype(np.float32), y.astype(np.int32)
+
+
+class Bunch(dict):
+    """Attribute-accessible dict (the sklearn container convention used by
+    every fetcher return)."""
+
+    def __getattr__(self, key):
+        try:
+            return self[key]
+        except KeyError:
+            raise AttributeError(key) from None
+
+    def __setattr__(self, key, value):
+        self[key] = value  # keep attribute and item access in sync
+
+
+def fetch_openml(name="mnist_784", *, version=1, data_id=None,
+                 return_X_y=False, as_frame=False, data_home=None,
+                 **_ignored):
+    """Drop-in facade for the reference's ``fetch_openml`` call sites
+    (``MnistTrial.py:10`` fetches 'mnist_784'; sklearn
+    ``datasets/_openml.py:694``), limited to the datasets the quantum
+    pipelines use. Offline it degrades to the deterministic surrogate like
+    every loader here (``bunch.details['real']`` says which you got).
+    """
+    if as_frame not in (False, "auto"):
+        raise ValueError("as_frame=True is not supported (dense arrays "
+                         "feed the MXU); use as_frame=False")
+    if data_id is not None:
+        if data_id == 554:  # openml id of mnist_784
+            name = "mnist_784"
+        else:
+            raise ValueError(
+                f"fetch_openml(data_id={data_id}) is not available in this "
+                "offline environment; supported: data_id=554 (mnist_784).")
+    if name != "mnist_784":
+        raise ValueError(
+            f"fetch_openml({name!r}) is not available in this offline "
+            "environment; supported: 'mnist_784'. For other data use the "
+            "sq_learn_tpu.datasets loaders or pass arrays directly.")
+    X, y, real = load_mnist(data_home)
+    if return_X_y:
+        return X, y
+    return Bunch(data=X, target=y,
+                 feature_names=[f"pixel{i + 1}" for i in range(X.shape[1])],
+                 details={"name": name, "version": version, "real": real})
+
+
+def fetch_covtype(*, data_home=None, download_if_missing=True,
+                  return_X_y=False, **_ignored):
+    """Drop-in facade for ``sklearn.datasets.fetch_covtype`` (reference
+    ``datasets/_covtype.py``; BASELINE #4)."""
+    X, y, real = load_covtype(data_home)
+    if return_X_y:
+        return X, y
+    return Bunch(data=X, target=y, details={"real": real})

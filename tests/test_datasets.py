@@ -57,3 +57,48 @@ def test_cicids_missing_falls_back():
                                  n_features=10)
     assert not real
     assert X.shape == (500, 10)
+
+
+@pytest.fixture(scope="module")
+def mnist_bunch():
+    # one surrogate generation for the whole module (70000x784 is seconds
+    # of rng + ~GB intermediates; don't pay it per test)
+    import warnings
+    from sq_learn_tpu.datasets import fetch_openml
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return fetch_openml("mnist_784", version=1, as_frame=False)
+
+
+class TestFetchFacades:
+    """Drop-in fetch_openml / fetch_covtype facades (reference
+    ``MnistTrial.py:10`` call shape)."""
+
+    def test_fetch_openml_bunch(self, mnist_bunch):
+        b = mnist_bunch
+        assert b.data.shape == (70_000, 784)
+        assert b.target.shape == (70_000,)
+        assert "real" in b.details
+        # attribute writes stay in sync with item access
+        b2 = type(b)(b)
+        b2.target = b2.target[:10]
+        assert b2["target"].shape == (10,)
+
+    def test_fetch_openml_unknown_name_or_id(self):
+        from sq_learn_tpu.datasets import fetch_openml
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError, match="offline"):
+            fetch_openml("adult")
+        with _pytest.raises(ValueError, match="offline"):
+            fetch_openml(data_id=40945)
+
+    def test_fetch_covtype(self):
+        import warnings
+        from sq_learn_tpu.datasets import fetch_covtype
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            X, y = fetch_covtype(return_X_y=True)
+        assert X.shape == (581_012, 54)
