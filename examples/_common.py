@@ -1,33 +1,12 @@
 """Shared example plumbing: accelerator probe with CPU fallback.
 
-The default environment points JAX at a tunneled accelerator whose relay
-can wedge backend init indefinitely (see CLAUDE.md gotchas). Every example
-calls :func:`ensure_backend` before its first jax operation: the configured
-platform is probed in a throwaway subprocess with a timeout, and on
-failure the process is pinned to the CPU backend via the documented
-in-process override. Same contract as ``bench/_common.probe_backend``.
+One definition for the wedged-tunnel escape (see CLAUDE.md gotchas) —
+re-exported from the bench suite's probe so the two cannot drift.
 """
 
 import os
-import subprocess
 import sys
 
-
-def ensure_backend(timeout_s=90):
-    platform = os.environ.get("JAX_PLATFORMS", "")
-    if platform in ("", "cpu"):
-        if platform == "cpu":
-            import jax
-
-            jax.config.update("jax_platforms", "cpu")
-        return
-    try:
-        subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=timeout_s, check=True, capture_output=True)
-    except (subprocess.TimeoutExpired, subprocess.CalledProcessError):
-        print(f"# backend {platform!r} unreachable; falling back to CPU",
-              file=sys.stderr)
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from bench._common import probe_backend as ensure_backend  # noqa: E402,F401

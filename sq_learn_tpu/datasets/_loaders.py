@@ -198,7 +198,7 @@ class Bunch(dict):
 
 def fetch_openml(name="mnist_784", *, version=1, data_id=None,
                  return_X_y=False, as_frame=False, data_home=None,
-                 **_ignored):
+                 target_column="default-target", cache=True):
     """Drop-in facade for the reference's ``fetch_openml`` call sites
     (``MnistTrial.py:10`` fetches 'mnist_784'; sklearn
     ``datasets/_openml.py:694``), limited to the datasets the quantum
@@ -208,6 +208,10 @@ def fetch_openml(name="mnist_784", *, version=1, data_id=None,
     if as_frame not in (False, "auto"):
         raise ValueError("as_frame=True is not supported (dense arrays "
                          "feed the MXU); use as_frame=False")
+    if target_column != "default-target":
+        raise ValueError(
+            "target_column selection is not supported; the facade returns "
+            "each dataset's default target")
     if data_id is not None:
         if data_id == 554:  # openml id of mnist_784
             name = "mnist_784"
@@ -229,10 +233,19 @@ def fetch_openml(name="mnist_784", *, version=1, data_id=None,
 
 
 def fetch_covtype(*, data_home=None, download_if_missing=True,
-                  return_X_y=False, **_ignored):
+                  random_state=None, shuffle=False, return_X_y=False,
+                  as_frame=False):
     """Drop-in facade for ``sklearn.datasets.fetch_covtype`` (reference
-    ``datasets/_covtype.py``; BASELINE #4)."""
+    ``datasets/_covtype.py``; BASELINE #4). ``shuffle``/``random_state``
+    follow sklearn semantics — covertype ships sorted by cover type, so
+    unshuffled splits are single-class; silently ignoring the flag would
+    corrupt migrated pipelines."""
+    if as_frame:
+        raise ValueError("as_frame=True is not supported; dense arrays only")
     X, y, real = load_covtype(data_home)
+    if shuffle:
+        idx = np.random.RandomState(random_state).permutation(X.shape[0])
+        X, y = X[idx], y[idx]
     if return_X_y:
         return X, y
     return Bunch(data=X, target=y, details={"real": real})

@@ -114,10 +114,18 @@ minibatch_step_jit = jax.jit(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("delta", "mode", "ipe_q", "reassignment_ratio"))
-def _epoch_scan(key, batches, wbatches, centers, counts, step0, delta, mode,
-                ipe_q, reassignment_ratio=0.0):
-    """scan the streaming update over a (n_batches, b, m) batch stack."""
+    static_argnames=("delta", "mode", "ipe_q", "reassignment_ratio",
+                     "batch"))
+def _epoch_scan(key, Xp, wp, centers, counts, step0, delta, mode,
+                ipe_q, reassignment_ratio=0.0, *, batch):
+    """One epoch: on-device reshuffle of the padded row block into a
+    (n_batches, batch, m) stack, then scan the streaming update over it.
+
+    The shuffle lives inside the jit so the host uploads the dataset ONCE
+    per fit — re-uploading a reshuffled copy every epoch is the dominant
+    cost over an accelerator tunnel. Zero-weight padding rows land in
+    random batches; they contribute nothing wherever they land.
+    """
 
     def body(carry, xs):
         centers, counts, step_idx = carry
@@ -127,7 +135,12 @@ def _epoch_scan(key, batches, wbatches, centers, counts, step0, delta, mode,
             ipe_q=ipe_q, reassignment_ratio=reassignment_ratio)
         return (centers, counts, step_idx + 1), inertia
 
-    keys = jax.random.split(key, batches.shape[0])
+    kp, ke = jax.random.split(key)
+    perm = jax.random.permutation(kp, Xp.shape[0])
+    n_batches = Xp.shape[0] // batch
+    batches = Xp[perm].reshape(n_batches, batch, Xp.shape[1])
+    wbatches = wp[perm].reshape(n_batches, batch)
+    keys = jax.random.split(ke, n_batches)
     (centers, counts, step), inertias = lax.scan(
         body, (centers, counts, step0), (keys, batches, wbatches))
     return centers, counts, step, inertias
@@ -178,41 +191,40 @@ class MiniBatchQKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
 
     # -- streaming state ---------------------------------------------------
 
-    def _init_state(self, key, X, sample_weight):
-        Xd = as_device_array(X)  # set_config(device=...) placement
+    def _init_state(self, key, Xd, w, n):
+        """Initial centers/counts from the (possibly padded) device rows;
+        ``n`` is the real row count — padded rows carry zero weight so the
+        weighted k-means++ potential never selects them, and the random
+        init draws from the first ``n`` rows only."""
         xsq = row_norms(Xd, squared=True)
-        w = jnp.asarray(sample_weight, Xd.dtype)
         if isinstance(self.init, str) and self.init == "k-means++":
             centers, _ = kmeans_plusplus(key, Xd, xsq, self.n_clusters,
                                          weights=w)
         elif isinstance(self.init, str) and self.init == "random":
-            idx = jax.random.choice(key, X.shape[0], (self.n_clusters,),
+            idx = jax.random.choice(key, n, (self.n_clusters,),
                                     replace=False)
             centers = Xd[idx]
         else:
             centers = jnp.asarray(self.init, Xd.dtype)
-            if centers.shape != (self.n_clusters, X.shape[1]):
+            if centers.shape != (self.n_clusters, Xd.shape[1]):
                 raise ValueError(
                     f"init centers shape {centers.shape} != "
-                    f"({self.n_clusters}, {X.shape[1]})")
+                    f"({self.n_clusters}, {Xd.shape[1]})")
         counts = jnp.zeros((self.n_clusters,), Xd.dtype)
         return centers, counts
 
-    def _batch_stack(self, key, X, sample_weight):
-        """Shuffle and reshape into (n_batches, b, m); pad with zero-weight
-        rows so every batch has static shape."""
+    def _padded_rows(self, X, sample_weight):
+        """(Xp, wp, b) device arrays padded to a whole number of batches;
+        padding rows carry zero weight. Uploaded once per fit — the
+        per-epoch shuffle happens on device (:func:`_epoch_scan`)."""
         n = X.shape[0]
         b = min(self.batch_size, n)
         n_batches = -(-n // b)
-        perm = np.asarray(jax.random.permutation(key, n))
         pad = n_batches * b - n
-        idx = np.concatenate([perm, perm[:pad]]) if pad else perm
-        Xs = as_device_array(X)[idx].reshape(n_batches, b, X.shape[1])
-        w = np.asarray(sample_weight, dtype=X.dtype)[idx].copy()
-        if pad:
-            w[n:] = 0.0  # duplicated padding rows must not contribute
-        ws = jnp.asarray(w).reshape(n_batches, b)
-        return Xs, ws
+        Xp = np.concatenate([X, X[:pad]]) if pad else X
+        w = np.asarray(sample_weight, dtype=X.dtype)
+        wp = np.concatenate([w, np.zeros(pad, X.dtype)]) if pad else w
+        return as_device_array(Xp), jnp.asarray(wp, X.dtype), b
 
     # -- API ---------------------------------------------------------------
 
@@ -232,12 +244,16 @@ class MiniBatchQKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         key = as_key(self.random_state)
         tol_ = tolerance(X, self.tol)
 
+        # ONE host->device upload for the whole fit (every restart and
+        # every epoch reshuffles on device)
+        Xp, wp, b = self._padded_rows(X, sample_weight)
         best = None
         for _ in range(max(1, self.n_init)):
             key, ki, kf = jax.random.split(key, 3)
-            centers, counts = self._init_state(ki, X, sample_weight)
+            centers, counts = self._init_state(ki, Xp, wp, X.shape[0])
             centers, counts, n_iter, n_steps, ewa = self._fit_loop(
-                kf, X, sample_weight, centers, counts, delta, mode, tol_)
+                kf, Xp, wp, b, X.shape[0], centers, counts, delta, mode,
+                tol_)
             if best is None or ewa < best[4]:
                 best = (centers, counts, n_iter, n_steps, ewa)
         centers, counts, n_iter, n_steps, _ = best
@@ -253,12 +269,10 @@ class MiniBatchQKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         self.inertia_ = inertia
         return self
 
-    def _fit_loop(self, key, X, sample_weight, centers, counts, delta, mode,
+    def _fit_loop(self, key, Xp, wp, b, n, centers, counts, delta, mode,
                   tol_):
         """Epochs of scanned mini-batch steps with EWA-inertia early stop
         (the reference's ``_mini_batch_convergence`` logic, host-side)."""
-        n = X.shape[0]
-        b = min(self.batch_size, n)
         ewa = None
         alpha = 2.0 * b / (n + 1)
         no_improve = 0
@@ -267,11 +281,10 @@ class MiniBatchQKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         it = 0
         step = jnp.asarray(0)
         for epoch in range(self.max_iter):
-            key, ks, ke = jax.random.split(key, 3)
-            Xs, ws = self._batch_stack(ks, X, sample_weight)
+            key, ke = jax.random.split(key)
             centers, counts, step, inertias = _epoch_scan(
-                ke, Xs, ws, centers, counts, step, delta, mode, self.ipe_q,
-                float(self.reassignment_ratio))
+                ke, Xp, wp, centers, counts, step, delta, mode, self.ipe_q,
+                float(self.reassignment_ratio), batch=b)
             it = epoch + 1
             for bi in np.asarray(inertias):
                 ewa = bi if ewa is None else ewa * (1 - alpha) + bi * alpha
@@ -306,7 +319,9 @@ class MiniBatchQKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
             self._pf_key = as_key(self.random_state)
         self._pf_key, ki, kb = jax.random.split(self._pf_key, 3)
         if not hasattr(self, "cluster_centers_"):
-            centers, counts = self._init_state(ki, X, sample_weight)
+            centers, counts = self._init_state(
+                ki, as_device_array(X), jnp.asarray(sample_weight, X.dtype),
+                X.shape[0])
             self.n_steps_ = 0
         else:
             centers = jnp.asarray(self.cluster_centers_, X.dtype)

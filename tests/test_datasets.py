@@ -101,4 +101,12 @@ class TestFetchFacades:
         with warnings.catch_warnings():
             warnings.simplefilter("ignore")
             X, y = fetch_covtype(return_X_y=True)
+            Xs, ys = fetch_covtype(return_X_y=True, shuffle=True,
+                                   random_state=0)
         assert X.shape == (581_012, 54)
+        # shuffle must actually permute (sorted covertype would otherwise
+        # produce single-class splits) and be seed-deterministic
+        assert not np.array_equal(y[:1000], ys[:1000])
+        Xs2, ys2 = fetch_covtype(return_X_y=True, shuffle=True,
+                                 random_state=0)
+        np.testing.assert_array_equal(ys, ys2)
