@@ -198,7 +198,12 @@ class Bunch(dict):
 
 def fetch_openml(name="mnist_784", *, version=1, data_id=None,
                  return_X_y=False, as_frame=False, data_home=None,
-                 target_column="default-target", cache=True):
+                 target_column="default-target", cache=True,
+                 parser="auto", n_retries=3, delay=1.0):
+    # parser/n_retries/delay/cache are transport details with no semantic
+    # effect here — accepted and ignored so sklearn-era call sites run;
+    # kwargs that would change WHAT data comes back (target_column,
+    # as_frame, unknown name/data_id) still error loudly
     """Drop-in facade for the reference's ``fetch_openml`` call sites
     (``MnistTrial.py:10`` fetches 'mnist_784'; sklearn
     ``datasets/_openml.py:694``), limited to the datasets the quantum
@@ -234,7 +239,7 @@ def fetch_openml(name="mnist_784", *, version=1, data_id=None,
 
 def fetch_covtype(*, data_home=None, download_if_missing=True,
                   random_state=None, shuffle=False, return_X_y=False,
-                  as_frame=False):
+                  as_frame=False, n_retries=3, delay=1.0):
     """Drop-in facade for ``sklearn.datasets.fetch_covtype`` (reference
     ``datasets/_covtype.py``; BASELINE #4). ``shuffle``/``random_state``
     follow sklearn semantics — covertype ships sorted by cover type, so

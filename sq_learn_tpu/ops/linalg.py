@@ -30,6 +30,20 @@ def svd_flip(u, v):
     return u * signs, v * signs[:, None]
 
 
+def svd_flip_v(u, v):
+    """Sign correction from V's rows (sklearn's ``u_based_decision=False``
+    variant of ``svd_flip``): the largest-|.|-entry of each right singular
+    vector is made positive. Lets thin SVDs fix signs without ever
+    materializing the full U factor; ``u`` may be None or a partial
+    (n, k≤r) block — only its first ``len(signs)`` columns are flipped."""
+    max_abs_rows = jnp.argmax(jnp.abs(v), axis=1)
+    signs = jnp.sign(v[jnp.arange(v.shape[0]), max_abs_rows])
+    signs = jnp.where(signs == 0, 1.0, signs)
+    if u is not None:
+        u = u * signs[: u.shape[1]]
+    return u, v * signs[:, None]
+
+
 def gram_spectrum(G):
     """Descending singular spectrum from a Gram matrix: eigh → flip →
     clamped sqrt. Returns (S, V, safe) with ``safe`` the zero-guarded
@@ -70,13 +84,37 @@ def thin_svd(X, method="auto"):
 
 
 def centered_svd(X, method="auto"):
-    """Column-center X and return (mean, U, S, Vt) with deterministic signs —
-    the core of every PCA fit (reference ``_qPCA.py:578-583``)."""
+    """Column-center X and return (mean, U, S, Vt) with deterministic
+    V-based signs (:func:`svd_flip_v` — the convention every PCA path in
+    the package shares, so partial-U routes agree with full ones) — the
+    core of every PCA fit (reference ``_qPCA.py:578-583``)."""
     X = jnp.asarray(X)
     mean = jnp.mean(X, axis=0)
     U, S, Vt = thin_svd(X - mean, method=method)
-    U, Vt = svd_flip(U, Vt)
+    U, Vt = svd_flip_v(U, Vt)
     return mean, U, S, Vt
+
+
+@functools.partial(jax.jit, static_argnames=("n_left",))
+def centered_svd_topk(X, n_left):
+    """Centered Gram-route SVD of a TALL matrix materializing only the
+    first ``n_left`` columns of U.
+
+    The qPCA fit consumes the full spectrum and full Vt but only
+    U[:, :n_components]; the full (n, r) U product is the same O(n·m²)
+    GEMM as the Gram matrix itself, i.e. half the fit's FLOPs spent on
+    output that is sliced away. V-based signs (:func:`svd_flip_v`) never
+    need the unmaterialized columns; the U block pairs consistently.
+    """
+    X = jnp.asarray(X)
+    n, m = X.shape
+    mean = jnp.mean(X, axis=0)
+    Xc = X - mean
+    G = Xc.T @ Xc  # (m, m)
+    S, V, safe = gram_spectrum(G)
+    _, Vt = svd_flip_v(None, V.T)
+    Uk = (Xc @ Vt.T[:, :n_left]) / safe[None, :n_left]
+    return mean, Uk, S, Vt
 
 
 @functools.partial(
@@ -105,7 +143,8 @@ def randomized_svd(key, X, n_components, n_oversamples=10, n_iter=4, flip=True):
     Uhat, S, Vt = jnp.linalg.svd(B, full_matrices=False)
     U = Q @ Uhat
     if flip:
-        U, Vt = svd_flip(U, Vt)
+        # V-based: the one sign convention every SVD path shares
+        U, Vt = svd_flip_v(U, Vt)
     if transpose:
         U, S, Vt = Vt.T, S, U.T
     return U[:, :n_components], S[:n_components], Vt[:n_components]

@@ -39,8 +39,12 @@ class QuantumState:
         if n_reg != amplitudes.shape[0]:
             raise ValueError("registers and amplitudes must have the same length")
         if not isinstance(self.probabilities, jax.core.Tracer):
+            # the reference asserts Σp == 1 (Utility.py:49); after an f32
+            # norm+divide the sum is 1 only to a few ulp (~1.2e-7 each for
+            # the norm, the divide, and the square/sum), so the check
+            # tolerance must be above f32 eps or exact inputs fail it
             np.testing.assert_allclose(
-                float(jnp.sum(self.probabilities)), 1.0, atol=1e-7
+                float(jnp.sum(self.probabilities)), 1.0, atol=1e-5
             )
 
     def measure_indices(self, key, n_times=1):

@@ -18,7 +18,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from ..ops.linalg import gram_spectrum, svd_flip
+from ..ops.linalg import gram_spectrum, svd_flip_v
 from .mesh import pad_to_multiple, shard_rows
 
 
@@ -41,8 +41,10 @@ def _masked_centered_svd(X, w, n):
     # single-device thin SVD's shapes (n and m are static here)
     r = min(n, X.shape[1])
     S, V, safe = S[:r], V[:, :r], safe[:r]
-    U = (Xc @ V) / safe[None, :]  # row-sharded
-    U, Vt = svd_flip(U, V.T)
+    # V-based signs: the shared convention (ops.linalg.svd_flip_v) — a
+    # U-based flip would also gather argmax over the sharded factor
+    _, Vt = svd_flip_v(None, V.T)
+    U = (Xc @ Vt.T) / safe[None, :]  # row-sharded
     return mean, U, S, Vt
 
 

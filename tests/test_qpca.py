@@ -47,7 +47,8 @@ class TestClassicalParity:
         ours = PCA(n_components=4).fit(data)
         ref = sklearn.decomposition.PCA(
             n_components=4, svd_solver="full").fit(data)
-        # our flip follows the reference fork's u-based svd_flip
+        # our flip is the deterministic V-based convention (svd_flip_v),
+        # which can differ per-component from sklearn's u-based one
         # (extmath.py:522); installed sklearn may use a different basis —
         # align per-column signs before comparing
         A, B = ours.transform(data), ref.transform(data)
