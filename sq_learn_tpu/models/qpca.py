@@ -40,7 +40,8 @@ import jax.numpy as jnp
 
 from .._config import as_device_array, with_device_scope
 from ..base import BaseEstimator, TransformerMixin, check_is_fitted
-from ..ops.linalg import centered_svd, randomized_svd, stable_cumsum
+from ..ops.linalg import (centered_svd, check_compute_dtype, randomized_svd,
+                          stable_cumsum)
 from ..ops.quantum import (
     QuantumState,
     amplitude_estimation,
@@ -196,6 +197,12 @@ class QPCA(TransformerMixin, BaseEstimator):
         reductions. 'auto' computes it iff a QADRA fit kwarg is set; True
         always (needed to call the QADRA methods post-fit on a classical
         fit); False never.
+    compute_dtype : None | 'bfloat16' | 'float16' | 'float32'
+        Performance hint for the partial-U Gram route (integral
+        ``n_components`` on a strongly tall matrix): run the two O(n·m²)
+        GEMMs in the MXU-native reduced precision with input-dtype
+        accumulation (the m×m eigh stays exact). Spectrum error is
+        O(eps·‖X‖²); other routes warn and ignore the hint.
     mesh : jax.sharding.Mesh or None
         Run the full-SVD fit data-parallel over the mesh's first axis:
         sample-sharded Gram reduction over ICI, replicated m×m eigh
@@ -206,7 +213,8 @@ class QPCA(TransformerMixin, BaseEstimator):
 
     def __init__(self, n_components=None, *, copy=True, whiten=False,
                  svd_solver="auto", tol=0.0, iterated_power="auto",
-                 random_state=None, name=None, compute_mu="auto", mesh=None):
+                 random_state=None, name=None, compute_mu="auto", mesh=None,
+                 compute_dtype=None):
         self.n_components = n_components
         self.copy = copy
         self.whiten = whiten
@@ -217,6 +225,7 @@ class QPCA(TransformerMixin, BaseEstimator):
         self.name = name
         self.compute_mu = compute_mu
         self.mesh = mesh
+        self.compute_dtype = compute_dtype
         self.quantum_runtime_container = []
 
     # -- fit ----------------------------------------------------------------
@@ -330,6 +339,18 @@ class QPCA(TransformerMixin, BaseEstimator):
             solver = "full"
         self._fit_svd_solver = solver
 
+        # the reduced-precision hint engages only the partial-U Gram
+        # route; every other route must say so rather than silently run
+        # full precision (a decorative flag is worse than none)
+        if self.compute_dtype is not None and not (
+                solver == "full"
+                and self._partial_u_route(n_components, *X.shape)):
+            warnings.warn(
+                "compute_dtype engages only the partial-U Gram route "
+                "(svd_solver='full', integral n_components, aspect ratio "
+                ">= 8, no mesh); this fit runs in the input dtype.",
+                RuntimeWarning)
+
         if solver == "full":
             self._fit_full(X, n_components)
         elif solver in ("arpack", "randomized"):
@@ -365,6 +386,13 @@ class QPCA(TransformerMixin, BaseEstimator):
         self._key, sub = jax.random.split(self._key)
         return sub
 
+    def _partial_u_route(self, n_components, n_samples, n_features):
+        """True when the fit takes the partial-U Gram route (the only
+        route the compute_dtype hint applies to)."""
+        return (self.mesh is None
+                and isinstance(n_components, numbers.Integral)
+                and 0 < n_components and n_samples >= 8 * n_features)
+
     def _fit_full(self, X, n_components):
         """Full-SVD fit + gated quantum estimators (reference ``_fit_full``,
         ``_qPCA.py:557-676``)."""
@@ -388,8 +416,7 @@ class QPCA(TransformerMixin, BaseEstimator):
             from ..parallel.pca import centered_svd_sharded
 
             mean, U, S, Vt = centered_svd_sharded(self.mesh, X)
-        elif (isinstance(n_components, numbers.Integral)
-                and 0 < n_components and n_samples >= 8 * n_features):
+        elif self._partial_u_route(n_components, n_samples, n_features):
             # integral n_components in the Gram regime (same aspect≥8
             # heuristic as thin_svd 'auto' — squaring a mildly rectangular
             # matrix would clamp the tail spectrum the fit publishes):
@@ -398,7 +425,9 @@ class QPCA(TransformerMixin, BaseEstimator):
             # half the fit's FLOPs
             from ..ops.linalg import centered_svd_topk
 
-            mean, U, S, Vt = centered_svd_topk(X, int(n_components))
+            mean, U, S, Vt = centered_svd_topk(
+                X, int(n_components),
+                compute_dtype=check_compute_dtype(self.compute_dtype))
         else:
             mean, U, S, Vt = centered_svd(X)
         self.mean_ = np.asarray(mean)

@@ -14,7 +14,7 @@ import jax.numpy as jnp
 
 from .._config import as_device_array, with_device_scope
 from ..base import BaseEstimator, TransformerMixin, check_is_fitted
-from ..ops.linalg import randomized_svd, svd_flip, thin_svd
+from ..ops.linalg import randomized_svd, svd_flip_v, thin_svd
 from ..utils import as_key, check_array
 
 
@@ -53,7 +53,8 @@ class TruncatedSVD(TransformerMixin, BaseEstimator):
                                       n_iter=self.n_iter)
         elif self.algorithm == "arpack":
             U, S, Vt = thin_svd(Xd)
-            U, Vt = svd_flip(U, Vt)
+            # V-based: the sign convention every SVD path shares
+            U, Vt = svd_flip_v(U, Vt)
             U, S, Vt = U[:, :k], S[:k], Vt[:k]
         else:
             raise ValueError(

@@ -95,8 +95,8 @@ def centered_svd(X, method="auto"):
     return mean, U, S, Vt
 
 
-@functools.partial(jax.jit, static_argnames=("n_left",))
-def centered_svd_topk(X, n_left):
+@functools.partial(jax.jit, static_argnames=("n_left", "compute_dtype"))
+def centered_svd_topk(X, n_left, compute_dtype=None):
     """Centered Gram-route SVD of a TALL matrix materializing only the
     first ``n_left`` columns of U.
 
@@ -105,15 +105,20 @@ def centered_svd_topk(X, n_left):
     GEMM as the Gram matrix itself, i.e. half the fit's FLOPs spent on
     output that is sliced away. V-based signs (:func:`svd_flip_v`) never
     need the unmaterialized columns; the U block pairs consistently.
+
+    ``compute_dtype`` runs the two big GEMMs (Gram, U block) in the
+    MXU-native reduced precision with input-dtype accumulation; the
+    m×m eigh stays exact. Spectrum error is O(eps·‖X‖²) — a perf knob
+    for explained-variance-scale work, not for tiny-σ analysis.
     """
     X = jnp.asarray(X)
     n, m = X.shape
     mean = jnp.mean(X, axis=0)
     Xc = X - mean
-    G = Xc.T @ Xc  # (m, m)
+    G = inner_product(Xc.T, Xc.T, compute_dtype)  # (m, m)
     S, V, safe = gram_spectrum(G)
     _, Vt = svd_flip_v(None, V.T)
-    Uk = (Xc @ Vt.T[:, :n_left]) / safe[None, :n_left]
+    Uk = inner_product(Xc, Vt[:n_left], compute_dtype) / safe[None, :n_left]
     return mean, Uk, S, Vt
 
 
@@ -142,11 +147,12 @@ def randomized_svd(key, X, n_components, n_oversamples=10, n_iter=4, flip=True):
     B = Q.T @ A  # (size, min_dim)
     Uhat, S, Vt = jnp.linalg.svd(B, full_matrices=False)
     U = Q @ Uhat
-    if flip:
-        # V-based: the one sign convention every SVD path shares
-        U, Vt = svd_flip_v(U, Vt)
     if transpose:
         U, S, Vt = Vt.T, S, U.T
+    if flip:
+        # flip AFTER any transpose-back so the V-based convention (the one
+        # every SVD path shares) refers to the caller's orientation
+        U, Vt = svd_flip_v(U, Vt)
     return U[:, :n_components], S[:n_components], Vt[:n_components]
 
 

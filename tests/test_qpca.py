@@ -354,3 +354,25 @@ def test_mle_tied_eigenvalues_raise_loudly():
     spec = np.array([5.0, 5.0, 2.0, 1.0, 0.5])
     with pytest.raises(ValueError, match="tied eigenvalues"):
         _assess_dimension(spec, 2, 100)
+
+
+class TestComputeDtypeQPCA:
+    def test_bfloat16_gram_route(self):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(2000, 32)).astype(np.float32)
+        ref = QPCA(n_components=4, svd_solver="full").fit(X)
+        bf = QPCA(n_components=4, svd_solver="full",
+                  compute_dtype="bfloat16").fit(X)
+        np.testing.assert_allclose(bf.explained_variance_ratio_,
+                                   ref.explained_variance_ratio_, rtol=5e-2)
+        # components agree up to bf16-scale error after sign alignment
+        sgn = np.sign(np.sum(bf.components_ * ref.components_, axis=1))
+        err = np.abs(bf.components_ * sgn[:, None] - ref.components_).max()
+        assert err < 0.1, err
+
+    def test_non_gram_route_warns(self):
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(100, 32)).astype(np.float32)  # aspect < 8
+        with pytest.warns(RuntimeWarning, match="partial-U Gram route"):
+            QPCA(n_components=4, svd_solver="full",
+                 compute_dtype="bfloat16").fit(X)
