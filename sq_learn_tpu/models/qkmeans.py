@@ -118,20 +118,20 @@ def e_step(key, X, weights, centers, x_sq_norms, *, delta, mode, ipe_q,
     runs on the cheap distances, the selected distance is recomputed
     exactly.
     """
-    cd = jnp.dtype(compute_dtype) if compute_dtype is not None else None
-    reduced = is_reduced(cd, X.dtype)
+    reduced = is_reduced(compute_dtype, X.dtype)
     if axis_name is not None:
         key = jax.random.fold_in(key, lax.axis_index(axis_name))
     if mode == "ipe":
         c_sq = row_norms(centers, squared=True)
-        inner = inner_product(X, centers, cd)
+        inner = inner_product(X, centers, compute_dtype)
         key, sub = jax.random.split(key)
         est_ip = ipe(sub, x_sq_norms[:, None], c_sq[None, :], inner,
                      epsilon=delta / 2, Q=ipe_q)
         d2 = x_sq_norms[:, None] + c_sq[None, :] - 2.0 * est_ip
         window = 0.0
     else:
-        d2 = pairwise_sq_distances(X, centers, x_sq_norms, compute_dtype=cd)
+        d2 = pairwise_sq_distances(X, centers, x_sq_norms,
+                                   compute_dtype=compute_dtype)
         window = delta if mode == "delta" else 0.0
 
     # the window/tie mask must use the SAME precision as d2: an exact
@@ -867,6 +867,16 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         self.center_shift_history_ = shift_tr[:n_iter]
         return self
 
+    @staticmethod
+    def _on_cpu_backend():
+        """True when fits run on the host CPU — either the default backend
+        or a set_config(device='cpu...') pin. One predicate for every
+        dispatch decision."""
+        from .._config import _get_threadlocal_config
+
+        return (jax.default_backend() == "cpu"
+                or _get_threadlocal_config()["device"].startswith("cpu"))
+
     def _fused_fit_ok(self):
         """The one-dispatch path covers the common accelerator fit: string
         init (array/callable inits are host-resolved), no explicit mesh
@@ -874,12 +884,9 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         reporting needs the host loop). The CPU backend keeps the
         native/serial paths — with no tunnel round-trips to amortize,
         per-restart early exit wins there."""
-        from .._config import _get_threadlocal_config
-
-        on_cpu = (jax.default_backend() == "cpu"
-                  or _get_threadlocal_config()["device"].startswith("cpu"))
         return (self.mesh is None and not self.verbose
-                and isinstance(self.init, str) and not on_cpu)
+                and isinstance(self.init, str)
+                and not self._on_cpu_backend())
 
     def _fit_fused(self, X, sample_weight, delta, mode):
         """One-dispatch fit (see :func:`fit_fused`). Returns self, or None
@@ -1012,11 +1019,8 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         # per-dispatch overhead on small hosts. Routed only when no kernel
         # was forced (use_pallas='auto'), no mesh, and the error model is
         # expressible (classic/δ-means without intermediate tomography).
-        from .._config import _get_threadlocal_config
-
-        on_cpu = (jax.default_backend() == "cpu"
-                  or _get_threadlocal_config()["device"].startswith("cpu"))
-        if (on_cpu and self.use_pallas == "auto" and self.mesh is None
+        if (self._on_cpu_backend()
+                and self.use_pallas == "auto" and self.mesh is None
                 and mode in ("classic", "delta")
                 and not self.intermediate_error
                 and (isinstance(init, str) or hasattr(init, "__array__"))):
@@ -1043,7 +1047,7 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         # batching is the mesh's own.
         if (self.mesh is None and not self.verbose
                 and isinstance(init, str) and n_init > 1
-                and jax.default_backend() != "cpu"):
+                and not self._on_cpu_backend()):
             batched = functools.partial(
                 lloyd_restarts, key, Xd, w, xsq, n_init=n_init, init=init,
                 n_clusters=self.n_clusters)

@@ -40,8 +40,8 @@ import jax.numpy as jnp
 
 from .._config import as_device_array, with_device_scope
 from ..base import BaseEstimator, TransformerMixin, check_is_fitted
-from ..ops.linalg import (centered_svd, check_compute_dtype, randomized_svd,
-                          stable_cumsum)
+from ..ops.linalg import (centered_svd, centered_svd_topk,
+                          check_compute_dtype, randomized_svd, stable_cumsum)
 from ..ops.quantum import (
     QuantumState,
     amplitude_estimation,
@@ -423,8 +423,6 @@ class QPCA(TransformerMixin, BaseEstimator):
             # materialize only the U columns the fit keeps — the full U
             # product is the same O(n·m²) GEMM as the Gram matrix, i.e.
             # half the fit's FLOPs
-            from ..ops.linalg import centered_svd_topk
-
             mean, U, S, Vt = centered_svd_topk(
                 X, int(n_components),
                 compute_dtype=check_compute_dtype(self.compute_dtype))

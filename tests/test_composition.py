@@ -217,3 +217,20 @@ class TestDistributed:
         lo, hi, per = dist.host_shard_bounds(1000)
         assert (lo, hi) == (0, 1000)  # single process owns everything
         assert per == 1000
+
+
+class TestParameterGrid:
+    def test_iterates_product(self):
+        from sq_learn_tpu.model_selection import ParameterGrid
+
+        grid = ParameterGrid({"a": [1, 2], "b": ["x", "y", "z"]})
+        combos = list(grid)
+        assert len(combos) == len(grid) == 6
+        assert {"a": 2, "b": "z"} in combos
+
+    def test_list_of_grids(self):
+        from sq_learn_tpu.model_selection import ParameterGrid
+
+        grid = ParameterGrid([{"a": [1]}, {"b": [2, 3]}])
+        assert len(grid) == 3
+        assert list(grid) == [{"a": 1}, {"b": 2}, {"b": 3}]

@@ -263,3 +263,25 @@ class TestFejerTail:
         p = p / p.sum()
         tv = 0.5 * np.abs(emp - p).sum()
         assert tv < 0.02
+
+
+class TestPhaseArgumentWrappers:
+    """sv_to_theta / theta_to_sv (reference wrapper/unwrap_phase_est_arguments,
+    ``Utility.py:575-587``) — exact inverses and range behavior."""
+
+    def test_round_trip(self):
+        from sq_learn_tpu.ops.quantum.estimation import sv_to_theta, theta_to_sv
+
+        sv = jnp.linspace(0.0, 1.0, 11)
+        for eps in (0.1, 0.01):
+            theta = sv_to_theta(sv, eps)
+            back = theta_to_sv(theta, eps)
+            np.testing.assert_allclose(np.asarray(back), np.asarray(sv),
+                                       rtol=1e-5, atol=1e-6)
+            assert np.all(np.asarray(theta) >= 0)
+
+    def test_out_of_range_clipped(self):
+        from sq_learn_tpu.ops.quantum.estimation import sv_to_theta
+
+        theta = sv_to_theta(jnp.asarray([-2.0, 2.0]), 0.1)
+        assert np.all(np.isfinite(np.asarray(theta)))
