@@ -95,6 +95,15 @@ def _load():
         lib.csv_parse_floats.argtypes = [
             ctypes.c_char_p, ctypes.c_char, ctypes.c_int,
             ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_int64]
+        lib.csv_stream_open.restype = ctypes.c_void_p
+        lib.csv_stream_open.argtypes = [
+            ctypes.c_char_p, ctypes.c_char, ctypes.c_int]
+        lib.csv_stream_next.restype = ctypes.c_int64
+        lib.csv_stream_next.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_float), ctypes.c_int64,
+            ctypes.c_int64]
+        lib.csv_stream_close.restype = None
+        lib.csv_stream_close.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
 
@@ -349,11 +358,79 @@ def csv_read_floats(path, delimiter=",", skip_header=1, max_rows=None):
             if got >= 0:
                 return out[:got]
     data = np.genfromtxt(path, delimiter=delimiter, skip_header=skip_header,
-                         max_rows=max_rows, dtype=np.float32)
+                         max_rows=max_rows, dtype=np.float32, comments=None)
     if data.ndim == 1:  # single column parses as (n,), not (1, n)
         data = data.reshape(-1, 1)
     return data
 
 
+def csv_stream_batches(path, batch_rows, delimiter=",", skip_header=1,
+                       n_cols=None):
+    """Yield (batch_rows, n_cols) float32 arrays from a numeric CSV without
+    loading the file — the host-side input pipeline for incremental fits
+    (``MiniBatchQKMeans.partial_fit``) on larger-than-memory data. The last
+    batch may be short; non-numeric fields parse as NaN.
+
+    Native path keeps one open stream (no per-batch rescan); fallback
+    streams the file line-by-line in NumPy.
+    """
+    path = os.fspath(path)
+    if batch_rows <= 0:
+        raise ValueError(f"batch_rows must be > 0, got {batch_rows}")
+    lib = _load()
+    if lib is not None:
+        if n_cols is None:
+            rows = ctypes.c_int64()
+            cols = ctypes.c_int64()
+            if lib.csv_shape(path.encode(), delimiter.encode(),
+                             int(skip_header), ctypes.byref(rows),
+                             ctypes.byref(cols)) != 0:
+                raise OSError(f"cannot read {path}")
+            n_cols = cols.value
+        handle = lib.csv_stream_open(path.encode(), delimiter.encode(),
+                                     int(skip_header))
+        if handle:
+            try:
+                while True:
+                    out = np.empty((batch_rows, n_cols), np.float32)
+                    got = lib.csv_stream_next(
+                        handle,
+                        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                        batch_rows, n_cols)
+                    if got <= 0:
+                        return
+                    yield out[:got]
+            finally:
+                lib.csv_stream_close(handle)
+            return
+    # NumPy fallback: stream lines, parse per batch (same contract as the
+    # native stream: blank lines are free, '#' is data not a comment,
+    # n_cols truncates/NaN-pads the field count)
+    with open(path, "r") as f:
+        for _ in range(skip_header):
+            f.readline()
+        while True:
+            lines = []
+            while len(lines) < batch_rows:
+                line = f.readline()
+                if not line:
+                    break
+                if line.strip():
+                    lines.append(line)
+            if not lines:
+                return
+            batch = np.genfromtxt(lines, delimiter=delimiter,
+                                  dtype=np.float32, comments=None)
+            batch = batch.reshape(len(lines), -1)
+            if n_cols is not None and batch.shape[1] != n_cols:
+                if batch.shape[1] > n_cols:
+                    batch = batch[:, :n_cols]
+                else:
+                    pad = np.full((len(lines), n_cols - batch.shape[1]),
+                                  np.nan, np.float32)
+                    batch = np.concatenate([batch, pad], axis=1)
+            yield batch
+
+
 __all__ = ["native_available", "lloyd_iter", "murmurhash3_32",
-           "murmurhash3_bulk", "csv_read_floats"]
+           "murmurhash3_bulk", "csv_read_floats", "csv_stream_batches"]

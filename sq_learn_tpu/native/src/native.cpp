@@ -316,10 +316,28 @@ int csv_shape(const char* path, char delim, int skip_header, int64_t* n_rows,
   return 0;
 }
 
+// Parse one CSV line into n_cols float32 fields. Non-numeric fields parse
+// as NaN (strtof stops at junk; empty fields / text labels -> NaN, caller
+// decides). One definition for the one-shot and streaming readers.
+static void parse_csv_line(char* line, char delim, float* out,
+                           int64_t n_cols) {
+  char* p = line;
+  for (int64_t c = 0; c < n_cols; ++c) {
+    char* end = p;
+    float v = strtof(p, &end);
+    if (end == p) {  // non-numeric field
+      v = NAN;
+      while (*end && *end != delim && *end != '\n') ++end;
+    }
+    out[c] = v;
+    p = end;
+    while (*p && *p != delim && *p != '\n') ++p;
+    if (*p == delim) ++p;
+  }
+}
+
 // Parse the file into a preallocated (n_rows, n_cols) float32 row-major
-// buffer. Non-numeric fields parse as NaN (strtof stops at junk; empty
-// fields / text labels -> NaN, caller decides). Returns number of rows
-// parsed, or -1 on IO error.
+// buffer. Returns number of rows parsed, or -1 on IO error.
 int64_t csv_parse_floats(const char* path, char delim, int skip_header,
                          float* out, int64_t max_rows, int64_t n_cols) {
   FILE* f = std::fopen(path, "rb");
@@ -332,24 +350,65 @@ int64_t csv_parse_floats(const char* path, char delim, int skip_header,
   while (row < max_rows && (len = getline(&line, &cap, f)) != -1) {
     if (skipped < skip_header) { ++skipped; continue; }
     if (len <= 1 && (line[0] == '\n' || line[0] == '\0')) continue;
-    char* p = line;
-    for (int64_t c = 0; c < n_cols; ++c) {
-      char* end = p;
-      float v = strtof(p, &end);
-      if (end == p) {  // non-numeric field
-        v = NAN;
-        while (*end && *end != delim && *end != '\n') ++end;
-      }
-      out[row * n_cols + c] = v;
-      p = end;
-      while (*p && *p != delim && *p != '\n') ++p;
-      if (*p == delim) ++p;
-    }
+    parse_csv_line(line, delim, out + row * n_cols, n_cols);
     ++row;
   }
   std::free(line);
   std::fclose(f);
   return row;
+}
+
+// ---------------------------------------------------------------------------
+// Streaming CSV batches — a stateful reader handle so larger-than-memory
+// files feed incremental fits (MiniBatch partial_fit) batch by batch
+// without re-scanning from the top per batch.
+// ---------------------------------------------------------------------------
+
+struct CsvStream {
+  FILE* f;
+  char delim;
+  char* line;
+  size_t cap;
+};
+
+// Open a stream positioned past `skip_header` lines; returns nullptr on IO
+// error. Close with csv_stream_close.
+void* csv_stream_open(const char* path, char delim, int skip_header) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return nullptr;
+  char* line = nullptr;
+  size_t cap = 0;
+  for (int i = 0; i < skip_header; ++i) {
+    if (getline(&line, &cap, f) == -1) break;
+  }
+  CsvStream* s = new CsvStream{f, delim, line, cap};
+  return s;
+}
+
+// Parse up to max_rows rows into the preallocated row-major float32 buffer
+// (same field semantics as csv_parse_floats). Returns rows parsed — 0 at
+// EOF — or -1 on a null handle.
+int64_t csv_stream_next(void* handle, float* out, int64_t max_rows,
+                        int64_t n_cols) {
+  CsvStream* s = static_cast<CsvStream*>(handle);
+  if (!s) return -1;
+  int64_t row = 0;
+  ssize_t len;
+  while (row < max_rows && (len = getline(&s->line, &s->cap, s->f)) != -1) {
+    char* line = s->line;
+    if (len <= 1 && (line[0] == '\n' || line[0] == '\0')) continue;
+    parse_csv_line(line, s->delim, out + row * n_cols, n_cols);
+    ++row;
+  }
+  return row;
+}
+
+void csv_stream_close(void* handle) {
+  CsvStream* s = static_cast<CsvStream*>(handle);
+  if (!s) return;
+  std::free(s->line);
+  std::fclose(s->f);
+  delete s;
 }
 
 }  // extern "C"

@@ -91,3 +91,79 @@ def test_csv_read_floats_max_rows_and_nan(tmp_path):
     assert out[0, 0] == pytest.approx(1.5)
     assert np.isnan(out[1, 1])
     assert out[1, 2] == pytest.approx(6.0)
+
+
+class TestCsvStreamBatches:
+    """Streaming CSV batch reader (native stateful stream + NumPy
+    fallback) — the input pipeline for incremental fits."""
+
+    @pytest.fixture()
+    def csvfile(self, tmp_path):
+        rng = np.random.default_rng(0)
+        data = rng.normal(size=(107, 5)).astype(np.float32)
+        p = tmp_path / "data.csv"
+        header = "a,b,c,d,e\n"
+        body = "\n".join(",".join(f"{v:.6f}" for v in row) for row in data)
+        p.write_text(header + body + "\n")
+        return p, data
+
+    def _roundtrip(self, path, data, **kw):
+        from sq_learn_tpu.native import csv_stream_batches
+
+        batches = list(csv_stream_batches(path, 25, **kw))
+        assert [b.shape[0] for b in batches] == [25, 25, 25, 25, 7]
+        # atol covers the 6-decimal text round-trip of the fixture
+        np.testing.assert_allclose(np.vstack(batches), data, atol=1e-5)
+
+    def test_native_path(self, csvfile):
+        from sq_learn_tpu import native
+
+        path, data = csvfile
+        if not native.native_available():
+            pytest.skip("native library unavailable")
+        self._roundtrip(path, data)
+
+    def test_numpy_fallback(self, csvfile, monkeypatch):
+        from sq_learn_tpu import native
+
+        path, data = csvfile
+        monkeypatch.setattr(native, "_load", lambda: None)
+        self._roundtrip(path, data)
+
+    def test_feeds_partial_fit(self, csvfile):
+        from sq_learn_tpu.native import csv_stream_batches
+        from sq_learn_tpu.models import MiniBatchQKMeans
+
+        path, _ = csvfile
+        est = MiniBatchQKMeans(n_clusters=3, random_state=0)
+        for batch in csv_stream_batches(path, 30):
+            est.partial_fit(batch)
+        assert est.cluster_centers_.shape == (3, 5)
+        assert np.isfinite(est.inertia_)
+
+    def test_fallback_contract_matches_native(self, tmp_path, monkeypatch):
+        # '#' is data (NaN field), blank lines are free, n_cols
+        # truncates/pads — identical on both paths
+        p = tmp_path / "tricky.csv"
+        p.write_text("h1,h2,h3\n1.0,2.0,3.0\n\n4.0,#x,6.0\n7.0,8.0,9.0\n")
+        from sq_learn_tpu import native
+
+        def collect(**kw):
+            return list(native.csv_stream_batches(p, 2, **kw))
+
+        for forced_fallback in (False, True):
+            if forced_fallback:
+                monkeypatch.setattr(native, "_load", lambda: None)
+            elif not native.native_available():
+                continue
+            batches = collect()
+            assert [b.shape for b in batches] == [(2, 3), (1, 3)], batches
+            merged = np.vstack(batches)
+            assert np.isnan(merged[1, 1])  # '#x' field -> NaN
+            np.testing.assert_allclose(merged[2], [7.0, 8.0, 9.0])
+            narrow = collect(n_cols=2)
+            assert all(b.shape[1] == 2 for b in narrow)
+            wide = collect(n_cols=4)
+            assert all(b.shape[1] == 4 for b in wide)
+            assert np.isnan(np.vstack(wide)[:, 3]).all()
+            monkeypatch.undo()
