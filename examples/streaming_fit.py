@@ -1,0 +1,73 @@
+"""Streaming clustering on a larger-than-memory CSV, with resume.
+
+Ties three subsystems together:
+
+- ``native.csv_stream_batches`` — the C++ stateful CSV stream (NumPy
+  fallback) yields fixed-size batches without loading the file;
+- ``MiniBatchQKMeans.partial_fit`` — the incremental-state API (the
+  reference's only streaming surface, ``_dmeans.py:2139``, fixed here);
+- ``utils.checkpoint`` — the fitted state round-trips to disk mid-stream,
+  so an interrupted ingest resumes where it stopped.
+
+Run: python examples/streaming_fit.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _common import ensure_backend  # noqa: E402
+
+ensure_backend()
+
+
+import tempfile  # noqa: E402
+import warnings  # noqa: E402
+
+import numpy as np  # noqa: E402
+
+from sq_learn_tpu.models import MiniBatchQKMeans  # noqa: E402
+from sq_learn_tpu.native import csv_stream_batches, native_available  # noqa: E402
+from sq_learn_tpu.utils import load_estimator, save_estimator  # noqa: E402
+
+warnings.filterwarnings("ignore")
+
+
+def main():
+    workdir = tempfile.mkdtemp(prefix="sq_streaming_")
+    csv_path = os.path.join(workdir, "events.csv")
+    ckpt_dir = os.path.join(workdir, "ckpt")
+
+    # synthesize a "big" file on disk (stand-in for CICIDS-scale logs)
+    rng = np.random.default_rng(0)
+    centers = rng.normal(scale=10.0, size=(5, 12))
+    X = np.vstack([c + rng.normal(size=(4000, 12)) for c in centers])
+    rng.shuffle(X)
+    np.savetxt(csv_path, X.astype(np.float32), delimiter=",",
+               header=",".join(f"f{i}" for i in range(12)))
+    print(f"wrote {X.shape[0]} rows to {csv_path} "
+          f"(native parser: {native_available()})")
+
+    est = MiniBatchQKMeans(n_clusters=5, delta=0.3,
+                           true_distance_estimate=False, random_state=0)
+    stream = csv_stream_batches(csv_path, batch_rows=1024)
+    for i, batch in enumerate(stream):
+        est.partial_fit(batch)
+        if i == 9:  # simulate an interruption mid-ingest
+            save_estimator(est, ckpt_dir)
+            print(f"checkpointed after {est.n_steps_} batches "
+                  f"(inertia {est.inertia_:.1f})")
+            break
+
+    resumed = load_estimator(ckpt_dir)
+    for batch in stream:  # the SAME stream object — ingest continues
+        resumed.partial_fit(batch)
+    print(f"resumed to {resumed.n_steps_} batches "
+          f"(inertia {resumed.inertia_:.1f})")
+
+    labels = resumed.predict(X[:10].astype(np.float32))
+    print("labels of first 10 rows:", labels)
+
+
+if __name__ == "__main__":
+    main()

@@ -364,29 +364,74 @@ def csv_read_floats(path, delimiter=",", skip_header=1, max_rows=None):
     return data
 
 
+_NUM_PREFIX = None  # compiled lazily
+
+
+def _parse_lines(lines, delimiter, n_cols):
+    """strtof-parity parser for the streaming fallback: each field is the
+    leading numeric prefix (junk suffix ignored), missing/invalid fields
+    are NaN, extra fields are truncated — exactly the native
+    ``parse_csv_line`` contract, including ragged rows."""
+    global _NUM_PREFIX
+    if _NUM_PREFIX is None:
+        import re
+
+        _NUM_PREFIX = re.compile(
+            r"^\s*[-+]?(\d+\.?\d*|\.\d+)([eE][-+]?\d+)?")
+    rows = np.full((len(lines), n_cols), np.nan, np.float32)
+    for i, ln in enumerate(lines):
+        parts = ln.rstrip("\r\n").split(delimiter)
+        for c in range(min(n_cols, len(parts))):
+            part = parts[c]
+            try:
+                rows[i, c] = float(part)
+            except ValueError:
+                m = _NUM_PREFIX.match(part)
+                if m:
+                    rows[i, c] = float(m.group(0))
+    return rows
+
+
+def _probe_n_cols(path, delimiter, skip_header):
+    """Column count from the first data line — NOT a full-file scan (the
+    whole point of streaming is never reading the file twice)."""
+    with open(path, "r") as f:
+        for _ in range(skip_header):
+            f.readline()
+        line = f.readline()
+        while line and not line.strip():
+            line = f.readline()
+        if not line:
+            return 0
+        return line.count(delimiter) + 1
+
+
 def csv_stream_batches(path, batch_rows, delimiter=",", skip_header=1,
                        n_cols=None):
     """Yield (batch_rows, n_cols) float32 arrays from a numeric CSV without
     loading the file — the host-side input pipeline for incremental fits
     (``MiniBatchQKMeans.partial_fit``) on larger-than-memory data. The last
-    batch may be short; non-numeric fields parse as NaN.
+    batch may be short; non-numeric/missing fields parse as NaN, extra
+    fields are dropped, blank (incl. whitespace-only) lines are skipped.
 
-    Native path keeps one open stream (no per-batch rescan); fallback
-    streams the file line-by-line in NumPy.
+    Native path keeps one open stream (no per-batch rescan); the NumPy
+    fallback implements the identical contract (pinned by tests).
     """
     path = os.fspath(path)
     if batch_rows <= 0:
         raise ValueError(f"batch_rows must be > 0, got {batch_rows}")
+    if n_cols is None:
+        # one line of lookahead, not csv_shape: that would scan the whole
+        # (possibly larger-than-memory) file before the first batch
+        n_cols = _probe_n_cols(path, delimiter, skip_header)
+    if n_cols <= 0:
+        return iter(())
+    return _stream_batches(path, batch_rows, delimiter, skip_header, n_cols)
+
+
+def _stream_batches(path, batch_rows, delimiter, skip_header, n_cols):
     lib = _load()
     if lib is not None:
-        if n_cols is None:
-            rows = ctypes.c_int64()
-            cols = ctypes.c_int64()
-            if lib.csv_shape(path.encode(), delimiter.encode(),
-                             int(skip_header), ctypes.byref(rows),
-                             ctypes.byref(cols)) != 0:
-                raise OSError(f"cannot read {path}")
-            n_cols = cols.value
         handle = lib.csv_stream_open(path.encode(), delimiter.encode(),
                                      int(skip_header))
         if handle:
@@ -403,9 +448,8 @@ def csv_stream_batches(path, batch_rows, delimiter=",", skip_header=1,
             finally:
                 lib.csv_stream_close(handle)
             return
-    # NumPy fallback: stream lines, parse per batch (same contract as the
-    # native stream: blank lines are free, '#' is data not a comment,
-    # n_cols truncates/NaN-pads the field count)
+    # NumPy fallback: stream lines, parse per batch with the same field
+    # semantics as the native stream
     with open(path, "r") as f:
         for _ in range(skip_header):
             f.readline()
@@ -419,17 +463,7 @@ def csv_stream_batches(path, batch_rows, delimiter=",", skip_header=1,
                     lines.append(line)
             if not lines:
                 return
-            batch = np.genfromtxt(lines, delimiter=delimiter,
-                                  dtype=np.float32, comments=None)
-            batch = batch.reshape(len(lines), -1)
-            if n_cols is not None and batch.shape[1] != n_cols:
-                if batch.shape[1] > n_cols:
-                    batch = batch[:, :n_cols]
-                else:
-                    pad = np.full((len(lines), n_cols - batch.shape[1]),
-                                  np.nan, np.float32)
-                    batch = np.concatenate([batch, pad], axis=1)
-            yield batch
+            yield _parse_lines(lines, delimiter, n_cols)
 
 
 __all__ = ["native_available", "lloyd_iter", "murmurhash3_32",

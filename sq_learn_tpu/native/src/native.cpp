@@ -301,7 +301,7 @@ int csv_shape(const char* path, char delim, int skip_header, int64_t* n_rows,
   ssize_t len;
   while ((len = getline(&line, &cap, f)) != -1) {
     if (skipped < skip_header) { ++skipped; continue; }
-    if (len <= 1 && (line[0] == '\n' || line[0] == '\0')) continue;
+    if (csv_blank_line(line, len)) continue;
     if (rows == 0) {
       cols = 1;
       for (ssize_t i = 0; i < len; ++i)
@@ -314,6 +314,17 @@ int csv_shape(const char* path, char delim, int skip_header, int64_t* n_rows,
   *n_rows = rows;
   *n_cols = cols;
   return 0;
+}
+
+// Whitespace-only (incl. CRLF) line — skipped by every reader so the
+// native and fallback paths agree on row counts.
+static bool csv_blank_line(const char* line, ssize_t len) {
+  for (ssize_t i = 0; i < len; ++i) {
+    char ch = line[i];
+    if (ch == '\0') break;
+    if (ch != '\n' && ch != '\r' && ch != ' ' && ch != '\t') return false;
+  }
+  return true;
 }
 
 // Parse one CSV line into n_cols float32 fields. Non-numeric fields parse
@@ -349,7 +360,7 @@ int64_t csv_parse_floats(const char* path, char delim, int skip_header,
   ssize_t len;
   while (row < max_rows && (len = getline(&line, &cap, f)) != -1) {
     if (skipped < skip_header) { ++skipped; continue; }
-    if (len <= 1 && (line[0] == '\n' || line[0] == '\0')) continue;
+    if (csv_blank_line(line, len)) continue;
     parse_csv_line(line, delim, out + row * n_cols, n_cols);
     ++row;
   }
@@ -396,7 +407,7 @@ int64_t csv_stream_next(void* handle, float* out, int64_t max_rows,
   ssize_t len;
   while (row < max_rows && (len = getline(&s->line, &s->cap, s->f)) != -1) {
     char* line = s->line;
-    if (len <= 1 && (line[0] == '\n' || line[0] == '\0')) continue;
+    if (csv_blank_line(line, len)) continue;
     parse_csv_line(line, s->delim, out + row * n_cols, n_cols);
     ++row;
   }

@@ -167,3 +167,26 @@ class TestCsvStreamBatches:
             assert all(b.shape[1] == 4 for b in wide)
             assert np.isnan(np.vstack(wide)[:, 3]).all()
             monkeypatch.undo()
+
+    def test_eager_validation_and_edge_files(self, tmp_path, monkeypatch):
+        from sq_learn_tpu import native
+
+        p = tmp_path / "crlf.csv"
+        # CRLF line endings, a whitespace-only line, a ragged short row,
+        # and a junk-suffixed numeric field
+        p.write_bytes(b"h1,h2,h3\r\n1.0,2.0,3.0\r\n \r\n4.0,5.0\r\n"
+                      b"7.0junk,8.0,9.0\r\n")
+        with pytest.raises(ValueError, match="batch_rows"):
+            native.csv_stream_batches(p, 0)  # raises at call, not at next()
+        for forced_fallback in (False, True):
+            if forced_fallback:
+                monkeypatch.setattr(native, "_load", lambda: None)
+            elif not native.native_available():
+                continue
+            merged = np.vstack(list(native.csv_stream_batches(p, 2)))
+            assert merged.shape == (3, 3), merged  # blank line skipped
+            np.testing.assert_allclose(merged[0], [1.0, 2.0, 3.0])
+            assert np.isnan(merged[1, 2])  # ragged row NaN-padded
+            np.testing.assert_allclose(merged[1, :2], [4.0, 5.0])
+            np.testing.assert_allclose(merged[2], [7.0, 8.0, 9.0])  # strtof prefix
+            monkeypatch.undo()
