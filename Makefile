@@ -4,7 +4,7 @@
 
 PYTHON ?= python
 
-.PHONY: test test-fast lint bench bench-smoke multichip all
+.PHONY: test test-fast lint bench bench-smoke multichip examples all
 
 all: lint test
 
@@ -34,6 +34,13 @@ bench-smoke:
 	SQ_BENCH_SMOKE=1 $(PYTHON) -m bench.bench_qkmeans_mnist
 	SQ_BENCH_SMOKE=1 $(PYTHON) -m bench.bench_randomized_svd_covtype
 	SQ_BENCH_SMOKE=1 $(PYTHON) -m bench.bench_qkmeans_cicids_sweep
+
+# The fast example drivers (the slow ones — mnist_trial, streaming_fit —
+# are exercised manually; these three finish in ~35s total on CPU).
+examples:
+	$(PYTHON) examples/qpca_demo.py
+	$(PYTHON) examples/tomography_histogram.py
+	$(PYTHON) examples/sharded_fit.py
 
 # The driver's multichip gate, runnable locally.
 multichip:

@@ -1215,6 +1215,21 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         classical = n_samples * n_features * k * self.n_init
         return np.broadcast_to(quantum, n_samples.shape), classical
 
+    def runtime_comparison(self, n_samples, n_features, saveas=None,
+                           well_clusterable=False, plot=False):
+        """Reference-named wrapper of :meth:`quantum_runtime_model`
+        (``runtime_comparison``, ``_dmeans.py:1412-1469``): scalar
+        ``n_samples``/``n_features`` become a 100×100 meshgrid exactly as
+        the reference builds (``_dmeans.py:1426-1427``) and the
+        (quantum, classical) cost SURFACES over it are returned. The
+        MATLAB-engine plotting is not reproduced — plot the returned
+        arrays (``saveas``/``plot`` accepted for signature parity and
+        ignored)."""
+        nn, mm = np.meshgrid(np.linspace(0, float(n_samples), 100),
+                             np.linspace(0, float(n_features), 100))
+        return self.quantum_runtime_model(
+            nn, mm, well_clusterable=well_clusterable)
+
 
 def k_means(X, n_clusters, *, sample_weight=None, init="k-means++",
             n_init=10, max_iter=300, tol=1e-4, random_state=None,

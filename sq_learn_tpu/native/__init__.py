@@ -368,27 +368,29 @@ _NUM_PREFIX = None  # compiled lazily
 
 
 def _parse_lines(lines, delimiter, n_cols):
-    """strtof-parity parser for the streaming fallback: each field is the
-    leading numeric prefix (junk suffix ignored), missing/invalid fields
-    are NaN, extra fields are truncated — exactly the native
-    ``parse_csv_line`` contract, including ragged rows."""
+    """Streaming-fallback parser matching the native ``parse_csv_line``
+    (strtof) contract: each field is its leading numeric prefix (junk
+    suffix ignored — so ``1_000`` is 1.0, not Python's 1000.0), with
+    inf/nan literals; missing/invalid fields are NaN, extra fields are
+    truncated, ragged rows NaN-pad. Known divergence: C hex-float
+    literals (``0x1A``) parse as their leading decimal prefix here.
+    Prefix-first, never bare ``float()`` — Python accepts literals strtof
+    does not."""
     global _NUM_PREFIX
     if _NUM_PREFIX is None:
         import re
 
         _NUM_PREFIX = re.compile(
-            r"^\s*[-+]?(\d+\.?\d*|\.\d+)([eE][-+]?\d+)?")
+            r"^\s*[-+]?(?:inf(?:inity)?|nan"
+            r"|(?:\d+\.?\d*|\.\d+)(?:[eE][-+]?\d+)?)",
+            re.IGNORECASE)
     rows = np.full((len(lines), n_cols), np.nan, np.float32)
     for i, ln in enumerate(lines):
         parts = ln.rstrip("\r\n").split(delimiter)
         for c in range(min(n_cols, len(parts))):
-            part = parts[c]
-            try:
-                rows[i, c] = float(part)
-            except ValueError:
-                m = _NUM_PREFIX.match(part)
-                if m:
-                    rows[i, c] = float(m.group(0))
+            m = _NUM_PREFIX.match(parts[c])
+            if m:
+                rows[i, c] = float(m.group(0))
     return rows
 
 

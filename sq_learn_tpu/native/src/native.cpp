@@ -288,6 +288,17 @@ void murmurhash3_bulk(const char* buf, const int64_t* offsets, int64_t count,
 // CSV float ingest
 // ---------------------------------------------------------------------------
 
+// Whitespace-only (incl. CRLF) line — skipped by every reader so the
+// native and fallback paths agree on row counts.
+static bool csv_blank_line(const char* line, ssize_t len) {
+  for (ssize_t i = 0; i < len; ++i) {
+    char ch = line[i];
+    if (ch == '\0') break;
+    if (ch != '\n' && ch != '\r' && ch != ' ' && ch != '\t') return false;
+  }
+  return true;
+}
+
 // Count data rows and columns of a delimiter-separated numeric file.
 // Returns 0 on success; n_rows excludes `skip_header` lines.
 int csv_shape(const char* path, char delim, int skip_header, int64_t* n_rows,
@@ -314,17 +325,6 @@ int csv_shape(const char* path, char delim, int skip_header, int64_t* n_rows,
   *n_rows = rows;
   *n_cols = cols;
   return 0;
-}
-
-// Whitespace-only (incl. CRLF) line — skipped by every reader so the
-// native and fallback paths agree on row counts.
-static bool csv_blank_line(const char* line, ssize_t len) {
-  for (ssize_t i = 0; i < len; ++i) {
-    char ch = line[i];
-    if (ch == '\0') break;
-    if (ch != '\n' && ch != '\r' && ch != ' ' && ch != '\t') return false;
-  }
-  return true;
 }
 
 // Parse one CSV line into n_cols float32 fields. Non-numeric fields parse

@@ -109,6 +109,11 @@ class TestQuantumModes:
                      n_init=1, random_state=0).fit(X)
         q, c = qm.quantum_runtime_model(np.array([1e4, 1e6]), np.array([64.0, 64.0]))
         assert (q > 0).all() and (c > 0).all()
+        # reference-named wrapper (runtime_comparison, _dmeans.py:1412):
+        # scalars become the reference's 100x100 cost-surface meshgrid
+        q2, c2 = qm.runtime_comparison(1e6, 64.0, saveas="x.png")
+        assert q2.shape == c2.shape == (100, 100)
+        assert np.isfinite(q2).all() and (c2 >= 0).all()
 
 
 class TestShardedLloyd:
