@@ -35,7 +35,7 @@ from ..ops.linalg import (check_compute_dtype, inner_product, is_reduced,
                           pairwise_sq_distances, row_norms,
                           smallest_singular_value)
 from ..ops.quantum import tomography
-from ..ops.quantum.estimation import ipe
+from ..ops.quantum.estimation import ipe_matrix
 from ..utils import as_key, check_array, check_sample_weight
 
 LloydMode = ("classic", "delta", "ipe")
@@ -125,8 +125,8 @@ def e_step(key, X, weights, centers, x_sq_norms, *, delta, mode, ipe_q,
         c_sq = row_norms(centers, squared=True)
         inner = inner_product(X, centers, compute_dtype)
         key, sub = jax.random.split(key)
-        est_ip = ipe(sub, x_sq_norms[:, None], c_sq[None, :], inner,
-                     epsilon=delta / 2, Q=ipe_q)
+        est_ip = ipe_matrix(sub, inner, x_sq_norms, c_sq,
+                            epsilon=delta / 2, Q=ipe_q)
         d2 = x_sq_norms[:, None] + c_sq[None, :] - 2.0 * est_ip
         window = 0.0
     else:
@@ -1206,8 +1206,9 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         n_samples = np.asarray(n_samples, dtype=float)
         n_features = np.asarray(n_features, dtype=float)
         if well_clusterable:
-            quantum = (k * n_features * eta / delta**2
-                       + k**2 * eta**1.5 / delta**2)
+            # reference _dmeans.py:1448-1449
+            quantum = (k**2 * n_features * eta**2.5 / delta**3
+                       + k**2.5 * eta**2 / delta**3)
         else:
             quantum = (k * n_features * eta * kappa * (mu + k * eta / delta)
                        / delta**2
@@ -1217,18 +1218,34 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
 
     def runtime_comparison(self, n_samples, n_features, saveas=None,
                            well_clusterable=False, plot=False):
-        """Reference-named wrapper of :meth:`quantum_runtime_model`
-        (``runtime_comparison``, ``_dmeans.py:1412-1469``): scalar
-        ``n_samples``/``n_features`` become a 100×100 meshgrid exactly as
-        the reference builds (``_dmeans.py:1426-1427``) and the
-        (quantum, classical) cost SURFACES over it are returned. The
-        MATLAB-engine plotting is not reproduced — plot the returned
-        arrays (``saveas``/``plot`` accepted for signature parity and
-        ignored)."""
-        nn, mm = np.meshgrid(np.linspace(0, float(n_samples), 100),
-                             np.linspace(0, float(n_features), 100))
-        return self.quantum_runtime_model(
+        """Quantum-vs-classical cost surfaces (reference
+        ``runtime_comparison``, ``_dmeans.py:1412-1469``): scalar
+        ``n_samples``/``n_features`` expand to the reference's 100×100
+        int64 meshgrid (``_dmeans.py:1437-1438``) and the
+        (quantum, classical) surfaces over it are returned. The reference
+        plots via the MATLAB engine; a non-None ``saveas`` renders the
+        same 3-D comparison with matplotlib instead (as
+        ``QPCA.runtime_comparison`` does)."""
+        nn, mm = np.meshgrid(
+            np.linspace(0, n_samples, dtype=np.int64, num=100),
+            np.linspace(0, n_features, dtype=np.int64, num=100))
+        quantum, classical = self.quantum_runtime_model(
             nn, mm, well_clusterable=well_clusterable)
+        if saveas:
+            import matplotlib
+            matplotlib.use("Agg")
+            import matplotlib.pyplot as plt
+
+            fig = plt.figure()
+            ax = fig.add_subplot(projection="3d")
+            ax.plot_surface(nn, mm, quantum, label="quantumRuntime")
+            ax.plot_surface(nn, mm, classical, label="classicRuntime")
+            ax.set_xlabel("nSamples")
+            ax.set_ylabel("nFeatures")
+            ax.set_title("k_means VS q_means")
+            fig.savefig(saveas)
+            plt.close(fig)
+        return quantum, classical
 
 
 def k_means(X, n_clusters, *, sample_weight=None, init="k-means++",

@@ -357,11 +357,19 @@ def csv_read_floats(path, delimiter=",", skip_header=1, max_rows=None):
                 n, cols.value)
             if got >= 0:
                 return out[:got]
-    data = np.genfromtxt(path, delimiter=delimiter, skip_header=skip_header,
-                         max_rows=max_rows, dtype=np.float32, comments=None)
-    if data.ndim == 1:  # single column parses as (n,), not (1, n)
-        data = data.reshape(-1, 1)
-    return data
+    # fallback shares the strtof-parity parser with the streaming reader
+    # (np.genfromtxt follows Python float semantics — '1_000' -> 1000.0 —
+    # and would diverge from the native path on the same file)
+    n_cols = _probe_n_cols(path, delimiter, skip_header)
+    if n_cols <= 0:
+        return np.empty((0, 0), np.float32)
+    with open(path, "r") as f:
+        for _ in range(skip_header):
+            f.readline()
+        lines = [ln for ln in f if ln.strip()]
+    if max_rows is not None:
+        lines = lines[:max_rows]
+    return _parse_lines(lines, delimiter, n_cols)
 
 
 _NUM_PREFIX = None  # compiled lazily

@@ -206,18 +206,66 @@ def ipe(key, x_sq_norm, y_sq_norm, inner, epsilon, Q=None, gamma=0.1, window=64)
     return ssum * (1 - 2 * a_tilde) / 2
 
 
+# cap on the Fejér sampler's transient logits tensor (elements of
+# (batch, Q, 2·window+1)); ~64 MB of float32. Module-level so tests can
+# shrink it to force the blocked path.
+_IPE_BLOCK_ELEMS = 1 << 24
+
+
+def ipe_matrix(key, inner, x_sq, c_sq, epsilon, Q=None, gamma=0.1,
+               window=64):
+    """IPE over a precomputed (n, k) inner-product matrix with the sampler
+    transient capped.
+
+    The batched Fejér sampler materializes (batch, Q, 2·window+1) logits —
+    n·k·Q·129 floats in one shot, ~1.8 GB for MNIST-scale (70k, 10) at
+    Q=5 — so rows are processed in blocks sized to ``_IPE_BLOCK_ELEMS``.
+    Below the cap the single fused call is kept (no scan overhead). This
+    is the one bounded implementation behind every matrix-IPE caller
+    (q-means E-step, :func:`inner_product_estimates`).
+    """
+    inner = jnp.asarray(inner)
+    x_sq = jnp.asarray(x_sq)
+    c_sq = jnp.asarray(c_sq)
+    n, k = inner.shape
+    q_eff = Q if Q is not None else median_q(gamma)
+    per_row = k * q_eff * (2 * window + 1)
+    block = max(1, _IPE_BLOCK_ELEMS // max(per_row, 1))
+    if block >= n:
+        return ipe(key, x_sq[:, None], c_sq[None, :], inner,
+                   epsilon=epsilon, Q=Q, gamma=gamma, window=window)
+    nb = -(-n // block)
+    pad = nb * block - n
+    # padding rows: x_sq=1 keeps the amplitude encoding well-defined
+    # (0/0 otherwise); their estimates are sliced away below
+    innerp = jnp.pad(inner, ((0, pad), (0, 0)))
+    xsqp = jnp.pad(x_sq, (0, pad), constant_values=1.0)
+    keys = jax.random.split(key, nb)
+
+    def one(args):
+        kb, ib, xb = args
+        return ipe(kb, xb[:, None], c_sq[None, :], ib,
+                   epsilon=epsilon, Q=Q, gamma=gamma, window=window)
+
+    out = jax.lax.map(one, (keys, innerp.reshape(nb, block, k),
+                            xsqp.reshape(nb, block)))
+    return out.reshape(nb * block, k)[:n]
+
+
 def inner_product_estimates(key, X, C, epsilon, Q=None, gamma=0.1, window=64):
-    """IPE for every (row of X, row of C) pair in one kernel.
+    """IPE for every (row of X, row of C) pair in one bounded kernel.
 
     Replaces the reference's ``itertools.product`` + ``pool.map`` over n·k
     scalar calls (``_dmeans.py:753-769``). Returns an (n, k) matrix of
-    estimated inner products.
+    estimated inner products; the sampler transient is capped by
+    :func:`ipe_matrix`'s row blocking.
     """
     from ..linalg import row_norms
 
     X = jnp.asarray(X)
     C = jnp.asarray(C)
-    x2 = row_norms(X, squared=True)[:, None]
-    c2 = row_norms(C, squared=True)[None, :]
+    x2 = row_norms(X, squared=True)
+    c2 = row_norms(C, squared=True)
     ip = X @ C.T  # MXU
-    return ipe(key, x2, c2, ip, epsilon, Q=Q, gamma=gamma, window=window)
+    return ipe_matrix(key, ip, x2, c2, epsilon, Q=Q, gamma=gamma,
+                      window=window)

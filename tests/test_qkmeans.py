@@ -110,10 +110,12 @@ class TestQuantumModes:
         q, c = qm.quantum_runtime_model(np.array([1e4, 1e6]), np.array([64.0, 64.0]))
         assert (q > 0).all() and (c > 0).all()
         # reference-named wrapper (runtime_comparison, _dmeans.py:1412):
-        # scalars become the reference's 100x100 cost-surface meshgrid
-        q2, c2 = qm.runtime_comparison(1e6, 64.0, saveas="x.png")
+        # scalars become the reference's 100x100 int64 cost-surface mesh
+        q2, c2 = qm.runtime_comparison(1e6, 64.0)
         assert q2.shape == c2.shape == (100, 100)
         assert np.isfinite(q2).all() and (c2 >= 0).all()
+        qw, _ = qm.runtime_comparison(1e6, 64.0, well_clusterable=True)
+        assert np.isfinite(qw).all()
 
 
 class TestShardedLloyd:
@@ -653,3 +655,59 @@ class TestComputeDtype:
                       use_pallas=False, compute_dtype="bfloat16").fit(X)
         assert sklearn.metrics.adjusted_rand_score(
             est.predict(X), est.labels_) == 1.0
+
+
+class TestBlockedIPE:
+    """The matrix-IPE sampler transient is capped by row blocking
+    (estimation.ipe_matrix); blocked and fused paths must be statistically
+    identical, and every matrix-IPE caller goes through the bounded
+    implementation."""
+
+    def test_blocked_path_quality(self, blobs, monkeypatch):
+        import sq_learn_tpu.ops.quantum.estimation as est_mod
+
+        X, y = blobs
+        # force blocking: cap below one row-block of the (400, 4) problem
+        monkeypatch.setattr(est_mod, "_IPE_BLOCK_ELEMS", 4 * 5 * 129 * 50)
+        est = QKMeans(n_clusters=4, n_init=1, max_iter=30, delta=0.5,
+                      true_distance_estimate=True, random_state=0,
+                      use_pallas=False).fit(X)
+        assert sklearn.metrics.adjusted_rand_score(y, est.labels_) > 0.8
+
+    def test_blocked_estimates_close_to_fused(self, monkeypatch):
+        import jax
+        import sq_learn_tpu.ops.quantum.estimation as est_mod
+
+        rng = np.random.default_rng(0)
+        Xn = rng.normal(size=(200, 8)).astype(np.float32)
+        C = rng.normal(size=(5, 8)).astype(np.float32)
+        inner = Xn @ C.T
+        xsq = (Xn**2).sum(1)
+        csq = (C**2).sum(1)
+        key = jax.random.PRNGKey(0)
+        fused = np.asarray(est_mod.ipe_matrix(
+            key, inner, xsq, csq, epsilon=0.05, Q=5))
+        monkeypatch.setattr(est_mod, "_IPE_BLOCK_ELEMS", 5 * 5 * 129 * 32)
+        blocked = np.asarray(est_mod.ipe_matrix(
+            key, inner, xsq, csq, epsilon=0.05, Q=5))
+        assert blocked.shape == fused.shape == (200, 5)
+        # both are eps-accurate estimates of the same true inner products
+        scale = np.abs(inner) + 1.0
+        assert np.median(np.abs(fused - inner) / scale) < 0.05
+        assert np.median(np.abs(blocked - inner) / scale) < 0.05
+
+    def test_public_api_is_bounded(self, monkeypatch):
+        # inner_product_estimates (the pool-replacement API) must route
+        # through the same bounded implementation
+        import jax
+        import sq_learn_tpu.ops.quantum.estimation as est_mod
+
+        calls = []
+        orig = est_mod.ipe_matrix
+        monkeypatch.setattr(est_mod, "ipe_matrix",
+                            lambda *a, **k: calls.append(1) or orig(*a, **k))
+        rng = np.random.default_rng(1)
+        out = est_mod.inner_product_estimates(
+            jax.random.PRNGKey(0), rng.normal(size=(16, 4)).astype(np.float32),
+            rng.normal(size=(3, 4)).astype(np.float32), epsilon=0.1, Q=3)
+        assert np.asarray(out).shape == (16, 3) and calls
