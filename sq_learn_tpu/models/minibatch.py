@@ -248,7 +248,14 @@ class MiniBatchQKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         # every epoch reshuffles on device)
         Xp, wp, b = self._padded_rows(X, sample_weight)
         best = None
-        for _ in range(max(1, self.n_init)):
+        # sklearn 1.4 n_init='auto': 1 for k-means++/array inits (D²
+        # sampling makes restarts near-redundant), 3 otherwise
+        if self.n_init == "auto":
+            n_init = 1 if (self.init == "k-means++"
+                           or hasattr(self.init, "__array__")) else 3
+        else:
+            n_init = max(1, self.n_init)
+        for _ in range(n_init):
             key, ki, kf = jax.random.split(key, 3)
             centers, counts = self._init_state(ki, Xp, wp, X.shape[0])
             centers, counts, n_iter, n_steps, ewa = self._fit_loop(

@@ -22,6 +22,7 @@ instead.
 
 import functools
 import math
+import numbers
 import warnings
 
 import numpy as np
@@ -707,8 +708,11 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
     # -- validation ---------------------------------------------------------
 
     def _check_params(self, X):
-        if self.n_init <= 0:
-            raise ValueError(f"n_init should be > 0, got {self.n_init} instead.")
+        if not (self.n_init == "auto"
+                or (isinstance(self.n_init, numbers.Integral)
+                    and self.n_init > 0)):
+            raise ValueError(
+                f"n_init should be 'auto' or > 0, got {self.n_init} instead.")
         if self.max_iter <= 0:
             raise ValueError(
                 f"max_iter should be > 0, got {self.max_iter} instead.")
@@ -716,9 +720,11 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
             raise ValueError(
                 f"n_samples={X.shape[0]} should be >= n_clusters="
                 f"{self.n_clusters}.")
-        if self.algorithm not in ("auto", "full", "elkan"):
+        # 'lloyd' is modern sklearn's name for 'full' (renamed in 1.1) —
+        # accepted so code written against current sklearn drops in
+        if self.algorithm not in ("auto", "full", "lloyd", "elkan"):
             raise ValueError(
-                f"Algorithm must be 'auto', 'full' or 'elkan', got "
+                f"Algorithm must be 'auto', 'full', 'lloyd' or 'elkan', got "
                 f"{self.algorithm} instead.")
         if self.algorithm == "elkan":
             # triangle-inequality pruning is data-dependent branching — XLA-
@@ -736,6 +742,14 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         if delta == 0:
             return "classic"
         return "ipe" if self.true_distance_estimate else "delta"
+
+    def _resolved_n_init(self, init):
+        """sklearn 1.4 ``n_init='auto'`` semantics: one k-means++ restart
+        (D² sampling makes restarts near-redundant), ten for 'random' or
+        array inits."""
+        if self.n_init != "auto":
+            return int(self.n_init)
+        return 1 if (isinstance(init, str) and init == "k-means++") else 10
 
     def _init_centroids(self, key, X, x_sq_norms, init, n, weights=None):
         if isinstance(init, str) and init == "k-means++":
@@ -822,7 +836,7 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         init = self.init
         if hasattr(init, "__array__"):
             init = np.asarray(init, dtype=X.dtype) - np.asarray(stats["mean"])
-        n_init = 1 if hasattr(init, "__array__") else self.n_init
+        n_init = 1 if hasattr(init, "__array__") else             self._resolved_n_init(init)
 
         mode = self._mode(delta)
         results = self._run_lloyd(key, Xc, xsq, sample_weight, init, n_init,
@@ -898,7 +912,7 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         Xd = as_device_array(X)
         w = jnp.asarray(sample_weight, Xd.dtype)
         key = as_key(self.random_state)
-        kw = dict(n_init=int(self.n_init), init=self.init,
+        kw = dict(n_init=self._resolved_n_init(self.init), init=self.init,
                   n_clusters=self.n_clusters, quantum=quantum,
                   mu_grid=mu_grid, delta=delta, mode=mode,
                   max_iter=self.max_iter,
@@ -1213,7 +1227,8 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
             quantum = (k * n_features * eta * kappa * (mu + k * eta / delta)
                        / delta**2
                        + k**2 * eta**1.5 * kappa * mu / delta**2)
-        classical = n_samples * n_features * k * self.n_init
+        classical = (n_samples * n_features * k
+                     * self._resolved_n_init(self.init))
         return np.broadcast_to(quantum, n_samples.shape), classical
 
     def runtime_comparison(self, n_samples, n_features, saveas=None,

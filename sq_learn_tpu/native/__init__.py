@@ -363,12 +363,15 @@ def csv_read_floats(path, delimiter=",", skip_header=1, max_rows=None):
     n_cols = _probe_n_cols(path, delimiter, skip_header)
     if n_cols <= 0:
         return np.empty((0, 0), np.float32)
+    lines = []
     with open(path, "r") as f:
         for _ in range(skip_header):
             f.readline()
-        lines = [ln for ln in f if ln.strip()]
-    if max_rows is not None:
-        lines = lines[:max_rows]
+        for ln in f:
+            if ln.strip():
+                lines.append(ln)
+                if max_rows is not None and len(lines) >= max_rows:
+                    break  # early stop — never materialize the whole file
     return _parse_lines(lines, delimiter, n_cols)
 
 

@@ -85,6 +85,22 @@ def main():
     np.testing.assert_allclose(np.asarray(mean), X.mean(axis=0),
                                rtol=1e-5, atol=1e-5)
 
+    # the FULL q-means Lloyd loop (while_loop + psum reductions) across the
+    # cross-process mesh: every host runs the same SPMD program; labels and
+    # centers come back identical everywhere (replicated outputs)
+    from sq_learn_tpu.parallel.lloyd import lloyd_single_sharded
+
+    centers0 = X[:3]
+    xsq_shard = (shard * shard).sum(axis=1)
+    xsqg = jax.make_array_from_process_local_data(
+        sharding, xsq_shard.astype(np.float32))
+    labels, inertia, centers_out, n_iter, _ = lloyd_single_sharded(
+        mesh, jax.random.PRNGKey(0), Xg, wg, centers0, xsqg,
+        delta=0.4, mode="delta", max_iter=5, tol=0.0)
+    assert centers_out.shape == centers0.shape
+    assert np.isfinite(float(inertia)), float(inertia)
+    assert int(n_iter) >= 1
+
     print(f"worker {pid} OK", flush=True)
 
 

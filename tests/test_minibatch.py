@@ -142,3 +142,18 @@ class TestReassignment:
             mb.fit(X, sample_weight=w)
         # the dead center must have left the outlier
         assert np.abs(mb.cluster_centers_).max() < 100.0
+
+
+def test_n_init_auto():
+    import numpy as np
+    from sq_learn_tpu.datasets import make_blobs
+    from sq_learn_tpu.models import MiniBatchKMeans
+
+    X, _ = make_blobs(n_samples=300, centers=3, n_features=4, random_state=0)
+    est = MiniBatchKMeans(n_clusters=3, n_init="auto", max_iter=5,
+                          random_state=0).fit(X.astype(np.float32))
+    assert np.isfinite(est.inertia_)
+    # sklearn semantics: 'auto' is 1 for the default k-means++ init
+    r = MiniBatchKMeans(n_clusters=3, n_init="auto", init="random",
+                        max_iter=5, random_state=0).fit(X.astype(np.float32))
+    assert np.isfinite(r.inertia_)

@@ -711,3 +711,30 @@ class TestBlockedIPE:
             jax.random.PRNGKey(0), rng.normal(size=(16, 4)).astype(np.float32),
             rng.normal(size=(3, 4)).astype(np.float32), epsilon=0.1, Q=3)
         assert np.asarray(out).shape == (16, 3) and calls
+
+
+class TestModernSklearnCompat:
+    def test_algorithm_lloyd_accepted(self, blobs):
+        X, _ = blobs
+        km = KMeans(n_clusters=4, algorithm="lloyd", n_init=1,
+                    random_state=0).fit(X)
+        assert km.cluster_centers_.shape == (4, X.shape[1])
+
+    def test_n_init_auto(self, blobs):
+        X, _ = blobs
+        km = KMeans(n_clusters=4, n_init="auto", random_state=0).fit(X)
+        assert np.isfinite(km.inertia_)
+        assert KMeans(n_clusters=4)._resolved_n_init("k-means++") == 10
+        assert KMeans(n_clusters=4, n_init="auto")._resolved_n_init(
+            "k-means++") == 1
+        assert KMeans(n_clusters=4, n_init="auto")._resolved_n_init(
+            "random") == 10
+        with pytest.raises(ValueError, match="n_init"):
+            KMeans(n_clusters=4, n_init=0).fit(X)
+        with pytest.raises(ValueError, match="n_init"):
+            KMeans(n_clusters=4, n_init="Auto").fit(X)  # typo'd string
+        # runtime model works after an n_init='auto' fit
+        qm = QKMeans(n_clusters=4, n_init="auto", delta=0.5,
+                     true_distance_estimate=False, random_state=0).fit(X)
+        q, c = qm.quantum_runtime_model(np.array([1e4]), np.array([64.0]))
+        assert np.isfinite(q).all() and np.isfinite(c).all()
