@@ -21,6 +21,7 @@ reshuffled batch stack, so the host never dispatches per batch.
 """
 
 import functools
+import numbers
 import warnings
 
 import numpy as np
@@ -249,12 +250,17 @@ class MiniBatchQKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         Xp, wp, b = self._padded_rows(X, sample_weight)
         best = None
         # sklearn 1.4 n_init='auto': 1 for k-means++/array inits (D²
-        # sampling makes restarts near-redundant), 3 otherwise
+        # sampling makes restarts near-redundant), 3 otherwise; same
+        # validation contract as QKMeans for anything else
         if self.n_init == "auto":
             n_init = 1 if (self.init == "k-means++"
                            or hasattr(self.init, "__array__")) else 3
+        elif isinstance(self.n_init, numbers.Integral) and self.n_init > 0:
+            n_init = int(self.n_init)
         else:
-            n_init = max(1, self.n_init)
+            raise ValueError(
+                f"n_init should be 'auto' or > 0, got {self.n_init} "
+                f"instead.")
         for _ in range(n_init):
             key, ki, kf = jax.random.split(key, 3)
             centers, counts = self._init_state(ki, Xp, wp, X.shape[0])

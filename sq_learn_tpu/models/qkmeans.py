@@ -744,11 +744,13 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         return "ipe" if self.true_distance_estimate else "delta"
 
     def _resolved_n_init(self, init):
-        """sklearn 1.4 ``n_init='auto'`` semantics: one k-means++ restart
-        (D² sampling makes restarts near-redundant), ten for 'random' or
-        array inits."""
+        """sklearn 1.4 ``n_init='auto'`` semantics: one restart for
+        k-means++ (D² sampling makes restarts near-redundant) and for
+        explicit array inits (deterministic start), ten for 'random'."""
         if self.n_init != "auto":
             return int(self.n_init)
+        if hasattr(init, "__array__"):
+            return 1
         return 1 if (isinstance(init, str) and init == "k-means++") else 10
 
     def _init_centroids(self, key, X, x_sq_norms, init, n, weights=None):

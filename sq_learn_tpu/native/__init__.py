@@ -368,10 +368,10 @@ def csv_read_floats(path, delimiter=",", skip_header=1, max_rows=None):
         for _ in range(skip_header):
             f.readline()
         for ln in f:
+            if max_rows is not None and len(lines) >= max_rows:
+                break  # early stop — never materialize the whole file
             if ln.strip():
                 lines.append(ln)
-                if max_rows is not None and len(lines) >= max_rows:
-                    break  # early stop — never materialize the whole file
     return _parse_lines(lines, delimiter, n_cols)
 
 

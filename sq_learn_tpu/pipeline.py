@@ -95,6 +95,9 @@ class Pipeline(BaseEstimator):
         return self.steps[-1][1].predict(
             self._transform_only(X), **predict_params)
 
+    def predict_proba(self, X):
+        return self.steps[-1][1].predict_proba(self._transform_only(X))
+
     def fit_predict(self, X, y=None, **fit_params):
         Xt = self._fit_transforms(X, y)
         return self.steps[-1][1].fit_predict(Xt, y)

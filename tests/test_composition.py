@@ -234,3 +234,19 @@ class TestParameterGrid:
         grid = ParameterGrid([{"a": [1]}, {"b": [2, 3]}])
         assert len(grid) == 3
         assert list(grid) == [{"a": 1}, {"b": 2}, {"b": 3}]
+
+
+def test_pipeline_predict_proba():
+    import numpy as np
+    from sq_learn_tpu.datasets import make_blobs
+    from sq_learn_tpu.models import KNeighborsClassifier
+    from sq_learn_tpu.pipeline import make_pipeline
+    from sq_learn_tpu.preprocessing import StandardScaler
+
+    X, y = make_blobs(n_samples=200, centers=3, n_features=5, random_state=0)
+    pipe = make_pipeline(StandardScaler(),
+                         KNeighborsClassifier(n_neighbors=5)).fit(
+        X.astype(np.float32), y)
+    proba = pipe.predict_proba(X[:20].astype(np.float32))
+    assert proba.shape == (20, 3)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-5)

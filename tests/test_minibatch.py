@@ -157,3 +157,7 @@ def test_n_init_auto():
     r = MiniBatchKMeans(n_clusters=3, n_init="auto", init="random",
                         max_iter=5, random_state=0).fit(X.astype(np.float32))
     assert np.isfinite(r.inertia_)
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="n_init"):
+        MiniBatchKMeans(n_clusters=3, n_init="Auto").fit(
+            X.astype(np.float32))
