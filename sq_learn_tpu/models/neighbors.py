@@ -10,6 +10,7 @@ for the dimensionalities these pipelines touch.
 """
 
 import functools
+import numbers
 
 import numpy as np
 import jax
@@ -97,11 +98,27 @@ class KNeighborsClassifier(ClassifierMixin, BaseEstimator):
         self.n_samples_fit_ = len(X)
         return self
 
+    def _check_k(self, k):
+        """Validate a neighbor count before it reaches ``lax.top_k``
+        (whose size error is opaque). Bounds and messages follow sklearn's
+        ``neighbors/_base.py`` ``kneighbors`` contract (the reference ships
+        it verbatim): 1 ≤ k ≤ n_samples_fit."""
+        if k is None:
+            k = self.n_neighbors
+        if not isinstance(k, numbers.Integral) or k <= 0:
+            raise ValueError(
+                f"n_neighbors must be a positive integer, got {k!r}")
+        if k > self.n_samples_fit_:
+            raise ValueError(
+                f"Expected n_neighbors <= n_samples_fit, but "
+                f"n_neighbors = {k}, n_samples_fit = {self.n_samples_fit_}")
+        return int(k)
+
     @with_device_scope
     def kneighbors(self, X, n_neighbors=None, return_distance=True):
         check_is_fitted(self, "n_samples_fit_")
         X = check_array(X)
-        k = n_neighbors or self.n_neighbors
+        k = self._check_k(n_neighbors)
         idx, d2 = knn_indices(self.X_fit_, jnp.asarray(X), k,
                               compute_dtype=self.compute_dtype)
         if return_distance:
@@ -112,7 +129,8 @@ class KNeighborsClassifier(ClassifierMixin, BaseEstimator):
     def predict_proba(self, X):
         check_is_fitted(self, "n_samples_fit_")
         X = check_array(X)
-        idx, d2 = knn_indices(self.X_fit_, jnp.asarray(X), self.n_neighbors,
+        idx, d2 = knn_indices(self.X_fit_, jnp.asarray(X),
+                              self._check_k(self.n_neighbors),
                               compute_dtype=self.compute_dtype)
         votes = self.y_fit_[idx]  # (n, k)
         n_classes = len(self.classes_)

@@ -744,13 +744,18 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         return "ipe" if self.true_distance_estimate else "delta"
 
     def _resolved_n_init(self, init):
-        """sklearn 1.4 ``n_init='auto'`` semantics: one restart for
-        k-means++ (D² sampling makes restarts near-redundant) and for
-        explicit array inits (deterministic start), ten for 'random'."""
+        """The restart count every consumer (fit paths AND cost models)
+        agrees on. Array inits always run once — sklearn's contract, with
+        its RuntimeWarning when an explicit n_init asked for more; 'auto'
+        follows sklearn 1.4 (1 for k-means++, 10 for 'random')."""
+        if hasattr(init, "__array__"):
+            if self.n_init != "auto" and int(self.n_init) > 1:
+                warnings.warn(
+                    "Explicit initial center position passed: performing "
+                    "only one init of the restart loop.", RuntimeWarning)
+            return 1
         if self.n_init != "auto":
             return int(self.n_init)
-        if hasattr(init, "__array__"):
-            return 1
         return 1 if (isinstance(init, str) and init == "k-means++") else 10
 
     def _init_centroids(self, key, X, x_sq_norms, init, n, weights=None):

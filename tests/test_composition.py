@@ -250,3 +250,20 @@ def test_pipeline_predict_proba():
     proba = pipe.predict_proba(X[:20].astype(np.float32))
     assert proba.shape == (20, 3)
     np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-5)
+
+
+def test_knn_k_exceeds_train_size_clean_error():
+    import numpy as np
+    import pytest as _pytest
+    from sq_learn_tpu.models import KNeighborsClassifier
+
+    knn = KNeighborsClassifier(n_neighbors=5).fit(
+        np.arange(6, dtype=np.float32).reshape(3, 2), np.array([0, 1, 0]))
+    with _pytest.raises(ValueError, match="n_neighbors <= n_samples_fit"):
+        knn.predict(np.ones((2, 2), np.float32))
+    with _pytest.raises(ValueError, match="n_neighbors <= n_samples_fit"):
+        knn.kneighbors(np.ones((2, 2), np.float32))
+    with _pytest.raises(ValueError, match="positive integer"):
+        knn.kneighbors(np.ones((2, 2), np.float32), n_neighbors=0)
+    with _pytest.raises(ValueError, match="positive integer"):
+        knn.kneighbors(np.ones((2, 2), np.float32), n_neighbors=-1)
