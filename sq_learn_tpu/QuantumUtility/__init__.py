@@ -48,6 +48,10 @@ from ..ops.quantum import (
     tomography_incremental,
 )
 from ..ops.quantum.estimation import sv_to_theta, theta_to_sv
+from ..ops.quantum.tomography import magnitude_tomography_signed
+
+# reference name (misspelling and all, Utility.py:234) kept as an alias
+L2_tomogrphy_fakeSign = magnitude_tomography_signed
 
 # reference aliases
 make_gaussian_est = gaussian_estimate

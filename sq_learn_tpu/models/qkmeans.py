@@ -745,14 +745,10 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
 
     def _resolved_n_init(self, init):
         """The restart count every consumer (fit paths AND cost models)
-        agrees on. Array inits always run once — sklearn's contract, with
-        its RuntimeWarning when an explicit n_init asked for more; 'auto'
-        follows sklearn 1.4 (1 for k-means++, 10 for 'random')."""
+        agrees on — pure: array inits always run once (sklearn's
+        contract; ``fit`` owns the RuntimeWarning), 'auto' follows
+        sklearn 1.4 (1 for k-means++, 10 for 'random')."""
         if hasattr(init, "__array__"):
-            if self.n_init != "auto" and int(self.n_init) > 1:
-                warnings.warn(
-                    "Explicit initial center position passed: performing "
-                    "only one init of the restart loop.", RuntimeWarning)
             return 1
         if self.n_init != "auto":
             return int(self.n_init)
@@ -795,6 +791,12 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
                 raise ValueError(
                     "intermediate_error cannot be True if delta is zero.")
         sample_weight = check_sample_weight(sample_weight, X)
+        if hasattr(self.init, "__array__") and self.n_init != "auto" \
+                and int(self.n_init) > 1:
+            # sklearn contract: explicit centers run exactly one restart
+            warnings.warn(
+                "Explicit initial center position passed: performing only "
+                "one init of the restart loop.", RuntimeWarning)
         cd = self._checked_compute_dtype()
         if self._mode(delta) == "ipe" and is_reduced(cd, X.dtype):
             warnings.warn(

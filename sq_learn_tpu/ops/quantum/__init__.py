@@ -28,6 +28,7 @@ from .norms import best_mu, linear_search, mu
 from .sampling import estimate_wald, fejer_grid_sample, fejer_probs, multinomial_counts
 from .state import QuantumState, coupon_collect
 from .tomography import (
+    magnitude_tomography_signed,
     real_tomography,
     tomography,
     tomography_incremental,
@@ -57,6 +58,7 @@ __all__ = [
     "multinomial_counts",
     "phase_estimation",
     "phase_estimation_m",
+    "magnitude_tomography_signed",
     "real_tomography",
     "sv_to_theta",
     "theta_to_sv",

@@ -105,6 +105,34 @@ def tomography(key, A, noise, true_tomography=True, norm="L2", N=None,
                            preserve_norm=preserve_norm)
 
 
+def magnitude_tomography_signed(key, v, delta=None, N=None,
+                                preserve_norm=False):
+    """Magnitude-only tomography with the TRUE signs copied onto the
+    estimated magnitudes — the legacy 'fake sign' shortcut (reference
+    ``L2_tomogrphy_fakeSign``, ``Utility.py:234-256``): part 1 of Alg. 4.1
+    (N = 36·d·ln d/δ² Wald magnitudes from measurement counts) without the
+    interference-state sign resolution. Kept for experiments comparing
+    sign-resolution cost; ``real_tomography`` is the faithful algorithm.
+    The reference's dict-keyed implementation silently merges duplicate
+    values; this one is positional, the documented intent. Like the
+    reference, the returned estimate is of the NORMALIZED vector
+    (``preserve_norm=True`` rescales by ‖v‖, the convention of
+    :func:`real_tomography`)."""
+    v = jnp.asarray(v)
+    d = v.shape[0]
+    if N is None:
+        if delta is None:
+            raise ValueError("provide either N or delta")
+        if float(delta) == 0.0:
+            # zero error budget short-circuits to the exact vector
+            # (normalized, matching the estimate's convention)
+            return v if preserve_norm else v / jnp.linalg.norm(v)
+        N = tomography_n_measurements(d, delta, "L2")
+    counts = multinomial_counts(key, int(N), v * v)
+    est = jnp.sign(v) * jnp.sqrt(counts / int(N))
+    return est * jnp.linalg.norm(v) if preserve_norm else est
+
+
 def tomography_incremental(key, v, delta, norm="L2", num_points=100,
                            faster_measure_increment=0, stop_when_reached_accuracy=True):
     """Incremental-measurement tomography (reference ``Utility.py:315-363``).

@@ -168,3 +168,33 @@ class TestMuNorms:
         desc, val = best_mu(A)
         assert val <= np.linalg.norm(A) + 1e-6
         assert desc.startswith("p=") or desc == "Frobenius"
+
+
+class TestMagnitudeTomographySigned:
+    """Legacy fake-sign tomography (reference L2_tomogrphy_fakeSign,
+    Utility.py:234-256)."""
+
+    def test_estimates_with_true_signs(self):
+        from sq_learn_tpu.ops.quantum import magnitude_tomography_signed
+
+        rng = np.random.default_rng(0)
+        v = rng.normal(size=32).astype(np.float32)
+        v /= np.linalg.norm(v)
+        est = np.asarray(magnitude_tomography_signed(
+            jax.random.PRNGKey(0), v, delta=0.1))
+        assert np.linalg.norm(est - v) <= 0.1  # L2 guarantee, w.h.p.
+        nz = np.abs(v) > 1e-3
+        assert np.all(np.sign(est[nz]) == np.sign(v[nz]))  # true signs
+
+    def test_reference_alias(self):
+        import sq_learn_tpu.QuantumUtility as QU
+
+        assert QU.L2_tomogrphy_fakeSign is QU.magnitude_tomography_signed
+
+    def test_zero_delta_exact(self):
+        from sq_learn_tpu.ops.quantum import magnitude_tomography_signed
+
+        v = np.array([0.6, -0.8], np.float32)
+        out = np.asarray(magnitude_tomography_signed(
+            jax.random.PRNGKey(0), v, delta=0.0))
+        np.testing.assert_allclose(out, v, rtol=1e-6)
