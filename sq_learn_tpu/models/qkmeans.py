@@ -845,7 +845,7 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         init = self.init
         if hasattr(init, "__array__"):
             init = np.asarray(init, dtype=X.dtype) - np.asarray(stats["mean"])
-        n_init = 1 if hasattr(init, "__array__") else             self._resolved_n_init(init)
+        n_init = self._resolved_n_init(init)
 
         mode = self._mode(delta)
         results = self._run_lloyd(key, Xc, xsq, sample_weight, init, n_init,

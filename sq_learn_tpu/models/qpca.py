@@ -848,6 +848,63 @@ class QPCA(TransformerMixin, BaseEstimator):
         # is the transformed matrix
         return X_final
 
+    def _covariance(self):
+        """Device-side Σ = Cᵀ·diag(λ−σ²)·C + σ²·I (the jnp core shared by
+        the public methods — one host transfer at the public boundary
+        only)."""
+        C = jnp.asarray(self.components_)
+        ev = jnp.asarray(self.explained_variance_)
+        noise = jnp.asarray(self.noise_variance_, C.dtype)
+        diff = jnp.maximum(ev - noise, 0.0)
+        return (C.T * diff) @ C + noise * jnp.eye(C.shape[1], dtype=C.dtype)
+
+    def _precision(self):
+        """Device-side Σ⁻¹: with orthonormal component rows the Woodbury
+        identity collapses to (1/σ²)(I − Cᵀ·diag((λ−σ²)/λ)·C) — O(k·m²)
+        instead of an m×m inverse; σ²=0 falls back to the pseudo-inverse
+        of the (then singular) covariance."""
+        noise = float(self.noise_variance_)
+        if noise == 0.0:
+            return jnp.linalg.pinv(self._covariance())
+        C = jnp.asarray(self.components_)
+        ev = jnp.asarray(self.explained_variance_)
+        diff = jnp.maximum(ev - noise, 0.0)
+        shrink = diff / jnp.maximum(ev, 1e-30)
+        return (jnp.eye(C.shape[1], dtype=C.dtype)
+                - (C.T * shrink) @ C) / noise
+
+    @with_device_scope
+    def get_covariance(self):
+        """Model covariance (reference ``_base.py:25-44``)."""
+        check_is_fitted(self, "components_")
+        return np.asarray(self._covariance())
+
+    @with_device_scope
+    def get_precision(self):
+        """Σ⁻¹ in closed form (reference ``_base.py:46-77``; see
+        :meth:`_precision`)."""
+        check_is_fitted(self, "components_")
+        return np.asarray(self._precision())
+
+    @with_device_scope
+    def score_samples(self, X):
+        """Per-sample Gaussian log-likelihood under the probabilistic PCA
+        model (stock sklearn ``PCA.score_samples`` surface the reference
+        inherits): −½(m·ln 2π − ln|Σ⁻¹| + xᵀΣ⁻¹x) for centered x."""
+        check_is_fitted(self, "components_")
+        X = check_array(X)
+        Xc = jnp.asarray(X) - jnp.asarray(self.mean_)
+        P = self._precision()
+        quad = jnp.sum((Xc @ P) * Xc, axis=1)
+        _, logdet = jnp.linalg.slogdet(P)
+        m = X.shape[1]
+        return np.asarray(
+            -0.5 * (m * math.log(2 * math.pi) - logdet + quad))
+
+    def score(self, X, y=None):
+        """Mean sample log-likelihood (stock sklearn ``PCA.score``)."""
+        return float(np.mean(self.score_samples(X)))
+
     @with_device_scope
     def inverse_transform(self, X, use_classical_components=True):
         """Map back to feature space (reference ``_base.py:130-164``)."""

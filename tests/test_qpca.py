@@ -376,3 +376,34 @@ class TestComputeDtypeQPCA:
         with pytest.warns(RuntimeWarning, match="partial-U Gram route"):
             QPCA(n_components=4, svd_solver="full",
                  compute_dtype="bfloat16").fit(X)
+
+
+class TestCovariancePrecisionScore:
+    """get_covariance / get_precision / score_samples parity with sklearn
+    (reference modified _BasePCA carries the first two, _base.py:25-77)."""
+
+    def test_matches_sklearn(self):
+        import sklearn.decomposition
+
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(300, 12)).astype(np.float64)
+        ours = QPCA(n_components=4, svd_solver="full").fit(X)
+        ref = sklearn.decomposition.PCA(n_components=4,
+                                        svd_solver="full").fit(X)
+        np.testing.assert_allclose(ours.get_covariance(),
+                                   ref.get_covariance(), rtol=1e-3,
+                                   atol=1e-4)
+        np.testing.assert_allclose(ours.get_precision(),
+                                   ref.get_precision(), rtol=1e-3,
+                                   atol=1e-3)
+        np.testing.assert_allclose(ours.score_samples(X[:20]),
+                                   ref.score_samples(X[:20]), rtol=1e-3,
+                                   atol=1e-2)
+        assert ours.score(X) == pytest.approx(ref.score(X), rel=1e-3)
+
+    def test_precision_is_inverse(self):
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(200, 8)).astype(np.float32)
+        pca = QPCA(n_components=3, svd_solver="full").fit(X)
+        prod = pca.get_covariance() @ pca.get_precision()
+        np.testing.assert_allclose(prod, np.eye(8), atol=5e-3)
