@@ -232,6 +232,7 @@ class MiniBatchQKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
     @with_device_scope
     def fit(self, X, y=None, sample_weight=None):
         X = check_array(X)
+        self.n_features_in_ = X.shape[1]
         if X.shape[0] < self.n_clusters:
             raise ValueError(
                 f"n_samples={X.shape[0]} should be >= n_clusters="
@@ -324,6 +325,13 @@ class MiniBatchQKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         """Incremental update from one batch — the checkpointable streaming
         API (reference ``_dmeans.py:2139``)."""
         X = check_array(X)
+        seen = getattr(self, "n_features_in_", None)
+        if seen is not None and X.shape[1] != seen:
+            # sklearn's partial_fit contract: reject before touching state
+            raise ValueError(
+                f"X has {X.shape[1]} features, but {type(self).__name__} "
+                f"is expecting {seen} features as input.")
+        self.n_features_in_ = X.shape[1]
         sample_weight = check_sample_weight(sample_weight, X)
         delta = self._delta()
         mode = self._mode(delta)

@@ -96,6 +96,7 @@ class KNeighborsClassifier(ClassifierMixin, BaseEstimator):
         self.X_fit_ = as_device_array(X)  # set_config(device=...) placement
         self.y_fit_ = jnp.asarray(y_enc.astype(np.int32))
         self.n_samples_fit_ = len(X)
+        self.n_features_in_ = X.shape[1]
         return self
 
     def _check_k(self, k):

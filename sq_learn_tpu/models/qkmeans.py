@@ -782,6 +782,7 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         # fit never mutates X in place (centering allocates), so no defensive
         # copy is needed; copy_x is accepted for API parity only
         X = check_array(X, copy=False)
+        self.n_features_in_ = X.shape[1]
         self._check_params(X)
         delta = 0.0 if self.delta is None else float(self.delta)
         if delta == 0:

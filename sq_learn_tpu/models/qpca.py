@@ -292,6 +292,7 @@ class QPCA(TransformerMixin, BaseEstimator):
         self.faster_measure_increment = faster_measure_increment
 
         X = check_array(X, copy=self.copy)
+        self.n_features_in_ = X.shape[1]
         # set_config(device=...) placement: committing the input here pins
         # every downstream jit (SVD, quantum estimators) to that device —
         # except under a mesh, whose sharding owns placement

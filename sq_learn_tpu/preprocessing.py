@@ -22,6 +22,7 @@ class StandardScaler(TransformerMixin, BaseEstimator):
 
     def fit(self, X, y=None):
         X = jnp.asarray(check_array(X))
+        self.n_features_in_ = X.shape[1]
         self.mean_ = (np.asarray(jnp.mean(X, axis=0))
                       if self.with_mean else np.zeros(X.shape[1]))
         if self.with_std:
@@ -58,6 +59,7 @@ class MinMaxScaler(TransformerMixin, BaseEstimator):
 
     def fit(self, X, y=None):
         X = jnp.asarray(check_array(X))
+        self.n_features_in_ = X.shape[1]
         lo, hi = self.feature_range
         data_min = np.asarray(jnp.min(X, axis=0))
         data_max = np.asarray(jnp.max(X, axis=0))

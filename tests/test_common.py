@@ -130,3 +130,10 @@ def test_refit_overwrites_state(make, needs_y, method, data):
             fitted.fit(X)
     again = np.asarray(getattr(fitted, method)(X[:5]), dtype=np.float64)
     np.testing.assert_allclose(first, again, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("make,needs_y,method", ESTIMATORS, ids=IDS)
+def test_n_features_in_set_by_fit(make, needs_y, method, data):
+    """sklearn fit contract: every estimator records n_features_in_."""
+    fitted, X = _fit(make, needs_y, data)
+    assert getattr(fitted, "n_features_in_", None) == X.shape[1]

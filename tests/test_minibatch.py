@@ -161,3 +161,17 @@ def test_n_init_auto():
     with _pytest.raises(ValueError, match="n_init"):
         MiniBatchKMeans(n_clusters=3, n_init="Auto").fit(
             X.astype(np.float32))
+
+
+def test_partial_fit_feature_mismatch_rejected():
+    import numpy as np
+    import pytest as _pytest
+    from sq_learn_tpu.models import MiniBatchQKMeans
+
+    est = MiniBatchQKMeans(n_clusters=2, random_state=0)
+    est.partial_fit(np.ones((8, 5), np.float32) * np.arange(8)[:, None])
+    with _pytest.raises(ValueError, match="expecting 5 features"):
+        est.partial_fit(np.ones((8, 3), np.float32))
+    # state untouched by the rejected call
+    assert est.n_features_in_ == 5
+    assert est.cluster_centers_.shape == (2, 5)
