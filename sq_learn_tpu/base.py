@@ -43,6 +43,20 @@ def check_is_fitted(estimator, attributes=None):
         )
 
 
+def check_n_features(estimator, X):
+    """Raise sklearn's clear width-mismatch error when a fitted estimator
+    receives inference input whose feature count differs from fit's
+    (``n_features_in_`` contract, sklearn ``base.py`` ``_check_n_features``)
+    — the alternative is an opaque shape error deep inside a jitted
+    kernel. No-op when the estimator never recorded a width."""
+    seen = getattr(estimator, "n_features_in_", None)
+    if seen is not None and X.shape[-1] != seen:
+        raise ValueError(
+            f"X has {X.shape[-1]} features, but {type(estimator).__name__} "
+            f"is expecting {seen} features as input.")
+    return X
+
+
 def clone(estimator, *, safe=True):
     """Construct an unfitted estimator with the same hyperparameters.
 

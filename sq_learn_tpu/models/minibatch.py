@@ -30,7 +30,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from .._config import as_device_array, with_device_scope
-from ..base import BaseEstimator, ClusterMixin, TransformerMixin, check_is_fitted
+from ..base import (BaseEstimator, ClusterMixin, TransformerMixin,
+                    check_is_fitted, check_n_features)
 from ..ops.linalg import pairwise_sq_distances, row_norms
 from ..utils import as_key, check_array, check_sample_weight
 from .qkmeans import e_step, kmeans_plusplus, tolerance
@@ -324,13 +325,8 @@ class MiniBatchQKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
     def partial_fit(self, X, y=None, sample_weight=None):
         """Incremental update from one batch — the checkpointable streaming
         API (reference ``_dmeans.py:2139``)."""
-        X = check_array(X)
-        seen = getattr(self, "n_features_in_", None)
-        if seen is not None and X.shape[1] != seen:
-            # sklearn's partial_fit contract: reject before touching state
-            raise ValueError(
-                f"X has {X.shape[1]} features, but {type(self).__name__} "
-                f"is expecting {seen} features as input.")
+        # sklearn's partial_fit contract: reject before touching state
+        X = check_n_features(self, check_array(X))
         self.n_features_in_ = X.shape[1]
         sample_weight = check_sample_weight(sample_weight, X)
         delta = self._delta()
@@ -369,7 +365,7 @@ class MiniBatchQKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
     @with_device_scope
     def predict(self, X, sample_weight=None):
         check_is_fitted(self, "cluster_centers_")
-        X = check_array(X)
+        X = check_n_features(self, check_array(X))
         d2 = pairwise_sq_distances(
             jnp.asarray(X), jnp.asarray(self.cluster_centers_, X.dtype))
         return np.asarray(jnp.argmin(d2, axis=1))
@@ -377,7 +373,7 @@ class MiniBatchQKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
     @with_device_scope
     def transform(self, X):
         check_is_fitted(self, "cluster_centers_")
-        X = check_array(X)
+        X = check_n_features(self, check_array(X))
         from ..metrics import euclidean_distances
 
         return np.asarray(euclidean_distances(X, self.cluster_centers_))
@@ -387,7 +383,7 @@ class MiniBatchQKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
 
     def score(self, X, y=None, sample_weight=None):
         check_is_fitted(self, "cluster_centers_")
-        X = check_array(X)
+        X = check_n_features(self, check_array(X))
         sample_weight = check_sample_weight(sample_weight, X)
         _, inertia = self._full_assign(X, sample_weight)
         return -inertia

@@ -18,7 +18,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from .._config import as_device_array, with_device_scope
-from ..base import BaseEstimator, ClassifierMixin, check_is_fitted
+from ..base import (BaseEstimator, ClassifierMixin, check_is_fitted,
+                    check_n_features)
 from ..ops.linalg import (check_compute_dtype, is_reduced,
                           pairwise_sq_distances)
 from ..utils import check_array, check_X_y
@@ -118,7 +119,7 @@ class KNeighborsClassifier(ClassifierMixin, BaseEstimator):
     @with_device_scope
     def kneighbors(self, X, n_neighbors=None, return_distance=True):
         check_is_fitted(self, "n_samples_fit_")
-        X = check_array(X)
+        X = check_n_features(self, check_array(X))
         k = self._check_k(n_neighbors)
         idx, d2 = knn_indices(self.X_fit_, jnp.asarray(X), k,
                               compute_dtype=self.compute_dtype)
@@ -129,7 +130,7 @@ class KNeighborsClassifier(ClassifierMixin, BaseEstimator):
     @with_device_scope
     def predict_proba(self, X):
         check_is_fitted(self, "n_samples_fit_")
-        X = check_array(X)
+        X = check_n_features(self, check_array(X))
         idx, d2 = knn_indices(self.X_fit_, jnp.asarray(X),
                               self._check_k(self.n_neighbors),
                               compute_dtype=self.compute_dtype)

@@ -31,7 +31,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from .._config import as_device_array, with_device_scope
-from ..base import BaseEstimator, ClusterMixin, TransformerMixin, check_is_fitted
+from ..base import (BaseEstimator, ClusterMixin, TransformerMixin,
+                    check_is_fitted, check_n_features)
 from ..ops.linalg import (check_compute_dtype, inner_product, is_reduced,
                           pairwise_sq_distances, row_norms,
                           smallest_singular_value)
@@ -1174,7 +1175,7 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         its documented intent.
         """
         check_is_fitted(self, "cluster_centers_")
-        X = check_array(X)
+        X = check_n_features(self, check_array(X))
         delta = 0.0 if delta is None else float(delta)
         key = as_key(self.random_state)
         Xd = as_device_array(X)
@@ -1191,7 +1192,7 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         """Distances to cluster centers (purely classical, as the reference
         warns at ``_dmeans.py:1341-1347``)."""
         check_is_fitted(self, "cluster_centers_")
-        X = check_array(X)
+        X = check_n_features(self, check_array(X))
         from ..metrics import euclidean_distances
 
         return np.asarray(euclidean_distances(X, self.cluster_centers_))
@@ -1204,7 +1205,7 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         """Negative inertia of X under the fitted centers (fixes the
         reference's stale-signature ``score``, ``_dmeans.py:1401-1402``)."""
         check_is_fitted(self, "cluster_centers_")
-        X = check_array(X)
+        X = check_n_features(self, check_array(X))
         sample_weight = check_sample_weight(sample_weight, X)
         d2 = pairwise_sq_distances(
             as_device_array(X),

@@ -25,7 +25,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from .._config import as_device_array, with_device_scope
-from ..base import BaseEstimator, ClassifierMixin, check_is_fitted
+from ..base import (BaseEstimator, ClassifierMixin, check_is_fitted,
+                    check_n_features)
 from ..metrics.pairwise import (
     linear_kernel,
     polynomial_kernel,
@@ -232,7 +233,7 @@ class QLSSVC(ClassifierMixin, BaseEstimator):
         """Decision values h(x) = α·K(X_train, x) + b for all x in one GEMM
         (reference ``get_h``, ``_qSVM.py:263-276``)."""
         check_is_fitted(self, "alpha_")
-        X = check_array(X)
+        X = check_n_features(self, check_array(X))
         K = self.get_kernel(jnp.asarray(self.X_), jnp.asarray(X))  # (N, n)
         h = jnp.asarray(self.alpha_) @ K + self.b_
         if approx:
@@ -251,7 +252,7 @@ class QLSSVC(ClassifierMixin, BaseEstimator):
         """β(x) = √((N‖x‖²+1)·Nu) (reference ``get_betas``,
         ``_qSVM.py:278-282``)."""
         check_is_fitted(self, "alpha_")
-        X = jnp.asarray(check_array(X))
+        X = jnp.asarray(check_n_features(self, check_array(X)))
         N = len(self.X_)
         return np.asarray(
             jnp.sqrt((N * jnp.sum(X * X, axis=1) + 1.0) * self.Nu_))

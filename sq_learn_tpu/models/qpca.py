@@ -39,7 +39,8 @@ import jax
 import jax.numpy as jnp
 
 from .._config import as_device_array, with_device_scope
-from ..base import BaseEstimator, TransformerMixin, check_is_fitted
+from ..base import (BaseEstimator, TransformerMixin, check_is_fitted,
+                    check_n_features)
 from ..ops.linalg import (centered_svd, centered_svd_topk,
                           check_compute_dtype, randomized_svd, stable_cumsum)
 from ..ops.quantum import (
@@ -797,7 +798,7 @@ class QPCA(TransformerMixin, BaseEstimator):
         """(X − mean)·Wᵀ with W either the classical components or the
         tomography-estimated ones (reference ``_base.py:97-128``)."""
         check_is_fitted(self, "components_")
-        X = check_array(X)
+        X = check_n_features(self, check_array(X))
         Xc = jnp.asarray(X) - jnp.asarray(self.mean_)
         if use_classical_components:
             W = jnp.asarray(self.components_)
@@ -893,7 +894,7 @@ class QPCA(TransformerMixin, BaseEstimator):
         model (stock sklearn ``PCA.score_samples`` surface the reference
         inherits): −½(m·ln 2π − ln|Σ⁻¹| + xᵀΣ⁻¹x) for centered x."""
         check_is_fitted(self, "components_")
-        X = check_array(X)
+        X = check_n_features(self, check_array(X))
         Xc = jnp.asarray(X) - jnp.asarray(self.mean_)
         P = self._precision()
         quad = jnp.sum((Xc @ P) * Xc, axis=1)

@@ -13,7 +13,8 @@ import numpy as np
 import jax.numpy as jnp
 
 from .._config import as_device_array, with_device_scope
-from ..base import BaseEstimator, TransformerMixin, check_is_fitted
+from ..base import (BaseEstimator, TransformerMixin, check_is_fitted,
+                    check_n_features)
 from ..ops.linalg import randomized_svd, svd_flip_v, thin_svd
 from ..utils import as_key, check_array
 
@@ -78,7 +79,7 @@ class TruncatedSVD(TransformerMixin, BaseEstimator):
     @with_device_scope
     def transform(self, X):
         check_is_fitted(self, "components_")
-        X = check_array(X)
+        X = check_n_features(self, check_array(X))
         return np.asarray(jnp.asarray(X) @ jnp.asarray(self.components_).T)
 
     @with_device_scope

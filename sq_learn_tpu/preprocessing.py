@@ -8,7 +8,8 @@ XLA ops that fuse into whatever consumes them.
 import numpy as np
 import jax.numpy as jnp
 
-from .base import BaseEstimator, TransformerMixin, check_is_fitted
+from .base import (BaseEstimator, TransformerMixin, check_is_fitted,
+                   check_n_features)
 from .utils import check_array
 
 
@@ -39,13 +40,13 @@ class StandardScaler(TransformerMixin, BaseEstimator):
 
     def transform(self, X):
         check_is_fitted(self, "scale_")
-        X = jnp.asarray(check_array(X))
+        X = jnp.asarray(check_n_features(self, check_array(X)))
         return np.asarray((X - jnp.asarray(self.mean_))
                           / jnp.asarray(self.scale_))
 
     def inverse_transform(self, X):
         check_is_fitted(self, "scale_")
-        X = jnp.asarray(X)
+        X = jnp.asarray(check_n_features(self, check_array(X)))
         return np.asarray(X * jnp.asarray(self.scale_)
                           + jnp.asarray(self.mean_))
 
@@ -73,13 +74,13 @@ class MinMaxScaler(TransformerMixin, BaseEstimator):
 
     def transform(self, X):
         check_is_fitted(self, "scale_")
-        X = jnp.asarray(check_array(X))
+        X = jnp.asarray(check_n_features(self, check_array(X)))
         return np.asarray(X * jnp.asarray(self.scale_)
                           + jnp.asarray(self.min_))
 
     def inverse_transform(self, X):
         check_is_fitted(self, "scale_")
-        X = jnp.asarray(X)
+        X = jnp.asarray(check_n_features(self, check_array(X)))
         return np.asarray((X - jnp.asarray(self.min_))
                           / jnp.asarray(self.scale_))
 

@@ -137,3 +137,33 @@ def test_n_features_in_set_by_fit(make, needs_y, method, data):
     """sklearn fit contract: every estimator records n_features_in_."""
     fitted, X = _fit(make, needs_y, data)
     assert getattr(fitted, "n_features_in_", None) == X.shape[1]
+
+
+@pytest.mark.parametrize("make,needs_y,method", ESTIMATORS, ids=IDS)
+def test_width_mismatch_raises_cleanly(make, needs_y, method, data):
+    """Inference with the wrong feature count raises sklearn's message,
+    not an opaque jitted shape error (check_n_features contract)."""
+    fitted, X = _fit(make, needs_y, data)
+    if fitted.__class__.__name__ == "Normalizer":
+        pytest.skip("stateless transformer: any width is valid")
+    bad = np.ones((4, X.shape[1] + 2), dtype=X.dtype)
+    with pytest.raises(ValueError, match="features"):
+        getattr(fitted, method)(bad)
+
+
+def test_width_mismatch_covers_score_and_inverse(data):
+    """score / inverse_transform / get_betas paths are guarded too."""
+    X, y, y_pm = data
+    bad = np.ones((4, X.shape[1] + 2), dtype=X.dtype)
+    import warnings as _w
+
+    with _w.catch_warnings():
+        _w.simplefilter("ignore")
+        km = sq.QKMeans(n_clusters=3, n_init=1, random_state=0).fit(X)
+        mb = sq.MiniBatchKMeans(n_clusters=3, n_init=1, max_iter=5,
+                                random_state=0).fit(X)
+        sc = sq.preprocessing.StandardScaler().fit(X)
+        svc = sq.QLSSVC(kernel="linear", random_state=0).fit(X, y_pm)
+    for call in (km.score, mb.score, sc.inverse_transform, svc.get_betas):
+        with pytest.raises(ValueError, match="features"):
+            call(bad)
