@@ -24,7 +24,11 @@ run_and_record() {  # run_and_record <header> <cmd...>; returns the cmd's rc
   shift
   timeout 1200 "$@" >> "$out" 2>"$stderr_tmp"
   local rc=$?
-  tail -3 "$stderr_tmp" | sed 's/^/# stderr: /' >> "$out"
+  # failures keep a full traceback in the record (the temp file is deleted
+  # on exit); successes keep the 3-line summary
+  local depth=3
+  [ "$rc" -ne 0 ] && depth=40
+  tail -"$depth" "$stderr_tmp" | sed 's/^/# stderr: /' >> "$out"
   echo "# rc=$rc" >> "$out"
   return $rc
 }

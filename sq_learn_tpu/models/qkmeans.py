@@ -471,12 +471,14 @@ def _native_lloyd_run(rng, Xn, wn, xsq, centers0, *, window, max_iter, tol,
     faster engine."""
     from .. import native
 
-    def step(centers):
+    def step(centers, e_only=False):
         if use_cpp:
+            # the C++ kernel is fused; its M half is not separable
             seed = int(rng.integers(0, 2**63 - 1))
             return native.lloyd_iter_window(
                 Xn, centers, sample_weight=wn, window=window, seed=seed)
-        return native.host_lloyd_step(rng, Xn, wn, xsq, centers, window)
+        return native.host_lloyd_step(rng, Xn, wn, xsq, centers, window,
+                                      e_only=e_only)
 
     centers = np.ascontiguousarray(centers0, np.float32)
     best_inertia, best_centers, best_it = np.inf, centers, 0
@@ -500,10 +502,11 @@ def _native_lloyd_run(rng, Xn, wn, xsq, centers0, *, window, max_iter, tol,
             break
         if patience is not None and it - best_it > patience:
             break
-    # consistent final triple: better of (last centers, best centers)
+    # consistent final triple: better of (last centers, best centers) —
+    # E-only: the re-evaluation needs labels and inertia, not M partials
     outs = []
     for cand in (centers, best_centers):
-        labels, _, _, _, inertia = step(cand)
+        labels, _, _, _, inertia = step(cand, e_only=True)
         outs.append((labels, inertia, cand))
     labels, inertia, out_centers = min(outs, key=lambda t: t[1])
     history = {"inertia": inertia_tr, "center_shift": shift_tr}

@@ -167,7 +167,7 @@ def lloyd_iter(X, centers, sample_weight=None, n_threads=0):
     return labels, sums, counts, inertia
 
 
-def host_lloyd_step(rng, Xn, wn, xsq, centers, window):
+def host_lloyd_step(rng, Xn, wn, xsq, centers, window, e_only=False):
     """One fused host E+M step on BLAS: sgemm distances (the ‖c‖²−2xcᵀ
     trick, same as the reference's chunked kernel
     ``_k_means_lloyd.pyx:196-203``), optional δ-window uniform pick, one-hot
@@ -177,6 +177,8 @@ def host_lloyd_step(rng, Xn, wn, xsq, centers, window):
 
     Returns ``(labels int32 (n,), min_d2 (n,), sums (k, m), counts (k,),
     inertia float)`` with the same semantics as :func:`lloyd_iter_window`.
+    ``e_only`` skips the M-step partials (sums/counts are None) — for
+    final-candidate re-evaluation, which only needs labels and inertia.
     """
     n, k = len(Xn), centers.shape[0]
     rows = np.arange(n)
@@ -187,20 +189,26 @@ def host_lloyd_step(rng, Xn, wn, xsq, centers, window):
     if window > 0 and k > 1:
         # the uniform δ-window pick only matters for rows whose runner-up
         # lies inside the window — with small δ that is a handful of rows,
-        # so the full-matrix masking/RNG runs on the ambiguous subset only
-        second = np.partition(d, 1, axis=1)[:, 1]
+        # so the full-matrix masking/RNG runs on the ambiguous subset only.
+        # Runner-up via mask-the-winner + min: one vectorized pass, cheaper
+        # than a partition sort of the whole (n, k) matrix
+        d[rows, labels] = np.inf
+        second = d.min(axis=1)
+        d[rows, labels] = best
         amb = np.flatnonzero(second <= best + window)
         if amb.size:
             sub = d[amb]
             m2 = sub <= best[amb, None] + window
             r = rng.random(sub.shape, dtype=np.float32)
             labels[amb] = np.where(m2, r, -1.0).argmax(axis=1)
+    min_d2 = best + xsq
+    inertia = float(min_d2 @ wn)
+    if e_only:
+        return labels, min_d2, None, None, inertia
     onehot = np.zeros(d.shape, np.float32)
     onehot[rows, labels] = wn
     sums = onehot.T @ Xn                             # (k, m) sgemm
     counts = np.bincount(labels, weights=wn, minlength=k)
-    min_d2 = best + xsq
-    inertia = float(min_d2 @ wn)
     return labels, min_d2, sums, counts, inertia
 
 
