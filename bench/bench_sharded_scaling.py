@@ -1,0 +1,118 @@
+"""Mesh-scaling benchmark: the sharded q-means Lloyd kernel across device
+counts.
+
+The reference's scaling mechanism is OpenMP threads over row chunks with a
+serial partial-centroid reduction (``cluster/_k_means_lloyd.pyx:118-154``);
+this framework's is SPMD over a ``jax.sharding.Mesh`` with ``psum`` centroid
+reductions over ICI (``sq_learn_tpu/parallel/lloyd.py``). This script times
+one full noisy Lloyd run (fixed init, fixed iteration budget) on meshes of
+1, 2, 4, ... up to every visible device, and records each mesh size's
+deviation from the 1-device centers (tiny: the psum reduction only
+reorders float32 sums, and the δ-window picks touch few rows).
+
+On real multi-chip hardware the timings measure ICI scaling. On a single
+host the conftest-style virtual CPU devices share one machine, so no
+speedup is expected — the value is the layout/collective validation, and
+``simulated: true`` is recorded so nobody mistakes the numbers for chip
+scaling.
+
+Emits ONE JSON line: wall-clock at the largest mesh, with the full
+per-mesh-size table in the stderr extras.
+"""
+
+import sys
+import warnings
+
+import numpy as np
+
+warnings.filterwarnings("ignore")
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from bench._common import emit, probe_backend, smoke_mode, timed  # noqa: E402
+
+
+def main():
+    probe_backend()
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from sq_learn_tpu.models.qkmeans import kmeans_plusplus
+    from sq_learn_tpu.parallel.lloyd import lloyd_single_sharded
+
+    devices = jax.devices()
+    if len(devices) == 1 and devices[0].platform == "cpu":
+        # single-CPU fallback: force the virtual-device mesh the tests use
+        import os
+        import subprocess
+
+        if os.environ.get("_SQ_SCALING_CHILD") != "1":
+            env = dict(os.environ, _SQ_SCALING_CHILD="1",
+                       JAX_PLATFORMS="cpu",
+                       XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
+                                  + " --xla_force_host_platform_device_count=8"
+                                  ).strip())
+            env.pop("PYTHONPATH", None)
+            raise SystemExit(subprocess.run(
+                [sys.executable, __file__] + sys.argv[1:], env=env).returncode)
+
+    n = 8192 if smoke_mode() else 65536
+    m, k = 64, 10
+    rng = np.random.default_rng(0)
+    X = np.concatenate([
+        rng.normal(loc=c, scale=1.0, size=(n // k, m))
+        for c in rng.normal(scale=6.0, size=(k, 1, m))
+    ]).astype(np.float32)
+    w = np.ones(len(X), np.float32)
+    xsq = (X * X).sum(axis=1)
+
+    key = jax.random.PRNGKey(0)
+    centers0, _ = kmeans_plusplus(
+        key, jnp.asarray(X), jnp.asarray(xsq), k)
+    centers0 = np.asarray(centers0)
+
+    static = dict(delta=0.5, mode="delta", max_iter=20, tol=0.0,
+                  patience=None, intermediate_error=False,
+                  true_tomography=False)
+
+    sizes = []
+    d = 1
+    while d <= len(jax.devices()):
+        sizes.append(d)
+        d *= 2
+    if sizes[-1] != len(jax.devices()):  # non-power-of-2 device count
+        sizes.append(len(jax.devices()))
+    table = {}
+    ref_centers = None
+    # uploaded once — the timed region measures the sharded Lloyd run, not
+    # per-rep host-to-device transfers
+    Xd, wd = jnp.asarray(X), jnp.asarray(w)
+    c0d, xsqd = jnp.asarray(centers0), jnp.asarray(xsq)
+    for nd in sizes:
+        mesh = Mesh(np.asarray(jax.devices()[:nd]), ("data",))
+
+        def run():
+            out = lloyd_single_sharded(
+                mesh, key, Xd, wd, c0d, xsqd, **static)
+            jax.block_until_ready(out[2])
+            return out
+
+        t, out = timed(run, warmup=1, reps=3 if smoke_mode() else 2)
+        centers = np.asarray(out[2])
+        if ref_centers is None:
+            ref_centers = centers
+        # same key; deviations come only from float32 psum reduction order
+        # and per-shard δ-window streams (fold_in by axis index)
+        max_dev = float(np.max(np.abs(centers - ref_centers)))
+        table[nd] = {"s": round(t, 4), "max_center_dev_vs_1dev": max_dev}
+
+    largest = sizes[-1]
+    simulated = jax.devices()[0].platform == "cpu"
+    emit("qkmeans_sharded_lloyd_scaling_wallclock", table[largest]["s"],
+         vs_baseline=round(table[sizes[0]]["s"] / table[largest]["s"], 3),
+         devices=largest, simulated=simulated, table=table,
+         n=len(X), m=m, k=k)
+
+
+if __name__ == "__main__":
+    main()

@@ -416,6 +416,27 @@ class TestNativeHostPath:
         np.testing.assert_allclose(c1, c2, rtol=1e-6)
         assert i1 == pytest.approx(i2, rel=1e-4)
 
+    def test_host_step_e_only_matches_full(self):
+        from sq_learn_tpu.native import host_lloyd_step
+
+        rng0 = np.random.default_rng(5)
+        Xn = rng0.normal(size=(300, 9)).astype(np.float32)
+        Xn[50:100] = Xn[:50]  # exact ties keep the window pick live
+        wn = rng0.uniform(0.5, 2.0, 300).astype(np.float32)
+        C = Xn[:7].copy()
+        xsq = (Xn**2).sum(axis=1)
+        for window in (0.0, 4.0):
+            lf, mf, sf, cf, inf_ = host_lloyd_step(
+                np.random.default_rng(2), Xn, wn, xsq, C, window)
+            le, me, se, ce, ine = host_lloyd_step(
+                np.random.default_rng(2), Xn, wn, xsq, C, window,
+                e_only=True)
+            np.testing.assert_array_equal(lf, le)
+            np.testing.assert_allclose(mf, me)
+            assert inf_ == pytest.approx(ine)
+            assert se is None and ce is None
+            assert sf is not None and cf is not None
+
     def test_cpp_kernel_window_semantics(self):
         from sq_learn_tpu.native import lloyd_iter_window, native_available
 
