@@ -30,6 +30,7 @@ Reference latent bugs NOT replicated (SURVEY §2.1):
   factor scores from top-k extraction.
 """
 
+import functools
 import math
 import numbers
 import warnings
@@ -37,6 +38,7 @@ import warnings
 import numpy as np
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from .._config import as_device_array, with_device_scope
 from ..base import (BaseEstimator, TransformerMixin, check_is_fitted,
@@ -85,6 +87,109 @@ def singular_value_estimates(key, singular_values, scale_norm, eps_scaled,
         key, theta, float(eps_scaled), float(gamma), window=window
     )
     return jnp.cos(theta_est * enc / 2.0) * scale_norm
+
+
+def estimated_mass(key, S, scale, tau, denom, *, eps_scaled, ae_epsilon,
+                   n_features, below=False):
+    """Theorem-9 core shared by every spectral search: consistent-PE
+    estimates of the spectrum, factor-score mass on one side of τ·scale
+    (selection by the *estimated* values, mass from the true ones),
+    amplitude-estimated at ``ae_epsilon`` (0 = exact). Pure and jit-safe;
+    ``eps_scaled``/``ae_epsilon``/``n_features``/``below`` must be static.
+    """
+    k1, k2 = jax.random.split(key)
+    est = singular_value_estimates(k1, S, scale, eps_scaled, n_features)
+    sel = (est <= tau * scale) if below else (est >= tau * scale)
+    a = jnp.clip(jnp.sum(jnp.where(sel, S**2, 0.0)) / denom, 0.0, 1.0)
+    if ae_epsilon == 0:
+        return a
+    return amplitude_estimation(k2, a, epsilon=ae_epsilon)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("eps_scaled", "ae_epsilon", "n_iterations", "n_features",
+                     "find_min"))
+def bracket_search_fused(key, S, frob, *, eps_scaled, ae_epsilon,
+                         n_iterations, n_features, find_min):
+    """On-device binary search bracketing σ_max (``find_min=False``;
+    reference ``spectral_norm_estimation``, ``_qPCA.py:882-907``) or σ_min
+    (``find_min=True``; the corrected ``condition_number_estimation``
+    bracket — see that method's docstring).
+
+    Each iteration re-estimates the whole spectrum by consistent PE, masses
+    the factor scores on the τ side of the bracket, and amplitude-estimates
+    that mass; zero estimated mass moves the bracket toward the surviving
+    side. The reference (and the previous host loop here) pays 2 dispatches
+    + 2 device→host fetches per iteration — ~40 tunnel round-trips per
+    estimator on an accelerator backend; this runs the entire search as ONE
+    ``lax.fori_loop`` dispatch, splitting the per-iteration keys from the
+    single ``key`` operand.
+    """
+    S = jnp.asarray(S)
+    frob = jnp.asarray(frob, S.dtype)
+
+    def body(_, carry):
+        lo, hi, key = carry
+        tau = (lo + hi) / 2
+        key, sub = jax.random.split(key)
+        eta_est = estimated_mass(
+            sub, S, frob, tau, frob**2, eps_scaled=eps_scaled,
+            ae_epsilon=ae_epsilon, n_features=n_features, below=find_min)
+        zero = eta_est == 0.0
+        if find_min:  # nothing below τ — σ_min is larger
+            lo, hi = jnp.where(zero, tau, lo), jnp.where(zero, hi, tau)
+        else:  # nothing above τ — σ_max is smaller
+            lo, hi = jnp.where(zero, lo, tau), jnp.where(zero, tau, hi)
+        return lo, hi, key
+
+    lo = jnp.zeros((), S.dtype)
+    hi = jnp.ones((), S.dtype)
+    lo, hi, _ = lax.fori_loop(0, n_iterations, body, (lo, hi, key))
+    return (lo + hi) / 2 * frob
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("eps_scaled", "eta", "n_iterations", "n_features"))
+def theta_search_fused(key, S, muA, p, *, eps_scaled, eta, n_iterations,
+                       n_features):
+    """Theorem-10 θ binary search (reference ``estimate_theta``,
+    ``_qPCA.py:1002-1022``) as ONE on-device ``lax.while_loop`` dispatch.
+
+    Each step runs the Theorem-9 factor-score-ratio-sum estimate
+    (consistent-PE spectrum + AE of the mass ≥ τ·μ(A)) and stops early once
+    |p̂ − p| ≤ η/2 — the same convergence rule the host loop applied between
+    round-trips. Returns ``(theta, found)``; the caller owns the
+    didn't-converge error.
+    """
+    S = jnp.asarray(S)
+    total = jnp.sum(S**2)
+    muA = jnp.asarray(muA, S.dtype)
+    p = jnp.asarray(p, S.dtype)
+
+    def cond(carry):
+        i, _, _, _, found, _ = carry
+        return jnp.logical_and(i < n_iterations, jnp.logical_not(found))
+
+    def body(carry):
+        i, lo, hi, tau, _, key = carry
+        key, sub = jax.random.split(key)
+        p_est = estimated_mass(
+            sub, S, muA, tau, total, eps_scaled=eps_scaled,
+            ae_epsilon=eta / 2, n_features=n_features)
+        found = jnp.abs(p_est - p) <= eta / 2
+        lower = p_est < p  # τ too high: too little mass retained
+        lo2 = jnp.where(found, lo, jnp.where(lower, lo, tau))
+        hi2 = jnp.where(found, hi, jnp.where(lower, tau, hi))
+        tau2 = jnp.where(found, tau, (lo2 + hi2) / 2)
+        return i + 1, lo2, hi2, tau2, found, key
+
+    init = (jnp.zeros((), jnp.int32), jnp.zeros((), S.dtype),
+            jnp.ones((), S.dtype), jnp.full((), 0.5, S.dtype),
+            jnp.zeros((), bool), key)
+    _, _, _, tau, found, _ = lax.while_loop(cond, body, init)
+    return tau * muA, found
 
 
 def _assess_dimension(spectrum, rank, n_samples):
@@ -612,21 +717,13 @@ class QPCA(TransformerMixin, BaseEstimator):
         the reference divides by ε and crashes)."""
         if epsilon == 0:
             return self.spectral_norm
-        S = jnp.asarray(self.singular_values_)
         frob = self.frob_norm
-        lo, hi = 0.0, 1.0
         n_iterations = max(1, int(np.ceil(np.log(frob / epsilon))))
-        tau = (lo + hi) / 2
-        for _ in range(n_iterations):
-            est = self._sv_estimates(S, frob, epsilon / frob)
-            mass = jnp.sum(jnp.where(est >= tau * frob, S**2, 0.0)) / frob**2
-            eta_est = self._amplitude_estimate(mass, delta)
-            if eta_est == 0.0:
-                hi = tau
-            else:
-                lo = tau
-            tau = (hi + lo) / 2
-        return tau * frob
+        return float(bracket_search_fused(
+            self._next_key(), jnp.asarray(self.singular_values_), frob,
+            eps_scaled=float(epsilon / frob), ae_epsilon=float(delta),
+            n_iterations=n_iterations, n_features=self.n_features_,
+            find_min=False))
 
     def condition_number_estimation(self, epsilon, delta):
         """Binary search for σ_min, then κ = σ̂_max/σ̂_min.
@@ -648,21 +745,13 @@ class QPCA(TransformerMixin, BaseEstimator):
             sigma_min = float(self.all_singular_values_[-1])
             return sigma_min, (self.spectral_norm / sigma_min
                                if sigma_min > 0 else np.inf)
-        S = jnp.asarray(self.all_singular_values_)
         frob = self.frob_norm
-        lo, hi = 0.0, 1.0
         n_iterations = max(1, int(np.ceil(np.log(frob / epsilon))))
-        tau = (lo + hi) / 2
-        for _ in range(n_iterations):
-            est = self._sv_estimates(S, frob, epsilon / frob)
-            mass = jnp.sum(jnp.where(est <= tau * frob, S**2, 0.0)) / frob**2
-            eta_est = self._amplitude_estimate(mass, delta)
-            if eta_est == 0.0:
-                lo = tau  # nothing below τ — σ_min is larger
-            else:
-                hi = tau
-            tau = (hi + lo) / 2
-        sigma_min = tau * frob
+        sigma_min = float(bracket_search_fused(
+            self._next_key(), jnp.asarray(self.all_singular_values_), frob,
+            eps_scaled=float(epsilon / frob), ae_epsilon=float(delta),
+            n_iterations=n_iterations, n_features=self.n_features_,
+            find_min=True))
         cond = self.spectral_norm / sigma_min if sigma_min > 0 else np.inf
         return sigma_min, cond
 
@@ -681,37 +770,52 @@ class QPCA(TransformerMixin, BaseEstimator):
         if not theta:
             theta = self.est_theta / self.muA  # est_theta is stored unscaled
         S = jnp.asarray(self.singular_values_)
-        est = self._sv_estimates(S, self.muA, eps)
-        # selection by the *estimated* values, mass from the true ones;
-        # θ is in σ/μ(A) units (what estimate_theta's binary search walks),
-        # est in original σ units
-        p_mass = jnp.sum(
-            jnp.where(est >= theta * self.muA, S**2, 0.0)) / jnp.sum(S**2)
-        return self._amplitude_estimate(p_mass, eta)
+        # θ is in σ/μ(A) units (what estimate_theta's binary search walks)
+        return float(estimated_mass(
+            self._next_key(), S, jnp.asarray(self.muA, S.dtype),
+            jnp.asarray(theta, S.dtype), jnp.sum(S**2),
+            eps_scaled=float(eps), ae_epsilon=float(eta),
+            n_features=self.n_features_))
 
     def estimate_theta(self, epsilon, eta, p):
         """Theorem 10 of QADRA (reference ``estimate_theta``,
         ``_qPCA.py:1002-1022``): binary-search the threshold θ whose
-        factor-score-ratio sum matches the target retained variance p."""
+        factor-score-ratio sum matches the target retained variance p.
+
+        The search runs as one on-device kernel
+        (:func:`theta_search_fused`). As in the reference, it raises when
+        no θ is found: the reachable masses are the discrete cumulative
+        steps of the retained spectrum, so a ``p`` farther than ``eta/2``
+        from every step converges only by a lucky estimation draw — widen
+        ``eta`` (or target a mass step) in that case. Note ``fit(p=...)``
+        also *truncates* the retained spectrum to mass ≈ p, which by
+        construction parks the target near a step boundary of the
+        truncated spectrum.
+        """
         self._require_mu()
-        lo, hi = 0.0, 1.0
-        if abs(lo - p) <= eta:
+        if abs(0.0 - p) <= eta:
             return self.muA
-        if abs(hi - p) <= eta:
+        if abs(1.0 - p) <= eta:
             return 0.0
+        if epsilon == 0:
+            # zero error budget: exact classical computation (framework
+            # contract; the reference divides by ε and crashes). The
+            # reachable masses are the cumulative steps of the retained
+            # spectrum; θ = σ at the step closest to p, when within η/2.
+            S = np.asarray(self.singular_values_, np.float64)
+            cum = np.cumsum(S**2) / np.sum(S**2)
+            j = int(np.argmin(np.abs(cum - p)))
+            if abs(cum[j] - p) > eta / 2:
+                raise ValueError("The binary search didn't find any value")
+            return float(S[j])
         n_iterations = max(1, int(np.ceil(np.log(self.muA / epsilon))))
-        tau = (lo + hi) / 2
-        for _ in range(n_iterations):
-            p_est = self.quantum_factor_score_ratio_sum(
-                eps=epsilon / self.muA, theta=tau, eta=eta / 2)
-            if abs(p_est - p) <= eta / 2:
-                return tau * self.muA
-            if p_est < p:
-                hi = tau
-            else:
-                lo = tau
-            tau = (hi + lo) / 2
-        raise ValueError("The binary search didn't find any value")
+        theta, found = theta_search_fused(
+            self._next_key(), jnp.asarray(self.singular_values_), self.muA,
+            float(p), eps_scaled=float(epsilon / self.muA), eta=float(eta),
+            n_iterations=n_iterations, n_features=self.n_features_)
+        if not bool(found):
+            raise ValueError("The binary search didn't find any value")
+        return float(theta)
 
     def _sv_extract(self, delta, eps, theta, true_tomography, norm, *, top):
         """Shared Theorem-11 machinery for top-k / least-k extraction.

@@ -277,10 +277,14 @@ class TestQuantumTransform:
 
 class TestRuntimeModel:
     def test_accumulate_and_compare(self, data, tmp_path):
+        # p targets the top-3 mass step of the retained 5-value spectrum
+        # (≈0.686): the θ search converges from the true masses alone. 0.8
+        # sits between steps (0.686/0.853), where success hinges on a lucky
+        # AE draw — fragile under any RNG-stream change.
         pca = QPCA(n_components=5, random_state=0).fit(
             data, estimate_all=True, theta_estimate=True,
             quantum_retained_variance=True, eps=0.1, eps_theta=0.1,
-            eta=0.1, delta=0.1, p=0.8, true_tomography=False)
+            eta=0.1, delta=0.1, p=0.7, true_tomography=False)
         n, m, q_rt, c_rt = pca.runtime_comparison(
             10_000, 1_000, saveas=str(tmp_path / "rt.png"))
         assert q_rt.shape == (100, 100)
