@@ -698,15 +698,6 @@ class QPCA(TransformerMixin, BaseEstimator):
             self._next_key(), singular_values, scale_norm, eps_scaled,
             self.n_features_)
 
-    def _amplitude_estimate(self, a, epsilon):
-        """AE of a scalar mass, exact when ε = 0 (the reference's AE divides
-        by ε to size its grid, so ε = 0 crashes it — ``Utility.py:484``)."""
-        a = float(jnp.clip(jnp.asarray(a), 0.0, 1.0))
-        if epsilon == 0:
-            return a
-        return float(amplitude_estimation(
-            self._next_key(), a, epsilon=epsilon))
-
     def spectral_norm_estimation(self, epsilon, delta):
         """Binary search for ‖A‖₂ (reference ``spectral_norm_estimation``,
         ``_qPCA.py:882-907``): at threshold τ, estimate all σ/‖A‖_F by

@@ -144,6 +144,47 @@ class TestReassignment:
         assert np.abs(mb.cluster_centers_).max() < 100.0
 
 
+def test_compute_labels_and_init_size():
+    import numpy as np
+    import warnings
+    from sq_learn_tpu.datasets import make_blobs
+    from sq_learn_tpu.models import MiniBatchKMeans, MiniBatchQKMeans
+
+    X, y = make_blobs(n_samples=400, centers=4, n_features=6, random_state=1)
+    X = X.astype(np.float32)
+    # compute_labels=False: centers fitted, labels_/inertia_ left unset
+    # (upstream sklearn contract)
+    mb = MiniBatchKMeans(n_clusters=4, compute_labels=False, max_iter=10,
+                         random_state=0).fit(X)
+    assert mb.cluster_centers_.shape == (4, 6)
+    assert not hasattr(mb, "labels_") and not hasattr(mb, "inertia_")
+    assert mb.predict(X).shape == (400,)  # inference still works
+    # explicit init_size: candidate scoring runs on the subsample and the
+    # fit still recovers the blob structure; init_size below n_clusters
+    # warns and falls back to 3·n_clusters (upstream semantics)
+    import pytest
+    from sklearn.metrics import adjusted_rand_score
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        q = MiniBatchQKMeans(n_clusters=4, n_init=3, init_size=50,
+                             max_iter=20, random_state=0).fit(X)
+    with pytest.warns(RuntimeWarning, match="init_size"):
+        with warnings.catch_warnings():
+            warnings.filterwarnings("ignore", message="Attention!")
+            tiny = MiniBatchQKMeans(n_clusters=4, n_init=2, init_size=1,
+                                    max_iter=10, random_state=0).fit(X)
+    assert adjusted_rand_score(y, q.labels_) > 0.9
+    assert np.isfinite(tiny.inertia_)
+    # partial_fit honors compute_labels the same way fit does
+    pf = MiniBatchQKMeans(n_clusters=4, compute_labels=False,
+                          random_state=0)
+    pf.partial_fit(X[:100])
+    assert not hasattr(pf, "labels_") and not hasattr(pf, "inertia_")
+    pf2 = MiniBatchQKMeans(n_clusters=4, random_state=0)
+    pf2.partial_fit(X[:100])
+    assert pf2.labels_.shape == (100,) and np.isfinite(pf2.inertia_)
+
+
 def test_n_init_auto():
     import numpy as np
     from sq_learn_tpu.datasets import make_blobs
