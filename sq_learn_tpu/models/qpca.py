@@ -89,6 +89,15 @@ def singular_value_estimates(key, singular_values, scale_norm, eps_scaled,
     return jnp.cos(theta_est * enc / 2.0) * scale_norm
 
 
+def _sv_ratio(true_sel, sv_est):
+    """σ_true/σ̂ of a selected spectrum slice — the diagnostic the
+    reference ``plt.show()``s under ``check_sv_uniform_distribution``
+    (``_qPCA.py:1041-1044``, ``:1089-1093``); stored instead — plots have
+    no place inside a fit."""
+    with np.errstate(divide="ignore", invalid="ignore"):
+        return np.asarray(true_sel / np.where(sv_est != 0, sv_est, np.nan))
+
+
 def estimated_mass(key, S, scale, tau, denom, *, eps_scaled, ae_epsilon,
                    n_features, below=False):
     """Theorem-9 core shared by every spectral search: consistent-PE
@@ -339,9 +348,11 @@ class QPCA(TransformerMixin, BaseEstimator):
     @with_device_scope
     def fit(self, X, y=None, *, quantum_retained_variance=False, eps=0,
             theta_major=0, theta_minor=0, eta=0, theta_estimate=False,
-            eps_theta=0, p=0, estimate_all=False, delta=0,
-            true_tomography=True, norm="L2", stop_when_reached_accuracy=False,
-            incremental_measure=False, faster_measure_increment=0,
+            use_computed_qcomponents=False, eps_theta=0, p=0,
+            estimate_all=False, delta=0, true_tomography=True,
+            fs_ratio_estimation=False, norm="L2",
+            stop_when_reached_accuracy=False, incremental_measure=False,
+            faster_measure_increment=0, check_sv_uniform_distribution=False,
             spectral_norm_est=False, condition_number_est=False,
             estimate_least_k=False):
         """Fit the model with X (reference ``qPCA.fit``, ``_qPCA.py:357-481``).
@@ -357,6 +368,18 @@ class QPCA(TransformerMixin, BaseEstimator):
         exposed via :func:`~sq_learn_tpu.ops.quantum.tomography_incremental`
         for experiments, but the fused kernels always compute the
         statistically equivalent final-N estimate (SURVEY §7 hard parts).
+
+        Drop-in-compatibility kwargs with no behavior in the reference
+        either: ``use_computed_qcomponents`` (threaded through the
+        ``_fit``/``_fit_full`` signatures, never stored or consumed —
+        ``_qPCA.py:485-496``) and ``fs_ratio_estimation`` (stored at
+        ``_qPCA.py:500``; its one consumer is commented out,
+        ``_qPCA.py:645-647``) are stored verbatim.
+        ``check_sv_uniform_distribution`` — ``plt.show()`` debug plots of
+        σ_true/σ̂ in the reference (top-k ``_qPCA.py:1041-1044``, least-k
+        ``:1089-1093``) — instead stores the ratio arrays as
+        ``sv_uniform_distribution_`` / ``least_k_sv_uniform_distribution_``
+        after the corresponding extraction.
         """
         if quantum_retained_variance:
             if eps <= 0:
@@ -396,6 +419,15 @@ class QPCA(TransformerMixin, BaseEstimator):
         self.stop_when_reached_accuracy = stop_when_reached_accuracy
         self.incremental_measure = incremental_measure
         self.faster_measure_increment = faster_measure_increment
+        self.use_computed_qcomponents = use_computed_qcomponents
+        self.fs_ratio_estimation = fs_ratio_estimation
+        self.check_sv_uniform_distribution = check_sv_uniform_distribution
+        # a refit with the flag off must not leave the previous fit's
+        # diagnostics behind (checkpoint.py serializes public attributes)
+        for attr in ("sv_uniform_distribution_",
+                     "least_k_sv_uniform_distribution_"):
+            if not check_sv_uniform_distribution and hasattr(self, attr):
+                delattr(self, attr)
 
         X = check_array(X, copy=self.copy)
         self.n_features_in_ = X.shape[1]
@@ -869,6 +901,8 @@ class QPCA(TransformerMixin, BaseEstimator):
         self.topk_right_singular_vectors = right
         self.topk_left_singular_vectors = left
         self.theta = theta
+        if getattr(self, "check_sv_uniform_distribution", False):
+            self.sv_uniform_distribution_ = _sv_ratio(true_sel, sv_est)
         return right_est, left_est, sv_est, fs, fs_ratio
 
     def least_k_sv_extractors(self, delta, eps, theta, true_tomography=True,
@@ -885,6 +919,9 @@ class QPCA(TransformerMixin, BaseEstimator):
         self.least_k_p = p
         self.leastk_right_singular_vectors = right
         self.leastk_left_singular_vectors = left
+        if getattr(self, "check_sv_uniform_distribution", False):
+            self.least_k_sv_uniform_distribution_ = _sv_ratio(true_sel,
+                                                              sv_est)
         return right_est, left_est, sv_est, fs, fs_ratio
 
     # -- transform ------------------------------------------------------------
