@@ -18,17 +18,22 @@ from bench._common import (emit, maybe_subsample, probe_backend,  # noqa: E402
 
 def main():
     probe_backend()
+    import jax
     from sq_learn_tpu.datasets import load_mnist
     from sq_learn_tpu.models import QPCA
 
     X, y, real = load_mnist()
     X, y = maybe_subsample(X, y)
     n_components = 50
+    # MXU-native precision on TPU: bf16 Gram GEMMs, exact m×m eigh (see
+    # QPCA.compute_dtype) — the explained-variance parity below records
+    # the effect; CPU/GPU keep the f32 default
+    compute_dtype = ("bfloat16" if jax.default_backend() == "tpu" else None)
 
     def ours_fit():
         # quantum path: full SVD + gated estimators at a realistic budget
         pca = QPCA(n_components=n_components, svd_solver="full",
-                   random_state=0).fit(
+                   random_state=0, compute_dtype=compute_dtype).fit(
             X, estimate_all=True, eps=0.1, delta=0.1, theta_major=1e-9,
             true_tomography=False)
         return pca
@@ -50,10 +55,13 @@ def main():
     except Exception as exc:
         print(f"# sklearn baseline unavailable: {exc}", file=sys.stderr)
 
+    # the record carries the precision that actually engaged (the
+    # partial-U gate can reject the hint, e.g. subsampled smoke shapes)
+    engaged = getattr(pca, "effective_compute_dtype_", None)
     emit("qpca_mnist_70kx784_c50_fit_wallclock", ours_t,
          vs_baseline=(sk_t / ours_t) if sk_t else 1.0,
          sklearn_s=sk_t, explained_variance_parity=ev_parity,
-         real_mnist=real)
+         real_mnist=real, compute_dtype=engaged or "float32")
 
 
 if __name__ == "__main__":

@@ -422,11 +422,12 @@ class QPCA(TransformerMixin, BaseEstimator):
         self.use_computed_qcomponents = use_computed_qcomponents
         self.fs_ratio_estimation = fs_ratio_estimation
         self.check_sv_uniform_distribution = check_sv_uniform_distribution
-        # a refit with the flag off must not leave the previous fit's
-        # diagnostics behind (checkpoint.py serializes public attributes)
+        # a refit must not leave a previous fit's diagnostics behind
+        # (checkpoint.py serializes public attributes); the extractors
+        # re-set these when they actually run under the flag
         for attr in ("sv_uniform_distribution_",
                      "least_k_sv_uniform_distribution_"):
-            if not check_sv_uniform_distribution and hasattr(self, attr):
+            if hasattr(self, attr):
                 delattr(self, attr)
 
         X = check_array(X, copy=self.copy)
@@ -480,10 +481,14 @@ class QPCA(TransformerMixin, BaseEstimator):
 
         # the reduced-precision hint engages only the partial-U Gram
         # route; every other route must say so rather than silently run
-        # full precision (a decorative flag is worse than none)
-        if self.compute_dtype is not None and not (
-                solver == "full"
-                and self._partial_u_route(n_components, *X.shape)):
+        # full precision (a decorative flag is worse than none).
+        # effective_compute_dtype_ records what actually engaged, so
+        # measurement records can label numbers with the true precision.
+        engaged = (self.compute_dtype is not None and solver == "full"
+                   and self._partial_u_route(n_components, *X.shape))
+        self.effective_compute_dtype_ = (
+            check_compute_dtype(self.compute_dtype) if engaged else None)
+        if self.compute_dtype is not None and not engaged:
             warnings.warn(
                 "compute_dtype engages only the partial-U Gram route "
                 "(svd_solver='full', integral n_components, aspect ratio "

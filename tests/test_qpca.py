@@ -275,6 +275,47 @@ class TestQuantumTransform:
         assert rel < 0.1
 
 
+class TestCompatFitKwargs:
+    """The reference's stored-only / debug fit kwargs (``_qPCA.py:357-362``)
+    are accepted; the plt.show() diagnostic becomes stored ratio arrays
+    (documented intent, not the reference's selected-slice/full-array
+    shape bug at ``_qPCA.py:1042``)."""
+
+    def test_sv_uniform_distribution_stored_per_side(self, data):
+        pca = QPCA(random_state=0).fit(
+            data, estimate_all=True, estimate_least_k=True, eps=0.05,
+            delta=0.05, theta_major=1e-6, theta_minor=3.0,
+            true_tomography=False, check_sv_uniform_distribution=True,
+            use_computed_qcomponents=True, fs_ratio_estimation=True)
+        # stored no-op flags round-trip verbatim
+        assert pca.use_computed_qcomponents is True
+        assert pca.fs_ratio_estimation is True
+        # per-side ratios align with each selected slice (the reference
+        # divides the slice by the full array and would crash)
+        assert pca.sv_uniform_distribution_.shape == (pca.topk,)
+        assert pca.least_k_sv_uniform_distribution_.shape == (pca.least_k,)
+        # direction: sigma_true / sigma_hat, so near-exact estimates ≈ 1
+        assert np.all(np.abs(pca.sv_uniform_distribution_ - 1.0) < 0.5)
+
+    def test_sv_uniform_distribution_cleared_on_refit(self, data):
+        pca = QPCA(random_state=0).fit(
+            data, estimate_all=True, eps=0.05, delta=0.05,
+            theta_major=1e-6, true_tomography=False,
+            check_sv_uniform_distribution=True)
+        assert hasattr(pca, "sv_uniform_distribution_")
+        # refit whose extractor never runs must drop the stale diagnostic
+        # even with the flag still on
+        pca.fit(data, check_sv_uniform_distribution=True)
+        assert not hasattr(pca, "sv_uniform_distribution_")
+        assert not hasattr(pca, "least_k_sv_uniform_distribution_")
+
+    def test_zero_sigma_ratio_is_nan(self):
+        from sq_learn_tpu.models.qpca import _sv_ratio
+
+        out = _sv_ratio(np.array([1.0, 2.0]), np.array([0.0, 2.0]))
+        assert np.isnan(out[0]) and out[1] == 1.0
+
+
 class TestRuntimeModel:
     def test_accumulate_and_compare(self, data, tmp_path):
         # p targets the top-3 mass step of the retained 5-value spectrum
