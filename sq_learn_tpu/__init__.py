@@ -42,8 +42,10 @@ from .models import (  # noqa: E402
     TruncatedSVD,
 )
 from .pipeline import Pipeline, make_pipeline  # noqa: E402
+from .utils import show_versions  # noqa: E402
 
 __all__ = [
+    "show_versions",
     "config_context",
     "default_dtype",
     "get_config",

@@ -2,6 +2,7 @@
 
 from .checkpoint import load_estimator, load_pytree, save_estimator, save_pytree
 from .keys import as_key, key_iter, split
+from ._show_versions import show_versions
 from .validation import (
     check_array,
     check_random_state,
@@ -21,4 +22,5 @@ __all__ = [
     "load_estimator",
     "save_pytree",
     "load_pytree",
+    "show_versions",
 ]

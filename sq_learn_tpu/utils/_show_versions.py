@@ -1,0 +1,63 @@
+"""Debug-information printer (reference ``utils/_show_versions.py:76``).
+
+The reference prints platform, Python dependency versions, and its OpenMP
+build flag; the TPU-native equivalents of the last section are the JAX
+backend and device inventory — the facts a bug report here needs.
+"""
+
+import platform
+import sys
+
+
+def _get_sys_info():
+    return {
+        "python": sys.version.replace("\n", " "),
+        "executable": sys.executable,
+        "machine": platform.platform(),
+    }
+
+
+def _get_deps_info():
+    deps = ["numpy", "scipy", "jax", "jaxlib", "flax", "optax", "sklearn"]
+    info = {}
+    for modname in deps:
+        try:
+            mod = __import__(modname)
+            info[modname] = getattr(mod, "__version__", "installed")
+        except ImportError:
+            info[modname] = None
+    return info
+
+
+def _get_backend_info():
+    """Backend facts without touching a possibly-wedged accelerator: only
+    report devices when a backend is already initialized; otherwise report
+    the configured platform string."""
+    import jax
+
+    info = {"configured platforms": str(jax.config.jax_platforms)}
+    try:
+        # devices() on an initialized runtime is cheap; on a cold process
+        # it would trigger (and possibly hang) backend discovery, so only
+        # report what is already known
+        if jax._src.xla_bridge._backends:  # initialized backends only
+            devs = jax.devices()
+            info["default backend"] = jax.default_backend()
+            info["devices"] = ", ".join(str(d) for d in devs)
+    except Exception as exc:  # pragma: no cover - defensive
+        info["devices"] = f"unavailable ({type(exc).__name__})"
+    return info
+
+
+def show_versions():
+    """Print useful debugging information (reference
+    ``utils/_show_versions.py:76``)."""
+    print("\nSystem:")
+    for k, stat in _get_sys_info().items():
+        print(f"{k:>12}: {stat}")
+    print("\nPython dependencies:")
+    for k, stat in _get_deps_info().items():
+        print(f"{k:>13}: {stat}")
+    print("\nJAX backend:")
+    for k, stat in _get_backend_info().items():
+        print(f"{k:>20}: {stat}")

@@ -167,3 +167,12 @@ def test_width_mismatch_covers_score_and_inverse(data):
     for call in (km.score, mb.score, sc.inverse_transform, svc.get_betas):
         with pytest.raises(ValueError, match="features"):
             call(bad)
+
+
+def test_show_versions(capsys):
+    import sq_learn_tpu as sq
+
+    sq.show_versions()
+    out = capsys.readouterr().out
+    assert "Python dependencies" in out and "jax" in out
+    assert "JAX backend" in out
