@@ -97,8 +97,9 @@ def confusion_matrix(y_true, y_pred):
 
 
 def f1_score(y_true, y_pred, average="binary", pos_label=1):
-    """F1 = 2·P·R/(P+R); ``average`` ∈ {'binary', 'macro', 'micro'}.
-    Binary mode scores ``pos_label`` (sklearn semantics)."""
+    """F1 = 2·P·R/(P+R); ``average`` ∈ {'binary', 'macro', 'micro',
+    'weighted'}. Binary mode scores ``pos_label``; 'weighted' weights the
+    per-class F1 by true-class support (sklearn semantics)."""
     classes, inv = np.unique(
         np.concatenate([np.asarray(y_true).ravel(),
                         np.asarray(y_pred).ravel()]), return_inverse=True)
@@ -120,6 +121,12 @@ def f1_score(y_true, y_pred, average="binary", pos_label=1):
         f1 = np.where(p + r > 0, 2 * p * r / (p + r), 0.0)
     if average == "macro":
         return float(f1.mean())
+    if average == "weighted":
+        support = C.sum(axis=1)
+        total = support.sum()
+        if total == 0:
+            return 0.0
+        return float((f1 * support).sum() / total)
     if average == "binary":
         where = np.flatnonzero(classes == pos_label)
         if len(where) == 0:

@@ -120,7 +120,7 @@ class TestExtendedScores:
         np.testing.assert_array_equal(confusion_matrix(self.yt, self.yp),
                                       sk_cm(self.yt, self.yp))
 
-    @pytest.mark.parametrize("average", ["macro", "micro"])
+    @pytest.mark.parametrize("average", ["macro", "micro", "weighted"])
     def test_f1_matches_sklearn(self, average):
         from sklearn.metrics import f1_score as sk_f1
 
