@@ -12,6 +12,7 @@ is device-parallel — ``n_jobs`` is accepted for API compatibility and
 ignored, which is the honest TPU answer (one accelerator, XLA owns it).
 """
 
+import warnings
 import numbers
 import time
 
@@ -58,14 +59,39 @@ class StratifiedKFold(KFold):
         y = np.asarray(y)
         n = len(y)
         rng = check_random_state(self.random_state)
-        # assign each class's members round-robin to folds (shuffled within
-        # class when requested) — preserves per-fold class balance
+        # upstream's allocation (model_selection/_split.py
+        # ``_make_test_folds``): classes are encoded by FIRST APPEARANCE
+        # (not lexicographic order), and interleaving the SORTED encoded
+        # ids over the folds staggers each class's remainder, so per-fold
+        # class counts differ by ≤1 AND total fold sizes differ by ≤1 — a
+        # per-class round-robin would stack every class's remainder on the
+        # low fold numbers
+        _, y_idx, y_inv = np.unique(y, return_index=True,
+                                    return_inverse=True)
+        _, class_perm = np.unique(y_idx, return_inverse=True)
+        y_enc = class_perm[y_inv]
+        n_classes = len(y_idx)
+        y_counts = np.bincount(y_enc)
+        if np.all(self.n_splits > y_counts):
+            raise ValueError(
+                f"n_splits={self.n_splits} cannot be greater than the "
+                "number of members in each class.")
+        if self.n_splits > y_counts.min():
+            warnings.warn(
+                f"The least populated class in y has only "
+                f"{int(y_counts.min())} members, which is less than "
+                f"n_splits={self.n_splits}.", UserWarning)
+        y_order = np.sort(y_enc)
+        allocation = np.asarray(
+            [np.bincount(y_order[i::self.n_splits], minlength=n_classes)
+             for i in range(self.n_splits)])
         fold_of = np.empty(n, dtype=int)
-        for cls in np.unique(y):
-            idx = np.flatnonzero(y == cls)
+        for c in range(n_classes):
+            idx = np.flatnonzero(y_enc == c)
             if self.shuffle:
                 rng.shuffle(idx)
-            fold_of[idx] = np.arange(len(idx)) % self.n_splits
+            fold_of[idx] = np.repeat(np.arange(self.n_splits),
+                                     allocation[:, c])
         indices = np.arange(n)
         for f in range(self.n_splits):
             test = indices[fold_of == f]

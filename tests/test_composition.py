@@ -46,6 +46,43 @@ class TestSplitters:
             assert np.sum(y[test] == 0) == 8
             assert np.sum(y[test] == 1) == 2
 
+    def test_stratified_kfold_equal_fold_totals(self):
+        # upstream's sorted-interleave allocation staggers per-class
+        # remainders so TOTAL fold sizes also differ by at most 1 (a
+        # per-class round-robin stacks remainders on the low folds)
+        rng = np.random.default_rng(3)
+        y = rng.integers(0, 3, 121)  # several classes with remainders
+        X = np.zeros((121, 2))
+        folds = list(StratifiedKFold(4).split(X, y))
+        sizes = [len(test) for _, test in folds]
+        assert max(sizes) - min(sizes) <= 1, sizes
+        # each class's members spread as evenly as possible: per-class
+        # fold counts differ by at most 1 across folds
+        counts = np.array([np.bincount(y[test], minlength=3)
+                           for _, test in folds])
+        assert np.all(counts.max(axis=0) - counts.min(axis=0) <= 1), counts
+
+    def test_stratified_kfold_matches_sklearn_splits(self):
+        # first-appearance class encoding + sorted-interleave allocation
+        # reproduce sklearn's splits index-for-index (shuffle=False)
+        from sklearn.model_selection import StratifiedKFold as SKSplit
+
+        rng = np.random.default_rng(1)
+        y = rng.choice([7, 2, 9], size=80)  # non-sorted first appearance
+        X = np.zeros((80, 2))
+        for (_, te1), (_, te2) in zip(StratifiedKFold(4).split(X, y),
+                                      SKSplit(4).split(X, y)):
+            np.testing.assert_array_equal(np.sort(te1), np.sort(te2))
+
+    def test_stratified_kfold_guards(self):
+        # every class smaller than n_splits: error (upstream semantics);
+        # least-populated class below n_splits: warning
+        with pytest.raises(ValueError, match="number of members"):
+            list(StratifiedKFold(3).split(np.zeros((4, 1)), [0, 0, 1, 1]))
+        with pytest.warns(UserWarning, match="least populated"):
+            list(StratifiedKFold(3).split(np.zeros((10, 1)),
+                                          [0] * 8 + [1] * 2))
+
     def test_train_test_split_stratified(self):
         X = np.arange(100).reshape(-1, 1)
         y = np.array([0] * 80 + [1] * 20)
