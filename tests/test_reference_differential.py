@@ -160,6 +160,55 @@ def test_consistent_phase_estimation_agreement(ref, key):
     assert abs(min(our_outs) - min(ref_outs)) <= eps
 
 
+def test_matrix_gaussian_tomography_flattening(ref, key):
+    # the reference flattens a matrix before the Gaussian path
+    # (Utility.py:159-166), so the truncnorm bound uses d = n·m, not the
+    # row width — pinned on both sides
+    from sq_learn_tpu.ops.quantum import tomography
+
+    rng = np.random.default_rng(4)
+    A = rng.normal(size=(12, 30))
+    noise = 0.2
+    ref_err = ref.tomography(A.copy(), noise, true_tomography=False) - A
+    our_err = np.asarray(tomography(key, A, noise,
+                                    true_tomography=False)) - A
+    bound = noise / np.sqrt(A.size) + 1e-9
+    assert np.all(np.abs(ref_err) <= bound)
+    assert np.all(np.abs(our_err) <= bound)
+    assert np.std(our_err) == pytest.approx(np.std(ref_err), rel=0.35)
+
+
+def test_amplitude_estimation_median_boost(ref, key):
+    from sq_learn_tpu.ops.quantum import amplitude_estimation
+
+    a, eps, gamma, n = 0.25, 0.1, 0.05, 400
+    ref_draws = np.array([ref.amplitude_estimation(a, epsilon=eps,
+                                                   gamma=gamma)
+                          for _ in range(n)])
+    ours = np.asarray(amplitude_estimation(key, np.full(n, a), epsilon=eps,
+                                           gamma=gamma))
+    # median boosting tightens both to within eps almost surely
+    assert np.mean(np.abs(ref_draws - a) <= eps) > 0.97
+    assert np.mean(np.abs(ours - a) <= eps) > 0.97
+    assert np.mean(ours) == pytest.approx(np.mean(ref_draws), abs=eps / 2)
+
+
+def test_quantum_state_measure_distribution(ref, key):
+    from sq_learn_tpu.ops.quantum import QuantumState
+
+    amps = np.array([0.5, -0.5, 0.5, 0.5])
+    regs = np.arange(4)
+    ref_counts = np.bincount(
+        ref.QuantumState(registers=regs, amplitudes=amps).measure(8000),
+        minlength=4)
+    ours = QuantumState(registers=regs, amplitudes=amps)
+    our_draws = np.asarray(ours.measure(key, 8000))
+    our_counts = np.bincount(our_draws, minlength=4)
+    # both sample registers w.p. amplitude² = 1/4 each
+    for c in (ref_counts, our_counts):
+        assert np.all(np.abs(c / 8000 - 0.25) < 0.03), c
+
+
 def test_ipe_distribution(ref, key):
     import jax
 
