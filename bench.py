@@ -76,11 +76,14 @@ def main():
     except Exception as exc:  # sklearn missing: report absolute time only
         print(f"# sklearn baseline unavailable: {exc}", file=sys.stderr)
 
+    import jax
+
     result = {
         "metric": "qkmeans_digits_1797x64_k10_fit_wallclock",
         "value": round(ours, 4),
         "unit": "s",
         "vs_baseline": round(sk_time / ours, 3) if sk_time else 1.0,
+        "backend": jax.default_backend(),
     }
     if ari is not None:
         result["ari_vs_sklearn_median3"] = round(ari, 3)
