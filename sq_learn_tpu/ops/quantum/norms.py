@@ -15,54 +15,128 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
+
+# row-block height of the tiled sweep: blocks of ~2^18 elements keep the
+# whole multiplication chain (|A| tile, log tile, base, running power)
+# cache/VMEM-resident, so the matrix streams from HBM/DRAM exactly once
+_TILE_ELEMS = 1 << 18
 
 
-@functools.partial(jax.jit, static_argnums=1)
-def _mu_grid(A, grid):
-    """Evaluate μ_p for every p in the (static) grid in one fused sweep.
-
-    Two structural savings over the naive 2·|grid| powered passes:
-    s_q(Aᵀ) = max_j Σ_i |a_ij|^q is the column reduction of the SAME powered
-    matrix whose row reduction is s_q(A), so each exponent q powers the
-    matrix once and serves both factors; and |a|^q is computed as
-    exp(q·ln|a|) from one hoisted log — vectorized exp instead of |grid|
-    scalar pow passes (a ~10× wall-clock difference on large hosts).
-    """
-    A = jnp.asarray(A)
-    absA = jnp.abs(A)
-    nz = absA > 0
-    logA = jnp.log(jnp.where(nz, absA, 1.0))
-
-    # the exponents needed across the grid: 2p for the row factor and
-    # 2(1−p) for the column factor draw from the same set
+def _grid_exponents(grid):
+    """The exponent set a μ grid needs — 2p for the row factor and 2(1−p)
+    for the column factor draw from the same set — plus the uniform-step
+    flag that enables the multiplication chain."""
     qs = sorted({round(2 * p, 12) for p in grid}
                 | {round(2 * (1 - p), 12) for p in grid})
-    row_s, col_s = {}, {}
-
-    def record(q, P):
-        row_s[q] = jnp.max(jnp.sum(P, axis=1))
-        col_s[q] = jnp.max(jnp.sum(P, axis=0))
-
-    if 0 in qs:
-        record(0, nz.astype(A.dtype))  # reference Utility.py:198-203
     qpos = [q for q in qs if q > 0]
     steps = {round(b - a, 12) for a, b in zip(qpos, qpos[1:])}
-    if qpos and (not steps or steps == {round(qpos[0], 12)}):
-        # uniformly-spaced exponents (every standard grid): the powered
-        # matrices form a multiplication chain |A|^{i·d} = (|A|^d)^i — ONE
-        # exp pass, then an elementwise multiply per grid point
-        base = jnp.where(nz, jnp.exp(qpos[0] * logA), 0.0)
+    uniform = bool(qpos) and (not steps or steps == {round(qpos[0], 12)})
+    return qs, qpos, uniform
+
+
+def _power_sweep(tile, qs, qpos, uniform):
+    """Per-tile reductions of |tile|^q for every exponent q.
+
+    Returns ``(row_max, cols)`` stacked over qs: row_max (|qs|,) —
+    max_i Σ_j |a_ij|^q (rows are never split across tiles, so the within-
+    tile max is exact); cols (|qs|, m) — Σ_i |a_ij|^q column partials.
+    With a uniformly-spaced exponent set the powered matrices form a
+    multiplication chain |A|^{i·d} = (|A|^d)^i — ONE exp pass, then an
+    elementwise multiply per grid point; |a|^q comes from exp(q·ln|a|) on
+    one hoisted log (vectorized exp instead of |grid| scalar pow passes).
+    """
+    absT = jnp.abs(tile)
+    nz = absT > 0
+    logT = jnp.log(jnp.where(nz, absT, 1.0))
+    row_max, cols = {}, {}
+
+    def record(q, P):
+        row_max[q] = jnp.max(jnp.sum(P, axis=1))
+        cols[q] = jnp.sum(P, axis=0)
+
+    if 0 in qs:
+        record(0, nz.astype(tile.dtype))  # reference Utility.py:198-203
+    if uniform:
+        base = jnp.where(nz, jnp.exp(qpos[0] * logT), 0.0)
         P = base
         for q in qpos:
             record(q, P)
             P = P * base
     else:
         for q in qpos:
-            record(q, jnp.where(nz, jnp.exp(q * logA), 0.0))
+            record(q, jnp.where(nz, jnp.exp(q * logT), 0.0))
+    return (jnp.stack([row_max[q] for q in qs]),
+            jnp.stack([cols[q] for q in qs]))
 
-    vals = [jnp.sqrt(row_s[round(2 * p, 12)] * col_s[round(2 * (1 - p), 12)])
+
+@functools.partial(jax.jit, static_argnums=1)
+def _mu_grid_unblocked(A, grid):
+    """One fused elementwise sweep — the variant for traced (in-jit) and
+    mesh-sharded operands, whose reductions XLA turns into the right
+    collectives (the blocked reshape would all-gather a sharded matrix)."""
+    qs, qpos, uniform = _grid_exponents(grid)
+    row_max, cols = _power_sweep(jnp.asarray(A), qs, qpos, uniform)
+    return _combine(grid, qs, row_max, jnp.max(cols, axis=1))
+
+
+@functools.partial(jax.jit, static_argnums=1)
+def _mu_grid_blocked(A, grid):
+    """Row-tiled sweep for large unsharded operands.
+
+    The reference walks the matrix 21 times (``Utility.py:196-219``); the
+    naive vectorized version still materializes every powered matrix —
+    ~2·|grid| full HBM/DRAM passes. Here the row axis is tiled
+    (``_TILE_ELEMS``-sized blocks) and each block runs the whole power
+    chain in cache/VMEM via ``lax.map``, so A streams from memory once:
+    per-tile row maxima are exact (rows are never split) and column
+    power-sums accumulate across tiles.
+    """
+    A = jnp.asarray(A)
+    n, m = A.shape
+    qs, qpos, uniform = _grid_exponents(grid)
+    block = max(1, _TILE_ELEMS // max(m, 1))
+    nb = -(-n // block)
+    # zero padding rows: they contribute 0 to column sums and their row
+    # sums are 0, never the max (power sums are non-negative)
+    Ap = jnp.pad(A, ((0, nb * block - n), (0, 0)))
+    tiles = Ap.reshape(nb, block, m)
+    rows_t, cols_t = lax.map(
+        lambda t: _power_sweep(t, qs, qpos, uniform), tiles)
+    # rows_t (nb, |qs|) → per-q global max; cols_t (nb, |qs|, m) → per-q
+    # column totals, then max
+    return _combine(grid, qs, jnp.max(rows_t, axis=0),
+                    jnp.max(jnp.sum(cols_t, axis=0), axis=1))
+
+
+def _combine(grid, qs, row_max, col_max):
+    """μ_p = √(s_{2p}(A)·s_{2(1−p)}(Aᵀ)) from the stacked per-q factors."""
+    idx = {q: i for i, q in enumerate(qs)}
+    vals = [jnp.sqrt(row_max[idx[round(2 * p, 12)]]
+                     * col_max[idx[round(2 * (1 - p), 12)]])
             for p in grid]
     return jnp.stack(vals)
+
+
+def _mu_grid(A, grid):
+    """Evaluate μ_p for every p in the (static) grid.
+
+    Dispatches between the row-tiled single-pass sweep (large concrete
+    unsharded matrices — the host/CPU and single-chip case) and the
+    unblocked fused sweep (traced operands inside an enclosing jit, small
+    matrices, and mesh-sharded operands, where the tiled reshape would
+    force all-gathers)."""
+    if isinstance(A, jax.core.Tracer):
+        return _mu_grid_unblocked(A, grid)
+    A = jnp.asarray(A)
+    n, m = A.shape
+    sh = getattr(A, "sharding", None)
+    sharded = (sh is not None and len(getattr(sh, "device_set", ())) > 1
+               and not sh.is_fully_replicated)
+    block = max(1, _TILE_ELEMS // max(m, 1))
+    if sharded or n <= 2 * block:
+        return _mu_grid_unblocked(A, grid)
+    return _mu_grid_blocked(A, grid)
 
 
 def mu(A, p):
