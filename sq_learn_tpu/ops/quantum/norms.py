@@ -82,7 +82,7 @@ def _mu_grid_unblocked(A, grid):
 
 @functools.partial(jax.jit, static_argnums=1)
 def _mu_grid_blocked(A, grid):
-    """Row-tiled sweep for large unsharded operands.
+    """Row-tiled sweep for large CPU-resident operands.
 
     The reference walks the matrix 21 times (``Utility.py:196-219``); the
     naive vectorized version still materializes every powered matrix —
@@ -122,10 +122,11 @@ def _mu_grid(A, grid):
     """Evaluate μ_p for every p in the (static) grid.
 
     Dispatches between the row-tiled single-pass sweep (large concrete
-    unsharded matrices — the host/CPU and single-chip case) and the
-    unblocked fused sweep (traced operands inside an enclosing jit, small
-    matrices, and mesh-sharded operands, where the tiled reshape would
-    force all-gathers)."""
+    CPU-resident matrices, where the cache hierarchy limits the repeated
+    passes) and the unblocked fused sweep (traced operands inside an
+    enclosing jit, small matrices, accelerator-resident operands — which
+    stream the fused sweep at HBM bandwidth — and mesh-sharded operands,
+    where the tiled reshape would force all-gathers)."""
     if isinstance(A, jax.core.Tracer):
         return _mu_grid_unblocked(A, grid)
     A = jnp.asarray(A)
@@ -133,8 +134,14 @@ def _mu_grid(A, grid):
     sh = getattr(A, "sharding", None)
     sharded = (sh is not None and len(getattr(sh, "device_set", ())) > 1
                and not sh.is_fully_replicated)
+    try:
+        on_cpu = all(d.platform == "cpu" for d in A.devices())
+    except Exception:  # committed-elsewhere edge: fall back to fused sweep
+        on_cpu = False
     block = max(1, _TILE_ELEMS // max(m, 1))
-    if sharded or n <= 2 * block:
+    if sharded or not on_cpu or n <= 2 * block:
+        # accelerators stream the fused sweep at HBM bandwidth — the tiled
+        # lax.map only pays off where the cache hierarchy is the limit
         return _mu_grid_unblocked(A, grid)
     return _mu_grid_blocked(A, grid)
 
