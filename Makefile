@@ -4,7 +4,7 @@
 
 PYTHON ?= python
 
-.PHONY: test test-fast lint bench bench-smoke multichip examples all
+.PHONY: test test-fast lint bench bench-smoke bench-suite multichip examples all
 
 all: lint test
 
@@ -46,3 +46,7 @@ examples:
 multichip:
 	$(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8); \
 	    print('dryrun_multichip(8) ok')"
+
+# Full BASELINE suite (headline + configs #2-#5) into one record file.
+bench-suite:
+	bash bench/run_suite.sh
