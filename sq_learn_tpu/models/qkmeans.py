@@ -76,14 +76,20 @@ def tolerance(X, tol):
     return float(tol * np.mean(np.var(np.asarray(X), axis=0)))
 
 
-@functools.partial(jax.jit, static_argnames=("quantum", "mu_grid"))
-def fit_prestats(X, *, quantum=False, mu_grid=()):
+@functools.partial(jax.jit,
+                   static_argnames=("quantum", "mu_grid", "mu_blocked"))
+def fit_prestats(X, *, quantum=False, mu_grid=(), mu_blocked=False):
     """Every pre-fit statistic in ONE dispatch — on a tunneled accelerator
     each separate launch pays a host↔device round-trip, so the mean /
     centering / centered row norms / tol variance scale, and (δ>0 only) the
     quantum runtime-model parameters — η = max‖xᵢ‖² , the μ_p(A) grid and
     Frobenius norm (reference ``Utility.py:215-231``), σ_min (reference
-    ``_dmeans.py:1242-1245``) — are fused into a single jit."""
+    ``_dmeans.py:1242-1245``) — are fused into a single jit.
+
+    ``mu_blocked`` selects the row-tiled μ sweep; X is a tracer here, so
+    the caller owns the choice (True on the CPU backend, where the cache
+    hierarchy limits the unblocked sweep's repeated passes; False on
+    accelerators/meshes)."""
     mean = jnp.mean(X, axis=0)
     Xc = X - mean
     out = {
@@ -93,10 +99,11 @@ def fit_prestats(X, *, quantum=False, mu_grid=()):
         "var_mean": jnp.mean(jnp.var(X, axis=0)),
     }
     if quantum:
-        from ..ops.quantum.norms import _mu_grid
+        from ..ops.quantum.norms import _mu_grid_blocked, _mu_grid_unblocked
 
         out["eta"] = jnp.max(row_norms(X, squared=True))
-        out["mu_vals"] = _mu_grid(X, mu_grid)
+        sweep = _mu_grid_blocked if mu_blocked else _mu_grid_unblocked
+        out["mu_vals"] = sweep(X, mu_grid)
         out["frob"] = jnp.linalg.norm(X)
         out["sigma_min"] = smallest_singular_value(X)
     return out
@@ -829,8 +836,14 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         # set_config(device=...) placement — except under an explicit mesh,
         # whose sharding owns placement (committed single-device operands
         # would conflict with the mesh's device set)
+        from ..ops.quantum.norms import blocked_worthwhile
+
         Xin = jnp.asarray(X) if self.mesh is not None else as_device_array(X)
-        stats = fit_prestats(Xin, quantum=quantum, mu_grid=mu_grid)
+        stats = fit_prestats(
+            Xin, quantum=quantum, mu_grid=mu_grid,
+            mu_blocked=(quantum and self.mesh is None
+                        and self._on_cpu_backend()
+                        and blocked_worthwhile(*X.shape)))
         if quantum:
             # fetch every host-needed scalar (incl. the μ grid) in ONE
             # device→host transfer

@@ -118,6 +118,14 @@ def _combine(grid, qs, row_max, col_max):
     return jnp.stack(vals)
 
 
+def blocked_worthwhile(n, m):
+    """True when an (n, m) matrix is large enough for the row-tiled sweep
+    to pay off — shared by :func:`_mu_grid`'s dispatch and callers that
+    must choose statically (e.g. a jitted prestats kernel whose operand is
+    a tracer)."""
+    return n > 2 * max(1, _TILE_ELEMS // max(m, 1))
+
+
 def _mu_grid(A, grid):
     """Evaluate μ_p for every p in the (static) grid.
 
@@ -138,8 +146,7 @@ def _mu_grid(A, grid):
         on_cpu = all(d.platform == "cpu" for d in A.devices())
     except Exception:  # committed-elsewhere edge: fall back to fused sweep
         on_cpu = False
-    block = max(1, _TILE_ELEMS // max(m, 1))
-    if sharded or not on_cpu or n <= 2 * block:
+    if sharded or not on_cpu or not blocked_worthwhile(n, m):
         # accelerators stream the fused sweep at HBM bandwidth — the tiled
         # lax.map only pays off where the cache hierarchy is the limit
         return _mu_grid_unblocked(A, grid)
