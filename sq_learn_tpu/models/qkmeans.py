@@ -467,26 +467,20 @@ def _relocate_empty_np(Xn, wn, labels, min_d2, sums, counts):
     return sums, counts
 
 
-def _native_lloyd_run(rng, Xn, wn, xsq, centers0, *, window, max_iter, tol,
-                      patience, use_cpp):
-    """One full q-means run on the host — the twin of :func:`lloyd_single`
-    with identical stopping semantics (shift ≤ tol, best-inertia plateau),
-    empty-cluster relocation, and history traces. The E+M step is either
-    the threaded C++ kernel (:func:`sq_learn_tpu.native.lloyd_iter_window`,
-    the reference's Cython-kernel role, ``cluster/_k_means_lloyd.pyx:29``)
-    on many-core hosts, or a BLAS sgemm step where few cores make BLAS the
-    faster engine."""
-    from .. import native
+def _native_run_loop(step, Xn, wn, centers0, *, max_iter, tol, patience,
+                     final_step, on_update=None):
+    """The shared host-runner scaffolding — the twin of
+    :func:`lloyd_single`'s loop with identical stopping semantics
+    (shift ≤ tol, best-inertia plateau), empty-cluster relocation, history
+    traces, and the final two-candidate E-only re-evaluation. One
+    definition keeps every host engine's semantics in lock-step with the
+    XLA path.
 
-    def step(centers, e_only=False):
-        if use_cpp:
-            # the C++ kernel is fused; its M half is not separable
-            seed = int(rng.integers(0, 2**63 - 1))
-            return native.lloyd_iter_window(
-                Xn, centers, sample_weight=wn, window=window, seed=seed)
-        return native.host_lloyd_step(rng, Xn, wn, xsq, centers, window,
-                                      e_only=e_only)
-
+    ``step(centers)`` is the engine's fused E(+M) step returning
+    ``(labels, min_d2, sums, counts, inertia)``; ``final_step(centers)``
+    is an exact E-only evaluation returning ``(labels, inertia)``;
+    ``on_update(old_centers, new_centers, labels)`` runs after each center
+    move (the Elkan bound update hook)."""
     centers = np.ascontiguousarray(centers0, np.float32)
     best_inertia, best_centers, best_it = np.inf, centers, 0
     inertia_tr = np.full(max_iter, np.nan, np.float32)
@@ -503,6 +497,8 @@ def _native_lloyd_run(rng, Xn, wn, xsq, centers0, *, window, max_iter, tol,
             best_inertia, best_centers, best_it = inertia, centers, it
         shift = float(((new_centers - centers) ** 2).sum())
         inertia_tr[it], shift_tr[it] = inertia, shift
+        if on_update is not None:
+            on_update(centers, new_centers, labels)
         centers = new_centers
         it += 1
         if shift <= tol:
@@ -513,11 +509,104 @@ def _native_lloyd_run(rng, Xn, wn, xsq, centers0, *, window, max_iter, tol,
     # E-only: the re-evaluation needs labels and inertia, not M partials
     outs = []
     for cand in (centers, best_centers):
-        labels, _, _, _, inertia = step(cand, e_only=True)
+        labels, inertia = final_step(cand)
         outs.append((labels, inertia, cand))
     labels, inertia, out_centers = min(outs, key=lambda t: t[1])
     history = {"inertia": inertia_tr, "center_shift": shift_tr}
     return labels, np.float32(inertia), out_centers, it, history
+
+
+def _native_lloyd_run(rng, Xn, wn, xsq, centers0, *, window, max_iter, tol,
+                      patience, use_cpp):
+    """One full q-means run on the host (:func:`_native_run_loop` over the
+    Lloyd engines). The E+M step is either the threaded C++ kernel
+    (:func:`sq_learn_tpu.native.lloyd_iter_window`, the reference's
+    Cython-kernel role, ``cluster/_k_means_lloyd.pyx:29``) on many-core
+    hosts, or a BLAS sgemm step where few cores make BLAS the faster
+    engine."""
+    from .. import native
+
+    def step(centers):
+        if use_cpp:
+            # the C++ kernel is fused; its M half is not separable
+            seed = int(rng.integers(0, 2**63 - 1))
+            return native.lloyd_iter_window(
+                Xn, centers, sample_weight=wn, window=window, seed=seed)
+        return native.host_lloyd_step(rng, Xn, wn, xsq, centers, window)
+
+    def final_step(centers):
+        labels, _, _, _, inertia = (
+            native.lloyd_iter_window(Xn, centers, sample_weight=wn,
+                                     window=window,
+                                     seed=int(rng.integers(0, 2**63 - 1)))
+            if use_cpp else
+            native.host_lloyd_step(rng, Xn, wn, xsq, centers, window,
+                                   e_only=True))
+        return labels, inertia
+
+    return _native_run_loop(step, Xn, wn, centers0, max_iter=max_iter,
+                            tol=tol, patience=patience,
+                            final_step=final_step)
+
+
+def _native_elkan_run(rng, Xn, wn, xsq, centers0, *, max_iter, tol,
+                      patience):
+    """Elkan twin of :func:`_native_lloyd_run`: the classical run with the
+    triangle-inequality-pruned E-step (reference
+    ``cluster/_k_means_elkan.pyx:184`` ``elkan_iter_chunked_dense``; bounds
+    seeding ``init_bounds_dense:33``). Identical stopping semantics
+    (shift ≤ tol, best-inertia plateau), relocation, and history traces as
+    the Lloyd runners — sklearn's elkan≡lloyd equivalence contract
+    (reference ``cluster/tests/test_k_means.py:140``) is pinned by tests.
+
+    The per-point upper/lower bounds live here, across iterations; the
+    center-shift bound update (u += p(a), l −= p(c), Elkan 2003 step 5-6,
+    as in ``_k_means_elkan.pyx:329-342``) runs vectorized on the host. The
+    E-step keeps ``upper`` exact each iteration (one extra m-dot per pruned
+    point), so per-iteration inertia is exact — the reference only computes
+    inertia after the loop."""
+    from .. import native
+
+    n, k = Xn.shape[0], centers0.shape[0]
+    state = {"labels": np.zeros(n, np.int32),
+             "upper": np.zeros(n, np.float32),
+             "lower": np.zeros((n, k), np.float32),
+             "first": True}
+
+    def step(centers):
+        # center-center geometry in float64: the Gram-trick cancellation in
+        # float32 can OVER-estimate near-zero separations by orders of
+        # magnitude, and an inflated s/c_half breaks Elkan's bound-safety
+        # invariant (a pruned center may genuinely be closer)
+        C = centers.astype(np.float64)
+        csq = (C**2).sum(axis=1)
+        cc = np.sqrt(np.maximum(
+            csq[:, None] + csq[None, :] - 2.0 * (C @ C.T), 0.0))
+        c_half = 0.5 * cc
+        np.fill_diagonal(cc, np.inf)
+        s = 0.5 * cc.min(axis=1)
+        out = native.elkan_iter(
+            Xn, centers, c_half, s, state["labels"], state["upper"],
+            state["lower"], sample_weight=wn, init=state["first"])
+        state["first"] = False
+        return (state["labels"],) + out
+
+    def on_update(centers, new_centers, labels):
+        # the bounds survive the center move (incl. relocation jumps):
+        # u grows by the assigned center's travel, l shrinks by each
+        # center's travel (Elkan 2003 steps 5-6)
+        p = np.sqrt(((new_centers - centers) ** 2).sum(axis=1))
+        state["upper"] += p[labels]
+        state["lower"] = np.maximum(state["lower"] - p[None, :], 0.0)
+
+    def final_step(centers):
+        labels_c, _, _, _, inertia_c = native.host_lloyd_step(
+            rng, Xn, wn, xsq, centers, 0.0, e_only=True)
+        return labels_c, inertia_c
+
+    return _native_run_loop(step, Xn, wn, centers0, max_iter=max_iter,
+                            tol=tol, patience=patience,
+                            final_step=final_step, on_update=on_update)
 
 
 # jit'd entry for a full single run — static over everything that changes
@@ -737,12 +826,8 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
             raise ValueError(
                 f"Algorithm must be 'auto', 'full', 'lloyd' or 'elkan', got "
                 f"{self.algorithm} instead.")
-        if self.algorithm == "elkan":
-            # triangle-inequality pruning is data-dependent branching — XLA-
-            # hostile; documented non-goal (SURVEY §2.2). Lloyd is used.
-            warnings.warn(
-                "algorithm='elkan' is not TPU-native; using the fused Lloyd "
-                "kernel instead.", RuntimeWarning)
+        # algorithm='elkan' is resolved per-fit by _use_elkan (it depends on
+        # the backend and the error mode)
         if not (isinstance(self.init, str) and self.init in ("k-means++", "random")
                 or hasattr(self.init, "__array__") or callable(self.init)):
             raise ValueError(
@@ -753,6 +838,49 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         if delta == 0:
             return "classic"
         return "ipe" if self.true_distance_estimate else "delta"
+
+    def _use_elkan(self, mode):
+        """Resolve ``algorithm='elkan'`` to an engine decision, warning
+        whenever the pruned path cannot honor the request. The Elkan engine
+        (reference ``cluster/_k_means_elkan.pyx``) lives in the native host
+        runtime: triangle-inequality pruning is data-dependent branching —
+        XLA computes masked lanes anyway, so on accelerators the fused
+        Lloyd GEMM is the faster program and pruning only pays on the
+        host. Note pruned scalar dots often still lose to a saturated
+        BLAS sgemm Lloyd step (the reason upstream sklearn reverted its
+        dense default to lloyd in 1.1); 'elkan' is an explicit opt-in for
+        parity, never the 'auto' resolution."""
+        if self.algorithm != "elkan":
+            return False
+        if mode != "classic":
+            warnings.warn(
+                "algorithm='elkan' applies to the classical (delta=0) path "
+                "only: the δ-window/IPE error models need the full distance "
+                "row per sample, which defeats triangle-inequality pruning "
+                "(the reference's Elkan path is classical-only too, "
+                "_dmeans.py:404). Using the Lloyd kernel.", RuntimeWarning)
+            return False
+        if not self._on_cpu_backend():
+            warnings.warn(
+                "algorithm='elkan' prunes with data-dependent branching — "
+                "XLA-hostile (SURVEY §2.2) — so accelerator backends use "
+                "the fused Lloyd kernel; the pruned Elkan engine runs on "
+                "the CPU host path.", RuntimeWarning)
+            return False
+        if self.mesh is not None or callable(self.init):
+            warnings.warn(
+                "algorithm='elkan' runs on the single-host native path; "
+                "with a mesh or a callable init the Lloyd kernel is used.",
+                RuntimeWarning)
+            return False
+        if self.use_pallas != "auto" and self.use_pallas:
+            # two explicit kernel requests conflict; the algorithm choice
+            # wins but never silently
+            warnings.warn(
+                "use_pallas is ignored with algorithm='elkan': the Elkan "
+                "engine is a host kernel (the pallas kernel implements the "
+                "fused Lloyd step).", RuntimeWarning)
+        return True
 
     def _resolved_n_init(self, init):
         """The restart count every consumer (fit paths AND cost models)
@@ -817,6 +945,11 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
                 "model — an unmodeled O(eps·‖x‖‖c‖) error on top of δ/2.",
                 RuntimeWarning)
 
+        # algorithm='elkan' resolution (one decision + warning per fit);
+        # True only on classical CPU fits, which never take the fused
+        # accelerator path below
+        elkan = self._use_elkan(self._mode(delta))
+
         # accelerator fast path: the whole fit (prestats + restarts +
         # packing) as ONE dispatch and ONE fetch — see fit_fused. Falls
         # through to the staged path when the kernel is unavailable.
@@ -867,7 +1000,7 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
 
         mode = self._mode(delta)
         results = self._run_lloyd(key, Xc, xsq, sample_weight, init, n_init,
-                                  delta, mode, tol_)
+                                  delta, mode, tol_, elkan=elkan)
         best_labels, best_inertia, best_centers, best_n_iter, history = results
 
         centers = np.asarray(best_centers) + np.asarray(stats["mean"])
@@ -1043,7 +1176,7 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         return use_pallas, use_pallas and not pallas_available()
 
     def _run_lloyd(self, key, Xc, xsq, sample_weight, init, n_init, delta,
-                   mode, tol_):
+                   mode, tol_, elkan=False):
         """n_init restarts of the single-run kernel; keep the best inertia."""
         use_pallas, interpret = self._resolve_pallas()
         static = dict(delta=delta, mode=mode, max_iter=self.max_iter, tol=tol_,
@@ -1060,24 +1193,32 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         # per-dispatch overhead on small hosts. Routed only when no kernel
         # was forced (use_pallas='auto'), no mesh, and the error model is
         # expressible (classic/δ-means without intermediate tomography).
-        if (self._on_cpu_backend()
-                and self.use_pallas == "auto" and self.mesh is None
-                and mode in ("classic", "delta")
-                and not self.intermediate_error
-                and (isinstance(init, str) or hasattr(init, "__array__"))):
+        native_ok = (self._on_cpu_backend() and self.mesh is None
+                     and mode in ("classic", "delta")
+                     and not self.intermediate_error
+                     and (isinstance(init, str)
+                          or hasattr(init, "__array__")))
+        if elkan or (native_ok and self.use_pallas == "auto"):
             import os
 
-            # the scalar C++ kernel scales with cores; single-threaded BLAS
-            # sgemm wins on small hosts — and needs no toolchain, so the
-            # (potentially slow) .so build is only attempted when the C++
-            # kernel would actually run
-            use_cpp = (os.cpu_count() or 1) >= 8
-            if use_cpp:
-                from ..native import native_available
+            if elkan:
+                # _use_elkan vetted the preconditions; the numpy fallback
+                # inside native.elkan_iter covers hosts without a toolchain
+                # (unpruned, identical results)
+                engine = "elkan"
+            else:
+                # the scalar C++ kernel scales with cores; single-threaded
+                # BLAS sgemm wins on small hosts — and needs no toolchain,
+                # so the (potentially slow) .so build is only attempted
+                # when the C++ kernel would actually run
+                use_cpp = (os.cpu_count() or 1) >= 8
+                if use_cpp:
+                    from ..native import native_available
 
-                use_cpp = native_available()
+                    use_cpp = native_available()
+                engine = "cpp" if use_cpp else "blas"
             return self._run_native(key, Xd, w, init, n_init, delta, mode,
-                                    tol_, use_cpp)
+                                    tol_, engine)
 
         # fast path: all restarts batched into one vmapped kernel (string
         # inits only; under vmap the pallas kernel's grid gains a restart
@@ -1119,8 +1260,10 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         return self._restart_loop(key, run, Xd, w, xsq, init, n_init)
 
     def _run_native(self, key, Xd, w, init, n_init, delta, mode, tol_,
-                    use_cpp):
-        """Host-side restart loop over the native/BLAS kernels."""
+                    engine):
+        """Host-side restart loop over the native engines: ``'cpp'`` (the
+        threaded fused Lloyd kernel), ``'blas'`` (sgemm Lloyd step), or
+        ``'elkan'`` (triangle-inequality-pruned classical runs)."""
         Xn = np.ascontiguousarray(np.asarray(Xd), np.float32)
         wn = np.ascontiguousarray(np.asarray(w), np.float32)
         xsqn = (Xn**2).sum(axis=1)
@@ -1147,10 +1290,17 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
                     idx = rinit.choice(Xn.shape[0], self.n_clusters,
                                        replace=False, p=wn / wn.sum())
                     centers0 = Xn[idx]
-            labels, inertia, centers, n_iter, history = _native_lloyd_run(
-                rng, Xn, wn, xsqn, centers0, window=window,
-                max_iter=self.max_iter, tol=tol_, patience=patience,
-                use_cpp=use_cpp)
+            if engine == "elkan":
+                labels, inertia, centers, n_iter, history = \
+                    _native_elkan_run(
+                        rng, Xn, wn, xsqn, centers0, max_iter=self.max_iter,
+                        tol=tol_, patience=patience)
+            else:
+                labels, inertia, centers, n_iter, history = \
+                    _native_lloyd_run(
+                        rng, Xn, wn, xsqn, centers0, window=window,
+                        max_iter=self.max_iter, tol=tol_, patience=patience,
+                        use_cpp=(engine == "cpp"))
             if self.verbose:
                 trace = history["inertia"][:n_iter]
                 for i, v in enumerate(trace):

@@ -80,6 +80,16 @@ def _load():
             ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_float),
             ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
             ctypes.POINTER(ctypes.c_double), ctypes.c_int]
+        lib.elkan_iter.restype = ctypes.c_int
+        lib.elkan_iter.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int, ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_double), ctypes.c_int]
         lib.murmurhash3_x86_32.restype = ctypes.c_uint32
         lib.murmurhash3_x86_32.argtypes = [
             ctypes.c_char_p, ctypes.c_int, ctypes.c_uint32]
@@ -260,6 +270,87 @@ def lloyd_iter_window(X, centers, sample_weight=None, window=0.0, seed=0,
     x_sq = (X**2).sum(axis=1)
     return host_lloyd_step(np.random.default_rng(seed), X, w, x_sq, centers,
                            float(window))
+
+
+def elkan_iter(X, centers, c_half, s, labels, upper, lower,
+               sample_weight=None, init=False, n_threads=0):
+    """One Elkan E-step (triangle-inequality-pruned classical assignment;
+    the reference ships it as ``cluster/_k_means_elkan.pyx:184``).
+
+    ``labels`` (n,) int32, ``upper`` (n,) float32 and ``lower`` (n, k)
+    float32 are the persistent bounds state, updated IN PLACE; ``c_half``
+    (k, k) and ``s`` (k,) are the caller-computed half center-center
+    distances. ``init=True`` seeds the bounds with a full distance pass.
+
+    Returns ``(min_d2 float32 (n,), sums float64 (k, m), counts float64
+    (k,), inertia float)`` with the same output contract as
+    :func:`lloyd_iter_window` at window=0; ``upper`` is exact on exit.
+    The NumPy fallback is the unpruned equivalent: a full distance pass
+    that re-seeds the bounds exactly (identical results, no pruning win).
+    """
+    X = np.ascontiguousarray(X, dtype=np.float32)
+    centers = np.ascontiguousarray(centers, dtype=np.float32)
+    n, m = X.shape
+    k = centers.shape[0]
+    # the in-place contract forbids coercion copies of the state arrays, so
+    # a wrong dtype/layout must fail loudly, not reinterpret the buffer
+    for name, arr, dtype, shape in (("labels", labels, np.int32, (n,)),
+                                    ("upper", upper, np.float32, (n,)),
+                                    ("lower", lower, np.float32, (n, k))):
+        if (arr.dtype != dtype or arr.shape != shape
+                or not arr.flags["C_CONTIGUOUS"]):
+            raise ValueError(
+                f"{name} must be a C-contiguous {np.dtype(dtype).name} "
+                f"array of shape {shape} (updated in place), got "
+                f"{arr.dtype} {arr.shape}")
+    if sample_weight is not None:
+        sample_weight = np.ascontiguousarray(sample_weight, dtype=np.float32)
+
+    lib = _load()
+    if lib is not None:
+        c_half = np.ascontiguousarray(c_half, dtype=np.float32)
+        s = np.ascontiguousarray(s, dtype=np.float32)
+        min_d2 = np.empty(n, np.float32)
+        sums = np.empty((k, m), np.float64)
+        counts = np.empty(k, np.float64)
+        inertia = ctypes.c_double()
+        w_ptr = (sample_weight.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+                 if sample_weight is not None
+                 else ctypes.cast(None, ctypes.POINTER(ctypes.c_float)))
+        rc = lib.elkan_iter(
+            X.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), w_ptr,
+            centers.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            c_half.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            s.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            n, m, k,
+            labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            upper.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            lower.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            int(bool(init)),
+            min_d2.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            sums.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            counts.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            ctypes.byref(inertia), int(n_threads))
+        if rc == 0:
+            return min_d2, sums, counts, float(inertia.value)
+
+    # NumPy fallback: full (unpruned) pass, bounds re-seeded exactly
+    w = (np.ones(n, np.float32) if sample_weight is None else sample_weight)
+    x_sq = (X**2).sum(axis=1)
+    c_sq = (centers**2).sum(axis=1)
+    d = np.sqrt(np.maximum(
+        x_sq[:, None] + c_sq[None, :] - 2.0 * (X @ centers.T), 0.0))
+    labels[:] = d.argmin(axis=1).astype(np.int32)
+    rows = np.arange(n)
+    upper[:] = d[rows, labels]
+    lower[:] = d
+    min_d2 = (upper.astype(np.float64)**2).astype(np.float32)
+    onehot = np.zeros((n, k), np.float32)
+    onehot[rows, labels] = w
+    sums = (onehot.T @ X).astype(np.float64)
+    counts = np.bincount(labels, weights=w, minlength=k).astype(np.float64)
+    inertia = float((upper.astype(np.float64)**2) @ w)
+    return min_d2, sums, counts, inertia
 
 
 # ---------------------------------------------------------------------------
@@ -487,5 +578,5 @@ def _stream_batches(path, batch_rows, delimiter, skip_header, n_cols):
             yield _parse_lines(lines, delimiter, n_cols)
 
 
-__all__ = ["native_available", "lloyd_iter", "murmurhash3_32",
+__all__ = ["native_available", "lloyd_iter", "elkan_iter", "murmurhash3_32",
            "murmurhash3_bulk", "csv_read_floats", "csv_stream_batches"]

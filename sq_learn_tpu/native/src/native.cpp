@@ -239,6 +239,143 @@ int lloyd_iter_window(const float* X, const float* sample_weight,
 }
 
 // ---------------------------------------------------------------------------
+// Elkan iteration (triangle-inequality-pruned classical E-step)
+// ---------------------------------------------------------------------------
+
+static inline double sq_dist(const float* x, const float* c, int64_t m) {
+  double s = 0.0;
+  for (int64_t f = 0; f < m; ++f) {
+    double d = (double)x[f] - c[f];
+    s += d * d;
+  }
+  return s;
+}
+
+// One Elkan E-step (Elkan 2003; the reference ships it as
+// cluster/_k_means_elkan.pyx `elkan_iter_chunked_dense:184`). Works in plain
+// (not squared) distance space. Persistent per-point state owned by the
+// caller across iterations:
+//   labels (n) int32, upper (n) float32 — upper bound on d(x, c_label),
+//   lower (n, k) float32 — lower bounds on d(x, c_j).
+// Caller-computed per-iteration center geometry:
+//   c_half (k, k) = 0.5 * d(c_a, c_j); s (k) = 0.5 * min_{j!=a} d(c_a, c_j).
+// With init != 0 all n*k distances are computed to seed the bounds (the
+// role of `init_bounds_dense:33`). On exit `upper` is the EXACT assigned
+// distance for every point (one extra m-dot for pruned points — ~1/k of the
+// work saved — which keeps bounds tight and yields exact per-iteration
+// inertia, unlike the reference, which only computes inertia after the
+// loop). Outputs match lloyd_iter_window: weighted partial sums/counts,
+// exact min_d2 (squared), weighted inertia.
+int elkan_iter(const float* X, const float* sample_weight,
+               const float* centers, const float* c_half, const float* s,
+               int64_t n, int64_t m, int64_t k, int32_t* labels, float* upper,
+               float* lower, int init, float* out_min_d2, double* out_sums,
+               double* out_counts, double* out_inertia, int n_threads) {
+  if (n <= 0 || m <= 0 || k <= 0) return -1;
+  if (n_threads <= 0) {
+    n_threads = (int)std::thread::hardware_concurrency();
+    if (n_threads <= 0) n_threads = 1;
+  }
+  if ((int64_t)n_threads > n) n_threads = (int)n;
+  {
+    const int64_t nch = (n + 255) / 256;
+    if ((int64_t)n_threads > nch) n_threads = (int)nch;
+  }
+
+  const int64_t chunk = 256;
+  std::atomic<int64_t> next_chunk{0};
+  const int64_t n_chunks = (n + chunk - 1) / chunk;
+
+  std::vector<std::vector<double>> t_sums((size_t)n_threads,
+                                          std::vector<double>(k * m, 0.0));
+  std::vector<std::vector<double>> t_counts((size_t)n_threads,
+                                            std::vector<double>(k, 0.0));
+  std::vector<double> t_inertia((size_t)n_threads, 0.0);
+
+  auto worker = [&](int tid) {
+    std::vector<double>& sums = t_sums[tid];
+    std::vector<double>& counts = t_counts[tid];
+    double inertia = 0.0;
+    for (;;) {
+      int64_t c0 = next_chunk.fetch_add(1);
+      if (c0 >= n_chunks) break;
+      int64_t lo = c0 * chunk, hi = std::min(n, lo + chunk);
+      for (int64_t i = lo; i < hi; ++i) {
+        const float* x = X + i * m;
+        float* lb = lower + i * k;
+        int32_t a;
+        float u;
+        if (init) {
+          double best = 1e300;
+          a = 0;
+          for (int64_t j = 0; j < k; ++j) {
+            double d = std::sqrt(sq_dist(x, centers + j * m, m));
+            lb[j] = (float)d;
+            if (d < best) { best = d; a = (int32_t)j; }
+          }
+          u = (float)best;
+        } else {
+          a = labels[i];
+          u = upper[i];
+          if (u > s[a]) {
+            // u is inflated by the last center shift; tighten lazily on
+            // the first center that survives the bound tests
+            bool tight = false;
+            for (int64_t j = 0; j < k; ++j) {
+              if ((int32_t)j == a) continue;
+              if (u > lb[j] && u > c_half[(int64_t)a * k + j]) {
+                if (!tight) {
+                  u = (float)std::sqrt(sq_dist(x, centers + (int64_t)a * m, m));
+                  lb[a] = u;
+                  tight = true;
+                  if (!(u > lb[j] && u > c_half[(int64_t)a * k + j])) continue;
+                }
+                float d = (float)std::sqrt(sq_dist(x, centers + j * m, m));
+                lb[j] = d;
+                if (d < u) { u = d; a = (int32_t)j; }
+              }
+            }
+            if (!tight) {
+              // every candidate was pruned by the bounds alone; one exact
+              // dot keeps `upper` tight for the next iteration
+              u = (float)std::sqrt(sq_dist(x, centers + (int64_t)a * m, m));
+              lb[a] = u;
+            }
+          } else {
+            u = (float)std::sqrt(sq_dist(x, centers + (int64_t)a * m, m));
+            lb[a] = u;
+          }
+        }
+        labels[i] = a;
+        upper[i] = u;
+        double md2 = (double)u * u;
+        if (out_min_d2) out_min_d2[i] = (float)md2;
+        double w = sample_weight ? (double)sample_weight[i] : 1.0;
+        for (int64_t f = 0; f < m; ++f) sums[(int64_t)a * m + f] += w * x[f];
+        counts[a] += w;
+        inertia += w * md2;
+      }
+    }
+    t_inertia[tid] = inertia;
+  };
+
+  std::vector<std::thread> threads;
+  for (int t = 0; t < n_threads; ++t) threads.emplace_back(worker, t);
+  for (auto& th : threads) th.join();
+
+  std::memset(out_sums, 0, sizeof(double) * k * m);
+  std::memset(out_counts, 0, sizeof(double) * k);
+  double inertia = 0.0;
+  for (int t = 0; t < n_threads; ++t) {
+    for (int64_t e = 0; e < k * m; ++e) out_sums[e] += t_sums[t][e];
+    for (int64_t j = 0; j < k; ++j) out_counts[j] += t_counts[t][j];
+    inertia += t_inertia[t];
+  }
+  *out_inertia = inertia;
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
 // MurmurHash3 x86 32-bit (public domain algorithm, Austin Appleby)
 // ---------------------------------------------------------------------------
 

@@ -759,3 +759,137 @@ class TestModernSklearnCompat:
                      true_distance_estimate=False, random_state=0).fit(X)
         q, c = qm.quantum_runtime_model(np.array([1e4]), np.array([64.0]))
         assert np.isfinite(q).all() and np.isfinite(c).all()
+
+
+class TestElkan:
+    """algorithm='elkan' — the pruned native engine (reference
+    ``cluster/_k_means_elkan.pyx:184``) must reproduce Lloyd exactly:
+    sklearn's elkan≡lloyd equivalence contract (reference
+    ``cluster/tests/test_k_means.py:140``)."""
+
+    def test_elkan_equals_lloyd_fit(self, blobs):
+        X, _ = blobs
+        init = X[:4].copy()
+        lloyd = KMeans(n_clusters=4, init=init, n_init=1, max_iter=100,
+                       random_state=0).fit(X)
+        with warnings.catch_warnings():
+            # on the CPU backend the elkan request is honored — any
+            # fallback RuntimeWarning is a routing bug
+            warnings.simplefilter("error")
+            elk = KMeans(n_clusters=4, init=init, n_init=1, max_iter=100,
+                         random_state=0, algorithm="elkan").fit(X)
+        assert float(adjusted_rand_score(elk.labels_, lloyd.labels_)) == \
+            pytest.approx(1.0)
+        np.testing.assert_allclose(elk.inertia_, lloyd.inertia_, rtol=1e-4)
+        np.testing.assert_allclose(
+            np.sort(elk.cluster_centers_, 0),
+            np.sort(lloyd.cluster_centers_, 0), rtol=1e-3, atol=1e-3)
+
+    def test_elkan_matches_sklearn_elkan(self, digits):
+        X, _ = digits
+        init = X[:10].copy()
+        ours = KMeans(n_clusters=10, init=init, n_init=1, max_iter=100,
+                      random_state=0, algorithm="elkan").fit(X)
+        ref = sklearn.cluster.KMeans(n_clusters=10, init=init, n_init=1,
+                                     max_iter=100,
+                                     algorithm="elkan").fit(X)
+        assert float(adjusted_rand_score(ours.labels_, ref.labels_)) == \
+            pytest.approx(1.0)
+        np.testing.assert_allclose(ours.inertia_, ref.inertia_, rtol=1e-4)
+
+    def test_elkan_delta_warns_and_falls_back_to_lloyd(self, blobs):
+        X, _ = blobs
+        init = X[:4].copy()
+        kw = dict(n_clusters=4, init=init, n_init=1, delta=0.5,
+                  true_distance_estimate=False, random_state=0)
+        with pytest.warns(RuntimeWarning, match="classical"):
+            elk = QKMeans(algorithm="elkan", **kw).fit(X)
+        lloyd = QKMeans(**kw).fit(X)
+        # identical routing + identical rng derivation → identical draws
+        np.testing.assert_array_equal(elk.labels_, lloyd.labels_)
+        assert elk.inertia_ == pytest.approx(lloyd.inertia_)
+
+    def test_elkan_relocation_degenerate_init(self):
+        """The adversarial all-centers-on-one-point init: relocation must
+        work inside the Elkan loop too (bounds stay valid across the
+        relocation jump via the center-shift update)."""
+        rng = np.random.RandomState(3)
+        X = np.vstack([rng.randn(60, 2) + c for c in
+                       ((0, 0), (12, 0), (0, 12), (12, 12))]).astype(
+                           np.float32)
+        init = np.vstack([X[0]] * 4).astype(np.float32)
+        init += rng.normal(scale=1e-5, size=init.shape).astype(np.float32)
+        ours = KMeans(n_clusters=4, init=init, n_init=1, max_iter=100,
+                      random_state=0, algorithm="elkan").fit(X)
+        ref = sklearn.cluster.KMeans(n_clusters=4, init=init, n_init=1,
+                                     max_iter=100,
+                                     algorithm="elkan").fit(X)
+        np.testing.assert_allclose(ours.inertia_, ref.inertia_, rtol=0.05)
+        assert len(np.unique(ours.labels_)) == 4
+
+    @staticmethod
+    def _geom(C):
+        # float64, as the runner computes it: the float32 Gram trick can
+        # over-estimate near-zero center separations, breaking the
+        # bound-safety invariant
+        C = C.astype(np.float64)
+        csq = (C**2).sum(axis=1)
+        cc = np.sqrt(np.maximum(
+            csq[:, None] + csq[None, :] - 2.0 * (C @ C.T), 0.0))
+        c_half = 0.5 * cc
+        np.fill_diagonal(cc, np.inf)
+        return c_half.astype(np.float32), (0.5 * cc.min(axis=1)).astype(
+            np.float32)
+
+    @staticmethod
+    def _full_argmin(Xn, C):
+        d = ((Xn[:, None, :].astype(np.float64)
+              - C[None, :, :].astype(np.float64))**2).sum(-1)
+        return d.argmin(1).astype(np.int32), d.min(1)
+
+    def test_elkan_iter_kernel_two_steps(self):
+        """Unit test of the kernel itself: the seeding pass must equal a
+        full argmin, and a second pruned pass — after a center move and
+        the Elkan bound update — must equal a fresh full argmin, with
+        ``upper`` exact on exit."""
+        from sq_learn_tpu import native
+
+        rng = np.random.default_rng(0)
+        n, k = 400, 5
+        Xn = rng.normal(size=(n, 7)).astype(np.float32)
+        wn = rng.uniform(0.5, 2.0, n).astype(np.float32)
+        C = np.ascontiguousarray(Xn[:k], np.float32)
+        labels = np.zeros(n, np.int32)
+        upper = np.zeros(n, np.float32)
+        lower = np.zeros((n, k), np.float32)
+
+        c_half, s = self._geom(C)
+        min_d2, sums, counts, inertia = native.elkan_iter(
+            Xn, C, c_half, s, labels, upper, lower, sample_weight=wn,
+            init=True)
+        ref_lab, ref_d2 = self._full_argmin(Xn, C)
+        np.testing.assert_array_equal(labels, ref_lab)
+        np.testing.assert_allclose(min_d2, ref_d2, rtol=1e-3, atol=1e-4)
+        assert inertia == pytest.approx(float(ref_d2 @ wn), rel=1e-4)
+
+        # move the centers, apply the bound update, run the pruned pass
+        C2 = (C + rng.normal(scale=0.5, size=C.shape)).astype(np.float32)
+        p = np.sqrt(((C2 - C)**2).sum(axis=1)).astype(np.float32)
+        upper += p[labels]
+        lower = np.maximum(lower - p[None, :], 0.0)
+        c_half, s = self._geom(C2)
+        min_d2b, sums_b, counts_b, inertia_b = native.elkan_iter(
+            Xn, C2, c_half, s, labels, upper, lower, sample_weight=wn,
+            init=False)
+        ref_lab2, ref_d2b = self._full_argmin(Xn, C2)
+        np.testing.assert_array_equal(labels, ref_lab2)
+        np.testing.assert_allclose(min_d2b, ref_d2b, rtol=1e-3, atol=1e-4)
+        # upper is the exact assigned distance on exit
+        np.testing.assert_allclose(
+            upper.astype(np.float64)**2, min_d2b, rtol=1e-3, atol=1e-4)
+        # M partials follow the assignment
+        onehot = np.zeros((n, k), np.float64)
+        onehot[np.arange(n), labels] = wn
+        np.testing.assert_allclose(sums_b, onehot.T @ Xn, rtol=1e-4,
+                                   atol=1e-4)
+        np.testing.assert_allclose(counts_b, onehot.sum(axis=0), rtol=1e-6)
