@@ -597,7 +597,11 @@ def _native_elkan_run(rng, Xn, wn, xsq, centers0, *, max_iter, tol,
         # center's travel (Elkan 2003 steps 5-6)
         p = np.sqrt(((new_centers - centers) ** 2).sum(axis=1))
         state["upper"] += p[labels]
-        state["lower"] = np.maximum(state["lower"] - p[None, :], 0.0)
+        # in place: the (n, k) bounds matrix is the algorithm's largest
+        # object, and sklearn's Elkan likewise updates bounds in place
+        lower = state["lower"]
+        np.subtract(lower, p[None, :], out=lower)
+        np.maximum(lower, 0.0, out=lower)
 
     def final_step(centers):
         labels_c, _, _, _, inertia_c = native.host_lloyd_step(
