@@ -549,6 +549,110 @@ def _native_lloyd_run(rng, Xn, wn, xsq, centers0, *, window, max_iter, tol,
                             final_step=final_step)
 
 
+def _native_lloyd_run_batched(rng, Xn, wn, xsq, centers_stack, *, window,
+                              max_iter, tol, patience):
+    """All restarts in LOCKSTEP on the BLAS host engine — the host twin of
+    :func:`lloyd_restarts`' vmapped batching. On few-core hosts the serial
+    restart loop is numpy-call-overhead-bound (each tiny E+M step pays
+    ~15 numpy dispatches); stacking the active restarts' centers into one
+    (n, R·k) sgemm amortizes that overhead across restarts. Semantics are
+    the serial runner's, per restart: δ-window pick, relocation,
+    best-inertia tracking, shift≤tol and patience stopping, history
+    traces, and the final best-of-(last, best) re-evaluation.
+
+    ``centers_stack`` is (R, k, m). Returns ``(winner, per_restart)``:
+    ``winner`` is the usual ``(labels, inertia, centers, n_iter,
+    history)`` of the globally best restart; ``per_restart`` is a list of
+    ``(final_inertia, n_iter, history)`` in restart order (verbose
+    reporting)."""
+    from .. import native
+
+    R, k, m = centers_stack.shape
+    n = Xn.shape[0]
+    rows = np.arange(n)
+    C = np.ascontiguousarray(centers_stack, np.float32).copy()
+    active = np.ones(R, bool)
+    best_inertia = np.full(R, np.inf)
+    best_centers = C.copy()
+    best_it = np.zeros(R, np.int64)
+    it_count = np.zeros(R, np.int64)
+    inertia_tr = np.full((R, max_iter), np.nan, np.float32)
+    shift_tr = np.full((R, max_iter), np.nan, np.float32)
+    it = 0
+    while it < max_iter and active.any():
+        act = np.flatnonzero(active)
+        A = len(act)
+        Call = C[act].reshape(A * k, m)
+        d3 = ((Call**2).sum(axis=1)[None, :]
+              - 2.0 * (Xn @ Call.T)).reshape(n, A, k)
+        labels = d3.argmin(axis=2).astype(np.int32)    # (n, A)
+        # gather the minima from the argmin instead of a second full scan
+        best = np.take_along_axis(
+            d3, labels[:, :, None], axis=2)[:, :, 0]   # (n, A)
+        if window > 0 and k > 1:
+            mask = d3 <= best[:, :, None] + window
+            ambr, ambc = np.nonzero(mask.sum(axis=2) > 1)
+            if ambr.size:
+                sub = mask[ambr, ambc]                 # (n_amb, k)
+                r = rng.random(sub.shape, dtype=np.float32)
+                labels[ambr, ambc] = np.where(sub, r, -1.0).argmax(axis=1)
+        min_d2 = best + xsq[:, None]                   # (n, A)
+        inertia = (wn @ min_d2).astype(np.float64)     # (A,)
+        flat = labels + (np.arange(A) * k)[None, :]
+        oh = np.zeros((n, A * k), np.float32)
+        oh[rows[:, None], flat] = wn[:, None]
+        sums3 = (oh.T @ Xn).reshape(A, k, m)           # one sgemm
+        # counts in float64, as the serial engine's bincount accumulates —
+        # they gate empty-cluster detection and the center division
+        counts2 = oh.sum(axis=0, dtype=np.float64).reshape(A, k)
+        for ai in range(A):
+            if (counts2[ai] <= 0).any():
+                sums3[ai], counts2[ai] = _relocate_empty_np(
+                    Xn, wn, labels[:, ai], min_d2[:, ai], sums3[ai],
+                    counts2[ai])
+        safe = np.where(counts2 > 0, counts2, 1.0)
+        newC = np.where((counts2 > 0)[..., None],
+                        sums3 / safe[..., None], C[act]).astype(np.float32)
+        shift = ((newC - C[act])**2).sum(axis=(1, 2))
+        better = inertia < best_inertia[act]
+        upd = act[better]
+        best_inertia[upd] = inertia[better]
+        best_centers[upd] = C[upd]
+        best_it[upd] = it
+        inertia_tr[act, it] = inertia
+        shift_tr[act, it] = shift
+        C[act] = newC
+        it_count[act] = it + 1
+        done = shift <= tol
+        if patience is not None:
+            done |= (it + 1 - best_it[act]) > patience
+        active[act[done]] = False
+        it += 1
+    # final consistent triple per restart: exact inertia of (last, best)
+    # candidates via one batched E pass, then the usual window-mode
+    # labeling of the single global winner
+    cand = np.concatenate([C, best_centers], axis=0)   # (2R, k, m)
+    Call = cand.reshape(2 * R * k, m)
+    d3 = ((Call**2).sum(axis=1)[None, :]
+          - 2.0 * (Xn @ Call.T)).reshape(n, 2 * R, k)
+    inert = (wn @ (d3.min(axis=2) + xsq[:, None])).astype(np.float64)
+    fin = np.minimum(inert[:R], inert[R:])
+    r_star = int(np.argmin(fin))
+    c_star = cand[r_star if inert[r_star] <= inert[R + r_star]
+                  else R + r_star]
+    labels, _, _, _, inertia = native.host_lloyd_step(
+        rng, Xn, wn, xsq, np.ascontiguousarray(c_star, np.float32), window,
+        e_only=True)
+    history = {"inertia": inertia_tr[r_star], "center_shift": shift_tr[r_star]}
+    winner = (labels, np.float32(inertia), c_star, int(it_count[r_star]),
+              history)
+    per_restart = [
+        (float(fin[r]), int(it_count[r]),
+         {"inertia": inertia_tr[r], "center_shift": shift_tr[r]})
+        for r in range(R)]
+    return winner, per_restart
+
+
 def _native_elkan_run(rng, Xn, wn, xsq, centers0, *, max_iter, tol,
                       patience):
     """Elkan twin of :func:`_native_lloyd_run`: the classical run with the
@@ -1276,8 +1380,8 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         # deterministic host RNG derived from the estimator's jax key
         rng = np.random.default_rng(
             np.asarray(jax.random.key_data(key), np.uint32).tolist())
-        best = None
-        for _ in range(n_init):
+
+        def make_init():
             if hasattr(init, "__array__"):
                 centers0 = np.asarray(init, np.float32)
                 if centers0.shape != (self.n_clusters, Xn.shape[1]):
@@ -1285,15 +1389,39 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
                         f"The shape of the initial centers {centers0.shape} "
                         f"does not match (n_clusters={self.n_clusters}, "
                         f"n_features={Xn.shape[1]}).")
-            else:
-                rinit = np.random.default_rng(int(rng.integers(0, 2**63 - 1)))
-                if init == "k-means++":
-                    centers0 = _kmeans_plusplus_np(rinit, Xn, xsqn,
-                                                   self.n_clusters, wn)
-                else:  # "random"
-                    idx = rinit.choice(Xn.shape[0], self.n_clusters,
-                                       replace=False, p=wn / wn.sum())
-                    centers0 = Xn[idx]
+                return centers0
+            rinit = np.random.default_rng(int(rng.integers(0, 2**63 - 1)))
+            if init == "k-means++":
+                return _kmeans_plusplus_np(rinit, Xn, xsqn, self.n_clusters,
+                                           wn)
+            # "random"
+            idx = rinit.choice(Xn.shape[0], self.n_clusters,
+                               replace=False, p=wn / wn.sum())
+            return Xn[idx]
+
+        # lockstep batching multiplies per-iteration temporaries by n_init;
+        # cap the footprint (~100 MB of float32 at the bound) and fall back
+        # to the serial loop beyond it — the overhead amortization it buys
+        # only matters on small workloads anyway
+        batch_ok = Xn.shape[0] * n_init * self.n_clusters <= 25_000_000
+        if engine == "blas" and batch_ok:
+            # all restarts in lockstep — one (n, R·k) sgemm per iteration
+            # amortizes the per-step numpy overhead across restarts
+            winner, per_restart = _native_lloyd_run_batched(
+                rng, Xn, wn, xsqn,
+                np.stack([make_init() for _ in range(n_init)]),
+                window=window, max_iter=self.max_iter, tol=tol_,
+                patience=patience)
+            if self.verbose:
+                for fin_inertia, n_it_r, hist_r in per_restart:
+                    for i, v in enumerate(hist_r["inertia"][:n_it_r]):
+                        print(f"Iteration {i}, inertia {v:.3f}.")
+                    print(f"init done, inertia {fin_inertia:.3f}")
+            return winner
+
+        best = None
+        for _ in range(n_init):
+            centers0 = make_init()
             if engine == "elkan":
                 labels, inertia, centers, n_iter, history = \
                     _native_elkan_run(

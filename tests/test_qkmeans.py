@@ -893,3 +893,56 @@ class TestElkan:
         np.testing.assert_allclose(sums_b, onehot.T @ Xn, rtol=1e-4,
                                    atol=1e-4)
         np.testing.assert_allclose(counts_b, onehot.sum(axis=0), rtol=1e-6)
+
+
+class TestBatchedHostRestarts:
+    """The lockstep batched BLAS runner must be indistinguishable from the
+    serial runner — the same per-restart stopping/relocation/best-tracking
+    semantics, just amortized into stacked sgemms."""
+
+    def test_batched_equals_serial_classic(self, blobs):
+        from sq_learn_tpu.models.qkmeans import (_native_lloyd_run,
+                                                 _native_lloyd_run_batched)
+
+        X, _ = blobs
+        Xn = np.ascontiguousarray(X, np.float32)
+        wn = np.ones(len(Xn), np.float32)
+        xsq = (Xn**2).sum(axis=1)
+        rng0 = np.random.default_rng(5)
+        stack = np.stack([Xn[rng0.choice(len(Xn), 4, replace=False)]
+                          for _ in range(4)])
+        kw = dict(max_iter=100, tol=1e-6, patience=None)
+        (labels_b, in_b, cent_b, it_b, hist_b), per = \
+            _native_lloyd_run_batched(np.random.default_rng(0), Xn, wn, xsq,
+                                      stack, window=0.0, **kw)
+        serial = [
+            _native_lloyd_run(np.random.default_rng(0), Xn, wn, xsq,
+                              stack[r], window=0.0, use_cpp=False, **kw)
+            for r in range(4)]
+        # per-restart final inertia and iteration counts agree
+        for r, (fin, n_it, hist) in enumerate(per):
+            assert fin == pytest.approx(float(serial[r][1]), rel=1e-5)
+            assert n_it == serial[r][3]
+            np.testing.assert_allclose(hist["inertia"][:n_it],
+                                       serial[r][4]["inertia"][:n_it],
+                                       rtol=1e-5)
+        # the winner matches the serial arg-best
+        best = min(serial, key=lambda t: float(t[1]))
+        np.testing.assert_array_equal(labels_b, best[0])
+        assert float(in_b) == pytest.approx(float(best[1]), rel=1e-5)
+        np.testing.assert_allclose(cent_b, best[2], rtol=1e-5, atol=1e-5)
+
+    def test_batched_routed_for_small_fits(self, blobs, monkeypatch):
+        """Small fits route through the batched runner; the public fit
+        surface is unchanged by the routing."""
+        import sq_learn_tpu.models.qkmeans as qk
+
+        X, _ = blobs
+        calls = []
+        orig = qk._native_lloyd_run_batched
+        monkeypatch.setattr(
+            qk, "_native_lloyd_run_batched",
+            lambda *a, **k: calls.append(1) or orig(*a, **k))
+        km = KMeans(n_clusters=4, n_init=2, random_state=0).fit(X)
+        assert calls, "batched runner was not routed for a small fit"
+        assert np.isfinite(km.inertia_) and km.labels_.shape == (len(X),)
