@@ -18,11 +18,13 @@ TPU-native implementations behind the reference's names
 - ``wrapper_phase_est_arguments`` (:575) / ``unwrap_phase_est_arguments``
   (:584) → :func:`sv_to_theta` / :func:`theta_to_sv` (aliases kept).
 
-``check_division`` (:425) has no equivalent: it splits work across a
-``multiprocessing.Pool``, which the batched kernels replace outright
-(SURVEY §2.3). ``check_measure`` (:414) lives inside
-:func:`~sq_learn_tpu.ops.quantum.tomography_incremental`'s schedule
-handling.
+``check_division`` (:425), ``check_measure`` (:414),
+``amplitude_est_dist`` (:435), ``auxiliary_fun`` (:404) and
+``vectorize_aux_fun`` (:409) are kept as drop-in compatibility shims —
+nothing internal consumes them (the batched kernels replace the Pool
+work-splitting outright, SURVEY §2.3, and the incremental tomography
+schedule de-duplicates inline), but reference code that calls them runs
+unmodified.
 """
 
 import jax
@@ -72,8 +74,67 @@ def create_rand_vec(key, n_vec, len_vec, scale=1.0, type="uniform"):
     return v
 
 
+def check_measure(arr, faster_measure_increment):
+    """Monotone measure-schedule fixup (reference ``check_measure``,
+    ``Utility.py:414``): bump equal/decreasing consecutive entries by
+    ``5 + faster_measure_increment`` so the schedule strictly increases.
+    Compatibility shim — :func:`tomography_incremental` de-duplicates its
+    schedule inline."""
+    arr = list(arr)
+    incr = 5 + faster_measure_increment
+    for i in range(len(arr) - 1):
+        if arr[i + 1] == arr[i]:
+            arr[i + 1] += incr
+        if arr[i + 1] <= arr[i]:
+            arr[i + 1] = arr[i] + incr
+    return arr
+
+
+def check_division(v, n_jobs):
+    """Split ``v`` work items into ``n_jobs`` near-equal integer chunks
+    (reference ``check_division``, ``Utility.py:425``). Compatibility
+    shim — the vectorized kernels replaced the reference's process-pool
+    fan-out, so nothing internal consumes this."""
+    base = int(v) // n_jobs
+    out = [base] * n_jobs
+    for i in range(int(v) - base * n_jobs):
+        out[i] += 1
+    return out
+
+
+def amplitude_est_dist(w0, w1):
+    """Circular (mod-1) distance between two phase-grid points (reference
+    ``amplitude_est_dist``, ``Utility.py:435``)."""
+    d = jnp.asarray(w1) - jnp.asarray(w0)
+    return jnp.minimum(jnp.abs(-jnp.ceil(d) + d), jnp.abs(-jnp.floor(d) + d))
+
+
+def auxiliary_fun(q_state, i, key=None):
+    """Measure ``q_state`` ``i`` times (reference ``auxiliary_fun``,
+    ``Utility.py:404``). The reference's version draws from a fresh
+    process-global RNG; ours takes an explicit key (a fresh
+    entropy-seeded key when omitted, for drop-in calls)."""
+    if key is None:
+        import numpy as _np
+
+        key = jax.random.PRNGKey(int(_np.random.SeedSequence().entropy
+                                     & 0x7FFFFFFF))
+    return q_state.measure(key, n_times=int(i))
+
+
+def vectorize_aux_fun(dic, i):
+    """√(count fraction) lookup with 0 default (reference
+    ``vectorize_aux_fun``, ``Utility.py:409``)."""
+    return jnp.sqrt(dic[i]) if i in dic else 0
+
+
 __all__ = [
     "QuantumState",
+    "amplitude_est_dist",
+    "auxiliary_fun",
+    "check_division",
+    "check_measure",
+    "vectorize_aux_fun",
     "amplitude_estimation",
     "best_mu",
     "consistent_phase_estimation",

@@ -18,6 +18,29 @@ from ..models.qkmeans import (
 # the reference's class name (``_dmeans.py:833``)
 qMeans_ = QKMeans
 
+
+def select_labels(a, key=None):
+    """Uniform pick among candidate labels (reference ``select_labels``,
+    ``_dmeans.py:2252`` — the δ-means tie-break). Compatibility shim: the
+    fused E-step samples the δ-window pick in-kernel
+    (:func:`~sq_learn_tpu.models.qkmeans.e_step`); reference code calling
+    this directly runs unmodified. The reference draws from the global
+    stdlib RNG; ours takes an explicit key (a fresh entropy-seeded pick
+    when omitted). Raises on an empty candidate set instead of printing
+    'Error' and returning None (reference latent bug, SURVEY §2.1)."""
+    import numpy as np
+
+    a = np.asarray(a)
+    if a.size == 0:
+        raise ValueError("select_labels: empty candidate set")
+    if key is None:
+        idx = np.random.default_rng().integers(a.shape[0])
+    else:
+        import jax
+
+        idx = int(jax.random.randint(key, (), 0, a.shape[0]))
+    return a[idx]
+
 __all__ = [
     "KMeans",
     "MiniBatchKMeans",
@@ -27,4 +50,5 @@ __all__ = [
     "k_means",
     "kmeans_plusplus",
     "lloyd_single",
+    "select_labels",
 ]

@@ -629,17 +629,20 @@ def _native_lloyd_run_batched(rng, Xn, wn, xsq, centers_stack, *, window,
         active[act[done]] = False
         it += 1
     # final consistent triple per restart: exact inertia of (last, best)
-    # candidates via one batched E pass, then the usual window-mode
-    # labeling of the single global winner
-    cand = np.concatenate([C, best_centers], axis=0)   # (2R, k, m)
-    Call = cand.reshape(2 * R * k, m)
-    d3 = ((Call**2).sum(axis=1)[None, :]
-          - 2.0 * (Xn @ Call.T)).reshape(n, 2 * R, k)
-    inert = (wn @ (d3.min(axis=2) + xsq[:, None])).astype(np.float64)
-    fin = np.minimum(inert[:R], inert[R:])
+    # candidates via two R-wide batched E passes (one 2R-wide pass would
+    # transiently double the footprint the batch_ok cap enforces), then
+    # the usual window-mode labeling of the single global winner
+    def batch_inertia(cands):
+        Call = cands.reshape(R * k, m)
+        d3 = ((Call**2).sum(axis=1)[None, :]
+              - 2.0 * (Xn @ Call.T)).reshape(n, R, k)
+        return (wn @ (d3.min(axis=2) + xsq[:, None])).astype(np.float64)
+
+    inert_last, inert_best = batch_inertia(C), batch_inertia(best_centers)
+    fin = np.minimum(inert_last, inert_best)
     r_star = int(np.argmin(fin))
-    c_star = cand[r_star if inert[r_star] <= inert[R + r_star]
-                  else R + r_star]
+    c_star = (C if inert_last[r_star] <= inert_best[r_star]
+              else best_centers)[r_star]
     labels, _, _, _, inertia = native.host_lloyd_step(
         rng, Xn, wn, xsq, np.ascontiguousarray(c_star, np.float32), window,
         e_only=True)

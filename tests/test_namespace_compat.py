@@ -67,3 +67,35 @@ def test_mnist_trial_style_pipeline_with_compat_imports():
     res = cross_validate(KNeighborsClassifier(n_neighbors=5), Xt, y,
                          cv=StratifiedKFold(n_splits=3))
     assert np.mean(res["test_score"]) > 0.9
+
+
+def test_reference_helper_shims():
+    """The small Utility.py helpers nothing internal consumes are still
+    importable drop-ins (reference ``Utility.py:404-441``,
+    ``_dmeans.py:2252``)."""
+    import jax
+    from sq_learn_tpu import QuantumUtility as QU
+    from sq_learn_tpu.cluster import select_labels
+    from sq_learn_tpu.ops.quantum import QuantumState
+
+    # check_measure: strictly increasing schedule fixup
+    assert QU.check_measure([5, 5, 4, 20], 0) == [5, 10, 15, 20]
+    # check_division: near-equal integer split summing to v
+    parts = QU.check_division(10, 3)
+    assert sum(parts) == 10 and max(parts) - min(parts) <= 1
+    # amplitude_est_dist: circular mod-1 distance
+    assert float(QU.amplitude_est_dist(0.1, 0.9)) == pytest.approx(0.2)
+    assert float(QU.amplitude_est_dist(0.4, 0.5)) == pytest.approx(0.1)
+    # auxiliary_fun / vectorize_aux_fun over a QuantumState
+    st = QuantumState(np.arange(4), np.ones(4) / 2.0)
+    out = QU.auxiliary_fun(st, 50, key=jax.random.PRNGKey(0))
+    assert len(out) == 50
+    assert float(QU.vectorize_aux_fun({2: 0.25}, 2)) == pytest.approx(0.5)
+    assert QU.vectorize_aux_fun({2: 0.25}, 3) == 0
+    # select_labels: uniform pick from candidates; empty set raises
+    picks = {int(select_labels(np.array([3, 7]),
+                               key=jax.random.PRNGKey(s)))
+             for s in range(20)}
+    assert picks == {3, 7}
+    with pytest.raises(ValueError, match="empty"):
+        select_labels(np.array([]))

@@ -933,16 +933,50 @@ class TestBatchedHostRestarts:
         np.testing.assert_allclose(cent_b, best[2], rtol=1e-5, atol=1e-5)
 
     def test_batched_routed_for_small_fits(self, blobs, monkeypatch):
-        """Small fits route through the batched runner; the public fit
-        surface is unchanged by the routing."""
+        """Small fits on the blas engine route through the batched runner;
+        the public fit surface is unchanged by the routing. cpu_count is
+        pinned low so many-core hosts do not route to the C++ engine and
+        skip the path under test."""
+        import os
+
         import sq_learn_tpu.models.qkmeans as qk
 
         X, _ = blobs
         calls = []
         orig = qk._native_lloyd_run_batched
+        monkeypatch.setattr(os, "cpu_count", lambda: 1)
         monkeypatch.setattr(
             qk, "_native_lloyd_run_batched",
             lambda *a, **k: calls.append(1) or orig(*a, **k))
         km = KMeans(n_clusters=4, n_init=2, random_state=0).fit(X)
         assert calls, "batched runner was not routed for a small fit"
         assert np.isfinite(km.inertia_) and km.labels_.shape == (len(X),)
+
+    def test_batched_window_semantics(self):
+        """The δ-window path of the batched runner: non-ambiguous rows keep
+        the argmin label, rows with several centers inside the window split
+        their picks, and inertia uses the true minima regardless of the
+        pick (the e_step contract)."""
+        from sq_learn_tpu.models.qkmeans import _native_lloyd_run_batched
+
+        # two exact centers at x=0 and x=1; points at x=0.5 are ambiguous
+        # for window >= 0.25 + eps, points at the centers are not
+        Xn = np.array([[0.0], [1.0]] * 30 + [[0.5]] * 60, np.float32)
+        wn = np.ones(len(Xn), np.float32)
+        xsq = (Xn**2).sum(axis=1)
+        stack = np.array([[[0.0], [1.0]]], np.float32)      # (1, 2, 1)
+        (labels, inertia, centers, n_iter, hist), _ =             _native_lloyd_run_batched(
+                np.random.default_rng(0), Xn, wn, xsq, stack, window=0.6,
+                max_iter=1, tol=np.inf, patience=None)
+        assert np.isfinite(float(inertia))
+        trace0 = float(hist["inertia"][0])
+        # true-minima inertia of iteration 0 under the init centers:
+        # midpoints contribute 0.25 each, center points 0
+        assert trace0 == pytest.approx(60 * 0.25, rel=1e-5)
+        # the returned labels come from the window-mode final E pass on the
+        # post-update centers; the midpoints are ambiguous under any of the
+        # candidate center configurations, so the uniform tie-break must
+        # split their picks between both clusters
+        mid = labels[60:]
+        assert set(np.unique(mid)) == {0, 1}
+        assert 10 <= int((mid == 0).sum()) <= 50   # ~Binomial(60, 1/2)
