@@ -564,8 +564,19 @@ def _native_lloyd_run_batched(rng, Xn, wn, xsq, centers_stack, *, window,
     ``winner`` is the usual ``(labels, inertia, centers, n_iter,
     history)`` of the globally best restart; ``per_restart`` is a list of
     ``(final_inertia, n_iter, history)`` in restart order (verbose
-    reporting)."""
+    reporting).
+
+    The C++ engine (:func:`sq_learn_tpu.native.lloyd_run_batched`) runs
+    this whole loop in one native call — one sgemm + one fused scan per
+    iteration, no per-step Python dispatch; the NumPy body below is its
+    semantics twin and the fallback for hosts without a toolchain."""
     from .. import native
+
+    out = native.lloyd_run_batched(
+        rng, Xn, wn, xsq, centers_stack, window=window, max_iter=max_iter,
+        tol=tol, patience=patience)
+    if out is not None:
+        return out
 
     R, k, m = centers_stack.shape
     n = Xn.shape[0]
@@ -886,6 +897,17 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
     model directly, adding unmodeled O(eps·‖x‖‖c‖) error on top of δ/2 —
     a warning says so. Equal to the input dtype is a no-op. The CPU host
     fast path always computes in float32 — a precision superset.
+
+    Determinism: ``random_state`` makes a fit reproducible on a given host
+    and backend. The stochastic streams (k-means++ draws, δ-window picks)
+    are engine-local — the XLA kernels thread jax PRNG keys, the C++ host
+    engine derives SplitMix64 streams, the NumPy fallback uses
+    ``default_rng`` — so fits on hosts that route to different engines
+    (accelerator vs CPU, toolchain vs no toolchain, core count) sample
+    different but identically-distributed streams, like sklearn across
+    BLAS/threading configurations. δ=0 single-init fits with an explicit
+    ``init`` array draw nothing and agree across engines to float
+    precision.
     """
 
     def __init__(self, n_clusters=8, *, init="k-means++", n_init=10,
@@ -1318,10 +1340,11 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
                 # (unpruned, identical results)
                 engine = "elkan"
             else:
-                # the scalar C++ kernel scales with cores; single-threaded
-                # BLAS sgemm wins on small hosts — and needs no toolchain,
-                # so the (potentially slow) .so build is only attempted
-                # when the C++ kernel would actually run
+                # the scalar C++ kernel scales with cores; on small hosts
+                # the blas engine wins — it prefers the one-call C++
+                # lockstep runner (which triggers the .so build on first
+                # use) and degrades to numpy sgemm steps without a
+                # toolchain
                 use_cpp = (os.cpu_count() or 1) >= 8
                 if use_cpp:
                     from ..native import native_available
@@ -1409,10 +1432,18 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         batch_ok = Xn.shape[0] * n_init * self.n_clusters <= 25_000_000
         if engine == "blas" and batch_ok:
             # all restarts in lockstep — one (n, R·k) sgemm per iteration
-            # amortizes the per-step numpy overhead across restarts
+            # amortizes the per-step numpy overhead across restarts; the
+            # k-means++ inits batch through the native engine too
+            stack = None
+            if isinstance(init, str) and init == "k-means++":
+                from .. import native
+
+                stack = native.kmeans_pp_batched(
+                    rng, Xn, wn, xsqn, self.n_clusters, n_init)
+            if stack is None:
+                stack = np.stack([make_init() for _ in range(n_init)])
             winner, per_restart = _native_lloyd_run_batched(
-                rng, Xn, wn, xsqn,
-                np.stack([make_init() for _ in range(n_init)]),
+                rng, Xn, wn, xsqn, stack,
                 window=window, max_iter=self.max_iter, tol=tol_,
                 patience=patience)
             if self.verbose:

@@ -90,6 +90,28 @@ def _load():
             ctypes.c_int, ctypes.POINTER(ctypes.c_float),
             ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
             ctypes.POINTER(ctypes.c_double), ctypes.c_int]
+        lib.lloyd_run_batched.restype = ctypes.c_int
+        lib.lloyd_run_batched.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_double, ctypes.c_uint64, ctypes.c_int64,
+            ctypes.c_double, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_double)]
+        lib.kmeans_pp_batched.restype = ctypes.c_int
+        lib.kmeans_pp_batched.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_float)]
+        lib.set_sgemm.restype = None
+        lib.set_sgemm.argtypes = [ctypes.c_void_p]
+        lib.has_sgemm.restype = ctypes.c_int
+        lib.has_sgemm.argtypes = []
+        _register_blas(lib)
         lib.murmurhash3_x86_32.restype = ctypes.c_uint32
         lib.murmurhash3_x86_32.argtypes = [
             ctypes.c_char_p, ctypes.c_int, ctypes.c_uint32]
@@ -118,9 +140,121 @@ def _load():
         return _lib
 
 
+_blas_handle = None  # keeps the OpenBLAS CDLL alive once registered
+
+
+def _register_blas(lib):
+    """Point the native library at a real BLAS sgemm when one is findable.
+
+    scipy bundles OpenBLAS as a private shared library exporting the
+    plain-int (LP64) ``scipy_cblas_sgemm`` — the only symbol/ABI the C++
+    ``cblas_sgemm_t`` typedef is valid for. numpy's bundled copy is the
+    ILP64 build (``scipy_cblas_sgemm64_``, 64-bit ints) and must NOT be
+    registered: binding it to the 32-bit-int signature would pass garbage
+    dims. Without a hit the C++ side falls back to its internal blocked
+    GEMM.
+    """
+    global _blas_handle
+    import glob
+
+    try:
+        import scipy
+    except ImportError:
+        return
+    libdir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(scipy.__file__))),
+        "scipy.libs")
+    for path in sorted(glob.glob(
+            os.path.join(libdir, "libscipy_openblas-*.so*"))):
+        try:
+            blas = ctypes.CDLL(path)
+            fn = blas.scipy_cblas_sgemm
+        except (OSError, AttributeError):
+            continue
+        lib.set_sgemm(ctypes.cast(fn, ctypes.c_void_p))
+        _blas_handle = blas
+        return
+
+
 def native_available():
     """True when the C++ library compiled and loaded."""
     return _load() is not None
+
+
+def kmeans_pp_batched(rng, Xn, wn, xsq, k, R, n_trials=None):
+    """R independent greedy k-means++ inits in one native call (the C++
+    twin of ``_kmeans_plusplus_np``: weighted first pick, then D² sampling
+    keeping the best of ``n_trials`` candidate centers per round). Returns
+    a (R, k, m) float32 stack, or None when the native library is
+    unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    Xn = np.ascontiguousarray(Xn, np.float32)
+    wn = np.ascontiguousarray(wn, np.float32)
+    xsq = np.ascontiguousarray(xsq, np.float32)
+    n, m = Xn.shape
+    if n_trials is None:
+        n_trials = 2 + int(np.log(k))
+    out = np.empty((R, k, m), np.float32)
+    fp = ctypes.POINTER(ctypes.c_float)
+    rc = lib.kmeans_pp_batched(
+        Xn.ctypes.data_as(fp), wn.ctypes.data_as(fp), xsq.ctypes.data_as(fp),
+        n, m, int(k), int(R), int(n_trials),
+        int(rng.integers(0, 2**63 - 1)), out.ctypes.data_as(fp))
+    return out if rc == 0 else None
+
+
+def lloyd_run_batched(rng, Xn, wn, xsq, centers_stack, *, window, max_iter,
+                      tol, patience):
+    """Full lockstep multi-restart windowed Lloyd run in ONE native call —
+    the C++ engine behind the host runner
+    (:func:`sq_learn_tpu.models.qkmeans._native_lloyd_run_batched`, which
+    holds the semantics contract and the NumPy twin). Returns the same
+    ``(winner, per_restart)`` structure, or None when the native library is
+    unavailable (caller falls back to the NumPy lockstep loop).
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    Xn = np.ascontiguousarray(Xn, np.float32)
+    wn = np.ascontiguousarray(wn, np.float32)
+    xsq = np.ascontiguousarray(xsq, np.float32)
+    C = np.ascontiguousarray(centers_stack, np.float32).copy()
+    R, k, m = C.shape
+    n = Xn.shape[0]
+    max_iter = int(max_iter)
+    labels = np.empty(n, np.int32)
+    out_centers = np.empty((k, m), np.float32)
+    out_final = np.empty(R, np.float64)
+    inertia_tr = np.full((R, max_iter), np.nan, np.float32)
+    shift_tr = np.full((R, max_iter), np.nan, np.float32)
+    out_iters = np.zeros(R, np.int64)
+    out_winner = ctypes.c_int64()
+    out_inertia = ctypes.c_double()
+    fp = ctypes.POINTER(ctypes.c_float)
+    rc = lib.lloyd_run_batched(
+        Xn.ctypes.data_as(fp), wn.ctypes.data_as(fp), xsq.ctypes.data_as(fp),
+        C.ctypes.data_as(fp), n, m, k, R, float(window),
+        int(rng.integers(0, 2**63 - 1)), max_iter, float(tol),
+        -1 if patience is None else int(patience),
+        labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        out_centers.ctypes.data_as(fp),
+        out_final.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        inertia_tr.ctypes.data_as(fp), shift_tr.ctypes.data_as(fp),
+        out_iters.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        ctypes.byref(out_winner), ctypes.byref(out_inertia))
+    if rc != 0:
+        return None
+    r_star = int(out_winner.value)
+    history = {"inertia": inertia_tr[r_star], "center_shift": shift_tr[r_star]}
+    winner = (labels, np.float32(out_inertia.value), out_centers,
+              int(out_iters[r_star]), history)
+    per_restart = [
+        (float(out_final[r]), int(out_iters[r]),
+         {"inertia": inertia_tr[r], "center_shift": shift_tr[r]})
+        for r in range(R)]
+    return winner, per_restart
 
 
 # ---------------------------------------------------------------------------
@@ -578,5 +712,6 @@ def _stream_batches(path, batch_rows, delimiter, skip_header, n_cols):
             yield _parse_lines(lines, delimiter, n_cols)
 
 
-__all__ = ["native_available", "lloyd_iter", "elkan_iter", "murmurhash3_32",
+__all__ = ["native_available", "lloyd_iter", "elkan_iter",
+           "lloyd_run_batched", "kmeans_pp_batched", "murmurhash3_32",
            "murmurhash3_bulk", "csv_read_floats", "csv_stream_batches"]

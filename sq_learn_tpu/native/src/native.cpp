@@ -17,6 +17,7 @@
 //
 // Exposed as plain C symbols consumed via ctypes (no pybind11 in the image).
 
+#include <algorithm>
 #include <atomic>
 #include <cmath>
 #include <cstdint>
@@ -235,6 +236,402 @@ int lloyd_iter_window(const float* X, const float* sample_weight,
     inertia += t_inertia[t];
   }
   *out_inertia = inertia;
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Full lockstep multi-restart windowed Lloyd run
+// ---------------------------------------------------------------------------
+
+// Optional BLAS sgemm, registered from Python (scipy's bundled OpenBLAS via
+// ctypes). Standard cblas signature; 101=RowMajor, 111=NoTrans, 112=Trans.
+typedef void (*cblas_sgemm_t)(int order, int trans_a, int trans_b, int m,
+                              int n, int k, float alpha, const float* a,
+                              int lda, const float* b, int ldb, float beta,
+                              float* c, int ldc);
+static cblas_sgemm_t g_sgemm = nullptr;
+
+void set_sgemm(void* fn) { g_sgemm = (cblas_sgemm_t)fn; }
+int has_sgemm() { return g_sgemm != nullptr; }
+
+// G(rows, cols) = A(rows, m) @ B(cols, m)^T — BLAS when registered, else a
+// blocked dot-product fallback (auto-vectorized; only hosts where scipy's
+// OpenBLAS could not be located pay it).
+static void gemm_nt(const float* A, const float* B, float* G, int64_t rows,
+                    int64_t cols, int64_t m) {
+  if (g_sgemm) {
+    g_sgemm(101, 111, 112, (int)rows, (int)cols, (int)m, 1.0f, A, (int)m, B,
+            (int)m, 0.0f, G, (int)cols);
+    return;
+  }
+  const int64_t BI = 64, BJ = 48;
+  for (int64_t i0 = 0; i0 < rows; i0 += BI) {
+    const int64_t i1 = std::min(rows, i0 + BI);
+    for (int64_t j0 = 0; j0 < cols; j0 += BJ) {
+      const int64_t j1 = std::min(cols, j0 + BJ);
+      for (int64_t i = i0; i < i1; ++i) {
+        const float* a = A + i * m;
+        float* g = G + i * cols;
+        for (int64_t j = j0; j < j1; ++j) {
+          const float* b = B + j * m;
+          float s = 0.0f;
+          for (int64_t f = 0; f < m; ++f) s += a[f] * b[f];
+          g[j] = s;
+        }
+      }
+    }
+  }
+}
+
+// The whole `_native_lloyd_run_batched` loop in one call: every restart
+// advances in lockstep (one (n, A·k) GEMM per iteration over the still-
+// active restarts), with the host runner's exact semantics — δ-window
+// uniform pick, true-minima inertia, empty-cluster relocation onto the
+// highest-min_d2 points, per-restart best-inertia tracking, shift ≤ tol and
+// best-inertia-plateau (patience) stopping, NaN-padded history traces, and
+// the final best-of-(last, best) exact re-evaluation per restart with
+// window-mode labeling of the single global winner.
+//
+// In/out: C (R, k, m) holds the initial centers and is left holding each
+// restart's LAST centers; out_centers gets the winner's chosen centers.
+// inertia_tr / shift_tr are (R, max_iter) float32, prefilled with NaN by the
+// caller. patience < 0 disables the plateau rule. Returns 0 on success.
+int lloyd_run_batched(const float* X, const float* sample_weight,
+                      const float* xsq, float* C, int64_t n, int64_t m,
+                      int64_t k, int64_t R, double window, uint64_t seed,
+                      int64_t max_iter, double tol, int64_t patience,
+                      int32_t* out_labels, float* out_centers,
+                      double* out_final, float* inertia_tr, float* shift_tr,
+                      int64_t* out_iters, int64_t* out_winner,
+                      double* out_winner_inertia) {
+  if (n <= 0 || m <= 0 || k <= 0 || R <= 0 || max_iter < 0) return -1;
+
+  const int64_t km = k * m;
+  std::vector<float> best_centers(C, C + R * km);  // snapshot at best it
+  std::vector<double> best_inertia(R, 1e300);
+  std::vector<int64_t> best_it(R, 0), it_count(R, 0);
+  std::vector<char> active(R, 1);
+  std::vector<int64_t> act(R);
+  std::vector<float> Call(R * km);        // gathered active centers
+  std::vector<float> G(n * R * k);        // X @ Call^T
+  std::vector<double> csq(R * k);
+  std::vector<double> sums(R * km), counts(R * k), inertia(R);
+  std::vector<int32_t> labels(n * R);
+  std::vector<float> min_d2(n * R);
+  std::vector<int64_t> order;             // relocation candidate scratch
+
+  auto pick_rng = [seed](uint64_t it, uint64_t r, uint64_t row) {
+    uint64_t x = splitmix64(seed ^ it);
+    x = splitmix64(x ^ (r + 1));
+    return splitmix64(x ^ row);
+  };
+
+  // One windowed E pass of restart r at `centers`, accumulating partials
+  // and inertia; shared by the iteration loop (emit=true) and the final
+  // re-evaluations (emit=false: exact inertia only).
+  // (kept inline in the loop below for cache locality; see scan lambda)
+
+  int64_t it = 0;
+  while (it < max_iter) {
+    int64_t A = 0;
+    for (int64_t r = 0; r < R; ++r)
+      if (active[r]) act[A++] = r;
+    if (A == 0) break;
+    for (int64_t a = 0; a < A; ++a)
+      std::memcpy(Call.data() + a * km, C + act[a] * km,
+                  sizeof(float) * km);
+    const int64_t cols = A * k;
+    for (int64_t c = 0; c < cols; ++c) {
+      const float* cc = Call.data() + c * m;
+      double s = 0.0;
+      for (int64_t f = 0; f < m; ++f) s += (double)cc[f] * cc[f];
+      csq[c] = s;
+    }
+    gemm_nt(X, Call.data(), G.data(), n, cols, m);
+    std::fill(sums.begin(), sums.begin() + cols * m, 0.0);
+    std::fill(counts.begin(), counts.begin() + cols, 0.0);
+    std::fill(inertia.begin(), inertia.begin() + A, 0.0);
+
+    for (int64_t i = 0; i < n; ++i) {
+      const float* g = G.data() + i * cols;
+      const float* x = X + i * m;
+      const double w = sample_weight ? (double)sample_weight[i] : 1.0;
+      const double xs = (double)xsq[i];
+      for (int64_t a = 0; a < A; ++a) {
+        const double* cs = csq.data() + a * k;
+        const float* ga = g + a * k;
+        double best = 1e300;
+        int32_t best_j = 0;
+        for (int64_t j = 0; j < k; ++j) {
+          const double d = cs[j] - 2.0 * (double)ga[j];
+          if (d < best) { best = d; best_j = (int32_t)j; }
+        }
+        int32_t pick = best_j;
+        if (window > 0.0 && k > 1) {
+          int64_t cnt = 0;
+          for (int64_t j = 0; j < k; ++j)
+            cnt += (cs[j] - 2.0 * (double)ga[j] <= best + window);
+          if (cnt > 1) {
+            uint64_t rr = pick_rng((uint64_t)it, (uint64_t)act[a],
+                                   (uint64_t)i) % (uint64_t)cnt;
+            for (int64_t j = 0; j < k; ++j) {
+              if (cs[j] - 2.0 * (double)ga[j] <= best + window &&
+                  rr-- == 0) { pick = (int32_t)j; break; }
+            }
+          }
+        }
+        labels[i * R + act[a]] = pick;
+        min_d2[i * R + act[a]] = (float)(best + xs);
+        double* sa = sums.data() + (a * k + pick) * m;
+        for (int64_t f = 0; f < m; ++f) sa[f] += w * (double)x[f];
+        counts[a * k + pick] += w;
+        inertia[a] += w * (best + xs);
+      }
+    }
+
+    for (int64_t a = 0; a < A; ++a) {
+      const int64_t r = act[a];
+      double* sa = sums.data() + a * km;
+      double* ca = counts.data() + a * k;
+      // empty-cluster relocation (reference _k_means_fast.pyx:162 role):
+      // each empty cluster takes the not-yet-taken point with the largest
+      // weighted-eligible min_d2; its donor cluster gives the point up
+      int64_t n_empty = 0;
+      for (int64_t j = 0; j < k; ++j) n_empty += (ca[j] <= 0.0);
+      if (n_empty > 0) {
+        order.resize(n);
+        for (int64_t i = 0; i < n; ++i) order[i] = i;
+        const int64_t take = std::min(n_empty, n);
+        const float* md = min_d2.data();
+        const float* sw = sample_weight;
+        auto better_cand = [md, sw, R, r](int64_t p, int64_t q) {
+          const bool pe = !sw || sw[p] > 0.0f, qe = !sw || sw[q] > 0.0f;
+          const double ps = pe ? (double)md[p * R + r] : -1e300;
+          const double qs = qe ? (double)md[q * R + r] : -1e300;
+          if (ps != qs) return ps > qs;
+          return p < q;  // deterministic tie order
+        };
+        std::partial_sort(order.begin(), order.begin() + take, order.end(),
+                          better_cand);
+        int64_t t = 0;
+        for (int64_t j = 0; j < k && t < take; ++j) {
+          if (ca[j] > 0.0) continue;
+          const int64_t p = order[t++];
+          if ((sample_weight && sample_weight[p] <= 0.0f)) continue;
+          const double wp = sample_weight ? (double)sample_weight[p] : 1.0;
+          const int32_t donor = labels[p * R + r];
+          const float* xp = X + p * m;
+          double* sd = sa + (int64_t)donor * m;
+          double* sj = sa + j * m;
+          for (int64_t f = 0; f < m; ++f) {
+            sd[f] -= wp * (double)xp[f];
+            sj[f] = wp * (double)xp[f];
+          }
+          ca[donor] -= wp;
+          ca[j] = wp;
+        }
+      }
+      // M-step + shift + best tracking + traces + stopping
+      float* cr = C + r * km;
+      if (inertia[a] < best_inertia[r]) {
+        best_inertia[r] = inertia[a];
+        std::memcpy(best_centers.data() + r * km, cr, sizeof(float) * km);
+        best_it[r] = it;
+      }
+      double shift = 0.0;
+      for (int64_t j = 0; j < k; ++j) {
+        float* cj = cr + j * m;
+        if (ca[j] > 0.0) {
+          const double inv = 1.0 / ca[j];
+          for (int64_t f = 0; f < m; ++f) {
+            const float nv = (float)(sa[j * m + f] * inv);
+            const double dd = (double)nv - (double)cj[f];
+            shift += dd * dd;
+            cj[f] = nv;
+          }
+        }  // empty with no candidate: center stays, contributes no shift
+      }
+      inertia_tr[r * max_iter + it] = (float)inertia[a];
+      shift_tr[r * max_iter + it] = (float)shift;
+      it_count[r] = it + 1;
+      if (shift <= tol) active[r] = 0;
+      if (patience >= 0 && (it + 1 - best_it[r]) > patience) active[r] = 0;
+    }
+    ++it;
+  }
+
+  // Exact per-restart re-evaluation of (last, best) candidates, then the
+  // global winner. One (n, R·k) GEMM per candidate set.
+  std::vector<double> inert_last(R, 0.0), inert_best(R, 0.0);
+  const float* cand_sets[2] = {C, best_centers.data()};
+  std::vector<double>* cand_out[2] = {&inert_last, &inert_best};
+  for (int s = 0; s < 2; ++s) {
+    const float* CS = cand_sets[s];
+    const int64_t cols = R * k;
+    for (int64_t c = 0; c < cols; ++c) {
+      const float* cc = CS + c * m;
+      double v = 0.0;
+      for (int64_t f = 0; f < m; ++f) v += (double)cc[f] * cc[f];
+      csq[c] = v;
+    }
+    gemm_nt(X, CS, G.data(), n, cols, m);
+    std::vector<double>& out = *cand_out[s];
+    for (int64_t i = 0; i < n; ++i) {
+      const float* g = G.data() + i * cols;
+      const double w = sample_weight ? (double)sample_weight[i] : 1.0;
+      const double xs = (double)xsq[i];
+      for (int64_t r = 0; r < R; ++r) {
+        double best = 1e300;
+        for (int64_t j = 0; j < k; ++j) {
+          const double d = csq[r * k + j] - 2.0 * (double)g[r * k + j];
+          if (d < best) best = d;
+        }
+        out[r] += w * (best + xs);
+      }
+    }
+  }
+  int64_t r_star = 0;
+  double fin_star = 1e300;
+  for (int64_t r = 0; r < R; ++r) {
+    const double fin = std::min(inert_last[r], inert_best[r]);
+    out_final[r] = fin;
+    out_iters[r] = it_count[r];
+    if (fin < fin_star) { fin_star = fin; r_star = r; }
+  }
+  const float* c_star = (inert_last[r_star] <= inert_best[r_star]
+                             ? C : best_centers.data()) + r_star * km;
+  std::memcpy(out_centers, c_star, sizeof(float) * km);
+
+  // window-mode labeling of the winner (the host runner's final E pass)
+  const uint64_t fseed = splitmix64(seed ^ 0x517cc1b727220a95ULL);
+  for (int64_t c = 0; c < k; ++c) {
+    const float* cc = c_star + c * m;
+    double v = 0.0;
+    for (int64_t f = 0; f < m; ++f) v += (double)cc[f] * cc[f];
+    csq[c] = v;
+  }
+  gemm_nt(X, c_star, G.data(), n, k, m);
+  double win_inertia = 0.0;
+  for (int64_t i = 0; i < n; ++i) {
+    const float* g = G.data() + i * k;
+    double best = 1e300;
+    int32_t best_j = 0;
+    for (int64_t j = 0; j < k; ++j) {
+      const double d = csq[j] - 2.0 * (double)g[j];
+      if (d < best) { best = d; best_j = (int32_t)j; }
+    }
+    int32_t pick = best_j;
+    if (window > 0.0 && k > 1) {
+      int64_t cnt = 0;
+      for (int64_t j = 0; j < k; ++j)
+        cnt += (csq[j] - 2.0 * (double)g[j] <= best + window);
+      if (cnt > 1) {
+        uint64_t rr = splitmix64(fseed ^ (uint64_t)i) % (uint64_t)cnt;
+        for (int64_t j = 0; j < k; ++j) {
+          if (csq[j] - 2.0 * (double)g[j] <= best + window && rr-- == 0) {
+            pick = (int32_t)j;
+            break;
+          }
+        }
+      }
+    }
+    out_labels[i] = pick;
+    const double w = sample_weight ? (double)sample_weight[i] : 1.0;
+    win_inertia += w * (best + (double)xsq[i]);
+  }
+  *out_winner = r_star;
+  *out_winner_inertia = win_inertia;
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Batched greedy k-means++ init (D² sampling, best-of-n_trials)
+// ---------------------------------------------------------------------------
+
+static inline double u01(uint64_t x) {  // uniform in [0, 1)
+  return (double)(x >> 11) * (1.0 / 9007199254740992.0);
+}
+
+// R independent greedy k-means++ inits (the host twin of
+// `_kmeans_plusplus_np`: weighted first pick, then k-1 rounds of D²
+// sampling over `n_trials` candidates keeping the one that minimizes the
+// would-be potential). out_centers: (R, k, m). Candidate draws come from
+// SplitMix64 streams keyed on (seed, restart, round) — same distribution
+// as the NumPy twin, different stream.
+int kmeans_pp_batched(const float* X, const float* sample_weight,
+                      const float* xsq, int64_t n, int64_t m, int64_t k,
+                      int64_t R, int64_t n_trials, uint64_t seed,
+                      float* out_centers) {
+  if (n <= 0 || m <= 0 || k <= 0 || R <= 0 || n_trials <= 0) return -1;
+  std::vector<double> cumw(n), pot(n), cum(n);
+  std::vector<float> cand_rows(n_trials * m);
+  std::vector<float> D(n * n_trials);  // X @ cand^T
+  std::vector<int64_t> cand(n_trials);
+  std::vector<double> closest(n), newc_best(n), newc(n);
+  double wtot = 0.0;
+  for (int64_t i = 0; i < n; ++i) {
+    wtot += sample_weight ? (double)sample_weight[i] : 1.0;
+    cumw[i] = wtot;
+  }
+  if (wtot <= 0.0) return -2;
+
+  for (int64_t r = 0; r < R; ++r) {
+    uint64_t st = splitmix64(seed ^ splitmix64((uint64_t)r + 0x9E37ULL));
+    auto next_u01 = [&st]() {
+      st = splitmix64(st);
+      return u01(st);
+    };
+    // weighted first center
+    const double u0 = next_u01() * wtot;
+    int64_t first = (int64_t)(std::lower_bound(cumw.begin(), cumw.end(), u0)
+                              - cumw.begin());
+    if (first >= n) first = n - 1;
+    float* C = out_centers + r * k * m;
+    std::memcpy(C, X + first * m, sizeof(float) * m);
+    gemm_nt(X, X + first * m, D.data(), n, 1, m);
+    for (int64_t i = 0; i < n; ++i)
+      closest[i] = std::max(
+          0.0, (double)xsq[i] + (double)xsq[first] - 2.0 * (double)D[i]);
+
+    for (int64_t c = 1; c < k; ++c) {
+      double tot = 0.0;
+      for (int64_t i = 0; i < n; ++i) {
+        const double w = sample_weight ? (double)sample_weight[i] : 1.0;
+        tot += closest[i] * w;
+        cum[i] = tot;
+      }
+      for (int64_t t = 0; t < n_trials; ++t) {
+        const double u = next_u01() * tot;
+        int64_t idx = (int64_t)(std::lower_bound(cum.begin(), cum.end(), u)
+                                - cum.begin());
+        if (idx >= n) idx = n - 1;
+        cand[t] = idx;
+        std::memcpy(cand_rows.data() + t * m, X + idx * m,
+                    sizeof(float) * m);
+      }
+      gemm_nt(X, cand_rows.data(), D.data(), n, n_trials, m);
+      double best_score = 1e300;
+      int64_t best_t = 0;
+      for (int64_t t = 0; t < n_trials; ++t) {
+        const double cxsq = (double)xsq[cand[t]];
+        double score = 0.0;
+        for (int64_t i = 0; i < n; ++i) {
+          const double d2 = std::max(
+              0.0, (double)xsq[i] + cxsq - 2.0 * (double)D[i * n_trials + t]);
+          const double v = std::min(closest[i], d2);
+          newc[i] = v;
+          score += v * (sample_weight ? (double)sample_weight[i] : 1.0);
+        }
+        if (score < best_score) {
+          best_score = score;
+          best_t = t;
+          std::swap(newc, newc_best);
+        }
+      }
+      closest.swap(newc_best);
+      std::memcpy(C + c * m, X + cand[best_t] * m, sizeof(float) * m);
+    }
+  }
   return 0;
 }
 

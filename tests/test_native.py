@@ -215,3 +215,159 @@ class TestCsvStreamBatches:
             # strtof semantics: '1_000' -> 1.0 (prefix), inf parsed, 2.5e2
             np.testing.assert_array_equal(
                 row, [[1.0, np.inf, 250.0]])
+
+
+class TestLloydRunBatched:
+    """The one-call C++ lockstep runner vs its NumPy twin
+    (`_native_lloyd_run_batched`'s fallback body): identical decisions at
+    window=0, same stopping/relocation/best-tracking, same output
+    structure. The twin holds the semantics contract — any drift between
+    the engines is a bug in one of them."""
+
+    @pytest.fixture()
+    def problem(self):
+        rng = np.random.default_rng(7)
+        X = np.vstack([rng.normal(c, 0.4, (150, 6))
+                       for c in (0.0, 4.0, 8.0, 12.0)]).astype(np.float32)
+        wn = np.ones(len(X), np.float32)
+        xsq = (X**2).sum(axis=1)
+        stack = np.stack([X[rng.choice(len(X), 4, replace=False)]
+                          for _ in range(5)])
+        return X, wn, xsq, stack
+
+    def _numpy_twin(self, monkeypatch, *args, **kw):
+        from sq_learn_tpu import native
+        from sq_learn_tpu.models.qkmeans import _native_lloyd_run_batched
+
+        monkeypatch.setattr(native, "lloyd_run_batched",
+                            lambda *a, **k: None)
+        try:
+            return _native_lloyd_run_batched(*args, **kw)
+        finally:
+            monkeypatch.undo()
+
+    def test_matches_numpy_twin_classic(self, problem, monkeypatch):
+        from sq_learn_tpu import native
+
+        if not native.native_available():
+            pytest.skip("no native toolchain")
+        X, wn, xsq, stack = problem
+        kw = dict(window=0.0, max_iter=80, tol=1e-6, patience=None)
+        win_c, per_c = native.lloyd_run_batched(
+            np.random.default_rng(0), X, wn, xsq, stack, **kw)
+        win_n, per_n = self._numpy_twin(
+            monkeypatch, np.random.default_rng(0), X, wn, xsq, stack, **kw)
+        # restarts that converge to the same optimum tie in `fin` to ~1e-7;
+        # sub-float tie-breaks may pick a differently-PERMUTED winner, so
+        # compare the clustering, not raw label ids
+        from sq_learn_tpu.metrics import adjusted_rand_score
+
+        assert adjusted_rand_score(win_c[0], win_n[0]) == pytest.approx(1.0)
+        assert float(win_c[1]) == pytest.approx(float(win_n[1]), rel=1e-5)
+        # per-restart outcomes agree; exact step-counts are NOT asserted —
+        # the engines run different float pipelines (double csq + scipy's
+        # OpenBLAS vs float32 + numpy's), so a near-tie distance may flip
+        # one label and shift convergence by an iteration on some hosts
+        for (fc, ic, hc), (fn, iN, hn) in zip(per_c, per_n):
+            assert fc == pytest.approx(fn, rel=1e-3)
+            assert abs(ic - iN) <= 2
+
+    def test_relocation_parity_with_degenerate_init(self, monkeypatch):
+        """All restarts seeded on ONE duplicated point: the C++ relocation
+        must rescue empty clusters exactly like the NumPy twin."""
+        from sq_learn_tpu import native
+
+        if not native.native_available():
+            pytest.skip("no native toolchain")
+        rng = np.random.default_rng(3)
+        X = np.vstack([rng.normal(c, 0.2, (60, 3))
+                       for c in (0.0, 5.0, 10.0)]).astype(np.float32)
+        wn = np.ones(len(X), np.float32)
+        xsq = (X**2).sum(axis=1)
+        stack = np.repeat(X[:1][None], 3, axis=1)[None].repeat(2, 0)
+        stack = np.ascontiguousarray(stack.reshape(2, 3, 3), np.float32)
+        kw = dict(window=0.0, max_iter=50, tol=1e-6, patience=None)
+        win_c, _ = native.lloyd_run_batched(
+            np.random.default_rng(1), X, wn, xsq, stack.copy(), **kw)
+        win_n, _ = self._numpy_twin(
+            monkeypatch, np.random.default_rng(1), X, wn, xsq, stack.copy(),
+            **kw)
+        assert len(np.unique(win_c[0])) == 3      # every cluster populated
+        np.testing.assert_array_equal(win_c[0], win_n[0])
+        assert float(win_c[1]) == pytest.approx(float(win_n[1]), rel=1e-5)
+
+    def test_window_pick_distribution(self):
+        """Ambiguous rows split uniformly between in-window centers (the
+        δ-means contract) under the C++ splitmix stream."""
+        from sq_learn_tpu import native
+
+        if not native.native_available():
+            pytest.skip("no native toolchain")
+        X = np.array([[0.0], [1.0]] * 20 + [[0.5]] * 200, np.float32)
+        wn = np.ones(len(X), np.float32)
+        xsq = (X**2).sum(axis=1)
+        stack = np.array([[[0.0], [1.0]]], np.float32)
+        (labels, _, _, _, _), _ = native.lloyd_run_batched(
+            np.random.default_rng(0), X, wn, xsq, stack, window=0.6,
+            max_iter=1, tol=np.inf, patience=None)
+        mid = labels[40:]
+        assert set(np.unique(mid)) == {0, 1}
+        assert 60 <= int((mid == 0).sum()) <= 140  # ~Binomial(200, 1/2)
+
+
+class TestKmeansPPBatched:
+    def test_centers_are_distinct_data_rows(self):
+        from sq_learn_tpu import native
+
+        if not native.native_available():
+            pytest.skip("no native toolchain")
+        rng = np.random.default_rng(11)
+        X = rng.normal(0, 1, (300, 5)).astype(np.float32)
+        xsq = (X**2).sum(axis=1)
+        S = native.kmeans_pp_batched(
+            np.random.default_rng(0), X, np.ones(300, np.float32), xsq, 8, 6)
+        assert S.shape == (6, 8, 5)
+        rows = {X[i].tobytes() for i in range(len(X))}
+        for r in range(6):
+            picked = {S[r, c].tobytes() for c in range(8)}
+            assert len(picked) == 8            # distinct within a restart
+            assert picked <= rows              # all are data points
+
+    def test_potential_comparable_to_numpy_twin(self):
+        """D² sampling quality: the native init's potential is in the same
+        band as the NumPy twin's (both greedy best-of-trials)."""
+        from sq_learn_tpu import native
+        from sq_learn_tpu.models.qkmeans import _kmeans_plusplus_np
+
+        if not native.native_available():
+            pytest.skip("no native toolchain")
+        rng = np.random.default_rng(5)
+        X = np.vstack([rng.normal(c, 0.5, (80, 4))
+                       for c in (0, 4, 8, 12, 16)]).astype(np.float32)
+        wn = np.ones(len(X), np.float32)
+        xsq = (X**2).sum(axis=1)
+
+        def potential(C):
+            d = xsq[:, None] + (C**2).sum(1)[None] - 2 * X @ C.T
+            return float(np.maximum(d, 0).min(axis=1).sum())
+
+        S = native.kmeans_pp_batched(
+            np.random.default_rng(0), X, wn, xsq, 5, 8)
+        pots_c = [potential(S[r]) for r in range(8)]
+        pots_n = [potential(_kmeans_plusplus_np(
+            np.random.default_rng(r), X, xsq, 5, wn)) for r in range(8)]
+        # same algorithm, different streams: medians within 2x
+        assert np.median(pots_c) <= 2.0 * np.median(pots_n) + 1e-6
+
+    def test_deterministic_given_seed(self):
+        from sq_learn_tpu import native
+
+        if not native.native_available():
+            pytest.skip("no native toolchain")
+        X = np.random.default_rng(2).normal(0, 1, (100, 3)).astype(np.float32)
+        xsq = (X**2).sum(axis=1)
+        a = native.kmeans_pp_batched(
+            np.random.default_rng(9), X, np.ones(100, np.float32), xsq, 4, 3)
+        b = native.kmeans_pp_batched(
+            np.random.default_rng(9), X, np.ones(100, np.float32), xsq, 4, 3)
+        np.testing.assert_array_equal(a, b)
