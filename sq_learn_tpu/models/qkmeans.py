@@ -1430,10 +1430,14 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         # to the serial loop beyond it — the overhead amortization it buys
         # only matters on small workloads anyway
         batch_ok = Xn.shape[0] * n_init * self.n_clusters <= 25_000_000
-        if engine == "blas" and batch_ok:
-            # all restarts in lockstep — one (n, R·k) sgemm per iteration
-            # amortizes the per-step numpy overhead across restarts; the
-            # k-means++ inits batch through the native engine too
+        if engine in ("blas", "cpp") and batch_ok:
+            # all restarts in lockstep — one fused (n, R·k) E+M step per
+            # iteration amortizes per-step dispatch across restarts. The
+            # C++ runner threads the scan and lets OpenBLAS thread the
+            # GEMMs, so it is the best engine on every host class; "cpp"
+            # (many-core) vs "blas" only matters on the serial fallback
+            # below. The k-means++ inits batch through the native engine
+            # too (restart-parallel).
             stack = None
             if isinstance(init, str) and init == "k-means++":
                 from .. import native

@@ -100,13 +100,14 @@ def _load():
             ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_float),
             ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_float),
             ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int64),
-            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_double)]
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int]
         lib.kmeans_pp_batched.restype = ctypes.c_int
         lib.kmeans_pp_batched.argtypes = [
             ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
             ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_int64,
             ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_uint64,
-            ctypes.POINTER(ctypes.c_float)]
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int]
         lib.set_sgemm.restype = None
         lib.set_sgemm.argtypes = [ctypes.c_void_p]
         lib.has_sgemm.restype = ctypes.c_int
@@ -181,7 +182,7 @@ def native_available():
     return _load() is not None
 
 
-def kmeans_pp_batched(rng, Xn, wn, xsq, k, R, n_trials=None):
+def kmeans_pp_batched(rng, Xn, wn, xsq, k, R, n_trials=None, n_threads=0):
     """R independent greedy k-means++ inits in one native call (the C++
     twin of ``_kmeans_plusplus_np``: weighted first pick, then D² sampling
     keeping the best of ``n_trials`` candidate centers per round). Returns
@@ -201,12 +202,13 @@ def kmeans_pp_batched(rng, Xn, wn, xsq, k, R, n_trials=None):
     rc = lib.kmeans_pp_batched(
         Xn.ctypes.data_as(fp), wn.ctypes.data_as(fp), xsq.ctypes.data_as(fp),
         n, m, int(k), int(R), int(n_trials),
-        int(rng.integers(0, 2**63 - 1)), out.ctypes.data_as(fp))
+        int(rng.integers(0, 2**63 - 1)), out.ctypes.data_as(fp),
+        int(n_threads))
     return out if rc == 0 else None
 
 
 def lloyd_run_batched(rng, Xn, wn, xsq, centers_stack, *, window, max_iter,
-                      tol, patience):
+                      tol, patience, n_threads=0):
     """Full lockstep multi-restart windowed Lloyd run in ONE native call —
     the C++ engine behind the host runner
     (:func:`sq_learn_tpu.models.qkmeans._native_lloyd_run_batched`, which
@@ -243,7 +245,7 @@ def lloyd_run_batched(rng, Xn, wn, xsq, centers_stack, *, window, max_iter,
         out_final.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
         inertia_tr.ctypes.data_as(fp), shift_tr.ctypes.data_as(fp),
         out_iters.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
-        ctypes.byref(out_winner), ctypes.byref(out_inertia))
+        ctypes.byref(out_winner), ctypes.byref(out_inertia), int(n_threads))
     if rc != 0:
         return None
     r_star = int(out_winner.value)

@@ -303,8 +303,27 @@ int lloyd_run_batched(const float* X, const float* sample_weight,
                       int32_t* out_labels, float* out_centers,
                       double* out_final, float* inertia_tr, float* shift_tr,
                       int64_t* out_iters, int64_t* out_winner,
-                      double* out_winner_inertia) {
+                      double* out_winner_inertia, int n_threads) {
   if (n <= 0 || m <= 0 || k <= 0 || R <= 0 || max_iter < 0) return -1;
+  const bool auto_threads = n_threads <= 0;
+  if (auto_threads) {
+    n_threads = (int)std::thread::hardware_concurrency();
+    if (n_threads <= 0) n_threads = 1;
+    // below ~4M scan ops per iteration the per-iteration thread
+    // create/join churn (the pool is not persistent) costs more than the
+    // parallelism buys — small fits stay serial in auto mode
+    if (n * R * k * (m / 8 + 1) < (int64_t)(4LL << 20)) n_threads = 1;
+  }
+  {
+    const int64_t nch = (n + 255) / 256;  // one row-chunk per thread max
+    if ((int64_t)n_threads > nch) n_threads = (int)nch;
+    // each extra thread replicates the (R*k, m) double accumulator and
+    // adds a serial reduction pass — cap the replication at ~256 MB and
+    // never let reduction work rival the scan it parallelizes
+    const int64_t repl = std::max((int64_t)1,
+                                  (int64_t)(32LL << 20) / (R * k * m));
+    if ((int64_t)n_threads > repl) n_threads = (int)repl;
+  }
 
   const int64_t km = k * m;
   std::vector<float> best_centers(C, C + R * km);  // snapshot at best it
@@ -325,6 +344,15 @@ int lloyd_run_batched(const float* X, const float* sample_weight,
     x = splitmix64(x ^ (r + 1));
     return splitmix64(x ^ row);
   };
+
+  // thread-local accumulators, allocated ONCE for the whole run (worst
+  // case A == R); zeroed per iteration only over the active prefix
+  std::vector<std::vector<double>> t_sums, t_counts, t_inertia;
+  for (int t = 1; t < n_threads; ++t) {  // thread 0 uses the main buffers
+    t_sums.emplace_back(R * km, 0.0);
+    t_counts.emplace_back(R * k, 0.0);
+    t_inertia.emplace_back(R, 0.0);
+  }
 
   // One windowed E pass of restart r at `centers`, accumulating partials
   // and inertia; shared by the iteration loop (emit=true) and the final
@@ -352,40 +380,78 @@ int lloyd_run_batched(const float* X, const float* sample_weight,
     std::fill(counts.begin(), counts.begin() + cols, 0.0);
     std::fill(inertia.begin(), inertia.begin() + A, 0.0);
 
-    for (int64_t i = 0; i < n; ++i) {
-      const float* g = G.data() + i * cols;
-      const float* x = X + i * m;
-      const double w = sample_weight ? (double)sample_weight[i] : 1.0;
-      const double xs = (double)xsq[i];
-      for (int64_t a = 0; a < A; ++a) {
-        const double* cs = csq.data() + a * k;
-        const float* ga = g + a * k;
-        double best = 1e300;
-        int32_t best_j = 0;
-        for (int64_t j = 0; j < k; ++j) {
-          const double d = cs[j] - 2.0 * (double)ga[j];
-          if (d < best) { best = d; best_j = (int32_t)j; }
-        }
-        int32_t pick = best_j;
-        if (window > 0.0 && k > 1) {
-          int64_t cnt = 0;
-          for (int64_t j = 0; j < k; ++j)
-            cnt += (cs[j] - 2.0 * (double)ga[j] <= best + window);
-          if (cnt > 1) {
-            uint64_t rr = pick_rng((uint64_t)it, (uint64_t)act[a],
-                                   (uint64_t)i) % (uint64_t)cnt;
-            for (int64_t j = 0; j < k; ++j) {
-              if (cs[j] - 2.0 * (double)ga[j] <= best + window &&
-                  rr-- == 0) { pick = (int32_t)j; break; }
+    // E-scan over rows: threaded with per-thread partial sums (the same
+    // thread-local-buffers + serial reduction shape as lloyd_iter_chunked)
+    auto scan_rows = [&](int64_t lo, int64_t hi, double* p_sums,
+                         double* p_counts, double* p_inertia) {
+      for (int64_t i = lo; i < hi; ++i) {
+        const float* g = G.data() + i * cols;
+        const float* x = X + i * m;
+        const double w = sample_weight ? (double)sample_weight[i] : 1.0;
+        const double xs = (double)xsq[i];
+        for (int64_t a = 0; a < A; ++a) {
+          const double* cs = csq.data() + a * k;
+          const float* ga = g + a * k;
+          double best = 1e300;
+          int32_t best_j = 0;
+          for (int64_t j = 0; j < k; ++j) {
+            const double d = cs[j] - 2.0 * (double)ga[j];
+            if (d < best) { best = d; best_j = (int32_t)j; }
+          }
+          int32_t pick = best_j;
+          if (window > 0.0 && k > 1) {
+            int64_t cnt = 0;
+            for (int64_t j = 0; j < k; ++j)
+              cnt += (cs[j] - 2.0 * (double)ga[j] <= best + window);
+            if (cnt > 1) {
+              uint64_t rr = pick_rng((uint64_t)it, (uint64_t)act[a],
+                                     (uint64_t)i) % (uint64_t)cnt;
+              for (int64_t j = 0; j < k; ++j) {
+                if (cs[j] - 2.0 * (double)ga[j] <= best + window &&
+                    rr-- == 0) { pick = (int32_t)j; break; }
+              }
             }
           }
+          labels[i * R + act[a]] = pick;
+          min_d2[i * R + act[a]] = (float)(best + xs);
+          double* sa = p_sums + (a * k + pick) * m;
+          for (int64_t f = 0; f < m; ++f) sa[f] += w * (double)x[f];
+          p_counts[a * k + pick] += w;
+          p_inertia[a] += w * (best + xs);
         }
-        labels[i * R + act[a]] = pick;
-        min_d2[i * R + act[a]] = (float)(best + xs);
-        double* sa = sums.data() + (a * k + pick) * m;
-        for (int64_t f = 0; f < m; ++f) sa[f] += w * (double)x[f];
-        counts[a * k + pick] += w;
-        inertia[a] += w * (best + xs);
+      }
+    };
+    if (n_threads <= 1) {
+      scan_rows(0, n, sums.data(), counts.data(), inertia.data());
+    } else {
+      const int64_t chunk = 256, n_chunks = (n + chunk - 1) / chunk;
+      std::atomic<int64_t> next{0};
+      for (auto& v : t_sums) std::fill(v.begin(), v.begin() + cols * m, 0.0);
+      for (auto& v : t_counts) std::fill(v.begin(), v.begin() + cols, 0.0);
+      for (auto& v : t_inertia) std::fill(v.begin(), v.begin() + A, 0.0);
+      auto t_buf = [&](int t) {  // thread 0 accumulates straight into main
+        return t == 0 ? std::make_tuple(sums.data(), counts.data(),
+                                        inertia.data())
+                      : std::make_tuple(t_sums[t - 1].data(),
+                                        t_counts[t - 1].data(),
+                                        t_inertia[t - 1].data());
+      };
+      std::vector<std::thread> pool;
+      for (int t = 0; t < n_threads; ++t) {
+        pool.emplace_back([&, t]() {
+          auto [ps, pc, pi] = t_buf(t);
+          for (;;) {
+            const int64_t c0 = next.fetch_add(1);
+            if (c0 >= n_chunks) break;
+            scan_rows(c0 * chunk, std::min(n, (c0 + 1) * chunk), ps, pc, pi);
+          }
+        });
+      }
+      for (auto& th : pool) th.join();
+      for (int t = 1; t < n_threads; ++t) {
+        for (int64_t e = 0; e < cols * m; ++e) sums[e] += t_sums[t - 1][e];
+        for (int64_t e = 0; e < cols; ++e) counts[e] += t_counts[t - 1][e];
+        for (int64_t a = 0; a < A; ++a) inertia[a] += t_inertia[t - 1][a];
       }
     }
 
@@ -561,13 +627,14 @@ static inline double u01(uint64_t x) {  // uniform in [0, 1)
 int kmeans_pp_batched(const float* X, const float* sample_weight,
                       const float* xsq, int64_t n, int64_t m, int64_t k,
                       int64_t R, int64_t n_trials, uint64_t seed,
-                      float* out_centers) {
+                      float* out_centers, int n_threads) {
   if (n <= 0 || m <= 0 || k <= 0 || R <= 0 || n_trials <= 0) return -1;
-  std::vector<double> cumw(n), pot(n), cum(n);
-  std::vector<float> cand_rows(n_trials * m);
-  std::vector<float> D(n * n_trials);  // X @ cand^T
-  std::vector<int64_t> cand(n_trials);
-  std::vector<double> closest(n), newc_best(n), newc(n);
+  if (n_threads <= 0) {
+    n_threads = (int)std::thread::hardware_concurrency();
+    if (n_threads <= 0) n_threads = 1;
+  }
+  if ((int64_t)n_threads > R) n_threads = (int)R;
+  std::vector<double> cumw(n);
   double wtot = 0.0;
   for (int64_t i = 0; i < n; ++i) {
     wtot += sample_weight ? (double)sample_weight[i] : 1.0;
@@ -575,7 +642,16 @@ int kmeans_pp_batched(const float* X, const float* sample_weight,
   }
   if (wtot <= 0.0) return -2;
 
-  for (int64_t r = 0; r < R; ++r) {
+  // restarts are independent streams — parallelize across them (BLAS
+  // calls from concurrent threads are safe; OpenBLAS serializes its own
+  // pool). Results are identical at any thread count: each restart's
+  // stream is keyed on (seed, r) alone.
+  auto run_restart = [&](int64_t r, std::vector<double>& cum,
+                         std::vector<float>& cand_rows, std::vector<float>& D,
+                         std::vector<int64_t>& cand,
+                         std::vector<double>& closest,
+                         std::vector<double>& newc_best,
+                         std::vector<double>& newc) {
     uint64_t st = splitmix64(seed ^ splitmix64((uint64_t)r + 0x9E37ULL));
     auto next_u01 = [&st]() {
       st = splitmix64(st);
@@ -631,6 +707,26 @@ int kmeans_pp_batched(const float* X, const float* sample_weight,
       closest.swap(newc_best);
       std::memcpy(C + c * m, X + cand[best_t] * m, sizeof(float) * m);
     }
+  };
+
+  auto worker = [&](int64_t r0, int64_t r1) {
+    std::vector<double> cum(n), closest(n), newc_best(n), newc(n);
+    std::vector<float> cand_rows(n_trials * m), D(n * n_trials);
+    std::vector<int64_t> cand(n_trials);
+    for (int64_t r = r0; r < r1; ++r)
+      run_restart(r, cum, cand_rows, D, cand, closest, newc_best, newc);
+  };
+  if (n_threads <= 1) {
+    worker(0, R);
+  } else {
+    std::vector<std::thread> pool;
+    const int64_t per = (R + n_threads - 1) / n_threads;
+    for (int t = 0; t < n_threads; ++t) {
+      const int64_t r0 = t * per, r1 = std::min(R, r0 + per);
+      if (r0 >= r1) break;
+      pool.emplace_back(worker, r0, r1);
+    }
+    for (auto& th : pool) th.join();
   }
   return 0;
 }
