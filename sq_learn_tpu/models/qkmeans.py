@@ -1466,11 +1466,24 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
                         rng, Xn, wn, xsqn, centers0, max_iter=self.max_iter,
                         tol=tol_, patience=patience)
             else:
-                labels, inertia, centers, n_iter, history = \
-                    _native_lloyd_run(
-                        rng, Xn, wn, xsqn, centers0, window=window,
-                        max_iter=self.max_iter, tol=tol_, patience=patience,
-                        use_cpp=(engine == "cpp"))
+                # beyond the lockstep footprint cap the restarts loop here,
+                # but each ONE still runs as a single native call (R=1) —
+                # per-iteration dispatch only remains for no-toolchain hosts
+                out = None
+                if engine in ("blas", "cpp"):
+                    from .. import native
+
+                    out = native.lloyd_run_batched(
+                        rng, Xn, wn, xsqn, centers0[None], window=window,
+                        max_iter=self.max_iter, tol=tol_, patience=patience)
+                if out is not None:
+                    (labels, inertia, centers, n_iter, history), _ = out
+                else:
+                    labels, inertia, centers, n_iter, history = \
+                        _native_lloyd_run(
+                            rng, Xn, wn, xsqn, centers0, window=window,
+                            max_iter=self.max_iter, tol=tol_,
+                            patience=patience, use_cpp=(engine == "cpp"))
             if self.verbose:
                 trace = history["inertia"][:n_iter]
                 for i, v in enumerate(trace):

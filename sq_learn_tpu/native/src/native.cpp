@@ -425,7 +425,6 @@ int lloyd_run_batched(const float* X, const float* sample_weight,
       scan_rows(0, n, sums.data(), counts.data(), inertia.data());
     } else {
       const int64_t chunk = 256, n_chunks = (n + chunk - 1) / chunk;
-      std::atomic<int64_t> next{0};
       for (auto& v : t_sums) std::fill(v.begin(), v.begin() + cols * m, 0.0);
       for (auto& v : t_counts) std::fill(v.begin(), v.begin() + cols, 0.0);
       for (auto& v : t_inertia) std::fill(v.begin(), v.begin() + A, 0.0);
@@ -436,15 +435,17 @@ int lloyd_run_batched(const float* X, const float* sample_weight,
                                         t_counts[t - 1].data(),
                                         t_inertia[t - 1].data());
       };
+      // STATIC strided chunk->thread assignment (not a work queue): each
+      // thread's chunk set — and therefore each accumulator's reduction
+      // order — is a pure function of (n, n_threads), keeping fits
+      // bit-reproducible at a fixed seed and thread count regardless of
+      // OS scheduling. Stride keeps the load balanced like the queue did.
       std::vector<std::thread> pool;
       for (int t = 0; t < n_threads; ++t) {
         pool.emplace_back([&, t]() {
           auto [ps, pc, pi] = t_buf(t);
-          for (;;) {
-            const int64_t c0 = next.fetch_add(1);
-            if (c0 >= n_chunks) break;
+          for (int64_t c0 = t; c0 < n_chunks; c0 += n_threads)
             scan_rows(c0 * chunk, std::min(n, (c0 + 1) * chunk), ps, pc, pi);
-          }
         });
       }
       for (auto& th : pool) th.join();
@@ -634,6 +635,15 @@ int kmeans_pp_batched(const float* X, const float* sample_weight,
     if (n_threads <= 0) n_threads = 1;
   }
   if ((int64_t)n_threads > R) n_threads = (int)R;
+  {
+    // per-worker scratch is 4 n-double vectors + (n, n_trials) floats —
+    // bound total replication at ~256 MB, as the Lloyd runner does for
+    // its accumulators
+    const int64_t per_worker = 32 * n + 4 * n * n_trials;
+    const int64_t cap = std::max(
+        (int64_t)1, (int64_t)(256LL << 20) / std::max(per_worker, (int64_t)1));
+    if ((int64_t)n_threads > cap) n_threads = (int)cap;
+  }
   std::vector<double> cumw(n);
   double wtot = 0.0;
   for (int64_t i = 0; i < n; ++i) {

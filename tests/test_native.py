@@ -344,6 +344,16 @@ class TestLloydRunBatched:
         for (f1, n1, _), (f3, n3, _) in zip(per1, per3):
             assert f1 == pytest.approx(f3, rel=1e-7)
             assert abs(n1 - n3) <= 1
+        # at a FIXED thread count, repeat runs are bit-identical: the
+        # static strided chunk assignment makes each accumulator's
+        # reduction order a pure function of (n, n_threads)
+        (lr, ir, cr, itr, _), _ = native.lloyd_run_batched(
+            np.random.default_rng(5), X, wn, xsq, stack.copy(),
+            n_threads=3, **kw)
+        np.testing.assert_array_equal(lr, l3)
+        assert float(ir) == float(i3)
+        np.testing.assert_array_equal(cr, c3)
+        assert itr == it3
 
 
 
