@@ -1395,9 +1395,13 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
 
     def _run_native(self, key, Xd, w, init, n_init, delta, mode, tol_,
                     engine):
-        """Host-side restart loop over the native engines: ``'cpp'`` (the
-        threaded fused Lloyd kernel), ``'blas'`` (sgemm Lloyd step), or
-        ``'elkan'`` (triangle-inequality-pruned classical runs)."""
+        """Host-side restart driver. With a toolchain, both ``'cpp'`` and
+        ``'blas'`` run through the one-call C++ runner
+        (:func:`sq_learn_tpu.native.lloyd_run_batched` — all restarts in
+        lockstep when the footprint cap allows, else one call per
+        restart); the engine label only changes behavior on no-toolchain
+        hosts, where ``'blas'`` falls back to numpy sgemm steps.
+        ``'elkan'`` is the triangle-inequality-pruned classical run."""
         Xn = np.ascontiguousarray(np.asarray(Xd), np.float32)
         wn = np.ascontiguousarray(np.asarray(w), np.float32)
         xsqn = (Xn**2).sum(axis=1)
@@ -1434,10 +1438,11 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
             # all restarts in lockstep — one fused (n, R·k) E+M step per
             # iteration amortizes per-step dispatch across restarts. The
             # C++ runner threads the scan and lets OpenBLAS thread the
-            # GEMMs, so it is the best engine on every host class; "cpp"
-            # (many-core) vs "blas" only matters on the serial fallback
-            # below. The k-means++ inits batch through the native engine
-            # too (restart-parallel).
+            # GEMMs, so it is the best engine on every host class; the
+            # "cpp" vs "blas" distinction only survives on no-toolchain
+            # hosts, where the serial loop below falls back to numpy
+            # sgemm steps. The k-means++ inits batch through the native
+            # engine too (restart-parallel).
             stack = None
             if isinstance(init, str) and init == "k-means++":
                 from .. import native

@@ -636,10 +636,10 @@ int kmeans_pp_batched(const float* X, const float* sample_weight,
   }
   if ((int64_t)n_threads > R) n_threads = (int)R;
   {
-    // per-worker scratch is 4 n-double vectors + (n, n_trials) floats —
-    // bound total replication at ~256 MB, as the Lloyd runner does for
-    // its accumulators
-    const int64_t per_worker = 32 * n + 4 * n * n_trials;
+    // per-worker scratch is 4 n-double vectors + the (n, n_trials) GEMM
+    // output + (n_trials, m) candidate rows — bound total replication at
+    // ~256 MB, as the Lloyd runner does for its accumulators
+    const int64_t per_worker = 32 * n + 4 * n * n_trials + 4 * n_trials * m;
     const int64_t cap = std::max(
         (int64_t)1, (int64_t)(256LL << 20) / std::max(per_worker, (int64_t)1));
     if ((int64_t)n_threads > cap) n_threads = (int)cap;

@@ -109,8 +109,21 @@ def fejer_grid_sample(key, pos, M, window, sample_shape=()):
     # Keep exactly min(2W+1, M) unique residues mod M: offsets in (−M/2, M/2].
     centered = j - base[..., None]
     valid = (centered > -M[..., None] / 2) & (centered <= M[..., None] / 2)
-    logits = jnp.where(valid, jnp.log(jnp.maximum(p, 1e-38)), -jnp.inf)
-    idx = jax.random.categorical(key, logits, shape=sample_shape + pos.shape)
+    # Inverse-CDF draw rather than jax.random.categorical: the pmf/cumsum
+    # is built ONCE per element and each of the `sample_shape` draws costs
+    # one uniform + 2W+1 compares, where Gumbel-max categorical would pay
+    # uniform+log per *candidate* per draw — on the q-means IPE E-step
+    # (n·k pairs × Q median repetitions) that is ~Q× less transcendental
+    # work for an identically-distributed sample.
+    cum = jnp.cumsum(jnp.where(valid, p, 0.0), axis=-1)
+    # u on (0, 1], not [0, 1): u == 0 would give thresh == 0 and select
+    # index 0 even when the leading window entries are masked (cum == 0),
+    # sampling a candidate the -inf-logits formulation could never emit
+    u = 1.0 - jax.random.uniform(key, sample_shape + pos.shape,
+                                 dtype=pos.dtype)
+    thresh = u * cum[..., -1]  # broadcast over sample_shape
+    idx = jnp.sum(cum < thresh[..., None], axis=-1)
+    idx = jnp.clip(idx, 0, 2 * window)
     j_sel = jnp.take_along_axis(
         jnp.broadcast_to(j, sample_shape + j.shape), idx[..., None], axis=-1
     )[..., 0]
