@@ -37,6 +37,158 @@ from ..utils import as_key, check_array, check_sample_weight
 from .qkmeans import e_step, kmeans_plusplus, tolerance
 
 
+def _host_reassign(rng, Xb, wb, centers, counts, step_idx,
+                   reassignment_ratio):
+    """NumPy twin of :func:`_random_reassign` (same cadence, selection,
+    cap, and count reset; host RNG stream). Mutates nothing; returns new
+    (centers, counts)."""
+    k, b = centers.shape[0], Xb.shape[0]
+    due = ((step_idx + 1) % (10 + int(np.floor(counts.min())))) == 0
+    if not due:
+        return centers, counts
+    low = counts < reassignment_ratio * counts.max()
+    rank = np.empty(k, np.int64)
+    rank[np.argsort(counts)] = np.arange(k)
+    low &= rank < int(0.5 * b)
+    if not low.any():
+        return centers, counts
+    p = (wb > 0).astype(np.float64)
+    npos = int(p.sum())
+    if npos <= 0:
+        return centers, counts
+    # the device twin tolerates fewer positive-weight rows than picks (its
+    # served-guard drops the weight-0 surplus); choice(replace=False)
+    # would raise instead, so cap picks at the positive-row count
+    n_pick = min(k, b, npos)
+    picks = rng.choice(b, n_pick, replace=False, p=p / npos)
+    order = np.cumsum(low) - 1
+    served = low & (order < n_pick)
+    sel = picks[np.clip(order, 0, n_pick - 1)]
+    served &= wb[sel] > 0
+    keep = counts[~low]
+    keep_min = keep.min() if keep.size else counts.max()
+    centers = np.where(served[:, None], Xb[sel], centers).astype(np.float32)
+    counts = np.where(served, keep_min, counts)
+    return centers, counts
+
+
+def _host_minibatch_fit(rng, Xn, wn, *, n_clusters, batch_size, max_iter,
+                        n_init, init, init_size, window, tol_,
+                        max_no_improvement, reassignment_ratio, verbose):
+    """The whole mini-batch fit on the host — the CPU twin of
+    ``_select_init`` + ``_fit_loop`` + :func:`_epoch_scan`, with the same
+    semantics (padded epoch shuffle, Sculley update via the fused
+    :func:`sq_learn_tpu.native.host_lloyd_step` E+M partials, per-batch
+    EWA early stop, low-count reassignment) but zero per-batch XLA
+    dispatch. Returns ``(centers, counts, n_iter, n_steps)``.
+    """
+    from .. import native
+    from .qkmeans import _kmeans_plusplus_np
+
+    n, m = Xn.shape
+    k = n_clusters
+    xsq = (Xn**2).sum(axis=1)
+    b = min(batch_size, n)
+    n_batches = -(-n // b)
+    pad = n_batches * b - n
+    idx_all = np.arange(n_batches * b) % n  # padded index block
+    wp_pad = np.concatenate([wn, np.zeros(pad, np.float32)]) if pad else wn
+
+    def make_candidate(rows_idx):
+        Xs = Xn[rows_idx]
+        ws = wn[rows_idx]
+        xs = xsq[rows_idx]
+        if hasattr(init, "__array__"):
+            return np.ascontiguousarray(np.asarray(init), np.float32)
+        if init == "random":
+            # uniform draw, like the device _init_state (no weighting)
+            ridx = rng.choice(len(Xs), k, replace=False)
+            return Xs[ridx]
+        stack = native.kmeans_pp_batched(rng, Xs, ws, xs, k, 1)
+        if stack is not None:
+            return stack[0]
+        return _kmeans_plusplus_np(
+            np.random.default_rng(int(rng.integers(0, 2**63 - 1))),
+            Xs, xs, k, ws)
+
+    def step(Xb, wb, xsqb, centers, counts, step_idx):
+        labels, _, sums, bcounts, inertia = native.host_lloyd_step(
+            rng, Xb, wb, xsqb, centers, window)
+        new_counts = counts + bcounts
+        safe = np.where(new_counts > 0, new_counts, 1.0)
+        upd = (sums - bcounts[:, None] * centers) / safe[:, None]
+        centers = np.where((bcounts > 0)[:, None], centers + upd,
+                           centers).astype(np.float32)
+        if reassignment_ratio > 0:
+            centers, new_counts = _host_reassign(
+                rng, Xb, wb, centers, new_counts, step_idx,
+                reassignment_ratio)
+        return centers, new_counts, float(inertia)
+
+    # -- init selection (upstream MiniBatchKMeans.fit semantics) --
+    if n_init == 1:
+        centers = make_candidate(np.arange(n))
+        counts = np.zeros(k, np.float64)
+    else:
+        isize = init_size
+        vidx = rng.integers(0, n, isize)
+        Xv, wv, xv = Xn[vidx], wn[vidx], xsq[vidx]
+        best = None
+        for _ in range(n_init):
+            sidx = rng.integers(0, n, isize)
+            cand = make_candidate(sidx)
+            # the scoring step only produces the inertia; the winner enters
+            # the streaming run as the PRE-step candidate with zero counts,
+            # exactly like the device _select_init
+            _, _, inertia = step(Xv, wv, xv, cand, np.zeros(k, np.float64),
+                                 0)
+            if best is None or inertia < best[0]:
+                best = (inertia, cand)
+            if verbose:
+                print(f"init candidate inertia {inertia:.3f}")
+        centers = best[1]
+        counts = np.zeros(k, np.float64)
+
+    # -- epochs with EWA early stop (the _fit_loop logic verbatim) --
+    ewa = None
+    alpha = 2.0 * b / (n + 1)
+    no_improve = 0
+    best_ewa = np.inf
+    prev_centers = None
+    it = 0
+    step_idx = 0
+    for epoch in range(max_iter):
+        perm = rng.permutation(n_batches * b)
+        stop = False
+        for bi in range(n_batches):
+            rows = idx_all[perm[bi * b:(bi + 1) * b]]
+            wb = wp_pad[perm[bi * b:(bi + 1) * b]]
+            centers, counts, inertia = step(
+                Xn[rows], wb, xsq[rows], centers, counts, step_idx)
+            step_idx += 1
+            ewa = (inertia if ewa is None
+                   else ewa * (1 - alpha) + inertia * alpha)
+            if ewa < best_ewa - 1e-12:
+                best_ewa = ewa
+                no_improve = 0
+            else:
+                no_improve += 1
+        it = epoch + 1
+        if verbose:
+            print(f"MiniBatch epoch {it}: ewa inertia {float(ewa):.3f}")
+        if (max_no_improvement is not None
+                and no_improve >= max_no_improvement):
+            stop = True
+        if prev_centers is not None and tol_ > 0:
+            shift = float(((centers - prev_centers) ** 2).sum())
+            if shift <= tol_:
+                stop = True
+        prev_centers = centers.copy()
+        if stop:
+            break
+    return centers, counts, it, step_idx
+
+
 def _random_reassign(key, Xb, wb, centers, counts, step_idx,
                      reassignment_ratio):
     """Low-count center reassignment (reference ``_mini_batch_step``,
@@ -249,9 +401,6 @@ class MiniBatchQKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         key = as_key(self.random_state)
         tol_ = tolerance(X, self.tol)
 
-        # ONE host->device upload for the whole fit (init selection and
-        # every epoch run on the device copy)
-        Xp, wp, b = self._padded_rows(X, sample_weight)
         # sklearn 1.4 n_init='auto': 1 for k-means++/array inits (D²
         # sampling makes restarts near-redundant), 3 otherwise; same
         # validation contract as QKMeans for anything else
@@ -264,6 +413,20 @@ class MiniBatchQKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
             raise ValueError(
                 f"n_init should be 'auto' or > 0, got {self.n_init} "
                 f"instead.")
+
+        # CPU backend: the expressible error models (classic / δ-means)
+        # run the whole streaming fit on the host — fused BLAS E+M steps,
+        # native k-means++ inits, zero per-batch XLA dispatch (the same
+        # dispatch-overhead reasoning as QKMeans' native route)
+        from .qkmeans import QKMeans as _QK
+
+        if mode in ("classic", "delta") and _QK._on_cpu_backend():
+            return self._fit_host(key, X, sample_weight, n_init, delta,
+                                  mode, tol_)
+
+        # ONE host->device upload for the whole fit (init selection and
+        # every epoch run on the device copy)
+        Xp, wp, b = self._padded_rows(X, sample_weight)
         key, kf = jax.random.split(key)
         centers, counts = self._select_init(key, Xp, wp, b, X.shape[0],
                                             n_init, delta, mode)
@@ -281,6 +444,72 @@ class MiniBatchQKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
             self.labels_ = labels
             self.inertia_ = inertia
         return self
+
+    def _fit_host(self, key, X, sample_weight, n_init, delta, mode, tol_):
+        """CPU fast path: the full streaming fit via
+        :func:`_host_minibatch_fit` (semantics twin of the device path;
+        pinned against it by tests)."""
+        from .. import native
+
+        Xn = np.ascontiguousarray(X, np.float32)
+        wn = np.ascontiguousarray(sample_weight, np.float32)
+        rng = np.random.default_rng(
+            np.asarray(jax.random.key_data(key), np.uint32).tolist())
+        n = Xn.shape[0]
+        b = min(self.batch_size, n)
+        if hasattr(self.init, "__array__"):
+            arr = np.asarray(self.init)
+            if arr.shape != (self.n_clusters, Xn.shape[1]):
+                raise ValueError(
+                    f"init centers shape {arr.shape} != "
+                    f"({self.n_clusters}, {Xn.shape[1]})")
+            if n_init > 1:
+                warnings.warn(
+                    "Explicit initial center position passed: performing "
+                    "only one init of the restart loop.", RuntimeWarning)
+                n_init = 1
+        # init_size only exists for multi-candidate selection — the device
+        # _select_init returns before validating it when n_init == 1
+        init_size = (self._resolve_init_size(b, n) if n_init > 1
+                     else self.n_clusters)
+        window = delta if mode == "delta" else 0.0
+        centers, counts, n_iter, n_steps = _host_minibatch_fit(
+            rng, Xn, wn, n_clusters=self.n_clusters,
+            batch_size=self.batch_size, max_iter=self.max_iter,
+            n_init=n_init, init=self.init, init_size=init_size,
+            window=window, tol_=float(tol_),
+            max_no_improvement=self.max_no_improvement,
+            reassignment_ratio=float(self.reassignment_ratio),
+            verbose=self.verbose)
+        self.cluster_centers_ = np.asarray(centers, np.float32)
+        self.counts_ = np.asarray(counts, np.float32)
+        self.n_iter_ = int(n_iter)
+        self.n_steps_ = int(n_steps)
+        if self.compute_labels:
+            # deterministic argmin, exactly like the device _full_assign
+            # (labels_ must agree with predict(); the δ-window noise is a
+            # TRAINING-step model, not an inference one)
+            xsq = (Xn**2).sum(axis=1)
+            labels, _, _, _, inertia = native.host_lloyd_step(
+                rng, Xn, wn, xsq, self.cluster_centers_, 0.0, e_only=True)
+            self.labels_ = np.asarray(labels)
+            self.inertia_ = float(inertia)
+        return self
+
+    def _resolve_init_size(self, b, n):
+        """Upstream init_size resolution (default 3·batch_size; values
+        below n_clusters warn and fall back to 3·n_clusters; clamp to
+        [n_clusters, n]). One definition for the device and host paths."""
+        init_size = self.init_size
+        if init_size is None:
+            init_size = 3 * b
+        elif init_size < self.n_clusters:
+            warnings.warn(
+                f"init_size={init_size} should be larger than "
+                f"n_clusters={self.n_clusters}; setting it to "
+                f"min(3*n_clusters, n_samples)", RuntimeWarning)
+            init_size = 3 * self.n_clusters
+        return int(min(max(init_size, self.n_clusters), n))
 
     def _select_init(self, key, Xp, wp, b, n, n_init, delta, mode):
         """Reference init selection (upstream ``MiniBatchKMeans.fit``, the
@@ -306,17 +535,7 @@ class MiniBatchQKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
             # by construction)
             key, ki = jax.random.split(key)
             return self._init_state(ki, Xp, wp, n)
-        init_size = self.init_size
-        if init_size is None:
-            init_size = 3 * b
-        elif init_size < self.n_clusters:
-            # upstream convention: warn and fall back to 3·n_clusters
-            warnings.warn(
-                f"init_size={init_size} should be larger than "
-                f"n_clusters={self.n_clusters}; setting it to "
-                f"min(3*n_clusters, n_samples)", RuntimeWarning)
-            init_size = 3 * self.n_clusters
-        init_size = int(min(max(init_size, self.n_clusters), n))
+        init_size = self._resolve_init_size(b, n)
         key, kv = jax.random.split(key)
         # upstream draws validation rows with replacement (randint); padded
         # rows (index ≥ n) are never drawn

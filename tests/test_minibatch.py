@@ -216,3 +216,54 @@ def test_partial_fit_feature_mismatch_rejected():
     # state untouched by the rejected call
     assert est.n_features_in_ == 5
     assert est.cluster_centers_.shape == (2, 5)
+
+
+def test_host_and_device_paths_agree(monkeypatch):
+    """The CPU host fast path and the scanned XLA path are semantics
+    twins: same init-selection shape, Sculley updates, EWA stopping, and
+    reassignment schedule — different RNG streams, so compare clustering
+    quality, not bits."""
+    from sq_learn_tpu.models.qkmeans import QKMeans as _QK
+
+    X, y = make_blobs(n_samples=600, centers=4, n_features=6,
+                      cluster_std=0.7, random_state=3)
+    X = X.astype(np.float32)
+    host = MiniBatchQKMeans(n_clusters=4, random_state=0, batch_size=128,
+                            n_init=3).fit(X)
+    monkeypatch.setattr(_QK, "_on_cpu_backend", staticmethod(lambda: False))
+    dev = MiniBatchQKMeans(n_clusters=4, random_state=0, batch_size=128,
+                           n_init=3).fit(X)
+    assert np.isfinite(host.inertia_) and np.isfinite(dev.inertia_)
+    # both converge to the same well-separated clustering
+    assert adjusted_rand_score(host.labels_, y) > 0.95
+    assert adjusted_rand_score(dev.labels_, y) > 0.95
+    assert host.inertia_ == pytest.approx(dev.inertia_, rel=0.1)
+    assert host.cluster_centers_.shape == dev.cluster_centers_.shape
+    # host path reports the same bookkeeping surface
+    assert host.n_steps_ >= host.n_iter_ >= 1
+
+
+def test_host_path_delta_mode_and_reassignment():
+    """δ-means label noise and low-count reassignment run inside the host
+    engine: a fit with a tiny reassignment_ratio and δ>0 must stay finite
+    and keep every cluster populated on well-separated data."""
+    X, y = make_blobs(n_samples=400, centers=4, n_features=5,
+                      cluster_std=0.5, random_state=1)
+    X = X.astype(np.float32)
+    est = MiniBatchQKMeans(n_clusters=4, random_state=2, delta=0.5,
+                           batch_size=100, reassignment_ratio=0.05).fit(X)
+    assert np.isfinite(est.inertia_)
+    assert len(np.unique(est.labels_)) == 4
+    assert adjusted_rand_score(est.labels_, y) > 0.9
+
+
+def test_labels_agree_with_predict_in_delta_mode():
+    """labels_ is an inference artifact: deterministic argmin under the
+    final centers, identical to predict(X) — the δ-window noise perturbs
+    TRAINING assignments only (device `_full_assign` contract)."""
+    X, _ = make_blobs(n_samples=300, centers=3, n_features=4,
+                      cluster_std=0.6, random_state=5)
+    X = X.astype(np.float32)
+    est = MiniBatchQKMeans(n_clusters=3, random_state=0, delta=0.5,
+                           batch_size=64).fit(X)
+    np.testing.assert_array_equal(est.labels_, est.predict(X))
