@@ -72,6 +72,41 @@ def knn_indices(X_train, X_query, k, block=4096, compute_dtype=None):
     return (idx.reshape(-1, k)[:nq], d2.reshape(-1, k)[:nq])
 
 
+def _host_knn(Xtr, xsq_tr, Xq, k):
+    """Host twin of :func:`knn_indices` (exact path). Preferred engine:
+    the native blocked argkmin (chunked sgemm + bounded heap — the
+    (n_q, n_tr) matrix never materializes); fallback: one numpy sgemm
+    block + per-row ``argpartition``. Ties order by engine internals
+    rather than ``lax.top_k``'s index order — the same freedom sklearn's
+    trees have."""
+    from .. import native
+
+    xsq_q = (Xq**2).sum(axis=1)
+    out = native.argkmin(Xtr, xsq_tr, Xq, xsq_q, k)
+    if out is not None:
+        return out
+    # numpy fallback: block over queries so the (n_q, n_tr) matrix never
+    # fully materializes (the same discipline as the engines on either
+    # side of this path)
+    block = max(1, (1 << 24) // max(Xtr.shape[0], 1))
+    idx_out = np.empty((Xq.shape[0], k), np.int64)
+    d2_out = np.empty((Xq.shape[0], k), np.float32)
+    for q0 in range(0, Xq.shape[0], block):
+        q1 = min(Xq.shape[0], q0 + block)
+        d2 = np.maximum(
+            xsq_q[q0:q1, None] + xsq_tr[None, :]
+            - 2.0 * (Xq[q0:q1] @ Xtr.T), 0.0)
+        if k < d2.shape[1]:
+            part = np.argpartition(d2, k - 1, axis=1)[:, :k]
+        else:
+            part = np.broadcast_to(np.arange(d2.shape[1]), d2.shape)
+        pd = np.take_along_axis(d2, part, 1)
+        order = np.argsort(pd, axis=1, kind="stable")
+        idx_out[q0:q1] = np.take_along_axis(part, order, 1)
+        d2_out[q0:q1] = np.take_along_axis(pd, order, 1)
+    return idx_out, d2_out
+
+
 class KNeighborsClassifier(ClassifierMixin, BaseEstimator):
     """Brute-force KNN classifier (API surface of the reference's
     ``neighbors/_classification.py`` used by the MNIST pipeline).
@@ -98,7 +133,34 @@ class KNeighborsClassifier(ClassifierMixin, BaseEstimator):
         self.y_fit_ = jnp.asarray(y_enc.astype(np.int32))
         self.n_samples_fit_ = len(X)
         self.n_features_in_ = X.shape[1]
+        # host copies for the CPU fast path (tiny relative to the model)
+        self._X_np = np.ascontiguousarray(X, np.float32)
+        self._xsq_np = (self._X_np**2).sum(axis=1)
+        self._y_np = y_enc.astype(np.int32)
         return self
+
+    def _host_search(self, X, k):
+        """(idx, d2) via the host sgemm path when it applies (CPU backend,
+        exact precision), else None. The ~ms XLA dispatch overhead
+        dominates small CV-fold predicts on the CPU backend; the numpy
+        path removes it (same exact-GEMM semantics)."""
+        from .qkmeans import QKMeans as _QK
+
+        if self.compute_dtype is not None or not _QK._on_cpu_backend():
+            return None
+        if jnp.asarray(self.X_fit_).dtype != jnp.float32:
+            # x64-configured fits stay on the jax path — the host copies
+            # are float32 and would silently drop the requested precision
+            return None
+        if not hasattr(self, "_X_np"):
+            # checkpoint-restored models carry only public fitted state
+            # (utils/checkpoint.py contract) — rebuild the host copies
+            self._X_np = np.ascontiguousarray(np.asarray(self.X_fit_),
+                                              np.float32)
+            self._xsq_np = (self._X_np**2).sum(axis=1)
+            self._y_np = np.asarray(self.y_fit_, np.int32)
+        return _host_knn(self._X_np, self._xsq_np,
+                         np.ascontiguousarray(X, np.float32), k)
 
     def _check_k(self, k):
         """Validate a neighbor count before it reaches ``lax.top_k``
@@ -121,8 +183,12 @@ class KNeighborsClassifier(ClassifierMixin, BaseEstimator):
         check_is_fitted(self, "n_samples_fit_")
         X = check_n_features(self, check_array(X))
         k = self._check_k(n_neighbors)
-        idx, d2 = knn_indices(self.X_fit_, jnp.asarray(X), k,
-                              compute_dtype=self.compute_dtype)
+        host = self._host_search(X, k)
+        if host is not None:
+            idx, d2 = host
+        else:
+            idx, d2 = knn_indices(self.X_fit_, jnp.asarray(X), k,
+                                  compute_dtype=self.compute_dtype)
         if return_distance:
             return np.sqrt(np.asarray(d2)), np.asarray(idx)
         return np.asarray(idx)
@@ -131,11 +197,25 @@ class KNeighborsClassifier(ClassifierMixin, BaseEstimator):
     def predict_proba(self, X):
         check_is_fitted(self, "n_samples_fit_")
         X = check_n_features(self, check_array(X))
-        idx, d2 = knn_indices(self.X_fit_, jnp.asarray(X),
-                              self._check_k(self.n_neighbors),
+        k = self._check_k(self.n_neighbors)
+        n_classes = len(self.classes_)
+        host = self._host_search(X, k)
+        if host is not None:
+            idx, d2 = host
+            votes = self._y_np[idx]                         # (n, k)
+            if self.weights == "distance":
+                wts = 1.0 / np.maximum(np.sqrt(d2), 1e-12)
+            else:
+                wts = np.ones_like(d2)
+            n = len(votes)
+            rows = np.repeat(np.arange(n), k)
+            counts = np.bincount(
+                rows * n_classes + votes.ravel(), weights=wts.ravel(),
+                minlength=n * n_classes).reshape(n, n_classes)
+            return counts / counts.sum(axis=1, keepdims=True)
+        idx, d2 = knn_indices(self.X_fit_, jnp.asarray(X), k,
                               compute_dtype=self.compute_dtype)
         votes = self.y_fit_[idx]  # (n, k)
-        n_classes = len(self.classes_)
         onehot = jax.nn.one_hot(votes, n_classes)
         if self.weights == "distance":
             w = 1.0 / jnp.maximum(jnp.sqrt(d2), 1e-12)

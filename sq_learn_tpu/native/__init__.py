@@ -102,6 +102,13 @@ def _load():
             ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int64),
             ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_double),
             ctypes.c_int]
+        lib.argkmin.restype = ctypes.c_int
+        lib.argkmin.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int]
         lib.kmeans_pp_batched.restype = ctypes.c_int
         lib.kmeans_pp_batched.argtypes = [
             ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
@@ -180,6 +187,33 @@ def _register_blas(lib):
 def native_available():
     """True when the C++ library compiled and loaded."""
     return _load() is not None
+
+
+def argkmin(Xtr, xsq_tr, Xq, xsq_q, k, n_threads=0):
+    """k nearest training rows per query — blocked sgemm + per-row bounded
+    max-heap (the reference's neighbor-kernel role,
+    ``neighbors/_ball_tree.pyx``/``_kd_tree.pyx``; brute-force is the
+    TPU-era equivalent, SURVEY §2.2). Returns ``(idx int64 (n_q, k),
+    d2 float32 (n_q, k))`` sorted by ascending distance, or None when the
+    native library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    Xtr = np.ascontiguousarray(Xtr, np.float32)
+    Xq = np.ascontiguousarray(Xq, np.float32)
+    xsq_tr = np.ascontiguousarray(xsq_tr, np.float32)
+    xsq_q = np.ascontiguousarray(xsq_q, np.float32)
+    n_q = Xq.shape[0]
+    idx = np.empty((n_q, int(k)), np.int64)
+    d2 = np.empty((n_q, int(k)), np.float32)
+    fp = ctypes.POINTER(ctypes.c_float)
+    rc = lib.argkmin(
+        Xtr.ctypes.data_as(fp), xsq_tr.ctypes.data_as(fp),
+        Xq.ctypes.data_as(fp), xsq_q.ctypes.data_as(fp),
+        Xtr.shape[0], n_q, Xtr.shape[1], int(k),
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        d2.ctypes.data_as(fp), int(n_threads))
+    return (idx, d2) if rc == 0 else None
 
 
 def kmeans_pp_batched(rng, Xn, wn, xsq, k, R, n_trials=None, n_threads=0):
@@ -715,5 +749,6 @@ def _stream_batches(path, batch_rows, delimiter, skip_header, n_cols):
 
 
 __all__ = ["native_available", "lloyd_iter", "elkan_iter",
-           "lloyd_run_batched", "kmeans_pp_batched", "murmurhash3_32",
-           "murmurhash3_bulk", "csv_read_floats", "csv_stream_batches"]
+           "lloyd_run_batched", "kmeans_pp_batched", "argkmin",
+           "murmurhash3_32", "murmurhash3_bulk", "csv_read_floats",
+           "csv_stream_batches"]

@@ -612,6 +612,104 @@ int lloyd_run_batched(const float* X, const float* sample_weight,
 }
 
 // ---------------------------------------------------------------------------
+// ArgKmin — k nearest training rows per query (brute force, chunked)
+// ---------------------------------------------------------------------------
+
+// The role of the reference's tree/brute neighbor kernels
+// (neighbors/_ball_tree.pyx, _kd_tree.pyx; sklearn's chunked ArgKmin):
+// blocked ‖c‖²−2x·c GEMM with a per-row bounded max-heap of size k, so the
+// (n_q, n_tr) distance matrix never materializes. Returns indices sorted by
+// ascending exact distance (+ xsq_q added at the end; ties keep the
+// lower train index). Threads stride over query chunks (deterministic).
+int argkmin(const float* Xtr, const float* xsq_tr, const float* Xq,
+            const float* xsq_q, int64_t n_tr, int64_t n_q, int64_t m,
+            int64_t k, int64_t* out_idx, float* out_d2, int n_threads) {
+  if (n_tr <= 0 || n_q <= 0 || m <= 0 || k <= 0 || k > n_tr) return -1;
+  if (n_threads <= 0) {
+    n_threads = (int)std::thread::hardware_concurrency();
+    if (n_threads <= 0) n_threads = 1;
+  }
+  const int64_t QB = 128, TB = 4096;
+  const int64_t n_chunks = (n_q + QB - 1) / QB;
+  if ((int64_t)n_threads > n_chunks) n_threads = (int)n_chunks;
+
+  auto worker = [&](int tid) {
+    std::vector<float> G(QB * TB);
+    // heap entries per in-chunk row: (d2 w/o xsq_q, train idx)
+    std::vector<double> hd(QB * k);
+    std::vector<int64_t> hi(QB * k);
+    for (int64_t c0 = tid; c0 < n_chunks; c0 += n_threads) {
+      const int64_t q0 = c0 * QB, q1 = std::min(n_q, q0 + QB);
+      const int64_t nq = q1 - q0;
+      std::fill(hd.begin(), hd.begin() + nq * k, 1e300);
+      std::fill(hi.begin(), hi.begin() + nq * k, (int64_t)-1);
+      for (int64_t t0 = 0; t0 < n_tr; t0 += TB) {
+        const int64_t t1 = std::min(n_tr, t0 + TB);
+        const int64_t nt = t1 - t0;
+        gemm_nt(Xq + q0 * m, Xtr + t0 * m, G.data(), nq, nt, m);
+        for (int64_t i = 0; i < nq; ++i) {
+          double* h = hd.data() + i * k;
+          int64_t* hx = hi.data() + i * k;
+          const float* g = G.data() + i * nt;
+          for (int64_t j = 0; j < nt; ++j) {
+            const double d = (double)xsq_tr[t0 + j] - 2.0 * (double)g[j];
+            if (d >= h[0]) continue;  // h[0] is the current k-th smallest
+            // Replace the heap max with the new entry and sift it down.
+            // The heap orders by (d, idx) LEXICOGRAPHICALLY — among tied
+            // distances the largest index sits closest to the root and is
+            // evicted first — so the kept set is exactly the k smallest
+            // (d, idx) pairs: stable-argsort tie semantics. (Candidates
+            // arrive in ascending index order, so `d >= h[0]` is already
+            // the correct lexicographic eviction test.)
+            h[0] = d;
+            hx[0] = t0 + j;
+            int64_t pos = 0;
+            auto lex_gt = [&](int64_t a, int64_t bb) {
+              return h[a] > h[bb] || (h[a] == h[bb] && hx[a] > hx[bb]);
+            };
+            for (;;) {
+              const int64_t l = 2 * pos + 1, r = l + 1;
+              int64_t big = pos;
+              if (l < k && lex_gt(l, big)) big = l;
+              if (r < k && lex_gt(r, big)) big = r;
+              if (big == pos) break;
+              std::swap(h[pos], h[big]);
+              std::swap(hx[pos], hx[big]);
+              pos = big;
+            }
+          }
+        }
+      }
+      // heap -> ascending order; ties by lower train index
+      std::vector<int64_t> ord(k);
+      for (int64_t i = 0; i < nq; ++i) {
+        double* h = hd.data() + i * k;
+        int64_t* hx = hi.data() + i * k;
+        for (int64_t e = 0; e < k; ++e) ord[e] = e;
+        std::sort(ord.begin(), ord.end(), [&](int64_t a, int64_t b) {
+          if (h[a] != h[b]) return h[a] < h[b];
+          return hx[a] < hx[b];
+        });
+        const double xq = (double)xsq_q[q0 + i];
+        for (int64_t e = 0; e < k; ++e) {
+          out_idx[(q0 + i) * k + e] = hx[ord[e]];
+          out_d2[(q0 + i) * k + e] =
+              (float)std::max(0.0, h[ord[e]] + xq);
+        }
+      }
+    }
+  };
+  if (n_threads <= 1) {
+    worker(0);
+  } else {
+    std::vector<std::thread> pool;
+    for (int t = 0; t < n_threads; ++t) pool.emplace_back(worker, t);
+    for (auto& th : pool) th.join();
+  }
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
 // Batched greedy k-means++ init (D² sampling, best-of-n_trials)
 // ---------------------------------------------------------------------------
 

@@ -428,3 +428,58 @@ def test_blas_sgemm_registered_when_scipy_present():
     assert native._load().has_sgemm() == 1, (
         "scipy is importable but scipy_cblas_sgemm was not registered — "
         "check _register_blas against the installed scipy.libs layout")
+
+
+def test_argkmin_matches_bruteforce():
+    """Blocked-heap argkmin vs a direct numpy brute force: distances agree
+    everywhere; indices agree wherever the neighbor gap exceeds float32
+    GEMM accumulation noise (different BLAS orderings may legitimately
+    swap near-ties); exact duplicate rows pin the stable lowest-index tie
+    rule."""
+    if not native.native_available():
+        pytest.skip("no native toolchain")
+    rng = np.random.default_rng(17)
+    Xtr = rng.normal(0, 1, (5000, 12)).astype(np.float32)
+    Xq = rng.normal(0, 1, (257, 12)).astype(np.float32)
+    xsq_tr = (Xtr**2).sum(axis=1)
+    xsq_q = (Xq**2).sum(axis=1)
+    full = np.maximum(
+        xsq_q[:, None].astype(np.float64) + xsq_tr[None, :]
+        - 2.0 * (Xq.astype(np.float64) @ Xtr.T.astype(np.float64)), 0.0)
+    order = np.argsort(full, axis=1, kind="stable")
+    for k in (1, 7, 64):
+        idx, d2 = native.argkmin(Xtr, xsq_tr, Xq, xsq_q, k)
+        ref_idx = order[:, :k]
+        ref_d2 = np.take_along_axis(full, ref_idx, 1)
+        np.testing.assert_allclose(d2, ref_d2, rtol=1e-4, atol=1e-4)
+        # returned pairs are self-consistent: d2 really is the distance
+        # of the returned index
+        np.testing.assert_allclose(
+            np.take_along_axis(full, idx, 1), d2, rtol=1e-4, atol=1e-4)
+        # distances come back ascending
+        assert (np.diff(d2, axis=1) >= -1e-6).all()
+        # where the k-boundary gap is clear, the neighbor SET matches
+        # exactly (positions of near-equal internal neighbors may
+        # legitimately swap between BLAS accumulation orders)
+        clear = (np.take_along_axis(full, order[:, k:k + 1], 1)
+                 - ref_d2[:, -1:] > 1e-3).ravel()
+        np.testing.assert_array_equal(np.sort(idx[clear], axis=1),
+                                      np.sort(ref_idx[clear], axis=1))
+
+
+def test_argkmin_stable_tie_order():
+    """Exact duplicate training rows: the kept/returned indices are the
+    LOWEST among the tied rows, in ascending order (the lexicographic
+    (d, idx) heap contract)."""
+    if not native.native_available():
+        pytest.skip("no native toolchain")
+    base = np.array([[0.0, 0.0], [3.0, 0.0], [5.0, 0.0]], np.float32)
+    # rows 0-2 distinct, rows 3-5 duplicate row 0, row 6 duplicates row 1
+    Xtr = np.vstack([base, base[:1], base[:1], base[:1], base[1:2]])
+    Xq = np.zeros((1, 2), np.float32)
+    xsq_tr = (Xtr**2).sum(axis=1)
+    xsq_q = (Xq**2).sum(axis=1)
+    idx, d2 = native.argkmin(Xtr, xsq_tr, Xq, xsq_q, 5)
+    # four zero-distance duplicates (0,3,4,5), then the nearer of {1,6}
+    np.testing.assert_array_equal(idx[0], [0, 3, 4, 5, 1])
+    np.testing.assert_allclose(d2[0], [0, 0, 0, 0, 9.0], atol=1e-5)
