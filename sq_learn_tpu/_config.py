@@ -144,6 +144,17 @@ def resolve_device():
     return pool[i]
 
 
+def on_cpu_backend():
+    """True when computation runs on the host CPU — either because it is
+    the default backend or because a ``set_config(device='cpu...')`` pin
+    is active. The one predicate behind every host-fast-path dispatch
+    decision (estimators re-export it as ``_on_cpu_backend``)."""
+    import jax
+
+    return (jax.default_backend() == "cpu"
+            or _get_threadlocal_config()["device"].startswith("cpu"))
+
+
 def device_scope():
     """Context manager scoping computation to the configured device.
 

@@ -144,9 +144,9 @@ class KNeighborsClassifier(ClassifierMixin, BaseEstimator):
         exact precision), else None. The ~ms XLA dispatch overhead
         dominates small CV-fold predicts on the CPU backend; the numpy
         path removes it (same exact-GEMM semantics)."""
-        from .qkmeans import QKMeans as _QK
+        from .._config import on_cpu_backend
 
-        if self.compute_dtype is not None or not _QK._on_cpu_backend():
+        if self.compute_dtype is not None or not on_cpu_backend():
             return None
         if jnp.asarray(self.X_fit_).dtype != jnp.float32:
             # x64-configured fits stay on the jax path — the host copies

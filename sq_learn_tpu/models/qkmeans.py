@@ -1178,11 +1178,10 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
     def _on_cpu_backend():
         """True when fits run on the host CPU — either the default backend
         or a set_config(device='cpu...') pin. One predicate for every
-        dispatch decision."""
-        from .._config import _get_threadlocal_config
+        dispatch decision (defined in :mod:`sq_learn_tpu._config`)."""
+        from .._config import on_cpu_backend
 
-        return (jax.default_backend() == "cpu"
-                or _get_threadlocal_config()["device"].startswith("cpu"))
+        return on_cpu_backend()
 
     def _fused_fit_ok(self):
         """The one-dispatch path covers the common accelerator fit: string

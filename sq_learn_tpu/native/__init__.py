@@ -204,6 +204,13 @@ def argkmin(Xtr, xsq_tr, Xq, xsq_q, k, n_threads=0):
     xsq_tr = np.ascontiguousarray(xsq_tr, np.float32)
     xsq_q = np.ascontiguousarray(xsq_q, np.float32)
     n_q = Xq.shape[0]
+    # the C++ side cannot see shape mismatches — it would read past the
+    # buffers; validate the public surface here
+    if (Xtr.ndim != 2 or Xq.ndim != 2 or Xq.shape[1] != Xtr.shape[1]
+            or xsq_tr.shape != (Xtr.shape[0],) or xsq_q.shape != (n_q,)):
+        raise ValueError(
+            f"argkmin shape mismatch: Xtr {Xtr.shape}, Xq {Xq.shape}, "
+            f"xsq_tr {xsq_tr.shape}, xsq_q {xsq_q.shape}")
     idx = np.empty((n_q, int(k)), np.int64)
     d2 = np.empty((n_q, int(k)), np.float32)
     fp = ctypes.POINTER(ctypes.c_float)

@@ -79,17 +79,70 @@ def real_tomography(key, v, delta=None, N=None, norm="L2", preserve_norm=True):
     return est * scale if preserve_norm else est
 
 
+def _host_real_tomography(rng, v, N, preserve_norm):
+    """NumPy twin of :func:`_tomography_unit` + the normalization wrapper:
+    identical Algorithm 4.1 math, but counts come from numpy's C
+    multinomial (BTPE binomial splitting) — on the CPU backend XLA's
+    multinomial lowers to a per-category binomial scan that costs seconds
+    per call where numpy's takes milliseconds."""
+    import numpy as np
+
+    v = np.asarray(v, np.float64)
+    scale = float(np.linalg.norm(v))
+    unit = v / (scale if scale > 0 else 1.0)
+    d = unit.shape[0]
+    p = unit * unit
+    psum = p.sum()
+    if not np.isfinite(psum) or psum <= 0:
+        # degenerate (zero / non-finite) state: the XLA path degrades to
+        # NaNs without raising; numpy's multinomial would raise instead
+        return np.full(d, np.nan)
+    p = p / psum
+    counts = rng.multinomial(int(N), p)
+    P = np.sqrt(counts / N)
+    amps = 0.5 * np.concatenate([unit + P, unit - P])
+    p2 = amps * amps
+    s2 = p2.sum()
+    p2 = p2 / s2 if s2 > 0 else np.full(2 * d, 1.0 / (2 * d))
+    counts2 = rng.multinomial(int(N), p2)
+    sign = np.where(counts2[:d] > 0.4 * P * P * N, 1.0, -1.0)
+    est = sign * P
+    return est * scale if preserve_norm else est
+
+
 def tomography(key, A, noise, true_tomography=True, norm="L2", N=None,
                preserve_norm=True):
     """Tomography dispatcher (reference ``tomography``, ``Utility.py:107-180``).
 
     noise == 0 returns A unchanged. ``true_tomography=False`` uses the
     truncated-Gaussian fast path; otherwise exact tomography runs per row
-    (``vmap``) for 2-D input.
+    (``vmap``) for 2-D input. Eager calls on the CPU backend route
+    through the numpy twin (:func:`_host_real_tomography` — same
+    algorithm, different stream, ~100× faster multinomials there); calls
+    from inside a trace always stay on the XLA path.
     """
-    A = jnp.asarray(A)
     if float(noise) == 0.0:
-        return A
+        return jnp.asarray(A)
+    if true_tomography and not isinstance(A, jax.core.Tracer) \
+            and not isinstance(key, jax.core.Tracer):
+        from ..._config import on_cpu_backend
+
+        if on_cpu_backend():
+            import numpy as np
+
+            rng = np.random.default_rng(
+                np.asarray(jax.random.key_data(key), np.uint32).tolist())
+            An = np.asarray(A)
+            N_ = N if N is not None else tomography_n_measurements(
+                An.shape[-1], noise, norm)
+            if An.ndim == 2:
+                est = np.stack([
+                    _host_real_tomography(rng, row, N_, preserve_norm)
+                    for row in An])
+            else:
+                est = _host_real_tomography(rng, An, N_, preserve_norm)
+            return jnp.asarray(est.astype(An.dtype))
+    A = jnp.asarray(A)
     if not true_tomography:
         if A.ndim == 2:
             flat = gaussian_estimate(key, A.reshape(-1), noise)
