@@ -134,8 +134,14 @@ def e_step(key, X, weights, centers, x_sq_norms, *, delta, mode, ipe_q,
         c_sq = row_norms(centers, squared=True)
         inner = inner_product(X, centers, compute_dtype)
         key, sub = jax.random.split(key)
+        # window=16 (not the sampler default 64): the rescaled per-pair
+        # precisions make M ≫ 2W+1 for most pairs at any practical window
+        # (median M ≈ 150 at δ=0.5 on digits), truncation only ever
+        # tightens the within-ε guarantee (fejer_grid_sample docstring),
+        # and measured estimate errors are identical at W∈{16,32,64}
+        # while the E-step is 4× cheaper at 16
         est_ip = ipe_matrix(sub, inner, x_sq_norms, c_sq,
-                            epsilon=delta / 2, Q=ipe_q)
+                            epsilon=delta / 2, Q=ipe_q, window=16)
         d2 = x_sq_norms[:, None] + c_sq[None, :] - 2.0 * est_ip
         window = 0.0
     else:
