@@ -34,6 +34,7 @@ bench-smoke:
 	SQ_BENCH_SMOKE=1 $(PYTHON) -m bench.bench_qkmeans_mnist
 	SQ_BENCH_SMOKE=1 $(PYTHON) -m bench.bench_randomized_svd_covtype
 	SQ_BENCH_SMOKE=1 $(PYTHON) -m bench.bench_qkmeans_cicids_sweep
+	SQ_BENCH_SMOKE=1 $(PYTHON) -m bench.bench_estimator_surfaces
 
 # The fast example drivers (the slow ones — mnist_trial, streaming_fit —
 # are exercised manually; these three finish in ~35s total on CPU).

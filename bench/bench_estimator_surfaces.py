@@ -1,0 +1,102 @@
+"""Secondary estimator surfaces vs their scikit-learn twins: MiniBatch
+k-means, brute-force KNN, and the MnistTrial pipeline shape (PCA →
+transform → 10-fold KNN CV — the reference's own headline experiment,
+``MnistTrial.py:10-28``). Not a BASELINE config; this script makes the
+BENCH_SUITE claims for these surfaces reproducible with one command.
+
+Emits one JSON line (the KNN ratio as the headline, every surface in the
+extras). SQ_BENCH_SMOKE=1 shrinks the KNN workload to a quick check.
+"""
+
+import os
+import sys
+import warnings
+
+import numpy as np
+
+warnings.filterwarnings("ignore")
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from bench._common import emit, probe_backend, timed  # noqa: E402
+
+
+def main():
+    probe_backend()
+    from sklearn.datasets import load_digits
+
+    X = load_digits().data.astype(np.float32)
+    y = load_digits().target
+    smoke = os.environ.get("SQ_BENCH_SMOKE")
+    extras = {}
+
+    # -- MiniBatch k-means ------------------------------------------------
+    from sklearn.cluster import MiniBatchKMeans as SKMB
+
+    from sq_learn_tpu.models import MiniBatchQKMeans
+
+    t_ours, est = timed(
+        lambda: MiniBatchQKMeans(n_clusters=10, random_state=0,
+                                 n_init=3).fit(X), warmup=1, reps=3)
+    t_sk, sk = timed(
+        lambda: SKMB(n_clusters=10, random_state=0, n_init=3).fit(X),
+        warmup=1, reps=3)
+    extras["minibatch"] = {
+        "ours_s": round(t_ours, 4), "sklearn_s": round(t_sk, 4),
+        "ratio": round(t_sk / t_ours, 2),
+        "inertia_ratio": round(float(est.inertia_) / sk.inertia_, 4)}
+
+    # -- KNN predict ------------------------------------------------------
+    from sklearn.neighbors import KNeighborsClassifier as SKKNN
+
+    from sq_learn_tpu.neighbors import KNeighborsClassifier
+
+    rng = np.random.default_rng(0)
+    n_tr, n_q = (2000, 500) if smoke else (20000, 5000)
+    Xtr = rng.normal(0, 1, (n_tr, 50)).astype(np.float32)
+    ytr = rng.integers(0, 10, n_tr)
+    Xq = rng.normal(0, 1, (n_q, 50)).astype(np.float32)
+    ours = KNeighborsClassifier(n_neighbors=7).fit(Xtr, ytr)
+    sk_knn = SKKNN(n_neighbors=7).fit(Xtr, ytr)
+    t_knn, pa = timed(lambda: ours.predict(Xq), warmup=1, reps=3)
+    t_sk, pb = timed(lambda: sk_knn.predict(Xq), warmup=1, reps=3)
+    knn_ratio = t_sk / t_knn
+    extras["knn_predict"] = {
+        "shape": f"{n_tr}x50 train / {n_q} queries",
+        "ours_s": round(t_knn, 4), "sklearn_s": round(t_sk, 4),
+        "ratio": round(knn_ratio, 2),
+        "label_agreement": round(float(np.mean(pa == pb)), 4)}
+
+    # -- MnistTrial pipeline shape ---------------------------------------
+    from sklearn.decomposition import PCA as SKPCA
+    from sklearn.model_selection import StratifiedKFold as SKSKF
+    from sklearn.model_selection import cross_validate as sk_cv
+
+    from sq_learn_tpu.decomposition import qPCA
+    from sq_learn_tpu.model_selection import StratifiedKFold, cross_validate
+
+    def ours_pipeline():
+        pca = qPCA(n_components=16, random_state=0).fit(X)
+        Xt = np.asarray(pca.transform(X))
+        cv = cross_validate(KNeighborsClassifier(n_neighbors=5), Xt, y,
+                            cv=StratifiedKFold(10))
+        return float(np.mean(cv["test_score"]))
+
+    def sk_pipeline():
+        pca = SKPCA(n_components=16, random_state=0).fit(X)
+        cv = sk_cv(SKKNN(n_neighbors=5), pca.transform(X), y,
+                   cv=SKSKF(10))
+        return float(np.mean(cv["test_score"]))
+
+    t_ours, acc_ours = timed(ours_pipeline, warmup=1, reps=3)
+    t_sk, acc_sk = timed(sk_pipeline, warmup=1, reps=3)
+    extras["mnist_trial_pipeline"] = {
+        "ours_s": round(t_ours, 4), "sklearn_s": round(t_sk, 4),
+        "ratio": round(t_sk / t_ours, 2),
+        "acc_ours": round(acc_ours, 4), "acc_sklearn": round(acc_sk, 4)}
+
+    emit("knn_predict_20kx50_wallclock", t_knn, vs_baseline=knn_ratio,
+         **extras)
+
+
+if __name__ == "__main__":
+    main()
