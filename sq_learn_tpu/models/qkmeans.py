@@ -1536,13 +1536,36 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         check_is_fitted(self, "cluster_centers_")
         X = check_n_features(self, check_array(X))
         delta = 0.0 if delta is None else float(delta)
+        mode = self._mode(delta)
+        # host fast path, same gating as fit: exact-precision classic/δ
+        # inference on the CPU backend skips the XLA dispatch
+        from .._config import on_cpu_backend
+
+        if (mode in ("classic", "delta") and on_cpu_backend()
+                and self.compute_dtype is None):
+            from .. import native
+
+            Xn = np.ascontiguousarray(X, np.float32)
+            if mode == "delta":
+                # only the δ-window pick draws; classic argmin needs no RNG
+                # (building a jax key would cost more than the assignment)
+                rng = np.random.default_rng(np.asarray(
+                    jax.random.key_data(as_key(self.random_state)),
+                    np.uint32).tolist())
+            else:
+                rng = None
+            labels, _, _, _, _ = native.host_lloyd_step(
+                rng, Xn, np.ones(len(Xn), np.float32), (Xn**2).sum(axis=1),
+                np.ascontiguousarray(self.cluster_centers_, np.float32),
+                delta if mode == "delta" else 0.0, e_only=True)
+            return np.asarray(labels)
         key = as_key(self.random_state)
         Xd = as_device_array(X)
         labels, _, _ = e_step_jit(
             key, Xd, jnp.ones(X.shape[0], X.dtype),
             as_device_array(np.asarray(self.cluster_centers_, X.dtype)),
             row_norms(Xd, squared=True),
-            delta=delta, mode=self._mode(delta), ipe_q=self.ipe_q,
+            delta=delta, mode=mode, ipe_q=self.ipe_q,
             compute_dtype=self._checked_compute_dtype())
         return np.asarray(labels)
 
@@ -1566,6 +1589,19 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         check_is_fitted(self, "cluster_centers_")
         X = check_n_features(self, check_array(X))
         sample_weight = check_sample_weight(sample_weight, X)
+        from .._config import on_cpu_backend
+
+        # same gate as predict: the host path computes in float32
+        if on_cpu_backend() and self.compute_dtype is None:
+            from .. import native
+
+            Xn = np.ascontiguousarray(X, np.float32)
+            _, _, _, _, inertia = native.host_lloyd_step(
+                None, Xn, np.ascontiguousarray(sample_weight, np.float32),
+                (Xn**2).sum(axis=1),
+                np.ascontiguousarray(self.cluster_centers_, np.float32),
+                0.0, e_only=True)
+            return -float(inertia)
         d2 = pairwise_sq_distances(
             as_device_array(X),
             as_device_array(np.asarray(self.cluster_centers_, X.dtype)))
