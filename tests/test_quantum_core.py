@@ -198,3 +198,49 @@ class TestMagnitudeTomographySigned:
         out = np.asarray(magnitude_tomography_signed(
             jax.random.PRNGKey(0), v, delta=0.0))
         np.testing.assert_allclose(out, v, rtol=1e-6)
+
+
+class TestHostTomographyTwin:
+    """Eager CPU-backend tomography routes through the numpy twin
+    (`_host_real_tomography`); these pin that the twin and the XLA kernel
+    draw from the same error distribution and that traced calls stay on
+    the XLA path."""
+
+    def test_error_distribution_matches_xla(self, key):
+        from sq_learn_tpu.ops.quantum.tomography import (_tomography_unit,
+                                                         real_tomography,
+                                                         tomography)
+
+        d, delta = 64, 0.2
+        v = jnp.asarray(random_unit(7, d))
+        # host twin errors (the eager dispatcher on the CPU conftest)
+        errs_h = []
+        errs_x = []
+        for s in range(12):
+            k = jax.random.PRNGKey(100 + s)
+            errs_h.append(float(jnp.linalg.norm(tomography(k, v, delta) - v)))
+            # the jit'd unit kernel is the XLA path regardless of backend
+            import functools
+            core = jax.jit(functools.partial(
+                _tomography_unit,
+                N=tomography_n_measurements(d, delta, "L2")))
+            errs_x.append(float(jnp.linalg.norm(core(k, v) - v)))
+        # both within the delta bound, and on the same error scale
+        assert max(errs_h) <= delta and max(errs_x) <= delta
+        m_h, m_x = np.mean(errs_h), np.mean(errs_x)
+        assert 0.5 * m_x <= m_h <= 2.0 * m_x
+
+    def test_traced_calls_stay_on_xla_path(self, key):
+        from sq_learn_tpu.ops.quantum import tomography
+
+        v = jnp.asarray(random_unit(5, 16))
+        # tracing through jit must not touch the host twin (numpy would
+        # raise a TracerArrayConversionError if it did)
+        out = jax.jit(lambda k, x: tomography(k, x, 0.3))(key, v)
+        assert float(jnp.linalg.norm(out - v)) <= 0.35
+
+    def test_zero_vector_degrades_to_nan(self, key):
+        from sq_learn_tpu.ops.quantum import tomography
+
+        out = np.asarray(tomography(key, jnp.zeros(6), 0.2))
+        assert out.shape == (6,) and np.isnan(out).all()
