@@ -124,7 +124,7 @@ def fejer_grid_sample(key, pos, M, window, sample_shape=()):
     thresh = u * cum[..., -1]  # broadcast over sample_shape
     idx = jnp.sum(cum < thresh[..., None], axis=-1)
     idx = jnp.clip(idx, 0, 2 * window)
-    j_sel = jnp.take_along_axis(
-        jnp.broadcast_to(j, sample_shape + j.shape), idx[..., None], axis=-1
-    )[..., 0]
+    # the candidate grid is arithmetic (j = base + offs), so selection is
+    # too — no (sample_shape, ..., 2W+1) broadcast + gather
+    j_sel = base + (idx.astype(pos.dtype) - window)
     return jnp.mod(j_sel, M)
