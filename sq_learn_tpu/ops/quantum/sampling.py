@@ -26,7 +26,35 @@ def multinomial_counts(key, n, probs):
     Returns
     -------
     counts : (..., d) float array summing to ``n`` along the last axis.
+
+    Eager calls on the CPU backend sample through numpy's C multinomial
+    (identical distribution, host RNG stream): XLA lowers multinomial to
+    a per-category binomial scan that costs seconds per large call on
+    this backend. Traced calls always use the XLA path.
     """
+    if not any(isinstance(x, jax.core.Tracer) for x in (key, n, probs)):
+        from ..._config import on_cpu_backend
+
+        if on_cpu_backend():
+            import numpy as np
+
+            p = np.asarray(probs, np.float64)
+            psum = p.sum(axis=-1, keepdims=True)
+            ok = np.isfinite(psum) & (psum > 0)
+            # degenerate rows degrade to NaN like the XLA path (numpy's
+            # multinomial would raise); sample them with uniform pvals
+            # and overwrite
+            safe = np.where(ok, p / np.where(ok, psum, 1.0),
+                            1.0 / p.shape[-1])
+            try:
+                kd = jax.random.key_data(key)
+            except TypeError:  # legacy raw uint32 key arrays
+                kd = key
+            rng = np.random.default_rng(np.asarray(kd, np.uint32).tolist())
+            n_arr = np.broadcast_to(np.asarray(n), p.shape[:-1])
+            counts = rng.multinomial(n_arr.astype(np.int64), safe).astype(
+                jnp.asarray(probs).dtype)
+            return jnp.asarray(np.where(ok, counts, np.nan))
     probs = jnp.asarray(probs)
     probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
     n = jnp.broadcast_to(jnp.asarray(n, dtype=probs.dtype), probs.shape[:-1])
