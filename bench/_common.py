@@ -31,10 +31,14 @@ def emit(metric, value, unit="s", vs_baseline=1.0, **extra):
     }))
 
 
-def probe_backend(timeout_s=120):
+def probe_backend(timeout_s=60):
     """Initialize the configured JAX backend in a throwaway subprocess and
     fall back to the CPU backend when the accelerator tunnel is wedged
-    (same contract as the headline bench.py)."""
+    (same contract as the headline bench.py).
+
+    60 s default: a healthy tunnel answers the probe in ~5-15 s; a wedged
+    one never answers, so the timeout is pure stall — every observed
+    wedge lasted hours, making longer patience pointless."""
     import os
     import subprocess
 
