@@ -19,7 +19,7 @@ from bench._common import (emit, maybe_subsample, probe_backend,  # noqa: E402
 def main():
     probe_backend()
     import jax
-    import jax.numpy as jnp
+    from sq_learn_tpu._config import as_device_array
     from sq_learn_tpu.datasets import load_covtype
     from sq_learn_tpu.ops.linalg import randomized_svd
 
@@ -27,7 +27,9 @@ def main():
     X, y = maybe_subsample(X, y)
     n_components = 10
     key = jax.random.PRNGKey(0)
-    Xd = jnp.asarray(X)
+    # chunked upload: covtype f32 is ~125 MB, right at the relay's comfort
+    # margin (wedges observed at >=200 MB) — stream it like the MNIST configs
+    Xd = as_device_array(X)
 
     def ours_run():
         U, S, Vt = randomized_svd(key, Xd, n_components, n_iter=4)

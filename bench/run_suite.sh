@@ -9,13 +9,28 @@
 # its number (a failure that also reproduces on CPU still records only the
 # two rc markers).
 #
-# Usage: bash bench/run_suite.sh [outfile]   (default /tmp/bench_suite_run.txt)
+# Usage: bash bench/run_suite.sh [outfile]
+# Default outfile: bench/records/<UTC date-time>_<backend>.txt — IN THE REPO,
+# so every number quoted in BENCH_SUITE.md stays traceable to a committed
+# raw record (VERDICT r2 missing #4: the /tmp records of the round-2 TPU
+# windows evaporated with the host).
 set -u
 stderr_tmp="$(mktemp /tmp/bench_stderr.XXXXXX)"
 trap 'rm -f "$stderr_tmp"' EXIT
-out="${1:-/tmp/bench_suite_run.txt}"
-case "$out" in /*) ;; *) out="$(pwd)/$out" ;; esac  # resolve before the cd
 cd "$(dirname "$0")/.."
+if [ -n "${1:-}" ]; then
+  out="$1"
+  case "$out" in /*) ;; *) out="$(pwd)/$out" ;; esac
+else
+  # label the record with the backend that answers the probe (a wedged
+  # tunnel means every config will fall back to CPU anyway)
+  backend="$(timeout 60 python -c 'import jax; print(jax.default_backend())' \
+             2>/dev/null | tail -1)"
+  [ -z "$backend" ] && backend="cpu"
+  [ "$backend" = "axon" ] && backend="tpu"
+  mkdir -p bench/records
+  out="$(pwd)/bench/records/$(date -u +%Y%m%dT%H%M%SZ)_${backend}.txt"
+fi
 : > "$out"
 echo "# suite run $(date -Is)" >> "$out"
 
@@ -54,4 +69,37 @@ for cmd in "python bench.py" \
       env -u PYTHONPATH JAX_PLATFORMS=cpu $cmd
   fi
 done
+
+# BASELINE acceptance gate (BASELINE.md: within 2x of classical sklearn,
+# i.e. vs_baseline >= 0.5, on the measurement of record). This script is
+# where the bar is enforced — the unit suite only warns, since wall-clock
+# there is subject to arbitrary host load.
+# (PYTHONPATH cleared + timeout, like the retry path: the bare interpreter
+# pre-imports jax via the axon sitecustomize and would hang on a wedged
+# relay even though this step only parses JSON)
+env -u PYTHONPATH timeout 60 python - "$out" <<'PY'
+import json, sys
+fails, seen = [], 0
+for line in open(sys.argv[1]):
+    line = line.strip()
+    if not line.startswith("{"):
+        continue
+    try:
+        rec = json.loads(line)
+    except json.JSONDecodeError:
+        continue
+    if "metric" not in rec or "vs_baseline" not in rec:
+        continue
+    seen += 1
+    ok = rec["vs_baseline"] >= 0.5
+    print(f"# ACCEPT {'pass' if ok else 'FAIL'}: {rec['metric']} "
+          f"vs_baseline={rec['vs_baseline']}")
+    if not ok:
+        fails.append(rec["metric"])
+if fails or not seen:
+    sys.exit(f"acceptance gate: {fails or 'no JSON lines recorded'}")
+PY
+gate_rc=$?
+echo "# acceptance gate rc=$gate_rc" >> "$out"
 echo "done: $out"
+exit $gate_rc

@@ -6,6 +6,7 @@ extended with the ``device`` switch that BASELINE designates for TPU dispatch an
 default dtype knob (TPUs natively prefer float32/bfloat16).
 """
 
+import os
 import threading
 from contextlib import contextmanager
 
@@ -185,6 +186,66 @@ def with_device_scope(method):
     return wrapper
 
 
+#: Host→device transfers are streamed in slices no larger than this. Every
+#: observed axon-relay wedge hit during a single ≥200 MB host→device upload
+#: (never during small transfers), so keeping each relay transaction under
+#: 128 MB lets full-MNIST-sized operands (70k×784 f32 ≈ 220 MB) reach the
+#: chip as two transactions; the full array only ever exists in HBM.
+_TRANSFER_CHUNK_BYTES = int(
+    os.environ.get("SQ_TRANSFER_CHUNK_BYTES", 128 * 2 ** 20))
+
+
+def chunked_device_put(x, device=None, max_bytes=None):
+    """Place host data on ``device`` in row slices of at most ``max_bytes``.
+
+    Semantically identical to ``jax.device_put(np.asarray(x), device)``
+    (dtype canonicalization included), but a large host array crosses the
+    host→device link as several independent transfers that are assembled
+    in device memory — dodging the accelerator-relay hazard documented in
+    CLAUDE.md where one oversized upload wedges the tunnel.
+
+    With the default ``max_bytes`` the slicing only engages for non-CPU
+    targets (host→host copies can't wedge a relay and the extra
+    concatenate would be pure overhead); passing ``max_bytes`` explicitly
+    forces slicing on any backend, which is how the CPU-backend tests
+    exercise the assembly path.
+    """
+    import jax
+    import numpy as np
+    import jax.numpy as jnp
+
+    explicit = max_bytes is not None
+    if max_bytes is None:
+        max_bytes = _TRANSFER_CHUNK_BYTES
+    if isinstance(x, jax.Array):
+        on_host = all(d.platform == "cpu" for d in x.devices())
+        to_accel = device is not None and device.platform != "cpu"
+        if not (on_host and to_accel and x.nbytes > max_bytes):
+            return jax.device_put(x, device) if device is not None else x
+        # a host-backend jax.Array bound for the accelerator is the same
+        # oversized relay upload as numpy data — slice it like one
+        x = np.asarray(x)
+    x = np.asarray(x)
+    # jnp.asarray canonicalizes on the host before transfer (f64→f32
+    # without x64); matching it here also halves the upload for float64
+    # host data.
+    canonical = jax.dtypes.canonicalize_dtype(x.dtype)
+    if x.dtype != canonical:
+        x = x.astype(canonical)
+    platform = (device.platform if device is not None
+                else jax.default_backend())
+    row_bytes = x.nbytes // max(1, x.shape[0]) if x.ndim else x.nbytes
+    if (x.nbytes <= max_bytes or x.ndim == 0
+            or (platform == "cpu" and not explicit)):
+        return jax.device_put(x, device) if device is not None else jnp.asarray(x)
+    rows = max(1, max_bytes // max(1, row_bytes))
+    parts = [jax.device_put(x[i:i + rows], device)
+             for i in range(0, x.shape[0], rows)]
+    # The inputs are already committed device buffers, so the concatenate
+    # executes on-device: no further host→device traffic.
+    return jnp.concatenate(parts, axis=0)
+
+
 def as_device_array(x):
     """``jnp.asarray`` honoring ``set_config(device=...)`` — the dispatch
     hook BASELINE designates on the reference's config system
@@ -196,17 +257,13 @@ def as_device_array(x):
     CPU-parity dispatch of SURVEY §7 step 1: identical code, selectable
     backend. Host data is converted with numpy first so a wedged default
     accelerator is never touched when a CPU device is requested.
+
+    Large host operands bound for an accelerator are streamed through
+    :func:`chunked_device_put` (see the relay-wedge note there).
     """
-    import jax
-    import numpy as np
-
     if _get_threadlocal_config()["device"] == "auto":
-        import jax.numpy as jnp
-
-        return jnp.asarray(x)
-    if not isinstance(x, jax.Array):
-        x = np.asarray(x)
-    return jax.device_put(x, resolve_device())
+        return chunked_device_put(x, None)
+    return chunked_device_put(x, resolve_device())
 
 
 def default_dtype():

@@ -6,14 +6,18 @@ the quantum workloads use (``MnistTrial.py:20-22`` runs
 K-fold splitters, ``train_test_split``, ``cross_validate`` /
 ``cross_val_score``, and an exhaustive ``GridSearchCV``.
 
-Parallelism note: the reference fans folds out with joblib ``n_jobs``
-(SURVEY §2.3). Here fits run sequentially on host while each fit's compute
-is device-parallel — ``n_jobs`` is accepted for API compatibility and
-ignored, which is the honest TPU answer (one accelerator, XLA owns it).
+Parallelism note: the reference fans folds out with joblib processes
+(``n_jobs``, SURVEY §2.3; ``MnistTrial.py:22`` runs ``n_jobs=4``). Here
+``n_jobs`` fans folds out over a thread pool instead: every compute-heavy
+path in this stack — XLA executions, the native C++ engines, BLAS — drops
+the GIL, so threads overlap real work without joblib's process spawn,
+pickling, or duplicated device runtimes, and each worker thread inherits
+the caller's ``config_context`` snapshot (the config is thread-local).
 """
 
 import warnings
 import numbers
+import os
 import time
 
 import numpy as np
@@ -56,44 +60,57 @@ class StratifiedKFold(KFold):
     the reference MNIST pipeline, ``MnistTrial.py:21``)."""
 
     def split(self, X, y, groups=None):
+        """Split semantics of the reference splitter
+        (``model_selection/_split.py:643`` ``_make_test_folds``), derived
+        in closed form from the class counts rather than by materializing
+        and striding a sorted label vector.
+
+        Two properties must hold simultaneously: per-fold class counts
+        differ by ≤1 AND total fold sizes differ by ≤1. A naive per-class
+        round-robin satisfies the first but stacks every class's
+        remainder on the low folds. Staggering achieves both: lay the
+        classes out in contiguous blocks (class c starting at cumulative
+        offset a_c) and give fold i of S the block positions congruent to
+        i mod S — then fold i receives ``ceil((count_c - o_ic) / S)``
+        members of class c, where ``o_ic = (i - a_c) mod S`` is the
+        stagger phase. That count formula IS the allocation; no sorted
+        vector is needed.
+        """
         y = np.asarray(y)
         n = len(y)
         rng = check_random_state(self.random_state)
-        # upstream's allocation (model_selection/_split.py
-        # ``_make_test_folds``): classes are encoded by FIRST APPEARANCE
-        # (not lexicographic order), and interleaving the SORTED encoded
-        # ids over the folds staggers each class's remainder, so per-fold
-        # class counts differ by ≤1 AND total fold sizes differ by ≤1 — a
-        # per-class round-robin would stack every class's remainder on the
-        # low fold numbers
-        _, y_idx, y_inv = np.unique(y, return_index=True,
-                                    return_inverse=True)
-        _, class_perm = np.unique(y_idx, return_inverse=True)
-        y_enc = class_perm[y_inv]
-        n_classes = len(y_idx)
-        y_counts = np.bincount(y_enc)
-        if np.all(self.n_splits > y_counts):
+        S = self.n_splits
+        # classes numbered by order of first appearance in y (reference
+        # semantics — NOT lexicographic): rank each lexicographic class
+        # by the position where it first occurs
+        classes, y_lex = np.unique(y, return_inverse=True)
+        n_classes = len(classes)
+        first_pos = np.full(n_classes, n)
+        np.minimum.at(first_pos, y_lex, np.arange(n))
+        appearance_rank = np.argsort(np.argsort(first_pos))
+        y_enc = appearance_rank[y_lex]
+        y_counts = np.bincount(y_enc, minlength=n_classes)
+        if y_counts.max() < S:
             raise ValueError(
-                f"n_splits={self.n_splits} cannot be greater than the "
-                "number of members in each class.")
-        if self.n_splits > y_counts.min():
+                f"n_splits={S} exceeds the number of members in each "
+                "class of y.")
+        if y_counts.min() < S:
             warnings.warn(
                 f"The least populated class in y has only "
-                f"{int(y_counts.min())} members, which is less than "
-                f"n_splits={self.n_splits}.", UserWarning)
-        y_order = np.sort(y_enc)
-        allocation = np.asarray(
-            [np.bincount(y_order[i::self.n_splits], minlength=n_classes)
-             for i in range(self.n_splits)])
+                f"{int(y_counts.min())} members, fewer than "
+                f"n_splits={S}.", UserWarning)
+        block_starts = np.concatenate([[0], np.cumsum(y_counts)[:-1]])
+        phase = (np.arange(S)[:, None] - block_starts[None, :]) % S
+        # ceil((count - phase) / S), clamped at 0, via floor division
+        allocation = -((phase - y_counts[None, :]) // S)
         fold_of = np.empty(n, dtype=int)
         for c in range(n_classes):
             idx = np.flatnonzero(y_enc == c)
             if self.shuffle:
                 rng.shuffle(idx)
-            fold_of[idx] = np.repeat(np.arange(self.n_splits),
-                                     allocation[:, c])
+            fold_of[idx] = np.repeat(np.arange(S), allocation[:, c])
         indices = np.arange(n)
-        for f in range(self.n_splits):
+        for f in range(S):
             test = indices[fold_of == f]
             train = indices[fold_of != f]
             yield train, test
@@ -159,11 +176,24 @@ def _score(estimator, X, y, scoring):
     raise ValueError(f"unknown scoring {scoring!r}")
 
 
+def _resolve_n_jobs(n_jobs, n_tasks):
+    """joblib-style ``n_jobs`` semantics: None/1 → serial, -1 → all cores,
+    negative k → cores+1+k, capped by the task count."""
+    if n_jobs is None:
+        return 1
+    n_jobs = int(n_jobs)
+    if n_jobs == 0:
+        raise ValueError("n_jobs == 0 has no meaning (joblib semantics)")
+    if n_jobs < 0:
+        n_jobs = max(1, (os.cpu_count() or 1) + 1 + n_jobs)
+    return max(1, min(n_jobs, n_tasks))
+
+
 def cross_validate(estimator, X, y=None, *, cv=5, scoring=None, n_jobs=None,
                    return_train_score=False, fit_params=None):
     """Evaluate by cross-validation (reference ``cross_validate``; used at
-    ``MnistTrial.py:22``). ``n_jobs`` accepted for compatibility — see
-    module docstring."""
+    ``MnistTrial.py:22`` with ``n_jobs=4``). Folds fan out over a thread
+    pool when ``n_jobs`` asks for it — see module docstring."""
     X = np.asarray(X)
     if isinstance(cv, numbers.Integral):
         # sklearn semantics: an int cv stratifies for classifiers
@@ -173,31 +203,57 @@ def cross_validate(estimator, X, y=None, *, cv=5, scoring=None, n_jobs=None,
         else:
             cv = KFold(n_splits=int(cv))
     fit_params = fit_params or {}
-    results = {"fit_time": [], "score_time": [], "test_score": []}
-    if return_train_score:
-        results["train_score"] = []
-    for train, test in cv.split(X, y):
+    y_arr = None if y is None else np.asarray(y)
+
+    def one_fold(train, test):
         est = clone(estimator)
-        y_tr = None if y is None else np.asarray(y)[train]
-        y_te = None if y is None else np.asarray(y)[test]
+        y_tr = None if y_arr is None else y_arr[train]
+        y_te = None if y_arr is None else y_arr[test]
         t0 = time.perf_counter()
         if y_tr is None:
             est.fit(X[train], **fit_params)
         else:
             est.fit(X[train], y_tr, **fit_params)
         t1 = time.perf_counter()
-        results["fit_time"].append(t1 - t0)
-        results["test_score"].append(_score(est, X[test], y_te, scoring))
-        results["score_time"].append(time.perf_counter() - t1)
-        if return_train_score:
-            results["train_score"].append(
-                _score(est, X[train], y_tr, scoring))
+        test_score = _score(est, X[test], y_te, scoring)
+        t2 = time.perf_counter()
+        train_score = (_score(est, X[train], y_tr, scoring)
+                       if return_train_score else None)
+        return t1 - t0, t2 - t1, test_score, train_score
+
+    folds = list(cv.split(X, y))
+    n_workers = _resolve_n_jobs(n_jobs, len(folds))
+    if n_workers == 1:
+        fold_results = [one_fold(tr, te) for tr, te in folds]
+    else:
+        from concurrent.futures import ThreadPoolExecutor
+
+        from ._config import _get_threadlocal_config
+
+        caller_config = _get_threadlocal_config().copy()
+
+        def with_config(args):
+            # worker threads materialize a fresh thread-local config from
+            # the GLOBAL defaults — propagate the caller's context instead
+            _get_threadlocal_config().update(caller_config)
+            return one_fold(*args)
+
+        with ThreadPoolExecutor(max_workers=n_workers) as pool:
+            fold_results = list(pool.map(with_config, folds))
+
+    results = {
+        "fit_time": [r[0] for r in fold_results],
+        "score_time": [r[1] for r in fold_results],
+        "test_score": [r[2] for r in fold_results],
+    }
+    if return_train_score:
+        results["train_score"] = [r[3] for r in fold_results]
     return {k: np.asarray(v) for k, v in results.items()}
 
 
 def cross_val_score(estimator, X, y=None, *, cv=5, scoring=None, n_jobs=None):
-    return cross_validate(estimator, X, y, cv=cv, scoring=scoring)[
-        "test_score"]
+    return cross_validate(estimator, X, y, cv=cv, scoring=scoring,
+                          n_jobs=n_jobs)["test_score"]
 
 
 class ParameterGrid:
@@ -246,7 +302,8 @@ class GridSearchCV:
         for params in grid:
             est = clone(self.estimator).set_params(**params)
             scores = cross_val_score(est, X, y, cv=self.cv,
-                                     scoring=self.scoring)
+                                     scoring=self.scoring,
+                                     n_jobs=self.n_jobs)
             all_scores.append(scores)
             mean_scores.append(float(np.mean(scores)))
         best = int(np.argmax(mean_scores))

@@ -303,11 +303,14 @@ def lloyd_single(key, X, weights, centers_init, x_sq_norms, *, delta=0.0,
     estep = functools.partial(e_step, delta=delta, mode=mode, ipe_q=ipe_q,
                               axis_name=axis_name,
                               compute_dtype=compute_dtype)
-    # the hand-tiled kernel computes its own fused distances in the input
-    # dtype; a REDUCED compute_dtype routes through the XLA path, whose
-    # bf16 GEMM + fusion is the equivalent bandwidth saving
-    fused = (use_pallas and mode in ("classic", "delta")
-             and not is_reduced(compute_dtype, X.dtype))
+    # the hand-tiled kernel takes a reduced compute_dtype natively (bf16
+    # VMEM blocks into the MXU, f32 accumulation — see lloyd_step_pallas);
+    # only a WIDENING request (f64 on f32 data) forces the XLA path
+    reduced = is_reduced(compute_dtype, X.dtype)
+    widening = (reduced
+                and jnp.dtype(compute_dtype).itemsize > X.dtype.itemsize)
+    fused = (use_pallas and mode in ("classic", "delta") and not widening)
+    pallas_cdt = str(compute_dtype) if reduced and not widening else None
     k = centers_init.shape[0]
 
     def cond(state):
@@ -331,7 +334,8 @@ def lloyd_single(key, X, weights, centers_init, x_sq_norms, *, delta=0.0,
             labels, min_d2, sums, counts, inertia = lloyd_step_pallas(
                 X, weights, centers, x_sq_norms, key=k1,
                 window=delta if mode == "delta" else 0.0,
-                interpret=pallas_interpret)
+                interpret=pallas_interpret, axis_name=axis_name,
+                compute_dtype=pallas_cdt)
             if axis_name is not None:
                 sums = lax.psum(sums, axis_name)
                 counts = lax.psum(counts, axis_name)
@@ -1542,7 +1546,14 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         from .._config import on_cpu_backend
 
         if (mode in ("classic", "delta") and on_cpu_backend()
-                and self.compute_dtype is None):
+                and self.compute_dtype is None
+                and (X.dtype == np.float32
+                     or not jax.config.jax_enable_x64)):
+            # precision guard in the spirit of KNeighbors._host_search: the
+            # host copies are float32, so the host route is skipped ONLY
+            # when it would actually lose precision — f64 input under x64
+            # mode. Without x64 the jax path canonicalizes to f32 anyway,
+            # so f64 numpy input (numpy's default) keeps the fast path.
             from .. import native
 
             Xn = np.ascontiguousarray(X, np.float32)
@@ -1591,8 +1602,10 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         sample_weight = check_sample_weight(sample_weight, X)
         from .._config import on_cpu_backend
 
-        # same gate as predict: the host path computes in float32
-        if on_cpu_backend() and self.compute_dtype is None:
+        # same gate as predict: f64-under-x64 keeps jax, all else host
+        if (on_cpu_backend() and self.compute_dtype is None
+                and (X.dtype == np.float32
+                     or not jax.config.jax_enable_x64)):
             from .. import native
 
             Xn = np.ascontiguousarray(X, np.float32)

@@ -480,9 +480,17 @@ int lloyd_run_batched(const float* X, const float* sample_weight,
         };
         std::partial_sort(order.begin(), order.begin() + take, order.end(),
                           better_cand);
+        // snapshot the originally-empty set before relocating (matches the
+        // NumPy twin _relocate_empty_np): a donor drained to exactly zero
+        // weight mid-pass must not absorb a candidate meant for a
+        // later originally-empty cluster
+        std::vector<int64_t> empty_js;
+        empty_js.reserve(n_empty);
+        for (int64_t j = 0; j < k; ++j)
+          if (ca[j] <= 0.0) empty_js.push_back(j);
         int64_t t = 0;
-        for (int64_t j = 0; j < k && t < take; ++j) {
-          if (ca[j] > 0.0) continue;
+        for (const int64_t j : empty_js) {
+          if (t >= take) break;
           const int64_t p = order[t++];
           if ((sample_weight && sample_weight[p] <= 0.0f)) continue;
           const double wp = sample_weight ? (double)sample_weight[p] : 1.0;

@@ -31,7 +31,11 @@ def _make_lloyd_kernel(window):
     """Build the tile kernel; ``window`` > 0 adds the δ-means noisy label
     pick (uniform among centroids within ``window`` of the min squared
     distance, implemented as Gumbel-argmax over pre-sampled noise — RNG
-    stays outside the kernel, the selection fuses inside)."""
+    stays outside the kernel, the selection fuses inside).
+
+    The X/centers blocks may arrive in bfloat16 (MXU-native): both GEMMs
+    accumulate in float32 via ``preferred_element_type``, and every
+    reduction buffer (sums/counts/inertia/min_d2) stays float32."""
     delta_mode = window > 0
 
     def kernel(x_ref, xsq_ref, w_ref, c_ref, csq_ref, *refs):
@@ -76,8 +80,9 @@ def _make_lloyd_kernel(window):
             counts_ref[:] = jnp.zeros_like(counts_ref)
             inertia_ref[:] = jnp.zeros_like(inertia_ref)
 
-        # MXU again: partial centroid sums, accumulated across tiles
-        sums_ref[:] += jnp.dot(onehot.T, x,
+        # MXU again: partial centroid sums, accumulated across tiles (the
+        # cast matches the GEMM operand dtype; counts/inertia stay f32)
+        sums_ref[:] += jnp.dot(onehot.astype(x.dtype).T, x,
                                preferred_element_type=jnp.float32)
         counts_ref[:] += jnp.sum(onehot, axis=0, keepdims=True)
         inertia_ref[:] += jnp.sum(
@@ -87,9 +92,11 @@ def _make_lloyd_kernel(window):
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("tile_n", "interpret", "window"))
+                   static_argnames=("tile_n", "interpret", "window",
+                                    "axis_name", "compute_dtype"))
 def lloyd_step_pallas(X, weights, centers, x_sq_norms, *, key=None,
-                      window=0.0, tile_n=512, interpret=False):
+                      window=0.0, tile_n=512, interpret=False,
+                      axis_name=None, compute_dtype=None):
     """Fused Lloyd iteration statistics in one pallas sweep.
 
     Parameters
@@ -103,6 +110,15 @@ def lloyd_step_pallas(X, weights, centers, x_sq_norms, *, key=None,
         classical argmin path.
     tile_n : static — samples per VMEM tile.
     interpret : static — run in interpreter mode (CPU tests).
+    axis_name : static — the mesh axis this call runs under when invoked
+        inside ``shard_map`` (the TPU-pod configuration). shard_map's
+        varying-across-mesh checker requires every pallas output to declare
+        its vma; all five outputs derive from the shard-local X, so they
+        vary over exactly this axis.
+    compute_dtype : static — 'bfloat16' feeds the X/centers VMEM blocks to
+        the MXU in its native dtype (halving GEMM cost and VMEM traffic);
+        distances, sums, counts and inertia still accumulate in float32.
+        None keeps everything float32.
 
     Returns
     -------
@@ -119,10 +135,12 @@ def lloyd_step_pallas(X, weights, centers, x_sq_norms, *, key=None,
     k_p = _round_up(k, 8)
     n_p = _round_up(n, tile_n)
 
-    Xp = jnp.zeros((n_p, m_p), jnp.float32).at[:n, :m].set(X)
+    cdt = jnp.dtype(compute_dtype) if compute_dtype else jnp.float32
+    Xp = jnp.zeros((n_p, m_p), cdt).at[:n, :m].set(X.astype(cdt))
     wp = jnp.zeros((n_p, 1), jnp.float32).at[:n, 0].set(weights)
     xsqp = jnp.zeros((n_p, 1), jnp.float32).at[:n, 0].set(x_sq_norms)
-    Cp = jnp.zeros((k_p, m_p), jnp.float32).at[:k, :m].set(centers)
+    Cp = jnp.zeros((k_p, m_p), cdt).at[:k, :m].set(centers.astype(cdt))
+    # centroid norms stay f32 regardless of the GEMM dtype
     csqp = jnp.full((1, k_p), _BIG, jnp.float32).at[0, :k].set(
         jnp.sum(centers * centers, axis=1))
 
@@ -150,6 +168,14 @@ def lloyd_step_pallas(X, weights, centers, x_sq_norms, *, key=None,
                                      memory_space=pltpu.VMEM))
         operands.append(gum)
 
+    vma = None if axis_name is None else frozenset({axis_name})
+    if axis_name is not None:
+        # centers (and their norms) enter shard_map replicated while X is
+        # shard-varying; the kernel may not mix the two, so promote the
+        # replicated operands to varying (a no-op on the data)
+        operands = [op if axis_name in jax.typeof(op).vma
+                    else jax.lax.pcast(op, axis_name, to="varying")
+                    for op in operands]
     grid = (n_p // tile_n,)
     labels, min_d2, sums, counts, inertia = pl.pallas_call(
         _make_lloyd_kernel(window),
@@ -166,11 +192,11 @@ def lloyd_step_pallas(X, weights, centers, x_sq_norms, *, key=None,
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((n_p, 1), jnp.int32),
-            jax.ShapeDtypeStruct((n_p, 1), jnp.float32),
-            jax.ShapeDtypeStruct((k_p, m_p), jnp.float32),
-            jax.ShapeDtypeStruct((1, k_p), jnp.float32),
-            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+            jax.ShapeDtypeStruct((n_p, 1), jnp.int32, vma=vma),
+            jax.ShapeDtypeStruct((n_p, 1), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((k_p, m_p), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((1, k_p), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((1, 1), jnp.float32, vma=vma),
         ],
         interpret=interpret,
     )(*operands)

@@ -62,6 +62,75 @@ def test_set_config_rejects_bogus_device():
             set_config(device=bogus)
 
 
+class TestChunkedDevicePut:
+    """Streamed host→device placement (the ≥200 MB relay-wedge dodge).
+
+    On the CPU backend the slicing only engages when max_bytes is passed
+    explicitly, which is exactly how these tests force the assembly path."""
+
+    def test_parity_with_plain_asarray(self):
+        from sq_learn_tpu._config import chunked_device_put
+
+        x = np.random.RandomState(0).randn(97, 13).astype(np.float32)
+        out = chunked_device_put(x, None, max_bytes=512)  # ~10 rows/slice
+        np.testing.assert_array_equal(np.asarray(out), x)
+        assert out.dtype == jax.numpy.asarray(x).dtype
+
+    def test_committed_placement_survives_chunking(self):
+        from sq_learn_tpu._config import chunked_device_put
+
+        cpus = jax.devices("cpu")
+        x = np.ones((64, 8), np.float32)
+        out = chunked_device_put(x, cpus[2], max_bytes=256)
+        assert out.devices() == {cpus[2]}
+        np.testing.assert_array_equal(np.asarray(out), x)
+
+    def test_dtype_canonicalization_matches_asarray(self):
+        from sq_learn_tpu._config import chunked_device_put
+
+        x64 = np.random.RandomState(1).randn(40, 4)  # float64 host data
+        out = chunked_device_put(x64, None, max_bytes=128)
+        expected = jax.numpy.asarray(x64)
+        assert out.dtype == expected.dtype
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(expected))
+
+    def test_one_dim_and_small_inputs_pass_through(self):
+        from sq_learn_tpu._config import chunked_device_put
+
+        v = np.arange(1000, dtype=np.float32)
+        np.testing.assert_array_equal(
+            np.asarray(chunked_device_put(v, None, max_bytes=400)), v)
+        small = np.ones((3, 3), np.float32)
+        np.testing.assert_array_equal(
+            np.asarray(chunked_device_put(small, None)), small)
+
+    def test_cpu_targets_skip_slicing_by_default(self, monkeypatch):
+        """With the default max_bytes a CPU-bound transfer stays one piece
+        even when the array exceeds the threshold (host→host copies can't
+        wedge a relay). Slicing is observable as device_put call count."""
+        import sq_learn_tpu._config as cfg
+
+        monkeypatch.setattr(cfg, "_TRANSFER_CHUNK_BYTES", 128)
+        calls = []
+        real_put = jax.device_put
+        monkeypatch.setattr(jax, "device_put",
+                            lambda *a, **k: (calls.append(1),
+                                             real_put(*a, **k))[1])
+        x = np.random.RandomState(2).randn(50, 6).astype(np.float32)
+        with config_context(device="cpu:1"):
+            out = as_device_array(x)
+        assert len(calls) == 1, f"expected ONE transfer, saw {len(calls)}"
+        assert out.devices() == {jax.devices("cpu")[1]}
+        np.testing.assert_array_equal(np.asarray(out), x)
+
+    def test_single_row_larger_than_budget_still_transfers(self):
+        from sq_learn_tpu._config import chunked_device_put
+
+        x = np.random.RandomState(3).randn(4, 64).astype(np.float32)
+        out = chunked_device_put(x, None, max_bytes=16)  # 256 B rows
+        np.testing.assert_array_equal(np.asarray(out), x)
+
+
 def test_fit_computation_runs_on_configured_device(blobs):
     """The committed input pins the fused prestats jit to the chosen chip."""
     from sq_learn_tpu.models.qkmeans import fit_prestats

@@ -72,6 +72,46 @@ class TestFusedKernelEquivalence:
                                    np.asarray(sums), rtol=1e-3, atol=1e-3)
 
 
+class TestBf16Kernel:
+    def test_bf16_matches_f32_on_separated_blobs(self):
+        """compute_dtype='bfloat16' feeds the MXU its native dtype; on
+        data whose cluster margins dwarf bf16 rounding the labels must
+        match the f32 kernel exactly, with f32-accumulated partials close."""
+        X, _ = make_blobs(n_samples=300, centers=4, n_features=16,
+                          cluster_std=0.5, random_state=7)
+        Xd = jnp.asarray(X)
+        w = jnp.ones(300, jnp.float32)
+        C = Xd[:4]
+        xsq = row_norms(Xd, squared=True)
+        l32, _, s32, c32, i32 = lloyd_step_pallas(
+            Xd, w, C, xsq, interpret=True)
+        l16, _, s16, c16, i16 = lloyd_step_pallas(
+            Xd, w, C, xsq, interpret=True, compute_dtype="bfloat16")
+        # a point sitting exactly on a Voronoi boundary may flip under
+        # bf16 rounding; anything beyond stray boundary flips is a bug
+        flips = np.mean(np.asarray(l16) != np.asarray(l32))
+        assert flips <= 0.01, f"{flips:.1%} labels flipped under bf16"
+        np.testing.assert_allclose(np.asarray(c16), np.asarray(c32),
+                                   atol=2.0)
+        # bf16 GEMM inputs, f32 accumulation: ~1e-2 relative (atol covers
+        # the one boundary point moving between cluster sums)
+        np.testing.assert_allclose(np.asarray(s16), np.asarray(s32),
+                                   rtol=2e-2, atol=12.0)
+        np.testing.assert_allclose(float(i16), float(i32), rtol=2e-2)
+
+    def test_outputs_stay_float32(self):
+        X, _ = make_blobs(n_samples=64, centers=2, n_features=8,
+                          cluster_std=0.5, random_state=3)
+        Xd = jnp.asarray(X)
+        out = lloyd_step_pallas(Xd, jnp.ones(64, jnp.float32), Xd[:2],
+                                row_norms(Xd, squared=True), interpret=True,
+                                compute_dtype="bfloat16")
+        labels, mind2, sums, counts, inertia = out
+        assert labels.dtype == jnp.int32
+        for a in (mind2, sums, counts, inertia):
+            assert a.dtype == jnp.float32
+
+
 class TestEstimatorIntegration:
     def test_kmeans_pallas_matches_xla(self):
         X, y = make_blobs(n_samples=300, centers=4, n_features=6,
@@ -127,6 +167,33 @@ def test_lloyd_step_pallas_delta_mode_interpret():
                                    np.asarray(X)[labels == j].sum(0),
                                    rtol=1e-4, atol=1e-4)
     assert float(inertia) == pytest.approx(float(min_d2.sum()), rel=1e-5)
+
+
+def test_lloyd_single_fused_bf16_quality():
+    """A reduced compute_dtype now rides the fused pallas kernel (bf16
+    MXU blocks) instead of falling back to XLA; clustering quality must
+    be unchanged on resolvable separations."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sq_learn_tpu.datasets import make_blobs
+    from sq_learn_tpu.metrics import adjusted_rand_score
+    from sq_learn_tpu.models.qkmeans import lloyd_single
+    from sq_learn_tpu.ops.linalg import row_norms
+
+    X, y = make_blobs(n_samples=300, centers=4, n_features=8,
+                      cluster_std=0.5, random_state=4)
+    Xd = jnp.asarray(X - X.mean(0))
+    w = jnp.ones(300, Xd.dtype)
+    xsq = row_norms(Xd, squared=True)
+    centers0 = Xd[np.asarray([5, 80, 160, 240])]
+    labels, inertia, centers, n_iter, _ = lloyd_single(
+        jax.random.PRNGKey(0), Xd, w, centers0, xsq, mode="classic",
+        max_iter=50, use_pallas=True, pallas_interpret=True,
+        compute_dtype="bfloat16")
+    assert adjusted_rand_score(y, np.asarray(labels)) > 0.95
+    assert np.isfinite(float(inertia))
 
 
 def test_lloyd_single_fused_delta_matches_quality():
