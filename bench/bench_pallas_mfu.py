@@ -16,11 +16,11 @@ a device→host read cannot complete before the producing computation,
 whereas ``block_until_ready`` proved soft on the experimental relay
 (the 0.0001 s covtype artifact of round 2).
 
-Peak FLOP/s by device kind (bf16 matmul peaks, the MXU's native rate;
-f32 MFU is reported against the same bf16 peak, so it is a conservative
-lower bound): TPU v4 275e12, v5e 197e12, v5p 459e12, v6e 918e12
-(public spec sheets / jax-ml scaling book). Override with
-``SQ_TPU_PEAK_FLOPS`` when the tunnel fronts different hardware.
+Peak FLOP/s resolution lives in ``sq_learn_tpu.utils.profiling``
+(``TPU_PEAK_FLOPS`` by device kind — bf16 matmul peaks, the MXU's
+native rate, so f32 MFU is a conservative lower bound; unknown chips
+report raw FLOP/s with no MFU claim). Override with
+``SQ_TPU_PEAK_FLOPS`` when the tunnel fronts unlisted hardware.
 
 Emits ONE JSON line: value = achieved TFLOP/s for the best pallas
 configuration, ``vs_baseline`` = XLA-path seconds / pallas seconds
@@ -39,26 +39,6 @@ warnings.filterwarnings("ignore")
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
 from bench._common import emit, probe_backend, smoke_mode  # noqa: E402
-
-_PEAKS = {  # bf16 matmul peak FLOP/s per chip
-    "v4": 275e12,
-    "v5e": 197e12,
-    "v5 lite": 197e12,
-    "v5p": 459e12,
-    "v6e": 918e12,
-    "v6 lite": 918e12,
-}
-
-
-def _peak_flops(device):
-    env = os.environ.get("SQ_TPU_PEAK_FLOPS")
-    if env:
-        return float(env), "env"
-    kind = getattr(device, "device_kind", "").lower()
-    for tag, peak in _PEAKS.items():
-        if tag in kind:
-            return peak, kind
-    return None, kind or "unknown"
 
 
 def _xla_lloyd_iter(X, centers, x_sq_norms):
@@ -96,6 +76,8 @@ def main():
 
     from sq_learn_tpu.ops.pallas_kernels import (lloyd_step_pallas,
                                                  pallas_available)
+    from sq_learn_tpu.utils.profiling import (device_peak_flops,
+                                              lloyd_iter_flops)
 
     on_tpu = pallas_available()
     interpret = not on_tpu
@@ -109,7 +91,9 @@ def main():
         reps = 5
 
     device = jax.devices()[0]
-    peak, kind = _peak_flops(device)
+    peak = device_peak_flops(device)
+    kind = ("env" if os.environ.get("SQ_TPU_PEAK_FLOPS")
+            else getattr(device, "device_kind", "unknown"))
     ladder = []
     headline = None
 
@@ -119,7 +103,7 @@ def main():
         centers = jax.random.normal(kc, (k, m), jnp.float32)
         xsq = jnp.sum(X * X, axis=1)
         jax.block_until_ready((X, centers, xsq))
-        flops = 4.0 * n * k * m
+        flops = lloyd_iter_flops(n, m, k)
 
         xla_iter = jax.jit(_xla_lloyd_iter)
         entry = {"n": n, "m": m, "k": k}

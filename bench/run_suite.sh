@@ -77,8 +77,9 @@ done
 # (PYTHONPATH cleared + timeout, like the retry path: the bare interpreter
 # pre-imports jax via the axon sitecustomize and would hang on a wedged
 # relay even though this step only parses JSON)
-env -u PYTHONPATH timeout 60 python - "$out" <<'PY'
+env -u PYTHONPATH timeout 60 python - "$out" 5 <<'PY'
 import json, sys
+expected = int(sys.argv[2])  # one JSON line per suite config
 fails, seen = [], 0
 for line in open(sys.argv[1]):
     line = line.strip()
@@ -96,8 +97,10 @@ for line in open(sys.argv[1]):
           f"vs_baseline={rec['vs_baseline']}")
     if not ok:
         fails.append(rec["metric"])
-if fails or not seen:
-    sys.exit(f"acceptance gate: {fails or 'no JSON lines recorded'}")
+if fails or seen != expected:
+    # a config that records only rc markers (double failure) must fail
+    # the gate too — a missing number is not a passing number
+    sys.exit(f"acceptance gate: fails={fails} recorded={seen}/{expected}")
 PY
 gate_rc=$?
 echo "# acceptance gate rc=$gate_rc" >> "$out"

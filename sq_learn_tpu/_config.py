@@ -223,8 +223,7 @@ def chunked_device_put(x, device=None, max_bytes=None):
         if not (on_host and to_accel and x.nbytes > max_bytes):
             return jax.device_put(x, device) if device is not None else x
         # a host-backend jax.Array bound for the accelerator is the same
-        # oversized relay upload as numpy data — slice it like one
-        x = np.asarray(x)
+        # oversized relay upload as numpy data — fall through and slice it
     x = np.asarray(x)
     # jnp.asarray canonicalizes on the host before transfer (f64→f32
     # without x64); matching it here also halves the upload for float64

@@ -303,14 +303,17 @@ def lloyd_single(key, X, weights, centers_init, x_sq_norms, *, delta=0.0,
     estep = functools.partial(e_step, delta=delta, mode=mode, ipe_q=ipe_q,
                               axis_name=axis_name,
                               compute_dtype=compute_dtype)
-    # the hand-tiled kernel takes a reduced compute_dtype natively (bf16
-    # VMEM blocks into the MXU, f32 accumulation — see lloyd_step_pallas);
-    # only a WIDENING request (f64 on f32 data) forces the XLA path
+    # the hand-tiled kernel takes bfloat16 natively (bf16 VMEM blocks into
+    # the MXU, f32 accumulation — see lloyd_step_pallas). Only bf16 rides
+    # pallas: it is the MXU's native dtype on every TPU generation, while
+    # float16 Mosaic support varies by hardware — f16 (and any widening
+    # request) keeps the XLA path, which handles both everywhere.
     reduced = is_reduced(compute_dtype, X.dtype)
-    widening = (reduced
-                and jnp.dtype(compute_dtype).itemsize > X.dtype.itemsize)
-    fused = (use_pallas and mode in ("classic", "delta") and not widening)
-    pallas_cdt = str(compute_dtype) if reduced and not widening else None
+    pallas_bf16 = (reduced
+                   and jnp.dtype(compute_dtype) == jnp.dtype(jnp.bfloat16))
+    fused = (use_pallas and mode in ("classic", "delta")
+             and (not reduced or pallas_bf16))
+    pallas_cdt = "bfloat16" if pallas_bf16 else None
     k = centers_init.shape[0]
 
     def cond(state):

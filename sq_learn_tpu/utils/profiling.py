@@ -12,12 +12,73 @@ module supplies the real-measurement side:
   async dispatch doesn't fake instant results.
 - :func:`benchmark` — median-of-repeats timing of a jitted callable with a
   compile warm-up, the measurement discipline ``bench.py`` uses.
+- :func:`lloyd_iter_flops` / :func:`matmul_flops` — FLOP accounting for
+  the MXU-bound kernels, and :func:`device_peak_flops` /
+  :func:`mfu` — achieved fraction of chip peak. Together these turn a
+  wall-clock into a hardware-utilization statement ("beating" the
+  reference's ``cluster/_k_means_lloyd.pyx:29`` on a TPU means a
+  roofline number, not a latency ratio on digit-scale data).
 """
 
+import os
 import time
 from contextlib import contextmanager
 
 import jax
+
+#: bf16 matmul peak FLOP/s per chip generation (public spec sheets /
+#: the jax-ml scaling book). The MXU's native rate; f32 MFU reported
+#: against it is a conservative lower bound.
+TPU_PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5 lite": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+    "v6 lite": 918e12,
+}
+
+
+def matmul_flops(m, k, n):
+    """FLOPs of an (m, k) @ (k, n) GEMM: one multiply + one add per MAC."""
+    return 2.0 * m * k * n
+
+
+def lloyd_iter_flops(n_samples, n_features, n_clusters):
+    """MXU FLOPs of one fused Lloyd iteration: the E-step distance GEMM
+    plus the M-step one-hot centroid-sum GEMM (2·n·k·m each). VPU work
+    (argmin, compares) is excluded — undercounting keeps MFU honest."""
+    return (matmul_flops(n_samples, n_features, n_clusters)
+            + matmul_flops(n_clusters, n_samples, n_features))
+
+
+def device_peak_flops(device=None):
+    """Best-known peak FLOP/s for ``device`` (default: the first device).
+
+    Resolution order: the ``SQ_TPU_PEAK_FLOPS`` env override (for tunnels
+    fronting unlisted hardware), then the generation table keyed on
+    ``device_kind``. Returns None when the chip is unknown — callers must
+    then report raw FLOP/s without an MFU claim, never guess a peak.
+    """
+    env = os.environ.get("SQ_TPU_PEAK_FLOPS")
+    if env:
+        return float(env)
+    if device is None:
+        device = jax.devices()[0]
+    kind = getattr(device, "device_kind", "").lower()
+    for tag, peak in TPU_PEAK_FLOPS.items():
+        if tag in kind:
+            return peak
+    return None
+
+
+def mfu(flops, seconds, device=None):
+    """Model FLOP utilization: achieved FLOP/s over chip peak, or None
+    when the peak is unknown (see :func:`device_peak_flops`)."""
+    peak = device_peak_flops(device)
+    if not peak or seconds <= 0:
+        return None
+    return (flops / seconds) / peak
 
 
 @contextmanager

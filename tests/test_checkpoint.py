@@ -110,3 +110,27 @@ def test_profiling_benchmark_and_timer():
     with Timer() as t:
         step(x)
     assert t.elapsed > 0
+
+
+def test_profiling_flop_accounting(monkeypatch):
+    """FLOP/MFU helpers (SURVEY §5; the roofline side of the bench
+    suite's hardware-utilization evidence)."""
+    from sq_learn_tpu.utils import profiling as prof
+
+    assert prof.matmul_flops(64, 32, 16) == 2 * 64 * 32 * 16
+    # one Lloyd iteration = E-step GEMM + M-step GEMM, 2·n·k·m each
+    assert prof.lloyd_iter_flops(1000, 64, 10) == 4 * 1000 * 64 * 10
+    # unknown chip (the CPU backend): no peak, no MFU claim
+    monkeypatch.delenv("SQ_TPU_PEAK_FLOPS", raising=False)
+    assert prof.device_peak_flops() is None
+    assert prof.mfu(1e12, 0.5) is None
+    # explicit override: MFU = achieved / peak
+    monkeypatch.setenv("SQ_TPU_PEAK_FLOPS", "2e14")
+    assert prof.device_peak_flops() == 2e14
+    assert prof.mfu(1e14, 1.0) == 0.5
+    # generation table keyed on device_kind
+    class FakeDev:
+        device_kind = "TPU v4"
+
+    monkeypatch.delenv("SQ_TPU_PEAK_FLOPS", raising=False)
+    assert prof.device_peak_flops(FakeDev()) == prof.TPU_PEAK_FLOPS["v4"]
