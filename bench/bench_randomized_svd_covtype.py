@@ -27,8 +27,11 @@ def main():
     X, y = maybe_subsample(X, y)
     n_components = 10
     key = jax.random.PRNGKey(0)
-    # chunked upload: covtype f32 is ~125 MB, right at the relay's comfort
-    # margin (wedges observed at >=200 MB) — stream it like the MNIST configs
+    # covtype f32 is ~125 MB — just UNDER the 128 MB chunk threshold, so
+    # this still crosses the relay as one transfer (wedges were only ever
+    # observed at >=200 MB); routing through as_device_array simply keeps
+    # every bench on the same placement path, and a lowered
+    # SQ_TRANSFER_CHUNK_BYTES would engage slicing here too
     Xd = as_device_array(X)
 
     def ours_run():

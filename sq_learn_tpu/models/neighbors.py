@@ -6,7 +6,10 @@ The reference's KNN (used downstream of qPCA in the MNIST pipeline,
 Spatial trees are pointer-chasing and data-dependent — exactly what a TPU
 can't use; the idiomatic equivalent (SURVEY §2.2 "neighbors" row) is one
 ‖x‖²+‖c‖²−2XCᵀ GEMM + ``lax.top_k`` per query block, which wins on the MXU
-for the dimensionalities these pipelines touch.
+for the dimensionalities these pipelines touch. On a real TPU the search
+rides the fused pallas argkmin (``ops.pallas_kernels.argkmin_pallas``):
+score tiles and the running k-best stay VMEM-resident, so no distance
+matrix ever round-trips HBM.
 """
 
 import functools
@@ -116,13 +119,15 @@ class KNeighborsClassifier(ClassifierMixin, BaseEstimator):
     """
 
     def __init__(self, n_neighbors=5, *, weights="uniform",
-                 algorithm="brute", p=2, n_jobs=None, compute_dtype=None):
+                 algorithm="brute", p=2, n_jobs=None, compute_dtype=None,
+                 use_pallas="auto"):
         self.n_neighbors = n_neighbors
         self.weights = weights
         self.algorithm = algorithm
         self.p = p
         self.n_jobs = n_jobs
         self.compute_dtype = compute_dtype
+        self.use_pallas = use_pallas
 
     @with_device_scope
     def fit(self, X, y):
@@ -137,6 +142,10 @@ class KNeighborsClassifier(ClassifierMixin, BaseEstimator):
         self._X_np = np.ascontiguousarray(X, np.float32)
         self._xsq_np = (self._X_np**2).sum(axis=1)
         self._y_np = y_enc.astype(np.int32)
+        # device-side norms for the pallas search (recomputing per predict
+        # would pay a dispatch + full-train reduction every call)
+        if jnp.asarray(self.X_fit_).dtype == jnp.float32:
+            self._xsq_dev = jnp.sum(self.X_fit_ * self.X_fit_, axis=1)
         return self
 
     def _host_search(self, X, k):
@@ -161,6 +170,41 @@ class KNeighborsClassifier(ClassifierMixin, BaseEstimator):
             self._y_np = np.asarray(self.y_fit_, np.int32)
         return _host_knn(self._X_np, self._xsq_np,
                          np.ascontiguousarray(X, np.float32), k)
+
+    def _device_search(self, X, k):
+        """(idx, d2) on the configured backend: the fused pallas argkmin
+        (one VMEM-resident sweep, no HBM distance matrix) when a TPU is
+        attached and precision is exact, else the XLA GEMM+top_k path.
+        A pallas failure falls back to XLA with a warning rather than
+        failing the predict (same contract as QKMeans._kernel_ladder)."""
+        from ..ops.pallas_kernels import argkmin_pallas, pallas_available
+
+        if self.use_pallas == "auto":
+            use, interpret = pallas_available(), False
+        else:
+            use = bool(self.use_pallas)
+            interpret = use and not pallas_available()
+        # same precision contract as _host_search: the kernel's buffers
+        # are float32, so x64-configured f64 fits stay on the XLA path
+        if (use and self.compute_dtype is None
+                and jnp.asarray(self.X_fit_).dtype == jnp.float32):
+            try:
+                if not hasattr(self, "_xsq_dev"):
+                    # cached at fit; rebuilt here for checkpoint-restored
+                    # models (only public fitted state round-trips)
+                    self._xsq_dev = jnp.sum(
+                        self.X_fit_ * self.X_fit_, axis=1)
+                return argkmin_pallas(self.X_fit_, self._xsq_dev,
+                                      jnp.asarray(X), k,
+                                      interpret=interpret)
+            except Exception as exc:  # pragma: no cover - hardware-specific
+                import warnings as _warnings
+
+                _warnings.warn(
+                    f"pallas argkmin rejected ({type(exc).__name__}: {exc});"
+                    " falling back to the XLA search")
+        return knn_indices(self.X_fit_, jnp.asarray(X), k,
+                           compute_dtype=self.compute_dtype)
 
     def _check_k(self, k):
         """Validate a neighbor count before it reaches ``lax.top_k``
@@ -187,8 +231,7 @@ class KNeighborsClassifier(ClassifierMixin, BaseEstimator):
         if host is not None:
             idx, d2 = host
         else:
-            idx, d2 = knn_indices(self.X_fit_, jnp.asarray(X), k,
-                                  compute_dtype=self.compute_dtype)
+            idx, d2 = self._device_search(X, k)
         if return_distance:
             return np.sqrt(np.asarray(d2)), np.asarray(idx)
         return np.asarray(idx)
@@ -213,8 +256,7 @@ class KNeighborsClassifier(ClassifierMixin, BaseEstimator):
                 rows * n_classes + votes.ravel(), weights=wts.ravel(),
                 minlength=n * n_classes).reshape(n, n_classes)
             return counts / counts.sum(axis=1, keepdims=True)
-        idx, d2 = knn_indices(self.X_fit_, jnp.asarray(X), k,
-                              compute_dtype=self.compute_dtype)
+        idx, d2 = self._device_search(X, k)
         votes = self.y_fit_[idx]  # (n, k)
         onehot = jax.nn.one_hot(votes, n_classes)
         if self.weights == "distance":
