@@ -31,6 +31,26 @@ def emit(metric, value, unit="s", vs_baseline=1.0, **extra):
     }))
 
 
+def _enable_compilation_cache():
+    """Persist XLA compilations across processes (and across healthy
+    tunnel windows): a ~7-minute window must spend its time measuring,
+    not re-compiling the same fits the previous window already lowered.
+    Best-effort — an old jax without the knobs just compiles as before."""
+    import os
+
+    try:
+        import jax
+
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                           "/tmp/sq_jax_compile_cache"))
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass
+
+
 def probe_backend(timeout_s=60):
     """Initialize the configured JAX backend in a throwaway subprocess and
     fall back to the CPU backend when the accelerator tunnel is wedged
@@ -42,6 +62,7 @@ def probe_backend(timeout_s=60):
     import os
     import subprocess
 
+    _enable_compilation_cache()
     platform = os.environ.get("JAX_PLATFORMS", "")
     if platform == "cpu":
         # the env var alone is NOT sufficient when a sitecustomize
