@@ -32,10 +32,19 @@ def emit(metric, value, unit="s", vs_baseline=1.0, **extra):
 
 
 def _enable_compilation_cache():
-    """Persist XLA compilations across processes (and across healthy
-    tunnel windows): a ~7-minute window must spend its time measuring,
-    not re-compiling the same fits the previous window already lowered.
-    Best-effort — an old jax without the knobs just compiles as before."""
+    """Persist XLA compilations across processes for ACCELERATOR runs:
+    healthy tunnel windows are ~7 minutes and scarce, so compiles from
+    one window must carry into the next instead of re-lowering the same
+    fits. CPU-backend runs never enable it — a persisted CPU executable
+    embeds host-specific AOT code, and cross-process reloads emit
+    multi-KB machine-feature-mismatch spam (cpu_aot_loader.cc, SIGILL
+    warnings) that would pollute the stderr tails run_suite.sh commits
+    into bench records (and risk real SIGILL after a host rotation).
+    Best-effort — an old jax without the knobs just compiles as before.
+
+    Called only once the caller KNOWS an accelerator is reachable (after
+    the subprocess probe): asking jax itself would initialize the
+    backend, which is exactly the hang the probe exists to avoid."""
     import os
 
     try:
@@ -62,7 +71,6 @@ def probe_backend(timeout_s=60):
     import os
     import subprocess
 
-    _enable_compilation_cache()
     platform = os.environ.get("JAX_PLATFORMS", "")
     if platform == "cpu":
         # the env var alone is NOT sufficient when a sitecustomize
@@ -78,6 +86,8 @@ def probe_backend(timeout_s=60):
         subprocess.run(
             [sys.executable, "-c", "import jax; jax.devices()"],
             timeout=timeout_s, check=True, capture_output=True)
+        # accelerator reachable: persist its compiles across processes
+        _enable_compilation_cache()
     except (subprocess.TimeoutExpired, subprocess.CalledProcessError) as exc:
         print(f"# backend {platform!r} unreachable ({type(exc).__name__}); "
               "falling back to CPU", file=sys.stderr)

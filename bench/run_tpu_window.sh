@@ -1,0 +1,50 @@
+#!/bin/bash
+# Healthy-tunnel window runbook: bank the round's TPU evidence in strict
+# value order, assuming the window may close at any moment (observed
+# windows last ~7-20 min; every wedge struck during a >=200 MB upload,
+# which chunked_device_put now avoids).
+#
+#   1. probe     — 60 s; abort immediately if the tunnel is wedged
+#   2. MFU bench — on-device data, no upload risk, the VERDICT r2 #2 ask
+#   3. full suite (bench/run_suite.sh) — chunked uploads for #2/#3
+#   4. same-window CPU-pinned headline + config #3 — the loaded-host
+#      control VERDICT r2 weak #2 asks for (TPU and CPU measured under
+#      the same host load, so the ratio is interpretable)
+#
+# All output lands in bench/records/<UTC>_tpu_window/ for committing.
+# The persistent compile cache (/tmp/sq_jax_compile_cache) carries
+# compiles across windows — a re-run after a mid-window wedge resumes
+# cheaply.
+set -u
+cd "$(dirname "$0")/.."
+stamp="$(date -u +%Y%m%dT%H%M%SZ)"
+dir="bench/records/${stamp}_tpu_window"
+mkdir -p "$dir"
+
+echo "== probe =="
+if ! timeout 60 python -c "import jax; print(jax.devices())" \
+     > "$dir/probe.txt" 2>&1; then
+  echo "tunnel wedged (probe timeout) — aborting window run"
+  cat "$dir/probe.txt"
+  rm -rf "$dir"   # only the probe log is in it on this path
+  exit 1
+fi
+cat "$dir/probe.txt"
+
+echo "== 1/3 pallas MFU (on-device data) =="
+timeout 900 python -m bench.bench_pallas_mfu \
+  > "$dir/mfu.txt" 2>"$dir/mfu.err" || echo "mfu rc=$? (continuing)"
+tail -2 "$dir/mfu.txt" 2>/dev/null
+
+echo "== 2/3 full suite =="
+bash bench/run_suite.sh "$(pwd)/$dir/suite.txt" || echo "suite gate rc=$?"
+
+echo "== 3/3 same-window CPU control (headline + config 3) =="
+env -u PYTHONPATH JAX_PLATFORMS=cpu timeout 600 python bench.py \
+  > "$dir/cpu_control_headline.txt" 2>/dev/null || true
+env -u PYTHONPATH JAX_PLATFORMS=cpu timeout 900 \
+  python -m bench.bench_qkmeans_mnist \
+  > "$dir/cpu_control_mnist.txt" 2>/dev/null || true
+grep -h '^{' "$dir"/cpu_control_*.txt 2>/dev/null
+
+echo "window records in $dir — commit them"
