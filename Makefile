@@ -45,6 +45,7 @@ examples:
 	$(PYTHON) examples/tomography_histogram.py
 	$(PYTHON) examples/sharded_fit.py
 	$(PYTHON) examples/mnist_trial.py
+	$(PYTHON) examples/delta_tradeoff.py
 
 # The driver's multichip gate, runnable locally.
 multichip:

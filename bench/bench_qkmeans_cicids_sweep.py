@@ -4,6 +4,10 @@ point (README.rst:26-44 of the reference), plus wall-clock.
 
 Emits the headline JSON line for the δ=0.5 point; the full sweep goes to
 stderr.
+
+Config (50k rows, n_init=3) is pinned by BASELINE.md — the runnable demo
+of the same trade-off, ``examples/delta_tradeoff.py``, intentionally uses
+n_init=10 at a smaller size so init luck never muddies its curve.
 """
 
 import sys

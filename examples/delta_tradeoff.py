@@ -1,0 +1,89 @@
+"""The framework's thesis as one runnable driver: δ is an explicit
+accuracy/runtime dial.
+
+Sweeps the q-means quantum error budget δ over an overlapping-class
+dataset (the CICIDS-shaped surrogate, whose graded near-duplicate class
+pairs merge progressively as δ grows — reference ``README.rst:26-44``
+describes exactly this trade-off without ever measuring it) and prints
+ARI + wall-clock per δ beside a classical sklearn KMeans baseline.
+
+Run: python examples/delta_tradeoff.py [--n-samples 20000] [--n-init 10]
+
+Deliberately NOT the same configuration as the BASELINE bench
+(``bench/bench_qkmeans_cicids_sweep.py``: 50k rows, n_init=3 — pinned by
+BASELINE.md): this driver optimizes for a clean demonstration at a
+smaller default size, where 3 restarts can land in a pair-merging local
+optimum that muddies the curve; n_init=10 (sklearn's own default) makes
+δ the only variable.
+"""
+
+import argparse
+import os
+import sys
+import time
+import warnings
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _common import ensure_backend  # noqa: E402
+
+ensure_backend()
+warnings.filterwarnings("ignore")
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n-samples", type=int, default=20_000)
+    ap.add_argument("--n-init", type=int, default=10)  # sklearn KMeans default; 3 restarts can land in a pair-merging local optimum
+    args = ap.parse_args()
+
+    from sq_learn_tpu.datasets import load_cicids
+    from sq_learn_tpu.metrics import adjusted_rand_score
+    from sq_learn_tpu.models import QKMeans
+    from sq_learn_tpu.preprocessing import StandardScaler
+
+    X, y, real = load_cicids(n_samples=args.n_samples)
+    if len(X) > args.n_samples:
+        # the real-CSV branch of load_cicids returns every row; honor the
+        # flag by subsampling (deterministic) so quick demos stay quick
+        idx = np.random.default_rng(0).choice(
+            len(X), args.n_samples, replace=False)
+        X, y = X[idx], y[idx]
+    X = StandardScaler().fit_transform(X)
+    k = int(len(np.unique(y)))
+    print(f"dataset: {X.shape[0]}x{X.shape[1]}, k={k} "
+          f"({'real CICIDS' if real else 'surrogate'})")
+
+    try:
+        from sklearn.cluster import KMeans as SKKMeans
+        from sklearn.metrics import adjusted_rand_score as sk_ari
+
+        t0 = time.perf_counter()
+        sk = SKKMeans(n_clusters=k, n_init=args.n_init, random_state=0).fit(X)
+        print(f"classical sklearn KMeans: ARI "
+              f"{sk_ari(y, sk.labels_):.3f} in "
+              f"{time.perf_counter() - t0:.2f}s  (the exact answer at "
+              f"full classical cost)")
+    except Exception as exc:
+        print(f"(classical sklearn baseline unavailable: {exc} — "
+              "showing the δ-sweep alone)")
+
+    print(f"{'δ':>5} | {'ARI':>6} | {'fit s':>7} | note")
+    for delta in (0.0, 0.1, 0.3, 0.5, 1.0):
+        est = QKMeans(n_clusters=k, n_init=args.n_init, delta=delta,
+                      true_distance_estimate=False, random_state=0)
+        t0 = time.perf_counter()
+        est.fit(X)
+        t = time.perf_counter() - t0
+        ari = float(adjusted_rand_score(y, est.labels_))
+        note = ("exact classical Lloyd" if delta == 0
+                else "δ-window label noise")
+        print(f"{delta:5.1f} | {ari:6.3f} | {t:7.3f} | {note}")
+    print("\nδ=0 matches classical quality; growing δ trades clustering "
+          "accuracy for a cheaper quantum circuit — the dial the "
+          "reference's README describes.")
+
+
+if __name__ == "__main__":
+    main()
