@@ -35,6 +35,8 @@ bench-smoke:
 	SQ_BENCH_SMOKE=1 $(PYTHON) -m bench.bench_randomized_svd_covtype
 	SQ_BENCH_SMOKE=1 $(PYTHON) -m bench.bench_qkmeans_cicids_sweep
 	SQ_BENCH_SMOKE=1 $(PYTHON) -m bench.bench_estimator_surfaces
+	SQ_BENCH_SMOKE=1 $(PYTHON) -m bench.bench_pallas_mfu
+	SQ_BENCH_SMOKE=1 $(PYTHON) -m bench.bench_ipe_digits
 
 # The example drivers (streaming_fit stays manual: its accelerator probe
 # waits out a wedged tunnel for ~2 min before falling back; the rest

@@ -1,0 +1,72 @@
+"""Reference-default q-means configuration: IPE (true-distance-estimate)
+mode on digits — the mode the reference ships as its default
+(``_dmeans.py`` ``true_distance_estimate=True``), where every E-step
+simulates an inner-product-estimation circuit per (sample, centroid).
+
+No classical twin exists for this surface (sklearn has no quantum noise
+model), so ``vs_baseline`` is 1.0 by convention; the meaningful numbers
+ride in the extras: our fused-kernel fit wall-clock vs the measured cost
+of the reference's own architecture (11.4 ms per serial ``ipe()`` call →
+~1.3 h for this fit serial, measured in round 2's differential harness;
+``tests/test_reference_differential.py`` pins that both implementations
+draw from identical distributions).
+
+Not a BASELINE config — not part of run_suite.sh's 5-config acceptance
+gate; the TPU window runbook records it as a supplementary surface.
+"""
+
+import sys
+import warnings
+
+import numpy as np
+
+warnings.filterwarnings("ignore")
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from bench._common import emit, probe_backend, smoke_mode, timed  # noqa: E402
+
+#: measured in round 2 (reference Utility.py imported standalone, same
+#: host class): one serial python ipe() call
+_REF_SECONDS_PER_IPE_CALL = 0.0114
+
+
+def main():
+    probe_backend()
+    import jax
+
+    from sklearn.datasets import load_digits
+
+    from sq_learn_tpu.models import QKMeans
+
+    d = load_digits()
+    X, y = d.data.astype(np.float32), d.target
+    n_init = 1 if smoke_mode() else 10
+    if smoke_mode():
+        X, y = X[:400], y[:400]
+
+    def fit():
+        return QKMeans(n_clusters=10, n_init=n_init, delta=0.5,
+                       true_distance_estimate=True,  # IPE mode
+                       random_state=0).fit(X)
+
+    t, est = timed(fit, warmup=1, reps=1)
+    # the reference runs one ipe() per (sample, centroid) pair per
+    # E-step iteration, serially (Pool optional)
+    pairs_per_iter = X.shape[0] * 10
+    ref_serial_s = (_REF_SECONDS_PER_IPE_CALL * pairs_per_iter
+                    * max(1, int(est.n_iter_)) * n_init)
+    try:
+        from sklearn.metrics import adjusted_rand_score
+
+        ari = round(float(adjusted_rand_score(y, est.labels_)), 3)
+    except Exception:
+        ari = None
+    emit("qkmeans_ipe_digits_fit_wallclock", t, vs_baseline=1.0,
+         backend=jax.default_backend(), n_iter=int(est.n_iter_),
+         ari_vs_labels=ari,
+         ref_architecture_serial_estimate_s=round(ref_serial_s, 1),
+         ref_vs_ours=round(ref_serial_s / t, 1))
+
+
+if __name__ == "__main__":
+    main()

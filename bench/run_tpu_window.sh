@@ -10,6 +10,8 @@
 #   4. same-window CPU-pinned headline + config #3 — the loaded-host
 #      control VERDICT r2 weak #2 asks for (TPU and CPU measured under
 #      the same host load, so the ratio is interpretable)
+#   5. IPE-mode digits — supplementary surface, lowest value, runs last
+#      so a closing window sacrifices it first
 #
 # All output lands in bench/records/<UTC>_tpu_window/ for committing.
 # The persistent compile cache (/tmp/sq_jax_compile_cache) carries
@@ -39,12 +41,17 @@ tail -2 "$dir/mfu.txt" 2>/dev/null
 echo "== 2/3 full suite =="
 bash bench/run_suite.sh "$(pwd)/$dir/suite.txt" || echo "suite gate rc=$?"
 
-echo "== 3/3 same-window CPU control (headline + config 3) =="
+echo "== 3/4 same-window CPU control (headline + config 3) =="
 env -u PYTHONPATH JAX_PLATFORMS=cpu timeout 600 python bench.py \
   > "$dir/cpu_control_headline.txt" 2>/dev/null || true
 env -u PYTHONPATH JAX_PLATFORMS=cpu timeout 900 \
   python -m bench.bench_qkmeans_mnist \
   > "$dir/cpu_control_mnist.txt" 2>/dev/null || true
 grep -h '^{' "$dir"/cpu_control_*.txt 2>/dev/null
+
+echo "== 4/4 reference-default IPE mode (supplementary, skippable) =="
+timeout 900 python -m bench.bench_ipe_digits \
+  > "$dir/ipe.txt" 2>"$dir/ipe.err" || echo "ipe rc=$? (continuing)"
+tail -1 "$dir/ipe.txt" 2>/dev/null
 
 echo "window records in $dir — commit them"
