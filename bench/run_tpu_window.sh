@@ -4,14 +4,16 @@
 # windows last ~7-20 min; every wedge struck during a >=200 MB upload,
 # which chunked_device_put now avoids).
 #
-#   1. probe     — 60 s; abort immediately if the tunnel is wedged
-#   2. MFU bench — on-device data, no upload risk, the VERDICT r2 #2 ask
-#   3. full suite (bench/run_suite.sh) — chunked uploads for #2/#3
-#   4. same-window CPU-pinned headline + config #3 — the loaded-host
-#      control VERDICT r2 weak #2 asks for (TPU and CPU measured under
-#      the same host load, so the ratio is interpretable)
-#   5. IPE-mode digits — supplementary surface, lowest value, runs last
-#      so a closing window sacrifices it first
+#   probe — 60 s; abort immediately if the tunnel is wedged
+#   0.    kernel lowering smoke — seconds; names any Mosaic rejection
+#         before real window time is spent (exit 2 = fell back to CPU)
+#   1/4.  MFU bench — on-device data, no upload risk (VERDICT r2 #2)
+#   2/4.  full suite (bench/run_suite.sh) — chunked uploads for #2/#3
+#   3/4.  same-window CPU-pinned headline + config #3 — the loaded-host
+#         control VERDICT r2 weak #2 asks for (TPU and CPU measured
+#         under the same host load, so the ratio is interpretable)
+#   4/4.  IPE-mode digits — supplementary surface, lowest value, runs
+#         last so a closing window sacrifices it first
 #
 # All output lands in bench/records/<UTC>_tpu_window/ for committing.
 # The persistent compile cache (/tmp/sq_jax_compile_cache) carries
@@ -33,12 +35,18 @@ if ! timeout 60 python -c "import jax; print(jax.devices())" \
 fi
 cat "$dir/probe.txt"
 
-echo "== 1/3 pallas MFU (on-device data) =="
+echo "== 0. kernel lowering smoke (seconds; names any Mosaic rejection) =="
+timeout 300 python -m bench.tpu_kernel_smoke \
+  > "$dir/kernel_smoke.txt" 2>"$dir/kernel_smoke.err" \
+  || echo "kernel smoke rc=$? — see kernel_smoke.txt (continuing)"
+cat "$dir/kernel_smoke.txt" 2>/dev/null
+
+echo "== 1/4 pallas MFU (on-device data) =="
 timeout 900 python -m bench.bench_pallas_mfu \
   > "$dir/mfu.txt" 2>"$dir/mfu.err" || echo "mfu rc=$? (continuing)"
 tail -2 "$dir/mfu.txt" 2>/dev/null
 
-echo "== 2/3 full suite =="
+echo "== 2/4 full suite =="
 bash bench/run_suite.sh "$(pwd)/$dir/suite.txt" || echo "suite gate rc=$?"
 
 echo "== 3/4 same-window CPU control (headline + config 3) =="
