@@ -37,7 +37,7 @@ bench-smoke:
 	SQ_BENCH_SMOKE=1 $(PYTHON) -m bench.bench_estimator_surfaces
 	SQ_BENCH_SMOKE=1 $(PYTHON) -m bench.bench_pallas_mfu
 	SQ_BENCH_SMOKE=1 $(PYTHON) -m bench.bench_ipe_digits
-	JAX_PLATFORMS=cpu SQ_BENCH_SMOKE=1 $(PYTHON) -m bench.tpu_kernel_smoke
+	JAX_PLATFORMS=cpu $(PYTHON) -m bench.tpu_kernel_smoke
 
 # The example drivers (streaming_fit stays manual: its accelerator probe
 # waits out a wedged tunnel for ~2 min before falling back; the rest

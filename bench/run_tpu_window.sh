@@ -37,9 +37,18 @@ cat "$dir/probe.txt"
 
 echo "== 0. kernel lowering smoke (seconds; names any Mosaic rejection) =="
 timeout 300 python -m bench.tpu_kernel_smoke \
-  > "$dir/kernel_smoke.txt" 2>"$dir/kernel_smoke.err" \
-  || echo "kernel smoke rc=$? — see kernel_smoke.txt (continuing)"
+  > "$dir/kernel_smoke.txt" 2>"$dir/kernel_smoke.err"
+smoke_rc=$?
 cat "$dir/kernel_smoke.txt" 2>/dev/null
+if [ "$smoke_rc" -eq 2 ]; then
+  # tunnel wedged between the top probe and the smoke's own probe: the
+  # TPU stages would all burn their probes and record CPU fallbacks
+  # masquerading as a window — stop here, like the initial probe abort
+  echo "tunnel lost after initial probe (smoke NOT-CHIP) — aborting"
+  exit 1
+fi
+[ "$smoke_rc" -ne 0 ] && echo "kernel smoke rc=$smoke_rc — see" \
+  "kernel_smoke.txt (continuing: XLA fallbacks still bank numbers)"
 
 echo "== 1/4 pallas MFU (on-device data) =="
 timeout 900 python -m bench.bench_pallas_mfu \

@@ -75,6 +75,11 @@ def main():
     mode = "interpret" if interpret else "mosaic"
     print(f"kernel smoke on backend={backend} ({mode}): "
           f"{len(checks) - len(failed)}/{len(checks)} pass")
+    # rc contract: 1 = kernel failure (always wins — a regression must
+    # never be read as a mere tunnel problem), 2 = all kernels passed but
+    # only in interpreter fallback (requested chip unreachable), 0 = ok.
+    if failed:
+        sys.exit(1)
     if wanted_chip and interpret:
         # the tunnel wedged between the caller's probe and ours: these
         # PASSes are interpreter runs, NOT Mosaic validation — refuse to
@@ -82,7 +87,7 @@ def main():
         print("NOT-CHIP: accelerator was requested but the probe fell "
               "back to CPU — no Mosaic lowering was exercised")
         sys.exit(2)
-    sys.exit(1 if failed else 0)
+    sys.exit(0)
 
 
 if __name__ == "__main__":

@@ -237,6 +237,25 @@ class TestMnistTrialPipeline:
                                       serial["train_score"])
         assert len(parallel["fit_time"]) == 6
 
+    def test_parallel_cv_rejects_n_jobs_zero(self, digits):
+        X, y = digits
+        with pytest.raises(ValueError, match="n_jobs == 0"):
+            cross_validate(KNeighborsClassifier(3), X[:100], y[:100],
+                           cv=StratifiedKFold(2), n_jobs=0)
+
+    def test_parallel_cv_propagates_worker_exception(self, digits):
+        """A fold failure inside the thread pool must surface to the
+        caller, not vanish into a worker thread."""
+        X, y = digits
+
+        class ExplodingKNN(KNeighborsClassifier):
+            def fit(self, X, y):
+                raise RuntimeError("boom in fold")
+
+        with pytest.raises(RuntimeError, match="boom in fold"):
+            cross_validate(ExplodingKNN(3), X[:200], y[:200],
+                           cv=StratifiedKFold(4), n_jobs=4)
+
     def test_parallel_cv_propagates_config_context(self, digits):
         """Worker threads must see the caller's config_context, not the
         global defaults (the config dict is thread-local)."""
