@@ -35,7 +35,9 @@ import numpy as np  # noqa: E402
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--n-samples", type=int, default=20_000)
-    ap.add_argument("--n-init", type=int, default=10)  # sklearn KMeans default; 3 restarts can land in a pair-merging local optimum
+    # sklearn's KMeans default; fewer restarts can land in a
+    # pair-merging local optimum (see module docstring)
+    ap.add_argument("--n-init", type=int, default=10)
     args = ap.parse_args()
 
     from sq_learn_tpu.datasets import load_cicids
