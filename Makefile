@@ -4,7 +4,8 @@
 
 PYTHON ?= python
 
-.PHONY: test test-fast lint bench bench-smoke bench-suite multichip examples all
+.PHONY: test test-fast lint bench bench-smoke bench-suite multichip examples \
+    hunt all
 
 all: lint test
 
@@ -58,3 +59,8 @@ multichip:
 # Full BASELINE suite (headline + configs #2-#5) into one record file.
 bench-suite:
 	bash bench/run_suite.sh
+
+# Round-long automated TPU window hunt: probe every ~4 min, fire the
+# window runbook on the first healthy probe, log every attempt.
+hunt:
+	bash bench/hunt_tpu_window.sh

@@ -20,14 +20,19 @@ def timed(fn, *args, warmup=1, reps=1, **kwargs):
 
 
 def emit(metric, value, unit="s", vs_baseline=1.0, **extra):
-    """Print the ONE machine-readable JSON line (extras go to stderr)."""
+    """Print the ONE machine-readable JSON line (extras go to stderr).
+
+    ``vs_baseline=None`` means "no baseline was measured" and is emitted
+    as JSON null — run_suite.sh's acceptance gate counts that as a MISS,
+    so a failed baseline can never silently pass as a 1.0 ratio."""
     if extra:
         print("# " + json.dumps(extra), file=sys.stderr)
     print(json.dumps({
         "metric": metric,
         "value": round(float(value), 4),
         "unit": unit,
-        "vs_baseline": round(float(vs_baseline), 3),
+        "vs_baseline": (None if vs_baseline is None
+                        else round(float(vs_baseline), 3)),
     }))
 
 

@@ -67,7 +67,7 @@ def main():
         print(f"# sklearn baseline unavailable: {exc}", file=sys.stderr)
 
     emit("qkmeans_cicids_delta_sweep_fit_wallclock", headline_t,
-         vs_baseline=(sk_t / headline_t) if sk_t else 1.0,
+         vs_baseline=(sk_t / headline_t) if sk_t else None,
          sweep=sweep, sklearn_s=sk_t, sklearn_ari=sk_ari, real_cicids=real)
 
 

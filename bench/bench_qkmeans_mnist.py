@@ -57,7 +57,7 @@ def main():
         print(f"# sklearn baseline unavailable: {exc}", file=sys.stderr)
 
     emit("qkmeans_mnist_70kx784_k10_fit_wallclock", ours_t,
-         vs_baseline=(sk_t / ours_t) if sk_t else 1.0,
+         vs_baseline=(sk_t / ours_t) if sk_t else None,
          sklearn_s=sk_t, ari_vs_sklearn=ari,
          devices=len(jax.devices()), real_mnist=real,
          compute_dtype=compute_dtype or "float32")

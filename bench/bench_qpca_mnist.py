@@ -59,7 +59,7 @@ def main():
     # partial-U gate can reject the hint, e.g. subsampled smoke shapes)
     engaged = getattr(pca, "effective_compute_dtype_", None)
     emit("qpca_mnist_70kx784_c50_fit_wallclock", ours_t,
-         vs_baseline=(sk_t / ours_t) if sk_t else 1.0,
+         vs_baseline=(sk_t / ours_t) if sk_t else None,
          sklearn_s=sk_t, explained_variance_parity=ev_parity,
          real_mnist=real, compute_dtype=engaged or "float32")
 

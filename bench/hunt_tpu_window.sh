@@ -1,0 +1,50 @@
+#!/bin/bash
+# Round-long automated TPU-window hunter (VERDICT r3 next-round #1).
+#
+# The axon tunnel wedges for hours and opens in ~7-20 min healthy
+# windows at unpredictable times; a human-in-the-loop "try the runbook
+# when you remember" cadence missed every window in round 3. This loop
+# makes the attempt record automatic: a cheap 60 s subprocess probe
+# every ~4 min, the full runbook (bench/run_tpu_window.sh) fired the
+# moment a probe answers, and EVERY attempt — wedged probes included —
+# appended to bench/records/window_hunt_r04.log so the hunt itself is
+# committable evidence even if no window ever opens.
+#
+# Deliberately does NOT git-commit: the foreground session owns the
+# index; it watches the log and .window_landed marker instead.
+#
+#   HUNT_INTERVAL_S  sleep between wedged probes (default 240)
+#   HUNT_MAX_S       total hunt lifetime (default 39600 = 11 h, so the
+#                    process exits before the round driver does)
+set -u
+cd "$(dirname "$0")/.."
+log="bench/records/window_hunt_r04.log"
+mkdir -p bench/records
+interval="${HUNT_INTERVAL_S:-240}"
+max_s="${HUNT_MAX_S:-39600}"
+start=$SECONDS
+echo "$(date -u +%Y%m%dT%H%M%SZ) HUNT-START interval=${interval}s max=${max_s}s" >> "$log"
+while [ $((SECONDS - start)) -lt "$max_s" ]; do
+  ts="$(date -u +%Y%m%dT%H%M%SZ)"
+  if timeout 60 python -c "import jax; print(jax.devices())" \
+       > /tmp/hunt_probe.txt 2>&1; then
+    echo "$ts PROBE-OK $(tr '\n' ' ' < /tmp/hunt_probe.txt | tail -c 200)" >> "$log"
+    echo "$ts WINDOW-START" >> "$log"
+    bash bench/run_tpu_window.sh >> "$log" 2>&1
+    rc=$?
+    echo "$(date -u +%Y%m%dT%H%M%SZ) WINDOW-END rc=$rc" >> "$log"
+    # marker = "a runbook run actually banked records" — an rc!=0 abort
+    # (tunnel wedged between probe and smoke) leaves nothing to commit
+    [ "$rc" -eq 0 ] && date -u +%Y%m%dT%H%M%SZ > bench/records/.window_landed
+    # a window just ran (or aborted mid-wedge); cool off before
+    # re-probing so back-to-back runbook fires don't duplicate records
+    sleep 600
+  else
+    # keep the probe's tail: a broken-env failure (ImportError, plugin
+    # error) must stay distinguishable from a genuinely wedged tunnel in
+    # the committed hunt log
+    echo "$ts PROBE-WEDGED $(tr '\n' ' ' < /tmp/hunt_probe.txt | tail -c 160)" >> "$log"
+    sleep "$interval"
+  fi
+done
+echo "$(date -u +%Y%m%dT%H%M%SZ) HUNT-END" >> "$log"

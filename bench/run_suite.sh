@@ -92,9 +92,12 @@ for line in open(sys.argv[1]):
     if "metric" not in rec or "vs_baseline" not in rec:
         continue
     seen += 1
-    ok = rec["vs_baseline"] >= 0.5
+    vb = rec["vs_baseline"]
+    # null = the script measured no baseline (emit(vs_baseline=None));
+    # an unmeasured baseline is a miss, not a free pass
+    ok = isinstance(vb, (int, float)) and vb >= 0.5
     print(f"# ACCEPT {'pass' if ok else 'FAIL'}: {rec['metric']} "
-          f"vs_baseline={rec['vs_baseline']}")
+          f"vs_baseline={vb}")
     if not ok:
         fails.append(rec["metric"])
 if fails or seen != expected:

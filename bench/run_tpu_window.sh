@@ -40,11 +40,13 @@ timeout 300 python -m bench.tpu_kernel_smoke \
   > "$dir/kernel_smoke.txt" 2>"$dir/kernel_smoke.err"
 smoke_rc=$?
 cat "$dir/kernel_smoke.txt" 2>/dev/null
-if [ "$smoke_rc" -eq 2 ]; then
-  # tunnel wedged between the top probe and the smoke's own probe: the
-  # TPU stages would all burn their probes and record CPU fallbacks
-  # masquerading as a window — stop here, like the initial probe abort
-  echo "tunnel lost after initial probe (smoke NOT-CHIP) — aborting"
+if [ "$smoke_rc" -eq 2 ] || [ "$smoke_rc" -ge 124 ]; then
+  # rc=2: tunnel wedged between the top probe and the smoke's own probe.
+  # rc>=124: the smoke hung (timeout kill) or died on a signal — the
+  # wedge struck mid-run before the smoke could classify it. Either way
+  # the TPU stages would all burn their probes and record CPU fallbacks
+  # masquerading as a window — stop here, like the initial probe abort.
+  echo "tunnel lost after initial probe (smoke rc=$smoke_rc) — aborting"
   exit 1
 fi
 [ "$smoke_rc" -ne 0 ] && echo "kernel smoke rc=$smoke_rc — see" \

@@ -27,6 +27,15 @@ from ..ops.linalg import (check_compute_dtype, is_reduced,
                           pairwise_sq_distances)
 from ..utils import check_array, check_X_y
 
+# (backend, k) pairs where the pallas argkmin was structurally rejected
+# (lowering / compile): with use_pallas='auto' the failed trace + warning
+# would otherwise repeat on every predict call — pay it once per process.
+# Keyed by k because the kernel's unrolled k-round selection is the part
+# Mosaic may reject for a pathological k; a rejection there must not
+# blacklist the kernel for every other model in the process (same
+# signature discipline as QKMeans._kernel_ladder).
+_argkmin_rejected = set()
+
 
 @functools.partial(jax.jit, static_argnames=("k", "block", "compute_dtype"))
 def knn_indices(X_train, X_query, k, block=4096, compute_dtype=None):
@@ -179,8 +188,13 @@ class KNeighborsClassifier(ClassifierMixin, BaseEstimator):
         failing the predict (same contract as QKMeans._kernel_ladder)."""
         from ..ops.pallas_kernels import argkmin_pallas, pallas_available
 
+        backend = jax.default_backend()
         if self.use_pallas == "auto":
-            use, interpret = pallas_available(), False
+            # skip a kernel this process already saw Mosaic reject; an
+            # explicit use_pallas=True keeps trying (user override)
+            use = (pallas_available()
+                   and (backend, k) not in _argkmin_rejected)
+            interpret = False
         else:
             use = bool(self.use_pallas)
             interpret = use and not pallas_available()
@@ -200,6 +214,10 @@ class KNeighborsClassifier(ClassifierMixin, BaseEstimator):
             except Exception as exc:  # pragma: no cover - hardware-specific
                 import warnings as _warnings
 
+                from .qkmeans import _memoizable_kernel_failure
+
+                if _memoizable_kernel_failure(exc):
+                    _argkmin_rejected.add((backend, k))
                 _warnings.warn(
                     f"pallas argkmin rejected ({type(exc).__name__}: {exc});"
                     " falling back to the XLA search")

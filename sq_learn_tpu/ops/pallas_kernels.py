@@ -35,7 +35,12 @@ def _make_lloyd_kernel(window):
 
     The X/centers blocks may arrive in bfloat16 (MXU-native): both GEMMs
     accumulate in float32 via ``preferred_element_type``, and every
-    reduction buffer (sums/counts/inertia/min_d2) stays float32."""
+    reduction buffer (sums/counts/inertia/min_d2) stays float32. Sample
+    weights never round through bfloat16 asymmetrically: the M-step GEMM
+    multiplies them into the x rows in float32 (one rounding of w·x into
+    the GEMM dtype) while the onehot operand stays an exact 0/1 mask, and
+    counts apply the same float32 weights — so the centroid update's
+    numerator and denominator see consistent weights."""
     delta_mode = window > 0
 
     def kernel(x_ref, xsq_ref, w_ref, c_ref, csq_ref, *refs):
@@ -72,7 +77,8 @@ def _make_lloyd_kernel(window):
 
         k = c.shape[0]
         col_ids = jax.lax.broadcasted_iota(jnp.int32, (x.shape[0], k), 1)
-        onehot = jnp.where(labels[:, None] == col_ids, 1.0, 0.0) * w_ref[:]
+        w = w_ref[:]
+        onehot = jnp.where(labels[:, None] == col_ids, 1.0, 0.0)
 
         @pl.when(i == 0)
         def _():
@@ -80,11 +86,15 @@ def _make_lloyd_kernel(window):
             counts_ref[:] = jnp.zeros_like(counts_ref)
             inertia_ref[:] = jnp.zeros_like(inertia_ref)
 
-        # MXU again: partial centroid sums, accumulated across tiles (the
-        # cast matches the GEMM operand dtype; counts/inertia stay f32)
-        sums_ref[:] += jnp.dot(onehot.astype(x.dtype).T, x,
+        # MXU again: partial centroid sums, accumulated across tiles. The
+        # weight multiply happens in f32 on the x rows (one rounding of
+        # w·x into the GEMM dtype); the onehot operand is an exact 0/1
+        # mask in any dtype, and counts reuse the exact f32 weights — so
+        # bf16 mode rounds numerator and denominator consistently.
+        xw = (x.astype(jnp.float32) * w).astype(x.dtype)
+        sums_ref[:] += jnp.dot(onehot.astype(x.dtype).T, xw,
                                preferred_element_type=jnp.float32)
-        counts_ref[:] += jnp.sum(onehot, axis=0, keepdims=True)
+        counts_ref[:] += jnp.sum(onehot * w, axis=0, keepdims=True)
         inertia_ref[:] += jnp.sum(
             min_d2 * w_ref[:], keepdims=True).reshape(1, 1)
 

@@ -86,6 +86,10 @@ def test_bench_emits_valid_json_line():
     # ~0.96-0.98 (local-optimum noise); our median-over-3-seeds measured
     # 0.978-0.983 across CPU and TPU windows of record, while any real
     # quality bug (mis-tuned δ, broken relocation) lands far below 0.9.
+    # The floor sits at 0.95 — comfortably under the observed 0.978 low
+    # of a seed-dependent statistic (a 0.97 floor was ~0.008 from it,
+    # i.e. one unlucky seed/host pairing from a false CI failure; ADVICE
+    # r3) yet still far above where any real bug lands.
     # (bench.py emits the quality keys only when its sklearn baseline ran;
     # this environment bundles sklearn, so their absence is itself a bug.)
     ari = rec.get("ari_vs_sklearn_median3")
@@ -93,5 +97,5 @@ def test_bench_emits_valid_json_line():
     assert ari is not None and inertia is not None, (
         f"bench.py emitted no quality fields — sklearn baseline path "
         f"failed unexpectedly: {rec}")
-    assert ari >= 0.97, rec
+    assert ari >= 0.95, rec
     assert abs(inertia - 1.0) <= 0.01, rec
