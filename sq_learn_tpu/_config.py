@@ -156,6 +156,36 @@ def on_cpu_backend():
             or _get_threadlocal_config()["device"].startswith("cpu"))
 
 
+#: Fits whose input has at most this many elements (n_samples × n_features)
+#: are dispatch-bound, not compute-bound, on a remote accelerator: at
+#: digits scale (1797×64 ≈ 115k elements) the arithmetic is sub-millisecond
+#: on either engine, so wall-clock is pure host↔device round-trips — which
+#: over the tunneled chip measured 20× slower than the host engines (round-1
+#: TPU headline: 1.43 s vs 0.063 s sklearn). 2^18 elements = 1 MiB of f32,
+#: comfortably past digits while 3 decades under the MNIST/covtype configs
+#: that genuinely use the chip. Set SQ_TINY_FIT_ELEMENTS=0 to disable.
+_TINY_FIT_ELEMENTS = int(os.environ.get("SQ_TINY_FIT_ELEMENTS", 1 << 18))
+
+
+def route_tiny_fit_to_host(n_elements):
+    """Dispatch policy for tiny fits when the default backend is a remote
+    accelerator: True = run the fit on the host CPU engines instead of
+    paying tunnel round-trips that dominate digit-scale problems.
+
+    Only engages under ``device='auto'`` — an explicit
+    ``set_config(device='tpu')`` (or ``'cpu'``) pin is always respected,
+    which is also the escape hatch for deliberately timing the chip on a
+    tiny problem."""
+    cfg = _get_threadlocal_config()
+    if cfg["device"] != "auto" or _TINY_FIT_ELEMENTS <= 0:
+        return False
+    import jax
+
+    if jax.default_backend() == "cpu":
+        return False
+    return n_elements <= _TINY_FIT_ELEMENTS
+
+
 def device_scope():
     """Context manager scoping computation to the configured device.
 

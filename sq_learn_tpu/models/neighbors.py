@@ -27,13 +27,14 @@ from ..ops.linalg import (check_compute_dtype, is_reduced,
                           pairwise_sq_distances)
 from ..utils import check_array, check_X_y
 
-# (backend, k) pairs where the pallas argkmin was structurally rejected
-# (lowering / compile): with use_pallas='auto' the failed trace + warning
-# would otherwise repeat on every predict call — pay it once per process.
-# Keyed by k because the kernel's unrolled k-round selection is the part
-# Mosaic may reject for a pathological k; a rejection there must not
-# blacklist the kernel for every other model in the process (same
-# signature discipline as QKMeans._kernel_ladder).
+# (backend, k, n_features) triples where the pallas argkmin was
+# structurally rejected (lowering / compile): with use_pallas='auto' the
+# failed trace + warning would otherwise repeat on every predict call —
+# pay it once per process. The key carries the operand properties that
+# shape the kernel (k drives the unrolled selection rounds, n_features
+# the VMEM tile width; query count only changes the grid length) so an
+# input-dependent rejection cannot blacklist the kernel for other models
+# (same signature discipline as QKMeans._kernel_ladder).
 _argkmin_rejected = set()
 
 
@@ -188,12 +189,11 @@ class KNeighborsClassifier(ClassifierMixin, BaseEstimator):
         failing the predict (same contract as QKMeans._kernel_ladder)."""
         from ..ops.pallas_kernels import argkmin_pallas, pallas_available
 
-        backend = jax.default_backend()
+        sig = (jax.default_backend(), k, self.n_features_in_)
         if self.use_pallas == "auto":
             # skip a kernel this process already saw Mosaic reject; an
             # explicit use_pallas=True keeps trying (user override)
-            use = (pallas_available()
-                   and (backend, k) not in _argkmin_rejected)
+            use = pallas_available() and sig not in _argkmin_rejected
             interpret = False
         else:
             use = bool(self.use_pallas)
@@ -217,7 +217,7 @@ class KNeighborsClassifier(ClassifierMixin, BaseEstimator):
                 from .qkmeans import _memoizable_kernel_failure
 
                 if _memoizable_kernel_failure(exc):
-                    _argkmin_rejected.add((backend, k))
+                    _argkmin_rejected.add(sig)
                 _warnings.warn(
                     f"pallas argkmin rejected ({type(exc).__name__}: {exc});"
                     " falling back to the XLA search")

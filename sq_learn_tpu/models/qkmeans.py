@@ -1069,6 +1069,26 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         X = check_array(X, copy=False)
         self.n_features_in_ = X.shape[1]
         self._check_params(X)
+        from .._config import (config_context, device_scope,
+                               route_tiny_fit_to_host)
+
+        if (self.mesh is None and self.use_pallas == "auto"
+                and route_tiny_fit_to_host(X.size)):
+            # Size-aware dispatch: a digit-scale fit on a remote
+            # accelerator is pure tunnel latency (the round-1 TPU headline
+            # measured 20× slower than the host engines on 1797×64) — run
+            # it on the host instead of letting wall-clock hinge on link
+            # health. Explicit device/mesh/use_pallas settings bypass this
+            # (see _config.route_tiny_fit_to_host).
+            self.fit_backend_ = "cpu:tiny-routed"
+            with config_context(device="cpu"), device_scope():
+                return self._fit_impl(X, sample_weight)
+        self.fit_backend_ = ("cpu" if self._on_cpu_backend()
+                             else jax.default_backend())
+        return self._fit_impl(X, sample_weight)
+
+    def _fit_impl(self, X, sample_weight):
+        """The fit body proper, on whatever backend :meth:`fit` routed to."""
         delta = 0.0 if self.delta is None else float(self.delta)
         if delta == 0:
             warnings.warn("Attention! You are running the classic version of "

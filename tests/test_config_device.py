@@ -181,3 +181,66 @@ def test_other_estimators_respect_device(blobs):
         knn = KNeighborsClassifier(n_neighbors=3).fit(X, (y % 2))
         assert knn.X_fit_.devices() == {jax.devices("cpu")[4]}
         assert knn.score(X[:50], (y % 2)[:50]) > 0.5
+
+
+class TestTinyFitHostRouting:
+    """Size-aware dispatch (VERDICT r3 next #4): digit-scale fits on a
+    remote accelerator are pure tunnel latency, so fit() routes them to
+    the host engines — explicitly, testably, instead of depending on
+    link health. No accelerator exists under the test conftest, so the
+    backend is faked at the predicate's seam (jax.default_backend)."""
+
+    def test_policy_predicate(self, monkeypatch):
+        from sq_learn_tpu import _config
+
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        digits, mnist = 1797 * 64, 70_000 * 784
+        assert _config.route_tiny_fit_to_host(digits)
+        assert not _config.route_tiny_fit_to_host(mnist)
+        # explicit pins are respected in BOTH directions: 'tpu' = the
+        # user wants the chip timed, 'cpu' already routes everything
+        with config_context(device="tpu"):
+            assert not _config.route_tiny_fit_to_host(digits)
+        with config_context(device="cpu"):
+            assert not _config.route_tiny_fit_to_host(digits)
+        # env kill-switch
+        monkeypatch.setattr(_config, "_TINY_FIT_ELEMENTS", 0)
+        assert not _config.route_tiny_fit_to_host(digits)
+
+    def test_policy_off_on_cpu_backend(self):
+        from sq_learn_tpu import _config
+
+        # the real test backend IS cpu: never route (nothing to dodge)
+        assert not _config.route_tiny_fit_to_host(1797 * 64)
+
+    def test_fit_routes_and_matches_unrouted_results(self, blobs,
+                                                     monkeypatch):
+        X, _ = blobs
+        from sq_learn_tpu import _config
+
+        base = QKMeans(n_clusters=4, n_init=2, delta=0.5,
+                       true_distance_estimate=False, random_state=0).fit(X)
+        assert base.fit_backend_ == "cpu"
+
+        # force the routing decision on (as a remote-accelerator process
+        # would take it); on this CPU host the rerouted fit must be the
+        # same computation, so results match the unrouted fit exactly
+        monkeypatch.setattr(_config, "route_tiny_fit_to_host",
+                            lambda n: True)
+        routed = QKMeans(n_clusters=4, n_init=2, delta=0.5,
+                         true_distance_estimate=False, random_state=0).fit(X)
+        assert routed.fit_backend_ == "cpu:tiny-routed"
+        np.testing.assert_array_equal(routed.labels_, base.labels_)
+        np.testing.assert_allclose(routed.cluster_centers_,
+                                   base.cluster_centers_, rtol=1e-6)
+
+    def test_explicit_settings_bypass_routing(self, blobs, monkeypatch):
+        X, _ = blobs
+        from sq_learn_tpu import _config
+
+        monkeypatch.setattr(_config, "route_tiny_fit_to_host",
+                            lambda n: True)
+        # forcing a kernel choice opts out of the size heuristic
+        est = QKMeans(n_clusters=4, n_init=1, delta=0.0, use_pallas=False,
+                      random_state=0).fit(X)
+        assert est.fit_backend_ != "cpu:tiny-routed"

@@ -73,12 +73,16 @@ def test_bench_emits_valid_json_line():
     # BASELINE acceptance bar (0.5); that bar is enforced by
     # bench/run_suite.sh on the measurement of record, not here. The unit
     # suite only pins that the ratio is well-formed, warning when low.
-    assert rec["vs_baseline"] > 0, rec
-    if rec["vs_baseline"] < 0.5:
+    # vs_baseline is null when the sklearn baseline failed to run — the
+    # purpose-built assertion on the quality fields below produces the
+    # readable failure for that case, so only compare when it's a number
+    vb = rec["vs_baseline"]
+    assert vb is None or vb > 0, rec
+    if vb is not None and vb < 0.5:
         import warnings
 
         warnings.warn(
-            f"bench.py vs_baseline={rec['vs_baseline']} below the 0.5 "
+            f"bench.py vs_baseline={vb} below the 0.5 "
             "acceptance bar (host load?) — run_suite.sh is the gate")
     # QUALITY floors are load-independent and therefore hard-asserted: a
     # regression that trades clustering accuracy for speed must fail CI.
