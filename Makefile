@@ -38,6 +38,7 @@ bench-smoke:
 	SQ_BENCH_SMOKE=1 $(PYTHON) -m bench.bench_estimator_surfaces
 	SQ_BENCH_SMOKE=1 $(PYTHON) -m bench.bench_pallas_mfu
 	SQ_BENCH_SMOKE=1 $(PYTHON) -m bench.bench_ipe_digits
+	SQ_BENCH_SMOKE=1 $(PYTHON) -m bench.bench_qpca_error_sweep
 	JAX_PLATFORMS=cpu $(PYTHON) -m bench.tpu_kernel_smoke
 
 # The example drivers (streaming_fit stays manual: its accelerator probe
@@ -50,6 +51,7 @@ examples:
 	$(PYTHON) examples/sharded_fit.py
 	$(PYTHON) examples/mnist_trial.py
 	$(PYTHON) examples/delta_tradeoff.py
+	$(PYTHON) examples/qpca_error_tradeoff.py --subsample 4000 --folds 3
 
 # The driver's multichip gate, runnable locally.
 multichip:

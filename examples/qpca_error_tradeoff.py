@@ -1,0 +1,100 @@
+"""The second estimator's thesis as one runnable driver: ε+δ is an
+explicit accuracy/precision dial on qPCA's quantum representation.
+
+Mirrors what ``delta_tradeoff.py`` demonstrates for q-means, on the
+reference's own MNIST experiment pattern (``sklearn/MnistTrial.py:10-28``,
+``README.rst:26-44``): fit PCA once, then sweep the total tomography error
+ε+δ applied to the transformed representation and report, per error level,
+the stratified-CV KNN accuracy, the F-norm deviation of the estimated
+representation from the exact one, and the transform wall-clock — beside
+the classical zero-error baseline.
+
+Two datasets make the demonstration honest offline: the MNIST-shaped
+surrogate's synthetic classes have angular margins larger than any noise
+the reference's tomography model can produce (its sample complexity
+N=36·d·ln d/δ² floors the achievable error), so its accuracy column stays
+flat — the CICIDS-shaped surrogate's graded near-duplicate classes show
+the dial actually bending.
+
+Run: python examples/qpca_error_tradeoff.py [--subsample 8000] [--folds 5]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _common import ensure_backend  # noqa: E402
+
+ensure_backend()
+
+import numpy as np  # noqa: E402
+
+ERRORS = (0.2, 0.8, 1.6, 3.2)
+
+
+def sweep_table(name, pca, X, y, folds):
+    from sq_learn_tpu.model_selection import StratifiedKFold, cross_validate
+    from sq_learn_tpu.models import KNeighborsClassifier
+
+    def knn_cv(Z):
+        res = cross_validate(
+            KNeighborsClassifier(n_neighbors=7), Z, y,
+            cv=StratifiedKFold(folds))
+        return float(np.mean(res["test_score"]))
+
+    acc_c = knn_cv(pca.transform(X))
+    print(f"\n{name}: classical transform {folds}-fold KNN accuracy "
+          f"{acc_c:.4f}  (the exact answer, ε+δ=0)")
+    print(f"{'ε+δ':>5} | {'KNN acc':>8} | {'F-norm err':>10} | "
+          f"{'transform s':>11}")
+    for err in ERRORS:
+        t0 = time.perf_counter()
+        out = pca.transform(
+            X, classic_transform=False, epsilon_delta=err,
+            quantum_representation=True, norm="est_representation",
+            true_tomography=True)
+        t = time.perf_counter() - t0
+        Xq, _, f_norm = out["quantum_representation_results"]
+        print(f"{err:5.1f} | {knn_cv(Xq):8.4f} | {f_norm:10.2f} | {t:11.3f}")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--subsample", type=int, default=8_000,
+                    help="rows of MNIST to use (0 = all 70k)")
+    ap.add_argument("--folds", type=int, default=5)
+    args = ap.parse_args()
+
+    from sq_learn_tpu.datasets import load_cicids, load_mnist
+    from sq_learn_tpu.models import QPCA
+    from sq_learn_tpu.preprocessing import StandardScaler
+
+    # the reference's experiment fits classically (svd_solver='full') and
+    # applies the quantum error purely at transform time — so one fit
+    # serves the whole sweep and ε+δ is the only variable
+    X, y, real = load_mnist()
+    if args.subsample:
+        X, y = X[: args.subsample], y[: args.subsample]
+    print(f"MNIST leg: {X.shape} "
+          f"({'real MNIST' if real else 'synthetic surrogate'}), "
+          f"n_components=61")
+    pca = QPCA(n_components=61, svd_solver="full", random_state=0).fit(X)
+    sweep_table("MNIST (MnistTrial.py config)", pca, X, y, args.folds)
+
+    Xc, yc, real_c = load_cicids(n_samples=4_000)
+    Xc = StandardScaler().fit_transform(Xc).astype(np.float32)
+    print(f"\nCICIDS leg: {Xc.shape} "
+          f"({'real CICIDS' if real_c else 'surrogate'}), n_components=10")
+    pca_c = QPCA(n_components=10, svd_solver="full", random_state=0).fit(Xc)
+    sweep_table("CICIDS (low-margin classes)", pca_c, Xc, yc, args.folds)
+
+    print("\nε+δ=0 is the classical representation; growing the total "
+          "tomography error budget degrades the downstream classifier "
+          "gracefully while cheapening the quantum circuit — the dial "
+          "the reference's MnistTrial sweeps one point of.")
+
+
+if __name__ == "__main__":
+    main()
