@@ -94,6 +94,7 @@ class TestFetchFacades:
         with _pytest.raises(ValueError, match="offline"):
             fetch_openml(data_id=40945)
 
+    @pytest.mark.slow
     def test_fetch_covtype(self):
         import warnings
         from sq_learn_tpu.datasets import fetch_covtype

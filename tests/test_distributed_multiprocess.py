@@ -13,6 +13,9 @@ import sys
 
 import pytest
 
+# heavyweight tier: deselect with -m 'not slow' (pyproject markers)
+pytestmark = pytest.mark.slow
+
 REPO = pathlib.Path(__file__).resolve().parent.parent
 WORKER = REPO / "tests" / "_dist_worker.py"
 

@@ -17,6 +17,9 @@ sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 import __graft_entry__  # noqa: E402
 
+# heavyweight tier: deselect with -m 'not slow' (pyproject markers)
+pytestmark = pytest.mark.slow
+
 
 def test_entry_compiles_and_runs():
     fn, args = __graft_entry__.entry()

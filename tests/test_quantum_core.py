@@ -206,6 +206,7 @@ class TestHostTomographyTwin:
     draw from the same error distribution and that traced calls stay on
     the XLA path."""
 
+    @pytest.mark.slow
     def test_error_distribution_matches_xla(self, key):
         from sq_learn_tpu.ops.quantum.tomography import (_tomography_unit,
                                                          real_tomography,

@@ -186,6 +186,7 @@ class TestIPE:
         tol = eps * np.maximum(1.0, np.abs(np.asarray(true_ip)))
         assert (np.abs(np.asarray(s - true_ip)) <= tol).mean() >= 0.9
 
+    @pytest.mark.slow
     def test_matrix_pairs(self, key):
         X = jax.random.normal(jax.random.PRNGKey(1), (40, 8))
         C = jax.random.normal(jax.random.PRNGKey(2), (5, 8))
@@ -248,6 +249,7 @@ class TestFejerTail:
         ok = (np.abs(np.asarray(est) - 0.27) <= eps).mean()
         assert ok >= 8 / np.pi**2 - 0.02  # binomial noise margin
 
+    @pytest.mark.slow
     def test_exact_when_window_covers_grid(self, key):
         """M ≤ 2·window+1: the sampler enumerates every residue — empirical
         frequencies must match the exact pmf (TV ≤ sampling noise)."""
@@ -295,6 +297,7 @@ class TestIPEWindowEquivalence:
     practical window, so truncation dominates at every width and only
     ever tightens the within-ε guarantee."""
 
+    @pytest.mark.slow
     def test_estimates_match_across_windows(self):
         import jax
         import jax.numpy as jnp

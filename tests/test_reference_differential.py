@@ -17,6 +17,9 @@ import warnings
 import numpy as np
 import pytest
 
+# heavyweight tier: deselect with -m 'not slow' (pyproject markers)
+pytestmark = pytest.mark.slow
+
 REF = "/root/reference/sklearn/QuantumUtility/Utility.py"
 
 if not os.path.exists(REF):  # pragma: no cover
