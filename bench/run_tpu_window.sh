@@ -68,6 +68,15 @@ env -u PYTHONPATH JAX_PLATFORMS=cpu timeout 900 \
   > "$dir/cpu_control_mnist.txt" 2>/dev/null || true
 grep -h '^{' "$dir"/cpu_control_*.txt 2>/dev/null
 
+echo "== 3b. chip-path headline (tiny-routing disabled) =="
+# The production headline routes digit-scale fits to the host
+# (route_tiny_fit_to_host); this run times the CHIP path explicitly so
+# the record shows what the fused one-dispatch fit actually costs over
+# the tunnel — the measured justification (or refutation) of the rule.
+SQ_TINY_FIT_ELEMENTS=0 timeout 600 python bench.py \
+  > "$dir/chip_headline_unrouted.txt" 2>/dev/null || true
+grep -h '^{' "$dir/chip_headline_unrouted.txt" 2>/dev/null
+
 echo "== 4/4 reference-default IPE mode (supplementary, skippable) =="
 timeout 900 python -m bench.bench_ipe_digits \
   > "$dir/ipe.txt" 2>"$dir/ipe.err" || echo "ipe rc=$? (continuing)"
