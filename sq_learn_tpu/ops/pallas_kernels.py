@@ -227,6 +227,13 @@ def _make_argkmin_kernel(k, tile_t):
     the lowest training index (prior bests come from earlier tiles and
     precede the tile's columns, which are themselves index-ascending) —
     the same order ``lax.top_k`` yields on the XLA path.
+
+    Every buffer keeps its full lane-aligned width: the best lists carry
+    ``lane_k`` columns with _BIG/-1 sentinels beyond ``k`` (sentinels can
+    never win a round against the ≥k real candidates), and results are
+    written back through iota/where masks — no minor-dimension slicing at
+    a non-aligned ``k``, no in-kernel pad, the constructs Mosaic versions
+    are most likely to reject (ADVICE r3).
     """
 
     def kernel(q_ref, t_ref, tsq_ref, bestd_ref, besti_ref):
@@ -246,23 +253,27 @@ def _make_argkmin_kernel(k, tile_t):
             q, t.T, preferred_element_type=jnp.float32)   # (T_q, T_t)
         col = j * tile_t + jax.lax.broadcasted_iota(
             jnp.int32, score.shape, 1)
-        # out-of-range padded train rows carry tsq = _BIG already
-        cand_d = jnp.concatenate([bestd_ref[:, :k], score], axis=1)
-        cand_i = jnp.concatenate([besti_ref[:, :k], col], axis=1)
+        # out-of-range padded train rows carry tsq = _BIG already; the
+        # lane_k-width best list's sentinel columns (≥ k) carry _BIG/-1
+        cand_d = jnp.concatenate([bestd_ref[:], score], axis=1)
+        cand_i = jnp.concatenate([besti_ref[:], col], axis=1)
         cols = jax.lax.broadcasted_iota(jnp.int32, cand_d.shape, 1)
-        new_d, new_i = [], []
-        for _ in range(k):  # unrolled: k is small + static. Mask/reduce
+        outcols = jax.lax.broadcasted_iota(
+            jnp.int32, bestd_ref.shape, 1)
+        new_d = jnp.full_like(bestd_ref, _BIG)
+        new_i = jnp.full_like(besti_ref, -1)
+        for r in range(k):  # unrolled: k is small + static. Mask/reduce
             # formulation only — no gather/scatter, which Mosaic lacks.
             pos = jnp.argmin(cand_d, axis=1)              # (T_q,)
             sel = cols == pos[:, None]                    # one-hot rows
-            new_d.append(jnp.min(cand_d, axis=1))
-            new_i.append(jnp.sum(jnp.where(sel, cand_i, 0), axis=1))
+            dmin = jnp.min(cand_d, axis=1)
+            imin = jnp.sum(jnp.where(sel, cand_i, 0), axis=1)
+            write = outcols == r
+            new_d = jnp.where(write, dmin[:, None], new_d)
+            new_i = jnp.where(write, imin[:, None], new_i)
             cand_d = jnp.where(sel, _BIG, cand_d)
-        pad = bestd_ref.shape[1] - k
-        bestd_ref[:] = jnp.pad(jnp.stack(new_d, axis=1),
-                               ((0, 0), (0, pad)), constant_values=_BIG)
-        besti_ref[:] = jnp.pad(jnp.stack(new_i, axis=1),
-                               ((0, 0), (0, pad)), constant_values=-1)
+        bestd_ref[:] = new_d
+        besti_ref[:] = new_i
 
     return kernel
 
