@@ -140,9 +140,15 @@ def lloyd_step_pallas(X, weights, centers, x_sq_norms, *, key=None,
     """
     n, m = X.shape
     k = centers.shape[0]
-    # hardware alignment: lanes are 128 wide, f32 sublanes 8 deep
+    # hardware alignment: lanes are 128 wide, f32 sublanes 8 deep. k is
+    # padded to a full lane multiple because it appears as the LANE dim
+    # of the csq/counts/gumbel blocks and of the in-kernel distance tile
+    # (the centers/sums blocks only need sublane alignment, but the MXU
+    # computes 128-wide lanes regardless, so the stricter padding costs
+    # no real cycles and keeps every block shape in the documented
+    # supported set).
     m_p = _round_up(m, 128)
-    k_p = _round_up(k, 8)
+    k_p = _round_up(k, 128)
     n_p = _round_up(n, tile_n)
 
     cdt = jnp.dtype(compute_dtype) if compute_dtype else jnp.float32
