@@ -1044,7 +1044,8 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
             centers, _ = kmeans_plusplus(key, X, x_sq_norms, self.n_clusters,
                                          weights=weights)
         elif isinstance(init, str) and init == "random":
-            p = None if weights is None else np.asarray(weights) / float(jnp.sum(weights))
+            p = (None if weights is None
+                 else np.asarray(weights) / float(jnp.sum(weights)))
             idx = jax.random.choice(key, n, (self.n_clusters,), replace=False,
                                     p=None if p is None else jnp.asarray(p))
             centers = X[idx]

@@ -39,7 +39,8 @@ class TestClassicalParity:
                       random_state=0).fit(X)
         ref = sklearn.cluster.KMeans(n_clusters=4, init=init, n_init=1,
                                      max_iter=100, algorithm="lloyd").fit(X)
-        assert float(adjusted_rand_score(ours.labels_, ref.labels_)) == pytest.approx(1.0)
+        ari = float(adjusted_rand_score(ours.labels_, ref.labels_))
+        assert ari == pytest.approx(1.0)
         np.testing.assert_allclose(
             np.sort(ours.cluster_centers_, axis=0),
             np.sort(ref.cluster_centers_, axis=0),
@@ -125,7 +126,8 @@ class TestShardedLloyd:
         single = KMeans(n_clusters=4, init=init, n_init=1, random_state=0).fit(X)
         sharded = KMeans(n_clusters=4, init=init, n_init=1, random_state=0,
                          mesh=mesh8).fit(X)
-        assert float(adjusted_rand_score(single.labels_, sharded.labels_)) == pytest.approx(1.0)
+        ari = float(adjusted_rand_score(single.labels_, sharded.labels_))
+        assert ari == pytest.approx(1.0)
         np.testing.assert_allclose(single.inertia_, sharded.inertia_, rtol=1e-3)
         np.testing.assert_allclose(
             np.sort(single.cluster_centers_, 0),
@@ -1074,7 +1076,8 @@ class TestBatchedHostRestarts:
         wn = np.ones(len(Xn), np.float32)
         xsq = (Xn**2).sum(axis=1)
         stack = np.array([[[0.0], [1.0]]], np.float32)      # (1, 2, 1)
-        (labels, inertia, centers, n_iter, hist), _ =             _native_lloyd_run_batched(
+        (labels, inertia, centers, n_iter, hist), _ = \
+            _native_lloyd_run_batched(
                 np.random.default_rng(0), Xn, wn, xsq, stack, window=0.6,
                 max_iter=1, tol=np.inf, patience=None)
         assert np.isfinite(float(inertia))
