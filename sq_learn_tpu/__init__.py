@@ -7,7 +7,8 @@ sharded over device meshes via ``shard_map`` + collectives. See SURVEY.md for
 the structural map of the reference this build follows.
 """
 
-from ._config import config_context, default_dtype, get_config, resolve_device, set_config
+from ._config import (config_context, default_dtype, get_config,
+                      resolve_device, set_config)
 from .base import (
     BaseEstimator,
     ClassifierMixin,

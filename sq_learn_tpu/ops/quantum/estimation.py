@@ -146,9 +146,11 @@ def consistent_phase_estimation(
     L = np.floor(2 / C)
     if shift is None:
         shift = int(L / 2) + 1
-    intervals = np.arange(-1 - shift * delta_prime, 1 + epsilon - shift * delta_prime, epsilon)
+    intervals = np.arange(-1 - shift * delta_prime,
+                          1 + epsilon - shift * delta_prime, epsilon)
     intervals = np.append(intervals, 1 + epsilon - shift * delta_prime)
-    intervals = jnp.asarray(intervals, dtype=jnp.result_type(jnp.asarray(omega), jnp.float32))
+    intervals = jnp.asarray(
+        intervals, dtype=jnp.result_type(jnp.asarray(omega), jnp.float32))
 
     pe = phase_estimation(key, omega, epsilon=delta_prime, gamma=gamma, window=window)
     # bisect.bisect is bisect_right

@@ -37,7 +37,8 @@ def introduce_error_array(key, array, norm_error):
     array = jnp.asarray(array)
     d = array.shape[-1]
     bound = jnp.asarray(norm_error) / jnp.sqrt(d)
-    bound = jnp.broadcast_to(bound[..., None] if jnp.ndim(bound) else bound, array.shape)
+    bound = jnp.broadcast_to(
+        bound[..., None] if jnp.ndim(bound) else bound, array.shape)
     return array + truncated_noise(key, bound, array.shape, array.dtype)
 
 

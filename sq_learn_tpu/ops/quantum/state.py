@@ -34,8 +34,10 @@ class QuantumState:
         self.norm_factor = jnp.linalg.norm(amplitudes)
         self.amplitudes = amplitudes / self.norm_factor
         self.probabilities = self.amplitudes**2
-        self.registers = jnp.asarray(registers) if not isinstance(registers, list) else registers
-        n_reg = len(self.registers) if isinstance(self.registers, list) else self.registers.shape[0]
+        self.registers = (jnp.asarray(registers)
+                          if not isinstance(registers, list) else registers)
+        n_reg = (len(self.registers) if isinstance(self.registers, list)
+                 else self.registers.shape[0])
         if n_reg != amplitudes.shape[0]:
             raise ValueError("registers and amplitudes must have the same length")
         if not isinstance(self.probabilities, jax.core.Tracer):

@@ -66,7 +66,8 @@ class TestKernels:
         X, Y = data
         np.testing.assert_allclose(
             np.asarray(polynomial_kernel(X, Y, degree=2, gamma=0.1, coef0=1.5)),
-            sklearn.metrics.pairwise.polynomial_kernel(X, Y, degree=2, gamma=0.1, coef0=1.5),
+            sklearn.metrics.pairwise.polynomial_kernel(
+                X, Y, degree=2, gamma=0.1, coef0=1.5),
             rtol=1e-3,
         )
 

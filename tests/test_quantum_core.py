@@ -84,14 +84,17 @@ class TestNoise:
     def test_zero_noise_identity(self, key):
         # reference bug: make_gaussian_est returns undefined var at noise==0
         v = jnp.asarray(random_unit(1, 16))
-        np.testing.assert_array_equal(np.asarray(gaussian_estimate(key, v, 0.0)), np.asarray(v))
+        np.testing.assert_array_equal(
+            np.asarray(gaussian_estimate(key, v, 0.0)), np.asarray(v))
 
 
 class TestTomography:
     def test_n_formula(self):
         d, delta = 784, 0.1
-        assert tomography_n_measurements(d, delta, "L2") == int(36 * d * np.log(d) / delta**2)
-        assert tomography_n_measurements(d, delta, "inf") == int(36 * np.log(d) / delta**2)
+        assert (tomography_n_measurements(d, delta, "L2")
+                == int(36 * d * np.log(d) / delta**2))
+        assert (tomography_n_measurements(d, delta, "inf")
+                == int(36 * np.log(d) / delta**2))
 
     def test_l2_error_bound(self, key):
         d, delta = 50, 0.3

@@ -144,12 +144,14 @@ class TestConsistentPhaseEstimation:
         assert counts.max() / len(ests) >= 0.9
 
     def test_accuracy(self, key):
-        omega = jax.random.uniform(jax.random.PRNGKey(11), (200,), minval=0.05, maxval=0.95)
+        omega = jax.random.uniform(jax.random.PRNGKey(11), (200,),
+                                   minval=0.05, maxval=0.95)
         est = consistent_phase_estimation(key, omega, epsilon=0.02, gamma=0.1)
         assert (np.abs(np.asarray(est - omega)) <= 2 * 0.02).mean() > 0.95
 
     def test_non_negative(self, key):
-        est = consistent_phase_estimation(key, jnp.array([0.001]), epsilon=0.05, gamma=0.1)
+        est = consistent_phase_estimation(key, jnp.array([0.001]),
+                                          epsilon=0.05, gamma=0.1)
         assert float(est[0]) >= 0.0
 
 
