@@ -268,3 +268,62 @@ def test_lloyd_single_fused_delta_matches_quality():
         jax.random.PRNGKey(0), Xd, w, centers0, xsq, delta=0.5,
         mode="delta", max_iter=50, use_pallas=True, pallas_interpret=True)
     assert adjusted_rand_score(y, np.asarray(labels)) > 0.95
+
+
+@pytest.mark.slow
+def test_argkmin_fuzz_matches_top_k():
+    """Randomized shape/k sweep incl. duplicate training rows (tie
+    stress): the fused argkmin must match the XLA top_k path's indices
+    EXACTLY — the lane-aligned merge rewrite keeps the same tie order."""
+    from sq_learn_tpu.models.neighbors import knn_indices
+    from sq_learn_tpu.ops.pallas_kernels import argkmin_pallas
+
+    rng = np.random.default_rng(0)
+    for _ in range(10):
+        nt = int(rng.integers(5, 900))
+        nq = int(rng.integers(1, 400))
+        m = int(rng.integers(1, 70))
+        k = int(rng.integers(1, min(nt, 20) + 1))
+        Xt = rng.standard_normal((nt, m)).astype(np.float32)
+        Xq = rng.standard_normal((nq, m)).astype(np.float32)
+        if nt > 10:  # duplicates exercise the lowest-index tie contract
+            Xt[nt // 2] = Xt[0]
+            Xt[-1] = Xt[1]
+        xsq = (Xt ** 2).sum(1)
+        pi, pd = argkmin_pallas(jnp.asarray(Xt), jnp.asarray(xsq),
+                                jnp.asarray(Xq), k, interpret=True)
+        xi, xd = knn_indices(jnp.asarray(Xt), jnp.asarray(Xq), k)
+        np.testing.assert_array_equal(np.asarray(pi), np.asarray(xi))
+        np.testing.assert_allclose(np.asarray(pd), np.asarray(xd),
+                                   rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.slow
+def test_lloyd_fuzz_matches_xla_across_lane_boundary(key):
+    """Randomized (n, m, k) sweep with k crossing the 128-lane padding
+    boundary: fused-kernel labels match the XLA E-step exactly, weighted
+    partials match the one-hot GEMM."""
+    from sq_learn_tpu.models.qkmeans import _cluster_partials, e_step
+
+    rng = np.random.default_rng(1)
+    for _ in range(8):
+        n = int(rng.integers(10, 1500))
+        m = int(rng.integers(1, 150))
+        k = int(rng.integers(2, 200))
+        X = rng.standard_normal((n, m)).astype(np.float32)
+        w = rng.uniform(0.2, 2.0, n).astype(np.float32)
+        C = X[rng.choice(n, min(k, n), replace=False)]
+        k = C.shape[0]
+        Xd, wd, Cd = jnp.asarray(X), jnp.asarray(w), jnp.asarray(C)
+        xsq = jnp.sum(Xd * Xd, axis=1)
+        lab, _, sums, counts, inert = lloyd_step_pallas(
+            Xd, wd, Cd, xsq, interpret=True)
+        rl, ri, _ = e_step(key, Xd, wd, Cd, xsq, delta=0.0,
+                           mode="classic", ipe_q=1)
+        rs, rc = _cluster_partials(Xd, wd, rl, k)
+        np.testing.assert_array_equal(np.asarray(lab), np.asarray(rl))
+        np.testing.assert_allclose(np.asarray(sums), np.asarray(rs),
+                                   rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(np.asarray(counts), np.asarray(rc),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(float(inert), float(ri), rtol=1e-4)
