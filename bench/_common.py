@@ -19,21 +19,33 @@ def timed(fn, *args, warmup=1, reps=1, **kwargs):
     return best, result
 
 
-def emit(metric, value, unit="s", vs_baseline=1.0, **extra):
+def emit(metric, value, unit="s", vs_baseline=1.0, baseline_kind=None,
+         **extra):
     """Print the ONE machine-readable JSON line (extras go to stderr).
 
     ``vs_baseline=None`` means "no baseline was measured" and is emitted
     as JSON null — run_suite.sh's acceptance gate counts that as a MISS,
-    so a failed baseline can never silently pass as a 1.0 ratio."""
+    so a failed baseline can never silently pass as a 1.0 ratio.
+
+    ``baseline_kind`` rides IN the JSON line (not the stderr extras)
+    because cross-record consumers parse only the line: the suite-wide
+    convention is a measured-wall-clock ratio, and a script whose
+    vs_baseline is on a different scale (e.g. bench_ipe_digits' derived
+    serial-cost ratio, order 1e4-1e5) must be distinguishable without
+    reading its docstring. None (the default) = measured, and the key is
+    omitted to keep the driver's headline line schema untouched."""
     if extra:
         print("# " + json.dumps(extra), file=sys.stderr)
-    print(json.dumps({
+    line = {
         "metric": metric,
         "value": round(float(value), 4),
         "unit": unit,
         "vs_baseline": (None if vs_baseline is None
                         else round(float(vs_baseline), 3)),
-    }))
+    }
+    if baseline_kind is not None:
+        line["baseline_kind"] = baseline_kind
+    print(json.dumps(line))
 
 
 def _enable_compilation_cache():

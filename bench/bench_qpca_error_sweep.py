@@ -4,23 +4,29 @@ experiment frames it (``MnistTrial.py:10-28``: classical fit, exact
 tomography applied to the transformed representation at total error ε+δ,
 downstream stratified-CV KNN accuracy + F-norm deviation).
 
-Two legs, one record:
+Three legs, one record; the headline is the leg where the dial can
+actually move (VERDICT r4 next #3):
 
-- **mnist leg** (the reference's exact configuration, n_components=61,
-  k=7 KNN): headline JSON line = KNN CV accuracy at the reference's
-  published ε+δ=0.8 point, ``vs_baseline`` = ratio against the zero-error
-  classical-transform accuracy. On the offline surrogate this curve is
-  structurally flat: the synthetic classes' angular margins exceed the
-  largest error the reference's tomography model can produce (sample
-  complexity N=36·d·ln d/δ² floors the achievable noise at ~20-50 %
-  relative even as δ→∞), which the extras record as
-  ``surrogate_margin_caveat`` — on real MNIST the margins are small and
-  the curve bends.
-- **cicids leg** (low-margin graded near-duplicate classes through the
-  same qPCA→KNN pipeline): demonstrates the dial actually bending —
-  accuracy degrades monotonically with ε+δ while F-norm error grows.
+- **cicids leg** (HEADLINE; low-margin graded near-duplicate classes
+  through the qPCA→KNN pipeline): JSON line = KNN CV accuracy at the
+  reference's published ε+δ=0.8 point, ``vs_baseline`` = ratio against
+  the zero-error classical-transform accuracy. Accuracy degrades
+  monotonically with ε+δ while F-norm error grows — a headline that is
+  structurally able to vary.
+- **mnist-low-margin leg** (``load_mnist_surrogate_low_margin``): the
+  MnistTrial pipeline shape (784-d, 10 classes, n_components=61, k=7
+  KNN) with graded pair margins *inside* the achievable tomography noise
+  band, so the MNIST-shaped leg bends too.
+- **mnist-faithful leg** (the ``load_mnist`` surrogate, the reference's
+  exact configuration): structurally flat offline — the synthetic
+  classes' angular margins exceed the largest error the reference's
+  tomography model can produce (N=36·d·ln d/δ² keeps relative noise
+  ≤ ~21 % even at ε+δ=3.2), which the extras record as
+  ``surrogate_margin_caveat``. Kept as the fidelity control; on real
+  MNIST the margins are small and this leg would bend.
 
-Not a BASELINE config — supplementary surface, like bench_ipe_digits.
+Not a BASELINE config — supplementary surface, like bench_ipe_digits
+(which runs inside run_suite.sh; this script is recorded standalone).
 """
 
 import sys
@@ -68,13 +74,27 @@ def main():
     probe_backend()
     import jax
 
-    from sq_learn_tpu.datasets import load_cicids, load_mnist
+    from sq_learn_tpu.datasets import (load_cicids, load_mnist,
+                                       load_mnist_surrogate_low_margin)
     from sq_learn_tpu.models import QPCA
     from sq_learn_tpu.preprocessing import StandardScaler
 
     n_rows, folds = (2_000, 3) if smoke_mode() else (10_000, 5)
 
-    # mnist leg — the reference's exact experiment shape
+    # cicids leg (headline) — low angular margins, the dial visibly bends
+    Xc_, yc_, real_c = load_cicids(n_samples=max(4_000, n_rows // 2))
+    Xc_ = StandardScaler().fit_transform(Xc_).astype(np.float32)
+    pca_c = QPCA(n_components=10, svd_solver="full", random_state=0).fit(Xc_)
+    acc_c_cicids, cicids_curve = _sweep(pca_c, Xc_, yc_, folds)
+
+    # mnist-low-margin leg — the MnistTrial shape with margins inside the
+    # tomography noise band (the pair grades are tuned in the loader)
+    Xlm, ylm = load_mnist_surrogate_low_margin(n_rows)
+    pca_lm = QPCA(n_components=61, svd_solver="full", random_state=0).fit(Xlm)
+    acc_c_lm, lm_curve = _sweep(pca_lm, Xlm, ylm, folds)
+
+    # mnist-faithful leg — the reference's exact experiment shape
+    # (fidelity control; flat offline, see module docstring)
     X, y, real = load_mnist()
     X, y = X[:n_rows], y[:n_rows]
     t0 = time.perf_counter()
@@ -82,27 +102,24 @@ def main():
     t_fit = time.perf_counter() - t0
     acc_c_mnist, mnist_curve = _sweep(pca, X, y, folds)
 
-    # cicids leg — low angular margins, where the dial visibly bends
-    Xc_, yc_, real_c = load_cicids(n_samples=max(4_000, n_rows // 2))
-    Xc_ = StandardScaler().fit_transform(Xc_).astype(np.float32)
-    pca_c = QPCA(n_components=10, svd_solver="full", random_state=0).fit(Xc_)
-    acc_c_cicids, cicids_curve = _sweep(pca_c, Xc_, yc_, folds)
-
-    headline = mnist_curve[0.8]["knn_acc"]
-    emit("qpca_mnist_eps_delta_sweep_knn_acc_at_0.8", headline,
-         unit="accuracy", vs_baseline=headline / acc_c_mnist,
+    headline = cicids_curve[0.8]["knn_acc"]
+    emit("qpca_cicids_eps_delta_sweep_knn_acc_at_0.8", headline,
+         unit="accuracy", vs_baseline=headline / acc_c_cicids,
          backend=jax.default_backend(), rows=n_rows, folds=folds,
-         mnist={"classical_knn_acc": round(acc_c_mnist, 4),
-                "fit_s": round(t_fit, 3), "real": real,
-                "sweep": mnist_curve},
          cicids={"classical_knn_acc": round(acc_c_cicids, 4),
                  "real": real_c, "sweep": cicids_curve},
+         mnist_low_margin={"classical_knn_acc": round(acc_c_lm, 4),
+                           "real": False, "sweep": lm_curve},
+         mnist_faithful={"classical_knn_acc": round(acc_c_mnist, 4),
+                         "fit_s": round(t_fit, 3), "real": real,
+                         "sweep": mnist_curve},
          surrogate_margin_caveat=(
              None if real else
-             "synthetic MNIST surrogate classes are angularly separated "
-             "beyond tomography's achievable noise (direction-only KNN "
-             "scores 1.0 on clean data), so the mnist-leg accuracy stays "
-             "flat; the cicids leg shows the dial bending"))
+             "the faithful-geometry MNIST surrogate's classes are "
+             "angularly separated beyond tomography's achievable noise "
+             "(direction-only KNN scores 1.0 on clean data), so that "
+             "leg's accuracy stays flat; the cicids headline and the "
+             "low-margin MNIST-shaped leg show the dial bending"))
 
 
 if __name__ == "__main__":

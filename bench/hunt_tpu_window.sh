@@ -7,8 +7,9 @@
 # makes the attempt record automatic: a cheap 60 s subprocess probe
 # every ~4 min, the full runbook (bench/run_tpu_window.sh) fired the
 # moment a probe answers, and EVERY attempt — wedged probes included —
-# appended to bench/records/window_hunt_r04.log so the hunt itself is
-# committable evidence even if no window ever opens.
+# appended to bench/records/window_hunt_<round>.log (HUNT_ROUND, default
+# r05) so the hunt itself is committable evidence even if no window ever
+# opens.
 #
 # Deliberately does NOT git-commit: the foreground session owns the
 # index; it watches the log and .window_landed marker instead.
@@ -18,8 +19,11 @@
 #                    process exits before the round driver does)
 set -u
 cd "$(dirname "$0")/.."
-log="bench/records/window_hunt_r04.log"
+round="${HUNT_ROUND:-r05}"
+log="bench/records/window_hunt_${round}.log"
 mkdir -p bench/records
+probe_out="$(mktemp /tmp/hunt_probe.XXXXXX)"
+trap 'rm -f "$probe_out"' EXIT
 interval="${HUNT_INTERVAL_S:-240}"
 max_s="${HUNT_MAX_S:-39600}"
 start=$SECONDS
@@ -27,8 +31,8 @@ echo "$(date -u +%Y%m%dT%H%M%SZ) HUNT-START interval=${interval}s max=${max_s}s"
 while [ $((SECONDS - start)) -lt "$max_s" ]; do
   ts="$(date -u +%Y%m%dT%H%M%SZ)"
   if timeout 60 python -c "import jax; print(jax.devices())" \
-       > /tmp/hunt_probe.txt 2>&1; then
-    echo "$ts PROBE-OK $(tr '\n' ' ' < /tmp/hunt_probe.txt | tail -c 200)" >> "$log"
+       > "$probe_out" 2>&1; then
+    echo "$ts PROBE-OK $(tr '\n' ' ' < "$probe_out" | tail -c 200)" >> "$log"
     echo "$ts WINDOW-START" >> "$log"
     bash bench/run_tpu_window.sh >> "$log" 2>&1
     rc=$?
@@ -43,7 +47,7 @@ while [ $((SECONDS - start)) -lt "$max_s" ]; do
     # keep the probe's tail: a broken-env failure (ImportError, plugin
     # error) must stay distinguishable from a genuinely wedged tunnel in
     # the committed hunt log
-    echo "$ts PROBE-WEDGED $(tr '\n' ' ' < /tmp/hunt_probe.txt | tail -c 160)" >> "$log"
+    echo "$ts PROBE-WEDGED $(tr '\n' ' ' < "$probe_out" | tail -c 160)" >> "$log"
     sleep "$interval"
   fi
 done

@@ -1,5 +1,6 @@
 #!/bin/bash
-# Run the full BASELINE bench suite (headline + configs #2-#5) and collect
+# Run the full BASELINE bench suite (headline + configs #2-#5, plus the
+# supplementary derived-baseline IPE config) and collect
 # the JSON lines into one file. Each script probes the accelerator in a
 # subprocess and falls back to CPU if the tunnel is wedged at START; the
 # probe cannot protect against a tunnel that wedges MID-run (observed: the
@@ -56,7 +57,15 @@ run_and_record() {  # run_and_record <timeout_s> <header> <cmd...>; returns the 
 # where a wedge can no longer cost the small configs their numbers.
 # First attempts get 600 s (a healthy run finishes well under that; only a
 # wedge reaches the timeout); CPU retries keep the conservative 1200 s.
+#
+# bench_ipe_digits is the one supplementary (non-BASELINE) config in the
+# suite: its vs_baseline is a DERIVED serial-cost ratio (tagged
+# baseline_kind="derived" in its JSON line), recorded here so the IPE
+# surface always has a committed artifact (VERDICT r4 next #2b). It runs
+# right after the headline — it's digit-scale (host-routed, seconds) and
+# must not be sacrificed to a mid-suite wedge on the heavy configs.
 for cmd in "python bench.py" \
+           "python -m bench.bench_ipe_digits" \
            "python -m bench.bench_randomized_svd_covtype" \
            "python -m bench.bench_qkmeans_cicids_sweep" \
            "python -m bench.bench_qpca_mnist" \
@@ -77,10 +86,15 @@ done
 # (PYTHONPATH cleared + timeout, like the retry path: the bare interpreter
 # pre-imports jax via the axon sitecustomize and would hang on a wedged
 # relay even though this step only parses JSON)
-env -u PYTHONPATH timeout 60 python - "$out" 5 <<'PY'
+env -u PYTHONPATH timeout 60 python - "$out" 5 1 <<'PY'
 import json, sys
-expected = int(sys.argv[2])  # one JSON line per suite config
-fails, seen = [], 0
+# measured BASELINE configs and derived-baseline supplementary configs
+# (baseline_kind="derived" in the JSON line) are counted separately: the
+# derived ratio lives on a different scale, but >= 0.5 still means "not
+# slower than the reference's own serial architecture" so the bar applies
+# to both
+exp_measured, exp_derived = int(sys.argv[2]), int(sys.argv[3])
+fails, measured, derived = [], 0, 0
 for line in open(sys.argv[1]):
     line = line.strip()
     if not line.startswith("{"):
@@ -91,19 +105,25 @@ for line in open(sys.argv[1]):
         continue
     if "metric" not in rec or "vs_baseline" not in rec:
         continue
-    seen += 1
+    kind = rec.get("baseline_kind", "measured")
+    if kind == "derived":
+        derived += 1
+    else:
+        measured += 1
     vb = rec["vs_baseline"]
     # null = the script measured no baseline (emit(vs_baseline=None));
     # an unmeasured baseline is a miss, not a free pass
     ok = isinstance(vb, (int, float)) and vb >= 0.5
     print(f"# ACCEPT {'pass' if ok else 'FAIL'}: {rec['metric']} "
-          f"vs_baseline={vb}")
+          f"({kind}) vs_baseline={vb}")
     if not ok:
         fails.append(rec["metric"])
-if fails or seen != expected:
+if fails or measured != exp_measured or derived != exp_derived:
     # a config that records only rc markers (double failure) must fail
     # the gate too — a missing number is not a passing number
-    sys.exit(f"acceptance gate: fails={fails} recorded={seen}/{expected}")
+    sys.exit(f"acceptance gate: fails={fails} "
+             f"measured={measured}/{exp_measured} "
+             f"derived={derived}/{exp_derived}")
 PY
 gate_rc=$?
 echo "# acceptance gate rc=$gate_rc" >> "$out"

@@ -164,7 +164,39 @@ def on_cpu_backend():
 #: TPU headline: 1.43 s vs 0.063 s sklearn). 2^18 elements = 1 MiB of f32,
 #: comfortably past digits while 3 decades under the MNIST/covtype configs
 #: that genuinely use the chip. Set SQ_TINY_FIT_ELEMENTS=0 to disable.
+#:
+#: PROVISIONAL: the 1.43 s justification predates the fused one-dispatch
+#: fit and the persistent compile cache; the current chip-path cost has
+#: never been re-measured (the runbook's step 3b,
+#: ``bench/run_tpu_window.sh`` "chip_headline_unrouted", exists to do so
+#: in the first healthy tunnel window). Until that record lands, treat
+#: the cutoff as a conservative policy guess, not a measured constant.
 _TINY_FIT_ELEMENTS = int(os.environ.get("SQ_TINY_FIT_ELEMENTS", 1 << 18))
+
+
+def _default_backend_platform_no_init():
+    """Platform of jax's default backend WITHOUT forcing backend init.
+
+    Initializing a backend over a wedged accelerator relay can hang
+    indefinitely (CLAUDE.md), so a pure dispatch-policy question must
+    never be the thing that first touches the tunnel. Three tiers:
+
+    - backends already initialized → the authoritative answer;
+    - a ``jax_platforms`` spec is pinned (e.g. this environment's
+      ``JAX_PLATFORMS=axon,cpu`` or the test conftest's in-process
+      ``jax.config.update("jax_platforms", "cpu")``) → its first entry,
+      which is what jax will pick as default once it does initialize;
+    - no spec (auto-detect) → ``None``: unknowable without an init.
+    """
+    import jax
+    from jax._src import xla_bridge
+
+    if xla_bridge.backends_are_initialized():
+        return jax.default_backend()
+    spec = jax.config.jax_platforms
+    if spec:
+        return spec.split(",")[0].strip()
+    return None
 
 
 def route_tiny_fit_to_host(n_elements):
@@ -175,15 +207,44 @@ def route_tiny_fit_to_host(n_elements):
     Only engages under ``device='auto'`` — an explicit
     ``set_config(device='tpu')`` (or ``'cpu'``) pin is always respected,
     which is also the escape hatch for deliberately timing the chip on a
-    tiny problem."""
+    tiny problem.
+
+    The backend check never initializes jax's backends (see
+    :func:`_default_backend_platform_no_init`), so this decision cannot
+    itself hang on a wedged tunnel — in-process library callers get the
+    same protection ``bench.py`` gets from its subprocess probe. Only
+    auto-detect installs with no ``jax_platforms`` spec fall back to a
+    real ``jax.default_backend()`` call (local backends, no tunnel)."""
     cfg = _get_threadlocal_config()
     if cfg["device"] != "auto" or _TINY_FIT_ELEMENTS <= 0:
         return False
-    import jax
+    platform = _default_backend_platform_no_init()
+    if platform is None:
+        import jax
 
-    if jax.default_backend() == "cpu":
+        platform = jax.default_backend()
+    if platform == "cpu":
         return False
     return n_elements <= _TINY_FIT_ELEMENTS
+
+
+#: fit_backend_ provenance value recorded by every tiny-routed surface
+TINY_ROUTED_BACKEND = "cpu:tiny-routed"
+
+
+@contextmanager
+def host_routed_scope():
+    """The ACTION side of :func:`route_tiny_fit_to_host` in one manager:
+    a cpu device pin plus the matching ``device_scope``, so every jax op
+    inside (key creation, eager casts, jits) stays on the host backend.
+    The DECISION side — the size predicate and each estimator's bypass
+    conditions (mesh, explicit kernels, dtypes) — stays at the call
+    sites, which is where they differ; the routing dance itself must not
+    drift across the routed surfaces (QKMeans fit/predict/score, QPCA
+    fit, minibatch fit/partial_fit, the KNN search)."""
+    with config_context(device="cpu"):
+        with device_scope():
+            yield
 
 
 def device_scope():

@@ -14,9 +14,11 @@ from ._loaders import (
     fetch_covtype,
     fetch_openml,
     load_cicids,
+    graded_pair_surrogate,
     load_covtype,
     load_digits,
     load_mnist,
+    load_mnist_surrogate_low_margin,
     make_blobs,
     synthetic_surrogate,
 )
@@ -26,9 +28,11 @@ __all__ = [
     "fetch_covtype",
     "fetch_openml",
     "load_cicids",
+    "graded_pair_surrogate",
     "load_covtype",
     "load_digits",
     "load_mnist",
+    "load_mnist_surrogate_low_margin",
     "make_blobs",
     "synthetic_surrogate",
 ]

@@ -181,6 +181,26 @@ class KNeighborsClassifier(ClassifierMixin, BaseEstimator):
         return _host_knn(self._X_np, self._xsq_np,
                          np.ascontiguousarray(X, np.float32), k)
 
+    def _tiny_routed_search(self, X, k):
+        """(idx, d2) via the host engines when the predict is dispatch-bound
+        on a remote accelerator (same size-aware policy as QKMeans.fit —
+        :func:`~sq_learn_tpu._config.route_tiny_fit_to_host`); None when
+        routing does not apply. Explicit ``use_pallas`` / ``compute_dtype``
+        settings bypass the routing, as does an x64 fit (the host copies
+        are float32)."""
+        from .._config import host_routed_scope, route_tiny_fit_to_host
+
+        if (self.use_pallas != "auto" or self.compute_dtype is not None
+                or jnp.asarray(self.X_fit_).dtype != jnp.float32):
+            return None
+        # the GEMM streams both operand matrices; queries and training
+        # rows both count toward "is this dispatch-bound"
+        n_elements = (self.n_samples_fit_ + X.shape[0]) * self.n_features_in_
+        if not route_tiny_fit_to_host(n_elements):
+            return None
+        with host_routed_scope():
+            return self._host_search(X, k)
+
     def _device_search(self, X, k):
         """(idx, d2) on the configured backend: the fused pallas argkmin
         (one VMEM-resident sweep, no HBM distance matrix) when a TPU is
@@ -211,12 +231,17 @@ class KNeighborsClassifier(ClassifierMixin, BaseEstimator):
                 return argkmin_pallas(self.X_fit_, self._xsq_dev,
                                       jnp.asarray(X), k,
                                       interpret=interpret)
-            except Exception as exc:  # pragma: no cover - hardware-specific
+            except Exception as exc:
                 import warnings as _warnings
 
                 from .qkmeans import _memoizable_kernel_failure
 
-                if _memoizable_kernel_failure(exc):
+                # only auto-path rejections populate the blacklist: an
+                # explicit use_pallas=True run is a user override whose
+                # failures must not silently disable 'auto' for the whole
+                # process (and it keeps retrying on every call by design)
+                if (self.use_pallas == "auto"
+                        and _memoizable_kernel_failure(exc)):
                     _argkmin_rejected.add(sig)
                 _warnings.warn(
                     f"pallas argkmin rejected ({type(exc).__name__}: {exc});"
@@ -246,6 +271,8 @@ class KNeighborsClassifier(ClassifierMixin, BaseEstimator):
         X = check_n_features(self, check_array(X))
         k = self._check_k(n_neighbors)
         host = self._host_search(X, k)
+        if host is None:
+            host = self._tiny_routed_search(X, k)
         if host is not None:
             idx, d2 = host
         else:
@@ -261,6 +288,8 @@ class KNeighborsClassifier(ClassifierMixin, BaseEstimator):
         k = self._check_k(self.n_neighbors)
         n_classes = len(self.classes_)
         host = self._host_search(X, k)
+        if host is None:
+            host = self._tiny_routed_search(X, k)
         if host is not None:
             idx, d2 = host
             votes = self._y_np[idx]                         # (n, k)

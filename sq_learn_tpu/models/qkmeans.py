@@ -1070,7 +1070,7 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         X = check_array(X, copy=False)
         self.n_features_in_ = X.shape[1]
         self._check_params(X)
-        from .._config import (config_context, device_scope,
+        from .._config import (TINY_ROUTED_BACKEND, host_routed_scope,
                                route_tiny_fit_to_host)
 
         if (self.mesh is None and self.use_pallas == "auto"
@@ -1081,12 +1081,18 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
             # it on the host instead of letting wall-clock hinge on link
             # health. Explicit device/mesh/use_pallas settings bypass this
             # (see _config.route_tiny_fit_to_host).
-            self.fit_backend_ = "cpu:tiny-routed"
-            with config_context(device="cpu"), device_scope():
-                return self._fit_impl(X, sample_weight)
-        self.fit_backend_ = ("cpu" if self._on_cpu_backend()
-                             else jax.default_backend())
-        return self._fit_impl(X, sample_weight)
+            with host_routed_scope():
+                out = self._fit_impl(X, sample_weight)
+            # assigned only after _fit_impl succeeds: a raise mid-fit must
+            # not leave a fitted-looking public attribute behind (which
+            # checkpoint.save_estimator would happily serialize)
+            self.fit_backend_ = TINY_ROUTED_BACKEND
+            return out
+        backend = ("cpu" if self._on_cpu_backend()
+                   else jax.default_backend())
+        out = self._fit_impl(X, sample_weight)
+        self.fit_backend_ = backend
+        return out
 
     def _fit_impl(self, X, sample_weight):
         """The fit body proper, on whatever backend :meth:`fit` routed to."""
@@ -1567,8 +1573,18 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         mode = self._mode(delta)
         # host fast path, same gating as fit: exact-precision classic/δ
         # inference on the CPU backend skips the XLA dispatch
-        from .._config import on_cpu_backend
+        from .._config import (host_routed_scope, on_cpu_backend,
+                               route_tiny_fit_to_host)
 
+        if (not on_cpu_backend() and self.compute_dtype is None
+                and mode in ("classic", "delta")
+                and route_tiny_fit_to_host(
+                    (X.shape[0] + self.n_clusters) * X.shape[1])):
+            # size-aware dispatch, same policy as fit: a digit-scale
+            # predict on a remote accelerator is pure tunnel latency —
+            # re-enter under a cpu pin so the host fast path below engages
+            with host_routed_scope():
+                return self.predict(X, sample_weight, delta)
         if (mode in ("classic", "delta") and on_cpu_backend()
                 and self.compute_dtype is None
                 and (X.dtype == np.float32
@@ -1624,8 +1640,15 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         check_is_fitted(self, "cluster_centers_")
         X = check_n_features(self, check_array(X))
         sample_weight = check_sample_weight(sample_weight, X)
-        from .._config import on_cpu_backend
+        from .._config import (host_routed_scope, on_cpu_backend,
+                               route_tiny_fit_to_host)
 
+        if (not on_cpu_backend() and self.compute_dtype is None
+                and route_tiny_fit_to_host(
+                    (X.shape[0] + self.n_clusters) * X.shape[1])):
+            # size-aware dispatch, same policy as predict
+            with host_routed_scope():
+                return self.score(X, y, sample_weight)
         # same gate as predict: f64-under-x64 keeps jax, all else host
         if (on_cpu_backend() and self.compute_dtype is None
                 and (X.dtype == np.float32

@@ -432,6 +432,28 @@ class QPCA(TransformerMixin, BaseEstimator):
 
         X = check_array(X, copy=self.copy)
         self.n_features_in_ = X.shape[1]
+        from .._config import (TINY_ROUTED_BACKEND, host_routed_scope,
+                               on_cpu_backend, route_tiny_fit_to_host)
+
+        if self.mesh is None and route_tiny_fit_to_host(X.size):
+            # same size-aware dispatch as QKMeans.fit: a digit-scale SVD
+            # (plus the quantum estimators downstream of it) on a remote
+            # accelerator is pure tunnel latency — run it on the host.
+            # An explicit device/mesh setting bypasses this (see
+            # _config.route_tiny_fit_to_host).
+            with host_routed_scope():
+                out = self._fit_impl(X)
+            self.fit_backend_ = TINY_ROUTED_BACKEND
+            return out
+        backend = "cpu" if on_cpu_backend() else jax.default_backend()
+        out = self._fit_impl(X)
+        self.fit_backend_ = backend
+        return out
+
+    def _fit_impl(self, X):
+        """The fit body proper (solver resolution + SVD + quantum
+        estimators), on whatever backend :meth:`fit` routed to; every
+        quantum fit kwarg was stashed on ``self`` by :meth:`fit`."""
         # set_config(device=...) placement: committing the input here pins
         # every downstream jit (SVD, quantum estimators) to that device —
         # except under a mesh, whose sharding owns placement
@@ -448,9 +470,10 @@ class QPCA(TransformerMixin, BaseEstimator):
             n_components = self.n_components
 
         # solver dispatch (reference _qPCA.py:538-553)
-        quantum_requested = (quantum_retained_variance or theta_estimate
-                             or estimate_all or estimate_least_k
-                             or spectral_norm_est or condition_number_est)
+        quantum_requested = (
+            self.quantum_retained_variance or self.theta_estimate
+            or self.estimate_all or self.estimate_least_k
+            or self.spectral_norm_est or self.condition_number_est)
         solver = self.svd_solver
         if solver == "auto":
             if quantum_requested:
@@ -1077,9 +1100,19 @@ class QPCA(TransformerMixin, BaseEstimator):
         ``compute_quantum_representation``, ``_qPCA.py:859-880``)."""
         if type == "est_representation":
             return self.compute_error(X, epsilon_delta, true_tomography)
-        Y = np.asarray(tomography(
-            self._next_key(), jnp.asarray(X), psi,
-            true_tomography=true_tomography))
+        if self.mesh is not None:
+            # pod-scale transform: the noisy estimates are drawn in-shard
+            # over the mesh (parallel.pca.tomography_sharded) — the
+            # projected matrix is never gathered onto one device
+            from ..parallel.pca import tomography_sharded
+
+            Y = np.asarray(tomography_sharded(
+                self.mesh, self._next_key(), jnp.asarray(X), psi,
+                true_tomography=true_tomography))
+        else:
+            Y = np.asarray(tomography(
+                self._next_key(), jnp.asarray(X), psi,
+                true_tomography=true_tomography))
         if type == "q_state":
             f_norm = np.linalg.norm(Y)
             row_norms_ = np.linalg.norm(Y, axis=1) / f_norm

@@ -5,7 +5,7 @@ thread reductions; SURVEY §2.3 maps them to psum over an ICI mesh.)
 """
 
 from . import distributed
-from .pca import centered_svd_sharded
+from .pca import centered_svd_sharded, tomography_sharded
 from .mesh import (
     DATA_AXIS,
     data_sharding,
@@ -24,4 +24,5 @@ __all__ = [
     "pad_to_multiple",
     "replicated",
     "shard_rows",
+    "tomography_sharded",
 ]

@@ -66,6 +66,51 @@ def centered_svd_sharded(mesh, X):
     return mean, U[:n], S, Vt
 
 
+@functools.partial(jax.jit, static_argnames=("noise", "true_tomography",
+                                              "N", "norm"))
+def _tomography_rows(key, Ap, mask, noise, true_tomography, N, norm):
+    from ..ops.quantum.tomography import tomography
+
+    # padding guard: a zero row would push 0/0 through the per-row state
+    # normalization inside the tomography sampler; give padding rows a
+    # unit basis vector and mask the estimates back to zero afterwards
+    e0 = jnp.zeros((Ap.shape[1],), Ap.dtype).at[0].set(1.0)
+    safe = Ap + (1.0 - mask)[:, None] * e0
+    est = tomography(key, safe, noise, true_tomography=true_tomography,
+                     N=N, norm=norm)
+    return est * mask[:, None]
+
+
+def tomography_sharded(mesh, key, A, noise, true_tomography=True, norm="L2"):
+    """Row-sharded tomography of a matrix over the mesh's first axis —
+    the quantum-transform side of qPCA at pod scale (reference
+    ``qPCA.transform`` → ``compute_quantum_representation``,
+    ``_qPCA.py:773-880``): each device draws the tomography estimates of
+    its own row shard (vmapped exact sampler, or the truncated-Gaussian
+    fast path), so the projected matrix is never gathered onto one
+    device. Statistically identical to
+    :func:`~sq_learn_tpu.ops.quantum.tomography` on the whole matrix; on
+    a 1-device mesh with no padding it is bit-identical to the XLA path
+    under the same key.
+    """
+    from ..ops.quantum.tomography import tomography_n_measurements
+
+    A = jnp.asarray(A)
+    if float(noise) == 0.0:
+        return A
+    n = A.shape[0]
+    Ap, _ = pad_to_multiple(A, int(mesh.devices.size))
+    mask = jnp.zeros((Ap.shape[0],), Ap.dtype).at[:n].set(1.0)
+    Ap, mask = shard_rows(mesh, Ap, mask)
+    # N is static host-side arithmetic (d, δ only): resolving it here
+    # keeps the jitted body free of shape-dependent python control flow
+    N = (tomography_n_measurements(A.shape[1], noise, norm)
+         if true_tomography else None)
+    out = _tomography_rows(key, Ap, mask, float(noise), true_tomography,
+                           N, norm)
+    return out[:n]
+
+
 def centered_sharded(mesh, X, mean):
     """Row-sharded centered copy of X with padding rows exactly zero.
 

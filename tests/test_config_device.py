@@ -213,6 +213,27 @@ class TestTinyFitHostRouting:
         # the real test backend IS cpu: never route (nothing to dodge)
         assert not _config.route_tiny_fit_to_host(1797 * 64)
 
+    def test_backend_probe_never_forces_init(self, monkeypatch):
+        """ADVICE r4 #2: the routing decision must not be the thing that
+        first initializes a (possibly wedged) accelerator backend — with
+        backends uninitialized and a platform spec pinned, the answer
+        comes from the spec alone."""
+        from jax._src import xla_bridge
+
+        from sq_learn_tpu import _config
+
+        # initialized tier: authoritative answer
+        assert (_config._default_backend_platform_no_init()
+                == jax.default_backend())
+        # uninitialized tier: first entry of the jax_platforms spec (the
+        # conftest pins 'cpu'); default_backend() must NOT be consulted
+        monkeypatch.setattr(xla_bridge, "backends_are_initialized",
+                            lambda: False)
+        monkeypatch.setattr(jax, "default_backend", lambda: (_ for _ in ())
+                            .throw(AssertionError("forced backend init")))
+        spec_first = jax.config.jax_platforms.split(",")[0].strip()
+        assert (_config._default_backend_platform_no_init() == spec_first)
+
     def test_fit_routes_and_matches_unrouted_results(self, blobs,
                                                      monkeypatch):
         X, _ = blobs
@@ -244,3 +265,144 @@ class TestTinyFitHostRouting:
         est = QKMeans(n_clusters=4, n_init=1, delta=0.0, use_pallas=False,
                       random_state=0).fit(X)
         assert est.fit_backend_ != "cpu:tiny-routed"
+
+
+class TestTinyRoutingExtendedSurfaces:
+    """Round-5 scope extension (VERDICT r4 next #4): the size-aware host
+    routing covers every tiny dispatch surface, not just QKMeans.fit —
+    QPCA.fit, MiniBatchQKMeans.fit/partial_fit, and the KNN search."""
+
+    def test_qpca_fit_routes_and_matches(self, blobs, monkeypatch):
+        from sq_learn_tpu import _config
+        from sq_learn_tpu.models import QPCA
+
+        X, _ = blobs
+        base = QPCA(n_components=2, random_state=0).fit(X)
+        assert base.fit_backend_ == "cpu"
+        monkeypatch.setattr(_config, "route_tiny_fit_to_host",
+                            lambda n: True)
+        routed = QPCA(n_components=2, random_state=0).fit(X)
+        assert routed.fit_backend_ == "cpu:tiny-routed"
+        np.testing.assert_allclose(routed.components_, base.components_,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(
+            routed.explained_variance_, base.explained_variance_, rtol=1e-6)
+
+    def test_qpca_mesh_bypasses_routing(self, blobs, monkeypatch):
+        from sq_learn_tpu import _config
+        from sq_learn_tpu.models import QPCA
+        from sq_learn_tpu.parallel import make_mesh
+
+        X, _ = blobs
+        monkeypatch.setattr(_config, "route_tiny_fit_to_host",
+                            lambda n: True)
+        est = QPCA(n_components=2, random_state=0,
+                   mesh=make_mesh(jax.devices("cpu")[:8])).fit(X)
+        assert est.fit_backend_ != "cpu:tiny-routed"
+
+    def test_minibatch_fit_routes_and_matches(self, blobs, monkeypatch):
+        from sq_learn_tpu import _config
+        from sq_learn_tpu.models import MiniBatchQKMeans
+
+        X, _ = blobs
+        kw = dict(n_clusters=4, batch_size=64, random_state=0, delta=0.0)
+        base = MiniBatchQKMeans(**kw).fit(X)
+        assert base.fit_backend_ == "cpu"
+        monkeypatch.setattr(_config, "route_tiny_fit_to_host",
+                            lambda n: True)
+        routed = MiniBatchQKMeans(**kw).fit(X)
+        assert routed.fit_backend_ == "cpu:tiny-routed"
+        np.testing.assert_allclose(routed.cluster_centers_,
+                                   base.cluster_centers_, rtol=1e-6)
+
+    def test_minibatch_partial_fit_routes_and_matches(self, blobs,
+                                                      monkeypatch):
+        from sq_learn_tpu import _config
+        from sq_learn_tpu.models import MiniBatchQKMeans
+
+        X, _ = blobs
+        kw = dict(n_clusters=4, batch_size=64, random_state=0, delta=0.0)
+        base = MiniBatchQKMeans(**kw).partial_fit(X).partial_fit(X)
+        assert base.fit_backend_ == "cpu"
+        monkeypatch.setattr(_config, "route_tiny_fit_to_host",
+                            lambda n: True)
+        routed = MiniBatchQKMeans(**kw).partial_fit(X).partial_fit(X)
+        assert routed.fit_backend_ == "cpu:tiny-routed"
+        np.testing.assert_allclose(routed.cluster_centers_,
+                                   base.cluster_centers_, rtol=1e-5)
+
+    def test_knn_search_routes_off_the_device_path(self, blobs, monkeypatch):
+        from sq_learn_tpu import _config
+        from sq_learn_tpu.models import KNeighborsClassifier
+
+        X, y = blobs
+        knn = KNeighborsClassifier(n_neighbors=3).fit(X, y)
+        want = knn.predict(X[:20])
+
+        # fake a remote-accelerator process: the host fast path disengages
+        # (backend != cpu) and the tiny-routing seam takes over
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        monkeypatch.setattr(_config, "route_tiny_fit_to_host",
+                            lambda n: True)
+        knn._device_search = lambda *a: (_ for _ in ()).throw(
+            AssertionError("tiny predict reached the device path"))
+        got = knn.predict(X[:20])
+        np.testing.assert_array_equal(got, want)
+
+    def test_qkmeans_predict_and_score_route(self, blobs, monkeypatch):
+        from sq_learn_tpu import _config
+
+        X, _ = blobs
+        est = QKMeans(n_clusters=4, n_init=1, delta=0.0,
+                      random_state=0).fit(X)
+        want_labels = est.predict(X[:30])
+        want_score = est.score(X[:30])
+        # fake a remote-accelerator process; the host fast path must be
+        # reached through the tiny-routing seam, never the device dispatch
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        monkeypatch.setattr(_config, "route_tiny_fit_to_host",
+                            lambda n: True)
+        from sq_learn_tpu.models import qkmeans as qk
+
+        def boom(*a, **k):
+            raise AssertionError("tiny predict reached the device path")
+
+        monkeypatch.setattr(qk, "e_step_jit", boom)
+        np.testing.assert_array_equal(est.predict(X[:30]), want_labels)
+        assert est.score(X[:30]) == pytest.approx(want_score, rel=1e-6)
+
+    def test_knn_explicit_settings_bypass_routing(self, blobs, monkeypatch):
+        from sq_learn_tpu import _config
+        from sq_learn_tpu.models import KNeighborsClassifier
+
+        X, y = blobs
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        monkeypatch.setattr(_config, "route_tiny_fit_to_host",
+                            lambda n: True)
+        knn = KNeighborsClassifier(n_neighbors=3, use_pallas=False)
+        knn.fit(X, y)
+        # an explicit kernel choice opts out of the size heuristic: the
+        # search must go to the device path, not the host engines
+        assert knn._tiny_routed_search(X[:20], 3) is None
+
+
+class TestFitBackendProvenance:
+    """fit_backend_ is assigned only after a successful fit (ADVICE r4
+    #1): a raise mid-fit must not leave a fitted-looking public attribute
+    for checkpoint.save_estimator to serialize."""
+
+    def test_qkmeans_failed_fit_leaves_no_backend(self, blobs):
+        X, _ = blobs
+        est = QKMeans(n_clusters=2, delta=0.0, intermediate_error=True)
+        with pytest.raises(ValueError, match="intermediate_error"):
+            est.fit(X)  # raises inside _fit_impl, after dispatch decided
+        assert not hasattr(est, "fit_backend_")
+
+    def test_qpca_failed_fit_leaves_no_backend(self, blobs):
+        from sq_learn_tpu.models import QPCA
+
+        X, _ = blobs
+        est = QPCA(n_components=2, svd_solver="bogus")
+        with pytest.raises(ValueError, match="Unrecognized svd_solver"):
+            est.fit(X)
+        assert not hasattr(est, "fit_backend_")

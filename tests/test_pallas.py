@@ -327,3 +327,167 @@ def test_lloyd_fuzz_matches_xla_across_lane_boundary(key):
         np.testing.assert_allclose(np.asarray(counts), np.asarray(rc),
                                    rtol=1e-5)
         np.testing.assert_allclose(float(inert), float(ri), rtol=1e-4)
+
+
+class TestKernelRejectionMemoization:
+    """The process-global rejection caches (VERDICT r4 next #5): a
+    structural Mosaic/lowering failure is learned once per (backend,
+    shape-family) signature; transient failures (OOM, tunnel resets) and
+    explicit ``use_pallas=True`` overrides never poison the caches."""
+
+    def test_memoizable_failure_classification(self):
+        from sq_learn_tpu.models.qkmeans import _memoizable_kernel_failure
+
+        # structural: lowering/compile rejections the backend will repeat
+        assert _memoizable_kernel_failure(NotImplementedError("no"))
+        assert _memoizable_kernel_failure(
+            RuntimeError("Mosaic lowering failed: op not supported"))
+        assert _memoizable_kernel_failure(
+            ValueError("UNIMPLEMENTED: dynamic slice on minor dim"))
+        # transient: must retry on the next fit/predict
+        assert not _memoizable_kernel_failure(
+            RuntimeError("RESOURCE_EXHAUSTED: out of memory while trying "
+                         "to allocate 1.2G"))
+        assert not _memoizable_kernel_failure(
+            RuntimeError("connection reset by peer"))
+        # an OOM whose message also names the compiler stays transient:
+        # the early RESOURCE_EXHAUSTED check wins over the MOSAIC keyword
+        assert not _memoizable_kernel_failure(
+            RuntimeError("RESOURCE_EXHAUSTED: mosaic kernel arena"))
+
+    @staticmethod
+    def _fit_knn(k=3, n=40, m=16, use_pallas="auto"):
+        from sq_learn_tpu.models.neighbors import KNeighborsClassifier
+
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(n, m)).astype(np.float32)
+        y = np.asarray(rng.integers(0, 3, n))
+        knn = KNeighborsClassifier(n_neighbors=k,
+                                   use_pallas=use_pallas).fit(X, y)
+        return knn, X[:5]
+
+    @staticmethod
+    def _patch_argkmin(monkeypatch, message):
+        """Replace the pallas argkmin with a raiser; returns the call log."""
+        from sq_learn_tpu.models import neighbors as nbr
+        from sq_learn_tpu.ops import pallas_kernels as pk
+
+        monkeypatch.setattr(nbr, "_argkmin_rejected", set())
+        calls = []
+
+        def fake_argkmin(Xtr, xsq, Xq, k, interpret=False):
+            calls.append(k)
+            raise RuntimeError(message)
+
+        monkeypatch.setattr(pk, "argkmin_pallas", fake_argkmin)
+        monkeypatch.setattr(pk, "pallas_available", lambda: True)
+        return calls
+
+    def test_structural_rejection_cached_once_per_signature(self, monkeypatch):
+        import warnings
+
+        from sq_learn_tpu.models import neighbors as nbr
+
+        calls = self._patch_argkmin(
+            monkeypatch, "Mosaic lowering failed: unsupported op")
+        knn, Xq = self._fit_knn()
+        with pytest.warns(UserWarning, match="falling back to the XLA"):
+            knn._device_search(Xq, 3)
+        assert calls == [3]
+        assert len(nbr._argkmin_rejected) == 1
+        # second call skips the pallas trace entirely — no retry, no warning
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            idx, d2 = knn._device_search(Xq, 3)
+        assert calls == [3]
+        assert idx.shape == (5, 3)  # XLA fallback still answers
+        # a different k is a different kernel shape family: not blacklisted
+        with pytest.warns(UserWarning, match="falling back to the XLA"):
+            knn._device_search(Xq, 2)
+        assert calls == [3, 2]
+
+    def test_explicit_use_pallas_true_bypasses_and_never_blacklists(
+            self, monkeypatch):
+        from sq_learn_tpu.models import neighbors as nbr
+
+        calls = self._patch_argkmin(
+            monkeypatch, "Mosaic lowering failed: unsupported op")
+        knn, Xq = self._fit_knn(use_pallas=True)
+        for _ in range(2):  # keeps retrying on every call (user override)
+            with pytest.warns(UserWarning, match="falling back to the XLA"):
+                knn._device_search(Xq, 3)
+        assert calls == [3, 3]
+        assert nbr._argkmin_rejected == set()
+        # ...and the explicit failures did not disable the auto path
+        auto_knn, _ = self._fit_knn(use_pallas="auto")
+        with pytest.warns(UserWarning, match="falling back to the XLA"):
+            auto_knn._device_search(Xq, 3)
+        assert calls == [3, 3, 3]
+
+    def test_transient_oom_not_blacklisted(self, monkeypatch):
+        from sq_learn_tpu.models import neighbors as nbr
+
+        calls = self._patch_argkmin(
+            monkeypatch, "RESOURCE_EXHAUSTED: out of memory in VMEM")
+        knn, Xq = self._fit_knn()
+        for _ in range(2):  # both calls attempt the kernel again
+            with pytest.warns(UserWarning, match="falling back to the XLA"):
+                knn._device_search(Xq, 3)
+        assert calls == [3, 3]
+        assert nbr._argkmin_rejected == set()
+
+    def test_kernel_ladder_memoizes_structural_per_signature(
+            self, monkeypatch):
+        import warnings
+
+        from sq_learn_tpu.models import qkmeans as qk
+
+        monkeypatch.setattr(qk, "_failed_kernels", set())
+        est = qk.QKMeans(n_clusters=2)
+        calls = []
+
+        def run(up, itp):
+            calls.append((up, itp))
+            if up:
+                raise NotImplementedError("mosaic says no")
+            return "ok"
+
+        with pytest.warns(RuntimeWarning, match="retrying without"):
+            out = est._kernel_ladder("lloyd", True, False, run, "giving up.",
+                                     sig=(5, 17))
+        assert out == "ok" and calls == [(True, False), (False, False)]
+        # second fit with the same signature: the rejected kernel is
+        # skipped up front (no re-trace, no warning)
+        calls.clear()
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            out = est._kernel_ladder("lloyd", True, False, run, "giving up.",
+                                     sig=(5, 17))
+        assert out == "ok" and calls == [(False, False)]
+        # a different operand signature re-learns the kernel independently
+        calls.clear()
+        with pytest.warns(RuntimeWarning, match="retrying without"):
+            est._kernel_ladder("lloyd", True, False, run, "giving up.",
+                               sig=(7, 3))
+        assert calls == [(True, False), (False, False)]
+
+    def test_kernel_ladder_transient_failures_retried(self, monkeypatch):
+        from sq_learn_tpu.models import qkmeans as qk
+
+        monkeypatch.setattr(qk, "_failed_kernels", set())
+        est = qk.QKMeans(n_clusters=2)
+        calls = []
+
+        def run(up, itp):
+            calls.append((up, itp))
+            if up:
+                raise RuntimeError("RESOURCE_EXHAUSTED: 2G on one operand")
+            return "ok"
+
+        for _ in range(2):
+            with pytest.warns(RuntimeWarning, match="retrying without"):
+                est._kernel_ladder("lloyd", True, False, run, "giving up.",
+                                   sig=(5, 17))
+        # the pallas plan was attempted both times — OOM is not structural
+        assert calls == [(True, False), (False, False)] * 2
+        assert qk._failed_kernels == set()

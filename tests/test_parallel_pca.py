@@ -87,3 +87,63 @@ def test_mesh_forces_full_solver(mesh):
     assert dp._fit_svd_solver == "full"
     with pytest.raises(ValueError, match="mesh requires svd_solver"):
         QPCA(n_components=5, svd_solver="randomized", mesh=mesh).fit(X)
+
+
+class TestTomographySharded:
+    """Row-sharded tomography (the quantum-transform side of pod-scale
+    qPCA, VERDICT r4 next #7)."""
+
+    def test_bit_identical_to_xla_path_on_one_device(self):
+        from sq_learn_tpu.ops.quantum.tomography import (
+            tomography, tomography_n_measurements)
+        from sq_learn_tpu.parallel import tomography_sharded
+
+        A = np.random.default_rng(0).normal(size=(16, 6)).astype(np.float32)
+        noise = 0.4
+        N = tomography_n_measurements(A.shape[1], noise, "L2")
+        mesh1 = make_mesh(jax.devices("cpu")[:1])
+        key = jax.random.PRNGKey(7)
+        sharded = tomography_sharded(mesh1, key, A, noise)
+        # jit forces the direct call down the same XLA sampler (an eager
+        # CPU call would route through the host twin's different stream)
+        direct = jax.jit(
+            lambda k, a: tomography(k, a, noise, true_tomography=True,
+                                    N=N))(key, jnp.asarray(A))
+        np.testing.assert_array_equal(np.asarray(sharded),
+                                      np.asarray(direct))
+
+    def test_mesh_noise_bounded_and_engaged(self, mesh):
+        from sq_learn_tpu.parallel import tomography_sharded
+
+        # 13 rows over 8 devices: padding rows exercised (they must not
+        # leak NaN through the per-row normalization guard)
+        A = np.random.default_rng(1).normal(size=(13, 8)).astype(np.float32)
+        noise = 0.3
+        est = np.asarray(tomography_sharded(
+            mesh, jax.random.PRNGKey(3), A, noise))
+        assert est.shape == A.shape
+        assert np.all(np.isfinite(est))
+        err = np.linalg.norm(est - A, axis=1)
+        assert err.max() > 0.0
+        assert err.max() < 3.0 * noise * np.linalg.norm(A, axis=1).max()
+
+    def test_zero_noise_short_circuits_exact(self, mesh):
+        from sq_learn_tpu.parallel import tomography_sharded
+
+        A = np.random.default_rng(2).normal(size=(24, 5)).astype(np.float32)
+        out = np.asarray(tomography_sharded(
+            mesh, jax.random.PRNGKey(0), A, 0.0))
+        np.testing.assert_array_equal(out, A)
+
+    def test_qpca_mesh_quantum_transform(self, mesh):
+        X = np.random.default_rng(3).normal(size=(67, 8)).astype(np.float32)
+        est = QPCA(n_components=4, mesh=mesh, random_state=0).fit(X)
+        Z = est.transform(X)
+        out = est.transform(X, classic_transform=False,
+                            quantum_representation=True, epsilon_delta=0.5,
+                            norm="None", psi=0.5)
+        Zq = np.asarray(out["quantum_representation_results"])
+        assert Zq.shape == Z.shape
+        err = np.linalg.norm(Zq - Z, axis=1)
+        assert 0.0 < err.max() < 3.0 * 0.5 * max(
+            np.linalg.norm(Z, axis=1).max(), 1.0)
