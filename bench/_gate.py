@@ -1,0 +1,66 @@
+"""BASELINE acceptance gate over a suite record file.
+
+Enforces BASELINE.md's bar (within 2x of classical sklearn, i.e.
+``vs_baseline >= 0.5``) on every JSON line of a `bench/run_suite.sh`
+record. Measured BASELINE configs and derived-baseline supplementary
+configs (``baseline_kind: "derived"`` in the JSON line — currently just
+``bench_ipe_digits``, whose ratio is a serial-cost derivation on the
+order of 1e4-1e5) are counted separately: the scales must never mix,
+but >= 0.5 still means "not slower than the reference's own (serial)
+architecture", so the bar applies to both kinds.
+
+A config that records no JSON line at all (double failure — both the
+primary run and the CPU retry died) fails the gate: a missing number is
+not a passing number. Likewise ``vs_baseline: null`` ("no baseline was
+measured") counts as a miss, never as a free 1.0 pass.
+
+Exit status 0 = gate green; non-zero with a diagnostic on stderr
+otherwise. Lives in its own module (rather than inline in run_suite.sh)
+so the counting rules are unit-testable (``tests/test_bench_gate.py``).
+"""
+
+import json
+import sys
+
+
+def check(record_path, expected_measured, expected_derived, out=sys.stdout):
+    """Return (fails, measured_count, derived_count) for a record file,
+    printing one ``# ACCEPT`` line per metric to ``out``."""
+    fails, measured, derived = [], 0, 0
+    for line in open(record_path):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if "metric" not in rec or "vs_baseline" not in rec:
+            continue
+        kind = rec.get("baseline_kind", "measured")
+        if kind == "derived":
+            derived += 1
+        else:
+            measured += 1
+        vb = rec["vs_baseline"]
+        ok = isinstance(vb, (int, float)) and vb >= 0.5
+        print(f"# ACCEPT {'pass' if ok else 'FAIL'}: {rec['metric']} "
+              f"({kind}) vs_baseline={vb}", file=out)
+        if not ok:
+            fails.append(rec["metric"])
+    return fails, measured, derived
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    record_path, exp_measured, exp_derived = (
+        argv[0], int(argv[1]), int(argv[2]))
+    fails, measured, derived = check(record_path, exp_measured, exp_derived)
+    if fails or measured != exp_measured or derived != exp_derived:
+        sys.exit(f"acceptance gate: fails={fails} "
+                 f"measured={measured}/{exp_measured} "
+                 f"derived={derived}/{exp_derived}")
+
+
+if __name__ == "__main__":
+    main()

@@ -103,14 +103,20 @@ def main():
     acc_c_mnist, mnist_curve = _sweep(pca, X, y, folds)
 
     headline = cicids_curve[0.8]["knn_acc"]
+    # rows are reported PER LEG (the legs differ: the cicids headline leg
+    # runs on max(4000, n_rows//2) rows, the mnist-shaped legs on n_rows)
     emit("qpca_cicids_eps_delta_sweep_knn_acc_at_0.8", headline,
          unit="accuracy", vs_baseline=headline / acc_c_cicids,
-         backend=jax.default_backend(), rows=n_rows, folds=folds,
+         backend=jax.default_backend(), folds=folds,
+         headline_rows=int(Xc_.shape[0]),
          cicids={"classical_knn_acc": round(acc_c_cicids, 4),
+                 "rows": int(Xc_.shape[0]),
                  "real": real_c, "sweep": cicids_curve},
          mnist_low_margin={"classical_knn_acc": round(acc_c_lm, 4),
+                           "rows": int(Xlm.shape[0]),
                            "real": False, "sweep": lm_curve},
          mnist_faithful={"classical_knn_acc": round(acc_c_mnist, 4),
+                         "rows": int(X.shape[0]),
                          "fit_s": round(t_fit, 3), "real": real,
                          "sweep": mnist_curve},
          surrogate_margin_caveat=(

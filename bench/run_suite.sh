@@ -79,52 +79,15 @@ for cmd in "python bench.py" \
   fi
 done
 
-# BASELINE acceptance gate (BASELINE.md: within 2x of classical sklearn,
-# i.e. vs_baseline >= 0.5, on the measurement of record). This script is
-# where the bar is enforced — the unit suite only warns, since wall-clock
-# there is subject to arbitrary host load.
+# BASELINE acceptance gate (bench/_gate.py: vs_baseline >= 0.5 on every
+# line, 5 measured + 1 derived line expected, missing/null = fail). This
+# script is where the bar is enforced — the unit suite only warns, since
+# wall-clock there is subject to arbitrary host load.
 # (PYTHONPATH cleared + timeout, like the retry path: the bare interpreter
 # pre-imports jax via the axon sitecustomize and would hang on a wedged
-# relay even though this step only parses JSON)
-env -u PYTHONPATH timeout 60 python - "$out" 5 1 <<'PY'
-import json, sys
-# measured BASELINE configs and derived-baseline supplementary configs
-# (baseline_kind="derived" in the JSON line) are counted separately: the
-# derived ratio lives on a different scale, but >= 0.5 still means "not
-# slower than the reference's own serial architecture" so the bar applies
-# to both
-exp_measured, exp_derived = int(sys.argv[2]), int(sys.argv[3])
-fails, measured, derived = [], 0, 0
-for line in open(sys.argv[1]):
-    line = line.strip()
-    if not line.startswith("{"):
-        continue
-    try:
-        rec = json.loads(line)
-    except json.JSONDecodeError:
-        continue
-    if "metric" not in rec or "vs_baseline" not in rec:
-        continue
-    kind = rec.get("baseline_kind", "measured")
-    if kind == "derived":
-        derived += 1
-    else:
-        measured += 1
-    vb = rec["vs_baseline"]
-    # null = the script measured no baseline (emit(vs_baseline=None));
-    # an unmeasured baseline is a miss, not a free pass
-    ok = isinstance(vb, (int, float)) and vb >= 0.5
-    print(f"# ACCEPT {'pass' if ok else 'FAIL'}: {rec['metric']} "
-          f"({kind}) vs_baseline={vb}")
-    if not ok:
-        fails.append(rec["metric"])
-if fails or measured != exp_measured or derived != exp_derived:
-    # a config that records only rc markers (double failure) must fail
-    # the gate too — a missing number is not a passing number
-    sys.exit(f"acceptance gate: fails={fails} "
-             f"measured={measured}/{exp_measured} "
-             f"derived={derived}/{exp_derived}")
-PY
+# relay even though this step only parses JSON; -m bench._gate resolves
+# via cwd, which is the repo root here)
+env -u PYTHONPATH timeout 60 python -m bench._gate "$out" 5 1
 gate_rc=$?
 echo "# acceptance gate rc=$gate_rc" >> "$out"
 echo "done: $out"

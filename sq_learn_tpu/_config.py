@@ -247,6 +247,28 @@ def host_routed_scope():
             yield
 
 
+def dispatch_tiny_routed(route, impl):
+    """The routed-fit contract shared by every fit-shaped surface
+    (QKMeans.fit, QPCA.fit, minibatch fit/partial_fit): run ``impl()``
+    under :func:`host_routed_scope` when ``route`` is truthy, else on the
+    current backend. Returns ``(out, fit_backend_label)`` — the label is
+    returned rather than assigned so callers set their public
+    ``fit_backend_`` only after ``impl`` has succeeded (a raise mid-fit
+    must not leave a fitted-looking attribute behind for checkpointing
+    to serialize). The inference-shaped surfaces (QKMeans
+    predict/score's cpu-pin re-entry, the KNN search's optional host
+    result) keep their own shapes on top of ``host_routed_scope``."""
+    if route:
+        with host_routed_scope():
+            out = impl()
+        return out, TINY_ROUTED_BACKEND
+    import jax
+
+    backend = "cpu" if on_cpu_backend() else jax.default_backend()
+    out = impl()
+    return out, backend
+
+
 def device_scope():
     """Context manager scoping computation to the configured device.
 

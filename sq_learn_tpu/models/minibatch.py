@@ -392,21 +392,13 @@ class MiniBatchQKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
             raise ValueError(
                 f"n_samples={X.shape[0]} should be >= n_clusters="
                 f"{self.n_clusters}.")
-        from .._config import (TINY_ROUTED_BACKEND, host_routed_scope,
-                               route_tiny_fit_to_host)
+        from .._config import dispatch_tiny_routed, route_tiny_fit_to_host
 
-        if route_tiny_fit_to_host(X.size):
-            # same size-aware dispatch as QKMeans.fit: a digit-scale
-            # streaming fit on a remote accelerator is pure tunnel latency
-            with host_routed_scope():
-                out = self._fit_impl(X, sample_weight)
-            self.fit_backend_ = TINY_ROUTED_BACKEND
-            return out
-        from .qkmeans import QKMeans as _QK
-
-        backend = ("cpu" if _QK._on_cpu_backend()
-                   else jax.default_backend())
-        out = self._fit_impl(X, sample_weight)
+        # same size-aware dispatch as QKMeans.fit: a digit-scale
+        # streaming fit on a remote accelerator is pure tunnel latency
+        out, backend = dispatch_tiny_routed(
+            route_tiny_fit_to_host(X.size),
+            lambda: self._fit_impl(X, sample_weight))
         self.fit_backend_ = backend
         return out
 
@@ -620,22 +612,14 @@ class MiniBatchQKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         # sklearn's partial_fit contract: reject before touching state
         X = check_n_features(self, check_array(X))
         self.n_features_in_ = X.shape[1]
-        from .._config import (TINY_ROUTED_BACKEND, host_routed_scope,
-                               route_tiny_fit_to_host)
+        from .._config import dispatch_tiny_routed, route_tiny_fit_to_host
 
-        if route_tiny_fit_to_host(X.size):
-            # one tiny batch = one dispatch-bound device round-trip; the
-            # inter-call state (cluster_centers_/counts_) lives in numpy,
-            # so per-call routing never strands state on either backend
-            with host_routed_scope():
-                out = self._partial_fit_impl(X, sample_weight)
-            self.fit_backend_ = TINY_ROUTED_BACKEND
-            return out
-        from .qkmeans import QKMeans as _QK
-
-        backend = ("cpu" if _QK._on_cpu_backend()
-                   else jax.default_backend())
-        out = self._partial_fit_impl(X, sample_weight)
+        # one tiny batch = one dispatch-bound device round-trip; the
+        # inter-call state (cluster_centers_/counts_) lives in numpy,
+        # so per-call routing never strands state on either backend
+        out, backend = dispatch_tiny_routed(
+            route_tiny_fit_to_host(X.size),
+            lambda: self._partial_fit_impl(X, sample_weight))
         self.fit_backend_ = backend
         return out
 

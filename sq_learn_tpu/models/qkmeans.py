@@ -1070,28 +1070,20 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         X = check_array(X, copy=False)
         self.n_features_in_ = X.shape[1]
         self._check_params(X)
-        from .._config import (TINY_ROUTED_BACKEND, host_routed_scope,
-                               route_tiny_fit_to_host)
+        from .._config import dispatch_tiny_routed, route_tiny_fit_to_host
 
-        if (self.mesh is None and self.use_pallas == "auto"
-                and route_tiny_fit_to_host(X.size)):
-            # Size-aware dispatch: a digit-scale fit on a remote
-            # accelerator is pure tunnel latency (the round-1 TPU headline
-            # measured 20× slower than the host engines on 1797×64) — run
-            # it on the host instead of letting wall-clock hinge on link
-            # health. Explicit device/mesh/use_pallas settings bypass this
-            # (see _config.route_tiny_fit_to_host).
-            with host_routed_scope():
-                out = self._fit_impl(X, sample_weight)
-            # assigned only after _fit_impl succeeds: a raise mid-fit must
-            # not leave a fitted-looking public attribute behind (which
-            # checkpoint.save_estimator would happily serialize)
-            self.fit_backend_ = TINY_ROUTED_BACKEND
-            return out
-        backend = ("cpu" if self._on_cpu_backend()
-                   else jax.default_backend())
-        out = self._fit_impl(X, sample_weight)
-        self.fit_backend_ = backend
+        # Size-aware dispatch: a digit-scale fit on a remote accelerator
+        # is pure tunnel latency (the round-1 TPU headline measured 20×
+        # slower than the host engines on 1797×64) — run it on the host
+        # instead of letting wall-clock hinge on link health. Explicit
+        # device/mesh/use_pallas/compute_dtype settings bypass this (see
+        # _config.route_tiny_fit_to_host).
+        route = (self.mesh is None and self.use_pallas == "auto"
+                 and self.compute_dtype is None
+                 and route_tiny_fit_to_host(X.size))
+        out, self_backend = dispatch_tiny_routed(
+            route, lambda: self._fit_impl(X, sample_weight))
+        self.fit_backend_ = self_backend
         return out
 
     def _fit_impl(self, X, sample_weight):

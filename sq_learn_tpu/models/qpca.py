@@ -432,21 +432,17 @@ class QPCA(TransformerMixin, BaseEstimator):
 
         X = check_array(X, copy=self.copy)
         self.n_features_in_ = X.shape[1]
-        from .._config import (TINY_ROUTED_BACKEND, host_routed_scope,
-                               on_cpu_backend, route_tiny_fit_to_host)
+        from .._config import dispatch_tiny_routed, route_tiny_fit_to_host
 
-        if self.mesh is None and route_tiny_fit_to_host(X.size):
-            # same size-aware dispatch as QKMeans.fit: a digit-scale SVD
-            # (plus the quantum estimators downstream of it) on a remote
-            # accelerator is pure tunnel latency — run it on the host.
-            # An explicit device/mesh setting bypasses this (see
-            # _config.route_tiny_fit_to_host).
-            with host_routed_scope():
-                out = self._fit_impl(X)
-            self.fit_backend_ = TINY_ROUTED_BACKEND
-            return out
-        backend = "cpu" if on_cpu_backend() else jax.default_backend()
-        out = self._fit_impl(X)
+        # same size-aware dispatch as QKMeans.fit: a digit-scale SVD
+        # (plus the quantum estimators downstream of it) on a remote
+        # accelerator is pure tunnel latency — run it on the host. An
+        # explicit device/mesh/compute_dtype setting bypasses this (see
+        # _config.route_tiny_fit_to_host).
+        route = (self.mesh is None and self.compute_dtype is None
+                 and route_tiny_fit_to_host(X.size))
+        out, backend = dispatch_tiny_routed(route,
+                                            lambda: self._fit_impl(X))
         self.fit_backend_ = backend
         return out
 

@@ -1,0 +1,85 @@
+"""bench/_gate.py — the suite acceptance gate's counting rules.
+
+The gate is the enforcement point of BASELINE.md's "within 2x" bar
+(vs_baseline >= 0.5 on the measurement of record), so its edge cases —
+null baselines, missing configs, the measured/derived split added in
+round 5 — are pinned here rather than living untested inside
+run_suite.sh.
+"""
+
+import io
+import json
+
+import pytest
+
+from bench._gate import check, main
+
+
+def _record(tmp_path, lines):
+    p = tmp_path / "rec.txt"
+    p.write_text("# suite run\n" + "\n".join(
+        json.dumps(rec) if isinstance(rec, dict) else rec
+        for rec in lines) + "\n")
+    return str(p)
+
+
+def _line(metric, vs_baseline, **extra):
+    rec = {"metric": metric, "value": 1.0, "unit": "s",
+           "vs_baseline": vs_baseline}
+    rec.update(extra)
+    return rec
+
+
+class TestGateCheck:
+    def test_green_record(self, tmp_path):
+        path = _record(tmp_path, [
+            _line("a", 2.0), _line("b", 0.9), _line("c", 1.2),
+            _line("d", 0.6), _line("e", 0.51),
+            _line("ipe", 5.5e4, baseline_kind="derived")])
+        fails, measured, derived = check(path, 5, 1, out=io.StringIO())
+        assert fails == [] and (measured, derived) == (5, 1)
+        main([path, "5", "1"])  # exits 0
+
+    def test_below_bar_fails(self, tmp_path):
+        path = _record(tmp_path, [_line("slow", 0.49)])
+        fails, _, _ = check(path, 1, 0, out=io.StringIO())
+        assert fails == ["slow"]
+        with pytest.raises(SystemExit, match="slow"):
+            main([path, "1", "0"])
+
+    def test_null_baseline_is_a_miss_not_a_pass(self, tmp_path):
+        path = _record(tmp_path, [_line("unmeasured", None)])
+        fails, _, _ = check(path, 1, 0, out=io.StringIO())
+        assert fails == ["unmeasured"]
+
+    def test_missing_config_fails_even_if_all_present_pass(self, tmp_path):
+        # double failure = only rc markers in the record, no JSON line
+        path = _record(tmp_path, [_line("a", 2.0), "# rc=124"])
+        with pytest.raises(SystemExit, match="measured=1/2"):
+            main([path, "2", "0"])
+
+    def test_derived_never_counts_toward_measured(self, tmp_path):
+        # a derived line must not paper over a missing measured config...
+        path = _record(tmp_path, [
+            _line("a", 2.0),
+            _line("ipe", 5.5e4, baseline_kind="derived")])
+        with pytest.raises(SystemExit, match="measured=1/2"):
+            main([path, "2", "0"])
+        # ...and a missing derived line fails too
+        with pytest.raises(SystemExit, match="derived=1/2"):
+            main([path, "1", "2"])
+
+    def test_derived_lines_share_the_bar(self, tmp_path):
+        # >= 0.5 means "not slower than the reference's serial
+        # architecture" — a derived ratio below it is a real failure
+        path = _record(tmp_path, [
+            _line("ipe", 0.3, baseline_kind="derived")])
+        fails, measured, derived = check(path, 0, 1, out=io.StringIO())
+        assert fails == ["ipe"] and (measured, derived) == (0, 1)
+
+    def test_non_json_and_malformed_lines_ignored(self, tmp_path):
+        path = _record(tmp_path, [
+            "# ACCEPT pass: stale", "{not json", '{"metric": "no_vb"}',
+            _line("a", 1.0)])
+        fails, measured, derived = check(path, 1, 0, out=io.StringIO())
+        assert fails == [] and (measured, derived) == (1, 0)

@@ -349,6 +349,27 @@ class TestTinyRoutingExtendedSurfaces:
         got = knn.predict(X[:20])
         np.testing.assert_array_equal(got, want)
 
+    def test_compute_dtype_bypasses_routing(self, blobs, monkeypatch):
+        """An explicit compute_dtype is a chip-path precision hint: the
+        routed surfaces must not silently reroute it to the host (the
+        bypass contract docs/api.md promises, uniform across surfaces)."""
+        import warnings
+
+        from sq_learn_tpu import _config
+        from sq_learn_tpu.models import QPCA
+
+        X, _ = blobs
+        monkeypatch.setattr(_config, "route_tiny_fit_to_host",
+                            lambda n: True)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            pca = QPCA(n_components=2, compute_dtype="bfloat16",
+                       random_state=0).fit(X)
+            km = QKMeans(n_clusters=4, n_init=1, delta=0.0,
+                         compute_dtype="bfloat16", random_state=0).fit(X)
+        assert pca.fit_backend_ != "cpu:tiny-routed"
+        assert km.fit_backend_ != "cpu:tiny-routed"
+
     def test_qkmeans_predict_and_score_route(self, blobs, monkeypatch):
         from sq_learn_tpu import _config
 
