@@ -126,11 +126,17 @@ class KNeighborsClassifier(ClassifierMixin, BaseEstimator):
 
     ``weights`` ∈ {'uniform', 'distance'}; ``algorithm`` accepted for
     compatibility — everything dispatches to the fused GEMM+top_k kernel.
+
+    ``mesh`` shards the TRAINING rows over the mesh's data axis and runs
+    every search via :func:`~sq_learn_tpu.parallel.knn_indices_sharded`
+    (the scaling path for corpora past one chip's HBM); it is exact
+    precision and takes precedence over the host/pallas/tiny-routing
+    dispatch, which are all single-device concerns.
     """
 
     def __init__(self, n_neighbors=5, *, weights="uniform",
                  algorithm="brute", p=2, n_jobs=None, compute_dtype=None,
-                 use_pallas="auto"):
+                 use_pallas="auto", mesh=None):
         self.n_neighbors = n_neighbors
         self.weights = weights
         self.algorithm = algorithm
@@ -138,6 +144,7 @@ class KNeighborsClassifier(ClassifierMixin, BaseEstimator):
         self.n_jobs = n_jobs
         self.compute_dtype = compute_dtype
         self.use_pallas = use_pallas
+        self.mesh = mesh
 
     @with_device_scope
     def fit(self, X, y):
@@ -156,6 +163,14 @@ class KNeighborsClassifier(ClassifierMixin, BaseEstimator):
         # would pay a dispatch + full-train reduction every call)
         if jnp.asarray(self.X_fit_).dtype == jnp.float32:
             self._xsq_dev = jnp.sum(self.X_fit_ * self.X_fit_, axis=1)
+        if self.mesh is not None:
+            # place the corpus on its shards once, at fit (see _search);
+            # a refit must rebuild, not reuse, the previous placement
+            from ..parallel.neighbors import shard_train_rows
+
+            self._mesh_state = shard_train_rows(self.mesh, self.X_fit_)
+        elif hasattr(self, "_mesh_state"):
+            del self._mesh_state
         return self
 
     def _host_search(self, X, k):
@@ -249,6 +264,36 @@ class KNeighborsClassifier(ClassifierMixin, BaseEstimator):
         return knn_indices(self.X_fit_, jnp.asarray(X), k,
                            compute_dtype=self.compute_dtype)
 
+    def _search(self, X, k):
+        """Full search dispatch, one ladder for every public surface:
+        mesh (train-sharded SPMD search) > host fast path > tiny-predict
+        host routing > single-device (pallas/XLA)."""
+        if self.mesh is not None:
+            if self.compute_dtype is not None:
+                import warnings as _warnings
+
+                _warnings.warn(
+                    "compute_dtype engages only the single-device search; "
+                    "the mesh path runs exact precision.", RuntimeWarning)
+            from ..parallel.neighbors import (knn_indices_sharded,
+                                             shard_train_rows)
+
+            if not hasattr(self, "_mesh_state"):
+                # the one corpus-sized transfer: pad + place the training
+                # rows on their shards once (at fit, or rebuilt here for
+                # checkpoint-restored models) — repeated predicts must
+                # not re-ship a corpus across a wedge-prone link
+                self._mesh_state = shard_train_rows(self.mesh, self.X_fit_)
+            return knn_indices_sharded(self.mesh, self.X_fit_,
+                                       jnp.asarray(X), k,
+                                       presharded=self._mesh_state)
+        host = self._host_search(X, k)
+        if host is None:
+            host = self._tiny_routed_search(X, k)
+        if host is not None:
+            return host
+        return self._device_search(X, k)
+
     def _check_k(self, k):
         """Validate a neighbor count before it reaches ``lax.top_k``
         (whose size error is opaque). Bounds and messages follow sklearn's
@@ -270,13 +315,7 @@ class KNeighborsClassifier(ClassifierMixin, BaseEstimator):
         check_is_fitted(self, "n_samples_fit_")
         X = check_n_features(self, check_array(X))
         k = self._check_k(n_neighbors)
-        host = self._host_search(X, k)
-        if host is None:
-            host = self._tiny_routed_search(X, k)
-        if host is not None:
-            idx, d2 = host
-        else:
-            idx, d2 = self._device_search(X, k)
+        idx, d2 = self._search(X, k)
         if return_distance:
             return np.sqrt(np.asarray(d2)), np.asarray(idx)
         return np.asarray(idx)
@@ -287,30 +326,24 @@ class KNeighborsClassifier(ClassifierMixin, BaseEstimator):
         X = check_n_features(self, check_array(X))
         k = self._check_k(self.n_neighbors)
         n_classes = len(self.classes_)
-        host = self._host_search(X, k)
-        if host is None:
-            host = self._tiny_routed_search(X, k)
-        if host is not None:
-            idx, d2 = host
-            votes = self._y_np[idx]                         # (n, k)
-            if self.weights == "distance":
-                wts = 1.0 / np.maximum(np.sqrt(d2), 1e-12)
-            else:
-                wts = np.ones_like(d2)
-            n = len(votes)
-            rows = np.repeat(np.arange(n), k)
-            counts = np.bincount(
-                rows * n_classes + votes.ravel(), weights=wts.ravel(),
-                minlength=n * n_classes).reshape(n, n_classes)
-            return counts / counts.sum(axis=1, keepdims=True)
-        idx, d2 = self._device_search(X, k)
-        votes = self.y_fit_[idx]  # (n, k)
-        onehot = jax.nn.one_hot(votes, n_classes)
+        idx, d2 = self._search(X, k)
+        # voting is host-side regardless of which engine searched: the
+        # (n, k) neighbor lists are tiny next to the search itself, and
+        # one bincount serves every path identically
+        idx, d2 = np.asarray(idx), np.asarray(d2)
+        y = (self._y_np if hasattr(self, "_y_np")
+             else np.asarray(self.y_fit_, np.int32))
+        votes = y[idx]                                      # (n, k)
         if self.weights == "distance":
-            w = 1.0 / jnp.maximum(jnp.sqrt(d2), 1e-12)
-            onehot = onehot * w[..., None]
-        counts = jnp.sum(onehot, axis=1)
-        return np.asarray(counts / jnp.sum(counts, axis=1, keepdims=True))
+            wts = 1.0 / np.maximum(np.sqrt(d2), 1e-12)
+        else:
+            wts = np.ones_like(d2)
+        n = len(votes)
+        rows = np.repeat(np.arange(n), k)
+        counts = np.bincount(
+            rows * n_classes + votes.ravel(), weights=wts.ravel(),
+            minlength=n * n_classes).reshape(n, n_classes)
+        return counts / counts.sum(axis=1, keepdims=True)
 
     def predict(self, X):
         proba = self.predict_proba(X)

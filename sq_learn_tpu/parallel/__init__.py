@@ -5,6 +5,7 @@ thread reductions; SURVEY §2.3 maps them to psum over an ICI mesh.)
 """
 
 from . import distributed
+from .neighbors import knn_indices_sharded
 from .pca import centered_svd_sharded, tomography_sharded
 from .mesh import (
     DATA_AXIS,
@@ -20,6 +21,7 @@ __all__ = [
     "centered_svd_sharded",
     "data_sharding",
     "distributed",
+    "knn_indices_sharded",
     "make_mesh",
     "pad_to_multiple",
     "replicated",

@@ -1,0 +1,120 @@
+"""Train-sharded brute-force k-NN search over a device mesh.
+
+The reference's neighbors module scales through ball/KD trees on one
+host (``neighbors/_ball_tree.pyx``, ``_kd_tree.pyx``) — pointer-chasing
+structures that neither shard nor vectorize. The TPU-native scaling path
+(SURVEY §2.2 "neighbors" row + §2.3's OpenMP→mesh mapping) shards the
+TRAINING rows over the mesh's data axis: each device GEMMs query blocks
+against its shard on the MXU, keeps a local k-best, and only the
+per-shard candidate lists — (n_q, k) distances + global row ids — cross
+ICI to be merged into the global top-k. Queries are blocked with
+``lax.map`` exactly like the single-device search, so neither the
+(n_q, n_train) nor an (n_q, per_shard) distance matrix ever
+materializes; the training corpus never leaves its shards. Queries are
+replicated, which is the regime these pipelines actually run (a CV fold
+of queries against a large fitted corpus).
+
+Exact-precision only: the ``compute_dtype`` shortlist trick and the
+single-device pallas argkmin stay on the unsharded path
+(``models/neighbors.py``) — per-shard pallas under ``shard_map`` is the
+natural extension once Mosaic-validated on hardware.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import PartitionSpec as P
+
+from ..ops.linalg import pairwise_sq_distances
+from .mesh import DATA_AXIS, pad_to_multiple, shard_rows
+
+#: additive distance penalty that pushes padding rows past every real
+#: candidate without overflowing float32 arithmetic in the merge
+_PAD_PENALTY = 1e30
+
+
+def shard_train_rows(mesh, X_train):
+    """Pad the training rows to a device-count multiple and place them
+    (plus the padding mask) sharded over the mesh — the one corpus-sized
+    transfer of a sharded search. Returns an opaque ``(Xp, mask, per,
+    n)`` state for :func:`knn_indices_sharded`'s ``presharded=``;
+    callers with a fitted corpus (``KNeighborsClassifier(mesh=...)``)
+    cache it at fit so repeated predicts never re-ship the corpus."""
+    X_train = jnp.asarray(X_train)
+    n = X_train.shape[0]
+    ndev = int(mesh.devices.size)
+    Xp, _ = pad_to_multiple(X_train, ndev)
+    per = Xp.shape[0] // ndev
+    mask = jnp.zeros((Xp.shape[0],), Xp.dtype).at[:n].set(1.0)
+    Xp, mask = shard_rows(mesh, Xp, mask)
+    return Xp, mask, per, n
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_candidates(mesh, k_local, per_shard, block):
+    """Jitted shard_map'd local search, cached per (mesh, k_local, shard
+    size, query block) like the sharded Lloyd kernel — restarts and
+    repeated predicts reuse one compilation."""
+
+    def search(X_local, mask_local, Q, qsq):
+        def one_block(args):
+            q, qs = args
+            d2 = pairwise_sq_distances(q, X_local, x_sq_norms=qs) \
+                + (1.0 - mask_local)[None, :] * _PAD_PENALTY
+            neg, idx = lax.top_k(-d2, k_local)
+            return -neg, idx
+
+        qb = Q.reshape(-1, block, Q.shape[1])
+        sb = qsq.reshape(-1, block)
+        d2k, idxk = lax.map(one_block, (qb, sb))
+        d2k = d2k.reshape(-1, k_local)
+        idxk = idxk.reshape(-1, k_local)
+        # local row ids -> global: every shard holds exactly per_shard rows
+        gidx = idxk + lax.axis_index(DATA_AXIS) * per_shard
+        return d2k, gidx
+
+    return jax.jit(shard_map(
+        search, mesh=mesh,
+        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(), P()),
+        # candidate lists concatenate along the candidate axis: the merge
+        # sees (n_q, n_dev * k_local) — the only cross-device traffic
+        out_specs=(P(None, DATA_AXIS), P(None, DATA_AXIS)),
+    ))
+
+
+def knn_indices_sharded(mesh, X_train, X_query, k, presharded=None,
+                        block=4096):
+    """Indices + squared distances of the k nearest training rows per
+    query, computed with the training rows sharded over ``mesh``.
+
+    Matches :func:`~sq_learn_tpu.models.neighbors.knn_indices` (exact
+    path) on the same input up to tie order — ties across shard
+    boundaries merge in shard order rather than global index order, the
+    same freedom sklearn's trees (and our host engines) already have.
+    The caller guarantees ``k <= n_train`` (the classifier's
+    ``_check_k`` contract). Pass ``presharded`` from
+    :func:`shard_train_rows` to skip the per-call corpus placement.
+    """
+    if presharded is None:
+        presharded = shard_train_rows(mesh, X_train)
+    Xp, mask, per, n = presharded
+    X_query = jnp.asarray(X_query)
+    nq = X_query.shape[0]
+    # a shard can contribute at most `per` candidates; with k <= n the
+    # union of shards always holds k real rows
+    k_local = min(k, per)
+    # query blocking, same discipline (and same small-set lane padding)
+    # as the single-device knn_indices: tiny predicts don't pay a full
+    # 4096-row GEMM, huge ones never materialize (n_q, per_shard)
+    block = min(block, nq + (-nq) % 8)
+    qpad = (-nq) % block
+    Qp = jnp.pad(X_query, ((0, qpad), (0, 0)))
+    qsq = jnp.sum(Qp * Qp, axis=1)
+    d2_cand, idx_cand = _sharded_candidates(mesh, k_local, per, block)(
+        Xp, mask, Qp, qsq)
+    # replicated merge over n_dev * k_local candidates per query
+    neg, pos = lax.top_k(-d2_cand, k)
+    idx = jnp.take_along_axis(idx_cand, pos, axis=1)
+    return idx[:nq], -neg[:nq]

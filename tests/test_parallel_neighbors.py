@@ -1,0 +1,91 @@
+"""Train-sharded k-NN search (parallel/neighbors.py) vs the single-device
+path, plus the classifier's mesh dispatch."""
+
+import numpy as np
+import jax
+import pytest
+
+from sq_learn_tpu.models.neighbors import KNeighborsClassifier, knn_indices
+from sq_learn_tpu.parallel import knn_indices_sharded, make_mesh
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(jax.devices("cpu")[:8])
+
+
+@pytest.mark.parametrize("n,nq,k", [
+    (256, 40, 5),    # even shards
+    (101, 7, 10),    # uneven shards (padding rows in play)
+    (20, 4, 10),     # k exceeds the per-shard row count
+    (64, 5, 64),     # k == n_train (every row is a neighbor)
+])
+def test_matches_single_device(mesh, n, nq, k):
+    rng = np.random.default_rng(3)
+    Xt = rng.normal(size=(n, 11)).astype(np.float32)
+    Xq = rng.normal(size=(nq, 11)).astype(np.float32)
+    si, sd = knn_indices_sharded(mesh, Xt, Xq, k)
+    ri, rd = knn_indices(Xt, Xq, k)
+    # continuous random data: no exact distance ties, so indices must
+    # agree exactly, not just up to tie order
+    np.testing.assert_array_equal(np.asarray(si), np.asarray(ri))
+    np.testing.assert_allclose(np.asarray(sd), np.asarray(rd),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_padding_rows_never_selected(mesh):
+    # 9 rows over 8 devices pads to 16: 7 padding rows, and k=9 demands
+    # every REAL row back
+    rng = np.random.default_rng(4)
+    Xt = rng.normal(size=(9, 6)).astype(np.float32)
+    Xq = rng.normal(size=(3, 6)).astype(np.float32)
+    idx, d2 = knn_indices_sharded(mesh, Xt, Xq, 9)
+    assert np.asarray(idx).max() < 9
+    assert np.all(np.asarray(d2) < 1e29)  # no _PAD_PENALTY leaked
+
+
+def test_classifier_mesh_dispatch(mesh):
+    rng = np.random.default_rng(5)
+    X = np.concatenate([rng.normal(size=(60, 8)) + 4.0,
+                        rng.normal(size=(60, 8)) - 4.0]).astype(np.float32)
+    y = np.repeat([0, 1], 60)
+    base = KNeighborsClassifier(n_neighbors=3).fit(X, y)
+    meshed = KNeighborsClassifier(n_neighbors=3, mesh=mesh).fit(X, y)
+    np.testing.assert_array_equal(meshed.predict(X), base.predict(X))
+    np.testing.assert_allclose(meshed.predict_proba(X),
+                               base.predict_proba(X), rtol=1e-5)
+    d_m, i_m = meshed.kneighbors(X[:10])
+    d_b, i_b = base.kneighbors(X[:10])
+    np.testing.assert_array_equal(i_m, i_b)
+    # self-queries have true distance 0; float32 GEMM round-off of ~1e-5
+    # in d² becomes ~3e-3 after the sqrt, so the distance tolerance is
+    # looser than the squared-distance comparisons elsewhere
+    np.testing.assert_allclose(d_m, d_b, rtol=1e-4, atol=1e-2)
+
+
+def test_classifier_mesh_warns_on_compute_dtype(mesh):
+    rng = np.random.default_rng(6)
+    X = rng.normal(size=(40, 8)).astype(np.float32)
+    y = (rng.random(40) > 0.5).astype(int)
+    knn = KNeighborsClassifier(n_neighbors=3, mesh=mesh,
+                               compute_dtype="bfloat16").fit(X, y)
+    with pytest.warns(RuntimeWarning, match="mesh path runs exact"):
+        knn.predict(X[:5])
+
+
+def test_corpus_placed_once_at_fit(mesh, monkeypatch):
+    """Repeated meshed predicts must reuse the fit-time shard placement —
+    re-shipping the corpus per predict is exactly the >=200 MB-upload
+    relay hazard the cache exists to avoid."""
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(50, 8)).astype(np.float32)
+    y = (rng.random(50) > 0.5).astype(int)
+    knn = KNeighborsClassifier(n_neighbors=3, mesh=mesh).fit(X, y)
+    from sq_learn_tpu.parallel import neighbors as pnbr
+
+    def boom(*a, **k):
+        raise AssertionError("corpus re-sharded after fit")
+
+    monkeypatch.setattr(pnbr, "shard_train_rows", boom)
+    knn.predict(X[:5])
+    knn.kneighbors(X[:5])
