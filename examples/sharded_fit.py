@@ -7,7 +7,11 @@ flagship estimators take a ``mesh``:
 - ``QKMeans(mesh=...)`` runs the Lloyd loop under ``shard_map`` with psum
   centroid/inertia reductions over ICI.
 - ``QPCA(mesh=...)`` computes the fit SVD from a sample-sharded Gram
-  contraction (per-shard GEMMs + one m×m all-reduce).
+  contraction (per-shard GEMMs + one m×m all-reduce), and its quantum
+  transform draws tomography estimates in-shard.
+- ``KNeighborsClassifier(mesh=...)`` shards the TRAINING corpus: each
+  device searches its shard, only (n_q, k) candidate lists cross ICI.
+- ``TruncatedSVD(mesh=...)`` is the uncentered variant of the qPCA path.
 
 On a pod slice this script runs unchanged over the real chips; here it
 demonstrates on however many devices the backend exposes (the test suite
@@ -55,11 +59,31 @@ def main():
 
     # data-parallel qPCA (classical fit; quantum estimators compose the
     # same way — they consume the spectrum, which is replicated)
-    Xd, _ = load_digits()
+    Xd, yd = load_digits()
     pca = QPCA(n_components=16, svd_solver="full", mesh=mesh,
                random_state=0).fit(Xd)
     print(f"qPCA: explained variance ratio (top-16) = "
           f"{pca.explained_variance_ratio_.sum():.4f}")
+
+    # ...and its tomography-noised transform, drawn in-shard over the mesh
+    noisy = pca.transform(Xd[:64], classic_transform=False,
+                          quantum_representation=True, epsilon_delta=0.5,
+                          norm="None", psi=0.5)
+    Zq = np.asarray(noisy["quantum_representation_results"])
+    print(f"qPCA quantum transform (sharded tomography): shape={Zq.shape}")
+
+    # train-sharded KNN: the corpus lives on its shards, every search
+    # merges per-shard candidate lists over ICI
+    from sq_learn_tpu.models import KNeighborsClassifier, TruncatedSVD
+
+    knn = KNeighborsClassifier(n_neighbors=5, mesh=mesh).fit(Xd, yd)
+    acc = float((knn.predict(Xd[:300]) == yd[:300]).mean())
+    print(f"sharded KNN: train accuracy on 300 digits = {acc:.3f}")
+
+    # uncentered sharded SVD (the LSA/TruncatedSVD contract)
+    tsvd = TruncatedSVD(n_components=8, mesh=mesh).fit(Xd)
+    print(f"sharded TruncatedSVD: top singular value = "
+          f"{tsvd.singular_values_[0]:.1f}")
 
 
 if __name__ == "__main__":

@@ -25,15 +25,24 @@ class TruncatedSVD(TransformerMixin, BaseEstimator):
     Parameters follow the reference surface: ``algorithm`` ∈ {'randomized',
     'arpack'} ('arpack' dispatches to an exact thin SVD — no ARPACK on
     XLA), ``n_iter`` power iterations for the randomized range finder.
+    ``mesh`` runs the fit as a sample-sharded Gram-route SVD over the
+    mesh's data axis (:func:`~sq_learn_tpu.parallel.uncentered_svd_sharded`)
+    for sample counts past one chip's HBM. The Gram route squares the
+    condition number: in float32, components whose singular values sit
+    ~3 decades under σ₁ lose accuracy relative to the single-device
+    direct routes — acceptable for the leading components a truncated
+    factorization keeps, but check ``singular_values_`` spread before
+    trusting deep tails under a mesh.
     """
 
     def __init__(self, n_components=2, *, algorithm="randomized", n_iter=5,
-                 random_state=None, tol=0.0):
+                 random_state=None, tol=0.0, mesh=None):
         self.n_components = n_components
         self.algorithm = algorithm
         self.n_iter = n_iter
         self.random_state = random_state
         self.tol = tol
+        self.mesh = mesh
 
     def fit(self, X, y=None):
         self.fit_transform(X)
@@ -48,19 +57,33 @@ class TruncatedSVD(TransformerMixin, BaseEstimator):
             raise ValueError(
                 f"n_components must be in [1, n_features={n_features}) and "
                 f"<= n_samples={n_samples}; got {k}")
-        Xd = as_device_array(X)  # set_config(device=...) placement
-        if self.algorithm == "randomized":
+        if self.algorithm not in ("randomized", "arpack"):
+            raise ValueError(
+                f"algorithm must be 'randomized' or 'arpack', got "
+                f"{self.algorithm!r}")
+        if self.mesh is not None:
+            # sample-sharded Gram-route SVD regardless of `algorithm`
+            # (same policy as QPCA's mesh-forces-'full'); placement
+            # belongs to the sharding, not as_device_array. Accuracy
+            # caveat: the Gram route squares the condition number, so in
+            # float32 trailing components past sigma_1/sigma_k ~ 1e3 are
+            # less accurate than the direct QR route of the single-device
+            # paths — the right trade for the leading components a
+            # truncated factorization keeps (see class docstring)
+            from ..parallel.pca import uncentered_svd_sharded
+
+            U, S, Vt = uncentered_svd_sharded(self.mesh, X)
+            U, S, Vt = U[:, :k], S[:k], Vt[:k]
+        elif self.algorithm == "randomized":
+            Xd = as_device_array(X)  # set_config(device=...) placement
             U, S, Vt = randomized_svd(as_key(self.random_state), Xd, k,
                                       n_iter=self.n_iter)
-        elif self.algorithm == "arpack":
+        else:  # 'arpack' -> exact thin SVD
+            Xd = as_device_array(X)
             U, S, Vt = thin_svd(Xd)
             # V-based: the sign convention every SVD path shares
             U, Vt = svd_flip_v(U, Vt)
             U, S, Vt = U[:, :k], S[:k], Vt[:k]
-        else:
-            raise ValueError(
-                f"algorithm must be 'randomized' or 'arpack', got "
-                f"{self.algorithm!r}")
 
         self.components_ = np.asarray(Vt)
         self.singular_values_ = np.asarray(S)

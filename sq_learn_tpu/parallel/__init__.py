@@ -6,7 +6,8 @@ thread reductions; SURVEY §2.3 maps them to psum over an ICI mesh.)
 
 from . import distributed
 from .neighbors import knn_indices_sharded
-from .pca import centered_svd_sharded, tomography_sharded
+from .pca import (centered_svd_sharded, tomography_sharded,
+                  uncentered_svd_sharded)
 from .mesh import (
     DATA_AXIS,
     data_sharding,
@@ -27,4 +28,5 @@ __all__ = [
     "replicated",
     "shard_rows",
     "tomography_sharded",
+    "uncentered_svd_sharded",
 ]

@@ -53,3 +53,19 @@ def shard_rows(mesh, *arrays, axis_name=DATA_AXIS):
     sharding = data_sharding(mesh, axis_name)
     out = tuple(jax.device_put(a, sharding) for a in arrays)
     return out if len(out) > 1 else out[0]
+
+
+def pad_and_shard(mesh, X):
+    """Pad axis 0 to a device-count multiple and place (X, padding mask)
+    row-sharded — the common preamble of every sharded row routine
+    (SVD, tomography, k-NN). Returns (Xp_sharded, mask_sharded,
+    n_true_rows); padding rows carry mask 0 and must be masked out or
+    banished by the caller."""
+    import jax.numpy as jnp
+
+    X = jnp.asarray(X)
+    n = X.shape[0]
+    Xp, _ = pad_to_multiple(X, int(mesh.devices.size))
+    mask = jnp.zeros((Xp.shape[0],), Xp.dtype).at[:n].set(1.0)
+    Xp, mask = shard_rows(mesh, Xp, mask)
+    return Xp, mask, n

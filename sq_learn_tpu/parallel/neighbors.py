@@ -28,7 +28,7 @@ from jax import lax, shard_map
 from jax.sharding import PartitionSpec as P
 
 from ..ops.linalg import pairwise_sq_distances
-from .mesh import DATA_AXIS, pad_to_multiple, shard_rows
+from .mesh import DATA_AXIS, pad_and_shard
 
 #: additive distance penalty that pushes padding rows past every real
 #: candidate without overflowing float32 arithmetic in the merge
@@ -42,13 +42,8 @@ def shard_train_rows(mesh, X_train):
     n)`` state for :func:`knn_indices_sharded`'s ``presharded=``;
     callers with a fitted corpus (``KNeighborsClassifier(mesh=...)``)
     cache it at fit so repeated predicts never re-ship the corpus."""
-    X_train = jnp.asarray(X_train)
-    n = X_train.shape[0]
-    ndev = int(mesh.devices.size)
-    Xp, _ = pad_to_multiple(X_train, ndev)
-    per = Xp.shape[0] // ndev
-    mask = jnp.zeros((Xp.shape[0],), Xp.dtype).at[:n].set(1.0)
-    Xp, mask = shard_rows(mesh, Xp, mask)
+    Xp, mask, n = pad_and_shard(mesh, X_train)
+    per = Xp.shape[0] // int(mesh.devices.size)
     return Xp, mask, per, n
 
 

@@ -19,20 +19,26 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.linalg import gram_spectrum, svd_flip_v
-from .mesh import pad_to_multiple, shard_rows
+from .mesh import pad_and_shard as _pad_and_shard
 
 
-@functools.partial(jax.jit, static_argnames=("n",))
-def _masked_centered_svd(X, w, n):
-    """Gram-route thin SVD of the weighted-centered rows of X.
+@functools.partial(jax.jit, static_argnames=("n", "center"))
+def _masked_gram_svd(X, w, n, center):
+    """Gram-route thin SVD of the masked rows of X, optionally centered.
 
     ``w`` zeroes padding rows so they contribute to neither the mean nor
     the Gram matrix; ``n`` is the true row count. Shardings propagate from
     the operands: with X/w row-sharded, the row-sums and the Gram
     contraction lower to per-shard partials + an ICI all-reduce.
+    ``center=False`` is the LSA/TruncatedSVD contract — the reference
+    factors the raw matrix (``decomposition/_truncated_svd.py:170-182``,
+    svds/randomized_svd on X itself, no mean subtraction).
     """
-    wX = X * w[:, None]
-    mean = jnp.sum(wX, axis=0) / n
+    if center:
+        wX = X * w[:, None]
+        mean = jnp.sum(wX, axis=0) / n
+    else:
+        mean = jnp.zeros((X.shape[1],), X.dtype)
     Xc = (X - mean) * w[:, None]
     G = Xc.T @ Xc  # (m, m) — per-shard GEMM + psum
     S, V, safe = gram_spectrum(G)  # replicated
@@ -56,14 +62,23 @@ def centered_svd_sharded(mesh, X):
     on the same input; U's rows are returned for the unpadded samples only,
     still sharded over the mesh.
     """
-    X = jnp.asarray(X)
-    n = X.shape[0]
-    ndev = int(mesh.devices.size)
-    Xp, _ = pad_to_multiple(X, ndev)
-    mask = jnp.zeros((Xp.shape[0],), Xp.dtype).at[:n].set(1.0)
-    Xp, mask = shard_rows(mesh, Xp, mask)
-    mean, U, S, Vt = _masked_centered_svd(Xp, mask, n)
+    Xp, mask, n = _pad_and_shard(mesh, X)
+    mean, U, S, Vt = _masked_gram_svd(Xp, mask, n, center=True)
     return mean, U[:n], S, Vt
+
+
+def uncentered_svd_sharded(mesh, X):
+    """Thin SVD of X without centering, data-parallel over ``mesh``'s
+    first axis — the sharded engine behind ``TruncatedSVD(mesh=...)``
+    (reference contract: ``decomposition/_truncated_svd.py:170-182``
+    factors the raw uncentered matrix). Matches the single-device exact
+    path (``thin_svd`` + ``svd_flip_v``) on the same input up to the
+    Gram route's conditioning (see the TruncatedSVD docstring); U's rows
+    are returned for the unpadded samples only, still sharded over the
+    mesh."""
+    Xp, mask, n = _pad_and_shard(mesh, X)
+    _, U, S, Vt = _masked_gram_svd(Xp, mask, n, center=False)
+    return U[:n], S, Vt
 
 
 @functools.partial(jax.jit, static_argnames=("noise", "true_tomography",
@@ -98,10 +113,7 @@ def tomography_sharded(mesh, key, A, noise, true_tomography=True, norm="L2"):
     A = jnp.asarray(A)
     if float(noise) == 0.0:
         return A
-    n = A.shape[0]
-    Ap, _ = pad_to_multiple(A, int(mesh.devices.size))
-    mask = jnp.zeros((Ap.shape[0],), Ap.dtype).at[:n].set(1.0)
-    Ap, mask = shard_rows(mesh, Ap, mask)
+    Ap, mask, n = _pad_and_shard(mesh, A)
     # N is static host-side arithmetic (d, δ only): resolving it here
     # keeps the jitted body free of shape-dependent python control flow
     N = (tomography_n_measurements(A.shape[1], noise, norm)
@@ -119,9 +131,5 @@ def centered_sharded(mesh, X, mean):
     nothing to μ's power sums or the Frobenius norm, so downstream jnp
     reductions over this array equal those over the unpadded centered X.
     """
-    X = jnp.asarray(X)
-    n = X.shape[0]
-    Xp, _ = pad_to_multiple(X, int(mesh.devices.size))
-    mask = jnp.zeros((Xp.shape[0],), Xp.dtype).at[:n].set(1.0)
-    Xp, mask = shard_rows(mesh, Xp, mask)
+    Xp, mask, _ = _pad_and_shard(mesh, X)
     return (Xp - jnp.asarray(mean)) * mask[:, None]

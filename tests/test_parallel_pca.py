@@ -147,3 +147,39 @@ class TestTomographySharded:
         err = np.linalg.norm(Zq - Z, axis=1)
         assert 0.0 < err.max() < 3.0 * 0.5 * max(
             np.linalg.norm(Z, axis=1).max(), 1.0)
+
+
+class TestUncenteredSVDSharded:
+    """Sample-sharded LSA SVD (TruncatedSVD's mesh engine)."""
+
+    @pytest.mark.parametrize("n", [160, 103])  # even and uneven shards
+    def test_matches_exact_thin_svd(self, mesh, n):
+        from sq_learn_tpu.ops.linalg import svd_flip_v, thin_svd
+        from sq_learn_tpu.parallel import uncentered_svd_sharded
+
+        X = np.random.default_rng(7).normal(size=(n, 12)).astype(np.float32)
+        U_s, S_s, Vt_s = uncentered_svd_sharded(mesh, X)
+        U, S, Vt = thin_svd(jnp.asarray(X))
+        U, Vt = svd_flip_v(U, Vt)
+        np.testing.assert_allclose(np.asarray(S_s), np.asarray(S),
+                                   rtol=1e-4, atol=1e-3)
+        np.testing.assert_allclose(np.asarray(Vt_s), np.asarray(Vt),
+                                   rtol=1e-3, atol=2e-3)
+        np.testing.assert_allclose(np.asarray(U_s), np.asarray(U),
+                                   rtol=1e-3, atol=2e-3)
+
+    def test_truncated_svd_mesh_matches_exact(self, mesh):
+        from sq_learn_tpu.models import TruncatedSVD
+
+        X = np.random.default_rng(8).normal(size=(91, 15)).astype(np.float32)
+        exact = TruncatedSVD(n_components=5, algorithm="arpack").fit(X)
+        meshed = TruncatedSVD(n_components=5, mesh=mesh).fit(X)
+        np.testing.assert_allclose(meshed.singular_values_,
+                                   exact.singular_values_, rtol=1e-4)
+        np.testing.assert_allclose(meshed.components_, exact.components_,
+                                   rtol=1e-3, atol=2e-3)
+        np.testing.assert_allclose(meshed.transform(X), exact.transform(X),
+                                   rtol=1e-3, atol=2e-3)
+        np.testing.assert_allclose(
+            meshed.explained_variance_ratio_,
+            exact.explained_variance_ratio_, rtol=1e-3, atol=1e-4)
