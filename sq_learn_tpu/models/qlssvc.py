@@ -134,6 +134,15 @@ class QLSSVC(ClassifierMixin, BaseEstimator):
     ``var`` truncate the SVD solve; ``error_type`` selects the absolute or
     relative quantum inference error model with magnitudes
     ``absolute_error`` / ``relative_error``.
+
+    Deliberately the one estimator family WITHOUT a ``mesh`` knob: the
+    fit is an eigendecomposition of the dense (n+1)×(n+1) LS-SVM saddle
+    matrix, and XLA's ``eigh`` is a replicated single-device kernel —
+    sharding only the kernel-matrix construction would still leave every
+    device holding (and factoring) the full n×n matrix, so a mesh would
+    add collectives without removing the actual memory or compute
+    bottleneck. Large-n LS-SVM wants a different algorithm (low-rank /
+    Nyström approximation via ``low_rank=True``), not data parallelism.
     """
 
     def __init__(self, kernel="linear", penalty=0.1, degree=3, gamma="scale",

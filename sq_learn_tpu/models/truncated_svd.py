@@ -62,14 +62,24 @@ class TruncatedSVD(TransformerMixin, BaseEstimator):
                 f"algorithm must be 'randomized' or 'arpack', got "
                 f"{self.algorithm!r}")
         if self.mesh is not None:
-            # sample-sharded Gram-route SVD regardless of `algorithm`
-            # (same policy as QPCA's mesh-forces-'full'); placement
-            # belongs to the sharding, not as_device_array. Accuracy
-            # caveat: the Gram route squares the condition number, so in
-            # float32 trailing components past sigma_1/sigma_k ~ 1e3 are
-            # less accurate than the direct QR route of the single-device
-            # paths — the right trade for the leading components a
-            # truncated factorization keeps (see class docstring)
+            # The mesh has one engine: the sample-sharded Gram-route SVD
+            # (placement belongs to the sharding, not as_device_array).
+            # Unlike QPCA — whose 'full' solver IS its mesh engine, so a
+            # conflicting explicit solver raises (qpca.py solver
+            # dispatch) — neither of TruncatedSVD's algorithm values
+            # names the Gram route, so an explicit exactness request
+            # ('arpack') gets a warning rather than silence: the Gram
+            # route squares the condition number and float32 trailing
+            # components degrade (see class docstring).
+            if self.algorithm == "arpack":
+                import warnings
+
+                warnings.warn(
+                    "algorithm='arpack' requests the exact thin SVD, but "
+                    "mesh= dispatches to the sample-sharded Gram route "
+                    "(condition number squared; float32 trailing "
+                    "components are less accurate — see the TruncatedSVD "
+                    "docstring).", RuntimeWarning)
             from ..parallel.pca import uncentered_svd_sharded
 
             U, S, Vt = uncentered_svd_sharded(self.mesh, X)

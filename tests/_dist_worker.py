@@ -76,9 +76,9 @@ def main():
     # the sharded qPCA SVD kernel on the cross-process global mesh: the
     # Gram contraction reduces across DCN; only the replicated outputs
     # (spectrum, Vt) are fetched — U stays host-sharded
-    from sq_learn_tpu.parallel.pca import _masked_centered_svd
+    from sq_learn_tpu.parallel.pca import _masked_gram_svd
 
-    mean, U, S, Vt = _masked_centered_svd(Xg, wg, n)
+    mean, U, S, Vt = _masked_gram_svd(Xg, wg, n, center=True)
     Xc = X - X.mean(axis=0)
     S_ref = np.linalg.svd(Xc, compute_uv=False)
     np.testing.assert_allclose(np.asarray(S), S_ref, rtol=1e-3, atol=1e-3)
@@ -100,6 +100,42 @@ def main():
     assert centers_out.shape == centers0.shape
     assert np.isfinite(float(inertia)), float(inertia)
     assert int(n_iter) >= 1
+
+    # the train-sharded KNN candidate kernel across the cross-process
+    # mesh: each host searches only its own corpus shard; the (n_q, k)
+    # per-shard candidate lists are the only cross-DCN traffic, merged
+    # by a replicated top-k
+    from jax import lax
+
+    from sq_learn_tpu.parallel.neighbors import _sharded_candidates
+
+    n2, k2, nq = 40, 5, 8  # n2 divisible by the 4 global devices
+    Xt = rng.normal(size=(n2, m)).astype(np.float32)
+    per_dev = n2 // mesh.devices.size
+    per_host = n2 // nproc
+    tshard = Xt[pid * per_host:(pid + 1) * per_host]
+    Xtg = jax.make_array_from_process_local_data(sharding, tshard)
+    mg = jax.make_array_from_process_local_data(
+        sharding, np.ones((per_host,), np.float32))
+    rep = NamedSharding(mesh, P())
+    Q = Xt[:nq].copy()
+    Qg = jax.make_array_from_process_local_data(rep, Q)
+    qsqg = jax.make_array_from_process_local_data(
+        rep, (Q * Q).sum(axis=1).astype(np.float32))
+    d2c, idxc = _sharded_candidates(mesh, k2, per_dev, nq)(Xtg, mg, Qg, qsqg)
+
+    @jax.jit
+    def merge(d2c, idxc):
+        neg, pos = lax.top_k(-d2c, k2)
+        return jnp.take_along_axis(idxc, pos, axis=1), -neg
+
+    gi, gd = merge(d2c, idxc)
+    d2_full = ((Q[:, None, :] - Xt[None, :, :]) ** 2).sum(-1)
+    ref_idx = np.argsort(d2_full, axis=1)[:, :k2]
+    np.testing.assert_array_equal(np.asarray(gi), ref_idx)
+    np.testing.assert_allclose(np.asarray(gd),
+                               np.sort(d2_full, axis=1)[:, :k2],
+                               rtol=1e-4, atol=1e-4)
 
     print(f"worker {pid} OK", flush=True)
 

@@ -183,3 +183,11 @@ class TestUncenteredSVDSharded:
         np.testing.assert_allclose(
             meshed.explained_variance_ratio_,
             exact.explained_variance_ratio_, rtol=1e-3, atol=1e-4)
+
+    def test_truncated_svd_mesh_warns_on_explicit_arpack(self, mesh):
+        from sq_learn_tpu.models import TruncatedSVD
+
+        X = np.random.default_rng(9).normal(size=(64, 10)).astype(np.float32)
+        with pytest.warns(RuntimeWarning, match="Gram route"):
+            TruncatedSVD(n_components=3, algorithm="arpack",
+                         mesh=mesh).fit(X)
