@@ -1,5 +1,5 @@
-"""Mesh-scaling benchmark: the sharded q-means Lloyd kernel across device
-counts.
+"""Mesh-scaling benchmark: the sharded q-means Lloyd kernel (and, since
+round 5, the train-sharded KNN search) across device counts.
 
 The reference's scaling mechanism is OpenMP threads over row chunks with a
 serial partial-centroid reduction (``cluster/_k_means_lloyd.pyx:118-154``);
@@ -88,6 +88,11 @@ def main():
     # per-rep host-to-device transfers
     Xd, wd = jnp.asarray(X), jnp.asarray(w)
     c0d, xsqd = jnp.asarray(centers0), jnp.asarray(xsq)
+    from sq_learn_tpu.parallel.neighbors import (knn_indices_sharded,
+                                                 shard_train_rows)
+
+    n_query, knn_k = 2048, 10
+    ref_knn_idx = None
     for nd in sizes:
         mesh = Mesh(np.asarray(jax.devices()[:nd]), ("data",))
 
@@ -104,7 +109,36 @@ def main():
         # same key; deviations come only from float32 psum reduction order
         # and per-shard δ-window streams (fold_in by axis index)
         max_dev = float(np.max(np.abs(centers - ref_centers)))
-        table[nd] = {"s": round(t, 4), "max_center_dev_vs_1dev": max_dev}
+
+        # the train-sharded KNN search on the same mesh ladder (corpus
+        # placed once per mesh size, outside the timed region — the
+        # classifier's fit-time cache discipline)
+        state = shard_train_rows(mesh, Xd)
+
+        def run_knn():
+            out = knn_indices_sharded(mesh, Xd, Xd[:n_query], knn_k,
+                                      presharded=state)
+            jax.block_until_ready(out[0])
+            return out
+
+        t_knn, (ki, kd) = timed(run_knn, warmup=1,
+                                reps=3 if smoke_mode() else 2)
+        ki, kd = np.asarray(ki), np.asarray(kd)
+        if ref_knn_idx is None:
+            ref_knn_idx, ref_knn_d2 = ki, kd
+        # the kernel's parity contract is "up to tie order" (near-equal
+        # d2 can legitimately swap at the k boundary across shard
+        # layouts), so record neighbor-SET overlap + distance deviation,
+        # not strict index equality — same spirit as the Lloyd leg's
+        # max_center_dev_vs_1dev
+        overlap = float(np.mean([
+            len(set(a) & set(b)) / knn_k
+            for a, b in zip(ki, ref_knn_idx)]))
+        d2_dev = float(np.max(np.abs(kd - ref_knn_d2)))
+        table[nd] = {"s": round(t, 4), "max_center_dev_vs_1dev": max_dev,
+                     "knn_s": round(t_knn, 4),
+                     "knn_idx_overlap_1dev": round(overlap, 5),
+                     "knn_max_d2_dev_vs_1dev": d2_dev}
 
     largest = sizes[-1]
     simulated = jax.devices()[0].platform == "cpu"

@@ -314,6 +314,14 @@ class MiniBatchQKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
     (``_k_means_fast.pyx:291``) exists for CPU cache efficiency on sparse
     text workloads; on TPU, sparse gathers defeat the MXU and the dense
     batch GEMM is the idiomatic equivalent (see docs/design.md non-goals).
+
+    Also deliberately no ``mesh`` knob: mini-batching IS the
+    memory-scaling strategy — one batch on one device per step, the full
+    dataset never resident. Its pod-scale counterpart is not a sharded
+    minibatch (a 1024-row batch over 8 devices is dispatch-bound, and a
+    sharded dynamic batch slice reshards every step) but full-batch
+    ``QKMeans(mesh=...)``, whose sharded Lloyd sweep IS the
+    all-the-data-every-step regime minibatching approximates.
     """
 
     def __init__(self, n_clusters=8, *, init="k-means++", max_iter=100,

@@ -135,7 +135,9 @@ class QLSSVC(ClassifierMixin, BaseEstimator):
     relative quantum inference error model with magnitudes
     ``absolute_error`` / ``relative_error``.
 
-    Deliberately the one estimator family WITHOUT a ``mesh`` knob: the
+    Deliberately no ``mesh`` knob (like
+    :class:`~sq_learn_tpu.models.minibatch.MiniBatchQKMeans`, whose
+    scaling strategy is streaming): the
     fit is an eigendecomposition of the dense (n+1)×(n+1) LS-SVM saddle
     matrix, and XLA's ``eigh`` is a replicated single-device kernel —
     sharding only the kernel-matrix construction would still leave every
