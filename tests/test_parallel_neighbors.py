@@ -89,3 +89,28 @@ def test_corpus_placed_once_at_fit(mesh, monkeypatch):
     monkeypatch.setattr(pnbr, "shard_train_rows", boom)
     knn.predict(X[:5])
     knn.kneighbors(X[:5])
+
+
+@pytest.mark.slow
+def test_sharded_knn_fuzz_matches_single_device(mesh):
+    """Randomized (n, nq, m, k, n_devices) sweep crossing every padding
+    and k/per-shard boundary: the sharded search must agree with the
+    single-device kernel exactly on continuous data (no ties), on every
+    mesh size from 1 to 8."""
+    rng = np.random.default_rng(12)
+    for _ in range(12):
+        ndev = int(rng.choice([1, 2, 3, 5, 8]))
+        sub = make_mesh(jax.devices("cpu")[:ndev])
+        n = int(rng.integers(ndev, 400))
+        nq = int(rng.integers(1, 60))
+        m = int(rng.integers(1, 40))
+        k = int(rng.integers(1, n + 1))
+        Xt = rng.normal(size=(n, m)).astype(np.float32)
+        Xq = rng.normal(size=(nq, m)).astype(np.float32)
+        si, sd = knn_indices_sharded(sub, Xt, Xq, k)
+        ri, rd = knn_indices(Xt, Xq, k)
+        np.testing.assert_array_equal(
+            np.asarray(si), np.asarray(ri),
+            err_msg=f"ndev={ndev} n={n} nq={nq} m={m} k={k}")
+        np.testing.assert_allclose(np.asarray(sd), np.asarray(rd),
+                                   rtol=1e-4, atol=1e-4)

@@ -191,3 +191,69 @@ class TestUncenteredSVDSharded:
         with pytest.warns(RuntimeWarning, match="Gram route"):
             TruncatedSVD(n_components=3, algorithm="arpack",
                          mesh=mesh).fit(X)
+
+
+def _assert_separated_rows_match(S, Vt_got, Vt_want, gap=5e-2, tol=5e-2):
+    """Compare right-singular rows (sign included) only where the
+    spectrum is well-separated relative to ``gap``."""
+    S = np.abs(S)
+    scale = max(float(S[0]), 1e-12)
+    for i in range(len(S)):
+        near = [abs(S[i] - S[j]) for j in (i - 1, i + 1)
+                if 0 <= j < len(S)]
+        if min(near) / scale < gap or S[i] / scale < gap:
+            continue
+        np.testing.assert_allclose(Vt_got[i], Vt_want[i],
+                                   rtol=tol, atol=tol,
+                                   err_msg=f"sign/row mismatch at "
+                                           f"component {i}")
+
+
+@pytest.mark.slow
+def test_sharded_gram_svd_fuzz_matches_single_device():
+    """Randomized (n, m, n_devices) sweep over both centered and
+    uncentered sharded SVDs vs their single-device twins — padding,
+    thin-spectrum slicing (n < m and n > m), and sign conventions all
+    exercised."""
+    from sq_learn_tpu.ops.linalg import centered_svd, svd_flip_v, thin_svd
+    from sq_learn_tpu.parallel import (centered_svd_sharded,
+                                       uncentered_svd_sharded)
+
+    rng = np.random.default_rng(13)
+    for _ in range(10):
+        ndev = int(rng.choice([1, 2, 4, 8]))
+        sub = make_mesh(jax.devices("cpu")[:ndev])
+        n = int(rng.integers(max(2, ndev), 200))
+        m = int(rng.integers(2, 40))
+        X = rng.normal(size=(n, m)).astype(np.float32)
+        r = min(n, m)
+
+        mean_s, U_s, S_s, Vt_s = centered_svd_sharded(sub, X)
+        mean, U, S, Vt = centered_svd(X, method="gram")
+        np.testing.assert_allclose(np.asarray(S_s), np.asarray(S),
+                                   rtol=1e-3, atol=1e-2,
+                                   err_msg=f"centered ndev={ndev} "
+                                           f"n={n} m={m}")
+        np.testing.assert_allclose(
+            np.asarray(U_s) * np.asarray(S_s) @ np.asarray(Vt_s)
+            + np.asarray(mean_s),
+            X, rtol=1e-2, atol=1e-2)
+        # the deterministic-sign contract (svd_flip_v), pinned directly —
+        # but only on well-separated components: near-degenerate singular
+        # pairs span an arbitrary rotation of the same subspace, where a
+        # row-by-row comparison is meaningless for any implementation
+        _assert_separated_rows_match(np.asarray(S_s), np.asarray(Vt_s),
+                                     np.asarray(Vt))
+
+        U_u, S_u, Vt_u = uncentered_svd_sharded(sub, X)
+        Ur, Sr, Vtr = thin_svd(jnp.asarray(X))
+        Ur, Vtr = svd_flip_v(Ur, Vtr)
+        np.testing.assert_allclose(np.asarray(S_u), np.asarray(Sr)[:r],
+                                   rtol=1e-3, atol=1e-2,
+                                   err_msg=f"uncentered ndev={ndev} "
+                                           f"n={n} m={m}")
+        np.testing.assert_allclose(
+            np.asarray(U_u) * np.asarray(S_u) @ np.asarray(Vt_u),
+            X, rtol=1e-2, atol=1e-2)
+        _assert_separated_rows_match(np.asarray(S_u), np.asarray(Vt_u),
+                                     np.asarray(Vtr)[:r])
