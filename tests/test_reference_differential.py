@@ -320,3 +320,51 @@ def test_ipe_distribution(ref, key):
     assert np.mean(np.abs(our_draws - true_ip) <= tol) > 0.7
     assert np.mean(our_draws) == pytest.approx(np.mean(ref_draws),
                                                abs=2 * tol)
+
+
+def test_estimate_wald_exact_parity(ref, key):
+    """Deterministic given the same draws: the reference's Counter-based
+    frequency dict and our counts-based estimator must agree up to
+    float32 rounding (``Utility.py:61-64``)."""
+    from sq_learn_tpu.ops.quantum import QuantumState
+    from sq_learn_tpu.ops.quantum.sampling import estimate_wald
+
+    amps = np.array([0.8, 0.4, 0.4, 0.2])
+    amps = amps / np.linalg.norm(amps)
+    regs = np.arange(4)
+    draws = np.asarray(
+        QuantumState(registers=regs, amplitudes=amps).measure(key, 5000))
+    ref_freq = ref.estimate_wald(list(draws))
+    counts = np.bincount(draws, minlength=4)
+    ours = np.asarray(estimate_wald(counts, len(draws)))
+    # abs=1e-6: our estimator returns float32 (x64 off under the test
+    # conftest), so parity is exact up to f32 rounding of count/n —
+    # ~2e-8 worst-case here, not the f64-exactness a tighter bound
+    # would falsely claim
+    for reg in regs:
+        assert ours[reg] == pytest.approx(ref_freq.get(reg, 0.0), abs=1e-6)
+
+
+def test_coupon_collect_distribution(ref, key):
+    """Both implementations draw until every basis state is seen; the
+    mean draw count over repeats must match (and match the analytic
+    harmonic-number expectation for the uniform case, n·H_n ≈ 8.33 for
+    d=4) — reference ``Utility.py:75-85`` vs our lax.while_loop form."""
+    import jax
+
+    from sq_learn_tpu.ops.quantum import QuantumState
+    from sq_learn_tpu.ops.quantum.state import coupon_collect
+
+    amps = np.full(4, 0.5)
+    regs = np.arange(4)
+    reps = 300
+    ref_state = ref.QuantumState(registers=regs, amplitudes=amps)
+    ref_counts = [ref.coupon_collect(ref_state) for _ in range(reps)]
+    ours_state = QuantumState(registers=regs, amplitudes=amps)
+    keys = jax.random.split(key, reps)
+    our_counts = [int(coupon_collect(k, ours_state)) for k in keys]
+    expected = 4 * (1 + 1 / 2 + 1 / 3 + 1 / 4)  # n·H_n = 8.33
+    assert np.mean(ref_counts) == pytest.approx(expected, rel=0.15)
+    assert np.mean(our_counts) == pytest.approx(expected, rel=0.15)
+    assert np.mean(our_counts) == pytest.approx(np.mean(ref_counts),
+                                                rel=0.2)
