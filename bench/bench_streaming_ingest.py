@@ -1,0 +1,144 @@
+"""Streaming tiled-ingestion bench: streamed vs monolithic qPCA Gram fit.
+
+Measures the double-buffered streaming engine (``sq_learn_tpu.streaming``)
+on the MNIST-shaped qPCA partial-U Gram fit (70k×784 f32 ≈ 220 MB — the
+exact upload class that has wedged the accelerator relay mid-transfer,
+CLAUDE.md):
+
+- end-to-end fit wall-clock, streamed vs monolithic ingest
+  (``vs_baseline`` = monolithic/streamed; ≥ 0.909 ⇔ the streamed path is
+  within the 1.10× acceptance bar);
+- the maximum bytes of any single ``jax.device_put`` in the streamed fit
+  (recorded by wrapping the transfer call — must be ≤ the tile cap, which
+  is how the engine caps every transfer below the relay-wedge threshold
+  *by construction*);
+- overlap efficiency: streamed-Gram-pass wall-clock vs the larger of its
+  transfer-only / compute-only halves — 1.0 means the smaller half fully
+  hid under the larger (on the CPU backend "transfer" is a host copy, so
+  this mostly measures engine overhead; the number is honest either way);
+- compile discipline: streaming-kernel compile-cache entries after a sweep
+  of 5 row counts vs the distinct (bucket, dtype) signatures the tiler
+  planned — bucketing must pin entries to buckets, never to row counts.
+
+Smoke mode subsamples rows; the tile cap scales down with it so the
+streamed path still walks several tiles.
+"""
+
+import os
+import sys
+import warnings
+
+import numpy as np
+
+warnings.filterwarnings("ignore")
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from bench._common import emit, probe_backend, smoke_mode, timed  # noqa: E402
+
+
+def main():
+    probe_backend()
+    import jax
+
+    from sq_learn_tpu import streaming
+    from sq_learn_tpu.models import QPCA
+
+    if smoke_mode():
+        n, m, k = 8_000, 128, 10
+        tile_bytes = 1 << 20  # 1 MB → ~8 tiles
+    else:
+        n, m, k = 70_000, 784, 50
+        # the relay-safe default (128 MB) gives a 70k×784 f32 matrix only
+        # 2 tiles; 32 MB exercises a real tile walk while every transfer
+        # stays far under the wedge threshold
+        tile_bytes = 32 * (1 << 20)
+    X = np.random.default_rng(0).normal(size=(n, m)).astype(np.float32)
+
+    def fit(ingest):
+        return QPCA(n_components=k, svd_solver="full", random_state=0,
+                    ingest=ingest).fit(X)
+
+    mono_t, mono = timed(fit, "monolithic", warmup=1, reps=2)
+
+    # record every streamed device_put size by wrapping the transfer call
+    # (the engine resolves it as `jax.device_put`, so this sees each tile)
+    sizes = []
+    real_put = jax.device_put
+
+    def recording_put(x, *a, **kw):
+        sizes.append(int(getattr(x, "nbytes", 0)))
+        return real_put(x, *a, **kw)
+
+    os.environ["SQ_STREAM_TILE_BYTES"] = str(tile_bytes)
+    jax.device_put = recording_put
+    try:
+        stream_t, stream = timed(fit, "streamed", warmup=1, reps=2)
+    finally:
+        jax.device_put = real_put
+    assert stream.ingest_ == "streamed", stream.ingest_
+    max_put = max(sizes) if sizes else 0
+
+    parity = float(np.abs(
+        np.asarray(stream.explained_variance_ratio_)
+        - np.asarray(mono.explained_variance_ratio_)).max())
+
+    try:
+        # overlap efficiency of the streamed Gram pass
+        def gram_pass():
+            out = streaming.streamed_centered_gram(X)
+            jax.block_until_ready(out[1])
+
+        gram_t, _ = timed(gram_pass, warmup=1, reps=2)
+
+        def transfer_only():
+            last = None
+            for tile, _, _ in streaming.stream_tiles(X):
+                last = tile
+            jax.block_until_ready(last)
+
+        xfer_t, _ = timed(transfer_only, warmup=1, reps=2)
+        Xd = jax.device_put(X)
+
+        def compute_only():
+            jax.block_until_ready(Xd.T @ Xd)
+
+        comp_t, _ = timed(compute_only, warmup=1, reps=2)
+        del Xd
+        overlap_eff = max(xfer_t, comp_t) / gram_t if gram_t > 0 else 1.0
+
+        # compile discipline: sweep 5 row counts through the Gram pass,
+        # then compare cache entries against the distinct bucket shapes
+        # the tiler planned (row counts must NOT mint compiles)
+        sweep = [int(n * f) for f in np.linspace(0.55, 0.95, 5)]
+        row_bytes = X.nbytes // n
+        buckets = set()
+        for size in [n] + sweep:
+            rows, _ = streaming.plan_row_tiles(size, row_bytes)
+            buckets.add(rows)
+            tail = size % rows
+            if tail:
+                buckets.add(streaming._bucket_rows(tail, rows))
+        for size in sweep:
+            streaming.streamed_centered_gram(X[:size])
+        entries = streaming.kernel_cache_sizes()["gram_colsum"]
+    finally:
+        os.environ.pop("SQ_STREAM_TILE_BYTES", None)
+
+    emit("streaming_ingest_qpca_gram_fit_wallclock", stream_t,
+         vs_baseline=(mono_t / stream_t if stream_t > 0 else None),
+         n=n, m=m, k=k, tile_bytes=tile_bytes,
+         monolithic_s=round(mono_t, 4),
+         max_single_device_put_bytes=int(max_put),
+         tile_cap_respected=bool(max_put <= tile_bytes),
+         overlap_efficiency=round(float(overlap_eff), 3),
+         gram_pass_s=round(gram_t, 4), transfer_only_s=round(xfer_t, 4),
+         compute_only_s=round(comp_t, 4),
+         gram_kernel_cache_entries=int(entries),
+         distinct_tile_buckets=len(buckets),
+         compiles_per_bucket_ok=bool(entries <= 2 * len(buckets)),
+         ev_ratio_max_abs_dev=parity,
+         backend=jax.default_backend())
+
+
+if __name__ == "__main__":
+    main()

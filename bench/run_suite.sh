@@ -64,8 +64,13 @@ run_and_record() {  # run_and_record <timeout_s> <header> <cmd...>; returns the 
 # surface always has a committed artifact (VERDICT r4 next #2b). It runs
 # right after the headline — it's digit-scale (host-routed, seconds) and
 # must not be sacrificed to a mid-suite wedge on the heavy configs.
+# bench_streaming_ingest runs in smoke mode inside the suite (the full
+# 70k×784 acceptance config is a manual run — see BENCH_SUITE.md): it is
+# small and must not be sacrificed to a mid-suite wedge, so it rides in
+# the small-config-first block right after the headline.
 for cmd in "python bench.py" \
            "python -m bench.bench_ipe_digits" \
+           "env SQ_BENCH_SMOKE=1 python -m bench.bench_streaming_ingest" \
            "python -m bench.bench_randomized_svd_covtype" \
            "python -m bench.bench_qkmeans_cicids_sweep" \
            "python -m bench.bench_qpca_mnist" \
@@ -80,14 +85,16 @@ for cmd in "python bench.py" \
 done
 
 # BASELINE acceptance gate (bench/_gate.py: vs_baseline >= 0.5 on every
-# line, 5 measured + 1 derived line expected, missing/null = fail). This
+# line, 6 measured + 1 derived line expected — the sixth measured line is
+# the streaming-ingest smoke config, whose baseline is the monolithic
+# ingest of the same fit; missing/null = fail). This
 # script is where the bar is enforced — the unit suite only warns, since
 # wall-clock there is subject to arbitrary host load.
 # (PYTHONPATH cleared + timeout, like the retry path: the bare interpreter
 # pre-imports jax via the axon sitecustomize and would hang on a wedged
 # relay even though this step only parses JSON; -m bench._gate resolves
 # via cwd, which is the repo root here)
-env -u PYTHONPATH timeout 60 python -m bench._gate "$out" 5 1
+env -u PYTHONPATH timeout 60 python -m bench._gate "$out" 6 1
 gate_rc=$?
 echo "# acceptance gate rc=$gate_rc" >> "$out"
 echo "done: $out"

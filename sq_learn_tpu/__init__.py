@@ -26,6 +26,7 @@ __version__ = "0.1.0"
 from . import ops, utils  # noqa: E402
 
 from . import datasets, metrics, model_selection, models, native, parallel  # noqa: E402
+from . import streaming  # noqa: E402
 from . import feature_extraction, pipeline, preprocessing  # noqa: E402
 # reference-namespace facades (sklearn/cluster, decomposition, svm,
 # neighbors, QuantumUtility) so reference users find familiar paths

@@ -1,0 +1,76 @@
+"""Version-compat shims for the jax API surface this package leans on.
+
+The package targets current jax (top-level ``jax.shard_map``,
+``lax.axis_size``, pallas vma plumbing) but must keep importing — and
+keep its mesh paths working — on the 0.4.x line some deployment hosts
+still run, where ``shard_map`` lives in ``jax.experimental.shard_map``
+with a ``check_rep`` kwarg instead of ``check_vma``. Everything here is
+a thin dispatch to whichever spelling the installed jax provides; no
+behavior differences beyond the names.
+"""
+
+import jax
+from jax import lax
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+    """``jax.shard_map`` with the modern kwarg names, on any jax.
+
+    Newer jax exports ``shard_map`` at top level with ``check_vma``;
+    0.4.x has it under ``jax.experimental.shard_map`` with the same
+    check under its old name ``check_rep``.
+    """
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as sm_legacy
+
+    # the legacy checker predates replication rules for while/scan (it
+    # rejects the sharded Lloyd loop outright), so it stays off there —
+    # the modern checker runs wherever the modern API exists
+    return sm_legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                     check_rep=False)
+
+
+def random_multinomial(key, n, probs):
+    """``jax.random.multinomial`` on any jax.
+
+    0.4.x lacks the primitive; the fallback is the standard conditional
+    binomial chain (category i draws Binomial(remaining, pᵢ/tailᵢ)), the
+    same decomposition the modern implementation lowers to. ``probs``
+    must be normalized along the last axis; counts come back in
+    ``probs.dtype`` with the category axis last, matching the modern API.
+    """
+    if hasattr(jax.random, "multinomial"):
+        return jax.random.multinomial(key, n, probs)
+    import jax.numpy as jnp
+
+    p = jnp.moveaxis(probs, -1, 0)                     # (d, ...)
+    tail = jnp.flip(jnp.cumsum(jnp.flip(p, 0), axis=0), 0)
+    keys = jax.random.split(key, p.shape[0])
+    n = jnp.broadcast_to(jnp.asarray(n, p.dtype), p.shape[1:])
+
+    def body(remaining, xs):
+        ki, pi, ti = xs
+        ratio = jnp.clip(jnp.where(ti > 0, pi / ti, 1.0), 0.0, 1.0)
+        ci = jax.random.binomial(ki, remaining, ratio, dtype=p.dtype)
+        # degenerate rows (NaN/zero mass) propagate NaN like the modern
+        # primitive rather than raising
+        ci = jnp.where(jnp.isfinite(ratio), ci, jnp.nan)
+        return remaining - ci, ci
+
+    _, counts = lax.scan(body, n, (keys, p, tail))
+    return jnp.moveaxis(counts, 0, -1)
+
+
+def axis_size(axis_name):
+    """Static size of a mapped axis inside ``shard_map``/``pmap``.
+
+    ``lax.axis_size`` only exists on newer jax; on 0.4.x the documented
+    equivalent is ``psum`` of the literal 1, which resolves statically
+    (no collective is emitted for a non-tracer operand).
+    """
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)

@@ -209,12 +209,16 @@ def route_tiny_fit_to_host(n_elements):
     which is also the escape hatch for deliberately timing the chip on a
     tiny problem.
 
-    The backend check never initializes jax's backends (see
-    :func:`_default_backend_platform_no_init`), so this decision cannot
-    itself hang on a wedged tunnel — in-process library callers get the
-    same protection ``bench.py`` gets from its subprocess probe. Only
-    auto-detect installs with no ``jax_platforms`` spec fall back to a
-    real ``jax.default_backend()`` call (local backends, no tunnel)."""
+    The DECISION predicate never initializes jax's backends (see
+    :func:`_default_backend_platform_no_init`), so asking the question
+    cannot itself hang on a wedged tunnel; only auto-detect installs with
+    no ``jax_platforms`` spec fall back to a real
+    ``jax.default_backend()`` call (local backends, no tunnel). The
+    ACTION side is a weaker guarantee: :func:`host_routed_scope` pins the
+    CPU backend for the routed work, but entering it still initializes
+    jax's platform set, so the FIRST routed call in a process can touch a
+    wedged relay during that one-time init — ``bench.py``-style callers
+    who need a hard no-hang guarantee must keep their subprocess probe."""
     cfg = _get_threadlocal_config()
     if cfg["device"] != "auto" or _TINY_FIT_ELEMENTS <= 0:
         return False
@@ -316,6 +320,12 @@ def chunked_device_put(x, device=None, max_bytes=None):
     host→device link as several independent transfers that are assembled
     in device memory — dodging the accelerator-relay hazard documented in
     CLAUDE.md where one oversized upload wedges the tunnel.
+
+    NOTE: this still ends with the WHOLE array resident (the concatenate
+    doubles peak HBM transiently). Fit paths that only need tile-sequential
+    accumulations should ride :mod:`sq_learn_tpu.streaming` instead — the
+    double-buffered tiled-ingestion engine overlaps each upload with the
+    previous tile's compute and never materializes the input.
 
     With the default ``max_bytes`` the slicing only engages for non-CPU
     targets (host→host copies can't wedge a relay and the extra

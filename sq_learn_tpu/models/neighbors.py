@@ -275,6 +275,17 @@ class KNeighborsClassifier(ClassifierMixin, BaseEstimator):
                 _warnings.warn(
                     "compute_dtype engages only the single-device search; "
                     "the mesh path runs exact precision.", RuntimeWarning)
+            if self.use_pallas != "auto" and self.use_pallas:
+                import warnings as _warnings
+
+                # same contract as the compute_dtype override above: an
+                # explicit kernel request the mesh path cannot honor must
+                # say so, never be silently dropped (per-shard pallas
+                # under shard_map is future work — parallel/neighbors.py)
+                _warnings.warn(
+                    "use_pallas engages only the single-device search; "
+                    "the mesh path runs the sharded XLA GEMM+top_k "
+                    "kernel.", RuntimeWarning)
             from ..parallel.neighbors import (knn_indices_sharded,
                                              shard_train_rows)
 
@@ -292,6 +303,14 @@ class KNeighborsClassifier(ClassifierMixin, BaseEstimator):
             host = self._tiny_routed_search(X, k)
         if host is not None:
             return host
+        from ..streaming import stream_map_rows, worth_streaming
+
+        if worth_streaming(X):
+            # streaming predict: query tiles upload double-buffered while
+            # the previous tile's search runs; only (rows, k) candidate
+            # lists return per tile, so the query matrix is never
+            # device-resident and no single transfer exceeds the tile cap
+            return stream_map_rows(X, lambda t: self._device_search(t, k))
         return self._device_search(X, k)
 
     def _check_k(self, k):

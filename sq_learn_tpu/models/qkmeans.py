@@ -224,7 +224,9 @@ def relocate_empty_clusters(X, weights, labels, min_d2, sums, counts,
         # disjoint slots, but psum's output is provably axis-invariant so
         # shard_map's varying-manual-axes check stays enabled
         def gathered(x):
-            buf = jnp.zeros((lax.axis_size(axis_name),) + x.shape, x.dtype)
+            from .._compat import axis_size
+
+            buf = jnp.zeros((axis_size(axis_name),) + x.shape, x.dtype)
             buf = buf.at[lax.axis_index(axis_name)].set(x)
             return lax.psum(buf, axis_name).reshape((-1,) + x.shape[1:])
 
@@ -870,6 +872,25 @@ e_step_jit = jax.jit(
 )
 
 
+@functools.partial(
+    jax.jit, static_argnames=("delta", "mode", "ipe_q", "compute_dtype"))
+def predict_tile(key, start, tile, centers, *, delta, mode, ipe_q,
+                 compute_dtype):
+    """One streamed-predict tile: row norms + E-step assignment fused in
+    a single dispatch (the per-tile kernel behind the streaming-ingestion
+    predict path — tile *i+1* uploads while this runs on tile *i*).
+    ``start`` folds the tile offset into the key so the noisy modes draw
+    decorrelated streams per tile; classic mode ignores the key. Padded
+    zero rows get labels too — the caller slices them away."""
+    key = jax.random.fold_in(key, start)
+    xsq = row_norms(tile, squared=True)
+    weights = jnp.ones((tile.shape[0],), tile.dtype)
+    labels, _, _ = e_step(key, tile, weights, centers, xsq, delta=delta,
+                          mode=mode, ipe_q=ipe_q,
+                          compute_dtype=compute_dtype)
+    return labels
+
+
 # ---------------------------------------------------------------------------
 # Estimator facade
 # ---------------------------------------------------------------------------
@@ -1088,6 +1109,9 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
 
     def _fit_impl(self, X, sample_weight):
         """The fit body proper, on whatever backend :meth:`fit` routed to."""
+        # ingest provenance; the staged path below overrides it when the
+        # prestats ride the streaming engine
+        self.ingest_ = "monolithic"
         delta = 0.0 if self.delta is None else float(self.delta)
         if delta == 0:
             warnings.warn("Attention! You are running the classic version of "
@@ -1131,17 +1155,30 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         # path skips those O(n·m²) scans entirely.
         quantum = delta > 0
         mu_grid = MU_GRID if quantum else ()
-        # set_config(device=...) placement — except under an explicit mesh,
-        # whose sharding owns placement (committed single-device operands
-        # would conflict with the mesh's device set)
         from ..ops.quantum.norms import blocked_worthwhile
 
-        Xin = jnp.asarray(X) if self.mesh is not None else as_device_array(X)
-        stats = fit_prestats(
-            Xin, quantum=quantum, mu_grid=mu_grid,
-            mu_blocked=(quantum and self.mesh is None
-                        and self._on_cpu_backend()
-                        and blocked_worthwhile(*X.shape)))
+        mu_blocked = (quantum and self.mesh is None
+                      and self._on_cpu_backend()
+                      and blocked_worthwhile(*X.shape))
+        from ..streaming import streamed_prestats, worth_streaming
+
+        if self.mesh is None and worth_streaming(X):
+            # streamed ingestion: the device copy assembles tile-by-tile
+            # into one donated buffer (every transfer under the tile cap,
+            # no concatenate) while the column sums/square-sums accumulate
+            # under the uploads; centering/norms finalize on device
+            self.ingest_ = "streamed"
+            stats = streamed_prestats(X, quantum=quantum, mu_grid=mu_grid,
+                                      mu_blocked=mu_blocked)
+        else:
+            # set_config(device=...) placement — except under an explicit
+            # mesh, whose sharding owns placement (committed single-device
+            # operands would conflict with the mesh's device set)
+            self.ingest_ = "monolithic"
+            Xin = (jnp.asarray(X) if self.mesh is not None
+                   else as_device_array(X))
+            stats = fit_prestats(Xin, quantum=quantum, mu_grid=mu_grid,
+                                 mu_blocked=mu_blocked)
         if quantum:
             # fetch every host-needed scalar (incl. the μ grid) in ONE
             # device→host transfer
@@ -1603,6 +1640,24 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
                 delta if mode == "delta" else 0.0, e_only=True)
             return np.asarray(labels)
         key = as_key(self.random_state)
+        from ..streaming import stream_map_rows, worth_streaming
+
+        if worth_streaming(X):
+            # streaming predict: walk the query rows in bounded tiles,
+            # the next upload overlapped with the current tile's fused
+            # norms+E-step kernel; only the (rows,) labels come back per
+            # tile — the query matrix is never device-resident
+            centers = as_device_array(
+                np.asarray(self.cluster_centers_,
+                           jax.dtypes.canonicalize_dtype(X.dtype)))
+            cd = self._checked_compute_dtype()
+
+            def tile_fn(tile, start):
+                return predict_tile(key, start, tile, centers, delta=delta,
+                                    mode=mode, ipe_q=self.ipe_q,
+                                    compute_dtype=cd)
+
+            return stream_map_rows(X, tile_fn, with_offsets=True)
         Xd = as_device_array(X)
         labels, _, _ = e_step_jit(
             key, Xd, jnp.ones(X.shape[0], X.dtype),

@@ -324,12 +324,23 @@ class QPCA(TransformerMixin, BaseEstimator):
         (:func:`~sq_learn_tpu.parallel.pca.centered_svd_sharded`). The
         scaling path for sample axes beyond one chip's HBM; None (default)
         fits on the configured single device.
+    ingest : {'auto', 'monolithic', 'streamed'}
+        How host data reaches the device. 'streamed' fits through the
+        double-buffered tiled-ingestion engine
+        (:mod:`sq_learn_tpu.streaming`): the m×m Gram and the partial-U
+        block are built tile-by-tile — X is never device-resident and no
+        single transfer exceeds the tile cap. 'auto' streams whenever the
+        host input is larger than ``stream_tile_bytes()`` and the fit
+        takes a Gram route that supports it (full solver, integral
+        ``n_components``, tall input, no QADRA estimator — μ(A) needs the
+        resident centered matrix). 'monolithic' always materializes
+        (the pre-streaming behavior).
     """
 
     def __init__(self, n_components=None, *, copy=True, whiten=False,
                  svd_solver="auto", tol=0.0, iterated_power="auto",
                  random_state=None, name=None, compute_mu="auto", mesh=None,
-                 compute_dtype=None):
+                 compute_dtype=None, ingest="auto"):
         self.n_components = n_components
         self.copy = copy
         self.whiten = whiten
@@ -341,6 +352,7 @@ class QPCA(TransformerMixin, BaseEstimator):
         self.compute_mu = compute_mu
         self.mesh = mesh
         self.compute_dtype = compute_dtype
+        self.ingest = ingest
         self.quantum_runtime_container = []
 
     # -- fit ----------------------------------------------------------------
@@ -450,11 +462,6 @@ class QPCA(TransformerMixin, BaseEstimator):
         """The fit body proper (solver resolution + SVD + quantum
         estimators), on whatever backend :meth:`fit` routed to; every
         quantum fit kwarg was stashed on ``self`` by :meth:`fit`."""
-        # set_config(device=...) placement: committing the input here pins
-        # every downstream jit (SVD, quantum estimators) to that device —
-        # except under a mesh, whose sharding owns placement
-        if self.mesh is None:
-            X = as_device_array(X)
         self._key = as_key(self.random_state)
 
         # n_components handling (reference _qPCA.py:527-536)
@@ -514,6 +521,18 @@ class QPCA(TransformerMixin, BaseEstimator):
                 ">= 8, no mesh); this fit runs in the input dtype.",
                 RuntimeWarning)
 
+        # ingest resolution: the streamed Gram routes never materialize X
+        # on device; everything else commits the input up front. The
+        # placement pin (set_config(device=...)) still applies to the
+        # streamed path through each tile's device_put.
+        self._ingest_streamed = self._resolve_ingest(X, solver, n_components)
+        self.ingest_ = "streamed" if self._ingest_streamed else "monolithic"
+        if self.mesh is None and not self._ingest_streamed:
+            # set_config(device=...) placement: committing the input here
+            # pins every downstream jit (SVD, quantum estimators) to that
+            # device — except under a mesh, whose sharding owns placement
+            X = as_device_array(X)
+
         if solver == "full":
             self._fit_full(X, n_components)
         elif solver in ("arpack", "randomized"):
@@ -556,6 +575,52 @@ class QPCA(TransformerMixin, BaseEstimator):
                 and isinstance(n_components, numbers.Integral)
                 and 0 < n_components and n_samples >= 8 * n_features)
 
+    def _need_mu(self):
+        """Whether this fit computes μ(A) — the one consumer that needs
+        the resident centered matrix (and therefore vetoes streaming)."""
+        if self.compute_mu == "auto":
+            return (self.quantum_retained_variance or self.theta_estimate
+                    or self.estimate_all or self.estimate_least_k)
+        return bool(self.compute_mu)
+
+    def _resolve_ingest(self, X, solver, n_components):
+        """Resolve the ``ingest`` hyperparameter to a streamed/monolithic
+        decision for this fit. The streamed engines exist for the
+        full-solver Gram routes: integral ``n_components`` on tall input
+        (the partial-U route single-device, n ≥ m under a mesh); μ(A)
+        needs the resident centered matrix, so a QADRA fit never streams.
+        """
+        if self.ingest not in ("auto", "monolithic", "streamed"):
+            raise ValueError(
+                f"ingest must be 'auto', 'monolithic' or 'streamed', got "
+                f"{self.ingest!r}")
+        if self.ingest == "monolithic":
+            return False
+        import jax as _jax
+
+        n_samples, n_features = X.shape
+        structural = (
+            solver == "full"
+            and not self._need_mu()
+            and isinstance(n_components, numbers.Integral)
+            and n_components > 0
+            and not isinstance(X, _jax.Array)
+            and (self._partial_u_route(n_components, n_samples, n_features)
+                 if self.mesh is None else n_samples >= n_features))
+        if self.ingest == "streamed":
+            if not structural:
+                warnings.warn(
+                    "ingest='streamed' requires the full-solver Gram route "
+                    "(integral n_components, tall host input, no QADRA "
+                    "estimator — mu(A) needs the resident matrix); this "
+                    "fit ingests monolithically.", RuntimeWarning)
+            return structural
+        # 'auto': stream only when a monolithic upload would exceed the
+        # per-tile transfer cap
+        from ..streaming import worth_streaming
+
+        return structural and worth_streaming(X)
+
     def _fit_full(self, X, n_components):
         """Full-SVD fit + gated quantum estimators (reference ``_fit_full``,
         ``_qPCA.py:557-676``)."""
@@ -575,10 +640,20 @@ class QPCA(TransformerMixin, BaseEstimator):
                 f"n_components={n_components!r} must be of type int when "
                 f">= 1, was of type={type(n_components)!r}")
 
+        streamed = getattr(self, "_ingest_streamed", False)
         if self.mesh is not None:
-            from ..parallel.pca import centered_svd_sharded
+            if streamed:
+                # tiles land sharded, partial Grams psum over ICI — the
+                # sample axis never exists on any device or in aggregate
+                from ..parallel.streaming import \
+                    streamed_centered_svd_topk_sharded
 
-            mean, U, S, Vt = centered_svd_sharded(self.mesh, X)
+                mean, U, S, Vt = streamed_centered_svd_topk_sharded(
+                    self.mesh, X, int(n_components))
+            else:
+                from ..parallel.pca import centered_svd_sharded
+
+                mean, U, S, Vt = centered_svd_sharded(self.mesh, X)
         elif self._partial_u_route(n_components, n_samples, n_features):
             # integral n_components in the Gram regime (same aspect≥8
             # heuristic as thin_svd 'auto' — squaring a mildly rectangular
@@ -586,9 +661,19 @@ class QPCA(TransformerMixin, BaseEstimator):
             # materialize only the U columns the fit keeps — the full U
             # product is the same O(n·m²) GEMM as the Gram matrix, i.e.
             # half the fit's FLOPs
-            mean, U, S, Vt = centered_svd_topk(
-                X, int(n_components),
-                compute_dtype=check_compute_dtype(self.compute_dtype))
+            if streamed:
+                # same route, built tile-by-tile: the m×m Gram + column
+                # mean accumulate on device while the next tile uploads;
+                # X is never device-resident (sq_learn_tpu.streaming)
+                from ..streaming import streamed_centered_svd_topk
+
+                mean, U, S, Vt = streamed_centered_svd_topk(
+                    X, int(n_components),
+                    compute_dtype=check_compute_dtype(self.compute_dtype))
+            else:
+                mean, U, S, Vt = centered_svd_topk(
+                    X, int(n_components),
+                    compute_dtype=check_compute_dtype(self.compute_dtype))
         else:
             mean, U, S, Vt = centered_svd(X)
         self.mean_ = np.asarray(mean)
@@ -654,9 +739,9 @@ class QPCA(TransformerMixin, BaseEstimator):
         self.frob_norm = float(np.sqrt((S_np**2).sum()))
         # μ(A) feeds only the QADRA estimators below — its grid search costs
         # ~11 powered full-matrix reductions, so pure classical fits skip it
-        need_mu = (self.quantum_retained_variance or self.theta_estimate
-                   or self.estimate_all or self.estimate_least_k
-                   if self.compute_mu == "auto" else bool(self.compute_mu))
+        # (a streamed ingest never reaches here with need_mu set:
+        # _resolve_ingest vetoes streaming for QADRA fits)
+        need_mu = self._need_mu()
         if need_mu:
             if self.mesh is not None:
                 # row-sharded centered copy (padding rows exactly zero, so

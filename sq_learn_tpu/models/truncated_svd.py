@@ -33,16 +33,26 @@ class TruncatedSVD(TransformerMixin, BaseEstimator):
     direct routes — acceptable for the leading components a truncated
     factorization keeps, but check ``singular_values_`` spread before
     trusting deep tails under a mesh.
+
+    ``ingest`` ∈ {'auto', 'monolithic', 'streamed'}: 'streamed' runs the
+    randomized range finder and power iterations as tiled passes through
+    the double-buffered ingestion engine
+    (:func:`~sq_learn_tpu.streaming.streamed_randomized_svd`) — X is
+    never device-resident and no single host→device transfer exceeds
+    ``stream_tile_bytes()``. 'auto' streams when the host input exceeds
+    the tile cap (randomized algorithm, no mesh); 'monolithic' always
+    materializes.
     """
 
     def __init__(self, n_components=2, *, algorithm="randomized", n_iter=5,
-                 random_state=None, tol=0.0, mesh=None):
+                 random_state=None, tol=0.0, mesh=None, ingest="auto"):
         self.n_components = n_components
         self.algorithm = algorithm
         self.n_iter = n_iter
         self.random_state = random_state
         self.tol = tol
         self.mesh = mesh
+        self.ingest = ingest
 
     def fit(self, X, y=None):
         self.fit_transform(X)
@@ -61,6 +71,12 @@ class TruncatedSVD(TransformerMixin, BaseEstimator):
             raise ValueError(
                 f"algorithm must be 'randomized' or 'arpack', got "
                 f"{self.algorithm!r}")
+        if self.ingest not in ("auto", "monolithic", "streamed"):
+            raise ValueError(
+                f"ingest must be 'auto', 'monolithic' or 'streamed', got "
+                f"{self.ingest!r}")
+        streamed = self._resolve_ingest(X)
+        self.ingest_ = "streamed" if streamed else "monolithic"
         if self.mesh is not None:
             # The mesh has one engine: the sample-sharded Gram-route SVD
             # (placement belongs to the sharding, not as_device_array).
@@ -84,6 +100,14 @@ class TruncatedSVD(TransformerMixin, BaseEstimator):
 
             U, S, Vt = uncentered_svd_sharded(self.mesh, X)
             U, S, Vt = U[:, :k], S[:k], Vt[:k]
+        elif self.algorithm == "randomized" and streamed:
+            # tiled range finder + power iterations: per pass, one (m, k)
+            # accumulation Σ tileᵀ·(tile·Q) while the next tile uploads —
+            # X is never device-resident (sq_learn_tpu.streaming)
+            from ..streaming import streamed_randomized_svd
+
+            U, S, Vt = streamed_randomized_svd(
+                as_key(self.random_state), X, k, n_iter=self.n_iter)
         elif self.algorithm == "randomized":
             Xd = as_device_array(X)  # set_config(device=...) placement
             U, S, Vt = randomized_svd(as_key(self.random_state), Xd, k,
@@ -108,6 +132,28 @@ class TruncatedSVD(TransformerMixin, BaseEstimator):
             else np.zeros_like(self.explained_variance_))
         self.n_features_in_ = n_features
         return Xt
+
+    def _resolve_ingest(self, X):
+        """Streamed/monolithic decision: the streamed engine covers the
+        single-device randomized path on host data; 'streamed' on an
+        uncovered route warns and falls back (same contract as QPCA)."""
+        import jax
+        import warnings
+
+        if self.ingest == "monolithic":
+            return False
+        structural = (self.algorithm == "randomized" and self.mesh is None
+                      and not isinstance(X, jax.Array))
+        if self.ingest == "streamed":
+            if not structural:
+                warnings.warn(
+                    "ingest='streamed' engages only the single-device "
+                    "randomized path on host data; this fit ingests "
+                    "monolithically.", RuntimeWarning)
+            return structural
+        from ..streaming import worth_streaming
+
+        return structural and worth_streaming(X)
 
     @with_device_scope
     def transform(self, X):

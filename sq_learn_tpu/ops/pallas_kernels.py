@@ -27,6 +27,16 @@ def _round_up(x, m):
     return (x + m - 1) // m * m
 
 
+def _shape_struct(shape, dtype, vma):
+    """ShapeDtypeStruct with the vma declaration where the installed jax
+    has one (the kwarg only exists on post-0.4.x jax; `vma` is always
+    None on the older line — see the `has_vma` resolution at the call
+    site)."""
+    if vma is None:
+        return jax.ShapeDtypeStruct(shape, dtype)
+    return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+
+
 def _make_lloyd_kernel(window):
     """Build the tile kernel; ``window`` > 0 adds the δ-means noisy label
     pick (uniform among centroids within ``window`` of the min squared
@@ -184,8 +194,14 @@ def lloyd_step_pallas(X, weights, centers, x_sq_norms, *, key=None,
                                      memory_space=pltpu.VMEM))
         operands.append(gum)
 
-    vma = None if axis_name is None else frozenset({axis_name})
-    if axis_name is not None:
+    # vma plumbing exists only on newer jax (jax.typeof / lax.pcast /
+    # ShapeDtypeStruct(vma=...)); on 0.4.x shard_map's replication checker
+    # is disabled for the interpret path anyway (parallel/lloyd.py), so the
+    # promotion is simply skipped there
+    has_vma = hasattr(jax, "typeof")
+    vma = (None if axis_name is None or not has_vma
+           else frozenset({axis_name}))
+    if axis_name is not None and has_vma:
         # centers (and their norms) enter shard_map replicated while X is
         # shard-varying; the kernel may not mix the two, so promote the
         # replicated operands to varying (a no-op on the data)
@@ -208,11 +224,11 @@ def lloyd_step_pallas(X, weights, centers, x_sq_norms, *, key=None,
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((n_p, 1), jnp.int32, vma=vma),
-            jax.ShapeDtypeStruct((n_p, 1), jnp.float32, vma=vma),
-            jax.ShapeDtypeStruct((k_p, m_p), jnp.float32, vma=vma),
-            jax.ShapeDtypeStruct((1, k_p), jnp.float32, vma=vma),
-            jax.ShapeDtypeStruct((1, 1), jnp.float32, vma=vma),
+            _shape_struct((n_p, 1), jnp.int32, vma),
+            _shape_struct((n_p, 1), jnp.float32, vma),
+            _shape_struct((k_p, m_p), jnp.float32, vma),
+            _shape_struct((1, k_p), jnp.float32, vma),
+            _shape_struct((1, 1), jnp.float32, vma),
         ],
         interpret=interpret,
     )(*operands)

@@ -58,7 +58,9 @@ def multinomial_counts(key, n, probs):
     probs = jnp.asarray(probs)
     probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
     n = jnp.broadcast_to(jnp.asarray(n, dtype=probs.dtype), probs.shape[:-1])
-    return jax.random.multinomial(key, n, probs)
+    from ..._compat import random_multinomial
+
+    return random_multinomial(key, n, probs)
 
 
 def estimate_wald(counts, n):

@@ -4,7 +4,7 @@
 thread reductions; SURVEY §2.3 maps them to psum over an ICI mesh.)
 """
 
-from . import distributed
+from . import distributed, streaming
 from .neighbors import knn_indices_sharded
 from .pca import (centered_svd_sharded, tomography_sharded,
                   uncentered_svd_sharded)
@@ -27,6 +27,7 @@ __all__ = [
     "pad_to_multiple",
     "replicated",
     "shard_rows",
+    "streaming",
     "tomography_sharded",
     "uncentered_svd_sharded",
 ]

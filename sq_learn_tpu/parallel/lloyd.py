@@ -13,7 +13,8 @@ import functools
 
 import jax
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+
+from .._compat import shard_map
 
 from .mesh import DATA_AXIS, pad_to_multiple
 from ..models.qkmeans import lloyd_single

@@ -24,8 +24,10 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
 from jax.sharding import PartitionSpec as P
+
+from .._compat import shard_map
 
 from ..ops.linalg import pairwise_sq_distances
 from .mesh import DATA_AXIS, pad_and_shard
@@ -47,11 +49,17 @@ def shard_train_rows(mesh, X_train):
     return Xp, mask, per, n
 
 
-@functools.lru_cache(maxsize=None)
+@functools.lru_cache(maxsize=64)
 def _sharded_candidates(mesh, k_local, per_shard, block):
     """Jitted shard_map'd local search, cached per (mesh, k_local, shard
     size, query block) like the sharded Lloyd kernel — restarts and
-    repeated predicts reuse one compilation."""
+    repeated predicts reuse one compilation.
+
+    The cache is bounded (it holds Mesh references, which pin device
+    buffers for process lifetime) and small-query block sizes are
+    quantized to power-of-two buckets at the call site, so a stream of
+    odd-sized tiny predicts maps to a handful of entries instead of one
+    per distinct size."""
 
     def search(X_local, mask_local, Q, qsq):
         def one_block(args):
@@ -100,10 +108,16 @@ def knn_indices_sharded(mesh, X_train, X_query, k, presharded=None,
     # a shard can contribute at most `per` candidates; with k <= n the
     # union of shards always holds k real rows
     k_local = min(k, per)
-    # query blocking, same discipline (and same small-set lane padding)
-    # as the single-device knn_indices: tiny predicts don't pay a full
-    # 4096-row GEMM, huge ones never materialize (n_q, per_shard)
-    block = min(block, nq + (-nq) % 8)
+    # query blocking, same discipline as the single-device knn_indices:
+    # tiny predicts don't pay a full 4096-row GEMM, huge ones never
+    # materialize (n_q, per_shard). Small sizes quantize to power-of-two
+    # buckets (min 8 = one lane group) so the compile cache above sees a
+    # handful of block shapes, not one per distinct query count.
+    if nq < block:
+        bucket = 8
+        while bucket < nq:
+            bucket <<= 1
+        block = min(block, bucket)
     qpad = (-nq) % block
     Qp = jnp.pad(X_query, ((0, qpad), (0, 0)))
     qsq = jnp.sum(Qp * Qp, axis=1)

@@ -1,0 +1,107 @@
+"""Mesh variant of the streaming tiled-ingestion engine.
+
+Same double-buffered tile walk as :mod:`sq_learn_tpu.streaming`, but each
+tile lands **sharded** over the mesh's data axis (one bounded
+``device_put`` fans the tile's rows across the devices) and the Gram /
+column-sum accumulators are replicated: the per-shard partial Grams reduce
+over ICI inside the jitted accumulation step — XLA inserts the ``psum``
+for the sharded contraction itself, exactly as the resident-matrix path in
+:mod:`~sq_learn_tpu.parallel.pca` does. The full sample axis therefore
+never exists on any single device NOR in aggregate: per tile, each device
+holds ``tile_rows / n_dev`` rows, and between tiles only the (m, m)
+accumulator survives.
+
+Tile buckets are rounded to device-count multiples (SPMD needs equal
+shards); zero-padded rows contribute nothing to the sums.
+"""
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..streaming import (_gram_colsum_step, _finalize_centered_gram,
+                         stream_fold, stream_map_rows)
+from .mesh import data_sharding, replicated
+
+__all__ = [
+    "streamed_centered_gram_sharded",
+    "streamed_centered_svd_topk_sharded",
+]
+
+
+def _sharded_put(mesh):
+    """Placement callable handed to the tiler: one ``jax.device_put`` per
+    tile, row-sharded over the mesh — the bounded transfer that replaces
+    the monolithic corpus placement."""
+    sharding = data_sharding(mesh)
+
+    def put(tile):
+        return jax.device_put(tile, sharding)
+
+    return put
+
+
+def streamed_centered_gram_sharded(mesh, X, *, max_bytes=None):
+    """(mean, G_centered, n) with every tile landing sharded over the
+    mesh and the partial Grams psum-reduced over ICI.
+
+    The replicated (m, m)/(m,) accumulators ride through the same donated
+    kernel as the single-device engine; with the tile row-sharded, XLA
+    lowers ``tileᵀ·tile`` to per-shard partials + an all-reduce.
+    """
+    X = np.asarray(X)
+    n, m = X.shape
+    dtype = jax.dtypes.canonicalize_dtype(X.dtype)
+    rep = replicated(mesh)
+    init = (jax.device_put(jnp.zeros((m, m), dtype), rep),
+            jax.device_put(jnp.zeros((m,), dtype), rep))
+    G, colsum = stream_fold(
+        X, _gram_colsum_step, init, max_bytes=max_bytes,
+        put=_sharded_put(mesh), multiple=int(mesh.devices.size))
+    mean, Gc = _finalize_centered_gram(G, colsum, n)
+    return mean, Gc, n
+
+
+@jax.jit
+def _tile_topk_u(tile, mean, Vk_over_s):
+    """Per-tile partial-U rows (tile − mean)·(Vₖᵀ/σ). The tile arrives
+    sharded; the (m, k) projector is replicated, so the GEMM runs
+    shard-local with no collective. Zero-padded tail rows produce
+    −mean·proj garbage, which the caller slices away per tile."""
+    return (tile - mean) @ Vk_over_s
+
+
+def streamed_centered_svd_topk_sharded(mesh, X, n_left, *, max_bytes=None):
+    """Streamed mesh twin of the qPCA partial-U Gram route: (mean, Uk, S,
+    Vt) with the Gram built from sharded tiles (psum over ICI) and the
+    (n, k) U block assembled host-side from per-tile shard-local GEMMs —
+    X is never resident, on any device or in aggregate.
+
+    Matches :func:`~sq_learn_tpu.parallel.pca.centered_svd_sharded` on
+    the same input up to tile-summation order; ``Uk`` comes back as a
+    host array (its k columns are what the fit publishes as ``left_sv``).
+    """
+    from ..ops.linalg import gram_spectrum, svd_flip_v
+
+    X = np.asarray(X)
+    n, m = X.shape
+    mean, Gc, _ = streamed_centered_gram_sharded(mesh, X,
+                                                 max_bytes=max_bytes)
+    S, V, safe = gram_spectrum(Gc)
+    _, Vt = svd_flip_v(None, V.T)
+    k = int(n_left)
+    Vk_over_s = (Vt[:k] / safe[:k, None]).T  # (m, k), replicated
+    rep = replicated(mesh)
+    mean_r = jax.device_put(mean, rep)
+    proj_r = jax.device_put(Vk_over_s, rep)
+
+    def tile_fn(tile):
+        return _tile_topk_u(tile, mean_r, proj_r)
+
+    # small per-tile (rows, k) outputs come back to the host
+    Uk = stream_map_rows(X, tile_fn, max_bytes=max_bytes,
+                         put=_sharded_put(mesh),
+                         multiple=int(mesh.devices.size))
+    return mean, Uk, S, Vt

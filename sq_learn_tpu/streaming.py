@@ -1,0 +1,518 @@
+"""Streaming tiled-ingestion engine: double-buffered host→device row tiles
+with on-device accumulation.
+
+Every fit path used to materialize the whole dataset on device before the
+first FLOP: ``chunked_device_put`` (``_config.py``) slices the *upload* but
+immediately concatenates the pieces back into one device-resident array, so
+the monolithic residency cost — and the documented ≥200 MB relay-wedge
+trigger (CLAUDE.md) — stayed on the critical path. The out-of-core
+factorization literature (Halko et al.'s randomized range finder, which
+``ops/linalg.py:randomized_svd`` follows in-core) reduces these workloads to
+tile-sequential accumulations, which is exactly the shape XLA's async
+dispatch can overlap with transfers. This module is that engine:
+
+- **fixed-byte row tiles**: host data is walked in row slices of at most
+  ``stream_tile_bytes()`` bytes, so no single ``jax.device_put`` ever
+  exceeds the relay-safe transfer size — by construction, not by policy.
+- **double buffering**: the ``device_put`` for tile *i+1* is issued before
+  tile *i*'s jitted accumulation kernel is dispatched; nothing calls
+  ``block_until_ready`` between tiles, so on an accelerator the upload of
+  the next tile overlaps the compute on the current one.
+- **bucketed shapes**: tiles are zero-padded to a small set of bucketed row
+  counts (the full tile size plus power-of-two tail buckets), so a whole
+  pass compiles at most once per bucket — sweeping different dataset sizes
+  never recompiles the accumulation kernel for the full-tile bucket.
+- **donated accumulators**: every accumulation kernel is jitted with
+  ``donate_argnums=(0,)`` so the running state updates in place instead of
+  doubling its footprint each tile.
+
+Consumers (qPCA's Gram route, the randomized-SVD range finder, q-means
+prestats, streamed predicts) live at the bottom of this module; the mesh
+variant — tiles landing sharded, partial Grams reduced over ICI — is
+:mod:`sq_learn_tpu.parallel.streaming`.
+
+Env knobs: ``SQ_STREAM_TILE_BYTES`` caps the per-tile transfer size
+(default: ``SQ_TRANSFER_CHUNK_BYTES``, i.e. the relay-safe 128 MB);
+``SQ_STREAM_MIN_BUCKET_ROWS`` floors the tail buckets (default 64 rows).
+"""
+
+import functools
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "stream_tile_bytes",
+    "plan_row_tiles",
+    "stream_tiles",
+    "stream_fold",
+    "stream_map_rows",
+    "streamed_centered_gram",
+    "streamed_centered_svd_topk",
+    "streamed_randomized_svd",
+    "streamed_prestats",
+    "kernel_cache_sizes",
+    "worth_streaming",
+]
+
+#: tail tiles are padded up to power-of-two row buckets no smaller than
+#: this, bounding the bucket set to ~log2(rows_per_tile) compiled shapes
+_MIN_BUCKET_ROWS = int(os.environ.get("SQ_STREAM_MIN_BUCKET_ROWS", 64))
+
+
+def stream_tile_bytes():
+    """Per-tile transfer cap in bytes. ``SQ_STREAM_TILE_BYTES`` overrides;
+    the default is the relay-safe ``SQ_TRANSFER_CHUNK_BYTES`` from
+    :mod:`sq_learn_tpu._config` (every observed relay wedge hit during a
+    single ≥200 MB upload, never during small transfers)."""
+    env = os.environ.get("SQ_STREAM_TILE_BYTES")
+    if env is not None:
+        return int(env)
+    from ._config import _TRANSFER_CHUNK_BYTES
+
+    return _TRANSFER_CHUNK_BYTES
+
+
+def worth_streaming(X, max_bytes=None):
+    """True when ``X`` is host data large enough that a monolithic upload
+    would exceed the per-tile transfer cap — the 'auto' engagement rule
+    every streamed consumer shares. jax Arrays are already placed (their
+    upload, if any, already happened); only host numpy data streams."""
+    if isinstance(X, jax.Array):
+        return False
+    nbytes = getattr(X, "nbytes", None)
+    if nbytes is None:
+        return False
+    return nbytes > (stream_tile_bytes() if max_bytes is None else max_bytes)
+
+
+def _bucket_rows(n, full_rows, multiple=1):
+    """Bucketed row count for a tile holding ``n`` valid rows: the full
+    tile size for full tiles, else the smallest power-of-two ≥ n (floored
+    at ``_MIN_BUCKET_ROWS``, capped at the full tile size). The bucket
+    set for a pass is therefore {full_rows} ∪ {2^j}, so a sweep of
+    dataset sizes compiles each kernel at most once per bucket.
+    ``multiple`` rounds every bucket up to a device-count multiple (the
+    mesh variant's equal-shard requirement)."""
+    if n >= full_rows:
+        return full_rows
+    b = _MIN_BUCKET_ROWS
+    while b < n:
+        b <<= 1
+    b = -(-b // multiple) * multiple
+    return min(b, full_rows)
+
+
+def plan_row_tiles(n_rows, row_bytes, max_bytes=None, multiple=1):
+    """(rows_per_tile, n_tiles) for streaming ``n_rows`` rows of
+    ``row_bytes`` each under the per-tile byte cap; ``multiple`` forces
+    the full-tile row count to a device-count multiple for sharded
+    landing."""
+    if max_bytes is None:
+        max_bytes = stream_tile_bytes()
+    rows = max(1, int(max_bytes) // max(1, int(row_bytes)))
+    rows = min(rows, int(n_rows))
+    rows = max(multiple, rows // multiple * multiple)
+    n_tiles = -(-int(n_rows) // rows)
+    return rows, n_tiles
+
+
+def padded_rows(n_rows, row_bytes, max_bytes=None, multiple=1):
+    """Total row count including the tail tile's bucket padding — the
+    buffer size row-output consumers must allocate so the tail tile's
+    ``dynamic_update_slice`` never clamps."""
+    rows, _ = plan_row_tiles(n_rows, row_bytes, max_bytes, multiple)
+    tail = n_rows % rows
+    if not tail:
+        return n_rows
+    return n_rows + (_bucket_rows(tail, rows, multiple) - tail)
+
+
+def stream_tiles(X, max_bytes=None, device=None, put=None, multiple=1):
+    """Yield ``(dev_tile, n_valid, start)`` over the row tiles of host
+    array ``X``, double-buffered: the ``device_put`` for tile *i+1* is
+    issued before tile *i* is yielded (i.e. before the consumer dispatches
+    tile *i*'s kernel), and nothing blocks between tiles — on an
+    accelerator the next upload overlaps the current tile's compute.
+
+    Tiles are zero-padded to bucketed row counts (:func:`_bucket_rows`);
+    ``n_valid`` is the true row count of each tile and ``start`` its row
+    offset in ``X``. ``put`` overrides the placement callable (the mesh
+    variant passes a sharded ``device_put``); the default goes through
+    ``jax.device_put`` so transfer-accounting tests can monkeypatch it.
+    """
+    X = np.asarray(X)
+    # canonicalize on the host exactly like chunked_device_put: without it
+    # the f64→f32 cast would happen device-side, doubling the upload
+    canonical = jax.dtypes.canonicalize_dtype(X.dtype)
+    if X.dtype != canonical:
+        X = X.astype(canonical)
+    n = X.shape[0]
+    rows, n_tiles = plan_row_tiles(n, X.nbytes // max(1, n), max_bytes,
+                                   multiple)
+    if put is None:
+        def put(tile):
+            return jax.device_put(tile, device)
+
+    def staged(i):
+        start = i * rows
+        stop = min(start + rows, n)
+        valid = stop - start
+        bucket = _bucket_rows(valid, rows, multiple)
+        tile = X[start:stop]
+        if valid < bucket:
+            pad = np.zeros((bucket - valid,) + X.shape[1:], X.dtype)
+            tile = np.concatenate([tile, pad], axis=0)
+        return put(tile), valid, start
+
+    nxt = staged(0)
+    for i in range(n_tiles):
+        cur = nxt
+        if i + 1 < n_tiles:
+            # stage tile i+1 BEFORE the consumer dispatches tile i's
+            # kernel: both are async, so the transfer rides under the
+            # accumulation compute
+            nxt = staged(i + 1)
+        yield cur
+
+
+def stream_fold(X, step, init, *, max_bytes=None, device=None, put=None,
+                multiple=1, with_offsets=False):
+    """Fold a donated-accumulator kernel over the row tiles of ``X``.
+
+    ``step(acc, tile)`` (or ``step(acc, tile, n_valid, start)`` with
+    ``with_offsets=True``) must be jitted with ``donate_argnums=(0,)`` —
+    the engine threads the accumulator through the tiles without ever
+    synchronizing, so dispatch of tile *i+1*'s upload and tile *i*'s
+    kernel interleave. Tiles arrive zero-padded to bucket shapes; kernels
+    that sum over rows need no masking (zero rows contribute nothing),
+    kernels that need the true count take ``with_offsets``.
+    """
+    if device is not None:
+        init = jax.tree.map(lambda a: jax.device_put(a, device), init)
+    acc = init
+    for tile, n_valid, start in stream_tiles(X, max_bytes, device, put,
+                                             multiple):
+        if with_offsets:
+            acc = step(acc, tile, n_valid, start)
+        else:
+            acc = step(acc, tile)
+    return acc
+
+
+def stream_map_rows(X, fn, *, max_bytes=None, device=None, put=None,
+                    multiple=1, with_offsets=False):
+    """Apply a row-wise jitted ``fn(tile)`` to every tile and assemble the
+    (host) row-aligned outputs — the streamed-inference primitive
+    (labels, neighbor lists): tile *i+1* uploads while ``fn`` runs on
+    tile *i*; only the small per-tile outputs come back. ``fn`` may
+    return an array or a tuple of arrays whose leading axis is the tile
+    row axis; with ``with_offsets`` it is called as ``fn(tile, start)``
+    (tile-decorrelated RNG streams fold the offset into their key)."""
+    outs = []
+    for tile, n_valid, start in stream_tiles(X, max_bytes, device, put,
+                                             multiple):
+        out = fn(tile, start) if with_offsets else fn(tile)
+        outs.append((out, n_valid))
+    first = outs[0][0]
+    if isinstance(first, tuple):
+        return tuple(
+            np.concatenate([np.asarray(o[j])[:v] for o, v in outs], axis=0)
+            for j in range(len(first)))
+    return np.concatenate([np.asarray(o)[:v] for o, v in outs], axis=0)
+
+
+# ---------------------------------------------------------------------------
+# Accumulation kernels (module-level jits: one compile cache per process,
+# at most one entry per (bucket, dtype))
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _gram_colsum_step(acc, tile):
+    """acc = (G, colsum) ← (G + tileᵀ·tile, colsum + Σrows). Zero-padded
+    rows contribute nothing to either sum."""
+    G, colsum = acc
+    return G + tile.T @ tile, colsum + jnp.sum(tile, axis=0)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _colsum_step(acc, tile):
+    """acc ← acc + Σrows — the cheap column-mean pass (randomized-SVD
+    centering); zero-padded rows contribute nothing."""
+    return acc + jnp.sum(tile, axis=0)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _ingest_step(acc, tile, n_valid, start):
+    """Resident-assembly accumulator: write the tile's rows into the
+    donated device buffer (in place — no concatenate, no 2× peak) while
+    accumulating column sums / square-sums. ``start`` is traced, so every
+    tile of a bucket reuses one compiled kernel."""
+    buf, colsum, sqsum = acc
+    buf = lax.dynamic_update_slice(buf, tile, (start,) + (0,) * (tile.ndim - 1))
+    return (buf, colsum + jnp.sum(tile, axis=0),
+            sqsum + jnp.sum(tile * tile, axis=0))
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _matmul_accum_step(acc, tile, Q):
+    """acc ← acc + tileᵀ·(tile·Q) — one power-iteration pass of the
+    Gram-based range finder, never materializing the (n, size) product."""
+    return acc + tile.T @ (tile @ Q)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _project_rows_step(acc, tile, n_valid, start, Q):
+    """acc[start:start+rows] ← tile·Q (donated row-output buffer)."""
+    return lax.dynamic_update_slice(acc, tile @ Q, (start, 0))
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _qtb_step(acc, tile, n_valid, start, Qn):
+    """acc ← acc + Qn[start:start+rows]ᵀ·tile — the B = Qᵀ·A pass of the
+    range finder; ``Qn`` is the (row-padded) on-device orthonormal basis,
+    sliced per tile with a traced offset. Zero-padded tile rows pair with
+    zero-padded Qn rows, so they cancel."""
+    rows = tile.shape[0]
+    Qt = lax.dynamic_slice(Qn, (start, 0), (rows, Qn.shape[1]))
+    return acc + Qt.T @ tile
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _topk_u_step(acc, tile, n_valid, start, mean, Vk_over_s):
+    """acc[start:start+rows] ← (tile − mean)·(Vₖᵀ/σ) — the partial-U
+    assembly pass of the streamed Gram-route SVD. The subtraction uses a
+    masked mean so zero-padded rows stay exactly zero (they are sliced
+    away by the caller anyway, but must not pollute the buffer when a
+    tail bucket overlaps the next tile's offset — it never does; this is
+    pure hygiene)."""
+    rows = tile.shape[0]
+    mask = (jnp.arange(rows) < n_valid).astype(tile.dtype)[:, None]
+    Uk = ((tile - mean) * mask) @ Vk_over_s
+    return lax.dynamic_update_slice(acc, Uk, (start, 0))
+
+
+def kernel_cache_sizes():
+    """Compile-cache entry count per streaming kernel — the observability
+    hook the bench and the no-per-shape-recompile tests read. Each entry
+    corresponds to one (bucket shape, dtype) signature."""
+    kernels = {
+        "gram_colsum": _gram_colsum_step,
+        "colsum": _colsum_step,
+        "ingest": _ingest_step,
+        "matmul_accum": _matmul_accum_step,
+        "project_rows": _project_rows_step,
+        "qtb": _qtb_step,
+        "topk_u": _topk_u_step,
+    }
+    return {name: int(fn._cache_size()) for name, fn in kernels.items()}
+
+
+# ---------------------------------------------------------------------------
+# Consumers
+# ---------------------------------------------------------------------------
+
+
+def streamed_centered_gram(X, *, max_bytes=None, device=None):
+    """(mean, G_centered, n) of host data, built tile-by-tile — X is never
+    resident on device.
+
+    One pass accumulates the raw Gram ``G = Σ tileᵀ·tile`` and the column
+    sum; the centered Gram follows from the rank-one identity
+    ``Xcᵀ·Xc = XᵀX − n·mean·meanᵀ`` (exact in exact arithmetic; in f32 it
+    trades the monolithic path's last-ulp agreement for never holding X —
+    fine at explained-variance scale, not for σ ≈ 0 tails of badly
+    uncentered data)."""
+    X = np.asarray(X)
+    n, m = X.shape
+    dtype = jax.dtypes.canonicalize_dtype(X.dtype)
+    init = (jnp.zeros((m, m), dtype), jnp.zeros((m,), dtype))
+    G, colsum = stream_fold(X, _gram_colsum_step, init,
+                            max_bytes=max_bytes, device=device)
+    mean, Gc = _finalize_centered_gram(G, colsum, n)
+    return mean, Gc, n
+
+
+@functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("n",))
+def _finalize_centered_gram(G, colsum, n):
+    mean = colsum / n
+    return mean, G - n * jnp.outer(mean, mean)
+
+
+def streamed_centered_svd_topk(X, n_left, *, compute_dtype=None,
+                               max_bytes=None, device=None):
+    """Streamed twin of :func:`~sq_learn_tpu.ops.linalg.centered_svd_topk`:
+    (mean, Uk, S, Vt) of a tall host matrix via the tiled centered Gram,
+    materializing only the first ``n_left`` columns of U.
+
+    Two streamed passes: (1) Gram + column mean, (2) the (n, k) partial-U
+    block assembled into a donated device buffer — X itself is never
+    device-resident. ``compute_dtype`` applies to the U-block GEMM (the
+    Gram pass accumulates in the input dtype: the tile Grams are the
+    accuracy-critical reduction).
+    """
+    from .ops.linalg import gram_spectrum, svd_flip_v
+
+    X = np.asarray(X)
+    n, m = X.shape
+    mean, Gc, _ = streamed_centered_gram(X, max_bytes=max_bytes,
+                                         device=device)
+    S, V, safe = gram_spectrum(Gc)
+    _, Vt = svd_flip_v(None, V.T)
+    k = int(n_left)
+    Vk_over_s = (Vt[:k] / safe[:k, None]).T  # (m, k)
+    cdt = S.dtype if compute_dtype is None else jnp.dtype(compute_dtype)
+    Vk_over_s = Vk_over_s.astype(cdt)
+    mean_c = mean.astype(cdt)
+
+    def step(acc, tile, n_valid, start):
+        return _topk_u_step(acc, tile.astype(cdt), n_valid, start,
+                            mean_c, Vk_over_s)
+
+    # the output buffer is padded like the tiles: the tail bucket's
+    # dynamic_update_slice must never clamp (a clamped start would shift
+    # the tail rows onto earlier ones)
+    n_pad = padded_rows(n, X.nbytes // max(1, n), max_bytes)
+    Uk = stream_fold(X, step, jnp.zeros((n_pad, k), cdt),
+                     max_bytes=max_bytes, device=device, with_offsets=True)
+    return mean, Uk[:n].astype(S.dtype), S, Vt
+
+
+def streamed_randomized_svd(key, X, n_components, *, n_oversamples=10,
+                            n_iter=4, center=False, max_bytes=None,
+                            device=None, flip=True):
+    """Streamed randomized truncated SVD (Halko et al.) of host data:
+    the range finder and power iterations run as tiled passes — per pass,
+    one (m, size) accumulation ``Σ tileᵀ·(tile·Q)`` — so X is never
+    device-resident and every transfer stays under the tile cap.
+
+    Mathematically the same subspace iteration as
+    :func:`~sq_learn_tpu.ops.linalg.randomized_svd` (QR-renormalized
+    power iterations on AᵀA), reassociated tile-wise; results agree to
+    the usual randomized-SVD accuracy, not bitwise. ``center=True``
+    factors X − mean via the rank-one correction, never materializing the
+    centered matrix. Returns (U, S, Vt) — plus ``mean`` when centering —
+    with U (n, k) device-resident.
+    """
+    from .ops.linalg import svd_flip_v
+
+    X = np.asarray(X)
+    n, m = X.shape
+    dtype = jax.dtypes.canonicalize_dtype(X.dtype)
+    size = min(int(n_components) + int(n_oversamples), min(n, m))
+
+    # pass 0: column mean (only when factoring the centered matrix)
+    mean = None
+    if center:
+        colsum = stream_fold(X, _colsum_step, jnp.zeros((m,), dtype),
+                             max_bytes=max_bytes, device=device)
+        mean = colsum / n
+
+    Q = jax.random.normal(key, (m, size), dtype=dtype)
+    for _ in range(max(1, int(n_iter))):
+        F = stream_fold(X, functools.partial(_matmul_accum_step, Q=Q),
+                        jnp.zeros((m, size), dtype),
+                        max_bytes=max_bytes, device=device)
+        if center:
+            # (Xcᵀ·Xc)·Q = AᵀA·Q − n·mean·(meanᵀ·Q)
+            F = F - n * jnp.outer(mean, mean @ Q)
+        Q, _ = jnp.linalg.qr(F)
+
+    # Y = Xc·Q assembled row-tile-wise into a donated (n_pad, size) buffer
+    n_pad = padded_rows(n, X.nbytes // max(1, n), max_bytes)
+    Y = stream_fold(
+        X, functools.partial(_project_rows_step, Q=Q),
+        jnp.zeros((n_pad, size), dtype),
+        max_bytes=max_bytes, device=device, with_offsets=True)
+    if center:
+        Y = Y - (mean @ Q)[None, :]
+    # zero-pad rows of Y must not enter the QR basis: re-zero them (the
+    # centering shift above made them −meanᵀQ)
+    if n_pad > n:
+        Y = Y.at[n:].set(0.0)
+    Qn, _ = jnp.linalg.qr(Y)  # (n_pad, size); padded rows stay zero
+
+    B = stream_fold(
+        X, functools.partial(_qtb_step, Qn=Qn),
+        jnp.zeros((size, m), dtype),
+        max_bytes=max_bytes, device=device, with_offsets=True)
+    if center:
+        B = B - jnp.outer(jnp.sum(Qn[:n], axis=0), mean)
+    Uhat, S, Vt = jnp.linalg.svd(B, full_matrices=False)
+    U = (Qn @ Uhat)[:n]
+    if flip:
+        U, Vt = svd_flip_v(U, Vt)
+    k = int(n_components)
+    out = (U[:, :k], S[:k], Vt[:k])
+    return out + (mean,) if center else out
+
+
+def streamed_prestats(X, *, quantum=False, mu_grid=(), mu_blocked=False,
+                      max_bytes=None, device=None):
+    """Streamed twin of :func:`~sq_learn_tpu.models.qkmeans.fit_prestats`:
+    assemble the device copy tile-by-tile into ONE donated buffer (bounded
+    transfers, no concatenate, upload overlapped with the running
+    column-sum/square-sum accumulation), then finalize mean / centering /
+    row norms / tolerance scale on device.
+
+    q-means fundamentally needs the data resident (the Lloyd loop sweeps
+    it every iteration), so unlike the Gram consumers this path keeps X on
+    device — what streaming buys is the bounded per-transfer size and the
+    in-place assembly. Returns the same dict as ``fit_prestats``.
+    """
+    X = np.asarray(X)
+    n, m = X.shape
+    dtype = jax.dtypes.canonicalize_dtype(X.dtype)
+    n_pad = padded_rows(n, X.nbytes // max(1, n), max_bytes)
+    init = (jnp.zeros((n_pad, m), dtype), jnp.zeros((m,), dtype),
+            jnp.zeros((m,), dtype))
+    buf, colsum, sqsum = stream_fold(X, _ingest_step, init,
+                                     max_bytes=max_bytes, device=device,
+                                     with_offsets=True)
+    out = {}
+    if quantum:
+        # the quantum runtime-model stats read the UNCENTERED matrix;
+        # compute them on the resident buffer before it is donated away
+        # by the centering finalize
+        out.update(_prestats_quantum(buf, n, mu_grid, mu_blocked))
+    import warnings
+
+    with warnings.catch_warnings():
+        # with a ragged tail the (n_pad, m) buffer cannot alias the
+        # (n, m) centered output; XLA warns the donation went unused —
+        # expected, and the buffer is dead after this call either way
+        warnings.filterwarnings("ignore",
+                                message="Some donated buffers were not")
+        mean, Xc, xsq, var_mean = _finalize_prestats(buf, colsum, sqsum, n)
+    out.update({"mean": mean, "Xc": Xc, "xsq": xsq, "var_mean": var_mean})
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("n", "mu_grid", "mu_blocked"))
+def _prestats_quantum(buf, n, mu_grid, mu_blocked):
+    from .ops.linalg import row_norms, smallest_singular_value
+    from .ops.quantum.norms import _mu_grid_blocked, _mu_grid_unblocked
+
+    X = buf[:n]
+    sweep = _mu_grid_blocked if mu_blocked else _mu_grid_unblocked
+    return {
+        "eta": jnp.max(row_norms(X, squared=True)),
+        "mu_vals": sweep(X, mu_grid),
+        "frob": jnp.linalg.norm(X),
+        "sigma_min": smallest_singular_value(X),
+    }
+
+
+@functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("n",))
+def _finalize_prestats(buf, colsum, sqsum, n):
+    from .ops.linalg import row_norms
+
+    mean = colsum / n
+    Xc = buf[:n] - mean
+    xsq = row_norms(Xc, squared=True)
+    var_mean = jnp.mean(jnp.maximum(sqsum / n - mean * mean, 0.0))
+    return mean, Xc, xsq, var_mean

@@ -63,7 +63,7 @@ def main():
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
 
     # an explicit psum inside shard_map over the global mesh
-    from jax import shard_map
+    from sq_learn_tpu._compat import shard_map
 
     @jax.jit
     def total_weight(wg):

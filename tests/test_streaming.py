@@ -1,0 +1,333 @@
+"""Streaming tiled-ingestion engine tests.
+
+Parity contract: streamed results must match the monolithic path in the
+same dtype. Quantities whose computation is row-independent (resident
+assembly, classic predict labels) are pinned exactly equal; tile-summed
+reductions (Gram, column mean) reassociate float adds across tiles, so
+they are pinned to tight tolerances instead — tolerance-free equality
+there would pin XLA's reduction order, not our engine.
+
+Transfer accounting monkeypatches ``jax.device_put`` (the engine resolves
+it late, so the patch sees every tile) and asserts no single streamed
+transfer exceeds the configured tile bytes.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from sq_learn_tpu import streaming
+from sq_learn_tpu.models import QPCA, QKMeans, KNeighborsClassifier
+from sq_learn_tpu.models.qkmeans import fit_prestats
+from sq_learn_tpu.ops.linalg import centered_svd_topk, randomized_svd
+
+
+RNG = np.random.default_rng(0)
+# 1003 rows: a ragged final tile for every divisor-ish tile size below
+X_TALL = (RNG.normal(size=(1003, 16)) + 2.0).astype(np.float32)
+ROW_BYTES = X_TALL.nbytes // X_TALL.shape[0]
+TILE_BYTES = 150 * ROW_BYTES  # ~7 tiles, tail of 103 → bucket 128
+
+
+class TestTiler:
+    def test_plan_row_tiles(self):
+        rows, n_tiles = streaming.plan_row_tiles(1003, ROW_BYTES,
+                                                 TILE_BYTES)
+        assert rows == 150
+        assert n_tiles == 7
+
+    def test_bucket_rows_pow2_tail(self):
+        assert streaming._bucket_rows(150, 150) == 150
+        assert streaming._bucket_rows(103, 150) == 128
+        assert streaming._bucket_rows(3, 150) == 64   # floor bucket
+        assert streaming._bucket_rows(140, 150) == 150  # cap at full tile
+
+    def test_bucket_rows_multiple(self):
+        # mesh buckets round to device-count multiples
+        assert streaming._bucket_rows(65, 150, multiple=8) == 128
+        assert streaming._bucket_rows(3, 150, multiple=8) == 64
+
+    def test_tiles_cover_rows_with_zero_padding(self):
+        seen = np.zeros(1003, bool)
+        for tile, n_valid, start in streaming.stream_tiles(
+                X_TALL, max_bytes=TILE_BYTES):
+            t = np.asarray(tile)
+            assert np.array_equal(t[:n_valid],
+                                  X_TALL[start:start + n_valid])
+            assert not t[n_valid:].any()  # zero padding
+            seen[start:start + n_valid] = True
+        assert seen.all()
+
+
+class TestTransferAccounting:
+    """No single device_put in a streamed fit exceeds the tile bytes."""
+
+    @pytest.fixture
+    def recorded_puts(self, monkeypatch):
+        sizes = []
+        real_put = jax.device_put
+
+        def recording(x, *a, **kw):
+            sizes.append(int(getattr(x, "nbytes", 0)))
+            return real_put(x, *a, **kw)
+
+        monkeypatch.setattr(jax, "device_put", recording)
+        return sizes
+
+    def test_streamed_qpca_fit_transfers_capped(self, monkeypatch,
+                                                recorded_puts):
+        monkeypatch.setenv("SQ_STREAM_TILE_BYTES", str(TILE_BYTES))
+        pca = QPCA(n_components=3, svd_solver="full",
+                   ingest="streamed").fit(X_TALL)
+        assert pca.ingest_ == "streamed"
+        assert recorded_puts, "no transfer was recorded"
+        assert max(recorded_puts) <= TILE_BYTES
+
+    def test_streamed_qkmeans_fit_transfers_capped(self, monkeypatch,
+                                                   recorded_puts):
+        monkeypatch.setenv("SQ_STREAM_TILE_BYTES", str(TILE_BYTES))
+        km = QKMeans(n_clusters=3, n_init=1, random_state=0).fit(X_TALL)
+        assert km.ingest_ == "streamed"
+        # the tile uploads are the big transfers; centers/keys are tiny
+        big = [s for s in recorded_puts if s > 64 * ROW_BYTES]
+        assert big, "no tile-sized transfer was recorded"
+        assert max(recorded_puts) <= TILE_BYTES
+
+
+class TestGramParity:
+    """Streamed Gram/partial-U route vs the monolithic kernel, including a
+    ragged final tile (1003 % 150 = 103) and a bucket-boundary row count
+    (an exact multiple: no tail tile at all)."""
+
+    @pytest.mark.parametrize("n_rows", [1003, 900])  # ragged, exact tiles
+    def test_streamed_centered_svd_topk(self, n_rows):
+        X = X_TALL[:n_rows]
+        mean_s, Uk_s, S_s, Vt_s = streaming.streamed_centered_svd_topk(
+            X, 3, max_bytes=TILE_BYTES)
+        mean_m, Uk_m, S_m, Vt_m = centered_svd_topk(jnp.asarray(X), 3)
+        assert np.asarray(S_s).dtype == np.asarray(S_m).dtype
+        assert np.asarray(Uk_s).shape == np.asarray(Uk_m).shape
+        np.testing.assert_allclose(np.asarray(mean_s), np.asarray(mean_m),
+                                   rtol=1e-6, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(S_s), np.asarray(S_m),
+                                   rtol=1e-4, atol=1e-3)
+        np.testing.assert_allclose(np.asarray(Uk_s), np.asarray(Uk_m),
+                                   rtol=1e-2, atol=1e-3)
+        np.testing.assert_allclose(np.asarray(Vt_s[:3]),
+                                   np.asarray(Vt_m[:3]),
+                                   rtol=1e-2, atol=1e-3)
+
+    def test_streamed_gram_matches_direct(self):
+        mean, Gc, n = streaming.streamed_centered_gram(
+            X_TALL, max_bytes=TILE_BYTES)
+        Xc = X_TALL - X_TALL.mean(0, dtype=np.float64).astype(np.float32)
+        ref = Xc.T.astype(np.float64) @ Xc.astype(np.float64)
+        assert n == 1003
+        scale = np.abs(ref).max()
+        assert np.abs(np.asarray(Gc, np.float64) - ref).max() < 1e-5 * scale
+
+    def test_single_tile_degenerates_to_monolithic_math(self):
+        # max_bytes larger than X: one tile, no padding
+        mean, Gc, _ = streaming.streamed_centered_gram(
+            X_TALL, max_bytes=X_TALL.nbytes * 2)
+        Xc = X_TALL - np.asarray(mean)
+        np.testing.assert_allclose(np.asarray(Gc), Xc.T @ Xc,
+                                   rtol=1e-4, atol=1e-2)
+
+
+class TestRangeFinderParity:
+    @pytest.mark.parametrize("n_rows", [1003, 900])
+    def test_streamed_randomized_svd(self, key, n_rows):
+        X = X_TALL[:n_rows]
+        U_s, S_s, Vt_s = streaming.streamed_randomized_svd(
+            key, X, 4, max_bytes=TILE_BYTES)
+        U_m, S_m, Vt_m = randomized_svd(key, jnp.asarray(X), 4)
+        assert np.asarray(S_s).dtype == np.asarray(S_m).dtype
+        np.testing.assert_allclose(np.asarray(S_s), np.asarray(S_m),
+                                   rtol=1e-3)
+        # same key, same subspace: the leading components align to sign
+        dots = np.abs(np.sum(np.asarray(Vt_s) * np.asarray(Vt_m), axis=1))
+        np.testing.assert_allclose(dots, 1.0, atol=1e-3)
+
+    def test_truncated_svd_streamed_estimator(self):
+        from sq_learn_tpu.models import TruncatedSVD
+
+        m = TruncatedSVD(n_components=3, random_state=0,
+                         ingest="monolithic").fit(X_TALL)
+        import os
+
+        os.environ["SQ_STREAM_TILE_BYTES"] = str(TILE_BYTES)
+        try:
+            s = TruncatedSVD(n_components=3, random_state=0,
+                             ingest="streamed").fit(X_TALL)
+        finally:
+            del os.environ["SQ_STREAM_TILE_BYTES"]
+        assert s.ingest_ == "streamed" and m.ingest_ == "monolithic"
+        np.testing.assert_allclose(s.singular_values_, m.singular_values_,
+                                   rtol=1e-3)
+        dots = np.abs(np.sum(s.components_ * m.components_, axis=1))
+        np.testing.assert_allclose(dots, 1.0, atol=1e-3)
+
+
+class TestPrestatsParity:
+    @pytest.mark.parametrize("n_rows", [1003, 900])
+    def test_streamed_prestats(self, n_rows):
+        X = X_TALL[:n_rows]
+        stats = streaming.streamed_prestats(X, max_bytes=TILE_BYTES)
+        ref = fit_prestats(jnp.asarray(X))
+        # the resident assembly is byte-identical by construction; the
+        # centered matrix inherits only the tile-summed mean's ulp noise
+        for name, tol in (("mean", 1e-6), ("Xc", 1e-5), ("xsq", 1e-3),
+                          ("var_mean", 1e-5)):
+            a, b = np.asarray(stats[name]), np.asarray(ref[name])
+            assert a.dtype == b.dtype and a.shape == b.shape
+            np.testing.assert_allclose(a, b, rtol=tol, atol=tol,
+                                       err_msg=name)
+
+    def test_streamed_prestats_quantum(self):
+        from sq_learn_tpu.models.qkmeans import MU_GRID
+
+        stats = streaming.streamed_prestats(
+            X_TALL, quantum=True, mu_grid=MU_GRID, max_bytes=TILE_BYTES)
+        ref = fit_prestats(jnp.asarray(X_TALL), quantum=True,
+                           mu_grid=MU_GRID)
+        # quantum stats are computed on the resident assembled buffer —
+        # the same values the monolithic kernel sees, so exact equality
+        for name in ("eta", "frob", "sigma_min", "mu_vals"):
+            a, b = np.asarray(stats[name]), np.asarray(ref[name])
+            assert a.dtype == b.dtype
+            np.testing.assert_array_equal(a, b, err_msg=name)
+
+    def test_streamed_qkmeans_fit_matches_monolithic(self, monkeypatch):
+        init = X_TALL[:3].copy()
+        km_m = QKMeans(n_clusters=3, init=init, n_init=1,
+                       random_state=0).fit(X_TALL)
+        monkeypatch.setenv("SQ_STREAM_TILE_BYTES", str(TILE_BYTES))
+        km_s = QKMeans(n_clusters=3, init=init, n_init=1,
+                       random_state=0).fit(X_TALL)
+        assert km_s.ingest_ == "streamed" and km_m.ingest_ == "monolithic"
+        np.testing.assert_allclose(km_s.cluster_centers_,
+                                   km_m.cluster_centers_,
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(km_s.inertia_, km_m.inertia_,
+                                   rtol=1e-5)
+
+
+class TestStreamedPredict:
+    def test_qkmeans_streamed_predict_exact(self, monkeypatch):
+        km = QKMeans(n_clusters=3, init=X_TALL[:3].copy(), n_init=1,
+                     random_state=0).fit(X_TALL)
+        ref = km.predict(X_TALL)
+        # compute_dtype='float32' (a no-op precision-wise) skips the host
+        # fast path so the device branch — where streaming engages — runs
+        km.compute_dtype = "float32"
+        ref_dev = km.predict(X_TALL)
+        monkeypatch.setenv("SQ_STREAM_TILE_BYTES", str(TILE_BYTES))
+        streamed = km.predict(X_TALL)
+        # classic-mode labels are row-independent: exact equality
+        np.testing.assert_array_equal(streamed, ref_dev)
+        np.testing.assert_array_equal(streamed, ref)
+
+    def test_knn_streamed_predict_exact(self, monkeypatch):
+        y = (np.arange(len(X_TALL)) % 3)
+        kn = KNeighborsClassifier(n_neighbors=3,
+                                  compute_dtype="float32").fit(X_TALL, y)
+        d_ref, i_ref = kn.kneighbors(X_TALL[:257])
+        monkeypatch.setenv("SQ_STREAM_TILE_BYTES", str(64 * ROW_BYTES))
+        d_s, i_s = kn.kneighbors(X_TALL[:257])
+        np.testing.assert_array_equal(i_s, i_ref)
+        np.testing.assert_allclose(d_s, d_ref, rtol=1e-5, atol=1e-5)
+
+
+class TestCompileDiscipline:
+    def test_no_per_shape_recompile_across_row_count_sweep(self):
+        """5 row counts through the Gram pass: compile-cache entries stay
+        pinned to the distinct (bucket, dtype) signatures (≤ 2 per
+        bucket), never one per row count."""
+        sweep = [551, 667, 782, 900, 1003]
+        buckets = set()
+        for size in sweep:
+            rows, _ = streaming.plan_row_tiles(size, ROW_BYTES, TILE_BYTES)
+            buckets.add(rows)
+            tail = size % rows
+            if tail:
+                buckets.add(streaming._bucket_rows(tail, rows))
+        before = streaming.kernel_cache_sizes()["gram_colsum"]
+        for size in sweep:
+            streaming.streamed_centered_gram(X_TALL[:size],
+                                             max_bytes=TILE_BYTES)
+        after = streaming.kernel_cache_sizes()["gram_colsum"]
+        assert after <= 2 * len(buckets)
+        # and the sweep itself minted at most the new buckets, not one
+        # compile per row count
+        assert after - before <= len(buckets)
+
+
+class TestMeshStreaming:
+    def test_streamed_gram_sharded_parity(self, mesh8):
+        from sq_learn_tpu.parallel.streaming import \
+            streamed_centered_gram_sharded
+
+        mean, Gc, n = streamed_centered_gram_sharded(
+            mesh8, X_TALL, max_bytes=TILE_BYTES)
+        mean_1, Gc_1, _ = streaming.streamed_centered_gram(
+            X_TALL, max_bytes=TILE_BYTES)
+        assert n == 1003
+        np.testing.assert_allclose(np.asarray(mean), np.asarray(mean_1),
+                                   rtol=1e-6, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(Gc), np.asarray(Gc_1),
+                                   rtol=1e-4, atol=1e-2)
+
+    def test_streamed_topk_sharded_vs_resident_mesh_svd(self, mesh8):
+        from sq_learn_tpu.parallel.pca import centered_svd_sharded
+        from sq_learn_tpu.parallel.streaming import \
+            streamed_centered_svd_topk_sharded
+
+        mean_s, Uk, S_s, Vt_s = streamed_centered_svd_topk_sharded(
+            mesh8, X_TALL, 3, max_bytes=TILE_BYTES)
+        mean_m, U_m, S_m, Vt_m = centered_svd_sharded(mesh8, X_TALL)
+        assert Uk.shape == (1003, 3)
+        np.testing.assert_allclose(np.asarray(S_s)[:16], np.asarray(S_m),
+                                   rtol=1e-4, atol=1e-3)
+        np.testing.assert_allclose(Uk, np.asarray(U_m)[:, :3],
+                                   rtol=1e-2, atol=1e-3)
+
+    def test_qpca_mesh_streamed_fit(self, mesh8, monkeypatch):
+        ref = QPCA(n_components=3, svd_solver="full", mesh=mesh8,
+                   ingest="monolithic").fit(X_TALL)
+        monkeypatch.setenv("SQ_STREAM_TILE_BYTES", str(TILE_BYTES))
+        got = QPCA(n_components=3, svd_solver="full", mesh=mesh8).fit(
+            X_TALL)
+        assert got.ingest_ == "streamed"
+        np.testing.assert_allclose(got.explained_variance_ratio_,
+                                   ref.explained_variance_ratio_,
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(got.components_, ref.components_,
+                                   rtol=1e-2, atol=1e-3)
+        np.testing.assert_allclose(got.left_sv, ref.left_sv,
+                                   rtol=1e-2, atol=1e-3)
+
+
+class TestIngestResolution:
+    def test_qadra_fit_vetoes_streaming_with_warning(self):
+        with pytest.warns(RuntimeWarning, match="ingest='streamed'"):
+            pca = QPCA(n_components=3, svd_solver="full",
+                       ingest="streamed").fit(
+                X_TALL, estimate_all=True, eps=0.1, delta=0.1,
+                theta_major=1e-9, true_tomography=False)
+        assert pca.ingest_ == "monolithic"
+        assert np.isfinite(pca.estimate_s_values).all()
+
+    def test_auto_respects_tile_cap(self, monkeypatch):
+        # input below the cap: no streaming
+        pca = QPCA(n_components=3, svd_solver="full").fit(X_TALL)
+        assert pca.ingest_ == "monolithic"
+        monkeypatch.setenv("SQ_STREAM_TILE_BYTES", str(TILE_BYTES))
+        pca = QPCA(n_components=3, svd_solver="full").fit(X_TALL)
+        assert pca.ingest_ == "streamed"
+
+    def test_invalid_ingest_rejected(self):
+        with pytest.raises(ValueError, match="ingest"):
+            QPCA(n_components=3, svd_solver="full", ingest="nope").fit(
+                X_TALL)
