@@ -96,6 +96,13 @@ def main():
         result["inertia_vs_sklearn"] = round(inertia_ratio, 5)
         print(f"# sklearn={sk_time:.4f}s ARI(median over 3 seeds)={ari:.3f} "
               f"inertia ratio={inertia_ratio:.5f}", file=sys.stderr)
+    # SQ_OBS=1: the headline line gains compile/transfer/probe totals so
+    # BENCH_*.json tracks observability regressions alongside latency
+    from bench._common import obs_snapshot
+
+    snap = obs_snapshot()
+    if snap is not None:
+        result["obs"] = snap
     print(json.dumps(result))
 
 

@@ -19,6 +19,18 @@ def timed(fn, *args, warmup=1, reps=1, **kwargs):
     return best, result
 
 
+def obs_snapshot():
+    """The active obs run's summary (compile_count, total_transfer_bytes,
+    probe_ms, ...), or None when observability is off — import-safe even
+    if sq_learn_tpu is broken (a bench must still print its line)."""
+    try:
+        from sq_learn_tpu import obs
+
+        return obs.snapshot()
+    except Exception:
+        return None
+
+
 def emit(metric, value, unit="s", vs_baseline=1.0, baseline_kind=None,
          **extra):
     """Print the ONE machine-readable JSON line (extras go to stderr).
@@ -33,7 +45,12 @@ def emit(metric, value, unit="s", vs_baseline=1.0, baseline_kind=None,
     vs_baseline is on a different scale (e.g. bench_ipe_digits' derived
     serial-cost ratio, order 1e4-1e5) must be distinguishable without
     reading its docstring. None (the default) = measured, and the key is
-    omitted to keep the driver's headline line schema untouched."""
+    omitted to keep the driver's headline line schema untouched.
+
+    With ``SQ_OBS=1`` the line gains an ``obs`` object (compile_count,
+    total_transfer_bytes, probe_ms, ...) so bench records track
+    observability regressions alongside latency; with observability off
+    the schema is byte-identical to pre-obs records."""
     if extra:
         print("# " + json.dumps(extra), file=sys.stderr)
     line = {
@@ -45,6 +62,9 @@ def emit(metric, value, unit="s", vs_baseline=1.0, baseline_kind=None,
     }
     if baseline_kind is not None:
         line["baseline_kind"] = baseline_kind
+    snap = obs_snapshot()
+    if snap is not None:
+        line["obs"] = snap
     print(json.dumps(line))
 
 
@@ -82,11 +102,17 @@ def probe_backend(timeout_s=60):
     fall back to the CPU backend when the accelerator tunnel is wedged
     (same contract as the headline bench.py).
 
+    The probe itself (subprocess + timeout + latency/outcome accounting)
+    lives in :mod:`sq_learn_tpu.obs.probe` — the one implementation of
+    the known axon-wedge escape — so every bench run records probe
+    latency and outcome as metrics when ``SQ_OBS=1``.
+
     60 s default: a healthy tunnel answers the probe in ~5-15 s; a wedged
     one never answers, so the timeout is pure stall — every observed
     wedge lasted hours, making longer patience pointless."""
     import os
-    import subprocess
+
+    from sq_learn_tpu.obs.probe import probe_device
 
     platform = os.environ.get("JAX_PLATFORMS", "")
     if platform == "cpu":
@@ -96,18 +122,19 @@ def probe_backend(timeout_s=60):
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+        probe_device(platform=platform)  # records the 'cpu' outcome
         return
     if platform == "":
+        probe_device(platform=platform)  # records the 'skipped' outcome
         return
-    try:
-        subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=timeout_s, check=True, capture_output=True)
+    result = probe_device(timeout_s=timeout_s, platform=platform)
+    if result["outcome"] == "ok":
         # accelerator reachable: persist its compiles across processes
         _enable_compilation_cache()
-    except (subprocess.TimeoutExpired, subprocess.CalledProcessError) as exc:
-        print(f"# backend {platform!r} unreachable ({type(exc).__name__}); "
-              "falling back to CPU", file=sys.stderr)
+    else:
+        print(f"# backend {platform!r} unreachable ({result['outcome']}, "
+              f"{result['latency_s']:.1f}s); falling back to CPU",
+              file=sys.stderr)
         os.environ["JAX_PLATFORMS"] = "cpu"
         import jax
 

@@ -124,7 +124,31 @@ def main():
     finally:
         os.environ.pop("SQ_STREAM_TILE_BYTES", None)
 
+    # SQ_OBS=1: close the run artifact with (a) the watchdog's view of the
+    # bucket sweep — the enforced form of the ≤1-compile-per-bucket
+    # invariant this bench's cache-entry count measures by hand — and
+    # (b) a small quantum-extraction fit so the run's ledger states the
+    # paper's accuracy-vs-runtime trade-off (nonzero tomography shots)
+    # next to the streamed classical numbers.
+    from sq_learn_tpu import obs
+
+    obs_extra = {}
+    if obs.enabled():
+        report = obs.watchdog.report().get("streaming.gram_colsum", {})
+        Xq = X[:512, :64]
+        QPCA(n_components=8, svd_solver="full", random_state=0).fit(
+            Xq, estimate_all=True, theta_major=1.0, eps=0.1, delta=0.5,
+            true_tomography=False)
+        totals = obs.ledger.totals()
+        obs_extra = {
+            "obs_watchdog_gram_compiles": report.get("compiles"),
+            "obs_watchdog_gram_budget": report.get("budget"),
+            "obs_ledger_tomography_shots":
+                totals["queries"].get("tomography_shots", 0),
+        }
+
     emit("streaming_ingest_qpca_gram_fit_wallclock", stream_t,
+         **obs_extra,
          vs_baseline=(mono_t / stream_t if stream_t > 0 else None),
          n=n, m=m, k=k, tile_bytes=tile_bytes,
          monolithic_s=round(mono_t, 4),

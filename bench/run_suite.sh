@@ -35,11 +35,21 @@ fi
 : > "$out"
 echo "# suite run $(date -Is)" >> "$out"
 
+# per-config obs JSONL archive (SQ_OBS=1 run-scoped observability): the
+# spans/ledger/watchdog artifact of every config lands next to the record
+# it explains, committed with it — same traceability rule as the record
+# itself (VERDICT r2 missing #4).
+obs_dir="${out%.txt}_obs"
+mkdir -p "$obs_dir"
+
 run_and_record() {  # run_and_record <timeout_s> <header> <cmd...>; returns the cmd's rc
   local tmo=$1
   echo "## $2" >> "$out"
+  local slug
+  slug="$(printf '%s' "$2" | tr -c 'A-Za-z0-9._-' '_')"
   shift 2
-  timeout "$tmo" "$@" >> "$out" 2>"$stderr_tmp"
+  timeout "$tmo" env SQ_OBS=1 SQ_OBS_PATH="$obs_dir/${slug}.jsonl" \
+    "$@" >> "$out" 2>"$stderr_tmp"
   local rc=$?
   # failures keep a full traceback in the record (the temp file is deleted
   # on exit); successes keep the 3-line summary

@@ -23,6 +23,7 @@ __version__ = "0.1.0"
 
 # Submodules are imported lazily-but-eagerly here; keep this list in sync with
 # the component inventory in SURVEY.md §2.
+from . import obs  # noqa: E402  (first: everything else instruments through it)
 from . import ops, utils  # noqa: E402
 
 from . import datasets, metrics, model_selection, models, native, parallel  # noqa: E402
@@ -60,6 +61,7 @@ __all__ = [
     "TransformerMixin",
     "check_is_fitted",
     "clone",
+    "obs",
     "ops",
     "utils",
     "native",

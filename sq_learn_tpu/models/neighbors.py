@@ -14,12 +14,14 @@ matrix ever round-trips HBM.
 
 import functools
 import numbers
+import time
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .. import obs as _obs
 from .._config import as_device_array, with_device_scope
 from ..base import (BaseEstimator, ClassifierMixin, check_is_fitted,
                     check_n_features)
@@ -267,7 +269,22 @@ class KNeighborsClassifier(ClassifierMixin, BaseEstimator):
     def _search(self, X, k):
         """Full search dispatch, one ladder for every public surface:
         mesh (train-sharded SPMD search) > host fast path > tiny-predict
-        host routing > single-device (pallas/XLA)."""
+        host routing > single-device (pallas/XLA). Every search is one
+        span + one (classical, zero-quantum-queries) ledger entry with
+        the engine that actually served it."""
+        t0 = time.perf_counter()
+        with _obs.span("knn.search", n_queries=X.shape[0], k=k,
+                       n_train=self.n_samples_fit_) as sp:
+            out, engine = self._search_impl(X, k)
+            sp.set(engine=engine)
+        _obs.ledger.record("knn", "search",
+                           wall_s=time.perf_counter() - t0, queries={},
+                           budget={}, engine=engine,
+                           n_queries=X.shape[0], k=k)
+        return out
+
+    def _search_impl(self, X, k):
+        """((idx, d2), engine) — the dispatch ladder proper."""
         if self.mesh is not None:
             if self.compute_dtype is not None:
                 import warnings as _warnings
@@ -297,12 +314,13 @@ class KNeighborsClassifier(ClassifierMixin, BaseEstimator):
                 self._mesh_state = shard_train_rows(self.mesh, self.X_fit_)
             return knn_indices_sharded(self.mesh, self.X_fit_,
                                        jnp.asarray(X), k,
-                                       presharded=self._mesh_state)
+                                       presharded=self._mesh_state), "mesh"
         host = self._host_search(X, k)
-        if host is None:
-            host = self._tiny_routed_search(X, k)
         if host is not None:
-            return host
+            return host, "host"
+        host = self._tiny_routed_search(X, k)
+        if host is not None:
+            return host, "host:tiny-routed"
         from ..streaming import stream_map_rows, worth_streaming
 
         if worth_streaming(X):
@@ -310,8 +328,9 @@ class KNeighborsClassifier(ClassifierMixin, BaseEstimator):
             # the previous tile's search runs; only (rows, k) candidate
             # lists return per tile, so the query matrix is never
             # device-resident and no single transfer exceeds the tile cap
-            return stream_map_rows(X, lambda t: self._device_search(t, k))
-        return self._device_search(X, k)
+            return stream_map_rows(
+                X, lambda t: self._device_search(t, k)), "streamed-device"
+        return self._device_search(X, k), "device"
 
     def _check_k(self, k):
         """Validate a neighbor count before it reaches ``lax.top_k``

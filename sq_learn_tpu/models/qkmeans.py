@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .. import obs as _obs
 from .._config import as_device_array, with_device_scope
 from ..base import (BaseEstimator, ClusterMixin, TransformerMixin,
                     check_is_fitted, check_n_features)
@@ -1102,10 +1103,43 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         route = (self.mesh is None and self.use_pallas == "auto"
                  and self.compute_dtype is None
                  and route_tiny_fit_to_host(X.size))
-        out, self_backend = dispatch_tiny_routed(
-            route, lambda: self._fit_impl(X, sample_weight))
+        with _obs.span("qkmeans.fit", n_samples=X.shape[0],
+                       n_features=X.shape[1],
+                       n_clusters=self.n_clusters) as sp:
+            out, self_backend = dispatch_tiny_routed(
+                route, lambda: self._fit_impl(X, sample_weight))
+            sp.set(backend=self_backend,
+                   ingest=getattr(self, "ingest_", None),
+                   n_iter=getattr(self, "n_iter_", None))
         self.fit_backend_ = self_backend
+        self._ledger_fit_entry(X)
         return out
+
+    def _ledger_fit_entry(self, X):
+        """Feed the quantum-runtime ledger after a successful fit: the
+        theoretical q-means cost model (reference ``_dmeans.py:1440-1449``)
+        evaluated at this fit's shape, against the fit's measured
+        wall-clock (already in the enclosing span). δ=0 is the classical
+        short-circuit — zero quantum queries by contract."""
+        if not _obs.enabled():
+            return
+        delta = 0.0 if self.delta is None else float(self.delta)
+        if delta == 0.0 or not hasattr(self, "eta_"):
+            _obs.ledger.record("qkmeans", "fit", queries={},
+                               budget={"delta": delta}, short_circuit=True)
+            return
+        try:
+            quantum, classical = self.quantum_runtime_model(*X.shape)
+            _obs.ledger.record(
+                "qkmeans", "fit",
+                queries={"theoretical_quantum_cost": float(quantum.ravel()[0]),
+                         "classical_cost": float(classical)},
+                budget={"delta": delta},
+                mode=self._mode(delta), ipe_q=self.ipe_q,
+                n_iter=getattr(self, "n_iter_", None))
+        except Exception:
+            # the cost model must never break a fit that already succeeded
+            pass
 
     def _fit_impl(self, X, sample_weight):
         """The fit body proper, on whatever backend :meth:`fit` routed to."""
@@ -1599,6 +1633,12 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         check_is_fitted(self, "cluster_centers_")
         X = check_n_features(self, check_array(X))
         delta = 0.0 if delta is None else float(delta)
+        with _obs.span("qkmeans.predict", n_queries=X.shape[0],
+                       delta=delta):
+            return self._predict_impl(X, sample_weight, delta)
+
+    def _predict_impl(self, X, sample_weight, delta):
+        """The predict body proper (``X`` validated, ``delta`` resolved)."""
         mode = self._mode(delta)
         # host fast path, same gating as fit: exact-precision classic/δ
         # inference on the CPU backend skips the XLA dispatch

@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .. import obs as _obs
 from .._config import as_device_array, with_device_scope
 from ..base import (BaseEstimator, ClassifierMixin, check_is_fitted,
                     check_n_features)
@@ -203,6 +204,11 @@ class QLSSVC(ClassifierMixin, BaseEstimator):
         Also precomputes the quantum complexity parameters: α_F (the
         quantum-accessible norm bound √N + γ⁻¹ + ‖X‖_F²), and
         Nu = b² + Σᵢ αᵢ²‖xᵢ‖² entering every β."""
+        with _obs.span("qlssvc.fit", n_samples=len(X),
+                       kernel=self.kernel):
+            return self._fit_impl(X, y)
+
+    def _fit_impl(self, X, y):
         X, y = check_X_y(X, y)
         self.X_ = X
         self.n_features_in_ = X.shape[1]
@@ -235,6 +241,13 @@ class QLSSVC(ClassifierMixin, BaseEstimator):
             # primal hyperplane w = Σ αᵢ xᵢ — one GEMV, not the reference's
             # accumulation loop (_qSVM.py:164-170)
             self.coef_ = np.asarray(alpha @ Xd)
+        # theoretical quantum training cost κ(F)·α_F (_qSVM.py:300-301)
+        # against this fit's measured wall-clock (the enclosing span)
+        _obs.ledger.record(
+            "qlssvc", "fit",
+            queries={"training_complexity": self.cond_ * self.alpha_F_},
+            budget={"train_error": self.train_error},
+            kernel=self.kernel, n_samples=len(X))
         return self
 
     # -- decision pieces ------------------------------------------------------
@@ -294,10 +307,22 @@ class QLSSVC(ClassifierMixin, BaseEstimator):
     def predict(self, X):
         """Quantum-error-model classification (reference ``predict``,
         ``_qSVM.py:178-215``): threshold the noisy P at ½ → ±1."""
-        h = jnp.asarray(self.get_h(X))
-        beta = jnp.asarray(self.get_betas(X))
-        P = self._noisy_P(0.5 * (1.0 - h / beta), h, beta)
-        return np.where(np.asarray(P) <= 0.5, 1.0, -1.0)
+        with _obs.span("qlssvc.predict", n_queries=len(X)):
+            h = jnp.asarray(self.get_h(X))
+            beta = jnp.asarray(self.get_betas(X))
+            P = self._noisy_P(0.5 * (1.0 - h / beta), h, beta)
+            out = np.where(np.asarray(P) <= 0.5, 1.0, -1.0)
+        # one amplitude-estimation call per sample in the inference error
+        # model; the per-sample theoretical cost is κ·β·α_F-scaled
+        # (get_classification_complexity) — too costly to recompute here,
+        # so the ledger carries the call count and the error budget
+        err = (self.absolute_error if self.error_type == "absolute"
+               else self.relative_error)
+        _obs.ledger.record(
+            "qlssvc", "predict",
+            queries={"ae_calls": len(out)},
+            budget={self.error_type + "_error": err})
+        return out
 
     def classical_predict(self, X):
         """Noise-free classification sign(α·K+b) (reference

@@ -33,6 +33,7 @@ Reference latent bugs NOT replicated (SURVEY §2.1):
 import functools
 import math
 import numbers
+import time
 import warnings
 
 import numpy as np
@@ -40,6 +41,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .. import obs as _obs
 from .._config import as_device_array, with_device_scope
 from ..base import (BaseEstimator, TransformerMixin, check_is_fitted,
                     check_n_features)
@@ -453,8 +455,12 @@ class QPCA(TransformerMixin, BaseEstimator):
         # _config.route_tiny_fit_to_host).
         route = (self.mesh is None and self.compute_dtype is None
                  and route_tiny_fit_to_host(X.size))
-        out, backend = dispatch_tiny_routed(route,
-                                            lambda: self._fit_impl(X))
+        with _obs.span("qpca.fit", n_samples=X.shape[0],
+                       n_features=X.shape[1]) as sp:
+            out, backend = dispatch_tiny_routed(route,
+                                                lambda: self._fit_impl(X))
+            sp.set(backend=backend, solver=self._fit_svd_solver,
+                   ingest=getattr(self, "ingest_", None))
         self.fit_backend_ = backend
         return out
 
@@ -848,14 +854,24 @@ class QPCA(TransformerMixin, BaseEstimator):
         framework-wide "zero error budget means classical" convention —
         the reference divides by ε and crashes)."""
         if epsilon == 0:
+            _obs.ledger.record("qpca", "spectral_norm_estimation",
+                               queries={}, budget={"epsilon": 0.0},
+                               short_circuit=True)
             return self.spectral_norm
         frob = self.frob_norm
         n_iterations = max(1, int(np.ceil(np.log(frob / epsilon))))
-        return float(bracket_search_fused(
-            self._next_key(), jnp.asarray(self.singular_values_), frob,
-            eps_scaled=float(epsilon / frob), ae_epsilon=float(delta),
-            n_iterations=n_iterations, n_features=self.n_features_,
-            find_min=False))
+        with _obs.ledger.timed_step(
+                "qpca", "spectral_norm_estimation",
+                queries={"pe_spectrum_queries":
+                         _obs.ledger.phase_estimation_queries(
+                             len(self.singular_values_), n_iterations),
+                         "ae_calls": n_iterations},
+                budget={"epsilon": epsilon, "delta": delta}):
+            return float(bracket_search_fused(
+                self._next_key(), jnp.asarray(self.singular_values_), frob,
+                eps_scaled=float(epsilon / frob), ae_epsilon=float(delta),
+                n_iterations=n_iterations, n_features=self.n_features_,
+                find_min=False))
 
     def condition_number_estimation(self, epsilon, delta):
         """Binary search for σ_min, then κ = σ̂_max/σ̂_min.
@@ -874,16 +890,26 @@ class QPCA(TransformerMixin, BaseEstimator):
         Returns (σ̂_min, κ̂). ε = 0 short-circuits to the exact values.
         """
         if epsilon == 0:
+            _obs.ledger.record("qpca", "condition_number_estimation",
+                               queries={}, budget={"epsilon": 0.0},
+                               short_circuit=True)
             sigma_min = float(self.all_singular_values_[-1])
             return sigma_min, (self.spectral_norm / sigma_min
                                if sigma_min > 0 else np.inf)
         frob = self.frob_norm
         n_iterations = max(1, int(np.ceil(np.log(frob / epsilon))))
-        sigma_min = float(bracket_search_fused(
-            self._next_key(), jnp.asarray(self.all_singular_values_), frob,
-            eps_scaled=float(epsilon / frob), ae_epsilon=float(delta),
-            n_iterations=n_iterations, n_features=self.n_features_,
-            find_min=True))
+        with _obs.ledger.timed_step(
+                "qpca", "condition_number_estimation",
+                queries={"pe_spectrum_queries":
+                         _obs.ledger.phase_estimation_queries(
+                             len(self.all_singular_values_), n_iterations),
+                         "ae_calls": n_iterations},
+                budget={"epsilon": epsilon, "delta": delta}):
+            sigma_min = float(bracket_search_fused(
+                self._next_key(), jnp.asarray(self.all_singular_values_),
+                frob, eps_scaled=float(epsilon / frob),
+                ae_epsilon=float(delta), n_iterations=n_iterations,
+                n_features=self.n_features_, find_min=True))
         cond = self.spectral_norm / sigma_min if sigma_min > 0 else np.inf
         return sigma_min, cond
 
@@ -903,11 +929,17 @@ class QPCA(TransformerMixin, BaseEstimator):
             theta = self.est_theta / self.muA  # est_theta is stored unscaled
         S = jnp.asarray(self.singular_values_)
         # θ is in σ/μ(A) units (what estimate_theta's binary search walks)
-        return float(estimated_mass(
-            self._next_key(), S, jnp.asarray(self.muA, S.dtype),
-            jnp.asarray(theta, S.dtype), jnp.sum(S**2),
-            eps_scaled=float(eps), ae_epsilon=float(eta),
-            n_features=self.n_features_))
+        with _obs.ledger.timed_step(
+                "qpca", "factor_score_ratio_sum",
+                queries=({} if eps == 0 and eta == 0 else
+                         {"pe_spectrum_queries": len(self.singular_values_),
+                          "ae_calls": 1}),
+                budget={"eps": eps, "eta": eta}):
+            return float(estimated_mass(
+                self._next_key(), S, jnp.asarray(self.muA, S.dtype),
+                jnp.asarray(theta, S.dtype), jnp.sum(S**2),
+                eps_scaled=float(eps), ae_epsilon=float(eta),
+                n_features=self.n_features_))
 
     def estimate_theta(self, epsilon, eta, p):
         """Theorem 10 of QADRA (reference ``estimate_theta``,
@@ -934,6 +966,9 @@ class QPCA(TransformerMixin, BaseEstimator):
             # contract; the reference divides by ε and crashes). The
             # reachable masses are the cumulative steps of the retained
             # spectrum; θ = σ at the step closest to p, when within η/2.
+            _obs.ledger.record("qpca", "estimate_theta", queries={},
+                               budget={"epsilon": 0.0, "eta": eta},
+                               short_circuit=True)
             S = np.asarray(self.singular_values_, np.float64)
             cum = np.cumsum(S**2) / np.sum(S**2)
             j = int(np.argmin(np.abs(cum - p)))
@@ -941,10 +976,20 @@ class QPCA(TransformerMixin, BaseEstimator):
                 raise ValueError("The binary search didn't find any value")
             return float(S[j])
         n_iterations = max(1, int(np.ceil(np.log(self.muA / epsilon))))
-        theta, found = theta_search_fused(
-            self._next_key(), jnp.asarray(self.singular_values_), self.muA,
-            float(p), eps_scaled=float(epsilon / self.muA), eta=float(eta),
-            n_iterations=n_iterations, n_features=self.n_features_)
+        # query counts are the n_iterations upper bound: the fused search
+        # exits early on convergence without reporting its iteration count
+        with _obs.ledger.timed_step(
+                "qpca", "estimate_theta",
+                queries={"pe_spectrum_queries":
+                         _obs.ledger.phase_estimation_queries(
+                             len(self.singular_values_), n_iterations),
+                         "ae_calls": n_iterations},
+                budget={"epsilon": epsilon, "eta": eta}, upper_bound=True):
+            theta, found = theta_search_fused(
+                self._next_key(), jnp.asarray(self.singular_values_),
+                self.muA, float(p), eps_scaled=float(epsilon / self.muA),
+                eta=float(eta), n_iterations=n_iterations,
+                n_features=self.n_features_)
         if not bool(found):
             raise ValueError("The binary search didn't find any value")
         return float(theta)
@@ -954,8 +999,17 @@ class QPCA(TransformerMixin, BaseEstimator):
 
         One batched consistent-PE pass over the spectrum, host-side
         selection (the selected count is data-dependent — jit-hostile by
-        nature), then one vmapped tomography call per side (U and V)."""
+        nature), then one vmapped tomography call per side (U and V).
+
+        Ledger accounting: one PE spectrum pass (ε > 0) plus Theorem-11
+        tomography shots — 2·N(d)·k per side with d the vector dimension
+        (right: n_features, left: n_samples) and k the selected count;
+        δ = 0 short-circuits to the exact vectors and records 0 shots."""
         self._require_mu()
+        _step = _obs.ledger.timed_step(
+            "qpca", "topk_extract" if top else "leastk_extract",
+            budget={"eps": eps, "delta": delta})
+        _step.__enter__()
         S = np.asarray(self.singular_values_)
         if not top:
             # least-k only considers numerically nonzero σ (the reference
@@ -985,6 +1039,15 @@ class QPCA(TransformerMixin, BaseEstimator):
         else:
             right_est, left_est = right, left
 
+        _step.set_queries(
+            pe_spectrum_queries=0 if eps == 0 else len(S),
+            tomography_shots=(
+                _obs.ledger.tomography_shot_count(k, right.shape[1], delta,
+                                                  norm)
+                + _obs.ledger.tomography_shot_count(k, left.shape[1], delta,
+                                                    norm)) if k else 0)
+        _step.attrs["selected_k"] = k
+        _step.__exit__(None, None, None)
         fs = sv_estimation**2 / (self.n_samples_ - 1)
         fs_ratio = sv_estimation**2 / self.frob_norm**2
         return (right_est, left_est, sv_estimation, fs, fs_ratio,

@@ -9,9 +9,12 @@ randomized_svd` (one jit'd kernel), with a full-SVD fallback for
 the equivalent here and is exact rather than iterative).
 """
 
+import time
+
 import numpy as np
 import jax.numpy as jnp
 
+from .. import obs as _obs
 from .._config import as_device_array, with_device_scope
 from ..base import (BaseEstimator, TransformerMixin, check_is_fitted,
                     check_n_features)
@@ -77,6 +80,22 @@ class TruncatedSVD(TransformerMixin, BaseEstimator):
                 f"{self.ingest!r}")
         streamed = self._resolve_ingest(X)
         self.ingest_ = "streamed" if streamed else "monolithic"
+        _t0 = time.perf_counter()
+        _sp = _obs.span("truncated_svd.fit_transform", n_samples=n_samples,
+                        n_features=n_features, k=k,
+                        algorithm=self.algorithm, ingest=self.ingest_)
+        with _sp:
+            out = self._fit_transform_impl(X, n_samples, n_features, k,
+                                           streamed)
+        # classical estimator: the ledger entry carries the wall-clock
+        # baseline the quantum estimators' query counts trade against
+        _obs.ledger.record(
+            "truncated_svd", "fit", wall_s=time.perf_counter() - _t0,
+            queries={}, budget={}, algorithm=self.algorithm,
+            ingest=self.ingest_)
+        return out
+
+    def _fit_transform_impl(self, X, n_samples, n_features, k, streamed):
         if self.mesh is not None:
             # The mesh has one engine: the sample-sharded Gram-route SVD
             # (placement belongs to the sharding, not as_device_array).

@@ -1,0 +1,53 @@
+"""Run-scoped observability: spans, counters/gauges, the quantum-runtime
+ledger, the retracing watchdog, and the device-health probe.
+
+Quickstart::
+
+    from sq_learn_tpu import obs
+
+    obs.enable("/tmp/run.jsonl")          # or export SQ_OBS=1
+    with obs.span("my.step", n=1000):
+        ...
+    obs.ledger.record("qpca", "tomography",
+                      queries={"tomography_shots": 1.2e7},
+                      budget={"delta": 0.1}, wall_s=0.8)
+    print(obs.ledger.totals())
+    print(obs.watchdog.report())
+    obs.disable()                          # flush the sink
+
+Env knobs: ``SQ_OBS=1`` auto-enables with a JSONL sink at ``SQ_OBS_PATH``
+(default ``sq_obs.jsonl``); ``SQ_OBS_STRICT=1`` makes watchdog budget
+violations raise instead of warn. Full docs: ``docs/observability.md``.
+"""
+
+from . import ledger, probe, schema
+from .recorder import (NULL_SPAN, Recorder, counter_add, disable, enable,
+                       enabled, gauge, get_recorder, record_span, snapshot,
+                       span)
+from .watchdog import (RetracingError, RetracingWarning, RetracingWatchdog,
+                       watchdog)
+
+#: convenience alias: obs.ledger_record(...) == obs.ledger.record(...)
+ledger_record = ledger.record
+
+__all__ = [
+    "NULL_SPAN",
+    "Recorder",
+    "RetracingError",
+    "RetracingWarning",
+    "RetracingWatchdog",
+    "counter_add",
+    "disable",
+    "enable",
+    "enabled",
+    "gauge",
+    "get_recorder",
+    "ledger",
+    "ledger_record",
+    "probe",
+    "record_span",
+    "schema",
+    "snapshot",
+    "span",
+    "watchdog",
+]

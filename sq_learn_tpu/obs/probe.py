@@ -1,0 +1,77 @@
+"""Device-health probe: the subprocess-with-timeout accelerator check,
+measured.
+
+The axon TPU tunnel can hang ``jax.devices()`` indefinitely (CLAUDE.md);
+the known escape is probing backend init in a throwaway subprocess with a
+timeout. That escape was duplicated across bench scripts with no record of
+what it found — yet probe latency and outcome are exactly the fleet-health
+signals the round-5 failures (judge-host segfault, relay wedges) showed we
+were flying blind on. This module is the one implementation, and it records
+every probe as a 'probe' JSONL record plus ``probe.latency_s`` /
+``probe.ok`` gauges when a recorder is active.
+
+Outcomes:
+
+- ``"ok"``        — the subprocess initialized the backend within the
+  timeout (a healthy tunnel answers in ~5–15 s).
+- ``"timeout"``   — the subprocess hit the timeout: the wedge signature
+  (every observed wedge lasted hours; the timeout is pure stall).
+- ``"error"``     — backend init failed fast (version skew, no device).
+- ``"cpu"``       — the platform under test is the host CPU; no probe
+  subprocess is needed (nothing to wedge).
+- ``"skipped"``   — no platform configured (jax auto-detect, local only).
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+#: last probe result in this process (outcome, latency_s, platform) —
+#: readable even when no recorder was active at probe time
+last_probe = None
+
+
+def _record(outcome, latency_s, platform):
+    global last_probe
+    last_probe = {"outcome": outcome, "latency_s": round(latency_s, 3),
+                  "platform": platform}
+    from . import recorder
+
+    rec = recorder.get_recorder()
+    if rec is not None:
+        rec.record(dict(last_probe, type="probe"), kind="probe_events")
+        recorder.gauge("probe.latency_s", round(latency_s, 3))
+        # "skipped"/"cpu" are healthy outcomes: nothing to probe ≠ failure
+        recorder.gauge("probe.ok", outcome in ("ok", "cpu", "skipped"))
+    return last_probe
+
+
+def probe_device(timeout_s=60, platform=None):
+    """Initialize the configured JAX backend in a throwaway subprocess and
+    report (never raise) the outcome with its measured latency.
+
+    ``platform`` defaults to ``JAX_PLATFORMS``. CPU platforms and empty
+    specs record without spawning (nothing to wedge); otherwise the
+    subprocess runs ``import jax; jax.devices()`` under ``timeout_s``.
+    The 60 s default matches the bench contract: a healthy tunnel answers
+    in ~5–15 s and a wedged one never does, so longer patience is pure
+    stall (CLAUDE.md). Returns ``{"outcome", "latency_s", "platform"}``.
+    """
+    if platform is None:
+        platform = os.environ.get("JAX_PLATFORMS", "")
+    if platform.split(",")[0].strip() == "cpu":
+        return _record("cpu", 0.0, platform)
+    if platform == "":
+        return _record("skipped", 0.0, platform)
+    t0 = time.perf_counter()
+    try:
+        subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s, check=True, capture_output=True)
+        outcome = "ok"
+    except subprocess.TimeoutExpired:
+        outcome = "timeout"
+    except (subprocess.CalledProcessError, OSError):
+        outcome = "error"
+    return _record(outcome, time.perf_counter() - t0, platform)

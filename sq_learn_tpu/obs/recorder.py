@@ -1,0 +1,321 @@
+"""Run-scoped observability recorder: spans, counters, gauges, JSONL sink.
+
+The paper's thesis makes ε/δ *runtime* parameters, so stating its
+accuracy-vs-runtime trade-off requires correlating measured wall-clock with
+theoretical quantum query counts per run — and the production north star
+(ROADMAP) requires knowing where wall-clock goes at all. This module is the
+spine: an in-memory :class:`Recorder` that every instrumented surface
+(streaming engine, estimator fits, mesh kernels, bench scripts, the driver
+gate) writes through, with an optional append-only JSONL sink.
+
+Design constraints, in order:
+
+1. **Near-zero overhead when disabled.** ``SQ_OBS`` unset means every
+   instrumentation point is one module-global read: :func:`span` returns a
+   shared no-op context manager, :func:`counter_add`/:func:`gauge` return
+   immediately. Nothing allocates, nothing formats, nothing touches jax.
+2. **Run-scoped.** :func:`enable` starts a fresh run (empty recorder, reset
+   watchdog/ledger state); :func:`disable` closes the sink. ``SQ_OBS=1``
+   auto-enables at import with the sink at ``SQ_OBS_PATH`` (default
+   ``sq_obs.jsonl`` in the CWD).
+3. **Honest timing.** Spans record host wall-clock between enter and exit.
+   JAX dispatch is asynchronous, so a span around an unsynced dispatch
+   measures dispatch, not compute; pass ``sync=`` (or call ``.sync(x)``)
+   to block on device values at exit, and the record carries
+   ``synced: true`` only then. Instrumented fit surfaces return host
+   arrays, so their spans are synced by construction.
+
+JSONL schema: one JSON object per line, every line carrying
+``{"v": 1, "ts": <unix seconds>, "type": <record type>}`` plus per-type
+fields — see :mod:`sq_learn_tpu.obs.schema` (the validator) and
+``docs/observability.md`` (the prose).
+"""
+
+import json
+import os
+import threading
+import time
+
+SCHEMA_VERSION = 1
+
+#: default sink path when SQ_OBS=1 and SQ_OBS_PATH is unset
+DEFAULT_PATH = "sq_obs.jsonl"
+
+_lock = threading.RLock()
+_tls = threading.local()
+
+#: the active recorder, or None when observability is off. Module-global so
+#: the disabled fast path is a single attribute read.
+_active = None
+
+
+class _NullSpan:
+    """The disabled-mode span: a shared, stateless, no-op context manager."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **attrs):
+        return self
+
+    def sync(self, value):
+        return value
+
+
+NULL_SPAN = _NullSpan()
+
+
+class Span:
+    """One timed scope. Created by :func:`span`; closes into a 'span'
+    record with nesting metadata (depth, parent seq) from a per-thread
+    stack."""
+
+    __slots__ = ("_rec", "name", "attrs", "_sync", "_t0", "_seq", "_parent",
+                 "_depth", "_synced")
+
+    def __init__(self, rec, name, sync, attrs):
+        self._rec = rec
+        self.name = name
+        self.attrs = attrs
+        self._sync = sync
+        self._synced = False
+
+    def set(self, **attrs):
+        """Attach attributes discovered mid-scope (resolved solver, engine,
+        byte counts); they land in the closed record."""
+        self.attrs.update(attrs)
+        return self
+
+    def sync(self, value):
+        """Block on ``value`` at exit (device sync) and return it — chains
+        into expressions: ``out = sp.sync(step(...))``."""
+        self._sync = value
+        return value
+
+    def __enter__(self):
+        stack = getattr(_tls, "span_stack", None)
+        if stack is None:
+            stack = _tls.span_stack = []
+        self._parent = stack[-1]._seq if stack else None
+        self._depth = len(stack)
+        self._seq = self._rec._next_seq()
+        stack.append(self)
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._sync is not None:
+            import jax
+
+            jax.block_until_ready(self._sync)
+            self._synced = True
+        dur = time.perf_counter() - self._t0
+        stack = getattr(_tls, "span_stack", ())
+        if stack and stack[-1] is self:
+            stack.pop()
+        rec = {"type": "span", "name": self.name, "seq": self._seq,
+               "dur_s": round(dur, 6), "depth": self._depth,
+               "parent": self._parent, "synced": self._synced}
+        if exc_type is not None:
+            rec["error"] = exc_type.__name__
+        if self.attrs:
+            rec["attrs"] = _jsonable(self.attrs)
+        self._rec.record(rec, kind="spans")
+        return False
+
+
+def _jsonable(obj):
+    """Best-effort conversion of attr values to JSON-serializable types;
+    observability must never crash the instrumented computation."""
+    if isinstance(obj, dict):
+        return {str(k): _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v) for v in obj]
+    if isinstance(obj, (str, int, float, bool)) or obj is None:
+        return obj
+    try:
+        return float(obj)  # numpy / jax scalars
+    except Exception:
+        return repr(obj)
+
+
+class Recorder:
+    """In-memory store of one run's records, with an optional JSONL sink.
+
+    Public views: ``spans``, ``counters``, ``gauges``, ``ledger_entries``,
+    ``watchdog_events``, ``probe_events`` — all plain Python containers,
+    safe to read at any point in the run.
+    """
+
+    def __init__(self, path=None):
+        self.spans = []
+        self.counters = {}
+        self.gauges = {}
+        self.gauge_events = []
+        self.ledger_entries = []
+        self.watchdog_events = []
+        self.probe_events = []
+        self.path = path
+        self._seq = 0
+        self._sink = None
+        if path:
+            self._sink = open(path, "a", buffering=1)
+            self.record({"type": "meta", "pid": os.getpid(),
+                         "schema": SCHEMA_VERSION}, kind=None)
+
+    def _next_seq(self):
+        with _lock:
+            self._seq += 1
+            return self._seq
+
+    def record(self, rec, kind=None):
+        """Store ``rec`` in-memory (under ``kind``) and append it to the
+        sink as one JSON line."""
+        rec.setdefault("v", SCHEMA_VERSION)
+        rec.setdefault("ts", round(time.time(), 3))
+        with _lock:
+            if kind is not None:
+                getattr(self, kind).append(rec)
+            if self._sink is not None:
+                try:
+                    self._sink.write(json.dumps(rec) + "\n")
+                except Exception:
+                    pass  # a full disk must not kill the fit
+
+    def close(self):
+        with _lock:
+            if self._sink is not None:
+                try:
+                    self._sink.close()
+                finally:
+                    self._sink = None
+
+
+# ---------------------------------------------------------------------------
+# Module-level API (the instrumentation surface)
+# ---------------------------------------------------------------------------
+
+
+def enabled():
+    """True when a recorder is active (``SQ_OBS=1`` or :func:`enable`)."""
+    return _active is not None
+
+
+def get_recorder():
+    """The active :class:`Recorder`, or None when observability is off."""
+    return _active
+
+
+def enable(path=None, reset_watchdog=True):
+    """Start a fresh observability run.
+
+    ``path`` opens a JSONL sink (None = in-memory only — the test/default
+    programmatic mode). Resets the retracing watchdog so compile counts are
+    scoped to this run (compiled-cache entries from before the run never
+    count against a budget declared inside it).
+    """
+    global _active
+    with _lock:
+        disable()
+        _active = Recorder(path)
+        if reset_watchdog:
+            from .watchdog import watchdog
+
+            watchdog.reset()
+    return _active
+
+
+def disable():
+    """Close the current run (flushes the sink). Safe to call when off."""
+    global _active
+    with _lock:
+        rec = _active
+        _active = None
+        if rec is not None:
+            rec.close()
+        return rec
+
+
+def span(name, sync=None, **attrs):
+    """Open a named timed scope. Disabled mode returns a shared no-op
+    context manager (one global read, zero allocation)."""
+    rec = _active
+    if rec is None:
+        return NULL_SPAN
+    return Span(rec, name, sync, attrs)
+
+
+def record_span(name, dur_s, **attrs):
+    """Record an externally-timed span (e.g. :class:`utils.profiling.Timer`
+    scopes, which own their device sync)."""
+    rec = _active
+    if rec is None:
+        return
+    rec.record({"type": "span", "name": name, "seq": rec._next_seq(),
+                "dur_s": round(float(dur_s), 6), "depth": 0, "parent": None,
+                "synced": True, "attrs": _jsonable(attrs) if attrs else {}},
+               kind="spans")
+
+
+def counter_add(name, delta):
+    """Add ``delta`` to a cumulative counter (e.g. transfer bytes)."""
+    rec = _active
+    if rec is None:
+        return
+    with _lock:
+        val = rec.counters.get(name, 0) + delta
+        rec.counters[name] = val
+    rec.record({"type": "counter", "name": name, "value": val,
+                "delta": delta})
+
+
+def gauge(name, value, **attrs):
+    """Set a point-in-time gauge (e.g. probe latency, MFU)."""
+    rec = _active
+    if rec is None:
+        return
+    with _lock:
+        rec.gauges[name] = value
+    out = {"type": "gauge", "name": name, "value": _jsonable(value)}
+    if attrs:
+        out["attrs"] = _jsonable(attrs)
+    rec.record(out, kind="gauge_events")
+
+
+def snapshot():
+    """One-dict summary for bench records: compile/transfer/probe totals.
+
+    Returns None when disabled — callers embed the dict only when a run is
+    active, so headline JSON lines keep their pre-obs schema otherwise.
+    """
+    rec = _active
+    if rec is None:
+        return None
+    from .watchdog import watchdog
+
+    report = watchdog.report()
+    compile_count = sum(s["compiles"] for s in report.values())
+    probe_ms = None
+    if rec.probe_events:
+        probe_ms = round(rec.probe_events[-1].get("latency_s", 0.0) * 1e3, 3)
+    return {
+        "compile_count": int(compile_count),
+        "total_transfer_bytes": int(
+            rec.counters.get("streaming.transfer_bytes", 0)),
+        "probe_ms": probe_ms,
+        "spans": len(rec.spans),
+        "ledger_entries": len(rec.ledger_entries),
+        "watchdog_over_budget": sorted(
+            site for site, s in report.items() if s["over_budget"]),
+    }
+
+
+# SQ_OBS=1 auto-enables at first import, sink at SQ_OBS_PATH (CLAUDE.md
+# env knobs). Programmatic enable()/disable() always works regardless.
+if os.environ.get("SQ_OBS") == "1":
+    enable(os.environ.get("SQ_OBS_PATH", DEFAULT_PATH))

@@ -1,0 +1,146 @@
+"""JSONL schema for obs records, and a dependency-free validator.
+
+Every line of an obs JSONL file is one JSON object carrying the common
+envelope ``{"v": 1, "ts": <unix seconds>, "type": <t>}`` plus per-type
+required fields:
+
+=========  ==============================================================
+type       required fields (beyond the envelope)
+=========  ==============================================================
+meta       pid (int), schema (int)
+span       name (str), seq (int), dur_s (number ≥ 0), depth (int ≥ 0),
+           parent (int | null), synced (bool); optional attrs (object),
+           error (str)
+counter    name (str), value (number), delta (number)
+gauge      name (str), value (any JSON scalar); optional attrs (object)
+ledger     estimator (str), step (str), queries (object: str → number),
+           budget (object: str → number); optional wall_s (number ≥ 0),
+           attrs (object)
+watchdog   site (str), compiles (int ≥ 0), budget (int | null),
+           over_budget (bool)
+probe      outcome (str ∈ {ok, timeout, error, cpu, skipped}),
+           latency_s (number ≥ 0), platform (str)
+=========  ==============================================================
+
+The validator is hand-rolled (no jsonschema in the image — CLAUDE.md: no
+installs) and is the contract ``make obs-smoke``, the bench suite, and the
+tests all check against.
+"""
+
+import json
+
+from .recorder import SCHEMA_VERSION
+
+_NUM = (int, float)
+
+_PROBE_OUTCOMES = {"ok", "timeout", "error", "cpu", "skipped"}
+
+
+def _check(cond, errors, msg):
+    if not cond:
+        errors.append(msg)
+
+
+def validate_record(rec):
+    """Validate one decoded record; returns a list of error strings
+    (empty = valid)."""
+    errors = []
+    if not isinstance(rec, dict):
+        return ["record is not an object"]
+    _check(rec.get("v") == SCHEMA_VERSION, errors,
+           f"v must be {SCHEMA_VERSION}, got {rec.get('v')!r}")
+    _check(isinstance(rec.get("ts"), _NUM), errors, "ts must be numeric")
+    t = rec.get("type")
+    if t == "meta":
+        _check(isinstance(rec.get("pid"), int), errors, "meta.pid int")
+        _check(isinstance(rec.get("schema"), int), errors, "meta.schema int")
+    elif t == "span":
+        _check(isinstance(rec.get("name"), str), errors, "span.name str")
+        _check(isinstance(rec.get("seq"), int), errors, "span.seq int")
+        _check(isinstance(rec.get("dur_s"), _NUM) and rec["dur_s"] >= 0,
+               errors, "span.dur_s non-negative number")
+        _check(isinstance(rec.get("depth"), int) and rec["depth"] >= 0,
+               errors, "span.depth non-negative int")
+        _check(rec.get("parent") is None or isinstance(rec["parent"], int),
+               errors, "span.parent int or null")
+        _check(isinstance(rec.get("synced"), bool), errors,
+               "span.synced bool")
+        _check(isinstance(rec.get("attrs", {}), dict), errors,
+               "span.attrs object")
+    elif t == "counter":
+        _check(isinstance(rec.get("name"), str), errors, "counter.name str")
+        _check(isinstance(rec.get("value"), _NUM), errors,
+               "counter.value number")
+        _check(isinstance(rec.get("delta"), _NUM), errors,
+               "counter.delta number")
+    elif t == "gauge":
+        _check(isinstance(rec.get("name"), str), errors, "gauge.name str")
+        _check("value" in rec, errors, "gauge.value required")
+    elif t == "ledger":
+        _check(isinstance(rec.get("estimator"), str), errors,
+               "ledger.estimator str")
+        _check(isinstance(rec.get("step"), str), errors, "ledger.step str")
+        for field in ("queries", "budget"):
+            obj = rec.get(field)
+            ok = isinstance(obj, dict) and all(
+                isinstance(k, str) and isinstance(v, _NUM)
+                for k, v in obj.items())
+            _check(ok, errors, f"ledger.{field} object of str → number")
+        if "wall_s" in rec:
+            _check(isinstance(rec["wall_s"], _NUM) and rec["wall_s"] >= 0,
+                   errors, "ledger.wall_s non-negative number")
+    elif t == "watchdog":
+        _check(isinstance(rec.get("site"), str), errors, "watchdog.site str")
+        _check(isinstance(rec.get("compiles"), int) and rec["compiles"] >= 0,
+               errors, "watchdog.compiles non-negative int")
+        _check(rec.get("budget") is None or isinstance(rec["budget"], int),
+               errors, "watchdog.budget int or null")
+        _check(isinstance(rec.get("over_budget"), bool), errors,
+               "watchdog.over_budget bool")
+    elif t == "probe":
+        _check(rec.get("outcome") in _PROBE_OUTCOMES, errors,
+               f"probe.outcome in {sorted(_PROBE_OUTCOMES)}")
+        _check(isinstance(rec.get("latency_s"), _NUM)
+               and rec["latency_s"] >= 0, errors,
+               "probe.latency_s non-negative number")
+        _check(isinstance(rec.get("platform"), str), errors,
+               "probe.platform str")
+    else:
+        errors.append(f"unknown record type {t!r}")
+    return errors
+
+
+def validate_jsonl(path, max_errors=20):
+    """Validate every line of an obs JSONL file.
+
+    Returns a summary dict {lines, by_type, errors} where ``errors`` is a
+    list of "line N: message" strings (truncated at ``max_errors``). An
+    empty or missing file is an error — a run that recorded nothing is a
+    broken run, not a valid one.
+    """
+    lines = 0
+    by_type = {}
+    errors = []
+    try:
+        fh = open(path)
+    except OSError as exc:
+        return {"lines": 0, "by_type": {}, "errors": [str(exc)]}
+    with fh:
+        for i, raw in enumerate(fh, 1):
+            raw = raw.strip()
+            if not raw:
+                continue
+            lines += 1
+            try:
+                rec = json.loads(raw)
+            except ValueError as exc:
+                errors.append(f"line {i}: not JSON ({exc})")
+                continue
+            for msg in validate_record(rec):
+                if len(errors) < max_errors:
+                    errors.append(f"line {i}: {msg}")
+            t = rec.get("type") if isinstance(rec, dict) else None
+            by_type[t] = by_type.get(t, 0) + 1
+    if lines == 0:
+        errors.append("file has no records")
+    return {"lines": lines, "by_type": by_type, "errors": errors}

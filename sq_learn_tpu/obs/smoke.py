@@ -1,0 +1,80 @@
+"""Observability smoke: tiny instrumented fits + JSONL schema validation.
+
+``make obs-smoke`` runs this module: a streamed qPCA Gram fit (streaming
+counters + retracing watchdog) and a quantum top-k extraction (nonzero
+tomography shots in the ledger) under an active recorder, then validates
+the emitted JSONL against :mod:`sq_learn_tpu.obs.schema` and asserts the
+run artifact carries the signals the layer exists for. Exit code 0 =
+contract holds; 1 = schema or content violation (printed).
+
+Pins the CPU backend in-process first (the documented wedge-proof
+override, CLAUDE.md) — a health check must never hang on the thing whose
+health it reports.
+"""
+
+import json
+import os
+import sys
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from . import disable, enable, ledger, watchdog
+    from .schema import validate_jsonl
+
+    path = os.environ.get("SQ_OBS_PATH", "/tmp/sq_obs_smoke.jsonl")
+    open(path, "w").close()  # truncate any previous smoke artifact
+    enable(path)  # fresh run: resets the watchdog, reopens the sink
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(2048, 64)).astype(np.float32)
+
+    from ..models import QPCA
+
+    # streamed Gram-route fit: small tile cap forces a real tile walk
+    os.environ["SQ_STREAM_TILE_BYTES"] = str(64 * 1024)
+    try:
+        QPCA(n_components=4, svd_solver="full", random_state=0,
+             ingest="streamed").fit(X)
+    finally:
+        os.environ.pop("SQ_STREAM_TILE_BYTES", None)
+
+    # quantum extraction: tomography shots + PE queries land in the ledger
+    QPCA(n_components=4, svd_solver="full", random_state=0).fit(
+        X[:256], estimate_all=True, theta_major=1.0, eps=0.1, delta=0.5,
+        true_tomography=False)
+
+    report = watchdog.report()
+    totals = ledger.totals()
+    rec = disable()
+
+    summary = validate_jsonl(path)
+    failures = list(summary["errors"])
+    if totals["queries"].get("tomography_shots", 0) <= 0:
+        failures.append("ledger has no tomography shots")
+    if rec.counters.get("streaming.transfer_bytes", 0) <= 0:
+        failures.append("no streamed transfer bytes recorded")
+    gram = report.get("streaming.gram_colsum")
+    if gram is None:
+        failures.append("watchdog never observed the streamed Gram kernel")
+    elif gram["over_budget"]:
+        failures.append(f"streamed Gram kernel over compile budget: {gram}")
+
+    print(json.dumps({
+        "obs_smoke": "fail" if failures else "ok",
+        "path": path,
+        "jsonl": summary["by_type"],
+        "ledger_totals": totals,
+        "watchdog": report,
+        "errors": failures,
+    }))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

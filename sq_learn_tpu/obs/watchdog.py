@@ -1,0 +1,159 @@
+"""Retracing watchdog: per-call-site jit compile accounting with budgets.
+
+XLA recompiles silently — a shape leak turns "compile once, run many" into
+"compile every call", and nothing in the program text changes. PR 1's
+streaming engine promises ≤1 compile per (bucket, dtype); this module turns
+that class of promise into an enforced invariant: each call site registers
+its jitted kernel, declares the signatures it *expects* to mint compiles
+(or a flat integer budget), and :meth:`RetracingWatchdog.observe` compares
+the kernel's actual compile-cache growth against the budget — warning
+(:class:`RetracingWarning`) or, under ``SQ_OBS_STRICT=1``, raising
+(:class:`RetracingError`) on the first excess compile.
+
+Compile counts are read from the jitted function's ``_cache_size()`` (the
+same hook ``streaming.kernel_cache_sizes`` uses) and are **baselined at
+registration**: entries compiled before a site was tracked (earlier tests
+in the same process, warm-up phases outside the run) never count against a
+budget declared inside the run. :func:`~sq_learn_tpu.obs.recorder.enable`
+resets the whole watchdog, scoping counts to the observability run.
+
+The watchdog is usable standalone (no recorder needed — budgets are an
+enforcement tool, not a metric); when a recorder is active, every
+observation also lands as a 'watchdog' JSONL record.
+"""
+
+import os
+import threading
+import warnings
+
+
+class RetracingWarning(RuntimeWarning):
+    """A call site recompiled beyond its declared budget."""
+
+
+class RetracingError(RuntimeError):
+    """Strict-mode (``SQ_OBS_STRICT=1``) form of :class:`RetracingWarning`."""
+
+
+def _cache_size(fn):
+    """Compile-cache entry count of a jitted callable, or None when the
+    callable exposes no cache (not jitted / future jax API drift)."""
+    try:
+        return int(fn._cache_size())
+    except Exception:
+        return None
+
+
+class RetracingWatchdog:
+    """Per-site compile accounting. Sites are plain strings (convention:
+    ``"<module>.<kernel>"``); state per site is the tracked callable, a
+    baseline cache size, an allowed-signature set, and an optional flat
+    budget."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._sites = {}
+
+    def reset(self):
+        with self._lock:
+            self._sites.clear()
+
+    def track(self, site, fn, budget=None):
+        """Register ``fn`` under ``site``. First registration snapshots the
+        cache baseline; re-registration updates the budget/fn only (the
+        baseline is the run's anchor and must not move)."""
+        with self._lock:
+            st = self._sites.get(site)
+            if st is None:
+                st = {"fn": fn, "base": _cache_size(fn) or 0, "budget": budget,
+                      "signatures": set(), "compiles": 0, "observations": 0,
+                      "over_budget": False}
+                self._sites[site] = st
+            else:
+                st["fn"] = fn
+                if budget is not None:
+                    st["budget"] = budget
+            return st
+
+    def allow(self, site, signature):
+        """Declare one expected compile signature (e.g. a streaming
+        ``(bucket_rows, dtype)`` pair). With no flat budget set, the
+        budget is the number of distinct allowed signatures."""
+        with self._lock:
+            st = self._sites.get(site)
+            if st is None:
+                raise KeyError(f"watchdog site {site!r} is not tracked")
+            st["signatures"].add(signature)
+
+    def budget_of(self, site):
+        with self._lock:
+            st = self._sites[site]
+            if st["budget"] is not None:
+                return st["budget"]
+            return len(st["signatures"]) or None
+
+    def observe(self, site):
+        """Read the site's compile count (cache entries since baseline),
+        enforce the budget, and record the observation. Returns the compile
+        count, or None when the tracked callable exposes no cache."""
+        with self._lock:
+            st = self._sites.get(site)
+            if st is None:
+                raise KeyError(f"watchdog site {site!r} is not tracked")
+            size = _cache_size(st["fn"])
+            if size is None:
+                return None
+            compiles = max(0, size - st["base"])
+            st["compiles"] = compiles
+            st["observations"] += 1
+            budget = (st["budget"] if st["budget"] is not None
+                      else (len(st["signatures"]) or None))
+            over = budget is not None and compiles > budget
+            newly_over = over and not st["over_budget"]
+            st["over_budget"] = over
+        from . import recorder
+
+        rec = recorder.get_recorder()
+        if rec is not None:
+            rec.record({"type": "watchdog", "site": site,
+                        "compiles": compiles, "budget": budget,
+                        "over_budget": over}, kind="watchdog_events")
+        if newly_over:
+            msg = (f"retracing watchdog: call site {site!r} has {compiles} "
+                   f"jit compiles, over its declared budget of {budget} — "
+                   "a shape/dtype is leaking into the traced signature")
+            if os.environ.get("SQ_OBS_STRICT") == "1":
+                raise RetracingError(msg)
+            warnings.warn(msg, RetracingWarning, stacklevel=2)
+        return compiles
+
+    def watch(self, site, fn, budget=None):
+        """Wrap a jitted callable so every call is followed by an
+        :meth:`observe` — the hammer for suspected retracing hot spots
+        (per-call overhead: one cache-size read)."""
+        import functools
+
+        self.track(site, fn, budget=budget)
+
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            out = fn(*args, **kwargs)
+            self.observe(site)
+            return out
+
+        return wrapped
+
+    def report(self):
+        """{site: {compiles, budget, observations, over_budget}} snapshot."""
+        with self._lock:
+            return {
+                site: {"compiles": st["compiles"],
+                       "budget": (st["budget"] if st["budget"] is not None
+                                  else (len(st["signatures"]) or None)),
+                       "observations": st["observations"],
+                       "over_budget": st["over_budget"]}
+                for site, st in self._sites.items()}
+
+
+#: the process-wide watchdog every instrumented site shares
+watchdog = RetracingWatchdog()

@@ -56,13 +56,19 @@ def lloyd_single_sharded(mesh, key, X, weights, centers_init, x_sq_norms,
     Returns (labels, inertia, centers, n_iter, history) with labels trimmed
     back to the original length.
     """
-    n_dev = mesh.devices.size
-    X, n = pad_to_multiple(X, n_dev)
-    weights, _ = pad_to_multiple(weights, n_dev)
-    x_sq_norms, _ = pad_to_multiple(x_sq_norms, n_dev)
+    from .. import obs as _obs
 
-    run = _sharded_lloyd(mesh, tuple(sorted(static.items())))
-    labels, inertia, centers, n_iter, history = run(
-        key, X, weights, centers_init, x_sq_norms
-    )
+    n_dev = mesh.devices.size
+    with _obs.span("parallel.lloyd.single_sharded", n_devices=int(n_dev),
+                   n_samples=int(X.shape[0]),
+                   mode=static.get("mode")) as sp:
+        X, n = pad_to_multiple(X, n_dev)
+        weights, _ = pad_to_multiple(weights, n_dev)
+        x_sq_norms, _ = pad_to_multiple(x_sq_norms, n_dev)
+
+        run = _sharded_lloyd(mesh, tuple(sorted(static.items())))
+        labels, inertia, centers, n_iter, history = run(
+            key, X, weights, centers_init, x_sq_norms
+        )
+        sp.sync(centers)
     return labels[:n], inertia, centers, n_iter, history

@@ -100,30 +100,36 @@ def knn_indices_sharded(mesh, X_train, X_query, k, presharded=None,
     ``_check_k`` contract). Pass ``presharded`` from
     :func:`shard_train_rows` to skip the per-call corpus placement.
     """
+    from .. import obs as _obs
+
     if presharded is None:
         presharded = shard_train_rows(mesh, X_train)
     Xp, mask, per, n = presharded
     X_query = jnp.asarray(X_query)
     nq = X_query.shape[0]
-    # a shard can contribute at most `per` candidates; with k <= n the
-    # union of shards always holds k real rows
-    k_local = min(k, per)
-    # query blocking, same discipline as the single-device knn_indices:
-    # tiny predicts don't pay a full 4096-row GEMM, huge ones never
-    # materialize (n_q, per_shard). Small sizes quantize to power-of-two
-    # buckets (min 8 = one lane group) so the compile cache above sees a
-    # handful of block shapes, not one per distinct query count.
-    if nq < block:
-        bucket = 8
-        while bucket < nq:
-            bucket <<= 1
-        block = min(block, bucket)
-    qpad = (-nq) % block
-    Qp = jnp.pad(X_query, ((0, qpad), (0, 0)))
-    qsq = jnp.sum(Qp * Qp, axis=1)
-    d2_cand, idx_cand = _sharded_candidates(mesh, k_local, per, block)(
-        Xp, mask, Qp, qsq)
-    # replicated merge over n_dev * k_local candidates per query
-    neg, pos = lax.top_k(-d2_cand, k)
-    idx = jnp.take_along_axis(idx_cand, pos, axis=1)
+    with _obs.span("parallel.neighbors.knn_indices_sharded",
+                   n_devices=int(mesh.devices.size), n_queries=int(nq),
+                   k=int(k)) as sp:
+        # a shard can contribute at most `per` candidates; with k <= n the
+        # union of shards always holds k real rows
+        k_local = min(k, per)
+        # query blocking, same discipline as the single-device knn_indices:
+        # tiny predicts don't pay a full 4096-row GEMM, huge ones never
+        # materialize (n_q, per_shard). Small sizes quantize to power-of-two
+        # buckets (min 8 = one lane group) so the compile cache above sees a
+        # handful of block shapes, not one per distinct query count.
+        if nq < block:
+            bucket = 8
+            while bucket < nq:
+                bucket <<= 1
+            block = min(block, bucket)
+        qpad = (-nq) % block
+        Qp = jnp.pad(X_query, ((0, qpad), (0, 0)))
+        qsq = jnp.sum(Qp * Qp, axis=1)
+        d2_cand, idx_cand = _sharded_candidates(mesh, k_local, per, block)(
+            Xp, mask, Qp, qsq)
+        # replicated merge over n_dev * k_local candidates per query
+        neg, pos = lax.top_k(-d2_cand, k)
+        idx = jnp.take_along_axis(idx_cand, pos, axis=1)
+        sp.sync(idx)
     return idx[:nq], -neg[:nq]

@@ -18,6 +18,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from .. import obs as _obs
 from ..ops.linalg import gram_spectrum, svd_flip_v
 from .mesh import pad_and_shard as _pad_and_shard
 
@@ -62,8 +63,11 @@ def centered_svd_sharded(mesh, X):
     on the same input; U's rows are returned for the unpadded samples only,
     still sharded over the mesh.
     """
-    Xp, mask, n = _pad_and_shard(mesh, X)
-    mean, U, S, Vt = _masked_gram_svd(Xp, mask, n, center=True)
+    with _obs.span("parallel.pca.centered_svd_sharded",
+                   n_devices=int(mesh.devices.size)) as sp:
+        Xp, mask, n = _pad_and_shard(mesh, X)
+        mean, U, S, Vt = _masked_gram_svd(Xp, mask, n, center=True)
+        sp.sync(S)
     return mean, U[:n], S, Vt
 
 
@@ -76,8 +80,11 @@ def uncentered_svd_sharded(mesh, X):
     Gram route's conditioning (see the TruncatedSVD docstring); U's rows
     are returned for the unpadded samples only, still sharded over the
     mesh."""
-    Xp, mask, n = _pad_and_shard(mesh, X)
-    _, U, S, Vt = _masked_gram_svd(Xp, mask, n, center=False)
+    with _obs.span("parallel.pca.uncentered_svd_sharded",
+                   n_devices=int(mesh.devices.size)) as sp:
+        Xp, mask, n = _pad_and_shard(mesh, X)
+        _, U, S, Vt = _masked_gram_svd(Xp, mask, n, center=False)
+        sp.sync(S)
     return U[:n], S, Vt
 
 

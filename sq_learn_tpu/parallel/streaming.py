@@ -21,6 +21,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import obs as _obs
 from ..streaming import (_gram_colsum_step, _finalize_centered_gram,
                          stream_fold, stream_map_rows)
 from .mesh import data_sharding, replicated
@@ -57,10 +58,13 @@ def streamed_centered_gram_sharded(mesh, X, *, max_bytes=None):
     rep = replicated(mesh)
     init = (jax.device_put(jnp.zeros((m, m), dtype), rep),
             jax.device_put(jnp.zeros((m,), dtype), rep))
-    G, colsum = stream_fold(
-        X, _gram_colsum_step, init, max_bytes=max_bytes,
-        put=_sharded_put(mesh), multiple=int(mesh.devices.size))
-    mean, Gc = _finalize_centered_gram(G, colsum, n)
+    with _obs.span("parallel.streaming.centered_gram", n=n, m=m,
+                   n_devices=int(mesh.devices.size)):
+        G, colsum = stream_fold(
+            X, _gram_colsum_step, init, max_bytes=max_bytes,
+            put=_sharded_put(mesh), multiple=int(mesh.devices.size),
+            site="streaming.gram_colsum")
+        mean, Gc = _finalize_centered_gram(G, colsum, n)
     return mean, Gc, n
 
 
@@ -101,7 +105,12 @@ def streamed_centered_svd_topk_sharded(mesh, X, n_left, *, max_bytes=None):
         return _tile_topk_u(tile, mean_r, proj_r)
 
     # small per-tile (rows, k) outputs come back to the host
-    Uk = stream_map_rows(X, tile_fn, max_bytes=max_bytes,
-                         put=_sharded_put(mesh),
-                         multiple=int(mesh.devices.size))
+    with _obs.span("parallel.streaming.topk_u", n=n, k=k,
+                   n_devices=int(mesh.devices.size)):
+        Uk = stream_map_rows(X, tile_fn, max_bytes=max_bytes,
+                             put=_sharded_put(mesh),
+                             multiple=int(mesh.devices.size))
+    if _obs.enabled():
+        _obs.watchdog.track("parallel.streaming.tile_topk_u", _tile_topk_u)
+        _obs.watchdog.observe("parallel.streaming.tile_topk_u")
     return mean, Uk, S, Vt

@@ -44,6 +44,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from . import obs as _obs
+
 __all__ = [
     "stream_tile_bytes",
     "plan_row_tiles",
@@ -131,7 +133,8 @@ def padded_rows(n_rows, row_bytes, max_bytes=None, multiple=1):
     return n_rows + (_bucket_rows(tail, rows, multiple) - tail)
 
 
-def stream_tiles(X, max_bytes=None, device=None, put=None, multiple=1):
+def stream_tiles(X, max_bytes=None, device=None, put=None, multiple=1,
+                 site=None):
     """Yield ``(dev_tile, n_valid, start)`` over the row tiles of host
     array ``X``, double-buffered: the ``device_put`` for tile *i+1* is
     issued before tile *i* is yielded (i.e. before the consumer dispatches
@@ -143,6 +146,10 @@ def stream_tiles(X, max_bytes=None, device=None, put=None, multiple=1):
     offset in ``X``. ``put`` overrides the placement callable (the mesh
     variant passes a sharded ``device_put``); the default goes through
     ``jax.device_put`` so transfer-accounting tests can monkeypatch it.
+    ``site`` names the consuming kernel's retracing-watchdog call site:
+    with observability on, each tile's transfer size feeds the
+    ``streaming.transfer_bytes``/``streaming.tiles`` counters and each
+    planned (bucket, dtype) signature raises the site's compile budget.
     """
     X = np.asarray(X)
     # canonicalize on the host exactly like chunked_device_put: without it
@@ -157,6 +164,10 @@ def stream_tiles(X, max_bytes=None, device=None, put=None, multiple=1):
         def put(tile):
             return jax.device_put(tile, device)
 
+    observing = _obs.enabled()
+    if observing and site is not None and site in _KERNEL_SITES:
+        _obs.watchdog.track(site, _KERNEL_SITES[site])
+
     def staged(i):
         start = i * rows
         stop = min(start + rows, n)
@@ -166,6 +177,11 @@ def stream_tiles(X, max_bytes=None, device=None, put=None, multiple=1):
         if valid < bucket:
             pad = np.zeros((bucket - valid,) + X.shape[1:], X.dtype)
             tile = np.concatenate([tile, pad], axis=0)
+        if observing:
+            _obs.counter_add("streaming.transfer_bytes", int(tile.nbytes))
+            _obs.counter_add("streaming.tiles", 1)
+            if site is not None and site in _KERNEL_SITES:
+                _obs.watchdog.allow(site, (bucket, str(tile.dtype)))
         return put(tile), valid, start
 
     nxt = staged(0)
@@ -180,7 +196,7 @@ def stream_tiles(X, max_bytes=None, device=None, put=None, multiple=1):
 
 
 def stream_fold(X, step, init, *, max_bytes=None, device=None, put=None,
-                multiple=1, with_offsets=False):
+                multiple=1, with_offsets=False, site=None):
     """Fold a donated-accumulator kernel over the row tiles of ``X``.
 
     ``step(acc, tile)`` (or ``step(acc, tile, n_valid, start)`` with
@@ -189,22 +205,30 @@ def stream_fold(X, step, init, *, max_bytes=None, device=None, put=None,
     synchronizing, so dispatch of tile *i+1*'s upload and tile *i*'s
     kernel interleave. Tiles arrive zero-padded to bucket shapes; kernels
     that sum over rows need no masking (zero rows contribute nothing),
-    kernels that need the true count take ``with_offsets``.
+    kernels that need the true count take ``with_offsets``. ``site``
+    (watchdog call site of the underlying kernel) enforces the ≤1 compile
+    per (bucket, dtype) invariant after the pass when observability is on.
     """
     if device is not None:
         init = jax.tree.map(lambda a: jax.device_put(a, device), init)
     acc = init
-    for tile, n_valid, start in stream_tiles(X, max_bytes, device, put,
-                                             multiple):
-        if with_offsets:
-            acc = step(acc, tile, n_valid, start)
-        else:
-            acc = step(acc, tile)
+    with _obs.span("streaming.stream_fold", site=site):
+        for tile, n_valid, start in stream_tiles(X, max_bytes, device, put,
+                                                 multiple, site=site):
+            if with_offsets:
+                acc = step(acc, tile, n_valid, start)
+            else:
+                acc = step(acc, tile)
+    if _obs.enabled() and site is not None and site in _KERNEL_SITES:
+        # track() is idempotent (first call anchors the compile baseline);
+        # re-calling here covers a recorder enabled mid-pass
+        _obs.watchdog.track(site, _KERNEL_SITES[site])
+        _obs.watchdog.observe(site)
     return acc
 
 
 def stream_map_rows(X, fn, *, max_bytes=None, device=None, put=None,
-                    multiple=1, with_offsets=False):
+                    multiple=1, with_offsets=False, site=None):
     """Apply a row-wise jitted ``fn(tile)`` to every tile and assemble the
     (host) row-aligned outputs — the streamed-inference primitive
     (labels, neighbor lists): tile *i+1* uploads while ``fn`` runs on
@@ -213,10 +237,14 @@ def stream_map_rows(X, fn, *, max_bytes=None, device=None, put=None,
     row axis; with ``with_offsets`` it is called as ``fn(tile, start)``
     (tile-decorrelated RNG streams fold the offset into their key)."""
     outs = []
-    for tile, n_valid, start in stream_tiles(X, max_bytes, device, put,
-                                             multiple):
-        out = fn(tile, start) if with_offsets else fn(tile)
-        outs.append((out, n_valid))
+    with _obs.span("streaming.stream_map_rows", site=site):
+        for tile, n_valid, start in stream_tiles(X, max_bytes, device, put,
+                                                 multiple, site=site):
+            out = fn(tile, start) if with_offsets else fn(tile)
+            outs.append((out, n_valid))
+    if _obs.enabled() and site is not None and site in _KERNEL_SITES:
+        _obs.watchdog.track(site, _KERNEL_SITES[site])
+        _obs.watchdog.observe(site)
     first = outs[0][0]
     if isinstance(first, tuple):
         return tuple(
@@ -296,20 +324,29 @@ def _topk_u_step(acc, tile, n_valid, start, mean, Vk_over_s):
     return lax.dynamic_update_slice(acc, Uk, (start, 0))
 
 
+#: kernel registry: short name → jitted step. Watchdog call sites are
+#: ``"streaming.<short name>"``; :func:`kernel_cache_sizes` reads the same
+#: registry.
+_KERNELS = {
+    "gram_colsum": _gram_colsum_step,
+    "colsum": _colsum_step,
+    "ingest": _ingest_step,
+    "matmul_accum": _matmul_accum_step,
+    "project_rows": _project_rows_step,
+    "qtb": _qtb_step,
+    "topk_u": _topk_u_step,
+}
+
+#: watchdog site → kernel (what stream_fold/stream_tiles resolve ``site``
+#: against)
+_KERNEL_SITES = {f"streaming.{name}": fn for name, fn in _KERNELS.items()}
+
+
 def kernel_cache_sizes():
     """Compile-cache entry count per streaming kernel — the observability
     hook the bench and the no-per-shape-recompile tests read. Each entry
     corresponds to one (bucket shape, dtype) signature."""
-    kernels = {
-        "gram_colsum": _gram_colsum_step,
-        "colsum": _colsum_step,
-        "ingest": _ingest_step,
-        "matmul_accum": _matmul_accum_step,
-        "project_rows": _project_rows_step,
-        "qtb": _qtb_step,
-        "topk_u": _topk_u_step,
-    }
-    return {name: int(fn._cache_size()) for name, fn in kernels.items()}
+    return {name: int(fn._cache_size()) for name, fn in _KERNELS.items()}
 
 
 # ---------------------------------------------------------------------------
@@ -331,9 +368,11 @@ def streamed_centered_gram(X, *, max_bytes=None, device=None):
     n, m = X.shape
     dtype = jax.dtypes.canonicalize_dtype(X.dtype)
     init = (jnp.zeros((m, m), dtype), jnp.zeros((m,), dtype))
-    G, colsum = stream_fold(X, _gram_colsum_step, init,
-                            max_bytes=max_bytes, device=device)
-    mean, Gc = _finalize_centered_gram(G, colsum, n)
+    with _obs.span("streaming.centered_gram", n=n, m=m):
+        G, colsum = stream_fold(X, _gram_colsum_step, init,
+                                max_bytes=max_bytes, device=device,
+                                site="streaming.gram_colsum")
+        mean, Gc = _finalize_centered_gram(G, colsum, n)
     return mean, Gc, n
 
 
@@ -378,7 +417,8 @@ def streamed_centered_svd_topk(X, n_left, *, compute_dtype=None,
     # the tail rows onto earlier ones)
     n_pad = padded_rows(n, X.nbytes // max(1, n), max_bytes)
     Uk = stream_fold(X, step, jnp.zeros((n_pad, k), cdt),
-                     max_bytes=max_bytes, device=device, with_offsets=True)
+                     max_bytes=max_bytes, device=device, with_offsets=True,
+                     site="streaming.topk_u")
     return mean, Uk[:n].astype(S.dtype), S, Vt
 
 
@@ -409,14 +449,16 @@ def streamed_randomized_svd(key, X, n_components, *, n_oversamples=10,
     mean = None
     if center:
         colsum = stream_fold(X, _colsum_step, jnp.zeros((m,), dtype),
-                             max_bytes=max_bytes, device=device)
+                             max_bytes=max_bytes, device=device,
+                             site="streaming.colsum")
         mean = colsum / n
 
     Q = jax.random.normal(key, (m, size), dtype=dtype)
     for _ in range(max(1, int(n_iter))):
         F = stream_fold(X, functools.partial(_matmul_accum_step, Q=Q),
                         jnp.zeros((m, size), dtype),
-                        max_bytes=max_bytes, device=device)
+                        max_bytes=max_bytes, device=device,
+                        site="streaming.matmul_accum")
         if center:
             # (Xcᵀ·Xc)·Q = AᵀA·Q − n·mean·(meanᵀ·Q)
             F = F - n * jnp.outer(mean, mean @ Q)
@@ -427,7 +469,8 @@ def streamed_randomized_svd(key, X, n_components, *, n_oversamples=10,
     Y = stream_fold(
         X, functools.partial(_project_rows_step, Q=Q),
         jnp.zeros((n_pad, size), dtype),
-        max_bytes=max_bytes, device=device, with_offsets=True)
+        max_bytes=max_bytes, device=device, with_offsets=True,
+        site="streaming.project_rows")
     if center:
         Y = Y - (mean @ Q)[None, :]
     # zero-pad rows of Y must not enter the QR basis: re-zero them (the
@@ -439,7 +482,8 @@ def streamed_randomized_svd(key, X, n_components, *, n_oversamples=10,
     B = stream_fold(
         X, functools.partial(_qtb_step, Qn=Qn),
         jnp.zeros((size, m), dtype),
-        max_bytes=max_bytes, device=device, with_offsets=True)
+        max_bytes=max_bytes, device=device, with_offsets=True,
+        site="streaming.qtb")
     if center:
         B = B - jnp.outer(jnp.sum(Qn[:n], axis=0), mean)
     Uhat, S, Vt = jnp.linalg.svd(B, full_matrices=False)
@@ -472,7 +516,8 @@ def streamed_prestats(X, *, quantum=False, mu_grid=(), mu_blocked=False,
             jnp.zeros((m,), dtype))
     buf, colsum, sqsum = stream_fold(X, _ingest_step, init,
                                      max_bytes=max_bytes, device=device,
-                                     with_offsets=True)
+                                     with_offsets=True,
+                                     site="streaming.ingest")
     out = {}
     if quantum:
         # the quantum runtime-model stats read the UNCENTERED matrix;

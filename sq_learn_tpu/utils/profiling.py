@@ -18,6 +18,14 @@ module supplies the real-measurement side:
   wall-clock into a hardware-utilization statement ("beating" the
   reference's ``cluster/_k_means_lloyd.pyx:29`` on a TPU means a
   roofline number, not a latency ratio on digit-scale data).
+
+There is ONE timing discipline: every scope this module times emits
+through the run-scoped recorder (:mod:`sq_learn_tpu.obs`) when a run is
+active — ``Timer`` scopes land as synced spans, ``benchmark`` results as
+gauges with a compile-vs-execute split (warm-up wall-clock = compile +
+first execute; timed median = execute), ``mfu`` as a gauge. With
+observability off everything behaves exactly as before at zero extra
+cost.
 """
 
 import os
@@ -25,6 +33,8 @@ import time
 from contextlib import contextmanager
 
 import jax
+
+from .. import obs as _obs
 
 #: bf16 matmul peak FLOP/s per chip generation (public spec sheets /
 #: the jax-ml scaling book). The MXU's native rate; f32 MFU reported
@@ -73,35 +83,61 @@ def device_peak_flops(device=None):
 
 
 def mfu(flops, seconds, device=None):
-    """Model FLOP utilization: achieved FLOP/s over chip peak, or None
-    when the peak is unknown (see :func:`device_peak_flops`)."""
+    """Model FLOP utilization: achieved FLOP/s over chip peak.
+
+    Degrades gracefully on unknown hardware: when
+    :func:`device_peak_flops` has no entry for the chip (or ``seconds``
+    is non-positive) this returns None — callers need no pre-check — and
+    records a ``profiling.mfu`` gauge tagged ``unknown_chip`` so the run
+    artifact says *why* there is no utilization claim instead of silently
+    omitting one."""
     peak = device_peak_flops(device)
     if not peak or seconds <= 0:
+        kind = "unknown"
+        try:
+            d = device if device is not None else jax.devices()[0]
+            kind = getattr(d, "device_kind", "unknown")
+        except Exception:
+            pass
+        _obs.gauge("profiling.mfu", None, unknown_chip=True,
+                   device_kind=kind,
+                   reason=("nonpositive_seconds" if peak and seconds <= 0
+                           else "unknown_chip"))
         return None
-    return (flops / seconds) / peak
+    value = (flops / seconds) / peak
+    _obs.gauge("profiling.mfu", value)
+    return value
 
 
 @contextmanager
 def trace(log_dir, create_perfetto_link=False):
-    """Capture a device trace of the enclosed block into ``log_dir``."""
-    jax.profiler.start_trace(log_dir,
-                             create_perfetto_link=create_perfetto_link)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()
+    """Capture a device trace of the enclosed block into ``log_dir``
+    (and a ``utils.trace`` span in the obs recorder, so the run artifact
+    points at the XLA trace it corresponds to)."""
+    with _obs.span("utils.trace", log_dir=str(log_dir)):
+        jax.profiler.start_trace(log_dir,
+                                 create_perfetto_link=create_perfetto_link)
+        try:
+            yield
+        finally:
+            jax.profiler.stop_trace()
 
 
 class Timer:
     """Wall-clock scope timer that waits for device completion.
+
+    When an obs run is active the scope also lands as a synced span
+    (name from the ``name`` argument, default ``"utils.Timer"``) — the
+    one timing discipline of the framework.
 
     >>> with Timer() as t:
     ...     out = step(...)  # doctest: +SKIP
     >>> t.elapsed  # doctest: +SKIP
     """
 
-    def __init__(self, block_on=None):
+    def __init__(self, block_on=None, name=None):
         self._block_on = block_on
+        self.name = name
         self.elapsed = None
 
     def __enter__(self):
@@ -118,21 +154,34 @@ class Timer:
             for a in jax.live_arrays():
                 a.block_until_ready()
         self.elapsed = time.perf_counter() - self._t0
+        _obs.record_span(self.name or "utils.Timer", self.elapsed)
         return False
 
 
-def benchmark(fn, *args, repeats=5, warmup=1, **kwargs):
+def benchmark(fn, *args, repeats=5, warmup=1, name=None, **kwargs):
     """Median wall-clock of ``fn(*args, **kwargs)`` with device sync.
 
     Runs ``warmup`` untimed calls first (compile + cache), then ``repeats``
-    timed ones. Returns (median_seconds, all_times).
+    timed ones. Returns (median_seconds, all_times). With an obs run
+    active, records the compile-vs-execute split as gauges: the warm-up
+    wall-clock (compile + first execute) and the timed median (execute
+    only), under ``benchmark.<name>.{warmup_s,median_s}``.
     """
+    t0 = time.perf_counter()
     for _ in range(warmup):
         jax.block_until_ready(fn(*args, **kwargs))
+    warmup_s = time.perf_counter() - t0
     times = []
     for _ in range(repeats):
         t0 = time.perf_counter()
         jax.block_until_ready(fn(*args, **kwargs))
         times.append(time.perf_counter() - t0)
     times.sort()
-    return times[len(times) // 2], times
+    median = times[len(times) // 2]
+    if _obs.enabled():
+        label = name or getattr(fn, "__name__", "fn")
+        _obs.gauge(f"benchmark.{label}.warmup_s", round(warmup_s, 6),
+                   warmup_calls=warmup)
+        _obs.gauge(f"benchmark.{label}.median_s", round(median, 6),
+                   repeats=repeats)
+    return median, times

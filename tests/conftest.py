@@ -27,6 +27,24 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _clear_xla_caches_between_modules():
+    """Opt-in (``SQ_TEST_CLEAR_CACHES=1``) compile-cache reset between test
+    modules.
+
+    Mitigation for the round-5 full-suite XLA segfault at [95%]
+    (VERDICT.md): the CPU backend accumulated every module's compiled
+    executables and died near the end of the run. Clearing per module
+    bounds the cache's footprint at the cost of recompiles, so it is
+    opt-in — CI (``make test`` / ``make test-timed``) sets the env var;
+    the local fast loop keeps warm caches. Remove once the segfault is
+    root-caused.
+    """
+    yield
+    if os.environ.get("SQ_TEST_CLEAR_CACHES") == "1":
+        jax.clear_caches()
+
+
 @pytest.fixture(scope="session")
 def cpu_devices():
     return jax.devices("cpu")

@@ -1,0 +1,317 @@
+"""Observability layer (sq_learn_tpu.obs): recorder, ledger, watchdog,
+probe, schema — the run-scoped metrics/tracing contract of ISSUE 2."""
+
+import json
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from sq_learn_tpu import obs
+from sq_learn_tpu.obs.ledger import tomography_shot_count
+from sq_learn_tpu.obs.schema import validate_jsonl, validate_record
+
+
+@pytest.fixture
+def run():
+    """A fresh in-memory observability run, torn down afterwards."""
+    rec = obs.enable()
+    yield rec
+    obs.disable()
+
+
+# -- disabled fast path ------------------------------------------------------
+
+
+def test_disabled_span_is_shared_noop():
+    obs.disable()
+    assert obs.span("anything", big=1) is obs.NULL_SPAN
+    with obs.span("x") as sp:
+        assert sp.set(a=1) is sp
+        assert sp.sync("v") == "v"
+    assert obs.snapshot() is None
+    assert obs.ledger.entries() == []
+
+
+def test_disabled_overhead_micro():
+    """The disabled instrumentation points must be cheap enough to leave
+    in every hot path: ~1 µs/op would already be 100× slower than the
+    observed cost, so the bound below is loose against host noise while
+    still catching an accidental allocation/format on the fast path."""
+    obs.disable()
+    n = 100_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with obs.span("hot", a=1):
+            pass
+        obs.counter_add("c", 1)
+        obs.gauge("g", 1.0)
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 2.0, f"disabled-mode overhead too high: {elapsed:.3f}s"
+
+
+# -- spans -------------------------------------------------------------------
+
+
+def test_span_nesting_and_ordering(run):
+    with obs.span("outer", stage="fit") as sp_out:
+        with obs.span("inner"):
+            pass
+        sp_out.set(resolved="full")
+    # children close (and record) before parents
+    assert [s["name"] for s in run.spans] == ["inner", "outer"]
+    inner, outer = run.spans
+    assert inner["depth"] == 1 and outer["depth"] == 0
+    assert inner["parent"] == outer["seq"]
+    assert outer["parent"] is None
+    assert inner["seq"] > outer["seq"]  # opened after
+    assert outer["attrs"] == {"stage": "fit", "resolved": "full"}
+    assert not inner["synced"]
+
+
+def test_span_sync_blocks_and_flags(run):
+    with obs.span("synced") as sp:
+        out = sp.sync(jnp.ones((4,)) * 2)
+    assert run.spans[0]["synced"] is True
+    np.testing.assert_allclose(np.asarray(out), 2.0)
+
+
+def test_span_records_error(run):
+    with pytest.raises(ValueError):
+        with obs.span("boom"):
+            raise ValueError("x")
+    assert run.spans[0]["error"] == "ValueError"
+
+
+# -- counters / gauges / snapshot -------------------------------------------
+
+
+def test_counters_accumulate_and_gauges_overwrite(run):
+    obs.counter_add("bytes", 10)
+    obs.counter_add("bytes", 5)
+    obs.gauge("latency", 0.5)
+    obs.gauge("latency", 0.7, source="probe")
+    assert run.counters["bytes"] == 15
+    assert run.gauges["latency"] == 0.7
+
+
+def test_snapshot_fields(run):
+    snap = obs.snapshot()
+    for key in ("compile_count", "total_transfer_bytes", "probe_ms",
+                "spans", "ledger_entries", "watchdog_over_budget"):
+        assert key in snap
+    assert snap["probe_ms"] is None
+    obs.probe.probe_device(platform="cpu")
+    assert run.probe_events[-1]["outcome"] == "cpu"
+    assert obs.snapshot()["probe_ms"] is not None
+
+
+# -- JSONL sink + schema -----------------------------------------------------
+
+
+def test_jsonl_schema_roundtrip(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    obs.enable(path)
+    try:
+        with obs.span("step", n=3):
+            pass
+        obs.counter_add("streaming.transfer_bytes", 128)
+        obs.gauge("probe.latency_s", 0.01)
+        obs.ledger.record("qpca", "tomography",
+                          queries={"tomography_shots": 42.0},
+                          budget={"delta": 0.1}, wall_s=0.5)
+        f = jax.jit(lambda x: x + 1)
+        obs.watchdog.track("t.roundtrip", f, budget=2)
+        f(jnp.ones((3,)))
+        obs.watchdog.observe("t.roundtrip")
+        obs.probe.probe_device(platform="cpu")
+    finally:
+        obs.disable()
+    summary = validate_jsonl(path)
+    assert summary["errors"] == []
+    for t in ("meta", "span", "counter", "gauge", "ledger", "watchdog",
+              "probe"):
+        assert summary["by_type"].get(t, 0) >= 1, (t, summary)
+    # and the lines decode back to the recorded values
+    recs = [json.loads(l) for l in open(path)]
+    led = [r for r in recs if r["type"] == "ledger"][0]
+    assert led["queries"]["tomography_shots"] == 42.0
+    assert led["budget"]["delta"] == 0.1
+
+
+def test_schema_rejects_malformed():
+    assert validate_record({"v": 1, "ts": 0.0, "type": "nope"})
+    assert validate_record({"v": 1, "ts": 0.0, "type": "span",
+                            "name": 3, "seq": "x", "dur_s": -1,
+                            "depth": 0, "parent": None, "synced": True})
+    assert validate_record({"v": 99, "ts": 0.0, "type": "gauge",
+                            "name": "g", "value": 1})
+
+
+# -- retracing watchdog ------------------------------------------------------
+
+
+def test_watchdog_fires_on_retracing_callable(run):
+    f = jax.jit(lambda x: x * 2)
+    obs.watchdog.track("t.retrace", f, budget=1)
+    f(jnp.ones((4,)))
+    assert obs.watchdog.observe("t.retrace") == 1  # within budget
+    f(jnp.ones((5,)))  # new shape -> recompile -> over budget
+    with pytest.warns(obs.RetracingWarning, match="t.retrace"):
+        assert obs.watchdog.observe("t.retrace") == 2
+    assert obs.watchdog.report()["t.retrace"]["over_budget"]
+    # the violation also landed as a watchdog record
+    assert any(e["over_budget"] for e in run.watchdog_events)
+
+
+def test_watchdog_strict_raises(run, monkeypatch):
+    monkeypatch.setenv("SQ_OBS_STRICT", "1")
+    f = jax.jit(lambda x: x - 1)
+    wrapped = obs.watchdog.watch("t.strict", f, budget=1)
+    wrapped(jnp.ones((4,)))
+    with pytest.raises(obs.RetracingError, match="t.strict"):
+        wrapped(jnp.ones((6,)))
+
+
+def test_watchdog_signature_budget_and_baseline(run):
+    f = jax.jit(lambda x: jnp.sum(x))
+    f(jnp.ones((3,)))  # compiled BEFORE tracking: baselined away
+    obs.watchdog.track("t.base", f)
+    obs.watchdog.allow("t.base", (4, "float32"))
+    obs.watchdog.allow("t.base", (8, "float32"))
+    f(jnp.ones((4,)))
+    f(jnp.ones((8,)))
+    assert obs.watchdog.observe("t.base") == 2  # == len(signatures): ok
+    assert not obs.watchdog.report()["t.base"]["over_budget"]
+
+
+# -- streaming instrumentation ----------------------------------------------
+
+
+def test_streaming_counters_and_watchdog(run):
+    from sq_learn_tpu import streaming
+
+    X = np.random.default_rng(0).normal(size=(512, 16)).astype(np.float32)
+    streaming.streamed_centered_gram(X, max_bytes=8 * 1024)
+    assert run.counters["streaming.transfer_bytes"] >= X.nbytes
+    assert run.counters["streaming.tiles"] >= 2
+    rep = obs.watchdog.report()["streaming.gram_colsum"]
+    assert rep["observations"] == 1
+    assert not rep["over_budget"]
+    # a second pass at another size re-observes without minting compiles
+    # beyond the allowed buckets
+    streaming.streamed_centered_gram(X[:300], max_bytes=8 * 1024)
+    rep = obs.watchdog.report()["streaming.gram_colsum"]
+    assert rep["compiles"] <= rep["budget"]
+
+
+# -- quantum-runtime ledger --------------------------------------------------
+
+
+def test_ledger_matches_hand_computed_tomography_shots(run):
+    from sq_learn_tpu.models import QPCA
+
+    X = np.random.default_rng(1).normal(size=(256, 32)).astype(np.float32)
+    est = QPCA(n_components=8, svd_solver="full", random_state=0)
+    # eps=0: exact singular-value estimates, so the top-k selection (and
+    # therefore the shot count) is deterministic; delta>0 prices tomography
+    est.fit(X, estimate_all=True, theta_major=1.0, eps=0, delta=0.3,
+            true_tomography=False)
+    k = est.topk
+    assert k > 0
+    # Alg. 4.1: 2·N(d)·k shots per side — right vectors live in R^32,
+    # left in R^256
+    expected = (tomography_shot_count(k, 32, 0.3)
+                + tomography_shot_count(k, 256, 0.3))
+    totals = obs.ledger.totals()
+    assert totals["queries"]["tomography_shots"] == expected
+    assert totals["queries"]["pe_spectrum_queries"] == 0  # eps=0 exact
+    assert totals["wall_s"] > 0
+
+
+def test_ledger_zero_error_records_zero_queries(run):
+    from sq_learn_tpu.models import QPCA
+
+    X = np.random.default_rng(2).normal(size=(128, 16)).astype(np.float32)
+    est = QPCA(n_components=4, svd_solver="full", random_state=0)
+    est.fit(X, estimate_all=True, theta_major=1.0, eps=0, delta=0,
+            spectral_norm_est=True)
+    totals = obs.ledger.totals()
+    assert all(v == 0 for v in totals["queries"].values()), totals
+    steps = {(e["estimator"], e["step"]) for e in obs.ledger.entries()}
+    assert ("qpca", "topk_extract") in steps
+    assert ("qpca", "spectral_norm_estimation") in steps
+
+
+def test_ledger_qkmeans_quantum_cost(run):
+    from sq_learn_tpu.models import QKMeans
+
+    X = np.random.default_rng(3).normal(size=(128, 8)).astype(np.float32)
+    QKMeans(n_clusters=3, delta=0.4, true_distance_estimate=False,
+            n_init=1, max_iter=5, random_state=0).fit(X)
+    entry = [e for e in obs.ledger.entries()
+             if (e["estimator"], e["step"]) == ("qkmeans", "fit")][0]
+    assert entry["queries"]["theoretical_quantum_cost"] > 0
+    assert entry["budget"]["delta"] == 0.4
+
+
+def test_ledger_classical_estimators_feed_wall_clock(run):
+    from sq_learn_tpu.models import KNeighborsClassifier, TruncatedSVD
+
+    X = np.random.default_rng(4).normal(size=(64, 8)).astype(np.float32)
+    TruncatedSVD(n_components=2, random_state=0).fit(X)
+    KNeighborsClassifier(n_neighbors=3).fit(
+        X, np.arange(64) % 2).predict(X[:5])
+    steps = {(e["estimator"], e["step"]): e for e in obs.ledger.entries()}
+    assert steps[("truncated_svd", "fit")]["queries"] == {}
+    assert steps[("truncated_svd", "fit")]["wall_s"] >= 0
+    assert steps[("knn", "search")]["queries"] == {}
+
+
+# -- profiling refactor ------------------------------------------------------
+
+
+def test_timer_emits_span(run):
+    from sq_learn_tpu.utils.profiling import Timer
+
+    with Timer(name="unit.timer") as t:
+        jnp.ones((8,)).block_until_ready()
+    assert t.elapsed is not None
+    assert any(s["name"] == "unit.timer" for s in run.spans)
+
+
+def test_benchmark_records_compile_execute_split(run):
+    from sq_learn_tpu.utils.profiling import benchmark
+
+    f = jax.jit(lambda x: x * 3)
+    median, times = benchmark(f, jnp.ones((16,)), repeats=3, warmup=1,
+                              name="triple")
+    assert len(times) == 3 and median >= 0
+    assert "benchmark.triple.warmup_s" in run.gauges
+    assert "benchmark.triple.median_s" in run.gauges
+
+
+def test_mfu_degrades_gracefully_on_unknown_chip(run, monkeypatch):
+    from sq_learn_tpu.utils import profiling
+
+    monkeypatch.delenv("SQ_TPU_PEAK_FLOPS", raising=False)
+    # the CPU backend's device_kind is not in the TPU peak table
+    assert profiling.mfu(1e12, 1.0) is None
+    recs = [r for r in run.gauge_events if r["name"] == "profiling.mfu"]
+    assert recs, "no mfu gauge recorded"
+    assert recs[-1]["attrs"]["unknown_chip"] is True
+    assert recs[-1]["attrs"]["reason"] == "unknown_chip"
+
+
+# -- probe -------------------------------------------------------------------
+
+
+def test_probe_cpu_and_skipped_paths(run):
+    out = obs.probe.probe_device(platform="cpu")
+    assert out["outcome"] == "cpu" and out["latency_s"] == 0.0
+    out = obs.probe.probe_device(platform="")
+    assert out["outcome"] == "skipped"
+    assert len(run.probe_events) == 2
+    assert run.gauges["probe.ok"] is True
