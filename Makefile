@@ -8,7 +8,7 @@ PYTHON ?= python
 SHELL := /bin/bash
 
 .PHONY: test test-fast lint bench bench-smoke bench-suite multichip examples \
-    hunt obs-smoke all
+    hunt obs-smoke faults-smoke smoke all
 
 all: lint test
 
@@ -83,6 +83,18 @@ multichip:
 obs-smoke:
 	env SQ_OBS=1 SQ_OBS_PATH=/tmp/sq_obs_smoke.jsonl \
 	    $(PYTHON) -m sq_learn_tpu.obs.smoke
+
+# Resilience smoke: a streamed fit under an injected fault schedule
+# (transient transfer failure, probe timeout, mid-pass interrupt+resume,
+# breaker trip) on the CPU backend; asserts fault-free/faulted/resumed
+# parity and validates the emitted fault/breaker JSONL against the
+# schema. The CI-runnable contract check for sq_learn_tpu.resilience.
+faults-smoke:
+	env SQ_OBS=1 SQ_OBS_PATH=/tmp/sq_faults_smoke.jsonl \
+	    $(PYTHON) -m sq_learn_tpu.resilience.smoke
+
+# Both contract smokes (observability + resilience) in one target.
+smoke: obs-smoke faults-smoke
 
 # Full BASELINE suite (headline + configs #2-#5) into one record file.
 bench-suite:

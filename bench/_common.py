@@ -105,7 +105,11 @@ def probe_backend(timeout_s=60):
     The probe itself (subprocess + timeout + latency/outcome accounting)
     lives in :mod:`sq_learn_tpu.obs.probe` — the one implementation of
     the known axon-wedge escape — so every bench run records probe
-    latency and outcome as metrics when ``SQ_OBS=1``.
+    latency and outcome as metrics when ``SQ_OBS=1``. Results are cached
+    for ``SQ_PROBE_TTL_S`` (default 300 s) across processes, so the
+    suite's back-to-back configs share one real probe instead of each
+    paying the ~5-15 s subprocess; probe outcomes also feed the transfer
+    circuit breaker (:mod:`sq_learn_tpu.resilience.supervisor`).
 
     60 s default: a healthy tunnel answers the probe in ~5-15 s; a wedged
     one never answers, so the timeout is pure stall — every observed

@@ -57,6 +57,23 @@ run_and_record() {  # run_and_record <timeout_s> <header> <cmd...>; returns the 
   [ "$rc" -ne 0 ] && depth=40
   tail -"$depth" "$stderr_tmp" | sed 's/^/# stderr: /' >> "$out"
   echo "# rc=$rc" >> "$out"
+  # a config killed by its timeout is recorded as a machine-readable
+  # outcome line instead of silently missing a number ("config"/"outcome"
+  # keys only: bench/_gate.py counts lines carrying "metric", so a later
+  # successful CPU retry still contributes exactly one gated line)
+  if [ "$rc" -eq 124 ] || [ "$rc" -eq 137 ]; then
+    echo "{\"config\": \"${slug}\", \"outcome\": \"timeout\", \"timeout_s\": ${tmo}}" >> "$out"
+  elif [ "$rc" -ne 0 ]; then
+    echo "{\"config\": \"${slug}\", \"outcome\": \"failed\", \"rc\": ${rc}}" >> "$out"
+  fi
+  # archive the run's resilience records (fault injections, breaker
+  # transitions) next to its obs JSONL — same traceability rule: the
+  # artifact that explains a degraded number is committed with it
+  if grep -aq '"type": "\(fault\|breaker\)"' "$obs_dir/${slug}.jsonl" \
+      2>/dev/null; then
+    grep -a '"type": "\(fault\|breaker\)"' "$obs_dir/${slug}.jsonl" \
+      > "$obs_dir/${slug}_resilience.jsonl"
+  fi
   return $rc
 }
 

@@ -24,6 +24,7 @@ __version__ = "0.1.0"
 # Submodules are imported lazily-but-eagerly here; keep this list in sync with
 # the component inventory in SURVEY.md §2.
 from . import obs  # noqa: E402  (first: everything else instruments through it)
+from . import resilience  # noqa: E402  (second: the streaming engine's puts supervise through it)
 from . import ops, utils  # noqa: E402
 
 from . import datasets, metrics, model_selection, models, native, parallel  # noqa: E402
@@ -63,6 +64,7 @@ __all__ = [
     "clone",
     "obs",
     "ops",
+    "resilience",
     "utils",
     "native",
     "parallel",

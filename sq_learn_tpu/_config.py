@@ -327,6 +327,19 @@ def chunked_device_put(x, device=None, max_bytes=None):
     double-buffered tiled-ingestion engine overlaps each upload with the
     previous tile's compute and never materializes the input.
 
+    .. deprecated:: PR 3
+        New call sites should use :mod:`sq_learn_tpu.streaming`
+        (``stream_fold``/``streamed_prestats`` for accumulations, or
+        ``stream_tiles`` for resident assembly) — it keeps every transfer
+        bounded AND gets double-buffering, compile-bucketing, resumable
+        checkpoints, and per-tile watchdog accounting for free. This
+        helper remains for the whole-array placement surfaces
+        (``as_device_array``); its slices now at least run under the
+        transfer supervisor (:mod:`sq_learn_tpu.resilience.supervisor`:
+        retries/backoff, per-tile deadline, breaker accounting), so a
+        transient relay failure mid-upload retries instead of killing
+        the fit.
+
     With the default ``max_bytes`` the slicing only engages for non-CPU
     targets (host→host copies can't wedge a relay and the extra
     concatenate would be pure overhead); passing ``max_bytes`` explicitly
@@ -360,9 +373,13 @@ def chunked_device_put(x, device=None, max_bytes=None):
     if (x.nbytes <= max_bytes or x.ndim == 0
             or (platform == "cpu" and not explicit)):
         return jax.device_put(x, device) if device is not None else jnp.asarray(x)
+    from .resilience import supervisor as _sup
+
     rows = max(1, max_bytes // max(1, row_bytes))
-    parts = [jax.device_put(x[i:i + rows], device)
-             for i in range(0, x.shape[0], rows)]
+    parts = [
+        _sup.put(lambda t: jax.device_put(t, device), x[i:i + rows],
+                 tile_index=j, site="config.chunked_device_put")
+        for j, i in enumerate(range(0, x.shape[0], rows))]
     # The inputs are already committed device buffers, so the concatenate
     # executes on-device: no further host→device traffic.
     return jnp.concatenate(parts, axis=0)

@@ -1201,6 +1201,9 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
             # into one donated buffer (every transfer under the tile cap,
             # no concatenate) while the column sums/square-sums accumulate
             # under the uploads; centering/norms finalize on device
+            from ..resilience import breaker
+
+            breaker.preflight("qkmeans.fit")
             self.ingest_ = "streamed"
             stats = streamed_prestats(X, quantum=quantum, mu_grid=mu_grid,
                                       mu_blocked=mu_blocked)

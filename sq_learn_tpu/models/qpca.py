@@ -647,6 +647,13 @@ class QPCA(TransformerMixin, BaseEstimator):
                 f">= 1, was of type={type(n_components)!r}")
 
         streamed = getattr(self, "_ingest_streamed", False)
+        if streamed:
+            # give a tripped transfer breaker its half-open chance before
+            # committing this fit's tile walk to a possibly-wedged relay
+            # (closed-state cost: one comparison)
+            from ..resilience import breaker
+
+            breaker.preflight("qpca.fit")
         if self.mesh is not None:
             if streamed:
                 # tiles land sharded, partial Grams psum over ICI — the

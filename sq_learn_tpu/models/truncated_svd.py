@@ -123,7 +123,10 @@ class TruncatedSVD(TransformerMixin, BaseEstimator):
             # tiled range finder + power iterations: per pass, one (m, k)
             # accumulation Σ tileᵀ·(tile·Q) while the next tile uploads —
             # X is never device-resident (sq_learn_tpu.streaming)
+            from ..resilience import breaker
             from ..streaming import streamed_randomized_svd
+
+            breaker.preflight("truncated_svd.fit")
 
             U, S, Vt = streamed_randomized_svd(
                 as_key(self.random_state), X, k, n_iter=self.n_iter)

@@ -1,5 +1,5 @@
 """Device-health probe: the subprocess-with-timeout accelerator check,
-measured.
+measured, cached, and fault-injectable.
 
 The axon TPU tunnel can hang ``jax.devices()`` indefinitely (CLAUDE.md);
 the known escape is probing backend init in a throwaway subprocess with a
@@ -9,6 +9,28 @@ signals the round-5 failures (judge-host segfault, relay wedges) showed we
 were flying blind on. This module is the one implementation, and it records
 every probe as a 'probe' JSONL record plus ``probe.latency_s`` /
 ``probe.ok`` gauges when a recorder is active.
+
+Three resilience hooks ride on top of the measurement:
+
+- **TTL cache** (``SQ_PROBE_TTL_S``, default 300 s): back-to-back bench
+  scripts reuse the last real probe result instead of each paying a
+  ~5-15 s subprocess — in-process via a module global, across processes
+  via a tiny JSON file (``SQ_PROBE_CACHE``, default
+  ``$TMPDIR/sq_probe_cache.json`` — the suite's configs are separate
+  interpreters). A cached answer is recorded with ``cached: true`` and
+  never re-feeds the breaker (no new information). ``force=True``
+  bypasses the cache (the breaker's half-open trial must see a FRESH
+  probe). The 300 s default is far shorter than any observed wedge
+  (hours) or healthy window (~7-20 min), so a cached verdict cannot
+  outlive the regime it measured.
+- **Breaker feed**: every fresh outcome is reported to
+  :data:`sq_learn_tpu.resilience.supervisor.breaker` — probe timeouts
+  count toward the trip threshold exactly like mid-stream transfer
+  failures.
+- **Fault injection**: an armed ``probe_timeout`` injector
+  (:mod:`sq_learn_tpu.resilience.faults`) forces the outcome without
+  spawning a subprocess, so breaker behavior under wedge signals is
+  CI-testable on the CPU backend.
 
 Outcomes:
 
@@ -22,32 +44,97 @@ Outcomes:
 - ``"skipped"``   — no platform configured (jax auto-detect, local only).
 """
 
+import json
 import os
 import subprocess
 import sys
+import tempfile
 import time
 
 #: last probe result in this process (outcome, latency_s, platform) —
 #: readable even when no recorder was active at probe time
 last_probe = None
 
+#: monotonic timestamp of the last FRESH (non-cached) probe, for the TTL
+_last_probe_t = None
 
-def _record(outcome, latency_s, platform):
-    global last_probe
+
+def probe_ttl_s():
+    """TTL of a cached probe result. 300 s default: long enough that a
+    bench suite's scripts share one probe, far shorter than any observed
+    wedge (hours) or healthy window (~7-20 min). 0 disables caching."""
+    return float(os.environ.get("SQ_PROBE_TTL_S", 300.0))
+
+
+def _cache_path():
+    return os.environ.get(
+        "SQ_PROBE_CACHE",
+        os.path.join(tempfile.gettempdir(), "sq_probe_cache.json"))
+
+
+def _cache_read(platform):
+    """A fresh-enough cached result for ``platform`` from the cross-process
+    cache file, or None. Best-effort: an unreadable/stale/foreign file is
+    simply a cache miss."""
+    try:
+        with open(_cache_path()) as fh:
+            ent = json.load(fh)
+        if (ent.get("platform") == platform
+                and isinstance(ent.get("ts"), (int, float))
+                and time.time() - ent["ts"] < probe_ttl_s()
+                and isinstance(ent.get("outcome"), str)):
+            return ent
+    except Exception:
+        pass
+    return None
+
+
+def _cache_write(outcome, latency_s, platform):
+    """Persist a fresh real-probe result for sibling processes (atomic
+    write; a full disk must not break the probe)."""
+    try:
+        path = _cache_path()
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as fh:
+            json.dump({"outcome": outcome, "latency_s": round(latency_s, 3),
+                       "platform": platform, "ts": round(time.time(), 3)},
+                      fh)
+        os.replace(tmp, path)
+    except Exception:
+        pass
+
+
+def _record(outcome, latency_s, platform, cached=False):
+    global last_probe, _last_probe_t
     last_probe = {"outcome": outcome, "latency_s": round(latency_s, 3),
                   "platform": platform}
+    if not cached:
+        _last_probe_t = time.monotonic()
     from . import recorder
 
     rec = recorder.get_recorder()
     if rec is not None:
-        rec.record(dict(last_probe, type="probe"), kind="probe_events")
+        ev = dict(last_probe, type="probe")
+        if cached:
+            ev["cached"] = True
+        rec.record(ev, kind="probe_events")
         recorder.gauge("probe.latency_s", round(latency_s, 3))
         # "skipped"/"cpu" are healthy outcomes: nothing to probe ≠ failure
         recorder.gauge("probe.ok", outcome in ("ok", "cpu", "skipped"))
-    return last_probe
+    if not cached:
+        # fresh outcomes feed the circuit breaker (a cached answer carries
+        # no new health information); lazy import — resilience is optional
+        # at probe time and must never break the measurement
+        try:
+            from ..resilience.supervisor import breaker
+
+            breaker.on_probe(outcome)
+        except Exception:
+            pass
+    return dict(last_probe, cached=True) if cached else last_probe
 
 
-def probe_device(timeout_s=60, platform=None):
+def probe_device(timeout_s=60, platform=None, force=False):
     """Initialize the configured JAX backend in a throwaway subprocess and
     report (never raise) the outcome with its measured latency.
 
@@ -57,6 +144,11 @@ def probe_device(timeout_s=60, platform=None):
     The 60 s default matches the bench contract: a healthy tunnel answers
     in ~5–15 s and a wedged one never does, so longer patience is pure
     stall (CLAUDE.md). Returns ``{"outcome", "latency_s", "platform"}``.
+
+    A result younger than ``SQ_PROBE_TTL_S`` for the same platform is
+    returned from cache (``cached: true`` in the returned dict and the
+    JSONL record) unless ``force=True``; an armed ``probe_timeout``
+    injector forces the outcome without spawning.
     """
     if platform is None:
         platform = os.environ.get("JAX_PLATFORMS", "")
@@ -64,6 +156,24 @@ def probe_device(timeout_s=60, platform=None):
         return _record("cpu", 0.0, platform)
     if platform == "":
         return _record("skipped", 0.0, platform)
+    if not force:
+        if (last_probe is not None and _last_probe_t is not None
+                and last_probe["platform"] == platform
+                and time.monotonic() - _last_probe_t < probe_ttl_s()):
+            return _record(last_probe["outcome"], last_probe["latency_s"],
+                           platform, cached=True)
+        ent = _cache_read(platform)
+        if ent is not None:
+            return _record(ent["outcome"], ent.get("latency_s", 0.0),
+                           platform, cached=True)
+    from ..resilience import faults as _faults
+
+    if _faults._active is not None:
+        forced = _faults._active.on_probe()
+        if forced is not None:
+            return _record(forced,
+                           float(timeout_s) if forced == "timeout" else 0.0,
+                           platform)
     t0 = time.perf_counter()
     try:
         subprocess.run(
@@ -74,4 +184,6 @@ def probe_device(timeout_s=60, platform=None):
         outcome = "timeout"
     except (subprocess.CalledProcessError, OSError):
         outcome = "error"
-    return _record(outcome, time.perf_counter() - t0, platform)
+    latency = time.perf_counter() - t0
+    _cache_write(outcome, latency, platform)
+    return _record(outcome, latency, platform)

@@ -148,8 +148,9 @@ class Recorder:
     """In-memory store of one run's records, with an optional JSONL sink.
 
     Public views: ``spans``, ``counters``, ``gauges``, ``ledger_entries``,
-    ``watchdog_events``, ``probe_events`` — all plain Python containers,
-    safe to read at any point in the run.
+    ``watchdog_events``, ``probe_events``, ``fault_events``,
+    ``breaker_events`` — all plain Python containers, safe to read at any
+    point in the run.
     """
 
     def __init__(self, path=None):
@@ -160,6 +161,8 @@ class Recorder:
         self.ledger_entries = []
         self.watchdog_events = []
         self.probe_events = []
+        self.fault_events = []
+        self.breaker_events = []
         self.path = path
         self._seq = 0
         self._sink = None
@@ -303,6 +306,12 @@ def snapshot():
     probe_ms = None
     if rec.probe_events:
         probe_ms = round(rec.probe_events[-1].get("latency_s", 0.0) * 1e3, 3)
+    try:
+        from ..resilience.supervisor import breaker
+
+        breaker_state, breaker_trips = breaker.state(), breaker.trips
+    except Exception:  # obs must never die on a half-imported package
+        breaker_state, breaker_trips = "closed", 0
     return {
         "compile_count": int(compile_count),
         "total_transfer_bytes": int(
@@ -312,6 +321,9 @@ def snapshot():
         "ledger_entries": len(rec.ledger_entries),
         "watchdog_over_budget": sorted(
             site for site, s in report.items() if s["over_budget"]),
+        "faults_injected": len(rec.fault_events),
+        "breaker_state": breaker_state,
+        "breaker_trips": int(breaker_trips),
     }
 
 

@@ -19,7 +19,12 @@ ledger     estimator (str), step (str), queries (object: str → number),
 watchdog   site (str), compiles (int ≥ 0), budget (int | null),
            over_budget (bool)
 probe      outcome (str ∈ {ok, timeout, error, cpu, skipped}),
-           latency_s (number ≥ 0), platform (str)
+           latency_s (number ≥ 0), platform (str); optional cached (bool)
+fault      kind (str), tile (int | null) — one injected fault from the
+           ``SQ_FAULTS`` harness (:mod:`sq_learn_tpu.resilience.faults`)
+breaker    state (str ∈ {closed, open, half_open}), prev (str),
+           reason (str), consecutive (int ≥ 0) — one circuit-breaker
+           transition (:mod:`sq_learn_tpu.resilience.supervisor`)
 =========  ==============================================================
 
 The validator is hand-rolled (no jsonschema in the image — CLAUDE.md: no
@@ -34,6 +39,8 @@ from .recorder import SCHEMA_VERSION
 _NUM = (int, float)
 
 _PROBE_OUTCOMES = {"ok", "timeout", "error", "cpu", "skipped"}
+
+_BREAKER_STATES = {"closed", "open", "half_open"}
 
 
 def _check(cond, errors, msg):
@@ -105,6 +112,22 @@ def validate_record(rec):
                "probe.latency_s non-negative number")
         _check(isinstance(rec.get("platform"), str), errors,
                "probe.platform str")
+        if "cached" in rec:
+            _check(isinstance(rec["cached"], bool), errors,
+                   "probe.cached bool")
+    elif t == "fault":
+        _check(isinstance(rec.get("kind"), str), errors, "fault.kind str")
+        _check(rec.get("tile") is None or isinstance(rec["tile"], int),
+               errors, "fault.tile int or null")
+    elif t == "breaker":
+        _check(rec.get("state") in _BREAKER_STATES, errors,
+               f"breaker.state in {sorted(_BREAKER_STATES)}")
+        _check(isinstance(rec.get("prev"), str), errors, "breaker.prev str")
+        _check(isinstance(rec.get("reason"), str), errors,
+               "breaker.reason str")
+        _check(isinstance(rec.get("consecutive"), int)
+               and rec["consecutive"] >= 0, errors,
+               "breaker.consecutive non-negative int")
     else:
         errors.append(f"unknown record type {t!r}")
     return errors

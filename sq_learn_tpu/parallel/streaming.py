@@ -13,6 +13,14 @@ accumulator survives.
 
 Tile buckets are rounded to device-count multiples (SPMD needs equal
 shards); zero-padded rows contribute nothing to the sums.
+
+The resilience machinery rides along unchanged from the single-device
+engine: each sharded ``device_put`` runs under the transfer supervisor
+(retry/backoff/deadline/breaker), and the Gram pass is resumable via
+``SQ_STREAM_CKPT_DIR``/``checkpoint=`` — the replicated accumulators
+snapshot to host npz and re-place **replicated** on resume
+(:func:`~sq_learn_tpu.streaming.stream_fold` restores each leaf with its
+init counterpart's sharding).
 """
 
 import functools
@@ -44,13 +52,17 @@ def _sharded_put(mesh):
     return put
 
 
-def streamed_centered_gram_sharded(mesh, X, *, max_bytes=None):
+def streamed_centered_gram_sharded(mesh, X, *, max_bytes=None,
+                                   checkpoint=None):
     """(mean, G_centered, n) with every tile landing sharded over the
     mesh and the partial Grams psum-reduced over ICI.
 
     The replicated (m, m)/(m,) accumulators ride through the same donated
     kernel as the single-device engine; with the tile row-sharded, XLA
     lowers ``tileᵀ·tile`` to per-shard partials + an all-reduce.
+    ``checkpoint`` (or ``SQ_STREAM_CKPT_DIR``) makes the pass resumable;
+    the snapshot holds the psum-reduced accumulator, so resume re-places
+    it replicated and continues mid-sweep.
     """
     X = np.asarray(X)
     n, m = X.shape
@@ -63,7 +75,7 @@ def streamed_centered_gram_sharded(mesh, X, *, max_bytes=None):
         G, colsum = stream_fold(
             X, _gram_colsum_step, init, max_bytes=max_bytes,
             put=_sharded_put(mesh), multiple=int(mesh.devices.size),
-            site="streaming.gram_colsum")
+            site="streaming.gram_colsum", checkpoint=checkpoint)
         mean, Gc = _finalize_centered_gram(G, colsum, n)
     return mean, Gc, n
 

@@ -1,0 +1,49 @@
+"""Failure-budgeted runtime: fault injection, transfer supervision,
+circuit breaking, resumable streaming.
+
+The paper treats quantum-ML error (ε) and failure probability (γ) as
+runtime parameters; this package applies the same stance to the classical
+runtime's own failure modes (relay wedges, hung backend init, mid-pass
+interrupts — CLAUDE.md's observed incident catalogue):
+
+- :mod:`.faults` — deterministic, env-armed (``SQ_FAULTS=<spec>``)
+  injectors for transfer failures/stalls, NaN-corrupted tiles, mid-pass
+  interrupts, and probe timeouts, so every observed failure mode is
+  reproducible in CI on the CPU backend.
+- :mod:`.supervisor` — bounded retries + keyed exponential backoff +
+  per-tile deadlines around every streamed ``device_put``, and the
+  probe-fed circuit breaker that routes work to the in-process CPU escape
+  after K consecutive failures.
+- Resumable streaming passes live in :mod:`sq_learn_tpu.streaming`
+  (``SQ_STREAM_CKPT_DIR``): host-snapshotted accumulator + tile cursor
+  every M tiles via :mod:`sq_learn_tpu.utils.checkpoint`, so a wedge
+  mid-pass resumes from the last checkpoint instead of re-issuing the
+  upload that triggered it.
+
+Quickstart::
+
+    from sq_learn_tpu import resilience
+
+    resilience.faults.arm("put_fail:tiles=2,times=1")   # or SQ_FAULTS=...
+    ... streamed fit recovers via the supervisor's retries ...
+    resilience.faults.disarm()
+    print(resilience.breaker.state())
+
+Full docs: ``docs/resilience.md``.
+"""
+
+from . import faults, supervisor
+from .faults import (FaultSpecError, InjectedFault, InjectedInterrupt,
+                     InjectedTransferError)
+from .supervisor import NonFiniteAccumulatorError, breaker
+
+__all__ = [
+    "FaultSpecError",
+    "InjectedFault",
+    "InjectedInterrupt",
+    "InjectedTransferError",
+    "NonFiniteAccumulatorError",
+    "breaker",
+    "faults",
+    "supervisor",
+]

@@ -1,0 +1,299 @@
+"""Deterministic fault-injection harness.
+
+The failure modes this repo has actually observed — the axon relay wedging
+mid-upload (CLAUDE.md, 3/3 incidents during ≥200 MB transfers),
+``jax.devices()`` hanging indefinitely, a fit dying partway through a
+streamed pass — are all *rare in CI and catastrophic in production*. The
+paper's thesis is that error and failure probability are runtime parameters
+to be budgeted; this module makes our classical runtime's failures equally
+first-class: every one of them is reproducible, deterministically, on the
+CPU backend, so the supervisor/breaker/resume machinery in
+:mod:`.supervisor` and :mod:`sq_learn_tpu.streaming` is tested against the
+real shapes of trouble instead of hand-mocked ones.
+
+Arming
+------
+``SQ_FAULTS=<spec>`` arms the harness at import (mirroring ``SQ_OBS=1``);
+:func:`arm`/:func:`disarm` do it programmatically. With nothing armed the
+hot-path hooks are a single module-attribute read (``_active is None``) —
+the same zero-overhead discipline as the obs recorder's disabled mode,
+pinned by ``tests/test_resilience.py``.
+
+Spec grammar
+------------
+``spec    := fault (";" fault)*``
+``fault   := kind [":" param ("," param)*]``
+``param   := key "=" value``
+
+Kinds and their params (every param optional unless noted):
+
+``put_fail``
+    Transient ``device_put`` failure: raises :class:`InjectedTransferError`
+    from the supervisor's put path. ``tiles=a/b/c`` (explicit tile indices)
+    or ``p=0.25`` (per-tile probability, drawn from ``seed``); ``times=N``
+    — each selected tile fails its first N attempts, then succeeds (the
+    transient shape the retry loop must absorb).
+``put_stall``
+    Transfer stall: sleeps ``s=0.25`` seconds inside the supervised (timed)
+    put, so a per-tile deadline shorter than ``s`` sees a timeout — the
+    relay-wedge signature scaled down to CI. Selection params as above.
+``nan``
+    Tile corruption: the selected tiles' payload is NaN-poisoned before the
+    put — the failure the ``SQ_RESILIENCE_STRICT=1`` finiteness guard
+    exists to catch with tile provenance.
+``abort``
+    Mid-pass interrupt: raises :class:`InjectedInterrupt` at the tile
+    boundary ``tile=K`` (before that tile stages), ``times=N`` (default 1)
+    — the wedge-killed-the-process shape the resumable-pass checkpoints
+    recover from.
+``probe_timeout``
+    The next ``n=1`` device-health probes report ``"timeout"`` without
+    spawning a subprocess — feeds the circuit breaker the wedge signal.
+
+Example: ``SQ_FAULTS="put_fail:tiles=2,times=1;probe_timeout:n=2"``.
+
+Determinism: probabilistic selection (``p=``) draws from a splitmix64 hash
+of ``(seed, tile_index, injector_index)`` — no global RNG, the same spec
+injects the same faults on every run (the repo-wide explicit-key
+discipline, applied to failure).
+"""
+
+import os
+import time
+
+__all__ = [
+    "FaultPlan",
+    "FaultSpecError",
+    "InjectedFault",
+    "InjectedInterrupt",
+    "InjectedTransferError",
+    "active",
+    "arm",
+    "disarm",
+    "get_plan",
+]
+
+_KINDS = ("put_fail", "put_stall", "nan", "abort", "probe_timeout")
+
+
+class FaultSpecError(ValueError):
+    """Malformed ``SQ_FAULTS`` spec."""
+
+
+class InjectedFault(RuntimeError):
+    """Base of every injected failure (so tests and the smoke can catch
+    'anything this harness raised' without masking real bugs)."""
+
+
+class InjectedTransferError(InjectedFault):
+    """A transient device_put failure (the supervisor retries these)."""
+
+
+class InjectedInterrupt(InjectedFault):
+    """A mid-pass interrupt at a tile boundary (resume recovers these)."""
+
+
+def _u01(seed, *salt):
+    """Deterministic uniform in [0, 1) via splitmix64 over (seed, salt) —
+    keyed like the rest of the codebase, no global RNG, no jax import."""
+    x = (int(seed) & 0xFFFFFFFFFFFFFFFF) or 0x9E3779B97F4A7C15
+    for s in salt:
+        x = (x + 0x9E3779B97F4A7C15 + (int(s) << 1)) & 0xFFFFFFFFFFFFFFFF
+        x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9 & 0xFFFFFFFFFFFFFFFF
+        x = (x ^ (x >> 27)) * 0x94D049BB133111EB & 0xFFFFFFFFFFFFFFFF
+        x ^= x >> 31
+    return x / 2.0 ** 64
+
+
+class _Injector:
+    """One parsed fault clause with its countdown state."""
+
+    def __init__(self, index, kind, params):
+        self.index = index
+        self.kind = kind
+        self.tiles = params.pop("tiles", None)
+        self.tile = params.pop("tile", None)
+        self.p = params.pop("p", None)
+        self.times = params.pop("times", 1)
+        self.seed = params.pop("seed", 0)
+        self.stall_s = params.pop("s", 0.25)
+        self.count = params.pop("n", 1)
+        if params:
+            raise FaultSpecError(
+                f"unknown param(s) {sorted(params)} for fault {kind!r}")
+        #: per-tile remaining-failure countdowns (transient faults succeed
+        #: once their countdown is spent)
+        self._remaining = {}
+
+    def matches(self, tile_index):
+        if self.tiles is not None:
+            if tile_index not in self.tiles:
+                return False
+        elif self.tile is not None:
+            if tile_index != self.tile:
+                return False
+        elif self.p is not None:
+            if _u01(self.seed, tile_index, self.index) >= self.p:
+                return False
+        rem = self._remaining.setdefault(tile_index, self.times)
+        if rem <= 0:
+            return False
+        self._remaining[tile_index] = rem - 1
+        return True
+
+    def consume(self):
+        """Countdown for tile-free injectors (probe_timeout)."""
+        if self.count <= 0:
+            return False
+        self.count -= 1
+        return True
+
+
+def _parse_value(key, raw):
+    if key == "tiles":
+        return frozenset(int(t) for t in raw.split("/"))
+    if key in ("tile", "times", "seed", "n"):
+        return int(raw)
+    if key in ("p", "s"):
+        return float(raw)
+    raise FaultSpecError(f"unknown fault param {key!r}")
+
+
+def parse_spec(spec):
+    """Parse an ``SQ_FAULTS`` spec string into injectors (see the module
+    docstring for the grammar). Raises :class:`FaultSpecError` on any
+    malformed clause — an unparseable fault plan must fail loudly, not arm
+    partially."""
+    injectors = []
+    for i, clause in enumerate(filter(None,
+                                      (c.strip() for c in spec.split(";")))):
+        kind, _, rest = clause.partition(":")
+        kind = kind.strip()
+        if kind not in _KINDS:
+            raise FaultSpecError(
+                f"unknown fault kind {kind!r} (known: {', '.join(_KINDS)})")
+        params = {}
+        if rest.strip():
+            for item in rest.split(","):
+                key, sep, val = item.partition("=")
+                if not sep:
+                    raise FaultSpecError(
+                        f"fault param {item!r} is not key=value")
+                try:
+                    params[key.strip()] = _parse_value(key.strip(),
+                                                       val.strip())
+                except ValueError as exc:
+                    raise FaultSpecError(
+                        f"bad value for {key.strip()!r}: {exc}") from None
+        injectors.append(_Injector(i, kind, params))
+    if not injectors:
+        raise FaultSpecError(f"empty fault spec {spec!r}")
+    return injectors
+
+
+class FaultPlan:
+    """The armed injector set plus an event log of every injection.
+
+    The hooks below are only ever called when a plan is armed (the call
+    sites read the module global first), so nothing here needs a fast
+    path. Every injection is appended to :attr:`events` and — when a
+    recorder is active — recorded as a ``fault`` JSONL record, so a
+    fault-injected run's artifact says exactly what was done to it.
+    """
+
+    def __init__(self, spec):
+        self.spec = spec
+        self.injectors = parse_spec(spec)
+        self.events = []
+
+    def _record(self, kind, tile, **fields):
+        ev = dict({"kind": kind, "tile": tile}, **fields)
+        self.events.append(ev)
+        from ..obs import recorder
+
+        rec = recorder.get_recorder()
+        if rec is not None:
+            rec.record(dict(ev, type="fault"), kind="fault_events")
+
+    def _by_kind(self, kind):
+        return (inj for inj in self.injectors if inj.kind == kind)
+
+    def on_tile(self, tile_index):
+        """Tile-boundary hook (before the tile stages): mid-pass abort."""
+        for inj in self._by_kind("abort"):
+            if inj.matches(tile_index):
+                self._record("abort", tile_index)
+                raise InjectedInterrupt(
+                    f"injected mid-pass interrupt at tile {tile_index}")
+
+    def on_put(self, tile_index):
+        """Pre-put hook inside the supervisor's timed attempt: transient
+        failures raise, stalls sleep (so the attempt's wall-clock crosses
+        the per-tile deadline)."""
+        for inj in self._by_kind("put_stall"):
+            if inj.matches(tile_index):
+                self._record("put_stall", tile_index, stall_s=inj.stall_s)
+                time.sleep(inj.stall_s)
+        for inj in self._by_kind("put_fail"):
+            if inj.matches(tile_index):
+                self._record("put_fail", tile_index)
+                raise InjectedTransferError(
+                    f"injected transient transfer failure at tile "
+                    f"{tile_index}")
+
+    def corrupt(self, tile, tile_index):
+        """NaN-poison the selected tiles' payload (returns the tile,
+        corrupted or not)."""
+        import numpy as np
+
+        for inj in self._by_kind("nan"):
+            if inj.matches(tile_index):
+                self._record("nan", tile_index)
+                tile = np.array(tile, copy=True)
+                tile.reshape(-1)[:1] = np.nan
+        return tile
+
+    def on_probe(self):
+        """Probe hook: a forced outcome string, or None to probe for
+        real."""
+        for inj in self._by_kind("probe_timeout"):
+            if inj.consume():
+                self._record("probe_timeout", None)
+                return "timeout"
+        return None
+
+
+#: the armed plan, or None — hot paths read this one attribute and do
+#: nothing else when it is None (the zero-overhead contract)
+_active = None
+
+
+def active():
+    """True when a fault plan is armed."""
+    return _active is not None
+
+
+def get_plan():
+    """The armed :class:`FaultPlan`, or None."""
+    return _active
+
+
+def arm(spec):
+    """Arm a fault plan from a spec string; returns the plan. Re-arming
+    replaces the previous plan (countdown state does not carry over)."""
+    global _active
+    _active = FaultPlan(spec)
+    return _active
+
+
+def disarm():
+    """Disarm; returns the previous plan (its event log stays readable)."""
+    global _active
+    plan, _active = _active, None
+    return plan
+
+
+# SQ_FAULTS=<spec> arms at first import, mirroring SQ_OBS=1 — a subprocess
+# (bench config, CI smoke) opts into faults purely through its environment.
+if os.environ.get("SQ_FAULTS"):
+    arm(os.environ["SQ_FAULTS"])

@@ -1,0 +1,272 @@
+"""Transfer supervisor: bounded retries, keyed backoff, per-tile deadlines,
+and the probe-fed circuit breaker.
+
+Every host→device transfer in the streaming engine (and the legacy
+``chunked_device_put``) goes through :func:`put`. The supervision contract,
+in failure order:
+
+1. **Retry with backoff.** A transient put failure (injected
+   :class:`~.faults.InjectedTransferError`, or a real ``RuntimeError`` /
+   ``OSError`` out of the backend) is retried up to ``SQ_RETRY_MAX`` times
+   with exponential backoff ``SQ_RETRY_BACKOFF_S · 2^attempt`` plus keyed
+   jitter — deterministic per (tile, attempt), splitmix64 over
+   ``SQ_RETRY_SEED``, because even our failure handling follows the
+   explicit-key discipline.
+2. **Per-tile deadline.** Each attempt is wall-clocked; one that takes
+   longer than ``SQ_TILE_DEADLINE_S`` still returns its result (the data
+   DID arrive) but counts as a timeout against the breaker — a slow
+   transfer is the relay wedge's leading edge (CLAUDE.md: every observed
+   wedge started as one stalling upload).
+3. **Circuit breaker.** ``SQ_BREAKER_K`` *consecutive* failures/timeouts
+   trip the breaker: it runs the documented in-process escape
+   (``jax.config.update("jax_platforms", "cpu")`` — the one override that
+   works even when the axon sitecustomize pre-imported jax against a
+   wedged relay), emits a ``resilience.breaker_state`` gauge plus a
+   ``breaker`` JSONL record, and stops counting the accelerator as
+   healthy. After ``SQ_BREAKER_COOLDOWN_S`` it half-opens; the next
+   :meth:`CircuitBreaker.preflight` (models call it at the top of every
+   streamed fit) forces a **fresh** device-health probe (bypassing the
+   probe TTL cache), and a healthy outcome closes the breaker while a
+   timeout re-opens it. Probe outcomes always feed the breaker —
+   :mod:`sq_learn_tpu.obs.probe` reports every outcome here — so wedges
+   detected by bench preambles and wedges detected mid-stream share one
+   state machine.
+
+When no faults are armed and the breaker is closed, :func:`put` is one
+``perf_counter`` pair around the raw put — no allocation, no recording —
+so the supervised path costs nothing measurable per tile (pinned by
+``tests/test_resilience.py``).
+"""
+
+import os
+import time
+
+from .faults import InjectedTransferError, _u01
+from . import faults as _faults
+
+__all__ = [
+    "CLOSED",
+    "HALF_OPEN",
+    "OPEN",
+    "CircuitBreaker",
+    "NonFiniteAccumulatorError",
+    "backoff_delay",
+    "breaker",
+    "put",
+]
+
+CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+#: exceptions the retry loop treats as transient transfer failures; jax
+#: backend errors (XlaRuntimeError) derive from RuntimeError
+_TRANSIENT = (InjectedTransferError, RuntimeError, OSError)
+
+
+class NonFiniteAccumulatorError(RuntimeError):
+    """A streamed accumulator went non-finite under
+    ``SQ_RESILIENCE_STRICT=1``; the message carries the tile provenance
+    (site, tile index, row range) of the first bad tile."""
+
+
+def _retries():
+    return int(os.environ.get("SQ_RETRY_MAX", 3))
+
+
+def _backoff_s():
+    return float(os.environ.get("SQ_RETRY_BACKOFF_S", 0.05))
+
+
+def _deadline_s():
+    return float(os.environ.get("SQ_TILE_DEADLINE_S", 30.0))
+
+
+def backoff_delay(attempt, tile_index=0, seed=None):
+    """Backoff before retry ``attempt`` (0-based): exponential base with
+    deterministic keyed jitter in [1, 2) — doubling plus jitter decorrelates
+    concurrent retriers without a global RNG."""
+    if seed is None:
+        seed = int(os.environ.get("SQ_RETRY_SEED", 0))
+    return (_backoff_s() * (2 ** attempt)
+            * (1.0 + _u01(seed, tile_index, attempt)))
+
+
+def _cpu_escape():
+    """The documented reliable wedge escape (CLAUDE.md): re-pin the process
+    platform spec to the CPU backend in-process. Best-effort — on a process
+    whose non-CPU backend is already initialized the pin only steers arrays
+    created after it, which is exactly what routing *subsequent* work
+    needs."""
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        return True
+    except Exception:
+        return False
+
+
+class CircuitBreaker:
+    """Consecutive-failure circuit breaker over the transfer/probe path.
+
+    States: ``closed`` (healthy; failures count), ``open`` (tripped; the
+    CPU escape has run, cooldown ticking), ``half_open`` (cooldown
+    elapsed; one fresh probe decides). Transitions emit a ``breaker``
+    JSONL record and a ``resilience.breaker_state`` gauge when a recorder
+    is active. ``clock`` is injectable so the cooldown is unit-testable
+    without sleeping.
+    """
+
+    def __init__(self, clock=time.monotonic, trip_action=_cpu_escape):
+        self._clock = clock
+        self.trip_action = trip_action
+        self._state = CLOSED
+        self._consecutive = 0
+        self._opened_at = None
+        self.trips = 0
+        self.transitions = []
+
+    # -- state ---------------------------------------------------------------
+
+    @property
+    def consecutive_failures(self):
+        return self._consecutive
+
+    def state(self):
+        """Current state, lazily advancing ``open`` → ``half_open`` once
+        the cooldown has elapsed."""
+        if (self._state == OPEN and self._opened_at is not None
+                and self._clock() - self._opened_at >= self._cooldown_s()):
+            self._transition(HALF_OPEN, "cooldown elapsed")
+        return self._state
+
+    def _k(self):
+        return int(os.environ.get("SQ_BREAKER_K", 3))
+
+    def _cooldown_s(self):
+        return float(os.environ.get("SQ_BREAKER_COOLDOWN_S", 60.0))
+
+    def _transition(self, new, reason):
+        prev, self._state = self._state, new
+        ev = {"state": new, "prev": prev, "reason": reason,
+              "consecutive": self._consecutive}
+        self.transitions.append(ev)
+        from ..obs import recorder
+
+        rec = recorder.get_recorder()
+        if rec is not None:
+            rec.record(dict(ev, type="breaker"), kind="breaker_events")
+            recorder.gauge("resilience.breaker_state", new, reason=reason)
+
+    # -- inputs --------------------------------------------------------------
+
+    def record_failure(self, reason, site=None, elapsed=None):
+        """One transfer failure or timeout. Trips on the K-th consecutive
+        one; in ``half_open`` a single failure re-opens immediately (the
+        trial transfer failed — no K grace)."""
+        self._consecutive += 1
+        state = self.state()
+        if state == HALF_OPEN:
+            self._opened_at = self._clock()
+            self._transition(OPEN, f"half-open trial failed ({reason})")
+        elif state == CLOSED and self._consecutive >= self._k():
+            self._opened_at = self._clock()
+            self.trips += 1
+            self._transition(
+                OPEN, f"{self._consecutive} consecutive failures "
+                      f"(last: {reason}{f' at {site}' if site else ''})")
+            self.trip_action()
+
+    def record_timeout(self, site=None, elapsed=None):
+        self.record_failure("deadline exceeded", site=site, elapsed=elapsed)
+
+    def record_success(self):
+        """One healthy transfer: resets the consecutive count; in
+        ``half_open`` it closes the breaker."""
+        self._consecutive = 0
+        if self.state() == HALF_OPEN:
+            self._transition(CLOSED, "half-open trial succeeded")
+
+    def on_probe(self, outcome):
+        """Device-health probe outcomes feed the same state machine:
+        ``timeout``/``error`` count as failures, ``ok``/``cpu`` as
+        successes (``skipped`` carries no signal). Called by
+        :mod:`sq_learn_tpu.obs.probe` on every fresh probe."""
+        if outcome in ("ok", "cpu"):
+            self.record_success()
+        elif outcome in ("timeout", "error"):
+            self.record_failure(f"probe {outcome}")
+
+    def preflight(self, site=None):
+        """Fit-entry hook: give a tripped breaker its half-open chance.
+        If the cooldown has elapsed, forces a FRESH device-health probe
+        (bypassing the TTL cache — half-open must not close on a stale
+        'ok') whose outcome closes or re-opens the breaker. Returns the
+        (possibly advanced) state; closed-state cost is one comparison."""
+        if self._state == CLOSED:
+            return CLOSED
+        if self.state() == HALF_OPEN:
+            from ..obs.probe import probe_device
+
+            probe_device(force=True)  # outcome feeds on_probe via _record
+        return self.state()
+
+    def reset(self, reason="reset"):
+        """Back to a fresh closed breaker (tests, smoke teardown). Emits a
+        transition record only if the state actually changes."""
+        self._consecutive = 0
+        self._opened_at = None
+        if self._state != CLOSED:
+            self._transition(CLOSED, reason)
+
+
+#: the process-wide breaker every supervised put and probe feeds
+breaker = CircuitBreaker()
+
+
+def put(put_fn, tile, tile_index=0, site=None):
+    """Run one supervised placement ``put_fn(tile)``.
+
+    The fast path (no faults armed, breaker closed) is a timed raw call;
+    anything else goes through the full retry/backoff/injection loop.
+    Always returns ``put_fn``'s result or raises its terminal error after
+    retries are exhausted.
+    """
+    if _faults._active is None and breaker._state == CLOSED:
+        t0 = time.perf_counter()
+        out = put_fn(tile)
+        elapsed = time.perf_counter() - t0
+        if elapsed > _deadline_s():
+            breaker.record_timeout(site=site, elapsed=elapsed)
+        elif breaker._consecutive:
+            breaker.record_success()
+        return out
+    return _put_supervised(put_fn, tile, tile_index, site)
+
+
+def _put_supervised(put_fn, tile, tile_index, site):
+    plan = _faults._active
+    deadline = _deadline_s()
+    retries = _retries()
+    for attempt in range(retries + 1):
+        try:
+            t0 = time.perf_counter()
+            payload = tile
+            if plan is not None:
+                payload = plan.corrupt(tile, tile_index)
+                plan.on_put(tile_index)  # may stall (timed) or raise
+            out = put_fn(payload)
+        except _TRANSIENT as exc:
+            breaker.record_failure(type(exc).__name__, site=site)
+            if attempt >= retries:
+                raise
+            from ..obs import recorder
+
+            recorder.counter_add("resilience.retries", 1)
+            time.sleep(backoff_delay(attempt, tile_index))
+            continue
+        elapsed = time.perf_counter() - t0
+        if elapsed > deadline:
+            breaker.record_timeout(site=site, elapsed=elapsed)
+        else:
+            breaker.record_success()
+        return out

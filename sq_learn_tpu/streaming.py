@@ -31,9 +31,26 @@ prestats, streamed predicts) live at the bottom of this module; the mesh
 variant — tiles landing sharded, partial Grams reduced over ICI — is
 :mod:`sq_learn_tpu.parallel.streaming`.
 
+Resilience (PR 3): every tile's ``device_put`` runs under the transfer
+supervisor (:mod:`sq_learn_tpu.resilience.supervisor` — bounded retries,
+keyed backoff, per-tile deadline, circuit breaker), and fold passes are
+**resumable**: with a checkpoint configured (``SQ_STREAM_CKPT_DIR``, or an
+explicit :class:`StreamCheckpoint`), the host-snapshotted accumulator and
+tile cursor are saved every M tiles, so a wedge mid-pass resumes from the
+last checkpoint instead of re-issuing the uploads that triggered it —
+resumed results are bit-identical to an uninterrupted pass (the
+accumulator round-trips through npz losslessly and the remaining tiles
+replay the same kernels in the same order).
+
 Env knobs: ``SQ_STREAM_TILE_BYTES`` caps the per-tile transfer size
 (default: ``SQ_TRANSFER_CHUNK_BYTES``, i.e. the relay-safe 128 MB);
-``SQ_STREAM_MIN_BUCKET_ROWS`` floors the tail buckets (default 64 rows).
+``SQ_STREAM_MIN_BUCKET_ROWS`` floors the tail buckets (default 64 rows);
+``SQ_STREAM_CKPT_DIR`` + ``SQ_STREAM_CKPT_EVERY`` (default 8) enable
+per-site pass checkpoints; ``SQ_RESILIENCE_STRICT=1`` syncs and checks
+the accumulator after every tile, raising
+:class:`~sq_learn_tpu.resilience.supervisor.NonFiniteAccumulatorError`
+with tile provenance on the first non-finite value (opt-in: the per-tile
+host sync defeats the transfer/compute overlap).
 """
 
 import functools
@@ -45,8 +62,11 @@ import jax.numpy as jnp
 from jax import lax
 
 from . import obs as _obs
+from .resilience import faults as _faults
+from .resilience import supervisor as _sup
 
 __all__ = [
+    "StreamCheckpoint",
     "stream_tile_bytes",
     "plan_row_tiles",
     "stream_tiles",
@@ -134,7 +154,7 @@ def padded_rows(n_rows, row_bytes, max_bytes=None, multiple=1):
 
 
 def stream_tiles(X, max_bytes=None, device=None, put=None, multiple=1,
-                 site=None):
+                 site=None, start_tile=0):
     """Yield ``(dev_tile, n_valid, start)`` over the row tiles of host
     array ``X``, double-buffered: the ``device_put`` for tile *i+1* is
     issued before tile *i* is yielded (i.e. before the consumer dispatches
@@ -146,6 +166,12 @@ def stream_tiles(X, max_bytes=None, device=None, put=None, multiple=1,
     offset in ``X``. ``put`` overrides the placement callable (the mesh
     variant passes a sharded ``device_put``); the default goes through
     ``jax.device_put`` so transfer-accounting tests can monkeypatch it.
+    Either way each tile's placement runs under the transfer supervisor
+    (:func:`sq_learn_tpu.resilience.supervisor.put`: retries/backoff,
+    per-tile deadline, breaker accounting), and armed fault injectors
+    (``SQ_FAULTS``) hook the tile boundary here. ``start_tile`` skips the
+    leading tiles without staging them — the resume path's whole point is
+    NOT re-issuing the uploads already folded in.
     ``site`` names the consuming kernel's retracing-watchdog call site:
     with observability on, each tile's transfer size feeds the
     ``streaming.transfer_bytes``/``streaming.tiles`` counters and each
@@ -169,6 +195,8 @@ def stream_tiles(X, max_bytes=None, device=None, put=None, multiple=1,
         _obs.watchdog.track(site, _KERNEL_SITES[site])
 
     def staged(i):
+        if _faults._active is not None:
+            _faults._active.on_tile(i)  # mid-pass abort injection point
         start = i * rows
         stop = min(start + rows, n)
         valid = stop - start
@@ -182,10 +210,10 @@ def stream_tiles(X, max_bytes=None, device=None, put=None, multiple=1,
             _obs.counter_add("streaming.tiles", 1)
             if site is not None and site in _KERNEL_SITES:
                 _obs.watchdog.allow(site, (bucket, str(tile.dtype)))
-        return put(tile), valid, start
+        return _sup.put(put, tile, i, site=site), valid, start
 
-    nxt = staged(0)
-    for i in range(n_tiles):
+    nxt = staged(start_tile)
+    for i in range(start_tile, n_tiles):
         cur = nxt
         if i + 1 < n_tiles:
             # stage tile i+1 BEFORE the consumer dispatches tile i's
@@ -195,8 +223,83 @@ def stream_tiles(X, max_bytes=None, device=None, put=None, multiple=1,
         yield cur
 
 
+class StreamCheckpoint:
+    """Where and how often a fold pass checkpoints: ``path`` is the npz
+    file (written atomically via :func:`~sq_learn_tpu.utils.checkpoint.
+    save_stream_state`), ``every`` the tile period between snapshots.
+    Passing one to :func:`stream_fold` overrides the env-derived default
+    (``SQ_STREAM_CKPT_DIR``/``SQ_STREAM_CKPT_EVERY``)."""
+
+    __slots__ = ("path", "every")
+
+    def __init__(self, path, every=None):
+        self.path = str(path)
+        self.every = int(os.environ.get("SQ_STREAM_CKPT_EVERY", 8)
+                         if every is None else every)
+        if self.every < 1:
+            raise ValueError(f"checkpoint every must be >= 1, got {every}")
+
+
+def _data_digest(Xn):
+    """Cheap content fingerprint of the pass's input: CRC over the first
+    and last row plus the shape. Folded into the checkpoint fingerprint so
+    a checkpoint can only ever resume the same pass over the same data —
+    O(row) cost, paid once per checkpointed pass."""
+    import zlib
+
+    h = zlib.crc32(np.ascontiguousarray(Xn[:1]).tobytes())
+    return zlib.crc32(np.ascontiguousarray(Xn[-1:]).tobytes(), h)
+
+
+def _resolve_checkpoint(checkpoint, site):
+    """An explicit ``checkpoint`` wins; else ``SQ_STREAM_CKPT_DIR`` plus a
+    ``site`` derives ``<dir>/<site with dots → underscores>.npz``; else
+    checkpointing is off."""
+    if checkpoint is not None:
+        if isinstance(checkpoint, StreamCheckpoint):
+            return checkpoint
+        return StreamCheckpoint(checkpoint)
+    ckpt_dir = os.environ.get("SQ_STREAM_CKPT_DIR")
+    if not ckpt_dir or site is None:
+        return None
+    os.makedirs(ckpt_dir, exist_ok=True)
+    return StreamCheckpoint(
+        os.path.join(ckpt_dir, site.replace(".", "_") + ".npz"))
+
+
+def _strict_guard():
+    return os.environ.get("SQ_RESILIENCE_STRICT") == "1"
+
+
+def _check_finite(acc, site, tile_index, start, n_valid):
+    """Host-sync the accumulator and raise with tile provenance on the
+    first non-finite value (``SQ_RESILIENCE_STRICT=1`` only)."""
+    for j, leaf in enumerate(jax.tree_util.tree_leaves(acc)):
+        a = np.asarray(leaf)
+        if np.issubdtype(a.dtype, np.floating) and not np.isfinite(a).all():
+            raise _sup.NonFiniteAccumulatorError(
+                f"non-finite accumulator leaf {j} after tile {tile_index} "
+                f"(rows {start}..{start + n_valid}) of pass "
+                f"{site or '<unnamed>'}")
+
+
+def _restore_leaf(host, like):
+    """Re-place one checkpointed host leaf like its ``init`` counterpart —
+    sharding AND committed-ness included: the mesh variant's replicated
+    accumulators resume replicated, while an uncommitted single-device
+    init resumes uncommitted (a committed restore would change the jit
+    cache key and recompile the very kernel the resume is rejoining)."""
+    if isinstance(like, jax.Array):
+        if getattr(like, "_committed", False):
+            return jax.device_put(jnp.asarray(host, like.dtype),
+                                  like.sharding)
+        return jnp.asarray(host, like.dtype)
+    return jnp.asarray(host)
+
+
 def stream_fold(X, step, init, *, max_bytes=None, device=None, put=None,
-                multiple=1, with_offsets=False, site=None):
+                multiple=1, with_offsets=False, site=None, checkpoint=None,
+                pass_tag=None):
     """Fold a donated-accumulator kernel over the row tiles of ``X``.
 
     ``step(acc, tile)`` (or ``step(acc, tile, n_valid, start)`` with
@@ -208,17 +311,68 @@ def stream_fold(X, step, init, *, max_bytes=None, device=None, put=None,
     kernels that need the true count take ``with_offsets``. ``site``
     (watchdog call site of the underlying kernel) enforces the ≤1 compile
     per (bucket, dtype) invariant after the pass when observability is on.
+
+    With a checkpoint configured (explicit ``checkpoint=`` or
+    ``SQ_STREAM_CKPT_DIR`` + ``site``) the pass is **resumable**: every
+    ``every`` tiles the accumulator is host-snapshotted (one sync — the
+    only blocking points in the pass) and written atomically with the
+    tile cursor; a rerun of the same pass — same site, data digest,
+    dtype, tile plan, and ``pass_tag`` (the fingerprint) — picks up at
+    the cursor and skips the already-folded uploads entirely, and a
+    mismatched checkpoint is ignored, never trusted. Consumers that run
+    SEVERAL folds over the same site and data (the range finder's power
+    iterations) must pass a distinct ``pass_tag`` per fold, or later
+    passes could resume an earlier pass's snapshot. A completed pass
+    deletes its checkpoint. Resumed results are bit-identical to an
+    uninterrupted pass: the npz round-trip is lossless and the remaining
+    tiles replay the same kernels in the same order.
     """
+    Xn = np.asarray(X)
+    canonical = jax.dtypes.canonicalize_dtype(Xn.dtype)
+    if Xn.dtype != canonical:
+        Xn = Xn.astype(canonical)
     if device is not None:
         init = jax.tree.map(lambda a: jax.device_put(a, device), init)
     acc = init
-    with _obs.span("streaming.stream_fold", site=site):
-        for tile, n_valid, start in stream_tiles(X, max_bytes, device, put,
-                                                 multiple, site=site):
+    strict = _strict_guard()
+    ckpt = _resolve_checkpoint(checkpoint, site)
+    start_tile = 0
+    n_tiles = fingerprint = None
+    if ckpt is not None:
+        from .utils.checkpoint import load_stream_state, save_stream_state
+
+        n = Xn.shape[0]
+        rows, n_tiles = plan_row_tiles(n, Xn.nbytes // max(1, n), max_bytes,
+                                       multiple)
+        fingerprint = (f"v1|{site}|tag={pass_tag}|shape={Xn.shape}"
+                       f"|dtype={Xn.dtype}|rows={rows}|multiple={multiple}"
+                       f"|data={_data_digest(Xn):08x}")
+        loaded = load_stream_state(ckpt.path, init, fingerprint)
+        if loaded is not None:
+            host_acc, start_tile = loaded
+            acc = jax.tree.map(_restore_leaf, host_acc, init)
+            _obs.gauge("resilience.resume_cursor", start_tile, site=site)
+            _obs.counter_add("resilience.resumed_passes", 1)
+    with _obs.span("streaming.stream_fold", site=site,
+                   resumed_from=start_tile or None):
+        i = start_tile
+        for tile, n_valid, start in stream_tiles(
+                Xn, max_bytes, device, put, multiple, site=site,
+                start_tile=start_tile):
             if with_offsets:
                 acc = step(acc, tile, n_valid, start)
             else:
                 acc = step(acc, tile)
+            i += 1
+            if strict:
+                _check_finite(acc, site, i - 1, start, n_valid)
+            if ckpt is not None and i < n_tiles and i % ckpt.every == 0:
+                host = jax.tree.map(lambda a: np.asarray(a), acc)
+                save_stream_state(ckpt.path, host, i, fingerprint)
+    if ckpt is not None and os.path.exists(ckpt.path):
+        # a finished pass must not leave state a LATER same-tagged pass
+        # (or a rerun) could mistake for its own mid-pass snapshot
+        os.remove(ckpt.path)
     if _obs.enabled() and site is not None and site in _KERNEL_SITES:
         # track() is idempotent (first call anchors the compile baseline);
         # re-calling here covers a recorder enabled mid-pass
@@ -354,7 +508,8 @@ def kernel_cache_sizes():
 # ---------------------------------------------------------------------------
 
 
-def streamed_centered_gram(X, *, max_bytes=None, device=None):
+def streamed_centered_gram(X, *, max_bytes=None, device=None,
+                           checkpoint=None):
     """(mean, G_centered, n) of host data, built tile-by-tile — X is never
     resident on device.
 
@@ -363,7 +518,8 @@ def streamed_centered_gram(X, *, max_bytes=None, device=None):
     ``Xcᵀ·Xc = XᵀX − n·mean·meanᵀ`` (exact in exact arithmetic; in f32 it
     trades the monolithic path's last-ulp agreement for never holding X —
     fine at explained-variance scale, not for σ ≈ 0 tails of badly
-    uncentered data)."""
+    uncentered data). ``checkpoint`` (or ``SQ_STREAM_CKPT_DIR``) makes
+    the Gram pass resumable — see :func:`stream_fold`."""
     X = np.asarray(X)
     n, m = X.shape
     dtype = jax.dtypes.canonicalize_dtype(X.dtype)
@@ -371,7 +527,8 @@ def streamed_centered_gram(X, *, max_bytes=None, device=None):
     with _obs.span("streaming.centered_gram", n=n, m=m):
         G, colsum = stream_fold(X, _gram_colsum_step, init,
                                 max_bytes=max_bytes, device=device,
-                                site="streaming.gram_colsum")
+                                site="streaming.gram_colsum",
+                                checkpoint=checkpoint)
         mean, Gc = _finalize_centered_gram(G, colsum, n)
     return mean, Gc, n
 
@@ -454,11 +611,15 @@ def streamed_randomized_svd(key, X, n_components, *, n_oversamples=10,
         mean = colsum / n
 
     Q = jax.random.normal(key, (m, size), dtype=dtype)
-    for _ in range(max(1, int(n_iter))):
+    for it in range(max(1, int(n_iter))):
+        # pass_tag: the power iterations are same-site, same-data folds —
+        # without a distinct tag, iteration k could resume iteration j's
+        # checkpoint after a mid-sweep interrupt
         F = stream_fold(X, functools.partial(_matmul_accum_step, Q=Q),
                         jnp.zeros((m, size), dtype),
                         max_bytes=max_bytes, device=device,
-                        site="streaming.matmul_accum")
+                        site="streaming.matmul_accum",
+                        pass_tag=f"power_iter_{it}")
         if center:
             # (Xcᵀ·Xc)·Q = AᵀA·Q − n·mean·(meanᵀ·Q)
             F = F - n * jnp.outer(mean, mean @ Q)

@@ -1,6 +1,7 @@
 """Numeric and plumbing utilities (reference layer L1, ``sklearn/utils/``)."""
 
-from .checkpoint import load_estimator, load_pytree, save_estimator, save_pytree
+from .checkpoint import (load_estimator, load_pytree, load_stream_state,
+                         save_estimator, save_pytree, save_stream_state)
 from .keys import as_key, key_iter, split
 from ._show_versions import show_versions
 from .validation import (
@@ -22,5 +23,7 @@ __all__ = [
     "load_estimator",
     "save_pytree",
     "load_pytree",
+    "save_stream_state",
+    "load_stream_state",
     "show_versions",
 ]

@@ -163,3 +163,58 @@ def load_pytree(path, like):
     tree = jax.tree_util.tree_unflatten(treedef, leaves)
     step = int(npz["__step__"]) if "__step__" in npz.files else None
     return tree, step
+
+
+# ---------------------------------------------------------------------------
+# streaming-pass checkpoints (resumable tiled passes)
+# ---------------------------------------------------------------------------
+
+
+def save_stream_state(path, acc, cursor, fingerprint):
+    """Checkpoint a streamed pass: the host-snapshotted accumulator pytree
+    plus the tile ``cursor`` (the next tile index to process) and the
+    pass ``fingerprint`` (the caller's identity string — shape, dtype,
+    tile plan, pass sequence — that :func:`load_stream_state` matches
+    against so a stale file can never resume the wrong pass).
+
+    The write is atomic (temp file + ``os.replace``): a wedge or kill
+    mid-write leaves the previous checkpoint intact, never a torn one —
+    the whole point is surviving exactly that kind of death.
+    """
+    leaves, _ = jax.tree_util.tree_flatten(acc)
+    arrays = {f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)}
+    arrays["__cursor__"] = np.asarray(int(cursor))
+    arrays["__fingerprint__"] = np.asarray(str(fingerprint))
+    tmp = str(path) + ".tmp.npz"
+    np.savez(tmp, **arrays)
+    os.replace(tmp, path)
+    return path
+
+
+def load_stream_state(path, like, fingerprint):
+    """Load a streamed-pass checkpoint saved by :func:`save_stream_state`.
+
+    Returns ``(acc_tree, cursor)`` with ``acc_tree`` unflattened against
+    the structure of ``like`` (leaf values ignored), or ``None`` when the
+    file is absent, unreadable, or carries a different ``fingerprint`` /
+    leaf count — a mismatched checkpoint is silently ignored (the pass
+    simply starts fresh), never trusted.
+    """
+    if not os.path.exists(path):
+        return None
+    try:
+        npz = np.load(path, allow_pickle=False)
+    except Exception:
+        return None
+    with npz:
+        if "__fingerprint__" not in npz.files or "__cursor__" not in npz.files:
+            return None
+        if str(npz["__fingerprint__"]) != str(fingerprint):
+            return None
+        treedef = jax.tree_util.tree_structure(like)
+        n = sum(1 for k in npz.files if k.startswith("leaf_"))
+        if treedef.num_leaves != n:
+            return None
+        leaves = [npz[f"leaf_{i}"] for i in range(n)]
+        cursor = int(npz["__cursor__"])
+    return jax.tree_util.tree_unflatten(treedef, leaves), cursor

@@ -1,0 +1,493 @@
+"""Resilience layer (sq_learn_tpu.resilience): deterministic fault
+injection, the supervised transfer path (retry/backoff/deadline), the
+probe-fed circuit breaker, and resumable streaming passes — ISSUE 3's
+contract.
+
+Parity discipline: a fault-injected-and-recovered (or
+interrupted-and-resumed) streamed computation must agree with the
+fault-free one BIT-FOR-BIT — recovery re-runs the same kernels over the
+same tiles in the same order, and the checkpoint's npz round-trip is
+lossless, so tolerance here would hide a real divergence.
+"""
+
+import time
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from sq_learn_tpu import obs, streaming
+from sq_learn_tpu.obs import probe as probe_mod
+from sq_learn_tpu.obs.schema import validate_record
+from sq_learn_tpu.resilience import faults, supervisor
+from sq_learn_tpu.resilience.faults import (FaultSpecError, InjectedFault,
+                                            InjectedInterrupt,
+                                            InjectedTransferError)
+from sq_learn_tpu.resilience.supervisor import (CLOSED, HALF_OPEN, OPEN,
+                                                CircuitBreaker,
+                                                NonFiniteAccumulatorError)
+
+RNG = np.random.default_rng(0)
+# 1003 rows / 150-row tiles: 7 tiles with a ragged tail (same shape
+# discipline as test_streaming)
+X_TALL = (RNG.normal(size=(1003, 16)) + 2.0).astype(np.float32)
+ROW_BYTES = X_TALL.nbytes // X_TALL.shape[0]
+TILE_BYTES = 150 * ROW_BYTES
+
+
+@pytest.fixture(autouse=True)
+def _fresh_resilience_state(monkeypatch):
+    """Every test starts disarmed with a closed, history-free breaker and
+    fast retries; probe caching is scoped away from the shared /tmp
+    file so tests can neither read nor leave cross-process state."""
+    monkeypatch.setenv("SQ_RETRY_BACKOFF_S", "0.001")
+    monkeypatch.setenv("SQ_PROBE_CACHE", "/dev/null/nonexistent")
+    monkeypatch.setattr(probe_mod, "last_probe", None)
+    monkeypatch.setattr(probe_mod, "_last_probe_t", None)
+    yield
+    faults.disarm()
+    br = supervisor.breaker
+    br.trip_action = supervisor._cpu_escape
+    br.reset()
+    br.transitions.clear()
+    br.trips = 0
+
+
+# -- fault spec grammar ------------------------------------------------------
+
+
+class TestFaultSpec:
+    def test_parse_multi_clause(self):
+        plan = faults.FaultPlan(
+            "put_fail:tiles=2/5,times=2;put_stall:p=0.5,s=0.1,seed=7;"
+            "nan:tiles=1;abort:tile=4;probe_timeout:n=3")
+        kinds = [inj.kind for inj in plan.injectors]
+        assert kinds == ["put_fail", "put_stall", "nan", "abort",
+                         "probe_timeout"]
+        assert plan.injectors[0].tiles == {2, 5}
+        assert plan.injectors[0].times == 2
+        assert plan.injectors[1].p == 0.5 and plan.injectors[1].seed == 7
+        assert plan.injectors[3].tile == 4
+        assert plan.injectors[4].count == 3
+
+    @pytest.mark.parametrize("bad", [
+        "", "wedge_everything", "put_fail:frequency=2",
+        "put_fail:tiles", "put_stall:s=often"])
+    def test_malformed_specs_raise(self, bad):
+        with pytest.raises(FaultSpecError):
+            faults.parse_spec(bad)
+
+    def test_arm_disarm_roundtrip(self):
+        assert not faults.active()
+        plan = faults.arm("put_fail:tiles=0")
+        assert faults.active() and faults.get_plan() is plan
+        assert faults.disarm() is plan
+        assert not faults.active()
+
+    def test_probabilistic_selection_is_deterministic(self):
+        picks = [
+            [t for t in range(64)
+             if faults.FaultPlan("nan:p=0.25,seed=3").injectors[0].matches(t)]
+            for _ in range(2)]
+        assert picks[0] == picks[1]
+        assert 4 < len(picks[0]) < 28  # ~16 expected of 64
+
+
+# -- zero-overhead no-op path ------------------------------------------------
+
+
+class TestDisabledOverhead:
+    def test_unarmed_hooks_are_single_attribute_reads(self):
+        assert faults._active is None
+        assert supervisor.breaker._state == CLOSED
+
+    def test_supervised_put_fast_path_micro(self):
+        """SQ_FAULTS off + closed breaker: the supervised put is a timed
+        raw call — pinned like the obs recorder's disabled overhead
+        (~1 µs/op would already be far above the observed cost; the
+        bound is loose against host noise)."""
+        tile = np.zeros(4, np.float32)
+        n = 100_000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            supervisor.put(lambda t: t, tile)
+        elapsed = time.perf_counter() - t0
+        assert elapsed < 2.0, f"supervised-put overhead too high: {elapsed:.3f}s"
+
+
+# -- retry / backoff ---------------------------------------------------------
+
+
+class TestRetry:
+    def test_transient_failure_recovers_with_parity(self):
+        mean_ref, Gc_ref, _ = streaming.streamed_centered_gram(
+            X_TALL, max_bytes=TILE_BYTES)
+        plan = faults.arm("put_fail:tiles=2,times=2")
+        mean_f, Gc_f, _ = streaming.streamed_centered_gram(
+            X_TALL, max_bytes=TILE_BYTES)
+        assert [ev["kind"] for ev in plan.events] == ["put_fail", "put_fail"]
+        # recovery is a re-put of the same tile: results are bit-identical
+        np.testing.assert_array_equal(np.asarray(Gc_f), np.asarray(Gc_ref))
+        np.testing.assert_array_equal(np.asarray(mean_f),
+                                      np.asarray(mean_ref))
+        assert supervisor.breaker.state() == CLOSED
+        assert supervisor.breaker.consecutive_failures == 0
+
+    def test_retries_exhausted_raises_terminal(self, monkeypatch):
+        monkeypatch.setenv("SQ_RETRY_MAX", "2")
+        monkeypatch.setenv("SQ_BREAKER_K", "99")  # keep it from tripping
+        faults.arm("put_fail:tiles=0,times=10")
+        with pytest.raises(InjectedTransferError):
+            streaming.streamed_centered_gram(X_TALL, max_bytes=TILE_BYTES)
+        assert supervisor.breaker.consecutive_failures == 3  # 1 + 2 retries
+
+    def test_backoff_deterministic_and_exponential(self):
+        d0 = supervisor.backoff_delay(0, tile_index=3, seed=1)
+        d1 = supervisor.backoff_delay(1, tile_index=3, seed=1)
+        d2 = supervisor.backoff_delay(2, tile_index=3, seed=1)
+        assert d0 == supervisor.backoff_delay(0, tile_index=3, seed=1)
+        base = 0.001  # SQ_RETRY_BACKOFF_S from the fixture
+        for attempt, d in enumerate((d0, d1, d2)):
+            assert base * 2 ** attempt <= d < 2 * base * 2 ** attempt
+        assert supervisor.backoff_delay(0, tile_index=4, seed=1) != d0
+
+    def test_injected_faults_recorded_as_jsonl(self, tmp_path):
+        path = str(tmp_path / "faults.jsonl")
+        rec = obs.enable(path)
+        try:
+            faults.arm("put_fail:tiles=1,times=1")
+            streaming.streamed_centered_gram(X_TALL, max_bytes=TILE_BYTES)
+            assert len(rec.fault_events) == 1
+            for ev in rec.fault_events:
+                assert validate_record(ev) == []
+            assert rec.counters.get("resilience.retries", 0) == 1
+        finally:
+            obs.disable()
+
+
+# -- circuit breaker ---------------------------------------------------------
+
+
+class TestCircuitBreaker:
+    def _fresh(self, monkeypatch, k=2, cooldown=10.0):
+        monkeypatch.setenv("SQ_BREAKER_K", str(k))
+        monkeypatch.setenv("SQ_BREAKER_COOLDOWN_S", str(cooldown))
+        clock = {"t": 100.0}
+        trips = []
+        br = CircuitBreaker(clock=lambda: clock["t"],
+                            trip_action=lambda: trips.append(True))
+        return br, clock, trips
+
+    def test_trips_after_k_consecutive_failures(self, monkeypatch):
+        br, clock, trips = self._fresh(monkeypatch)
+        br.record_failure("x")
+        assert br.state() == CLOSED and not trips
+        br.record_failure("x")
+        assert br.state() == OPEN and trips == [True]
+        assert br.trips == 1
+
+    def test_success_resets_consecutive_count(self, monkeypatch):
+        br, clock, trips = self._fresh(monkeypatch)
+        br.record_failure("x")
+        br.record_success()
+        br.record_failure("x")
+        assert br.state() == CLOSED and not trips
+
+    def test_half_open_after_cooldown_then_probe_decides(self, monkeypatch):
+        br, clock, trips = self._fresh(monkeypatch)
+        br.record_failure("x")
+        br.record_failure("x")
+        assert br.state() == OPEN
+        clock["t"] += 5.0
+        assert br.state() == OPEN  # cooldown not elapsed
+        clock["t"] += 6.0
+        assert br.state() == HALF_OPEN
+        br.on_probe("timeout")  # trial failed: re-open, cooldown restarts
+        assert br.state() == OPEN
+        clock["t"] += 11.0
+        assert br.state() == HALF_OPEN
+        br.on_probe("ok")
+        assert br.state() == CLOSED
+        states = [t["state"] for t in br.transitions]
+        assert states == [OPEN, HALF_OPEN, OPEN, HALF_OPEN, CLOSED]
+
+    def test_preflight_forces_fresh_probe(self, monkeypatch):
+        monkeypatch.setenv("SQ_BREAKER_K", "1")
+        monkeypatch.setenv("SQ_BREAKER_COOLDOWN_S", "0")
+        br = supervisor.breaker
+        br.trip_action = lambda: None
+        calls = []
+
+        def fake_probe(timeout_s=60, platform=None, force=False):
+            calls.append(force)
+            br.on_probe("ok")
+            return {"outcome": "ok", "latency_s": 0.0, "platform": "x"}
+
+        monkeypatch.setattr(probe_mod, "probe_device", fake_probe)
+        br.record_failure("wedge")  # K=1: trips immediately
+        assert br.preflight("test") == CLOSED
+        assert calls == [True]  # the half-open trial bypassed the cache
+
+    def test_probe_timeouts_trip_and_route_to_cpu(self, monkeypatch, tmp_path):
+        """The acceptance wiring: injected probe timeouts feed the breaker
+        through obs.probe, trip it at K, run the CPU escape, and emit
+        schema-valid breaker JSONL."""
+        monkeypatch.setenv("SQ_BREAKER_K", "2")
+        escapes = []
+        supervisor.breaker.trip_action = lambda: escapes.append(
+            supervisor._cpu_escape())
+        rec = obs.enable(str(tmp_path / "breaker.jsonl"))
+        try:
+            faults.arm("probe_timeout:n=2")
+            probe_mod.probe_device(platform="fakeaccel", force=True)
+            probe_mod.probe_device(platform="fakeaccel", force=True)
+            assert supervisor.breaker.state() == OPEN
+            assert escapes == [True]  # jax_platforms now pinned to cpu
+            assert jax.default_backend() == "cpu"
+            assert [e["state"] for e in rec.breaker_events] == [OPEN]
+            for ev in rec.breaker_events:
+                assert validate_record(ev) == []
+            assert rec.gauges["resilience.breaker_state"] == OPEN
+        finally:
+            obs.disable()
+
+    def test_deadline_exceeded_counts_as_timeout(self, monkeypatch):
+        monkeypatch.setenv("SQ_TILE_DEADLINE_S", "0.005")
+        monkeypatch.setenv("SQ_BREAKER_K", "2")
+        trips = []
+        supervisor.breaker.trip_action = lambda: trips.append(True)
+        faults.arm("put_stall:p=1,s=0.02,times=1")
+        # every tile stalls past the deadline once: consecutive timeouts
+        # trip the breaker mid-pass, but the data still arrives — the
+        # pass completes with the correct result
+        mean_ref, Gc_ref, _ = streaming.streamed_centered_gram(
+            X_TALL, max_bytes=TILE_BYTES)
+        assert trips == [True]
+        assert supervisor.breaker.state() == OPEN
+        faults.disarm()
+        mean_ok, Gc_ok, _ = streaming.streamed_centered_gram(
+            X_TALL, max_bytes=TILE_BYTES)
+        np.testing.assert_array_equal(np.asarray(Gc_ref), np.asarray(Gc_ok))
+
+
+# -- probe TTL cache ---------------------------------------------------------
+
+
+class TestProbeTTL:
+    def test_cached_within_ttl_no_subprocess(self, monkeypatch):
+        monkeypatch.setenv("SQ_PROBE_TTL_S", "300")
+        monkeypatch.setenv("SQ_BREAKER_K", "99")
+        faults.arm("probe_timeout:n=1")
+        first = probe_mod.probe_device(platform="fakeaccel", timeout_s=1)
+        assert first["outcome"] == "timeout" and "cached" not in first
+        faults.disarm()
+
+        def no_subprocess(*a, **kw):  # a cache hit must not spawn
+            raise AssertionError("subprocess probe ran despite warm cache")
+
+        monkeypatch.setattr(probe_mod.subprocess, "run", no_subprocess)
+        second = probe_mod.probe_device(platform="fakeaccel", timeout_s=1)
+        assert second["outcome"] == "timeout" and second["cached"] is True
+
+    def test_cached_result_does_not_refeed_breaker(self, monkeypatch):
+        monkeypatch.setenv("SQ_PROBE_TTL_S", "300")
+        monkeypatch.setenv("SQ_BREAKER_K", "99")
+        faults.arm("probe_timeout:n=1")
+        probe_mod.probe_device(platform="fakeaccel", timeout_s=1)
+        faults.disarm()
+        before = supervisor.breaker.consecutive_failures
+        assert before == 1  # the fresh timeout fed it once
+        probe_mod.probe_device(platform="fakeaccel", timeout_s=1)
+        assert supervisor.breaker.consecutive_failures == before
+
+    def test_force_and_ttl_zero_bypass_cache(self, monkeypatch):
+        monkeypatch.setenv("SQ_BREAKER_K", "99")
+        faults.arm("probe_timeout:n=3")
+        probe_mod.probe_device(platform="fakeaccel", timeout_s=1)
+        forced = probe_mod.probe_device(platform="fakeaccel", timeout_s=1,
+                                        force=True)
+        assert "cached" not in forced  # injector consumed again
+        monkeypatch.setenv("SQ_PROBE_TTL_S", "0")
+        third = probe_mod.probe_device(platform="fakeaccel", timeout_s=1)
+        assert "cached" not in third
+
+    def test_cross_process_cache_file(self, monkeypatch, tmp_path):
+        cache = str(tmp_path / "probe_cache.json")
+        monkeypatch.setenv("SQ_PROBE_CACHE", cache)
+        monkeypatch.setenv("SQ_PROBE_TTL_S", "300")
+        monkeypatch.setenv("SQ_BREAKER_K", "99")
+        import subprocess as sp
+
+        def fake_run(*a, **kw):
+            return sp.CompletedProcess(a, 0)
+
+        monkeypatch.setattr(probe_mod.subprocess, "run", fake_run)
+        first = probe_mod.probe_device(platform="fakeaccel", timeout_s=1)
+        assert first["outcome"] == "ok"
+        # a sibling process = fresh module state; the file serves the hit
+        monkeypatch.setattr(probe_mod, "last_probe", None)
+        monkeypatch.setattr(probe_mod, "_last_probe_t", None)
+        monkeypatch.setattr(probe_mod.subprocess, "run", lambda *a, **kw: (
+            _ for _ in ()).throw(AssertionError("file cache missed")))
+        second = probe_mod.probe_device(platform="fakeaccel", timeout_s=1)
+        assert second["outcome"] == "ok" and second["cached"] is True
+
+
+# -- finiteness guard --------------------------------------------------------
+
+
+class TestStrictFiniteness:
+    def test_nan_tile_raises_with_provenance(self, monkeypatch):
+        monkeypatch.setenv("SQ_RESILIENCE_STRICT", "1")
+        faults.arm("nan:tiles=1")
+        with pytest.raises(NonFiniteAccumulatorError, match="tile 1"):
+            streaming.streamed_centered_gram(X_TALL, max_bytes=TILE_BYTES)
+
+    def test_without_strict_nan_propagates_silently(self):
+        faults.arm("nan:tiles=1")
+        _, Gc, _ = streaming.streamed_centered_gram(X_TALL,
+                                                    max_bytes=TILE_BYTES)
+        assert not np.isfinite(np.asarray(Gc)).all()
+
+
+# -- resumable streaming -----------------------------------------------------
+
+
+class TestResume:
+    def test_interrupt_then_resume_bitwise_parity(self, tmp_path):
+        ckpt = streaming.StreamCheckpoint(str(tmp_path / "gram.npz"),
+                                          every=2)
+        mean_ref, Gc_ref, _ = streaming.streamed_centered_gram(
+            X_TALL, max_bytes=TILE_BYTES)
+        faults.arm("abort:tile=4,times=1")
+        with pytest.raises(InjectedInterrupt):
+            streaming.streamed_centered_gram(X_TALL, max_bytes=TILE_BYTES,
+                                             checkpoint=ckpt)
+        assert (tmp_path / "gram.npz").exists()
+
+        puts = []
+        real_put = jax.device_put
+
+        def recording(x, *a, **kw):
+            puts.append(int(getattr(x, "nbytes", 0)))
+            return real_put(x, *a, **kw)
+
+        jax.device_put, saved = recording, jax.device_put
+        try:
+            mean_r, Gc_r, _ = streaming.streamed_centered_gram(
+                X_TALL, max_bytes=TILE_BYTES, checkpoint=ckpt)
+        finally:
+            jax.device_put = saved
+        # the resumed pass re-uploads only the tiles past the cursor: the
+        # abort fired while tile 4 staged (tile 3 still pending), so tiles
+        # 0-2 folded and the every=2 snapshot left cursor 2 — the rerun
+        # puts tiles 2..6 (5 of 7), never the full walk
+        tile_puts = [s for s in puts if s >= 64 * ROW_BYTES]
+        assert len(tile_puts) == 5
+        np.testing.assert_array_equal(np.asarray(Gc_r), np.asarray(Gc_ref))
+        np.testing.assert_array_equal(np.asarray(mean_r),
+                                      np.asarray(mean_ref))
+        assert not (tmp_path / "gram.npz").exists()  # completed: removed
+
+    def test_mismatched_checkpoint_is_ignored(self, tmp_path):
+        ckpt = streaming.StreamCheckpoint(str(tmp_path / "gram.npz"),
+                                          every=2)
+        faults.arm("abort:tile=4,times=1")
+        with pytest.raises(InjectedInterrupt):
+            streaming.streamed_centered_gram(X_TALL, max_bytes=TILE_BYTES,
+                                             checkpoint=ckpt)
+        faults.disarm()
+        other = X_TALL + 1.0  # different data, same shape/dtype/tile plan
+        mean_ref, Gc_ref, _ = streaming.streamed_centered_gram(
+            other, max_bytes=TILE_BYTES)
+        mean_o, Gc_o, _ = streaming.streamed_centered_gram(
+            other, max_bytes=TILE_BYTES, checkpoint=ckpt)
+        np.testing.assert_array_equal(np.asarray(Gc_o), np.asarray(Gc_ref))
+
+    def test_resumed_qpca_fit_matches_uninterrupted_exactly(
+            self, monkeypatch, tmp_path):
+        """The acceptance scenario end-to-end at estimator level: a
+        streamed qPCA fit interrupted mid-Gram-pass, rerun with the
+        env-armed checkpoint dir, resumes and publishes fitted state
+        identical to the never-interrupted fit."""
+        from sq_learn_tpu.models import QPCA
+
+        monkeypatch.setenv("SQ_STREAM_TILE_BYTES", str(TILE_BYTES))
+        monkeypatch.setenv("SQ_STREAM_CKPT_DIR", str(tmp_path))
+        monkeypatch.setenv("SQ_STREAM_CKPT_EVERY", "2")
+
+        def fit():
+            return QPCA(n_components=3, svd_solver="full", random_state=0,
+                        ingest="streamed").fit(X_TALL)
+
+        ref = fit()
+        faults.arm("abort:tile=4,times=1")
+        with pytest.raises(InjectedInterrupt):
+            fit()
+        assert any(f.suffix == ".npz" for f in tmp_path.iterdir())
+        resumed = fit()
+        for attr in ("mean_", "components_", "singular_values_",
+                     "explained_variance_", "left_sv"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(resumed, attr)),
+                np.asarray(getattr(ref, attr)), err_msg=attr)
+        assert not any(f.suffix == ".npz" for f in tmp_path.iterdir())
+
+    def test_sharded_gram_resume_parity(self, mesh8, tmp_path):
+        from sq_learn_tpu.parallel.streaming import \
+            streamed_centered_gram_sharded
+
+        ckpt = streaming.StreamCheckpoint(str(tmp_path / "gram.npz"),
+                                          every=2)
+        mean_ref, Gc_ref, _ = streamed_centered_gram_sharded(
+            mesh8, X_TALL, max_bytes=TILE_BYTES)
+        faults.arm("abort:tile=4,times=1")
+        with pytest.raises(InjectedInterrupt):
+            streamed_centered_gram_sharded(mesh8, X_TALL,
+                                           max_bytes=TILE_BYTES,
+                                           checkpoint=ckpt)
+        mean_r, Gc_r, _ = streamed_centered_gram_sharded(
+            mesh8, X_TALL, max_bytes=TILE_BYTES, checkpoint=ckpt)
+        np.testing.assert_array_equal(np.asarray(Gc_r), np.asarray(Gc_ref))
+        np.testing.assert_array_equal(np.asarray(mean_r),
+                                      np.asarray(mean_ref))
+
+
+# -- supervised chunked_device_put ------------------------------------------
+
+
+class TestChunkedPutSupervised:
+    def test_transient_failure_recovers(self):
+        from sq_learn_tpu._config import chunked_device_put
+
+        plan = faults.arm("put_fail:tiles=1,times=1")
+        out = chunked_device_put(X_TALL, max_bytes=TILE_BYTES)
+        assert [ev["kind"] for ev in plan.events] == ["put_fail"]
+        np.testing.assert_array_equal(np.asarray(out), X_TALL)
+
+
+# -- schema ------------------------------------------------------------------
+
+
+class TestSchema:
+    def test_fault_and_breaker_records_validate(self):
+        base = {"v": 1, "ts": 1.0}
+        assert validate_record(dict(base, type="fault", kind="put_fail",
+                                    tile=3)) == []
+        assert validate_record(dict(base, type="fault", kind="probe_timeout",
+                                    tile=None)) == []
+        assert validate_record(dict(base, type="breaker", state="open",
+                                    prev="closed", reason="r",
+                                    consecutive=3)) == []
+
+    @pytest.mark.parametrize("rec", [
+        {"type": "fault", "kind": 7, "tile": 1},
+        {"type": "fault", "kind": "x", "tile": "one"},
+        {"type": "breaker", "state": "melted", "prev": "closed",
+         "reason": "r", "consecutive": 1},
+        {"type": "breaker", "state": "open", "prev": "closed",
+         "reason": "r", "consecutive": -1},
+    ])
+    def test_invalid_records_rejected(self, rec):
+        assert validate_record(dict(rec, v=1, ts=1.0)) != []
