@@ -40,7 +40,9 @@ Kinds and their params (every param optional unless noted):
 ``nan``
     Tile corruption: the selected tiles' payload is NaN-poisoned before the
     put — the failure the ``SQ_RESILIENCE_STRICT=1`` finiteness guard
-    exists to catch with tile provenance.
+    exists to catch with tile provenance. Float tiles only: a selected
+    integer tile logs a skipped injection (its event carries ``skipped``)
+    and passes through unmodified.
 ``abort``
     Mid-pass interrupt: raises :class:`InjectedInterrupt` at the tile
     boundary ``tile=K`` (before that tile stages), ``times=N`` (default 1)
@@ -243,11 +245,17 @@ class FaultPlan:
 
     def corrupt(self, tile, tile_index):
         """NaN-poison the selected tiles' payload (returns the tile,
-        corrupted or not)."""
+        corrupted or not). Integer tiles cannot hold NaN — a selected
+        non-float tile records a skipped injection instead of crashing
+        the supervised put from inside the harness."""
         import numpy as np
 
         for inj in self._by_kind("nan"):
             if inj.matches(tile_index):
+                if not np.issubdtype(np.asarray(tile).dtype, np.floating):
+                    self._record("nan", tile_index,
+                                 skipped="non-float dtype")
+                    continue
                 self._record("nan", tile_index)
                 tile = np.array(tile, copy=True)
                 tile.reshape(-1)[:1] = np.nan

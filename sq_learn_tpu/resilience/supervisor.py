@@ -7,11 +7,16 @@ in failure order:
 
 1. **Retry with backoff.** A transient put failure (injected
    :class:`~.faults.InjectedTransferError`, or a real ``RuntimeError`` /
-   ``OSError`` out of the backend) is retried up to ``SQ_RETRY_MAX`` times
-   with exponential backoff ``SQ_RETRY_BACKOFF_S · 2^attempt`` plus keyed
+   ``OSError`` out of the backend — see :func:`_is_transient`; XLA OOM
+   and this package's own control-flow errors are deterministic and
+   never retried) is retried up to ``SQ_RETRY_MAX`` times with
+   exponential backoff ``SQ_RETRY_BACKOFF_S · 2^attempt`` plus keyed
    jitter — deterministic per (tile, attempt), splitmix64 over
    ``SQ_RETRY_SEED``, because even our failure handling follows the
-   explicit-key discipline.
+   explicit-key discipline. The retry contract holds on the FAST path
+   too: with no faults armed and the breaker closed, a real transient
+   error out of the raw put counts as attempt 0 and the remaining
+   attempts run through the same loop.
 2. **Per-tile deadline.** Each attempt is wall-clocked; one that takes
    longer than ``SQ_TILE_DEADLINE_S`` still returns its result (the data
    DID arrive) but counts as a timeout against the breaker — a slow
@@ -32,16 +37,18 @@ in failure order:
    detected by bench preambles and wedges detected mid-stream share one
    state machine.
 
-When no faults are armed and the breaker is closed, :func:`put` is one
-``perf_counter`` pair around the raw put — no allocation, no recording —
-so the supervised path costs nothing measurable per tile (pinned by
-``tests/test_resilience.py``).
+When no faults are armed and the breaker is closed, :func:`put`'s
+success path is one ``perf_counter`` pair around the raw put — no
+allocation, no recording — so the supervised path costs nothing
+measurable per tile (pinned by ``tests/test_resilience.py``). Failure
+handling is never skipped: the fast path only skips injection hooks and
+per-attempt bookkeeping, not the retry/breaker machinery.
 """
 
 import os
 import time
 
-from .faults import InjectedTransferError, _u01
+from .faults import InjectedFault, InjectedTransferError, _u01
 from . import faults as _faults
 
 __all__ = [
@@ -57,15 +64,38 @@ __all__ = [
 
 CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
 
-#: exceptions the retry loop treats as transient transfer failures; jax
-#: backend errors (XlaRuntimeError) derive from RuntimeError
-_TRANSIENT = (InjectedTransferError, RuntimeError, OSError)
+#: message markers of deterministic backend RuntimeErrors: XLA surfaces
+#: OOM as an XlaRuntimeError whose message carries the status name, and
+#: an allocation that failed once fails on every retry
+_NON_TRANSIENT_MARKERS = ("RESOURCE_EXHAUSTED", "out of memory",
+                          "Out of memory")
 
 
 class NonFiniteAccumulatorError(RuntimeError):
     """A streamed accumulator went non-finite under
     ``SQ_RESILIENCE_STRICT=1``; the message carries the tile provenance
     (site, tile index, row range) of the first bad tile."""
+
+
+def _is_transient(exc):
+    """Should the retry loop absorb ``exc``? Injected transfer failures
+    and OS-level errors always; backend ``RuntimeError``s (jaxlib's
+    ``XlaRuntimeError`` derives from it) unless they are deterministic —
+    XLA OOM recurs on every attempt, and retrying it burns
+    ``SQ_RETRY_MAX`` backoffs before K of them trip the breaker's
+    process-global CPU repin on a sizing mistake rather than a wedge.
+    Package-internal control flow (:class:`~.faults.InjectedInterrupt`,
+    :class:`NonFiniteAccumulatorError`) is never a transfer failure."""
+    if isinstance(exc, InjectedTransferError):
+        return True
+    if isinstance(exc, (InjectedFault, NonFiniteAccumulatorError)):
+        return False
+    if isinstance(exc, OSError):
+        return True
+    if isinstance(exc, RuntimeError):
+        msg = str(exc)
+        return not any(m in msg for m in _NON_TRANSIENT_MARKERS)
+    return False
 
 
 def _retries():
@@ -114,6 +144,13 @@ class CircuitBreaker:
     JSONL record and a ``resilience.breaker_state`` gauge when a recorder
     is active. ``clock`` is injectable so the cooldown is unit-testable
     without sleeping.
+
+    ``trip_action`` is a PROCESS-GLOBAL side effect: the default
+    :func:`_cpu_escape` repins ``jax_platforms`` for every subsequent
+    computation in the process, not just the failing pass — which is why
+    only transient transfer failures and timeouts may feed
+    :meth:`record_failure` (:func:`_is_transient` keeps deterministic
+    errors out).
     """
 
     def __init__(self, clock=time.monotonic, trip_action=_cpu_escape):
@@ -226,14 +263,27 @@ breaker = CircuitBreaker()
 def put(put_fn, tile, tile_index=0, site=None):
     """Run one supervised placement ``put_fn(tile)``.
 
-    The fast path (no faults armed, breaker closed) is a timed raw call;
-    anything else goes through the full retry/backoff/injection loop.
-    Always returns ``put_fn``'s result or raises its terminal error after
+    The fast path (no faults armed, breaker closed — the normal
+    production state) is a timed raw call on success, but its failure
+    handling is the same retry loop as the supervised path: a real
+    transient backend error counts as attempt 0, feeds the breaker, and
+    the remaining attempts run through :func:`_put_supervised`. Always
+    returns ``put_fn``'s result or raises its terminal error after
     retries are exhausted.
     """
     if _faults._active is None and breaker._state == CLOSED:
         t0 = time.perf_counter()
-        out = put_fn(tile)
+        try:
+            out = put_fn(tile)
+        except Exception as exc:
+            if not _is_transient(exc):
+                raise
+            # the production relay-wedge shape: a real transient error
+            # with no faults armed — hand the remaining attempts to the
+            # supervised loop (this raw call was attempt 0)
+            _pre_retry(exc, site, 0, tile_index)
+            return _put_supervised(put_fn, tile, tile_index, site,
+                                   first_attempt=1)
         elapsed = time.perf_counter() - t0
         if elapsed > _deadline_s():
             breaker.record_timeout(site=site, elapsed=elapsed)
@@ -243,11 +293,24 @@ def put(put_fn, tile, tile_index=0, site=None):
     return _put_supervised(put_fn, tile, tile_index, site)
 
 
-def _put_supervised(put_fn, tile, tile_index, site):
+def _pre_retry(exc, site, attempt, tile_index):
+    """Bookkeeping between a failed transient attempt and its retry:
+    feed the breaker, count the retry, sleep the keyed backoff.
+    Re-raises ``exc`` when the failed attempt was the last one allowed."""
+    breaker.record_failure(type(exc).__name__, site=site)
+    if attempt >= _retries():
+        raise exc
+    from ..obs import recorder
+
+    recorder.counter_add("resilience.retries", 1)
+    time.sleep(backoff_delay(attempt, tile_index))
+
+
+def _put_supervised(put_fn, tile, tile_index, site, first_attempt=0):
     plan = _faults._active
     deadline = _deadline_s()
-    retries = _retries()
-    for attempt in range(retries + 1):
+    attempt = first_attempt
+    while True:
         try:
             t0 = time.perf_counter()
             payload = tile
@@ -255,14 +318,11 @@ def _put_supervised(put_fn, tile, tile_index, site):
                 payload = plan.corrupt(tile, tile_index)
                 plan.on_put(tile_index)  # may stall (timed) or raise
             out = put_fn(payload)
-        except _TRANSIENT as exc:
-            breaker.record_failure(type(exc).__name__, site=site)
-            if attempt >= retries:
+        except Exception as exc:
+            if not _is_transient(exc):
                 raise
-            from ..obs import recorder
-
-            recorder.counter_add("resilience.retries", 1)
-            time.sleep(backoff_delay(attempt, tile_index))
+            _pre_retry(exc, site, attempt, tile_index)  # raises on last
+            attempt += 1
             continue
         elapsed = time.perf_counter() - t0
         if elapsed > deadline:

@@ -240,21 +240,34 @@ class StreamCheckpoint:
             raise ValueError(f"checkpoint every must be >= 1, got {every}")
 
 
-def _data_digest(Xn):
-    """Cheap content fingerprint of the pass's input: CRC over the first
-    and last row plus the shape. Folded into the checkpoint fingerprint so
-    a checkpoint can only ever resume the same pass over the same data —
-    O(row) cost, paid once per checkpointed pass."""
+def _data_digest(Xn, max_rows=64):
+    """Content fingerprint of the pass's input: CRC32 over an evenly
+    strided sample of up to ``max_rows`` rows, always including the first
+    and last. Folded into the checkpoint fingerprint so a checkpoint
+    resumes only a rerun over the same data — it catches the realistic
+    staleness shapes (different dataset, re-shuffled or re-cleaned rows,
+    changed scale), at O(max_rows · row) cost paid once per checkpointed
+    pass. It is NOT content-complete: rows between sample points can in
+    principle differ undetected, so callers who rewrite data in place
+    between runs should clear ``SQ_STREAM_CKPT_DIR`` rather than rely on
+    the digest (datasets with ≤ ``max_rows`` rows ARE hashed fully)."""
     import zlib
 
-    h = zlib.crc32(np.ascontiguousarray(Xn[:1]).tobytes())
-    return zlib.crc32(np.ascontiguousarray(Xn[-1:]).tobytes(), h)
+    n = Xn.shape[0]
+    idx = np.unique(np.linspace(0, max(n - 1, 0), num=min(n, max_rows),
+                                dtype=np.int64))
+    return zlib.crc32(np.ascontiguousarray(Xn[idx]).tobytes())
 
 
 def _resolve_checkpoint(checkpoint, site):
     """An explicit ``checkpoint`` wins; else ``SQ_STREAM_CKPT_DIR`` plus a
     ``site`` derives ``<dir>/<site with dots → underscores>.npz``; else
-    checkpointing is off."""
+    checkpointing is off. ``checkpoint=False`` opts the fold out even of
+    the env-derived default — for folds whose accumulator includes a
+    dataset-sized resident buffer, where a periodic host snapshot would
+    be an O(n·m) stall, not resilience."""
+    if checkpoint is False:
+        return None
     if checkpoint is not None:
         if isinstance(checkpoint, StreamCheckpoint):
             return checkpoint
@@ -322,8 +335,11 @@ def stream_fold(X, step, init, *, max_bytes=None, device=None, put=None,
     mismatched checkpoint is ignored, never trusted. Consumers that run
     SEVERAL folds over the same site and data (the range finder's power
     iterations) must pass a distinct ``pass_tag`` per fold, or later
-    passes could resume an earlier pass's snapshot. A completed pass
-    deletes its checkpoint. Resumed results are bit-identical to an
+    passes could resume an earlier pass's snapshot. ``checkpoint=False``
+    opts out even of the env-derived default — required for folds whose
+    accumulator contains a dataset-sized resident buffer (the q-means
+    ingest), where every snapshot would host-sync and write O(n·m)
+    bytes. A completed pass deletes its checkpoint. Resumed results are bit-identical to an
     uninterrupted pass: the npz round-trip is lossless and the remaining
     tiles replay the same kernels in the same order.
     """
@@ -344,7 +360,10 @@ def stream_fold(X, step, init, *, max_bytes=None, device=None, put=None,
         n = Xn.shape[0]
         rows, n_tiles = plan_row_tiles(n, Xn.nbytes // max(1, n), max_bytes,
                                        multiple)
-        fingerprint = (f"v1|{site}|tag={pass_tag}|shape={Xn.shape}"
+        # v2: the data digest grew from first/last-row to a strided
+        # sample — the version bump keeps a v1 checkpoint from ever
+        # matching by coincidence
+        fingerprint = (f"v2|{site}|tag={pass_tag}|shape={Xn.shape}"
                        f"|dtype={Xn.dtype}|rows={rows}|multiple={multiple}"
                        f"|data={_data_digest(Xn):08x}")
         loaded = load_stream_state(ckpt.path, init, fingerprint)
@@ -675,10 +694,16 @@ def streamed_prestats(X, *, quantum=False, mu_grid=(), mu_blocked=False,
     n_pad = padded_rows(n, X.nbytes // max(1, n), max_bytes)
     init = (jnp.zeros((n_pad, m), dtype), jnp.zeros((m,), dtype),
             jnp.zeros((m,), dtype))
+    # checkpoint=False: the accumulator IS the (n_pad, m) resident
+    # buffer, so an env-derived checkpoint would host-sync and write a
+    # dataset-sized npz every SQ_STREAM_CKPT_EVERY tiles — an O(n·m)
+    # periodic stall, not resilience. Mid-fit recovery for q-means lives
+    # at the Lloyd level (utils/checkpoint.save_pytree), not here.
     buf, colsum, sqsum = stream_fold(X, _ingest_step, init,
                                      max_bytes=max_bytes, device=device,
                                      with_offsets=True,
-                                     site="streaming.ingest")
+                                     site="streaming.ingest",
+                                     checkpoint=False)
     out = {}
     if quantum:
         # the quantum runtime-model stats read the UNCENTERED matrix;

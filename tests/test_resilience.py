@@ -85,6 +85,21 @@ class TestFaultSpec:
         assert faults.disarm() is plan
         assert not faults.active()
 
+    def test_nan_injection_skips_integer_tiles(self):
+        """NaN cannot be assigned into an integer tile — a selected
+        non-float tile must pass through unmodified with a skipped-event
+        record, not crash the supervised put from inside the harness."""
+        plan = faults.FaultPlan("nan:tiles=0/1")
+        int_tile = np.arange(6, dtype=np.int32).reshape(2, 3)
+        out = plan.corrupt(int_tile, 0)
+        np.testing.assert_array_equal(out, int_tile)
+        float_tile = np.ones((2, 3), np.float32)
+        poisoned = plan.corrupt(float_tile, 1)
+        assert np.isnan(poisoned).any()
+        assert np.isfinite(float_tile).all()  # original untouched
+        assert [ev.get("skipped") for ev in plan.events] == [
+            "non-float dtype", None]
+
     def test_probabilistic_selection_is_deterministic(self):
         picks = [
             [t for t in range(64)
@@ -132,6 +147,76 @@ class TestRetry:
         np.testing.assert_array_equal(np.asarray(mean_f),
                                       np.asarray(mean_ref))
         assert supervisor.breaker.state() == CLOSED
+        assert supervisor.breaker.consecutive_failures == 0
+
+    @pytest.mark.parametrize("exc_type", [RuntimeError, OSError,
+                                          jax.errors.JaxRuntimeError])
+    def test_fast_path_retries_real_transient_errors(self, exc_type):
+        """No faults armed, breaker closed — the normal production state:
+        a REAL transient backend error out of the raw put must retry and
+        feed the breaker, not propagate after a single attempt (the
+        relay-wedge scenario this layer exists for does not set
+        SQ_FAULTS)."""
+        assert faults._active is None
+        assert supervisor.breaker._state == CLOSED
+        calls = []
+
+        def flaky(t):
+            calls.append(1)
+            if len(calls) < 3:
+                raise exc_type("transient relay hiccup")
+            return t
+
+        out = supervisor.put(flaky, np.ones(4, np.float32))
+        assert len(calls) == 3
+        np.testing.assert_array_equal(np.asarray(out),
+                                      np.ones(4, np.float32))
+        # the final success reset the consecutive count the two real
+        # failures had built up
+        assert supervisor.breaker.consecutive_failures == 0
+
+    def test_fast_path_failures_feed_the_breaker(self, monkeypatch):
+        monkeypatch.setenv("SQ_BREAKER_K", "2")
+        trips = []
+        supervisor.breaker.trip_action = lambda: trips.append(True)
+        calls = []
+
+        def flaky(t):
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("connection reset by relay")
+            return t
+
+        supervisor.put(flaky, np.ones(2, np.float32))
+        # two consecutive real failures tripped at K=2, mid-retry
+        assert len(calls) == 3 and trips == [True]
+        assert supervisor.breaker.state() == OPEN
+
+    @pytest.mark.parametrize("armed", [False, True])
+    @pytest.mark.parametrize("exc", [
+        ValueError("operand shapes incompatible"),
+        TypeError("unhashable sharding"),
+        RuntimeError("RESOURCE_EXHAUSTED: Out of memory while trying to "
+                     "allocate 2147483648 bytes"),
+        NonFiniteAccumulatorError("non-finite accumulator leaf 0"),
+        InjectedInterrupt("injected mid-pass interrupt"),
+    ])
+    def test_deterministic_errors_never_retry(self, exc, armed):
+        """Shape/dtype mistakes, XLA OOM, and package-internal control
+        flow recur on every attempt: one call, no breaker feeding, no
+        backoff sleeps — K of them must never repin the process to CPU
+        (the trip action is process-global)."""
+        if armed:
+            faults.arm("probe_timeout:n=1")  # forces the supervised path
+        calls = []
+
+        def broken(t):
+            calls.append(1)
+            raise exc
+
+        with pytest.raises(type(exc)):
+            supervisor.put(broken, np.ones(2, np.float32))
+        assert len(calls) == 1
         assert supervisor.breaker.consecutive_failures == 0
 
     def test_retries_exhausted_raises_terminal(self, monkeypatch):
@@ -404,6 +489,51 @@ class TestResume:
         mean_o, Gc_o, _ = streaming.streamed_centered_gram(
             other, max_bytes=TILE_BYTES, checkpoint=ckpt)
         np.testing.assert_array_equal(np.asarray(Gc_o), np.asarray(Gc_ref))
+
+    def test_interior_data_change_invalidates_checkpoint(self, tmp_path):
+        """Re-shuffled/re-cleaned interior rows with identical first and
+        last rows must NOT resume a stale accumulator — the strided-sample
+        digest catches what the old first/last-row digest let through."""
+        ckpt = streaming.StreamCheckpoint(str(tmp_path / "gram.npz"),
+                                          every=2)
+        faults.arm("abort:tile=4,times=1")
+        with pytest.raises(InjectedInterrupt):
+            streaming.streamed_centered_gram(X_TALL, max_bytes=TILE_BYTES,
+                                             checkpoint=ckpt)
+        faults.disarm()
+        other = X_TALL.copy()
+        other[1:-1] = X_TALL[-2:0:-1]  # reverse the interior rows only
+        np.testing.assert_array_equal(other[0], X_TALL[0])
+        np.testing.assert_array_equal(other[-1], X_TALL[-1])
+        assert streaming._data_digest(other) != streaming._data_digest(
+            X_TALL)
+        mean_ref, Gc_ref, _ = streaming.streamed_centered_gram(
+            other, max_bytes=TILE_BYTES)
+        mean_o, Gc_o, _ = streaming.streamed_centered_gram(
+            other, max_bytes=TILE_BYTES, checkpoint=ckpt)
+        np.testing.assert_array_equal(np.asarray(Gc_o), np.asarray(Gc_ref))
+        np.testing.assert_array_equal(np.asarray(mean_o),
+                                      np.asarray(mean_ref))
+
+    def test_prestats_ingest_opts_out_of_env_checkpointing(
+            self, monkeypatch, tmp_path):
+        """streamed_prestats' accumulator is the dataset-sized resident
+        buffer: with SQ_STREAM_CKPT_DIR armed it must write NO checkpoint
+        (each snapshot would be an O(n·m) host sync + npz)."""
+        from sq_learn_tpu.utils import checkpoint as ckpt_mod
+
+        monkeypatch.setenv("SQ_STREAM_CKPT_DIR", str(tmp_path))
+        monkeypatch.setenv("SQ_STREAM_CKPT_EVERY", "1")
+
+        def no_snapshot(*a, **kw):
+            raise AssertionError("ingest fold wrote a checkpoint")
+
+        monkeypatch.setattr(ckpt_mod, "save_stream_state", no_snapshot)
+        out = streaming.streamed_prestats(X_TALL, max_bytes=TILE_BYTES)
+        assert not list(tmp_path.iterdir())
+        np.testing.assert_allclose(np.asarray(out["mean"]),
+                                   X_TALL.mean(axis=0), rtol=1e-5,
+                                   atol=1e-5)
 
     def test_resumed_qpca_fit_matches_uninterrupted_exactly(
             self, monkeypatch, tmp_path):
