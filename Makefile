@@ -8,7 +8,8 @@ PYTHON ?= python
 SHELL := /bin/bash
 
 .PHONY: test test-fast lint bench bench-smoke bench-suite multichip examples \
-    hunt obs-smoke faults-smoke smoke all
+    hunt obs-smoke faults-smoke regress-selftest smoke obs-report \
+    obs-trace regress all
 
 all: lint test
 
@@ -93,8 +94,33 @@ faults-smoke:
 	env SQ_OBS=1 SQ_OBS_PATH=/tmp/sq_faults_smoke.jsonl \
 	    $(PYTHON) -m sq_learn_tpu.resilience.smoke
 
-# Both contract smokes (observability + resilience) in one target.
-smoke: obs-smoke faults-smoke
+# Regression-gate self-test: a REAL forced-retracing injection (shape
+# leaked into a tracked jit) must produce a red compile_count verdict
+# against a clean baseline run, and an unmodified rerun must stay green.
+regress-selftest:
+	$(PYTHON) -m sq_learn_tpu.obs regress --selftest
+
+# All contract smokes (observability + resilience + regression gate).
+smoke: obs-smoke faults-smoke regress-selftest
+
+# Render the human report / Chrome trace of an obs JSONL artifact
+# (default: the obs-smoke artifact; override with OBS=<path>).
+OBS ?= /tmp/sq_obs_smoke.jsonl
+obs-report:
+	$(PYTHON) -m sq_learn_tpu.obs report $(OBS)
+
+obs-trace:
+	$(PYTHON) -m sq_learn_tpu.obs trace $(OBS) -o $(OBS).trace.json
+
+# Perf-regression gate, standalone: run the headline bench under SQ_OBS=1
+# and band its line (latency, compile_count, total_transfer_bytes, peak
+# HBM) against the committed BENCH_r*.json trajectory + bench/records
+# history. Exit 1 on any red verdict.
+regress:
+	env SQ_OBS=1 SQ_OBS_PATH=/tmp/sq_regress_obs.jsonl \
+	    $(PYTHON) bench.py > /tmp/sq_regress_bench.json
+	cat /tmp/sq_regress_bench.json
+	$(PYTHON) -m sq_learn_tpu.obs regress /tmp/sq_regress_bench.json --root .
 
 # Full BASELINE suite (headline + configs #2-#5) into one record file.
 bench-suite:

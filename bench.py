@@ -97,7 +97,24 @@ def main():
         print(f"# sklearn={sk_time:.4f}s ARI(median over 3 seeds)={ari:.3f} "
               f"inertia ratio={inertia_ratio:.5f}", file=sys.stderr)
     # SQ_OBS=1: the headline line gains compile/transfer/probe totals so
-    # BENCH_*.json tracks observability regressions alongside latency
+    # BENCH_*.json tracks observability regressions alongside latency.
+    # The MFU gauge is priced first so the snapshot's measured_mfu field
+    # carries this fit's number: FLOPs = the Lloyd E+M GEMMs at this
+    # shape × the iterations the timed fit actually ran × restarts
+    # (utils/profiling.lloyd_iter_flops — the same roofline accounting
+    # bench_pallas_mfu uses), over the measured wall-clock.
+    try:
+        from sq_learn_tpu import obs as _sqobs
+
+        if _sqobs.enabled():
+            from sq_learn_tpu.utils import profiling
+
+            n_iter = max(1, int(getattr(est, "n_iter_", 1)))
+            fit_flops = (profiling.lloyd_iter_flops(*X.shape, k)
+                         * n_iter * n_init)
+            profiling.mfu(fit_flops, ours)
+    except Exception:
+        pass  # the headline line must print even if pricing fails
     from bench._common import obs_snapshot
 
     snap = obs_snapshot()

@@ -14,6 +14,14 @@ primary run and the CPU retry died) fails the gate: a missing number is
 not a passing number. Likewise ``vs_baseline: null`` ("no baseline was
 measured") counts as a miss, never as a free 1.0 pass.
 
+Output is dual: the historical ``# ACCEPT`` comment per metric (humans,
+and the committed records that grep for it) plus one machine-readable
+``{"gate": ..., "verdict": ...}`` JSON line per criterion — per metric
+(gate ``vs_baseline``) and one ``counts`` line for the
+expected-vs-present config totals — so downstream tooling (the
+perf-regression analyzer, CI annotations) consumes verdicts without
+parsing prose.
+
 Exit status 0 = gate green; non-zero with a diagnostic on stderr
 otherwise. Lives in its own module (rather than inline in run_suite.sh)
 so the counting rules are unit-testable (``tests/test_bench_gate.py``).
@@ -25,7 +33,8 @@ import sys
 
 def check(record_path, expected_measured, expected_derived, out=sys.stdout):
     """Return (fails, measured_count, derived_count) for a record file,
-    printing one ``# ACCEPT`` line per metric to ``out``."""
+    printing one ``# ACCEPT`` comment AND one ``{"gate": ...}`` JSON
+    line per metric to ``out``."""
     fails, measured, derived = [], 0, 0
     for line in open(record_path):
         line = line.strip()
@@ -46,6 +55,10 @@ def check(record_path, expected_measured, expected_derived, out=sys.stdout):
         ok = isinstance(vb, (int, float)) and vb >= 0.5
         print(f"# ACCEPT {'pass' if ok else 'FAIL'}: {rec['metric']} "
               f"({kind}) vs_baseline={vb}", file=out)
+        print(json.dumps({
+            "gate": "vs_baseline", "metric": rec["metric"], "kind": kind,
+            "value": vb, "threshold": 0.5,
+            "verdict": "pass" if ok else "fail"}), file=out)
         if not ok:
             fails.append(rec["metric"])
     return fails, measured, derived
@@ -56,7 +69,14 @@ def main(argv=None):
     record_path, exp_measured, exp_derived = (
         argv[0], int(argv[1]), int(argv[2]))
     fails, measured, derived = check(record_path, exp_measured, exp_derived)
-    if fails or measured != exp_measured or derived != exp_derived:
+    counts_ok = (not fails and measured == exp_measured
+                 and derived == exp_derived)
+    print(json.dumps({
+        "gate": "counts", "measured": measured,
+        "expected_measured": exp_measured, "derived": derived,
+        "expected_derived": exp_derived, "fails": fails,
+        "verdict": "pass" if counts_ok else "fail"}))
+    if not counts_ok:
         sys.exit(f"acceptance gate: fails={fails} "
                  f"measured={measured}/{exp_measured} "
                  f"derived={derived}/{exp_derived}")

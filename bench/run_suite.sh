@@ -74,6 +74,18 @@ run_and_record() {  # run_and_record <timeout_s> <header> <cmd...>; returns the 
     grep -a '"type": "\(fault\|breaker\)"' "$obs_dir/${slug}.jsonl" \
       > "$obs_dir/${slug}_resilience.jsonl"
   fi
+  # rendered views of the same artifact, committed next to it: the
+  # Perfetto-loadable trace and the human report (PYTHONPATH cleared so
+  # the axon sitecustomize never touches a wedged relay; the obs CLIs
+  # are file tools and never initialize jax backends)
+  if [ -s "$obs_dir/${slug}.jsonl" ]; then
+    env -u PYTHONPATH timeout 60 python -m sq_learn_tpu.obs trace \
+      "$obs_dir/${slug}.jsonl" -o "$obs_dir/${slug}_trace.json" \
+      >/dev/null 2>&1 || true
+    env -u PYTHONPATH timeout 60 python -m sq_learn_tpu.obs report \
+      "$obs_dir/${slug}.jsonl" > "$obs_dir/${slug}_report.txt" \
+      2>/dev/null || true
+  fi
   return $rc
 }
 
@@ -110,6 +122,17 @@ for cmd in "python bench.py" \
       env -u PYTHONPATH JAX_PLATFORMS=cpu $cmd
   fi
 done
+
+# Perf-regression verdicts: every metric line of this fresh record banded
+# (latency, compile_count, total_transfer_bytes, peak HBM) against the
+# committed BENCH_r*.json trajectory + bench/records history, appended to
+# the record as schema-valid "regression" JSON lines. Report-only here
+# (--no-exit-code): the suite's pass/fail authority stays with the
+# BASELINE acceptance gate below — regression verdicts on a possibly
+# CPU-fallback, load-noisy suite run inform the round, they don't kill it.
+env -u PYTHONPATH timeout 60 python -m sq_learn_tpu.obs regress "$out" \
+  --root . --no-exit-code >> "$out" 2>/dev/null \
+  || echo "# regression analyzer unavailable" >> "$out"
 
 # BASELINE acceptance gate (bench/_gate.py: vs_baseline >= 0.5 on every
 # line, 6 measured + 1 derived line expected — the sixth measured line is

@@ -1214,6 +1214,9 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
             self.ingest_ = "monolithic"
             Xin = (jnp.asarray(X) if self.mesh is not None
                    else as_device_array(X))
+            _obs.xla.capture("qkmeans.fit_prestats", fit_prestats, Xin,
+                             quantum=quantum, mu_grid=mu_grid,
+                             mu_blocked=mu_blocked)
             stats = fit_prestats(Xin, quantum=quantum, mu_grid=mu_grid,
                                  mu_blocked=mu_blocked)
         if quantum:
@@ -1319,6 +1322,9 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
                   true_tomography=self.true_tomography, ipe_q=self.ipe_q,
                   compute_dtype=self._checked_compute_dtype())
         def run(up, itp):
+            _obs.xla.capture("qkmeans.fit_fused", fit_fused,
+                             key, Xd, w, float(self.tol), use_pallas=up,
+                             pallas_interpret=itp, **kw)
             # the fetch stays inside the attempt: dispatch is asynchronous,
             # so a runtime kernel failure surfaces at transfer time
             return np.asarray(fit_fused(
@@ -1702,12 +1708,14 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
 
             return stream_map_rows(X, tile_fn, with_offsets=True)
         Xd = as_device_array(X)
-        labels, _, _ = e_step_jit(
-            key, Xd, jnp.ones(X.shape[0], X.dtype),
-            as_device_array(np.asarray(self.cluster_centers_, X.dtype)),
-            row_norms(Xd, squared=True),
-            delta=delta, mode=mode, ipe_q=self.ipe_q,
-            compute_dtype=self._checked_compute_dtype())
+        e_args = (key, Xd, jnp.ones(X.shape[0], X.dtype),
+                  as_device_array(np.asarray(self.cluster_centers_,
+                                             X.dtype)),
+                  row_norms(Xd, squared=True))
+        e_kw = dict(delta=delta, mode=mode, ipe_q=self.ipe_q,
+                    compute_dtype=self._checked_compute_dtype())
+        _obs.xla.capture("qkmeans.e_step", e_step_jit, *e_args, **e_kw)
+        labels, _, _ = e_step_jit(*e_args, **e_kw)
         return np.asarray(labels)
 
     @with_device_scope

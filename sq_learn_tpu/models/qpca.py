@@ -684,8 +684,13 @@ class QPCA(TransformerMixin, BaseEstimator):
                     X, int(n_components),
                     compute_dtype=check_compute_dtype(self.compute_dtype))
             else:
+                Xd = jnp.asarray(X)
+                _obs.xla.capture(
+                    "qpca.centered_svd_topk", centered_svd_topk, Xd,
+                    int(n_components),
+                    compute_dtype=check_compute_dtype(self.compute_dtype))
                 mean, U, S, Vt = centered_svd_topk(
-                    X, int(n_components),
+                    Xd, int(n_components),
                     compute_dtype=check_compute_dtype(self.compute_dtype))
         else:
             mean, U, S, Vt = centered_svd(X)

@@ -132,10 +132,13 @@ class TruncatedSVD(TransformerMixin, BaseEstimator):
                 as_key(self.random_state), X, k, n_iter=self.n_iter)
         elif self.algorithm == "randomized":
             Xd = as_device_array(X)  # set_config(device=...) placement
-            U, S, Vt = randomized_svd(as_key(self.random_state), Xd, k,
-                                      n_iter=self.n_iter)
+            key = as_key(self.random_state)
+            _obs.xla.capture("truncated_svd.randomized_svd", randomized_svd,
+                             key, Xd, k, n_iter=self.n_iter)
+            U, S, Vt = randomized_svd(key, Xd, k, n_iter=self.n_iter)
         else:  # 'arpack' -> exact thin SVD
             Xd = as_device_array(X)
+            _obs.xla.capture("truncated_svd.thin_svd", thin_svd, Xd)
             U, S, Vt = thin_svd(Xd)
             # V-based: the sign convention every SVD path shares
             U, Vt = svd_flip_v(U, Vt)

@@ -17,10 +17,14 @@ Quickstart::
 
 Env knobs: ``SQ_OBS=1`` auto-enables with a JSONL sink at ``SQ_OBS_PATH``
 (default ``sq_obs.jsonl``); ``SQ_OBS_STRICT=1`` makes watchdog budget
-violations raise instead of warn. Full docs: ``docs/observability.md``.
+violations raise instead of warn; ``SQ_OBS_TRACE=<path>`` renders the
+closing run's JSONL into Chrome trace-event JSON. Analysis tooling:
+``python -m sq_learn_tpu.obs {trace,report,regress}`` and
+:mod:`~sq_learn_tpu.obs.xla` (per-compilation FLOP/byte/peak-HBM
+accounting). Full docs: ``docs/observability.md``.
 """
 
-from . import ledger, probe, schema
+from . import ledger, probe, regress, report, schema, trace, xla
 from .recorder import (NULL_SPAN, Recorder, counter_add, disable, enable,
                        enabled, gauge, get_recorder, record_span, snapshot,
                        span)
@@ -46,8 +50,12 @@ __all__ = [
     "ledger_record",
     "probe",
     "record_span",
+    "regress",
+    "report",
     "schema",
     "snapshot",
     "span",
+    "trace",
     "watchdog",
+    "xla",
 ]

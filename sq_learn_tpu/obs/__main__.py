@@ -1,0 +1,42 @@
+"""CLI dispatcher: ``python -m sq_learn_tpu.obs <trace|report|regress>``.
+
+- ``trace <jsonl> [...] [-o out.json]`` — render a run's JSONL into
+  Chrome trace-event JSON (Perfetto-viewable), merging multiple files
+  onto pid lanes (:mod:`~sq_learn_tpu.obs.trace`).
+- ``report <jsonl> [...] [--json]`` — the human view of a run: top spans
+  by self-time, compiles vs budget, transfer bytes, quantum-ledger vs
+  xla-cost table, fault/breaker timeline
+  (:mod:`~sq_learn_tpu.obs.report`).
+- ``regress <record-file> [--root DIR] [--no-exit-code] | --selftest``
+  — tolerance-banded perf verdicts against the committed bench
+  trajectory (:mod:`~sq_learn_tpu.obs.regress`).
+
+All three subcommands are dependency-free file tools (no jax import on
+the comparison/render paths), safe to run with PYTHONPATH cleared while
+the accelerator relay is wedged.
+"""
+
+import sys
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv:
+        print(__doc__, file=sys.stderr)
+        return 2
+    cmd, rest = argv[0], argv[1:]
+    if cmd == "trace":
+        from .trace import main as run
+    elif cmd == "report":
+        from .report import main as run
+    elif cmd == "regress":
+        from .regress import main as run
+    else:
+        print(f"unknown subcommand {cmd!r} (expected trace, report, or "
+              "regress)", file=sys.stderr)
+        return 2
+    return run(rest)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

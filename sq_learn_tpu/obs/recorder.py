@@ -26,9 +26,12 @@ Design constraints, in order:
    arrays, so their spans are synced by construction.
 
 JSONL schema: one JSON object per line, every line carrying
-``{"v": 1, "ts": <unix seconds>, "type": <record type>}`` plus per-type
-fields — see :mod:`sq_learn_tpu.obs.schema` (the validator) and
-``docs/observability.md`` (the prose).
+``{"v": 2, "schema_version": 2, "ts": <unix seconds>, "type": <record
+type>}`` plus per-type fields — see :mod:`sq_learn_tpu.obs.schema` (the
+validator) and ``docs/observability.md`` (the prose). ``v`` is the
+original envelope key (kept so pre-2 readers don't break);
+``schema_version`` is its explicit alias and the one the validator
+version-gates on.
 """
 
 import json
@@ -36,7 +39,8 @@ import os
 import threading
 import time
 
-SCHEMA_VERSION = 1
+# v2: +xla_cost / regression record types, +schema_version envelope field
+SCHEMA_VERSION = 2
 
 #: default sink path when SQ_OBS=1 and SQ_OBS_PATH is unset
 DEFAULT_PATH = "sq_obs.jsonl"
@@ -149,8 +153,8 @@ class Recorder:
 
     Public views: ``spans``, ``counters``, ``gauges``, ``ledger_entries``,
     ``watchdog_events``, ``probe_events``, ``fault_events``,
-    ``breaker_events`` — all plain Python containers, safe to read at any
-    point in the run.
+    ``breaker_events``, ``xla_cost_records`` — all plain Python
+    containers, safe to read at any point in the run.
     """
 
     def __init__(self, path=None):
@@ -163,6 +167,8 @@ class Recorder:
         self.probe_events = []
         self.fault_events = []
         self.breaker_events = []
+        self.xla_cost_records = []
+        self._xla_seen = set()  # (site, signature) dedup for obs.xla
         self.path = path
         self._seq = 0
         self._sink = None
@@ -180,6 +186,7 @@ class Recorder:
         """Store ``rec`` in-memory (under ``kind``) and append it to the
         sink as one JSON line."""
         rec.setdefault("v", SCHEMA_VERSION)
+        rec.setdefault("schema_version", SCHEMA_VERSION)
         rec.setdefault("ts", round(time.time(), 3))
         with _lock:
             if kind is not None:
@@ -234,14 +241,28 @@ def enable(path=None, reset_watchdog=True):
 
 
 def disable():
-    """Close the current run (flushes the sink). Safe to call when off."""
+    """Close the current run (flushes the sink). Safe to call when off.
+
+    With ``SQ_OBS_TRACE=<path>`` set and the run sinking to a JSONL file,
+    the closed run is additionally rendered into Chrome trace-event JSON
+    at that path (:mod:`sq_learn_tpu.obs.trace`) — best-effort: a failed
+    render never masks the run that produced the records.
+    """
     global _active
     with _lock:
         rec = _active
         _active = None
         if rec is not None:
             rec.close()
-        return rec
+    trace_path = os.environ.get("SQ_OBS_TRACE")
+    if rec is not None and rec.path and trace_path:
+        try:
+            from .trace import write_trace
+
+            write_trace([rec.path], trace_path)
+        except Exception:
+            pass
+    return rec
 
 
 def span(name, sync=None, **attrs):
@@ -312,6 +333,13 @@ def snapshot():
         breaker_state, breaker_trips = breaker.state(), breaker.trips
     except Exception:  # obs must never die on a half-imported package
         breaker_state, breaker_trips = "closed", 0
+    peak_hbm = None
+    for r in rec.xla_cost_records:
+        pb = r.get("peak_bytes")
+        if isinstance(pb, (int, float)) and (peak_hbm is None
+                                             or pb > peak_hbm):
+            peak_hbm = pb
+    mfu_gauge = rec.gauges.get("profiling.mfu")
     return {
         "compile_count": int(compile_count),
         "total_transfer_bytes": int(
@@ -324,10 +352,24 @@ def snapshot():
         "faults_injected": len(rec.fault_events),
         "breaker_state": breaker_state,
         "breaker_trips": int(breaker_trips),
+        # the classical-cost view (obs.xla): peak HBM of the run's most
+        # memory-hungry compiled kernel, and the run's measured MFU gauge
+        # (None until something priced one) — the regression gate bands
+        # both alongside latency/compiles/transfer
+        "peak_hbm_bytes": (int(peak_hbm) if peak_hbm is not None else None),
+        "xla_cost_records": len(rec.xla_cost_records),
+        "measured_mfu": (round(float(mfu_gauge), 6)
+                         if isinstance(mfu_gauge, (int, float)) else None),
     }
 
 
 # SQ_OBS=1 auto-enables at first import, sink at SQ_OBS_PATH (CLAUDE.md
 # env knobs). Programmatic enable()/disable() always works regardless.
+# The atexit disable flushes the sink and — with SQ_OBS_TRACE set —
+# renders the Chrome trace for runs that never call disable() themselves
+# (bench scripts, one-shot CLIs).
 if os.environ.get("SQ_OBS") == "1":
     enable(os.environ.get("SQ_OBS_PATH", DEFAULT_PATH))
+    import atexit
+
+    atexit.register(disable)

@@ -1,0 +1,296 @@
+"""Perf-regression gate over the committed bench trajectory.
+
+The repo commits every round's headline bench line (``BENCH_r*.json``)
+and every suite record (``bench/records/*.txt``), and — since PR 2 —
+those lines carry an ``obs`` object (compile counts, transfer bytes,
+now peak HBM). This module closes the loop the ROADMAP demands ("every
+PR makes a hot path measurably faster" is unenforceable without a
+comparator): a fresh record is banded against the history per metric,
+per gate —
+
+=====================  ====================================================
+gate                   red when (tolerance-banded, see ``TOLERANCES``)
+=====================  ====================================================
+latency                value > tol × median(history values) + slack
+compile_count          obs.compile_count over the banded history median —
+                       the forced-retracing signature (a shape leak turns
+                       "compile once" into "compile per call")
+total_transfer_bytes   obs.total_transfer_bytes over the band — a tiling
+                       regression re-uploading data
+peak_hbm_bytes         obs.peak_hbm_bytes over the band — a kernel's
+                       working set growing past its history
+=====================  ====================================================
+
+Verdicts are ``green`` / ``red`` / ``skip`` (skip = no reference on that
+gate yet: pre-obs history rounds have no ``obs`` object — honest, not
+silently green). Each verdict is one schema-valid ``regression`` JSONL
+line, so the same validator/trace/report tooling reads gate output.
+
+Consumers: ``run_suite.sh`` appends verdict lines per config (report-
+only — the suite's pass/fail stays with the BASELINE acceptance gate),
+``make regress`` runs the headline bench standalone and exits red, and
+``make smoke`` runs :func:`selftest` — a real forced-retracing
+injection that must flip the verdict red.
+
+Dependency-free for the comparison path (stdlib only; jax is imported
+by :func:`selftest` alone), so the CLI runs with PYTHONPATH cleared
+while the accelerator relay is wedged.
+"""
+
+import glob
+import json
+import os
+import time
+from statistics import median
+
+SCHEMA_VERSION = 2  # keep in sync with recorder.SCHEMA_VERSION (no import:
+# this module must stay loadable from a bare checkout for CI tooling)
+
+__all__ = ["load_history", "check_record", "check_file", "selftest", "main"]
+
+#: gate → (ratio tolerance, absolute slack). Ratio bands absorb
+#: proportional drift (host load for latency, bucket padding for bytes);
+#: the absolute slack keeps tiny references from banning tiny noise
+#: (ref compile_count=1 must not make 2 compiles red). Env-overridable
+#: per gate via SQ_REGRESS_TOL_<GATE> / SQ_REGRESS_SLACK_<GATE>.
+TOLERANCES = {
+    "latency": (2.0, 0.05),
+    "compile_count": (1.5, 2),
+    "total_transfer_bytes": (1.25, 4096),
+    "peak_hbm_bytes": (1.25, 1 << 20),
+}
+
+#: gates read from the record's obs object (latency reads "value")
+OBS_GATES = ("compile_count", "total_transfer_bytes", "peak_hbm_bytes")
+
+
+def _tolerance(gate):
+    tol, slack = TOLERANCES[gate]
+    env_t = os.environ.get(f"SQ_REGRESS_TOL_{gate.upper()}")
+    env_s = os.environ.get(f"SQ_REGRESS_SLACK_{gate.upper()}")
+    return (float(env_t) if env_t else tol,
+            float(env_s) if env_s else slack)
+
+
+def _metric_lines(path):
+    """The machine-readable metric lines of a bench record file (same
+    filter as bench/_gate.py: JSON objects carrying "metric")."""
+    out = []
+    try:
+        fh = open(path)
+    except OSError:
+        return out
+    with fh:
+        for raw in fh:
+            raw = raw.strip()
+            if not raw.startswith("{"):
+                continue
+            try:
+                rec = json.loads(raw)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and "metric" in rec and "value" in rec:
+                out.append(rec)
+    return out
+
+
+def load_history(root="."):
+    """{metric: [record, ...]} chronologically, from the committed
+    ``BENCH_r*.json`` trajectory (each round's parsed headline line)
+    plus every ``bench/records/*.txt`` suite record."""
+    history = {}
+
+    def add(rec):
+        if isinstance(rec, dict) and "metric" in rec and "value" in rec:
+            history.setdefault(rec["metric"], []).append(rec)
+
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        try:
+            doc = json.load(open(path))
+        except (OSError, ValueError):
+            continue
+        add(doc.get("parsed"))
+    for path in sorted(glob.glob(os.path.join(root, "bench", "records",
+                                              "*.txt"))):
+        for rec in _metric_lines(path):
+            add(rec)
+    return history
+
+
+def _reference(history_recs, gate):
+    """Banding reference for one gate: the median over history entries
+    that carry the number (latency always does; obs gates only since the
+    obs layer landed)."""
+    vals = []
+    for rec in history_recs:
+        if gate == "latency":
+            v = rec.get("value")
+        else:
+            v = (rec.get("obs") or {}).get(gate)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            vals.append(float(v))
+    return median(vals) if vals else None
+
+
+def _current(rec, gate):
+    if gate == "latency":
+        v = rec.get("value")
+    else:
+        v = (rec.get("obs") or {}).get(gate)
+    return float(v) if isinstance(v, (int, float)) \
+        and not isinstance(v, bool) else None
+
+
+def check_record(rec, history):
+    """Band one fresh metric record against the history; returns one
+    schema-valid ``regression`` record per gate."""
+    metric = rec.get("metric", "?")
+    past = history.get(metric, [])
+    verdicts = []
+    for gate in ("latency",) + OBS_GATES:
+        cur = _current(rec, gate)
+        ref = _reference(past, gate)
+        tol, slack = _tolerance(gate)
+        if cur is None or ref is None:
+            verdict, allowed = "skip", None
+        else:
+            allowed = ref * tol + slack
+            verdict = "red" if cur > allowed else "green"
+        verdicts.append({
+            "v": SCHEMA_VERSION, "schema_version": SCHEMA_VERSION,
+            "ts": round(time.time(), 3), "type": "regression",
+            "gate": gate, "metric": metric, "verdict": verdict,
+            "current": cur, "reference": ref,
+            "tolerance": (round(allowed, 6) if allowed is not None
+                          else None),
+            "history_n": len(past),
+        })
+    return verdicts
+
+
+def check_file(path, root="."):
+    """Band every metric line of a fresh record file (a run_suite record
+    or a single ``bench.py`` output line) against the committed history
+    under ``root``. The fresh file itself is excluded from the history
+    it is judged against."""
+    history = load_history(root)
+    fresh = _metric_lines(path)
+    # a fresh file living inside bench/records/ was swept into the
+    # history scan — drop its own lines from the reference set, or a run
+    # would band against itself and always pass the ratio gates
+    base = os.path.realpath(path)
+    if base.startswith(os.path.realpath(os.path.join(root, "bench",
+                                                     "records"))):
+        own = {json.dumps(r, sort_keys=True) for r in fresh}
+        history = {
+            m: [r for r in recs
+                if json.dumps(r, sort_keys=True) not in own]
+            for m, recs in history.items()}
+    verdicts = []
+    for rec in fresh:
+        verdicts.extend(check_record(rec, history))
+    return verdicts
+
+
+def selftest():
+    """The CI self-test: a REAL injected regression must go red.
+
+    Runs the same tiny jitted kernel three times under fresh obs runs:
+    a baseline, an unmodified rerun (must stay green on every comparable
+    gate), and a rerun with a deliberately leaked shape — one compile
+    per call, the forced-retracing signature the watchdog exists for —
+    which must produce a red ``compile_count`` verdict. Returns 0 on
+    contract held, 1 otherwise (printed).
+    """
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from . import recorder
+
+    def run(shapes):
+        import warnings
+
+        recorder.enable()
+        f = jax.jit(lambda x: x * 2.0 + 1.0)
+        from .watchdog import RetracingWarning, watchdog
+
+        watchdog.track("regress.selftest", f, budget=1)
+        for s in shapes:
+            f(jnp.ones(s, jnp.float32))
+        with warnings.catch_warnings():
+            # the leaked run trips the watchdog BY DESIGN — that warning
+            # is the injected regression, not selftest noise
+            warnings.simplefilter("ignore", RetracingWarning)
+            watchdog.observe("regress.selftest")
+        snap = recorder.snapshot()
+        recorder.disable()
+        return {"metric": "regress_selftest", "value": 0.01, "unit": "s",
+                "vs_baseline": 1.0, "obs": snap}
+
+    baseline = run([(8,)] * 4)              # 1 compile
+    clean = run([(8,)] * 4)                 # identical: 1 compile
+    leaked = run([(8,), (16,), (32,), (64,)])  # shape leak: 4 compiles
+
+    history = {"regress_selftest": [baseline]}
+    clean_verdicts = check_record(clean, history)
+    leaked_verdicts = check_record(leaked, history)
+    clean_red = [v for v in clean_verdicts if v["verdict"] == "red"]
+    leaked_red = [v for v in leaked_verdicts
+                  if v["verdict"] == "red" and v["gate"] == "compile_count"]
+    failures = []
+    if clean_red:
+        failures.append(f"clean rerun went red: {clean_red}")
+    if not leaked_red:
+        failures.append(
+            "injected retracing (4 compiles vs baseline 1) did not go red: "
+            f"{leaked_verdicts}")
+    print(json.dumps({
+        "regress_selftest": "fail" if failures else "ok",
+        "clean": [v["verdict"] for v in clean_verdicts],
+        "leaked": {v["gate"]: v["verdict"] for v in leaked_verdicts},
+        "errors": failures,
+    }))
+    return 1 if failures else 0
+
+
+def main(argv):
+    """``regress <record-file> [--root DIR] [--no-exit-code]`` or
+    ``regress --selftest``. Prints one regression JSONL line per
+    (metric, gate) plus a summary line; exits 1 when any verdict is red
+    (unless ``--no-exit-code`` — the report-only mode run_suite.sh
+    uses)."""
+    import sys
+
+    if "--selftest" in argv:
+        return selftest()
+    root = "."
+    exit_code = True
+    paths = []
+    it = iter(argv)
+    for a in it:
+        if a == "--root":
+            root = next(it, ".")
+        elif a == "--no-exit-code":
+            exit_code = False
+        else:
+            paths.append(a)
+    if not paths:
+        print("usage: python -m sq_learn_tpu.obs regress <record-file> "
+              "[--root DIR] [--no-exit-code] | --selftest",
+              file=sys.stderr)
+        return 2
+    verdicts = []
+    for p in paths:
+        verdicts.extend(check_file(p, root))
+    for v in verdicts:
+        print(json.dumps(v))
+    tally = {"green": 0, "red": 0, "skip": 0}
+    for v in verdicts:
+        tally[v["verdict"]] += 1
+    print(json.dumps({"regression_summary": tally,
+                      "metrics": len({v["metric"] for v in verdicts})}))
+    if exit_code and tally["red"]:
+        return 1
+    return 0

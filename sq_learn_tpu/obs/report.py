@@ -1,0 +1,230 @@
+"""Human-readable report over an obs JSONL run artifact.
+
+``python -m sq_learn_tpu.obs report <jsonl>`` prints the run the way a
+person asks about it: where did wall-clock go (top spans by SELF time —
+a parent's time minus its children's, so ``qpca.fit`` doesn't drown the
+tile walk it contains), did anything recompile past budget, how many
+bytes moved, what faults/breaker transitions fired, and the paper's
+two-sided cost table — theoretical quantum queries (ledger) next to
+measured classical kernel cost (xla_cost).
+
+Dependency-free like :mod:`~sq_learn_tpu.obs.schema`/`~.trace` (stdlib
+only, never imports jax): it must run with PYTHONPATH cleared while the
+accelerator relay is wedged.
+"""
+
+import json
+
+from .trace import load_jsonl
+
+__all__ = ["summarize", "render", "main"]
+
+
+def _fmt_bytes(n):
+    if n is None:
+        return "-"
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024.0
+
+
+def _fmt_num(n):
+    if n is None:
+        return "-"
+    if abs(n) >= 1e15:
+        return f"{n:.3e}"
+    for scale, suffix in ((1e12, "T"), (1e9, "G"), (1e6, "M"), (1e3, "k")):
+        if abs(n) >= scale:
+            return f"{n / scale:.2f}{suffix}"
+    return f"{n:.4g}"
+
+
+def summarize(records):
+    """Aggregate one run's records into the report dict ``render`` prints.
+
+    Span self-time: ``dur - Σ(direct children dur)``, children resolved
+    through the recorder's ``parent``-seq links (clamped at 0 — async
+    overlap can make children sum past the parent's wall-clock).
+    """
+    spans = [r for r in records if r.get("type") == "span"]
+    child_dur = {}
+    for s in spans:
+        p = s.get("parent")
+        if p is not None:
+            child_dur[p] = child_dur.get(p, 0.0) + float(s.get("dur_s", 0.0))
+    by_name = {}
+    for s in spans:
+        dur = float(s.get("dur_s", 0.0))
+        self_s = max(0.0, dur - child_dur.get(s.get("seq"), 0.0))
+        agg = by_name.setdefault(
+            s.get("name"), {"count": 0, "total_s": 0.0, "self_s": 0.0,
+                            "errors": 0})
+        agg["count"] += 1
+        agg["total_s"] += dur
+        agg["self_s"] += self_s
+        agg["errors"] += 1 if "error" in s else 0
+
+    watchdog = {}
+    for r in records:
+        if r.get("type") == "watchdog":
+            watchdog[r.get("site")] = r  # last observation wins
+
+    counters = {}
+    for r in records:
+        if r.get("type") == "counter":
+            counters[r.get("name")] = r.get("value")  # cumulative: last wins
+
+    xla = {}
+    for r in records:
+        if r.get("type") != "xla_cost":
+            continue
+        site = xla.setdefault(r.get("site"),
+                              {"signatures": 0, "flops": None,
+                               "bytes_accessed": None, "peak_bytes": None})
+        site["signatures"] += 1
+        for field in ("flops", "bytes_accessed", "peak_bytes"):
+            v = r.get(field)
+            if isinstance(v, (int, float)) and (site[field] is None
+                                                or v > site[field]):
+                site[field] = v
+
+    ledger_queries = {}
+    ledger_wall = 0.0
+    for r in records:
+        if r.get("type") != "ledger":
+            continue
+        for k, v in (r.get("queries") or {}).items():
+            ledger_queries[k] = ledger_queries.get(k, 0.0) + v
+        ledger_wall += float(r.get("wall_s", 0.0))
+
+    timeline = [r for r in records
+                if r.get("type") in ("fault", "breaker", "regression")]
+    timeline.sort(key=lambda r: r.get("ts", 0.0))
+
+    probes = [r for r in records if r.get("type") == "probe"]
+    gauges = {r.get("name"): r.get("value")
+              for r in records if r.get("type") == "gauge"}
+    by_type = {}
+    for r in records:
+        t = r.get("type")
+        by_type[t] = by_type.get(t, 0) + 1
+
+    return {
+        "by_type": by_type,
+        "spans": by_name,
+        "watchdog": watchdog,
+        "counters": counters,
+        "xla": xla,
+        "ledger": {"queries": ledger_queries,
+                   "wall_s": round(ledger_wall, 6)},
+        "timeline": timeline,
+        "probes": probes,
+        "gauges": gauges,
+    }
+
+
+def render(summary, top=12):
+    """Format the summary as the report text."""
+    lines = []
+    out = lines.append
+    out("== obs run report ==")
+    out("records: " + ", ".join(
+        f"{t}={n}" for t, n in sorted(summary["by_type"].items(),
+                                      key=lambda kv: -kv[1])))
+
+    out("")
+    out(f"-- top spans by self-time (top {top}) --")
+    ranked = sorted(summary["spans"].items(),
+                    key=lambda kv: -kv[1]["self_s"])[:top]
+    if not ranked:
+        out("  (no spans)")
+    for name, agg in ranked:
+        err = f"  errors={agg['errors']}" if agg["errors"] else ""
+        out(f"  {agg['self_s']:9.4f}s self  {agg['total_s']:9.4f}s total  "
+            f"x{agg['count']:<4d} {name}{err}")
+
+    out("")
+    out("-- compiles per site (watchdog, last observation) --")
+    if not summary["watchdog"]:
+        out("  (no watchdog observations)")
+    for site, r in sorted(summary["watchdog"].items()):
+        flag = "  OVER BUDGET" if r.get("over_budget") else ""
+        out(f"  {r.get('compiles', 0):3d} / budget "
+            f"{r.get('budget')!s:>4} {site}{flag}")
+
+    out("")
+    out("-- xla cost per site (max over signatures) --")
+    if not summary["xla"]:
+        out("  (no xla_cost records — pre-v2 run or analysis unavailable)")
+    for site, agg in sorted(summary["xla"].items()):
+        out(f"  {_fmt_num(agg['flops']):>10} flops  "
+            f"{_fmt_bytes(agg['bytes_accessed']):>10} accessed  "
+            f"{_fmt_bytes(agg['peak_bytes']):>10} peak  "
+            f"sigs={agg['signatures']} {site}")
+
+    out("")
+    out("-- transfers / counters --")
+    if not summary["counters"]:
+        out("  (no counters)")
+    for name, val in sorted(summary["counters"].items()):
+        shown = _fmt_bytes(val) if "bytes" in name else _fmt_num(val)
+        out(f"  {shown:>12} {name}")
+
+    out("")
+    out("-- quantum ledger vs measured classical cost --")
+    lq = summary["ledger"]["queries"]
+    if not lq:
+        out("  (no ledger entries)")
+    for k, v in sorted(lq.items()):
+        out(f"  {_fmt_num(v):>10} {k} (theoretical)")
+    out(f"  {summary['ledger']['wall_s']:10.4f}s simulated wall-clock")
+    mfu = summary["gauges"].get("profiling.mfu")
+    if isinstance(mfu, (int, float)):
+        out(f"  {mfu:10.6f} measured MFU (profiling.mfu)")
+
+    out("")
+    out("-- fault / breaker / regression timeline --")
+    if not summary["timeline"]:
+        out("  (clean run: no faults, breaker transitions, or verdicts)")
+    for r in summary["timeline"]:
+        t = r["type"]
+        if t == "fault":
+            out(f"  {r.get('ts')}: fault {r.get('kind')} "
+                f"tile={r.get('tile')}")
+        elif t == "breaker":
+            out(f"  {r.get('ts')}: breaker {r.get('prev')} -> "
+                f"{r.get('state')} ({r.get('reason')})")
+        else:
+            out(f"  {r.get('ts')}: regression {r.get('gate')} "
+                f"[{r.get('metric')}] -> {r.get('verdict')}")
+
+    if summary["probes"]:
+        out("")
+        out("-- probes --")
+        for r in summary["probes"]:
+            cached = " (cached)" if r.get("cached") else ""
+            out(f"  {r.get('outcome')} {r.get('latency_s', 0.0):.2f}s "
+                f"platform={r.get('platform')!r}{cached}")
+    return "\n".join(lines)
+
+
+def main(argv):
+    """``report <jsonl> [more.jsonl ...] [--json]``"""
+    import sys
+
+    as_json = "--json" in argv
+    paths = [a for a in argv if a != "--json"]
+    if not paths:
+        print("usage: python -m sq_learn_tpu.obs report <jsonl> "
+              "[more.jsonl ...] [--json]", file=sys.stderr)
+        return 2
+    records = []
+    for p in paths:
+        records.extend(load_jsonl(p))
+    summary = summarize(records)
+    if as_json:
+        print(json.dumps(summary, default=repr))
+    else:
+        print(render(summary))
+    return 0

@@ -1,8 +1,12 @@
 """JSONL schema for obs records, and a dependency-free validator.
 
 Every line of an obs JSONL file is one JSON object carrying the common
-envelope ``{"v": 1, "ts": <unix seconds>, "type": <t>}`` plus per-type
-required fields:
+envelope ``{"v": 2, "schema_version": 2, "ts": <unix seconds>,
+"type": <t>}`` plus per-type required fields. Version history: v1 (PR 2)
+had neither the ``schema_version`` alias nor the ``xla_cost`` /
+``regression`` types; v1 files still validate (their types are a strict
+subset), any other version is rejected — an unknown version means a
+reader that would silently misinterpret fields, so it must fail loudly.
 
 =========  ==============================================================
 type       required fields (beyond the envelope)
@@ -25,6 +29,17 @@ fault      kind (str), tile (int | null) — one injected fault from the
 breaker    state (str ∈ {closed, open, half_open}), prev (str),
            reason (str), consecutive (int ≥ 0) — one circuit-breaker
            transition (:mod:`sq_learn_tpu.resilience.supervisor`)
+xla_cost   site (str), signature (str), flops (number | null),
+           bytes_accessed (number | null), peak_bytes (number | null) —
+           one compilation's static cost/memory accounting
+           (:mod:`sq_learn_tpu.obs.xla`); optional argument_bytes /
+           output_bytes / temp_bytes / generated_code_bytes
+           (int | null), backend (str), error (str)
+regression  gate (str), metric (str),
+           verdict (str ∈ {green, red, skip}), current (number | null),
+           reference (number | null), tolerance (number | null) — one
+           tolerance-banded comparison against the committed bench
+           trajectory (:mod:`sq_learn_tpu.obs.regress`)
 =========  ==============================================================
 
 The validator is hand-rolled (no jsonschema in the image — CLAUDE.md: no
@@ -38,9 +53,15 @@ from .recorder import SCHEMA_VERSION
 
 _NUM = (int, float)
 
+#: versions this validator knows how to read (v1 = PR 2's envelope
+#: without schema_version/xla_cost/regression)
+KNOWN_VERSIONS = {1, SCHEMA_VERSION}
+
 _PROBE_OUTCOMES = {"ok", "timeout", "error", "cpu", "skipped"}
 
 _BREAKER_STATES = {"closed", "open", "half_open"}
+
+_REGRESSION_VERDICTS = {"green", "red", "skip"}
 
 
 def _check(cond, errors, msg):
@@ -54,8 +75,16 @@ def validate_record(rec):
     errors = []
     if not isinstance(rec, dict):
         return ["record is not an object"]
-    _check(rec.get("v") == SCHEMA_VERSION, errors,
-           f"v must be {SCHEMA_VERSION}, got {rec.get('v')!r}")
+    v = rec.get("v")
+    _check(v in KNOWN_VERSIONS, errors,
+           f"unknown schema version {v!r} (known: {sorted(KNOWN_VERSIONS)})")
+    if "schema_version" in rec:
+        _check(rec["schema_version"] == v, errors,
+               f"schema_version {rec['schema_version']!r} disagrees with "
+               f"v {v!r}")
+    elif v == SCHEMA_VERSION:
+        errors.append(f"v{SCHEMA_VERSION} records must carry "
+                      "schema_version")
     _check(isinstance(rec.get("ts"), _NUM), errors, "ts must be numeric")
     t = rec.get("type")
     if t == "meta":
@@ -128,6 +157,30 @@ def validate_record(rec):
         _check(isinstance(rec.get("consecutive"), int)
                and rec["consecutive"] >= 0, errors,
                "breaker.consecutive non-negative int")
+    elif t == "xla_cost":
+        _check(isinstance(rec.get("site"), str), errors, "xla_cost.site str")
+        _check(isinstance(rec.get("signature"), str), errors,
+               "xla_cost.signature str")
+        for field in ("flops", "bytes_accessed", "peak_bytes"):
+            _check(field in rec and (rec[field] is None
+                                     or isinstance(rec[field], _NUM)),
+                   errors, f"xla_cost.{field} number or null")
+        for field in ("argument_bytes", "output_bytes", "temp_bytes",
+                      "generated_code_bytes"):
+            if field in rec:
+                _check(rec[field] is None or isinstance(rec[field], int),
+                       errors, f"xla_cost.{field} int or null")
+    elif t == "regression":
+        _check(isinstance(rec.get("gate"), str), errors,
+               "regression.gate str")
+        _check(isinstance(rec.get("metric"), str), errors,
+               "regression.metric str")
+        _check(rec.get("verdict") in _REGRESSION_VERDICTS, errors,
+               f"regression.verdict in {sorted(_REGRESSION_VERDICTS)}")
+        for field in ("current", "reference", "tolerance"):
+            _check(field in rec and (rec[field] is None
+                                     or isinstance(rec[field], _NUM)),
+                   errors, f"regression.{field} number or null")
     else:
         errors.append(f"unknown record type {t!r}")
     return errors

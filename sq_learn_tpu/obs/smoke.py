@@ -59,6 +59,18 @@ def main():
         failures.append("ledger has no tomography shots")
     if rec.counters.get("streaming.transfer_bytes", 0) <= 0:
         failures.append("no streamed transfer bytes recorded")
+    # v2 contract: the instrumented streamed kernels record their
+    # compilation cost, and every line carries the schema_version field
+    # (the validator enforces the latter; re-assert the former here)
+    if summary["by_type"].get("xla_cost", 0) <= 0:
+        failures.append("no xla_cost records from the instrumented "
+                        "streamed kernels")
+    else:
+        costs = [r for r in rec.xla_cost_records
+                 if isinstance(r.get("flops"), (int, float))]
+        if not costs:
+            failures.append("xla_cost records carry no finite flops "
+                            "(cost_analysis degraded on this jax?)")
     gram = report.get("streaming.gram_colsum")
     if gram is None:
         failures.append("watchdog never observed the streamed Gram kernel")

@@ -1,0 +1,205 @@
+"""Render obs JSONL into Chrome trace-event JSON (Perfetto-viewable).
+
+A run's JSONL is the machine artifact; this module is the timeline view:
+spans become duration events, counters/gauges become counter tracks, and
+the discrete records (faults, breaker transitions, watchdog
+observations, probes, ledger entries, xla_cost compilations) become
+instant events on dedicated lanes — so a streamed fit reads as "tiles
+marching, a retry blip, a breaker trip, one compile per bucket" instead
+of a grep session.
+
+Multi-process merging: every process that opens a sink writes a ``meta``
+record carrying its pid first, so lines group onto pid lanes by the most
+recent ``meta`` above them; files without one (hand-built fixtures) get
+a synthetic per-file pid. Bench-suite runs pass each config's JSONL —
+``run_suite.sh`` archives ``<slug>_trace.json`` next to each
+``<slug>_obs.jsonl``, and multiple files merge onto separate process
+lanes in one trace.
+
+Dependency-free by design (stdlib json only, like
+:mod:`~sq_learn_tpu.obs.schema`): the CLI runs with PYTHONPATH cleared
+under a wedged accelerator relay, so it must never import jax.
+
+CLI: ``python -m sq_learn_tpu.obs trace run.jsonl [more.jsonl ...]
+[-o out.json]`` — default output is ``<first input>.trace.json``.
+Env: ``SQ_OBS_TRACE=<path>`` makes
+:func:`~sq_learn_tpu.obs.recorder.disable` render the closing run's
+sink automatically.
+"""
+
+import json
+import os
+
+__all__ = ["load_jsonl", "to_chrome_trace", "write_trace", "main"]
+
+#: tid lanes for non-span records — named via thread_name metadata so
+#: Perfetto labels them instead of showing bare numbers
+_LANES = {
+    "span": (0, "spans"),
+    "watchdog": (1, "compiles (watchdog)"),
+    "xla_cost": (2, "xla cost"),
+    "fault": (3, "faults"),
+    "breaker": (4, "breaker"),
+    "probe": (5, "probe"),
+    "ledger": (6, "quantum ledger"),
+    "regression": (7, "regression gate"),
+}
+
+
+def load_jsonl(path):
+    """Decode one obs JSONL file into a list of record dicts (bad lines
+    skipped — the trace view of a partially-written run is still a
+    view)."""
+    records = []
+    with open(path) as fh:
+        for raw in fh:
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                rec = json.loads(raw)
+            except ValueError:
+                continue
+            if isinstance(rec, dict):
+                records.append(rec)
+    return records
+
+
+def _args_of(rec, drop=("v", "schema_version", "ts", "type")):
+    out = {}
+    for k, v in rec.items():
+        if k in drop:
+            continue
+        if isinstance(v, dict):
+            out[k] = v
+        elif isinstance(v, (str, int, float, bool)) or v is None:
+            out[k] = v
+        else:
+            out[k] = repr(v)
+    return out
+
+
+def _instant_name(rec):
+    t = rec["type"]
+    if t == "watchdog":
+        return (f"compile {rec.get('site')}: {rec.get('compiles')}"
+                f"/{rec.get('budget')}")
+    if t == "xla_cost":
+        return f"xla_cost {rec.get('site')}"
+    if t == "fault":
+        return f"fault:{rec.get('kind')}"
+    if t == "breaker":
+        return f"breaker {rec.get('prev')}→{rec.get('state')}"
+    if t == "probe":
+        return f"probe:{rec.get('outcome')}"
+    if t == "ledger":
+        return f"ledger {rec.get('estimator')}.{rec.get('step')}"
+    if t == "regression":
+        return f"regress {rec.get('gate')}:{rec.get('verdict')}"
+    return t
+
+
+def to_chrome_trace(record_groups):
+    """Build the trace-event dict from ``[(pid_label, records), ...]``
+    groups — one group per source file. ``meta`` records inside a group
+    re-key the pid lane (multi-process appenders share one file); a
+    group with no ``meta`` gets a synthetic pid.
+    """
+    events = []
+    named_pids = set()
+    named_lanes = set()
+
+    def name_process(pid, label):
+        if pid in named_pids:
+            return
+        named_pids.add(pid)
+        events.append({"ph": "M", "name": "process_name", "pid": pid,
+                       "tid": 0, "args": {"name": label}})
+
+    def name_lane(pid, tid, label):
+        if (pid, tid) in named_lanes:
+            return
+        named_lanes.add((pid, tid))
+        events.append({"ph": "M", "name": "thread_name", "pid": pid,
+                       "tid": tid, "args": {"name": label}})
+
+    for group_idx, (label, records) in enumerate(record_groups):
+        pid = 100000 + group_idx  # synthetic until a meta names the real one
+        name_process(pid, label)
+        for rec in records:
+            t = rec.get("type")
+            ts = rec.get("ts")
+            if not isinstance(ts, (int, float)):
+                continue
+            us = ts * 1e6
+            if t == "meta":
+                real = rec.get("pid")
+                if isinstance(real, int):
+                    pid = real
+                    name_process(pid, f"{label} (pid {real})")
+                continue
+            if t == "span":
+                dur = rec.get("dur_s")
+                if not isinstance(dur, (int, float)):
+                    continue
+                tid, lane = _LANES["span"]
+                name_lane(pid, tid, lane)
+                events.append({
+                    "ph": "X", "cat": "span", "name": str(rec.get("name")),
+                    # ts is recorded at span CLOSE: start = end - duration
+                    "ts": us - dur * 1e6, "dur": dur * 1e6,
+                    "pid": pid, "tid": tid, "args": _args_of(rec),
+                })
+            elif t in ("counter", "gauge"):
+                val = rec.get("value")
+                if not isinstance(val, (int, float)) \
+                        or isinstance(val, bool):
+                    continue  # non-numeric gauges have no counter track
+                events.append({
+                    "ph": "C", "name": str(rec.get("name")), "ts": us,
+                    "pid": pid, "tid": 0, "args": {"value": val},
+                })
+            elif t in _LANES:
+                tid, lane = _LANES[t]
+                name_lane(pid, tid, lane)
+                events.append({
+                    "ph": "i", "s": "t", "cat": t, "name": _instant_name(rec),
+                    "ts": us, "pid": pid, "tid": tid, "args": _args_of(rec),
+                })
+            # unknown types: skipped — the trace is a view, not a validator
+    events.sort(key=lambda e: (e["ph"] != "M", e.get("ts", 0.0)))
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def write_trace(paths, out_path):
+    """Render one or more obs JSONL files into ``out_path``; returns the
+    trace dict."""
+    groups = [(os.path.basename(p), load_jsonl(p)) for p in paths]
+    trace = to_chrome_trace(groups)
+    with open(out_path, "w") as fh:
+        json.dump(trace, fh)
+    return trace
+
+
+def main(argv):
+    """``trace <jsonl> [more.jsonl ...] [-o out.json]``"""
+    import sys
+
+    out = None
+    paths = []
+    it = iter(argv)
+    for a in it:
+        if a in ("-o", "--out"):
+            out = next(it, None)
+        else:
+            paths.append(a)
+    if not paths or out is None and not paths[0]:
+        print("usage: python -m sq_learn_tpu.obs trace <jsonl> "
+              "[more.jsonl ...] [-o out.json]", file=sys.stderr)
+        return 2
+    if out is None:
+        out = paths[0] + ".trace.json"
+    trace = write_trace(paths, out)
+    print(json.dumps({"trace": out, "events": len(trace["traceEvents"]),
+                      "sources": len(paths)}))
+    return 0

@@ -1,0 +1,219 @@
+"""XLA cost/memory accounting: what a compiled kernel *costs*, per run.
+
+PR 2's ledger put the quantum side of the paper's trade-off (tomography
+shots, PE/AE queries) next to measured wall-clock; this module supplies
+the classical side. For every instrumented jit entry point — the
+streaming bucket kernels, the ``parallel/`` pca/lloyd/neighbors
+shard_maps, the estimator fit/predict jits, ``__graft_entry__`` — each
+distinct compilation (site × abstract signature) records one
+``xla_cost`` JSONL line with:
+
+- ``lowered.cost_analysis()``: XLA's static FLOP count and bytes-accessed
+  estimate for the lowering, and
+- ``compiled.memory_analysis()``: argument/output/temp/generated-code
+  buffer sizes, summed into ``peak_bytes`` — the peak-HBM claim of the
+  executable (newer jaxlibs expose ``peak_memory_in_bytes`` directly;
+  older ones get the component sum).
+
+The record is keyed by the retracing watchdog's site name, so a run
+artifact lines up "how many times did this site compile" (watchdog)
+with "what does one of those compilations cost" (here), and
+:func:`~sq_learn_tpu.utils.profiling.mfu` can price utilization from
+the *measured* cost instead of hand formulas (``mfu(..., site=...)``).
+
+Costs, not free:
+
+- **Disabled mode is one module-global read** — :func:`capture` and the
+  :func:`instrument` wrapper return immediately when no recorder is
+  active; nothing hashes, nothing traces.
+- **Enabled mode re-lowers once per (site, signature).** jax's AOT API
+  has no public hook into the jit cache, so the analysis pass lowers
+  (and, for memory analysis, compiles) the kernel a second time. That
+  doubles compile cost for analyzed signatures *under observability
+  only*; ``SQ_OBS_XLA_MEMORY=0`` skips the compile half (``peak_bytes``
+  degrades to null) when even that is too much.
+- **Graceful degradation**: a jax without ``Lowered.cost_analysis`` /
+  ``Compiled.memory_analysis`` (or a backend that refuses them) records
+  what it can, nulls for the rest, and never raises into the
+  instrumented computation.
+"""
+
+import os
+
+from . import recorder
+
+__all__ = ["capture", "instrument", "flops_of", "peak_bytes", "records"]
+
+
+def _leaf_signature(leaf):
+    """One leaf's contribution to the abstract signature: arrays as
+    dtype[shape], everything else by value-or-type (static kwargs like
+    mode strings change the compiled program, so they key the record)."""
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is not None and dtype is not None:
+        return f"{dtype}[{','.join(str(d) for d in shape)}]"
+    if isinstance(leaf, (str, int, float, bool)) or leaf is None:
+        return repr(leaf)
+    return type(leaf).__name__
+
+
+def signature_of(args, kwargs):
+    """Compact abstract-signature string of a call — the dedup key (and
+    the ``signature`` field of the record)."""
+    import jax
+
+    parts = [_leaf_signature(l) for l in jax.tree_util.tree_leaves(args)]
+    for k in sorted(kwargs):
+        sub = ",".join(_leaf_signature(l)
+                       for l in jax.tree_util.tree_leaves(kwargs[k]))
+        parts.append(f"{k}={sub}")
+    return "(" + ", ".join(parts) + ")"
+
+
+def _cost_dict(lowered):
+    """Normalized ``{flops, bytes_accessed}`` from ``cost_analysis()``,
+    which jax has returned as a dict, a list of per-device dicts, and
+    (future) nothing at all."""
+    try:
+        ca = lowered.cost_analysis()
+    except Exception:
+        return {"flops": None, "bytes_accessed": None}
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    if not isinstance(ca, dict):
+        return {"flops": None, "bytes_accessed": None}
+
+    def num(key):
+        v = ca.get(key)
+        return float(v) if isinstance(v, (int, float)) else None
+
+    return {"flops": num("flops"), "bytes_accessed": num("bytes accessed")}
+
+
+def _memory_dict(lowered):
+    """Normalized buffer sizes from ``compiled.memory_analysis()``.
+    ``peak_bytes`` prefers the executable's own peak stat and falls back
+    to argument+output+temp+generated-code (the live set at launch)."""
+    out = {"peak_bytes": None, "argument_bytes": None, "output_bytes": None,
+           "temp_bytes": None, "generated_code_bytes": None}
+    try:
+        ma = lowered.compile().memory_analysis()
+    except Exception:
+        return out
+    if ma is None:
+        return out
+
+    def num(attr):
+        v = getattr(ma, attr, None)
+        return int(v) if isinstance(v, (int, float)) else None
+
+    out["argument_bytes"] = num("argument_size_in_bytes")
+    out["output_bytes"] = num("output_size_in_bytes")
+    out["temp_bytes"] = num("temp_size_in_bytes")
+    out["generated_code_bytes"] = num("generated_code_size_in_bytes")
+    peak = num("peak_memory_in_bytes")
+    if peak is None:
+        parts = [out["argument_bytes"], out["output_bytes"],
+                 out["temp_bytes"], out["generated_code_bytes"]]
+        known = [p for p in parts if p is not None]
+        peak = sum(known) if known else None
+    out["peak_bytes"] = peak
+    return out
+
+
+def capture(site, fn, *args, _extra_key=None, **kwargs):
+    """Record one ``xla_cost`` line for ``fn`` at this call's signature,
+    once per (site, signature) per run. No-op (one global read) when
+    observability is off; never raises into the caller.
+
+    ``fn`` must be a jitted callable (exposes ``.lower``); call with the
+    exact args/kwargs of the real invocation so statics resolve the same
+    program the run executes. ``_extra_key`` folds closure state the
+    args can't see (e.g. a shard_map'd kernel's static config tuple)
+    into the signature, so two programs sharing arg shapes don't dedup
+    into one record.
+    """
+    rec = recorder._active
+    if rec is None:
+        return None
+    try:
+        sig = signature_of(args, kwargs)
+        if _extra_key is not None:
+            sig += f"|{_extra_key}"
+    except Exception:
+        return None
+    key = (site, sig)
+    with recorder._lock:
+        if key in rec._xla_seen:
+            return None
+        rec._xla_seen.add(key)
+    entry = {"type": "xla_cost", "site": site, "signature": sig,
+             "flops": None, "bytes_accessed": None, "peak_bytes": None}
+    try:
+        lowered = fn.lower(*args, **kwargs)
+    except Exception as exc:
+        entry["error"] = type(exc).__name__
+        rec.record(entry, kind="xla_cost_records")
+        return entry
+    entry.update(_cost_dict(lowered))
+    if os.environ.get("SQ_OBS_XLA_MEMORY") != "0":
+        entry.update(_memory_dict(lowered))
+    try:
+        import jax
+
+        entry["backend"] = jax.default_backend()
+    except Exception:
+        pass
+    rec.record(entry, kind="xla_cost_records")
+    return entry
+
+
+def instrument(site, fn):
+    """Wrap a jitted callable so every call first feeds :func:`capture`
+    (new signatures under an active run record their cost), then runs.
+
+    The wrapper forwards the jit's ``_cache_size`` hook so the retracing
+    watchdog and ``streaming.kernel_cache_sizes`` keep reading compile
+    counts through it, and keeps the raw jit at ``__wrapped__``.
+    """
+    import functools
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        if recorder._active is not None:
+            capture(site, fn, *args, **kwargs)
+        return fn(*args, **kwargs)
+
+    cache_size = getattr(fn, "_cache_size", None)
+    if cache_size is not None:
+        wrapped._cache_size = cache_size
+    wrapped._xla_site = site
+    wrapped.lower = fn.lower
+    return wrapped
+
+
+def records():
+    """The active run's ``xla_cost`` records (empty list when off)."""
+    rec = recorder.get_recorder()
+    return list(rec.xla_cost_records) if rec is not None else []
+
+
+def flops_of(site):
+    """Largest measured FLOP count recorded for ``site`` this run (the
+    dominant signature), or None — the hook
+    :func:`~sq_learn_tpu.utils.profiling.mfu` uses to price utilization
+    from measured cost instead of hand formulas."""
+    vals = [r["flops"] for r in records()
+            if r.get("site") == site and isinstance(r.get("flops"),
+                                                    (int, float))]
+    return max(vals) if vals else None
+
+
+def peak_bytes():
+    """Largest ``peak_bytes`` across the run's records, or None — the
+    peak-HBM figure :func:`~sq_learn_tpu.obs.recorder.snapshot` embeds
+    in bench lines (and the regression gate bands)."""
+    vals = [r["peak_bytes"] for r in records()
+            if isinstance(r.get("peak_bytes"), (int, float))]
+    return max(vals) if vals else None

@@ -66,7 +66,11 @@ def lloyd_single_sharded(mesh, key, X, weights, centers_init, x_sq_norms,
         weights, _ = pad_to_multiple(weights, n_dev)
         x_sq_norms, _ = pad_to_multiple(x_sq_norms, n_dev)
 
-        run = _sharded_lloyd(mesh, tuple(sorted(static.items())))
+        cfg = tuple(sorted(static.items()))
+        run = _sharded_lloyd(mesh, cfg)
+        _obs.xla.capture("parallel.lloyd.single_sharded", run,
+                         key, X, weights, centers_init, x_sq_norms,
+                         _extra_key=cfg)
         labels, inertia, centers, n_iter, history = run(
             key, X, weights, centers_init, x_sq_norms
         )

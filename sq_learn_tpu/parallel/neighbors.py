@@ -126,8 +126,11 @@ def knn_indices_sharded(mesh, X_train, X_query, k, presharded=None,
         qpad = (-nq) % block
         Qp = jnp.pad(X_query, ((0, qpad), (0, 0)))
         qsq = jnp.sum(Qp * Qp, axis=1)
-        d2_cand, idx_cand = _sharded_candidates(mesh, k_local, per, block)(
-            Xp, mask, Qp, qsq)
+        candidates = _sharded_candidates(mesh, k_local, per, block)
+        _obs.xla.capture("parallel.neighbors.sharded_candidates",
+                         candidates, Xp, mask, Qp, qsq,
+                         _extra_key=(k_local, per, block))
+        d2_cand, idx_cand = candidates(Xp, mask, Qp, qsq)
         # replicated merge over n_dev * k_local candidates per query
         neg, pos = lax.top_k(-d2_cand, k)
         idx = jnp.take_along_axis(idx_cand, pos, axis=1)

@@ -66,6 +66,8 @@ def centered_svd_sharded(mesh, X):
     with _obs.span("parallel.pca.centered_svd_sharded",
                    n_devices=int(mesh.devices.size)) as sp:
         Xp, mask, n = _pad_and_shard(mesh, X)
+        _obs.xla.capture("parallel.pca.masked_gram_svd", _masked_gram_svd,
+                         Xp, mask, n, center=True)
         mean, U, S, Vt = _masked_gram_svd(Xp, mask, n, center=True)
         sp.sync(S)
     return mean, U[:n], S, Vt
@@ -83,6 +85,8 @@ def uncentered_svd_sharded(mesh, X):
     with _obs.span("parallel.pca.uncentered_svd_sharded",
                    n_devices=int(mesh.devices.size)) as sp:
         Xp, mask, n = _pad_and_shard(mesh, X)
+        _obs.xla.capture("parallel.pca.masked_gram_svd", _masked_gram_svd,
+                         Xp, mask, n, center=False)
         _, U, S, Vt = _masked_gram_svd(Xp, mask, n, center=False)
         sp.sync(S)
     return U[:n], S, Vt

@@ -497,6 +497,24 @@ def _topk_u_step(acc, tile, n_valid, start, mean, Vk_over_s):
     return lax.dynamic_update_slice(acc, Uk, (start, 0))
 
 
+# xla cost accounting (obs.xla): each kernel's first call per (bucket,
+# dtype) signature under an active run records flops / bytes-accessed /
+# peak-HBM as an 'xla_cost' line keyed by its watchdog site. The wrapper
+# forwards _cache_size, so the watchdog and kernel_cache_sizes() keep
+# reading compile counts through it; disabled mode is one global read.
+from .obs import xla as _xla  # noqa: E402  (after kernel definitions)
+
+_gram_colsum_step = _xla.instrument("streaming.gram_colsum",
+                                    _gram_colsum_step)
+_colsum_step = _xla.instrument("streaming.colsum", _colsum_step)
+_ingest_step = _xla.instrument("streaming.ingest", _ingest_step)
+_matmul_accum_step = _xla.instrument("streaming.matmul_accum",
+                                     _matmul_accum_step)
+_project_rows_step = _xla.instrument("streaming.project_rows",
+                                     _project_rows_step)
+_qtb_step = _xla.instrument("streaming.qtb", _qtb_step)
+_topk_u_step = _xla.instrument("streaming.topk_u", _topk_u_step)
+
 #: kernel registry: short name → jitted step. Watchdog call sites are
 #: ``"streaming.<short name>"``; :func:`kernel_cache_sizes` reads the same
 #: registry.

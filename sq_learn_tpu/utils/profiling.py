@@ -62,13 +62,53 @@ def lloyd_iter_flops(n_samples, n_features, n_clusters):
             + matmul_flops(n_clusters, n_samples, n_features))
 
 
+#: f32 FLOPs per core per cycle for the host-CPU peak estimate: two
+#: 256-bit FMA ports × 8 lanes × 2 ops — the AVX2 dual-FMA figure, the
+#: floor for every x86 server generation this code runs on. An
+#: AVX-512 host's true peak is up to 2× higher, so treat CPU MFU as a
+#: roofline orientation, not a utilization claim of record (the gauge
+#: is tagged ``cpu_estimate`` for exactly this reason).
+CPU_FLOPS_PER_CORE_CYCLE = 32.0
+
+
+def _host_cpu_hz():
+    """Best-effort host clock in Hz from /proc/cpuinfo (first 'cpu MHz'
+    line); 2 GHz when unreadable — the estimate only needs to be
+    order-correct for a finite MFU statement."""
+    try:
+        with open("/proc/cpuinfo") as fh:
+            for line in fh:
+                if line.lower().startswith("cpu mhz"):
+                    return float(line.split(":", 1)[1]) * 1e6
+    except (OSError, ValueError, IndexError):
+        pass
+    return 2.0e9
+
+
+def host_cpu_peak_flops():
+    """Estimated peak f32 FLOP/s of THIS host's CPU: cores × clock ×
+    :data:`CPU_FLOPS_PER_CORE_CYCLE`, overridable via
+    ``SQ_CPU_PEAK_FLOPS``. An estimate (clock read once, no turbo/AVX512
+    modeling) — it exists so CPU-backend runs report a finite MFU
+    instead of ``None`` + an ``unknown_chip`` gauge, which left
+    ``bench_pallas_mfu`` blind off-TPU."""
+    env = os.environ.get("SQ_CPU_PEAK_FLOPS")
+    if env:
+        return float(env)
+    return (os.cpu_count() or 1) * _host_cpu_hz() * CPU_FLOPS_PER_CORE_CYCLE
+
+
 def device_peak_flops(device=None):
     """Best-known peak FLOP/s for ``device`` (default: the first device).
 
     Resolution order: the ``SQ_TPU_PEAK_FLOPS`` env override (for tunnels
     fronting unlisted hardware), then the generation table keyed on
-    ``device_kind``. Returns None when the chip is unknown — callers must
-    then report raw FLOP/s without an MFU claim, never guess a peak.
+    ``device_kind``, then — for CPU devices only — the
+    :func:`host_cpu_peak_flops` estimate. Returns None for an unknown
+    *accelerator* — callers must then report raw FLOP/s without an MFU
+    claim, never guess an accelerator's peak (the host estimate is
+    acceptable only because a CPU "MFU" is a roofline orientation, not a
+    hardware-utilization claim of record).
     """
     env = os.environ.get("SQ_TPU_PEAK_FLOPS")
     if env:
@@ -79,18 +119,36 @@ def device_peak_flops(device=None):
     for tag, peak in TPU_PEAK_FLOPS.items():
         if tag in kind:
             return peak
+    if getattr(device, "platform", "") == "cpu":
+        return host_cpu_peak_flops()
     return None
 
 
-def mfu(flops, seconds, device=None):
+def mfu(flops, seconds, device=None, site=None):
     """Model FLOP utilization: achieved FLOP/s over chip peak.
 
-    Degrades gracefully on unknown hardware: when
-    :func:`device_peak_flops` has no entry for the chip (or ``seconds``
-    is non-positive) this returns None — callers need no pre-check — and
-    records a ``profiling.mfu`` gauge tagged ``unknown_chip`` so the run
-    artifact says *why* there is no utilization claim instead of silently
-    omitting one."""
+    ``site`` switches the numerator from the hand formula to the
+    *measured* cost: when an obs run holds an ``xla_cost`` record for
+    that watchdog site (:mod:`sq_learn_tpu.obs.xla`), its XLA-reported
+    FLOP count replaces ``flops`` (gauge tagged ``source="xla_cost"``) —
+    callers time one execution of the analyzed kernel and pass its site.
+
+    Degrades gracefully on unknown hardware: CPU devices fall back to
+    the :func:`host_cpu_peak_flops` estimate (finite MFU, gauge tagged
+    ``cpu_estimate``); an unknown *accelerator* (or non-positive
+    ``seconds``) returns None — callers need no pre-check — and records
+    a ``profiling.mfu`` gauge tagged ``unknown_chip`` so the run
+    artifact says *why* there is no utilization claim instead of
+    silently omitting one."""
+    attrs = {}
+    if site is not None:
+        from ..obs import xla as _xla
+
+        measured = _xla.flops_of(site)
+        if measured is not None:
+            flops = measured
+            attrs["source"] = "xla_cost"
+            attrs["site"] = site
     peak = device_peak_flops(device)
     if not peak or seconds <= 0:
         kind = "unknown"
@@ -102,10 +160,17 @@ def mfu(flops, seconds, device=None):
         _obs.gauge("profiling.mfu", None, unknown_chip=True,
                    device_kind=kind,
                    reason=("nonpositive_seconds" if peak and seconds <= 0
-                           else "unknown_chip"))
+                           else "unknown_chip"), **attrs)
         return None
+    try:
+        d = device if device is not None else jax.devices()[0]
+        if getattr(d, "platform", "") == "cpu" \
+                and not os.environ.get("SQ_TPU_PEAK_FLOPS"):
+            attrs["cpu_estimate"] = True
+    except Exception:
+        pass
     value = (flops / seconds) / peak
-    _obs.gauge("profiling.mfu", value)
+    _obs.gauge("profiling.mfu", value, **attrs)
     return value
 
 

@@ -83,3 +83,46 @@ class TestGateCheck:
             _line("a", 1.0)])
         fails, measured, derived = check(path, 1, 0, out=io.StringIO())
         assert fails == [] and (measured, derived) == (1, 0)
+
+
+class TestGateMachineReadableOutput:
+    """The {"gate": ..., "verdict": ...} JSON line per criterion — the
+    format the perf-regression analyzer (and CI annotations) consume,
+    next to the historical # ACCEPT comments."""
+
+    def _json_lines(self, text):
+        return [json.loads(l) for l in text.splitlines()
+                if l.startswith("{")]
+
+    def test_one_json_verdict_per_metric(self, tmp_path):
+        path = _record(tmp_path, [
+            _line("fast", 2.0), _line("slow", 0.3),
+            _line("ipe", 5.5e4, baseline_kind="derived")])
+        out = io.StringIO()
+        check(path, 2, 1, out=out)
+        lines = self._json_lines(out.getvalue())
+        assert len(lines) == 3
+        by_metric = {l["metric"]: l for l in lines}
+        assert all(l["gate"] == "vs_baseline" for l in lines)
+        assert by_metric["fast"]["verdict"] == "pass"
+        assert by_metric["slow"]["verdict"] == "fail"
+        assert by_metric["slow"]["threshold"] == 0.5
+        assert by_metric["ipe"]["kind"] == "derived"
+
+    def test_main_emits_counts_verdict_line(self, tmp_path, capsys):
+        path = _record(tmp_path, [_line("a", 2.0)])
+        main([path, "1", "0"])
+        lines = self._json_lines(capsys.readouterr().out)
+        counts = [l for l in lines if l["gate"] == "counts"]
+        assert len(counts) == 1
+        assert counts[0]["verdict"] == "pass"
+        assert counts[0]["measured"] == 1 and counts[0]["derived"] == 0
+
+    def test_counts_line_fails_on_missing_config(self, tmp_path, capsys):
+        path = _record(tmp_path, [_line("a", 2.0)])
+        with pytest.raises(SystemExit):
+            main([path, "2", "0"])
+        lines = self._json_lines(capsys.readouterr().out)
+        counts = [l for l in lines if l["gate"] == "counts"][0]
+        assert counts["verdict"] == "fail"
+        assert counts["expected_measured"] == 2

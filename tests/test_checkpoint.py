@@ -120,10 +120,25 @@ def test_profiling_flop_accounting(monkeypatch):
     assert prof.matmul_flops(64, 32, 16) == 2 * 64 * 32 * 16
     # one Lloyd iteration = E-step GEMM + M-step GEMM, 2·n·k·m each
     assert prof.lloyd_iter_flops(1000, 64, 10) == 4 * 1000 * 64 * 10
-    # unknown chip (the CPU backend): no peak, no MFU claim
+    # the CPU backend prices against the host-CPU peak estimate: finite
+    # peak, finite MFU (pre-v2 both were None — bench_pallas_mfu reported
+    # nothing useful off-TPU)
     monkeypatch.delenv("SQ_TPU_PEAK_FLOPS", raising=False)
-    assert prof.device_peak_flops() is None
-    assert prof.mfu(1e12, 0.5) is None
+    import numpy as np
+
+    cpu_peak = prof.device_peak_flops()
+    assert cpu_peak is not None and np.isfinite(cpu_peak) and cpu_peak > 0
+    cpu_mfu = prof.mfu(1e9, 0.5)
+    assert isinstance(cpu_mfu, float) and np.isfinite(cpu_mfu)
+    assert cpu_mfu == (1e9 / 0.5) / cpu_peak
+    # an unknown ACCELERATOR still gets no peak and no MFU claim
+
+    class UnknownAccel:
+        device_kind = "npu x1"
+        platform = "axon"
+
+    assert prof.device_peak_flops(UnknownAccel()) is None
+    assert prof.mfu(1e12, 0.5, device=UnknownAccel()) is None
     # explicit override: MFU = achieved / peak
     monkeypatch.setenv("SQ_TPU_PEAK_FLOPS", "2e14")
     assert prof.device_peak_flops() == 2e14

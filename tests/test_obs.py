@@ -293,12 +293,30 @@ def test_benchmark_records_compile_execute_split(run):
     assert "benchmark.triple.median_s" in run.gauges
 
 
-def test_mfu_degrades_gracefully_on_unknown_chip(run, monkeypatch):
+def test_mfu_finite_on_cpu_backend(run, monkeypatch):
+    """The CPU backend prices MFU against the host-CPU peak estimate —
+    a finite float tagged cpu_estimate, instead of the pre-v2 None +
+    unknown_chip gauge that left bench_pallas_mfu blind off-TPU."""
     from sq_learn_tpu.utils import profiling
 
     monkeypatch.delenv("SQ_TPU_PEAK_FLOPS", raising=False)
-    # the CPU backend's device_kind is not in the TPU peak table
-    assert profiling.mfu(1e12, 1.0) is None
+    value = profiling.mfu(1e9, 1.0)
+    assert isinstance(value, float) and np.isfinite(value) and value > 0
+    recs = [r for r in run.gauge_events if r["name"] == "profiling.mfu"]
+    assert recs, "no mfu gauge recorded"
+    assert recs[-1]["attrs"]["cpu_estimate"] is True
+
+
+def test_mfu_degrades_gracefully_on_unknown_accelerator(run, monkeypatch):
+    from sq_learn_tpu.utils import profiling
+
+    monkeypatch.delenv("SQ_TPU_PEAK_FLOPS", raising=False)
+
+    class UnknownChip:  # an accelerator the peak table doesn't know
+        device_kind = "TPU v99"
+        platform = "axon"
+
+    assert profiling.mfu(1e12, 1.0, device=UnknownChip()) is None
     recs = [r for r in run.gauge_events if r["name"] == "profiling.mfu"]
     assert recs, "no mfu gauge recorded"
     assert recs[-1]["attrs"]["unknown_chip"] is True

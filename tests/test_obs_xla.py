@@ -1,0 +1,395 @@
+"""ISSUE 4's analysis layer: XLA cost/memory accounting (obs.xla),
+Chrome trace export (obs.trace), the report CLI (obs.report), and the
+perf-regression gate (obs.regress) — plus the v2 schema envelope."""
+
+import json
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from sq_learn_tpu import obs
+from sq_learn_tpu.obs.schema import validate_jsonl, validate_record
+from sq_learn_tpu.utils.profiling import matmul_flops
+
+
+@pytest.fixture
+def run():
+    rec = obs.enable()
+    yield rec
+    obs.disable()
+
+
+# -- xla cost accounting -----------------------------------------------------
+
+
+def test_capture_records_finite_cost_and_memory(run):
+    f = jax.jit(lambda a, b: a @ b)
+    x, y = jnp.ones((64, 32)), jnp.ones((32, 16))
+    entry = obs.xla.capture("t.matmul", f, x, y)
+    assert entry is not None
+    assert entry["site"] == "t.matmul"
+    assert "float32[64,32]" in entry["signature"]
+    assert np.isfinite(entry["flops"]) and entry["flops"] > 0
+    assert np.isfinite(entry["bytes_accessed"])
+    assert entry["peak_bytes"] > 0
+    assert run.xla_cost_records == [entry]
+
+
+def test_capture_dedups_per_site_signature(run):
+    f = jax.jit(lambda a: a * 2)
+    x = jnp.ones((8,))
+    assert obs.xla.capture("t.dedup", f, x) is not None
+    assert obs.xla.capture("t.dedup", f, x) is None  # same signature
+    assert obs.xla.capture("t.dedup", f, jnp.ones((16,))) is not None
+    assert obs.xla.capture("t.other", f, x) is not None  # site re-keys
+    assert len(run.xla_cost_records) == 3
+
+
+def test_capture_extra_key_splits_identical_arg_signatures(run):
+    x = jnp.ones((8,))
+    for mode in ("a", "b"):
+        f = jax.jit(lambda v, _m=mode: v + (1.0 if _m == "a" else 2.0))
+        obs.xla.capture("t.closure", f, x, _extra_key=mode)
+    assert len(run.xla_cost_records) == 2
+
+
+def test_capture_noop_when_disabled():
+    obs.disable()
+    # fn=None would explode on any real work: the disabled path must
+    # return before touching it
+    assert obs.xla.capture("t.off", None) is None
+    assert obs.xla.records() == []
+    assert obs.xla.flops_of("t.off") is None
+    assert obs.xla.peak_bytes() is None
+
+
+def test_capture_degrades_on_unlowerable_callable(run):
+    entry = obs.xla.capture("t.broken", object())
+    assert entry is not None and entry["flops"] is None
+    assert "error" in entry
+    # and the record still validates (null costs are legal)
+    assert validate_record(run.xla_cost_records[0]) == []
+
+
+def test_matmul_flops_parity_with_hand_formula(run):
+    """The accounting must be wired to the real computation: XLA's FLOP
+    count for an (m,k)@(k,n) GEMM agrees with utils.profiling's
+    2·m·k·n within 2x (satellite: pins against a stale lowering)."""
+    m, k, n = 128, 64, 32
+    f = jax.jit(lambda a, b: a @ b)
+    entry = obs.xla.capture("t.parity", f, jnp.ones((m, k)),
+                            jnp.ones((k, n)))
+    hand = matmul_flops(m, k, n)
+    assert hand / 2 <= entry["flops"] <= hand * 2
+
+
+def test_streaming_kernels_record_cost_with_parity(run):
+    """The instrumented streamed Gram kernel records one xla_cost per
+    (bucket, dtype) signature, and its FLOPs agree with the tile-GEMM
+    hand formula within 2x."""
+    from sq_learn_tpu import streaming
+
+    X = np.random.default_rng(0).normal(size=(512, 16)).astype(np.float32)
+    streaming.streamed_centered_gram(X, max_bytes=8 * 1024)
+    recs = [r for r in run.xla_cost_records
+            if r["site"] == "streaming.gram_colsum"]
+    assert recs, "streamed Gram pass recorded no xla_cost"
+    rows = 8 * 1024 // (16 * 4)  # tile rows under the byte cap
+    hand = matmul_flops(16, rows, 16)  # tile.T @ tile per tile
+    assert hand / 2 <= recs[0]["flops"] <= hand * 2
+    # watchdog keeps observing through the wrapper (compiles may be 0
+    # here: an earlier test in the same process can have warmed this
+    # bucket's cache, and run-scoped counts are baselined at track())
+    rep = obs.watchdog.report()["streaming.gram_colsum"]
+    assert rep["observations"] >= 1 and not rep["over_budget"]
+    sizes = streaming.kernel_cache_sizes()
+    assert sizes["gram_colsum"] >= 1
+
+
+def test_instrument_forwards_cache_size_and_result():
+    f = jax.jit(lambda x: x + 1)
+    wrapped = obs.xla.instrument("t.wrap", f)
+    out = wrapped(jnp.ones((4,)))
+    np.testing.assert_allclose(np.asarray(out), 2.0)
+    assert int(wrapped._cache_size()) == int(f._cache_size())
+
+
+def test_mfu_uses_measured_flops_for_site(run, monkeypatch):
+    from sq_learn_tpu.utils import profiling
+
+    monkeypatch.setenv("SQ_TPU_PEAK_FLOPS", "1e12")
+    f = jax.jit(lambda a, b: a @ b)
+    entry = obs.xla.capture("t.mfu", f, jnp.ones((64, 64)),
+                            jnp.ones((64, 64)))
+    # hand flops argument is deliberately nonsense: site= must override
+    value = profiling.mfu(1.0, 0.5, site="t.mfu")
+    assert value == pytest.approx((entry["flops"] / 0.5) / 1e12)
+    gauge = [r for r in run.gauge_events
+             if r["name"] == "profiling.mfu"][-1]
+    assert gauge["attrs"]["source"] == "xla_cost"
+
+
+def test_snapshot_carries_peak_hbm_and_measured_mfu(run):
+    from sq_learn_tpu.utils import profiling
+
+    snap = obs.snapshot()
+    assert snap["peak_hbm_bytes"] is None
+    assert snap["measured_mfu"] is None
+    assert snap["xla_cost_records"] == 0
+    f = jax.jit(lambda a, b: a @ b)
+    obs.xla.capture("t.snap", f, jnp.ones((32, 32)), jnp.ones((32, 32)))
+    profiling.mfu(1e9, 1.0)  # finite on the CPU backend (host estimate)
+    snap = obs.snapshot()
+    assert snap["peak_hbm_bytes"] > 0
+    assert isinstance(snap["measured_mfu"], float)
+    assert snap["xla_cost_records"] == 1
+
+
+# -- v2 schema ---------------------------------------------------------------
+
+
+def test_schema_v2_envelope_and_new_types(run, tmp_path):
+    path = str(tmp_path / "v2.jsonl")
+    obs.enable(path)
+    try:
+        with obs.span("s"):
+            pass
+        f = jax.jit(lambda x: x * 3)
+        obs.xla.capture("t.schema", f, jnp.ones((4,)))
+    finally:
+        obs.disable()
+    recs = [json.loads(l) for l in open(path)]
+    assert all(r["v"] == 2 and r["schema_version"] == 2 for r in recs)
+    summary = validate_jsonl(path)
+    assert summary["errors"] == []
+    assert summary["by_type"]["xla_cost"] == 1
+
+
+def test_schema_validates_regression_records():
+    good = {"v": 2, "schema_version": 2, "ts": 0.0, "type": "regression",
+            "gate": "latency", "metric": "m", "verdict": "green",
+            "current": 1.0, "reference": 1.1, "tolerance": 2.25}
+    assert validate_record(good) == []
+    bad = dict(good, verdict="maybe")
+    assert validate_record(bad)
+
+
+def test_schema_rejects_unknown_version_and_mismatch():
+    assert validate_record({"v": 3, "schema_version": 3, "ts": 0.0,
+                            "type": "gauge", "name": "g", "value": 1})
+    assert validate_record({"v": 2, "schema_version": 1, "ts": 0.0,
+                            "type": "gauge", "name": "g", "value": 1})
+    # a v2 record must carry the schema_version alias
+    assert validate_record({"v": 2, "ts": 0.0, "type": "gauge",
+                            "name": "g", "value": 1})
+    # v1 lines (pre-v2 files) still validate without it
+    assert validate_record({"v": 1, "ts": 0.0, "type": "gauge",
+                            "name": "g", "value": 1}) == []
+
+
+# -- chrome trace export -----------------------------------------------------
+
+
+def _jsonl(path, records):
+    with open(path, "w") as fh:
+        for r in records:
+            fh.write(json.dumps(r) + "\n")
+
+
+def _env(rec):
+    out = {"v": 2, "schema_version": 2, "ts": rec.pop("ts", 100.0)}
+    out.update(rec)
+    return out
+
+
+def test_trace_structurally_valid_and_multiprocess(tmp_path):
+    """Round-trips a run containing fault/breaker records from two
+    processes onto pid/tid lanes — the acceptance shape of the trace
+    exporter."""
+    from sq_learn_tpu.obs.trace import write_trace
+
+    a = str(tmp_path / "a.jsonl")
+    b = str(tmp_path / "b.jsonl")
+    _jsonl(a, [
+        _env({"type": "meta", "pid": 11, "schema": 2, "ts": 100.0}),
+        _env({"type": "span", "name": "fit", "seq": 1, "dur_s": 0.5,
+              "depth": 0, "parent": None, "synced": True, "ts": 101.0}),
+        _env({"type": "span", "name": "tile", "seq": 2, "dur_s": 0.1,
+              "depth": 1, "parent": 1, "synced": False, "ts": 100.8}),
+        _env({"type": "counter", "name": "streaming.transfer_bytes",
+              "value": 1024, "delta": 1024, "ts": 100.7}),
+        _env({"type": "fault", "kind": "put_fail", "tile": 3,
+              "ts": 100.75}),
+        _env({"type": "breaker", "state": "open", "prev": "closed",
+              "reason": "k_failures", "consecutive": 3, "ts": 100.9}),
+    ])
+    _jsonl(b, [
+        _env({"type": "meta", "pid": 22, "schema": 2, "ts": 100.0}),
+        _env({"type": "probe", "outcome": "ok", "latency_s": 5.0,
+              "platform": "axon", "ts": 105.0}),
+        _env({"type": "xla_cost", "site": "s", "signature": "(f32[4])",
+              "flops": 8.0, "bytes_accessed": 32.0, "peak_bytes": 64,
+              "ts": 106.0}),
+    ])
+    out = str(tmp_path / "trace.json")
+    write_trace([a, b], out)
+    trace = json.load(open(out))  # structurally valid JSON by parse
+    events = trace["traceEvents"]
+    assert isinstance(events, list) and events
+    for ev in events:
+        assert ev["ph"] in ("M", "X", "C", "i")
+        assert isinstance(ev["name"], str)
+        assert isinstance(ev["pid"], int)
+        if ev["ph"] != "M":
+            assert isinstance(ev["ts"], (int, float))
+        if ev["ph"] == "X":
+            assert ev["dur"] >= 0
+    # both processes landed on their meta-declared pid lanes
+    pids = {ev["pid"] for ev in events if ev["ph"] != "M"}
+    assert pids == {11, 22}
+    # spans became duration events with start = end - dur
+    fit = [e for e in events if e["ph"] == "X" and e["name"] == "fit"][0]
+    assert fit["ts"] == pytest.approx((101.0 - 0.5) * 1e6)
+    assert fit["dur"] == pytest.approx(0.5 * 1e6)
+    # fault/breaker ride dedicated instant lanes, distinct from spans
+    inst = {e["name"]: e for e in events if e["ph"] == "i"}
+    assert "fault:put_fail" in inst
+    assert any("closed" in n and "open" in n for n in inst)
+    assert inst["fault:put_fail"]["tid"] != fit["tid"]
+
+
+def test_trace_cli_and_obs_trace_env(tmp_path, monkeypatch):
+    """SQ_OBS_TRACE renders the closing run's sink automatically."""
+    jsonl = str(tmp_path / "run.jsonl")
+    trace_path = str(tmp_path / "run.trace.json")
+    monkeypatch.setenv("SQ_OBS_TRACE", trace_path)
+    obs.enable(jsonl)
+    with obs.span("step"):
+        pass
+    obs.disable()
+    trace = json.load(open(trace_path))
+    assert any(e.get("name") == "step" for e in trace["traceEvents"])
+
+
+# -- report ------------------------------------------------------------------
+
+
+def test_report_self_time_and_sections(capsys, tmp_path):
+    from sq_learn_tpu.obs.report import main, render, summarize
+
+    records = [
+        _env({"type": "span", "name": "outer", "seq": 1, "dur_s": 1.0,
+              "depth": 0, "parent": None, "synced": True, "ts": 101.0}),
+        _env({"type": "span", "name": "inner", "seq": 2, "dur_s": 0.75,
+              "depth": 1, "parent": 1, "synced": False, "ts": 100.9}),
+        _env({"type": "counter", "name": "streaming.transfer_bytes",
+              "value": 2048, "delta": 2048, "ts": 100.5}),
+        _env({"type": "watchdog", "site": "s.kernel", "compiles": 3,
+              "budget": 1, "over_budget": True, "ts": 100.6}),
+        _env({"type": "xla_cost", "site": "s.kernel",
+              "signature": "(f32[8])", "flops": 1e6,
+              "bytes_accessed": 4096.0, "peak_bytes": 8192, "ts": 100.7}),
+    ]
+    summary = summarize(records)
+    # self-time: outer's 1.0s minus inner's 0.75s
+    assert summary["spans"]["outer"]["self_s"] == pytest.approx(0.25)
+    assert summary["spans"]["inner"]["self_s"] == pytest.approx(0.75)
+    assert summary["watchdog"]["s.kernel"]["over_budget"] is True
+    assert summary["xla"]["s.kernel"]["flops"] == 1e6
+    text = render(summary)
+    assert "OVER BUDGET" in text
+    assert "streaming.transfer_bytes" in text
+    # and the CLI runs end to end on a file
+    path = str(tmp_path / "r.jsonl")
+    _jsonl(path, records)
+    assert main([path]) == 0
+    assert "top spans by self-time" in capsys.readouterr().out
+
+
+# -- regression gate ---------------------------------------------------------
+
+
+def _bench_line(value=1.0, metric="m", **obs_fields):
+    rec = {"metric": metric, "value": value, "unit": "s",
+           "vs_baseline": 1.0}
+    if obs_fields:
+        rec["obs"] = obs_fields
+    return rec
+
+
+class TestRegress:
+    def test_green_within_bands(self):
+        from sq_learn_tpu.obs.regress import check_record
+
+        history = {"m": [_bench_line(1.0, compile_count=10,
+                                     total_transfer_bytes=1 << 20,
+                                     peak_hbm_bytes=1 << 24)]}
+        verdicts = check_record(
+            _bench_line(1.2, compile_count=11,
+                        total_transfer_bytes=int(1.1 * (1 << 20)),
+                        peak_hbm_bytes=1 << 24), history)
+        assert {v["gate"] for v in verdicts} == {
+            "latency", "compile_count", "total_transfer_bytes",
+            "peak_hbm_bytes"}
+        assert all(v["verdict"] == "green" for v in verdicts), verdicts
+
+    def test_forced_retracing_goes_red(self):
+        """The acceptance demo: an injected retracing regression
+        (compile_count inflated well past the band) turns the verdict
+        red while the unmodified run stays green."""
+        from sq_learn_tpu.obs.regress import check_record
+
+        history = {"m": [_bench_line(1.0, compile_count=3)]}
+        clean = check_record(_bench_line(1.0, compile_count=3), history)
+        assert all(v["verdict"] != "red" for v in clean)
+        leaked = check_record(_bench_line(1.0, compile_count=40), history)
+        red = [v for v in leaked if v["verdict"] == "red"]
+        assert [v["gate"] for v in red] == ["compile_count"]
+
+    def test_inflated_transfer_and_latency_go_red(self):
+        from sq_learn_tpu.obs.regress import check_record
+
+        history = {"m": [_bench_line(1.0, total_transfer_bytes=1 << 20)]}
+        verdicts = check_record(
+            _bench_line(5.0, total_transfer_bytes=10 << 20), history)
+        by_gate = {v["gate"]: v["verdict"] for v in verdicts}
+        assert by_gate["latency"] == "red"
+        assert by_gate["total_transfer_bytes"] == "red"
+
+    def test_missing_history_skips_not_passes(self):
+        from sq_learn_tpu.obs.regress import check_record
+
+        # pre-obs history: latency comparable, obs gates must SKIP
+        history = {"m": [{"metric": "m", "value": 1.0}]}
+        verdicts = check_record(_bench_line(1.0, compile_count=999),
+                                history)
+        by_gate = {v["gate"]: v["verdict"] for v in verdicts}
+        assert by_gate["latency"] == "green"
+        assert by_gate["compile_count"] == "skip"
+        # verdict records are schema-valid obs records
+        for v in verdicts:
+            assert validate_record(v) == [], v
+
+    def test_check_file_against_repo_history(self, tmp_path):
+        from sq_learn_tpu.obs.regress import check_file
+
+        root = tmp_path
+        (root / "bench" / "records").mkdir(parents=True)
+        (root / "BENCH_r01.json").write_text(json.dumps(
+            {"n": 1, "parsed": _bench_line(1.0, compile_count=2)}))
+        rec = root / "fresh.txt"
+        rec.write_text("# suite run\n"
+                       + json.dumps(_bench_line(10.0, compile_count=2))
+                       + "\n")
+        verdicts = check_file(str(rec), str(root))
+        by_gate = {v["gate"]: v["verdict"] for v in verdicts}
+        assert by_gate["latency"] == "red"
+        assert by_gate["compile_count"] == "green"
+
+    @pytest.mark.slow
+    def test_selftest_contract(self):
+        from sq_learn_tpu.obs.regress import selftest
+
+        assert selftest() == 0
