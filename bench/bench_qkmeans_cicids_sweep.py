@@ -3,7 +3,13 @@ ARI-vs-δ accuracy/precision trade-off curve that is the framework's whole
 point (README.rst:26-44 of the reference), plus wall-clock.
 
 Emits the headline JSON line for the δ=0.5 point; the full sweep goes to
-stderr.
+stderr. Every δ > 0 point additionally records the fit's theoretical
+q-means cost (``QKMeans.quantum_runtime_model`` — the closed-form model
+the reference implements but never ran outside plots) and, under
+``SQ_OBS=1``, lands as one schema-valid ``tradeoff`` record so
+``python -m sq_learn_tpu.obs frontier`` can render the
+accuracy-vs-theoretical-runtime curve with its Pareto frontier
+(VERDICT r5 weak #2: the thesis artifact).
 
 Config (50k rows, n_init=3) is pinned by BASELINE.md — the runnable demo
 of the same trade-off, ``examples/delta_tradeoff.py``, intentionally uses
@@ -36,6 +42,8 @@ def main():
     X = StandardScaler().fit_transform(X)
     k = int(len(np.unique(y)))
 
+    from sq_learn_tpu.obs import frontier
+
     sweep = {}
     headline_t = None
     for delta in (0.0, 0.1, 0.3, 0.5, 1.0):
@@ -47,6 +55,21 @@ def main():
         t, est = timed(fit, warmup=1, reps=1)
         ari = float(adjusted_rand_score(y, est.labels_))
         sweep[delta] = {"fit_s": round(t, 4), "ari": round(ari, 4)}
+        # the thesis join: what theoretical quantum runtime did this δ
+        # buy, and what accuracy did it cost (δ=0 short-circuits to the
+        # classical computation — no quantum cost exists to trade)
+        q_rt = c_rt = None
+        if delta > 0:
+            quantum, classical = est.quantum_runtime_model(*X.shape)
+            q_rt = float(np.ravel(quantum)[0])
+            c_rt = float(classical)
+        sweep[delta]["q_runtime"] = q_rt
+        sweep[delta]["c_runtime"] = c_rt
+        frontier.record_tradeoff(
+            "qkmeans_cicids_delta", delta, accuracy=ari,
+            accuracy_metric="ari", q_runtime=q_rt, c_runtime=c_rt,
+            wall_s=t, budget={"delta": delta},
+            estimator="qkmeans", n=int(X.shape[0]), m=int(X.shape[1]))
         if delta == 0.5:
             headline_t = t
 

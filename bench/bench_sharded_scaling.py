@@ -142,8 +142,15 @@ def main():
 
     largest = sizes[-1]
     simulated = jax.devices()[0].platform == "cpu"
+    # baseline_kind="derived": vs_baseline here is the 1-device/largest
+    # SCALING ratio, not a measured-sklearn wall-clock ratio — on the
+    # virtual-device CPU mesh (simulated: true) it validates layout and
+    # collectives, never chip scaling, so the acceptance gate must count
+    # it with the derived configs (like bench_ipe_digits), not against
+    # the ≥0.5-of-sklearn bar's measured pool.
     emit("qkmeans_sharded_lloyd_scaling_wallclock", table[largest]["s"],
          vs_baseline=round(table[sizes[0]]["s"] / table[largest]["s"], 3),
+         baseline_kind="derived",
          devices=largest, simulated=simulated, table=table,
          n=len(X), m=m, k=k)
 

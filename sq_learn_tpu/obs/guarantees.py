@@ -1,0 +1,332 @@
+"""Guarantee auditor: do the simulated routines honor their (ε, δ) contracts?
+
+The paper's routines are randomized approximators sold with two-sided
+contracts: "the realized error is at most ``tol`` with probability at
+least ``1 − fail_prob``" (tomography's δ, amplitude/phase estimation's
+(ε, γ), IPE's rescaled ε, consistent PE's ε-grid snap). The classical
+simulations implement those estimators *exactly*, so every eager call has
+a computable ground truth — and until now nobody checked it. This module
+is the statistical half of the obs layer:
+
+- **Per-draw records.** Every instrumented routine with a computable
+  ground truth (:mod:`sq_learn_tpu.ops.quantum` — tomography, amplitude /
+  phase / consistent phase estimation, IPE — plus the estimator-level
+  sites in ``models/``) emits one ``guarantee`` JSONL record per audited
+  draw: the declared budgets, the realized error, and whether the draw
+  violated its tolerance. Calls from inside a jit trace are skipped (no
+  concrete truth exists there); large batches are evenly subsampled to
+  ``_MAX_DRAWS_PER_CALL`` draws so auditing never rivals the cost of the
+  routine it audits.
+- **Clopper–Pearson aggregation.** A single violated draw is *expected*
+  — the contracts are probabilistic — so :func:`audit` flags a site only
+  when the exact binomial lower confidence bound on its empirical failure
+  rate exceeds the site's declared failure probability: the data must be
+  statistically inconsistent with the contract before anyone is paged.
+  No flaky single-draw alarms, by construction.
+- **Strict escalation.** ``SQ_OBS_AUDIT_STRICT=1`` re-audits a site on
+  every new violated draw and raises :class:`GuaranteeViolationError`
+  the moment the lower bound crosses the declared failure probability.
+- **Zero-budget short-circuits.** δ=0/ε=0 routes are the exact classical
+  computation (framework-wide contract); their records carry
+  ``short_circuit: true`` with ``realized = 0`` and ``violated = false``
+  *by construction* — tests pin that an all-short-circuit site audits to
+  zero violations.
+
+Import-safe without jax (stdlib only): the audit/aggregation half is
+consumed by the dependency-free report/frontier CLIs, which must run
+with PYTHONPATH cleared while the accelerator relay is wedged.
+"""
+
+import math
+import os
+
+__all__ = [
+    "GuaranteeViolationError",
+    "audit",
+    "clopper_pearson_lower",
+    "enabled",
+    "observe",
+    "record_guarantee",
+    "strict",
+]
+
+#: per-call cap on audited draws: a 70k-row tomography call records an
+#: evenly strided 64-draw sample, not 70k lines (the audit is a
+#: statistical check, not a census; ``n_total`` rides in the record)
+_MAX_DRAWS_PER_CALL = 64
+
+#: default confidence level of the Clopper–Pearson lower bound
+CONFIDENCE = 0.95
+
+
+class GuaranteeViolationError(RuntimeError):
+    """A site's empirical failure rate is statistically inconsistent with
+    its declared failure probability (raised under
+    ``SQ_OBS_AUDIT_STRICT=1``)."""
+
+
+def enabled():
+    """True when a recorder is active — the arming condition for every
+    instrumentation point (one module-global read when off)."""
+    from . import recorder
+
+    return recorder._active is not None
+
+
+def strict():
+    """True when flagged sites must raise (``SQ_OBS_AUDIT_STRICT=1``)."""
+    return os.environ.get("SQ_OBS_AUDIT_STRICT") == "1"
+
+
+# ---------------------------------------------------------------------------
+# Clopper–Pearson (exact binomial) lower confidence bound — dependency-free
+# ---------------------------------------------------------------------------
+
+
+def _log_binom_tail_geq(n, k, p):
+    """log P(X ≥ k) for X ~ Binomial(n, p), exact via lgamma logs.
+
+    Summed in probability space from the (at most n−k+1) upper-tail
+    terms; n here is a per-site draw count (hundreds, not millions), so
+    the direct sum is both exact enough and cheap.
+    """
+    if p <= 0.0:
+        return -math.inf if k > 0 else 0.0
+    if p >= 1.0:
+        return 0.0
+    lp, lq = math.log(p), math.log1p(-p)
+    lgn = math.lgamma(n + 1)
+    total = 0.0
+    for i in range(k, n + 1):
+        lt = (lgn - math.lgamma(i + 1) - math.lgamma(n - i + 1)
+              + i * lp + (n - i) * lq)
+        total += math.exp(lt)
+    return math.log(total) if total > 0 else -math.inf
+
+
+def clopper_pearson_lower(violations, trials, confidence=CONFIDENCE):
+    """Exact (Clopper–Pearson) lower confidence bound on a binomial
+    proportion: the largest p such that observing ≥ ``violations`` out of
+    ``trials`` draws still has probability ≥ 1 − confidence under p.
+
+    ``violations == 0`` returns 0.0 (no evidence of any failure rate);
+    ``violations == trials`` still returns < 1 (finite data can't pin 1).
+    Solved by bisection on the exact binomial upper tail — no scipy in
+    the image (CLAUDE.md: no installs).
+    """
+    k, n = int(violations), int(trials)
+    if n <= 0 or k <= 0:
+        return 0.0
+    if k > n:
+        raise ValueError(f"violations {k} > trials {n}")
+    alpha = 1.0 - float(confidence)
+    log_alpha = math.log(alpha)
+    lo, hi = 0.0, 1.0
+    # P(X ≥ k | p) is increasing in p; the bound is the p where the tail
+    # probability equals α. 60 bisection steps ≈ 1 ulp of float64.
+    for _ in range(60):
+        mid = 0.5 * (lo + hi)
+        if _log_binom_tail_geq(n, k, mid) < log_alpha:
+            lo = mid
+        else:
+            hi = mid
+    return lo
+
+
+# ---------------------------------------------------------------------------
+# Per-draw records (the instrumentation surface)
+# ---------------------------------------------------------------------------
+
+
+def record_guarantee(site, realized, tol, *, fail_prob=None, violated=None,
+                     short_circuit=False, n_total=None, **attrs):
+    """Append one ``guarantee`` record (and its JSONL line) to the active
+    run. No-op when observability is disabled.
+
+    ``realized``/``tol`` are in the same error units (the routine's own:
+    L2/L∞ for tomography, amplitude units for AE, phase units for PE...);
+    ``fail_prob`` is the contract's declared failure probability (γ/δ —
+    None when the routine declares none, which makes the site
+    unflaggable but still measured). ``violated`` defaults to
+    ``realized > tol`` — short-circuits record 0/0/False by construction.
+    """
+    from . import recorder
+
+    rec = recorder.get_recorder()
+    if rec is None:
+        return
+    realized = float(realized)
+    tol = float(tol)
+    if violated is None:
+        violated = bool(realized > tol) and not short_circuit
+    entry = {"type": "guarantee", "site": str(site),
+             "realized": round(realized, 9), "tol": round(tol, 9),
+             "violated": bool(violated),
+             "fail_prob": (None if fail_prob is None
+                           else round(float(fail_prob), 9))}
+    if short_circuit:
+        entry["short_circuit"] = True
+    if n_total is not None:
+        entry["n_total"] = int(n_total)
+    if attrs:
+        entry["attrs"] = recorder._jsonable(attrs)
+    rec.record(entry, kind="guarantee_records")
+    if entry["violated"] and strict():
+        _enforce(rec, site)
+
+
+def _enforce(rec, site):
+    """Strict-mode escalation: re-audit ``site`` over the run so far and
+    raise when the Clopper–Pearson lower bound on its failure rate
+    exceeds its declared failure probability. Called only on violated
+    draws, so the O(draws) re-audit never touches the happy path."""
+    summary = audit(rec.guarantee_records).get(site)
+    if summary and summary["flagged"]:
+        raise GuaranteeViolationError(
+            f"guarantee audit: site {site!r} violates its declared "
+            f"contract — {summary['violations']}/{summary['trials']} draws "
+            f"over tolerance, failure-rate lower bound "
+            f"{summary['lower_bound']:.4f} > declared fail_prob "
+            f"{summary['fail_prob']:.4f} (SQ_OBS_AUDIT_STRICT=1)")
+
+
+def _subsample(n):
+    """Evenly strided index sample of ``range(n)`` capped at
+    ``_MAX_DRAWS_PER_CALL`` — deterministic, endpoints included."""
+    if n <= _MAX_DRAWS_PER_CALL:
+        return list(range(n))
+    step = (n - 1) / (_MAX_DRAWS_PER_CALL - 1)
+    return sorted({min(n - 1, round(i * step))
+                   for i in range(_MAX_DRAWS_PER_CALL)})
+
+
+def observe(site, realized_errors, tol, *, fail_prob=None, **attrs):
+    """Record a batch of realized errors against one declared tolerance.
+
+    ``realized_errors`` is a flat sequence (one entry per independent
+    draw of the routine); batches beyond :data:`_MAX_DRAWS_PER_CALL` are
+    evenly subsampled and the record carries ``n_total``. Scalar ``tol``
+    or one per draw. No-op when observability is disabled.
+    """
+    if not enabled():
+        return
+    errs = [float(e) for e in realized_errors]
+    n = len(errs)
+    if n == 0:
+        return
+    try:
+        tols = [float(t) for t in tol]
+        if len(tols) != n:
+            raise ValueError(
+                f"per-draw tol length {len(tols)} != draws {n}")
+    except TypeError:
+        tols = [float(tol)] * n
+    idx = _subsample(n)
+    for i in idx:
+        record_guarantee(site, errs[i], tols[i], fail_prob=fail_prob,
+                         n_total=(n if n > len(idx) else None), **attrs)
+
+
+# ---------------------------------------------------------------------------
+# Aggregation (the auditor proper)
+# ---------------------------------------------------------------------------
+
+
+def audit(records=None, confidence=CONFIDENCE):
+    """Aggregate guarantee records per site with Clopper–Pearson bounds.
+
+    ``records`` defaults to the active run's ``guarantee_records``;
+    any iterable of decoded record dicts works (the CLIs pass JSONL
+    lines). Returns ``{site: {trials, violations, rate, lower_bound,
+    fail_prob, flagged, short_circuits}}`` where ``fail_prob`` is the
+    LARGEST failure probability the site declared (auditing against the
+    loosest declaration is conservative: a flag means even the weakest
+    contract is broken) and ``flagged`` means ``lower_bound >
+    fail_prob``. Sites that never declared a failure probability are
+    measured but unflaggable (``fail_prob: None``).
+    """
+    if records is None:
+        from . import recorder
+
+        rec = recorder.get_recorder()
+        records = rec.guarantee_records if rec is not None else []
+    sites = {}
+    for r in records:
+        if not isinstance(r, dict) or r.get("type") != "guarantee":
+            continue
+        s = sites.setdefault(r.get("site"),
+                             {"trials": 0, "violations": 0,
+                              "short_circuits": 0, "fail_prob": None})
+        s["trials"] += 1
+        if r.get("violated"):
+            s["violations"] += 1
+        if r.get("short_circuit"):
+            s["short_circuits"] += 1
+        fp = r.get("fail_prob")
+        if isinstance(fp, (int, float)) and not isinstance(fp, bool):
+            if s["fail_prob"] is None or fp > s["fail_prob"]:
+                s["fail_prob"] = float(fp)
+    for s in sites.values():
+        s["rate"] = s["violations"] / s["trials"] if s["trials"] else 0.0
+        s["lower_bound"] = clopper_pearson_lower(
+            s["violations"], s["trials"], confidence)
+        s["confidence"] = confidence
+        s["flagged"] = (s["fail_prob"] is not None
+                        and s["lower_bound"] > s["fail_prob"])
+    return sites
+
+
+def render(summary):
+    """Format an :func:`audit` summary as the report's audit table."""
+    lines = []
+    if not summary:
+        return "  (no guarantee records)"
+    for site in sorted(summary):
+        a = summary[site]
+        fp = ("-" if a["fail_prob"] is None
+              else f"{a['fail_prob']:.4g}")
+        flag = "  FLAGGED" if a["flagged"] else ""
+        sc = (f" short_circuit={a['short_circuits']}"
+              if a["short_circuits"] else "")
+        lines.append(
+            f"  {a['violations']:4d}/{a['trials']:<5d} over tol  "
+            f"lcb={a['lower_bound']:.4f} vs declared {fp:>7}  "
+            f"{site}{sc}{flag}")
+    return "\n".join(lines)
+
+
+def main(argv):
+    """``audit <jsonl> [more.jsonl ...] [--json] [--confidence C]`` —
+    audit the guarantee records of one or more obs JSONL artifacts; exits
+    1 when any site is flagged (the CI-friendly contract check)."""
+    import json as _json
+    import sys
+
+    as_json = "--json" in argv
+    confidence = CONFIDENCE
+    paths = []
+    it = iter(a for a in argv if a != "--json")
+    for a in it:
+        if a == "--confidence":
+            confidence = float(next(it, CONFIDENCE))
+        else:
+            paths.append(a)
+    if not paths:
+        print("usage: python -m sq_learn_tpu.obs audit <jsonl> "
+              "[more.jsonl ...] [--json] [--confidence C]",
+              file=sys.stderr)
+        return 2
+    from .trace import load_jsonl
+
+    records = []
+    for p in paths:
+        records.extend(load_jsonl(p))
+    summary = audit(records, confidence)
+    flagged = sorted(s for s, a in summary.items() if a["flagged"])
+    if as_json:
+        print(_json.dumps({"audit": summary, "flagged": flagged}))
+    else:
+        print("== guarantee audit ==")
+        print(render(summary))
+        print(f"flagged: {flagged if flagged else 'none'}")
+    return 1 if flagged else 0

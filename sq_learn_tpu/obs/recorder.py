@@ -40,7 +40,9 @@ import threading
 import time
 
 # v2: +xla_cost / regression record types, +schema_version envelope field
-SCHEMA_VERSION = 2
+# v3: +guarantee / tradeoff record types (the statistical-observability
+#     layer: (ε, δ)-contract audits and accuracy-vs-runtime sweep points)
+SCHEMA_VERSION = 3
 
 #: default sink path when SQ_OBS=1 and SQ_OBS_PATH is unset
 DEFAULT_PATH = "sq_obs.jsonl"
@@ -153,8 +155,9 @@ class Recorder:
 
     Public views: ``spans``, ``counters``, ``gauges``, ``ledger_entries``,
     ``watchdog_events``, ``probe_events``, ``fault_events``,
-    ``breaker_events``, ``xla_cost_records`` — all plain Python
-    containers, safe to read at any point in the run.
+    ``breaker_events``, ``xla_cost_records``, ``guarantee_records``,
+    ``tradeoff_records`` — all plain Python containers, safe to read at
+    any point in the run.
     """
 
     def __init__(self, path=None):
@@ -168,6 +171,8 @@ class Recorder:
         self.fault_events = []
         self.breaker_events = []
         self.xla_cost_records = []
+        self.guarantee_records = []
+        self.tradeoff_records = []
         self._xla_seen = set()  # (site, signature) dedup for obs.xla
         self.path = path
         self._seq = 0
@@ -340,6 +345,17 @@ def snapshot():
                                              or pb > peak_hbm):
             peak_hbm = pb
     mfu_gauge = rec.gauges.get("profiling.mfu")
+    # statistical-observability view (obs.guarantees / obs.frontier):
+    # did the run's simulated routines honor their declared (ε, δ)
+    # contracts, and did any sweep state the accuracy-vs-runtime trade-off
+    try:
+        from .guarantees import audit
+
+        audit_flagged = sorted(
+            site for site, a in audit(rec.guarantee_records).items()
+            if a["flagged"])
+    except Exception:  # obs must never die on a half-imported package
+        audit_flagged = []
     return {
         "compile_count": int(compile_count),
         "total_transfer_bytes": int(
@@ -360,6 +376,15 @@ def snapshot():
         "xla_cost_records": len(rec.xla_cost_records),
         "measured_mfu": (round(float(mfu_gauge), 6)
                          if isinstance(mfu_gauge, (int, float)) else None),
+        # (ε, δ)-contract audit (obs.guarantees): draws observed, draws
+        # whose realized error exceeded the declared tolerance, and the
+        # sites whose Clopper–Pearson lower bound exceeds their declared
+        # failure probability (empty = every contract held)
+        "guarantee_records": len(rec.guarantee_records),
+        "guarantee_violations": sum(
+            1 for g in rec.guarantee_records if g.get("violated")),
+        "audit_flagged": audit_flagged,
+        "tradeoff_records": len(rec.tradeoff_records),
     }
 
 

@@ -19,6 +19,10 @@ total_transfer_bytes   obs.total_transfer_bytes over the band — a tiling
                        regression re-uploading data
 peak_hbm_bytes         obs.peak_hbm_bytes over the band — a kernel's
                        working set growing past its history
+accuracy               value of a ``unit: "accuracy"`` line (the frontier
+                       sweeps' headlines) UNDER ratio × median − slack —
+                       the lower-bounded quality band (replaces the
+                       latency gate on those lines)
 =====================  ====================================================
 
 Verdicts are ``green`` / ``red`` / ``skip`` (skip = no reference on that
@@ -43,7 +47,7 @@ import os
 import time
 from statistics import median
 
-SCHEMA_VERSION = 2  # keep in sync with recorder.SCHEMA_VERSION (no import:
+SCHEMA_VERSION = 3  # keep in sync with recorder.SCHEMA_VERSION (no import:
 # this module must stay loadable from a bare checkout for CI tooling)
 
 __all__ = ["load_history", "check_record", "check_file", "selftest", "main"]
@@ -53,11 +57,17 @@ __all__ = ["load_history", "check_record", "check_file", "selftest", "main"]
 #: the absolute slack keeps tiny references from banning tiny noise
 #: (ref compile_count=1 must not make 2 compiles red). Env-overridable
 #: per gate via SQ_REGRESS_TOL_<GATE> / SQ_REGRESS_SLACK_<GATE>.
+#: ``accuracy`` is the one LOWER-bounded gate (red when the value DROPS
+#: below ratio × reference − slack): it bands the frontier sweeps'
+#: accuracy headlines, whose ``unit`` is "accuracy" rather than seconds —
+#: a quality regression must trip the same analyzer a latency regression
+#: does.
 TOLERANCES = {
     "latency": (2.0, 0.05),
     "compile_count": (1.5, 2),
     "total_transfer_bytes": (1.25, 4096),
     "peak_hbm_bytes": (1.25, 1 << 20),
+    "accuracy": (0.9, 0.02),
 }
 
 #: gates read from the record's obs object (latency reads "value")
@@ -123,7 +133,7 @@ def _reference(history_recs, gate):
     obs layer landed)."""
     vals = []
     for rec in history_recs:
-        if gate == "latency":
+        if gate in ("latency", "accuracy"):
             v = rec.get("value")
         else:
             v = (rec.get("obs") or {}).get(gate)
@@ -133,7 +143,7 @@ def _reference(history_recs, gate):
 
 
 def _current(rec, gate):
-    if gate == "latency":
+    if gate in ("latency", "accuracy"):
         v = rec.get("value")
     else:
         v = (rec.get("obs") or {}).get(gate)
@@ -143,16 +153,26 @@ def _current(rec, gate):
 
 def check_record(rec, history):
     """Band one fresh metric record against the history; returns one
-    schema-valid ``regression`` record per gate."""
+    schema-valid ``regression`` record per gate.
+
+    The value gate depends on the record's unit: seconds-valued lines
+    get the UPPER-bounded ``latency`` band; ``unit: "accuracy"`` lines
+    (the frontier sweeps' headlines) get the LOWER-bounded ``accuracy``
+    band — a drop below ratio × median(history) − slack is red.
+    """
     metric = rec.get("metric", "?")
     past = history.get(metric, [])
+    value_gate = "accuracy" if rec.get("unit") == "accuracy" else "latency"
     verdicts = []
-    for gate in ("latency",) + OBS_GATES:
+    for gate in (value_gate,) + OBS_GATES:
         cur = _current(rec, gate)
         ref = _reference(past, gate)
         tol, slack = _tolerance(gate)
         if cur is None or ref is None:
             verdict, allowed = "skip", None
+        elif gate == "accuracy":
+            allowed = ref * tol - slack
+            verdict = "red" if cur < allowed else "green"
         else:
             allowed = ref * tol + slack
             verdict = "red" if cur > allowed else "green"

@@ -15,6 +15,8 @@ accelerator relay is wedged.
 
 import json
 
+from . import frontier as _frontier
+from . import guarantees as _guarantees
 from .trace import load_jsonl
 
 __all__ = ["summarize", "render", "main"]
@@ -121,6 +123,11 @@ def summarize(records):
         "timeline": timeline,
         "probes": probes,
         "gauges": gauges,
+        # the statistical-observability sections (v3): per-site
+        # Clopper–Pearson audit of the (ε, δ) guarantee draws, and the
+        # run's accuracy-vs-theoretical-runtime sweep points
+        "audit": _guarantees.audit(records),
+        "tradeoffs": _frontier.collect(records),
     }
 
 
@@ -182,6 +189,19 @@ def render(summary, top=12):
     mfu = summary["gauges"].get("profiling.mfu")
     if isinstance(mfu, (int, float)):
         out(f"  {mfu:10.6f} measured MFU (profiling.mfu)")
+
+    out("")
+    out("-- guarantee audit (Clopper-Pearson on declared (eps, delta)) --")
+    out(_guarantees.render(summary.get("audit", {})))
+
+    out("")
+    out("-- accuracy vs theoretical quantum runtime --")
+    tr = summary.get("tradeoffs", {})
+    if not tr:
+        out("  (no tradeoff records)")
+    else:
+        for line in _frontier.render(tr).splitlines():
+            out("  " + line)
 
     out("")
     out("-- fault / breaker / regression timeline --")

@@ -1,12 +1,15 @@
 """JSONL schema for obs records, and a dependency-free validator.
 
 Every line of an obs JSONL file is one JSON object carrying the common
-envelope ``{"v": 2, "schema_version": 2, "ts": <unix seconds>,
+envelope ``{"v": 3, "schema_version": 3, "ts": <unix seconds>,
 "type": <t>}`` plus per-type required fields. Version history: v1 (PR 2)
 had neither the ``schema_version`` alias nor the ``xla_cost`` /
-``regression`` types; v1 files still validate (their types are a strict
-subset), any other version is rejected — an unknown version means a
-reader that would silently misinterpret fields, so it must fail loudly.
+``regression`` types; v2 (PR 4) added those; v3 (PR 5) adds the
+statistical-observability types ``guarantee`` (one realized-vs-declared
+(ε, δ) draw) and ``tradeoff`` (one accuracy-vs-theoretical-runtime sweep
+point). Older versions still validate (their types are a strict subset),
+any other version is rejected — an unknown version means a reader that
+would silently misinterpret fields, so it must fail loudly.
 
 =========  ==============================================================
 type       required fields (beyond the envelope)
@@ -40,6 +43,20 @@ regression  gate (str), metric (str),
            reference (number | null), tolerance (number | null) — one
            tolerance-banded comparison against the committed bench
            trajectory (:mod:`sq_learn_tpu.obs.regress`)
+guarantee  site (str), realized (number ≥ 0), tol (number ≥ 0),
+           violated (bool), fail_prob (number in [0, 1] | null) — one
+           draw of a simulated routine's realized error against its
+           declared (ε, δ) contract
+           (:mod:`sq_learn_tpu.obs.guarantees`); optional
+           short_circuit (bool), epsilon / delta (number), norm (str),
+           estimator (str), attrs (object)
+tradeoff   sweep (str), point (number), accuracy (number),
+           q_runtime (number | null), c_runtime (number | null),
+           wall_s (number ≥ 0 | null) — one sweep point joining measured
+           accuracy with the theoretical quantum runtime its error
+           budget buys (:mod:`sq_learn_tpu.obs.frontier`); optional
+           accuracy_metric (str), budget (object: str → number),
+           attrs (object)
 =========  ==============================================================
 
 The validator is hand-rolled (no jsonschema in the image — CLAUDE.md: no
@@ -54,8 +71,9 @@ from .recorder import SCHEMA_VERSION
 _NUM = (int, float)
 
 #: versions this validator knows how to read (v1 = PR 2's envelope
-#: without schema_version/xla_cost/regression)
-KNOWN_VERSIONS = {1, SCHEMA_VERSION}
+#: without schema_version/xla_cost/regression; v2 = PR 4's, without
+#: guarantee/tradeoff)
+KNOWN_VERSIONS = {1, 2, SCHEMA_VERSION}
 
 _PROBE_OUTCOMES = {"ok", "timeout", "error", "cpu", "skipped"}
 
@@ -82,9 +100,8 @@ def validate_record(rec):
         _check(rec["schema_version"] == v, errors,
                f"schema_version {rec['schema_version']!r} disagrees with "
                f"v {v!r}")
-    elif v == SCHEMA_VERSION:
-        errors.append(f"v{SCHEMA_VERSION} records must carry "
-                      "schema_version")
+    elif isinstance(v, int) and v >= 2:
+        errors.append(f"v{v} records must carry schema_version")
     _check(isinstance(rec.get("ts"), _NUM), errors, "ts must be numeric")
     t = rec.get("type")
     if t == "meta":
@@ -181,6 +198,47 @@ def validate_record(rec):
             _check(field in rec and (rec[field] is None
                                      or isinstance(rec[field], _NUM)),
                    errors, f"regression.{field} number or null")
+    elif t == "guarantee":
+        _check(isinstance(rec.get("site"), str), errors,
+               "guarantee.site str")
+        for field in ("realized", "tol"):
+            _check(isinstance(rec.get(field), _NUM)
+                   and not isinstance(rec.get(field), bool)
+                   and rec[field] >= 0, errors,
+                   f"guarantee.{field} non-negative number")
+        _check(isinstance(rec.get("violated"), bool), errors,
+               "guarantee.violated bool")
+        fp = rec.get("fail_prob", None)
+        _check("fail_prob" in rec
+               and (fp is None or (isinstance(fp, _NUM)
+                                   and not isinstance(fp, bool)
+                                   and 0.0 <= fp <= 1.0)),
+               errors, "guarantee.fail_prob number in [0, 1] or null")
+        if "short_circuit" in rec:
+            _check(isinstance(rec["short_circuit"], bool), errors,
+                   "guarantee.short_circuit bool")
+    elif t == "tradeoff":
+        _check(isinstance(rec.get("sweep"), str), errors,
+               "tradeoff.sweep str")
+        for field in ("point", "accuracy"):
+            _check(isinstance(rec.get(field), _NUM)
+                   and not isinstance(rec.get(field), bool), errors,
+                   f"tradeoff.{field} number")
+        for field in ("q_runtime", "c_runtime"):
+            _check(field in rec and (rec[field] is None
+                                     or (isinstance(rec[field], _NUM)
+                                         and not isinstance(rec[field],
+                                                            bool))),
+                   errors, f"tradeoff.{field} number or null")
+        if rec.get("wall_s") is not None and "wall_s" in rec:
+            _check(isinstance(rec["wall_s"], _NUM) and rec["wall_s"] >= 0,
+                   errors, "tradeoff.wall_s non-negative number")
+        if "budget" in rec:
+            obj = rec["budget"]
+            _check(isinstance(obj, dict) and all(
+                isinstance(k, str) and isinstance(vv, _NUM)
+                for k, vv in obj.items()), errors,
+                "tradeoff.budget object of str → number")
     else:
         errors.append(f"unknown record type {t!r}")
     return errors

@@ -44,13 +44,29 @@ def main():
     finally:
         os.environ.pop("SQ_STREAM_TILE_BYTES", None)
 
-    # quantum extraction: tomography shots + PE queries land in the ledger
+    # quantum extraction: tomography shots + PE queries land in the
+    # ledger, and the eager estimators emit (ε, δ) guarantee draws
     QPCA(n_components=4, svd_solver="full", random_state=0).fit(
         X[:256], estimate_all=True, theta_major=1.0, eps=0.1, delta=0.5,
         true_tomography=False)
 
+    # thesis artifact: a tiny δ-sweep point joining measured accuracy
+    # with the theoretical quantum runtime its budget buys (the cost
+    # model's output consumed by the frontier, not just unit tests)
+    from . import frontier, guarantees
+    from ..models import QKMeans
+
+    qk = QKMeans(n_clusters=4, n_init=1, delta=0.5,
+                 true_distance_estimate=False, random_state=0).fit(X[:512])
+    quantum, classical = qk.quantum_runtime_model(*X[:512].shape)
+    frontier.record_tradeoff(
+        "smoke_qkmeans_delta", 0.5, accuracy=-float(qk.inertia_),
+        accuracy_metric="neg_inertia",
+        q_runtime=float(np.ravel(quantum)[0]), c_runtime=float(classical))
+
     report = watchdog.report()
     totals = ledger.totals()
+    audit = guarantees.audit()
     rec = disable()
 
     summary = validate_jsonl(path)
@@ -76,6 +92,21 @@ def main():
         failures.append("watchdog never observed the streamed Gram kernel")
     elif gram["over_budget"]:
         failures.append(f"streamed Gram kernel over compile budget: {gram}")
+    # v3 contract: the eager quantum estimators audit their (ε, δ)
+    # guarantees and the δ-sweep point lands as a schema-valid tradeoff
+    # record with a finite theoretical quantum runtime
+    if summary["by_type"].get("guarantee", 0) <= 0:
+        failures.append("no guarantee records from the eager estimators")
+    flagged = sorted(s for s, a in audit.items() if a["flagged"])
+    if flagged:
+        failures.append(f"guarantee audit flagged correct routines: "
+                        f"{flagged}")
+    if summary["by_type"].get("tradeoff", 0) <= 0:
+        failures.append("no tradeoff records from the smoke sweep point")
+    elif not any(isinstance(t.get("q_runtime"), (int, float))
+                 for t in rec.tradeoff_records):
+        failures.append("tradeoff records carry no finite theoretical "
+                        "quantum runtime")
 
     print(json.dumps({
         "obs_smoke": "fail" if failures else "ok",
@@ -83,6 +114,8 @@ def main():
         "jsonl": summary["by_type"],
         "ledger_totals": totals,
         "watchdog": report,
+        "audit_sites": {s: [a["violations"], a["trials"]]
+                        for s, a in sorted(audit.items())},
         "errors": failures,
     }))
     return 1 if failures else 0

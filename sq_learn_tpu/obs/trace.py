@@ -43,6 +43,8 @@ _LANES = {
     "probe": (5, "probe"),
     "ledger": (6, "quantum ledger"),
     "regression": (7, "regression gate"),
+    "guarantee": (8, "guarantee audit"),
+    "tradeoff": (9, "tradeoff frontier"),
 }
 
 
@@ -96,6 +98,14 @@ def _instant_name(rec):
         return f"ledger {rec.get('estimator')}.{rec.get('step')}"
     if t == "regression":
         return f"regress {rec.get('gate')}:{rec.get('verdict')}"
+    if t == "guarantee":
+        state = "VIOLATED" if rec.get("violated") else "ok"
+        if rec.get("short_circuit"):
+            state = "short-circuit"
+        return f"guarantee {rec.get('site')}:{state}"
+    if t == "tradeoff":
+        return (f"tradeoff {rec.get('sweep')}@{rec.get('point')}: "
+                f"acc={rec.get('accuracy')}")
     return t
 
 

@@ -25,6 +25,37 @@ from .sampling import fejer_grid_sample
 _MEDIAN_CONST = 2 * (8 / math.pi**2 - 0.5) ** 2
 
 
+def _eager(*values):
+    """True when every value is concrete — the precondition for auditing
+    a draw against its ground truth (inside a jit trace there is none)."""
+    return not any(isinstance(v, jax.core.Tracer) for v in values)
+
+
+def _observe_estimate(site, truth, est, tol, fail_prob, circular=False,
+                      **attrs):
+    """Emit ``guarantee`` records for one eager estimation call: the
+    simulator knows the true value it perturbs, so each element of the
+    batch is one audited draw of "|estimate − truth| ≤ tol w.p. ≥
+    1 − fail_prob" (:mod:`sq_learn_tpu.obs.guarantees`). ``circular``
+    measures distance on the unit phase circle (PE's ω ∈ [0, 1) wraps).
+    No-op when observability is disabled."""
+    from ... import obs as _obs
+
+    if not _obs.guarantees.enabled():
+        return
+    import numpy as np
+
+    t = np.asarray(truth, np.float64)
+    e = np.asarray(est, np.float64)
+    err = np.abs(np.broadcast_to(t, e.shape) - e).ravel()
+    if circular:
+        err = np.minimum(err, 1.0 - err)
+    tol_arr = np.broadcast_to(
+        np.asarray(tol, np.float64), e.shape).ravel()
+    _obs.guarantees.observe(site, err, tol_arr, fail_prob=fail_prob,
+                            **attrs)
+
+
 def median_q(gamma):
     """Number of repetitions Q = ⌈ln(1/γ)/(2(8/π²−½)²)⌉ (odd) for median
     boosting (reference ``median_evaluation``, ``Utility.py:564-568``)."""
@@ -78,7 +109,19 @@ def amplitude_estimation(key, a, epsilon=0.01, gamma=None, M=None, window=64):
     Q = 1 if gamma is None else median_q(gamma)
     j = fejer_grid_sample(key, w1 * M, float(M), window, sample_shape=(Q,))
     a_tilde = jnp.sin(jnp.pi * j / M) ** 2
-    return jnp.median(a_tilde, axis=0) if Q > 1 else a_tilde[0]
+    out = jnp.median(a_tilde, axis=0) if Q > 1 else a_tilde[0]
+    if _eager(key, a):
+        # AE contract: |ã − a| ≤ ε with prob ≥ 1−γ (median-boosted), or
+        # ≥ 8/π² for a single draw (Brassard et al. Thm 12). ε stays the
+        # declared tolerance even under an explicit (possibly
+        # under-budgeted) M override — that mismatch is exactly what the
+        # auditor exists to catch.
+        _observe_estimate(
+            "amplitude_estimation", jnp.clip(a, 0.0, 1.0), out,
+            float(epsilon),
+            float(gamma) if gamma is not None else 1.0 - 8 / math.pi**2,
+            M=int(M))
+    return out
 
 
 def amplitude_estimation_per_eps(key, a, epsilon, Q=1, window=64):
@@ -114,6 +157,7 @@ def phase_estimation(key, omega, m=None, epsilon=None, gamma=0.1, window=64):
     (reference ``phase_estimation``, ``Utility.py:591-694``), batched over
     ``omega``. ω ≈ 1 maps to (M−1)/M as in the reference (``:640``).
     """
+    declared_eps = epsilon
     if m is None:
         if epsilon is None:
             raise ValueError("specify either m or epsilon")
@@ -122,9 +166,17 @@ def phase_estimation(key, omega, m=None, epsilon=None, gamma=0.1, window=64):
     omega = jnp.asarray(omega)
     j = fejer_grid_sample(key, omega * M, float(M), window)
     omega_tilde = j / M
-    return jnp.where(
+    out = jnp.where(
         jnp.isclose(omega, 1.0), (M - 1) / M, omega_tilde
     )
+    if declared_eps is not None and _eager(key, omega):
+        # PE contract (Nielsen & Chuang eq. 5.35 at the implemented m):
+        # circular |ω̃ − ω| ≤ ε with prob ≥ 1−γ. Only ε-declared calls
+        # are audited — a bare qubit count carries no contract to hold.
+        _observe_estimate("phase_estimation", omega, out,
+                          float(declared_eps), float(gamma), circular=True,
+                          m=int(m))
+    return out
 
 
 def consistent_phase_estimation(
@@ -158,7 +210,14 @@ def consistent_phase_estimation(
         jnp.searchsorted(intervals, pe, side="right"), 1, intervals.shape[0] - 1
     )
     estimate = (intervals[idx - 1] + intervals[idx]) / 2
-    return jnp.maximum(estimate, 0.0)
+    out = jnp.maximum(estimate, 0.0)
+    if _eager(key, omega):
+        # consistent-PE contract: the snapped output lands within ε of ω
+        # with prob ≥ 1−γ (the inner PE ran at δ' = ε·γ/2n, so the snap's
+        # ε/2 half-interval plus δ' stays under ε)
+        _observe_estimate("consistent_phase_estimation", omega, out,
+                          float(epsilon), float(gamma))
+    return out
 
 
 def sv_to_theta(sv, eps):
@@ -205,7 +264,15 @@ def ipe(key, x_sq_norm, y_sq_norm, inner, epsilon, Q=None, gamma=0.1, window=64)
     if Q is None:
         Q = median_q(gamma)
     a_tilde = amplitude_estimation_per_eps(key, a, eps_a, Q=Q, window=window)
-    return ssum * (1 - 2 * a_tilde) / 2
+    out = ssum * (1 - 2 * a_tilde) / 2
+    if _eager(key, ip, x2, y2):
+        # robust-IPE contract: |⟨x,y⟩_est − ⟨x,y⟩| ≤ ε·max(1, |⟨x,y⟩|)
+        # with prob ≥ 1−γ (the amplitude ran at the rescaled ε_a, and the
+        # decode multiplies the amplitude error back by ‖x‖²+‖y‖²)
+        _observe_estimate(
+            "ipe", ip, out,
+            float(epsilon) * jnp.maximum(1.0, jnp.abs(ip)), float(gamma))
+    return out
 
 
 # cap on the Fejér sampler's transient logits tensor (elements of

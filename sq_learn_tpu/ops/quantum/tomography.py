@@ -25,6 +25,51 @@ from .noise import gaussian_estimate
 from .sampling import multinomial_counts
 
 
+def _observe_guarantee(A, est, noise, norm, preserve_norm, variant):
+    """Emit ``guarantee`` records for one eager tomography call (the
+    statistical-observability contract, :mod:`sq_learn_tpu.obs.guarantees`):
+    the simulation knows its own ground truth, so every eager estimate is
+    one audited draw of "realized error ≤ δ w.p. ≥ 1 − fail_prob".
+
+    - ``true``: Algorithm 4.1's contract is on the NORMALIZED vector —
+      per-row error of est/‖v‖ vs v/‖v‖ in the declared norm, failure
+      probability 1/d^0.83 (QIPM Theorem 4.3's tail at the implemented
+      N = 36·d·ln d/δ²).
+    - ``gaussian``: the fast path adds truncnorm(±δ/√d) per component of
+      the FLATTENED input, so its realized ‖A−Â‖_F ≤ δ by construction —
+      declared fail_prob 0 (a violation means the injector itself broke).
+
+    No-op when observability is disabled; never raises into the
+    estimate (except the deliberate strict-mode audit escalation).
+    """
+    from ... import obs as _obs
+
+    if not _obs.guarantees.enabled():
+        return
+    import numpy as np
+
+    A = np.asarray(A, np.float64)
+    E = np.asarray(est, np.float64)
+    if variant == "gaussian":
+        realized = [float(np.linalg.norm(A - E))]
+        _obs.guarantees.observe(
+            "tomography.gaussian", realized, float(noise), fail_prob=0.0,
+            norm="L2", d=int(A.size))
+        return
+    if A.ndim == 1:
+        A, E = A[None], E[None]
+    scale = np.linalg.norm(A, axis=1)
+    safe = np.where(scale > 0, scale, 1.0)
+    unit = A / safe[:, None]
+    Eu = (E / safe[:, None]) if preserve_norm else E
+    ord_ = 2 if norm == "L2" else np.inf
+    realized = np.linalg.norm(unit - Eu, ord=ord_, axis=1)
+    d = A.shape[1]
+    _obs.guarantees.observe(
+        "tomography.true", realized, float(noise),
+        fail_prob=min(1.0, d ** -0.83), norm=norm, d=int(d))
+
+
 def tomography_n_measurements(d, delta, norm="L2"):
     """Sample complexity N (reference ``Utility.py:307-311``):
     L2: 36·d·ln d/δ²; inf: 36·ln d/δ²."""
@@ -120,11 +165,26 @@ def tomography(key, A, noise, true_tomography=True, norm="L2", N=None,
     through the numpy twin (:func:`_host_real_tomography` — same
     algorithm, different stream, ~100× faster multinomials there); calls
     from inside a trace always stay on the XLA path.
+
+    Eager calls under an active obs run additionally emit ``guarantee``
+    records — realized error of each estimated row against the declared
+    δ (:func:`_observe_guarantee`); δ = 0 records the short-circuit with
+    zero realized error (and zero violations) by construction. Traced
+    calls are never audited (no concrete truth exists inside a jit).
     """
+    eager = (not isinstance(A, jax.core.Tracer)
+             and not isinstance(key, jax.core.Tracer))
+    variant = "true" if true_tomography else "gaussian"
     if float(noise) == 0.0:
+        if eager:
+            from ... import obs as _obs
+
+            if _obs.guarantees.enabled():
+                _obs.guarantees.record_guarantee(
+                    f"tomography.{variant}", 0.0, 0.0, fail_prob=0.0,
+                    short_circuit=True)
         return jnp.asarray(A)
-    if true_tomography and not isinstance(A, jax.core.Tracer) \
-            and not isinstance(key, jax.core.Tracer):
+    if true_tomography and eager:
         from ..._config import on_cpu_backend
 
         if on_cpu_backend():
@@ -141,21 +201,31 @@ def tomography(key, A, noise, true_tomography=True, norm="L2", N=None,
                     for row in An])
             else:
                 est = _host_real_tomography(rng, An, N_, preserve_norm)
+            _observe_guarantee(An, est, noise, norm, preserve_norm, "true")
             return jnp.asarray(est.astype(An.dtype))
     A = jnp.asarray(A)
     if not true_tomography:
         if A.ndim == 2:
             flat = gaussian_estimate(key, A.reshape(-1), noise)
-            return flat.reshape(A.shape)
-        return gaussian_estimate(key, A, noise)
+            out = flat.reshape(A.shape)
+        else:
+            out = gaussian_estimate(key, A, noise)
+        if eager:
+            _observe_guarantee(A, out, noise, norm, preserve_norm,
+                               "gaussian")
+        return out
     if A.ndim == 2:
         keys = jax.random.split(key, A.shape[0])
         fn = lambda k, row: real_tomography(
             k, row, delta=noise, N=N, norm=norm, preserve_norm=preserve_norm
         )
-        return jax.vmap(fn)(keys, A)
-    return real_tomography(key, A, delta=noise, N=N, norm=norm,
-                           preserve_norm=preserve_norm)
+        out = jax.vmap(fn)(keys, A)
+    else:
+        out = real_tomography(key, A, delta=noise, N=N, norm=norm,
+                              preserve_norm=preserve_norm)
+    if eager:
+        _observe_guarantee(A, out, noise, norm, preserve_norm, "true")
+    return out
 
 
 def magnitude_tomography_signed(key, v, delta=None, N=None,

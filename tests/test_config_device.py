@@ -427,3 +427,120 @@ class TestFitBackendProvenance:
         with pytest.raises(ValueError, match="Unrecognized svd_solver"):
             est.fit(X)
         assert not hasattr(est, "fit_backend_")
+
+
+class TestTinyRoutingTransformSurfaces:
+    """Round-6 scope closure (VERDICT r5 weak #3 / next #4): the
+    transform-shaped surfaces route too — QKMeans.transform,
+    QPCA.transform (and through it fit_transform's transform half), and
+    QLSSVC.predict — with the same bypass contract as the fit-shaped
+    ones. The spy pattern: host_routed_scope must be entered on the
+    routed path, and the routed result must equal the unrouted one."""
+
+    def _spy_scope(self, monkeypatch):
+        from sq_learn_tpu import _config
+
+        calls = []
+        real = _config.host_routed_scope
+
+        def spy():
+            calls.append(1)
+            return real()
+
+        monkeypatch.setattr(_config, "host_routed_scope", spy)
+        return calls
+
+    def test_qkmeans_transform_routes_and_matches(self, blobs, monkeypatch):
+        from sq_learn_tpu import _config
+
+        X, _ = blobs
+        est = QKMeans(n_clusters=4, n_init=1, delta=0.0,
+                      random_state=0).fit(X)
+        want = est.transform(X[:25])
+        calls = self._spy_scope(monkeypatch)
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        monkeypatch.setattr(_config, "route_tiny_fit_to_host",
+                            lambda n: True)
+        got = est.transform(X[:25])
+        assert calls, "tiny transform never entered host_routed_scope"
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_qpca_transform_routes_and_matches(self, blobs, monkeypatch):
+        from sq_learn_tpu import _config
+        from sq_learn_tpu.models import QPCA
+
+        X, _ = blobs
+        est = QPCA(n_components=2, random_state=0).fit(X)
+        want = est.transform(X[:25])
+        calls = self._spy_scope(monkeypatch)
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        monkeypatch.setattr(_config, "route_tiny_fit_to_host",
+                            lambda n: True)
+        got = est.transform(X[:25])
+        assert calls, "tiny transform never entered host_routed_scope"
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_qpca_fit_transform_halves_both_route(self, blobs, monkeypatch):
+        from sq_learn_tpu import _config
+        from sq_learn_tpu.models import QPCA
+
+        X, _ = blobs
+        want = QPCA(n_components=2, random_state=0).fit_transform(X)
+        calls = self._spy_scope(monkeypatch)
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        monkeypatch.setattr(_config, "route_tiny_fit_to_host",
+                            lambda n: True)
+        est = QPCA(n_components=2, random_state=0)
+        got = est.fit_transform(X)
+        assert est.fit_backend_ == "cpu:tiny-routed"
+        assert len(calls) >= 2  # the fit half AND the transform half
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_qlssvc_predict_routes_and_matches(self, blobs, monkeypatch):
+        from sq_learn_tpu import _config
+        from sq_learn_tpu.models import QLSSVC
+
+        X, _ = blobs
+        y = np.where(X[:, 0] > X[:, 0].mean(), 1.0, -1.0)
+        clf = QLSSVC(absolute_error=0.01, random_state=0).fit(X, y)
+        want = clf.predict(X[:20])
+        calls = self._spy_scope(monkeypatch)
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        monkeypatch.setattr(_config, "route_tiny_fit_to_host",
+                            lambda n: True)
+        got = clf.predict(X[:20])
+        assert calls, "tiny predict never entered host_routed_scope"
+        np.testing.assert_array_equal(got, want)
+
+    def test_qkmeans_transform_compute_dtype_bypasses(self, blobs,
+                                                      monkeypatch):
+        import warnings
+
+        from sq_learn_tpu import _config
+
+        X, _ = blobs
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            est = QKMeans(n_clusters=4, n_init=1, delta=0.0,
+                          compute_dtype="bfloat16", random_state=0).fit(X)
+        calls = self._spy_scope(monkeypatch)
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        monkeypatch.setattr(_config, "route_tiny_fit_to_host",
+                            lambda n: True)
+        est.transform(X[:25])
+        assert not calls, "compute_dtype hint must bypass tiny routing"
+
+    def test_qpca_transform_mesh_bypasses(self, blobs, monkeypatch):
+        from sq_learn_tpu import _config
+        from sq_learn_tpu.models import QPCA
+        from sq_learn_tpu.parallel import make_mesh
+
+        X, _ = blobs
+        est = QPCA(n_components=2, random_state=0,
+                   mesh=make_mesh(jax.devices("cpu")[:8])).fit(X)
+        calls = self._spy_scope(monkeypatch)
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        monkeypatch.setattr(_config, "route_tiny_fit_to_host",
+                            lambda n: True)
+        est.transform(X[:25])
+        assert not calls, "an explicit mesh must bypass tiny routing"

@@ -1,0 +1,206 @@
+"""Trade-off frontier (sq_learn_tpu.obs.frontier): tradeoff records,
+Pareto extraction, the frontier CLI, the regress accuracy band, and the
+runtime models' non-test consumption (ISSUE 5's thesis artifact)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from sq_learn_tpu import obs
+from sq_learn_tpu.obs import frontier
+from sq_learn_tpu.obs.schema import validate_record
+
+
+@pytest.fixture
+def run():
+    rec = obs.enable()
+    yield rec
+    obs.disable()
+
+
+def _pt(point, acc, q):
+    return {"type": "tradeoff", "sweep": "s", "point": point,
+            "accuracy": acc, "q_runtime": q, "c_runtime": 1.0}
+
+
+class TestRecords:
+    def test_disabled_is_noop(self):
+        obs.disable()
+        frontier.record_tradeoff("s", 0.5, accuracy=0.9, q_runtime=1.0)
+
+    def test_records_schema_valid_and_collected(self, run):
+        frontier.record_tradeoff(
+            "qpca", 0.8, accuracy=0.91, accuracy_metric="knn_cv_acc",
+            q_runtime=1e9, c_runtime=1e6, wall_s=0.2,
+            budget={"eps": 0.4, "delta": 0.4}, n=1000)
+        frontier.record_tradeoff("qpca", 0.0, accuracy=0.97,
+                                 q_runtime=None, c_runtime=None)
+        for rec in run.tradeoff_records:
+            assert validate_record(rec) == [], rec
+        sweeps = frontier.collect(run.tradeoff_records)
+        assert len(sweeps["qpca"]) == 2
+
+
+class TestPareto:
+    def test_dominated_points_excluded(self):
+        pts = [_pt(0.1, 0.95, 1000.0), _pt(0.5, 0.90, 100.0),
+               _pt(1.0, 0.92, 200.0),  # dominated by nothing cheaper...
+               _pt(2.0, 0.70, 500.0)]  # dominated: worse acc, more cost
+        front = frontier.pareto(pts)
+        assert 0 in front and 1 in front and 2 in front
+        assert 3 not in front
+
+    def test_null_runtime_never_member(self):
+        pts = [_pt(0.0, 0.99, None), _pt(0.5, 0.9, 10.0)]
+        assert frontier.pareto(pts) == [1]
+
+    def test_exact_ties_keep_first(self):
+        pts = [_pt(0.1, 0.9, 10.0), _pt(0.2, 0.9, 10.0)]
+        assert frontier.pareto(pts) == [0]
+
+    def test_render_marks_frontier(self):
+        pts = [_pt(0.1, 0.95, 1000.0), _pt(0.5, 0.70, 2000.0)]
+        text = frontier.render({"s": pts})
+        lines = [l for l in text.splitlines() if l.strip().startswith("*")]
+        assert len(lines) == 1 and "0.95" in lines[0]
+
+
+class TestCLI:
+    def test_frontier_cli_roundtrip(self, tmp_path, capsys):
+        path = str(tmp_path / "t.jsonl")
+        obs.enable(path)
+        try:
+            frontier.record_tradeoff("sweep_a", 0.5, accuracy=0.9,
+                                     q_runtime=100.0, c_runtime=10.0)
+        finally:
+            obs.disable()
+        assert frontier.main([path]) == 0
+        out = capsys.readouterr().out
+        assert "sweep_a" in out and "frontier" in out
+        assert frontier.main([path, "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["sweep_a"]["pareto"] == [0]
+
+    def test_frontier_cli_empty_artifact_exits_1(self, tmp_path, capsys):
+        path = str(tmp_path / "empty.jsonl")
+        open(path, "w").close()
+        assert frontier.main([path]) == 1
+
+    def test_report_includes_frontier_section(self, tmp_path, capsys):
+        from sq_learn_tpu.obs import report
+
+        path = str(tmp_path / "r.jsonl")
+        obs.enable(path)
+        try:
+            frontier.record_tradeoff("sw", 0.5, accuracy=0.8,
+                                     q_runtime=5.0)
+        finally:
+            obs.disable()
+        assert report.main([path]) == 0
+        out = capsys.readouterr().out
+        assert "accuracy vs theoretical quantum runtime" in out
+        assert "sw" in out
+
+    def test_trace_gains_guarantee_and_tradeoff_lanes(self, tmp_path):
+        from sq_learn_tpu.obs.trace import write_trace
+
+        path = str(tmp_path / "t.jsonl")
+        obs.enable(path)
+        try:
+            obs.guarantees.record_guarantee("s", 0.01, 0.1, fail_prob=0.1)
+            frontier.record_tradeoff("sw", 0.5, accuracy=0.8, q_runtime=1.0)
+        finally:
+            obs.disable()
+        trace = write_trace([path], str(tmp_path / "out.json"))
+        names = {e.get("name") for e in trace["traceEvents"]}
+        lanes = {e["args"].get("name") for e in trace["traceEvents"]
+                 if e.get("name") == "thread_name"}
+        assert "guarantee audit" in lanes
+        assert "tradeoff frontier" in lanes
+        assert any(str(n).startswith("tradeoff sw") for n in names)
+        assert "guarantee s:ok" in names
+
+
+class TestRegressAccuracyGate:
+    def _rec(self, value):
+        return {"metric": "sweep_acc", "value": value, "unit": "accuracy",
+                "vs_baseline": 1.0}
+
+    def test_accuracy_drop_goes_red(self):
+        from sq_learn_tpu.obs import regress
+
+        history = {"sweep_acc": [self._rec(0.90), self._rec(0.92)]}
+        red = regress.check_record(self._rec(0.50), history)
+        gates = {v["gate"]: v["verdict"] for v in red}
+        assert gates["accuracy"] == "red"
+        assert "latency" not in gates  # accuracy replaces the upper band
+
+    def test_equal_or_higher_accuracy_green(self):
+        from sq_learn_tpu.obs import regress
+
+        history = {"sweep_acc": [self._rec(0.90)]}
+        for cur in (0.90, 0.95, 0.88):  # within ratio 0.9 − slack 0.02
+            verdicts = regress.check_record(self._rec(cur), history)
+            gates = {v["gate"]: v["verdict"] for v in verdicts}
+            assert gates["accuracy"] == "green", cur
+
+    def test_seconds_lines_keep_latency_gate(self):
+        from sq_learn_tpu.obs import regress
+
+        history = {"m": [{"metric": "m", "value": 1.0, "unit": "s"}]}
+        verdicts = regress.check_record(
+            {"metric": "m", "value": 1.1, "unit": "s"}, history)
+        gates = {v["gate"]: v["verdict"] for v in verdicts}
+        assert gates["latency"] == "green"
+        assert "accuracy" not in gates
+
+    def test_verdicts_schema_valid(self):
+        from sq_learn_tpu.obs import regress
+
+        history = {"sweep_acc": [self._rec(0.9)]}
+        for v in regress.check_record(self._rec(0.85), history):
+            assert validate_record(v) == [], v
+
+
+class TestModelJoin:
+    """The acceptance wiring: the runtime models' fit-time output is
+    consumed by a non-test caller — here exercised the way the sweep
+    benches consume it, ending in schema-valid tradeoff records."""
+
+    def test_qkmeans_sweep_point_records_runtime_model(self, run):
+        from sq_learn_tpu.models import QKMeans
+
+        rng = np.random.default_rng(0)
+        X = np.concatenate([rng.normal(loc=c, size=(60, 8))
+                            for c in (-5, 0, 5)]).astype(np.float32)
+        est = QKMeans(n_clusters=3, n_init=1, delta=0.5,
+                      true_distance_estimate=False, random_state=0).fit(X)
+        quantum, classical = est.quantum_runtime_model(*X.shape)
+        frontier.record_tradeoff(
+            "t_qkmeans", 0.5, accuracy=0.9, accuracy_metric="ari",
+            q_runtime=float(np.ravel(quantum)[0]),
+            c_runtime=float(classical), budget={"delta": 0.5})
+        rec = run.tradeoff_records[-1]
+        assert validate_record(rec) == []
+        assert rec["q_runtime"] > 0 and np.isfinite(rec["q_runtime"])
+
+    def test_qpca_sweep_point_records_accumulated_runtime(self, run):
+        from sq_learn_tpu.models import QPCA
+
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(256, 16)).astype(np.float32)
+        probe = QPCA(n_components=4, svd_solver="full",
+                     random_state=0).fit(X)
+        theta = float(np.median(probe.singular_values_))
+        q = QPCA(n_components=4, svd_solver="full", random_state=0)
+        q.fit(X, estimate_all=True, theta_major=theta, eps=0.2, delta=0.2,
+              true_tomography=False)
+        cost = q.accumulate_q_runtime(*X.shape)
+        total = float(np.sum([np.asarray(c, float) for c in cost]))
+        assert np.isfinite(total) and total > 0
+        frontier.record_tradeoff(
+            "t_qpca", 0.4, accuracy=0.8, q_runtime=total,
+            c_runtime=float(X.shape[0]) * X.shape[1] ** 2,
+            budget={"eps": 0.2, "delta": 0.2})
+        assert validate_record(run.tradeoff_records[-1]) == []

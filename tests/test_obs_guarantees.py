@@ -1,0 +1,300 @@
+"""Guarantee auditor (sq_learn_tpu.obs.guarantees): the statistical
+observability contract of ISSUE 5 — every simulated routine's realized
+error audited against its declared (ε, δ), flagged only on
+Clopper–Pearson statistical inconsistency, with δ=0/ε=0 short-circuits
+recording zero violations by construction."""
+
+import math
+
+import numpy as np
+import jax
+import pytest
+
+from sq_learn_tpu import obs
+from sq_learn_tpu.obs import guarantees
+from sq_learn_tpu.obs.schema import validate_record
+
+
+@pytest.fixture
+def run():
+    rec = obs.enable()
+    yield rec
+    obs.disable()
+
+
+# -- Clopper–Pearson core ----------------------------------------------------
+
+
+class TestClopperPearson:
+    def test_zero_violations_bound_is_zero(self):
+        assert guarantees.clopper_pearson_lower(0, 50) == 0.0
+        assert guarantees.clopper_pearson_lower(0, 0) == 0.0
+
+    def test_all_violations_known_value(self):
+        # P(X >= n | p) = p^n = alpha  =>  p = alpha^(1/n)
+        lcb = guarantees.clopper_pearson_lower(10, 10, confidence=0.95)
+        assert lcb == pytest.approx(0.05 ** 0.1, abs=1e-6)
+
+    def test_monotone_in_violations(self):
+        bounds = [guarantees.clopper_pearson_lower(k, 100)
+                  for k in (1, 5, 20, 80)]
+        assert bounds == sorted(bounds)
+        assert all(0.0 < b < 1.0 for b in bounds)
+
+    def test_single_draw_never_alarms(self):
+        """The no-flaky-alarms property: ONE violated draw against any
+        plausible declared δ cannot flag — the lower bound on 1/1 at
+        95 % is 5 %, so only contracts declaring fail_prob < 5 % could
+        even in principle flag on a single draw, and 1/n for n ≥ 2
+        drops fast."""
+        assert guarantees.clopper_pearson_lower(1, 1) == \
+            pytest.approx(0.05, abs=1e-6)
+        assert guarantees.clopper_pearson_lower(1, 20) < 0.01
+
+
+# -- record / audit mechanics ------------------------------------------------
+
+
+class TestRecords:
+    def test_disabled_is_noop(self):
+        obs.disable()
+        guarantees.record_guarantee("s", 0.5, 0.1)
+        guarantees.observe("s", [1.0], 0.1)
+        assert guarantees.audit() == {}
+
+    def test_records_are_schema_valid(self, run):
+        guarantees.record_guarantee("site.a", 0.05, 0.1, fail_prob=0.1)
+        guarantees.record_guarantee("site.a", 0.2, 0.1, fail_prob=0.1,
+                                    n_total=100)
+        guarantees.record_guarantee("site.b", 0.0, 0.0, fail_prob=0.0,
+                                    short_circuit=True)
+        for rec in run.guarantee_records:
+            assert validate_record(rec) == [], rec
+        a = guarantees.audit()
+        assert a["site.a"]["trials"] == 2
+        assert a["site.a"]["violations"] == 1
+        assert a["site.b"]["short_circuits"] == 1
+        assert a["site.b"]["violations"] == 0
+
+    def test_batch_subsampling_caps_records(self, run):
+        guarantees.observe("big", np.zeros(10_000), 1.0, fail_prob=0.1)
+        n = len(run.guarantee_records)
+        assert n <= guarantees._MAX_DRAWS_PER_CALL
+        assert all(r["n_total"] == 10_000 for r in run.guarantee_records)
+
+    def test_audit_uses_loosest_declared_fail_prob(self, run):
+        guarantees.record_guarantee("s", 0.2, 0.1, fail_prob=0.01)
+        guarantees.record_guarantee("s", 0.0, 0.1, fail_prob=0.3)
+        assert guarantees.audit()["s"]["fail_prob"] == 0.3
+
+    def test_snapshot_carries_audit_view(self, run):
+        guarantees.record_guarantee("s", 0.2, 0.1, fail_prob=0.5)
+        snap = obs.snapshot()
+        assert snap["guarantee_records"] == 1
+        assert snap["guarantee_violations"] == 1
+        assert snap["audit_flagged"] == []
+        assert "tradeoff_records" in snap
+
+
+# -- the three acceptance behaviors (ISSUE 5) --------------------------------
+
+
+class TestAcceptance:
+    def test_correct_routine_passes_at_declared_delta(self, run):
+        """(a) a correctly-budgeted amplitude estimation passes the audit:
+        200 draws at the derived grid size M(ε) with γ-boosting stay
+        within ε essentially always, so the site is not flagged."""
+        from sq_learn_tpu.ops.quantum.estimation import amplitude_estimation
+
+        a = np.linspace(0.05, 0.95, 200)
+        amplitude_estimation(jax.random.PRNGKey(0), a, epsilon=0.01,
+                             gamma=0.05)
+        summary = guarantees.audit()["amplitude_estimation"]
+        assert summary["trials"] > 0
+        assert not summary["flagged"]
+        assert summary["lower_bound"] <= summary["fail_prob"]
+
+    def test_under_budgeted_routine_is_flagged(self, run):
+        """(b) an under-budgeted routine — grid M=8 against a declared
+        ε=0.001 — violates its tolerance on most draws, and the
+        Clopper–Pearson lower bound crosses the declared γ."""
+        from sq_learn_tpu.ops.quantum.estimation import amplitude_estimation
+
+        a = np.linspace(0.05, 0.95, 200)
+        amplitude_estimation(jax.random.PRNGKey(1), a, epsilon=0.001,
+                             gamma=0.05, M=8)
+        summary = guarantees.audit()["amplitude_estimation"]
+        assert summary["violations"] > 0
+        assert summary["flagged"]
+        assert summary["lower_bound"] > summary["fail_prob"]
+
+    def test_zero_budget_short_circuits_record_zero_violations(self, run):
+        """(c) δ=0/ε=0 short-circuits are exact classical computations:
+        the guarantee records say so by construction — zero realized
+        error, zero violations, short_circuit flagged."""
+        from sq_learn_tpu.ops.quantum.tomography import tomography
+
+        A = np.random.default_rng(0).normal(size=(6, 16)).astype(np.float32)
+        out = tomography(jax.random.PRNGKey(2), A, 0.0)
+        np.testing.assert_array_equal(np.asarray(out), A)
+        recs = [r for r in run.guarantee_records
+                if r["site"] == "tomography.true"]
+        assert recs and all(r.get("short_circuit") for r in recs)
+        assert all(not r["violated"] and r["realized"] == 0.0
+                   for r in recs)
+        summary = guarantees.audit()["tomography.true"]
+        assert summary["violations"] == 0 and not summary["flagged"]
+
+    def test_strict_mode_raises_on_flagged_site(self, run, monkeypatch):
+        monkeypatch.setenv("SQ_OBS_AUDIT_STRICT", "1")
+        from sq_learn_tpu.ops.quantum.estimation import amplitude_estimation
+
+        with pytest.raises(guarantees.GuaranteeViolationError):
+            # enough grossly-under-budgeted draws to cross any bound
+            amplitude_estimation(jax.random.PRNGKey(3),
+                                 np.linspace(0.1, 0.9, 200),
+                                 epsilon=1e-5, gamma=0.01, M=4)
+
+    def test_strict_mode_tolerates_probabilistic_violations(self, run,
+                                                            monkeypatch):
+        """A single violated draw under a loose declared γ must NOT raise
+        — the whole point of the confidence bound."""
+        monkeypatch.setenv("SQ_OBS_AUDIT_STRICT", "1")
+        guarantees.record_guarantee("loose", 0.2, 0.1, fail_prob=0.5)
+        guarantees.record_guarantee("loose", 0.05, 0.1, fail_prob=0.5)
+        assert not guarantees.audit()["loose"]["flagged"]
+
+
+# -- instrumented routines ---------------------------------------------------
+
+
+class TestRoutineInstrumentation:
+    def test_tomography_true_rows_within_delta(self, run, key):
+        from sq_learn_tpu.ops.quantum.tomography import tomography
+
+        A = np.random.default_rng(1).normal(size=(5, 32)).astype(np.float32)
+        tomography(key, A, 0.4)
+        recs = [r for r in run.guarantee_records
+                if r["site"] == "tomography.true"]
+        assert len(recs) == 5
+        assert all(r["tol"] == pytest.approx(0.4) for r in recs)
+        assert all(validate_record(r) == [] for r in recs)
+
+    def test_tomography_gaussian_bounded_by_construction(self, run, key):
+        from sq_learn_tpu.ops.quantum.tomography import tomography
+
+        A = np.random.default_rng(2).normal(size=(8, 16)).astype(np.float32)
+        tomography(key, A, 0.7, true_tomography=False)
+        recs = [r for r in run.guarantee_records
+                if r["site"] == "tomography.gaussian"]
+        assert len(recs) == 1  # one flattened-matrix draw
+        assert recs[0]["fail_prob"] == 0.0
+        assert not recs[0]["violated"]
+
+    def test_traced_calls_are_not_audited(self, run, key):
+        from sq_learn_tpu.ops.quantum.estimation import amplitude_estimation
+
+        jax.jit(lambda k, a: amplitude_estimation(k, a, epsilon=0.1))(
+            key, 0.3)
+        assert run.guarantee_records == []
+
+    def test_consistent_pe_and_ipe_sites(self, run, key):
+        from sq_learn_tpu.ops.quantum.estimation import (
+            consistent_phase_estimation, inner_product_estimates)
+
+        consistent_phase_estimation(
+            key, np.linspace(0.1, 0.4, 16), epsilon=0.05, gamma=0.1)
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(32, 8)).astype(np.float32)
+        C = rng.normal(size=(4, 8)).astype(np.float32)
+        inner_product_estimates(key, X, C, epsilon=0.25)
+        sites = {r["site"] for r in run.guarantee_records}
+        assert {"consistent_phase_estimation", "phase_estimation",
+                "ipe"} <= sites
+        flagged = [s for s, a in guarantees.audit().items() if a["flagged"]]
+        assert flagged == []
+
+    def test_qkmeans_fit_audit_delta_window(self, run):
+        from sq_learn_tpu.models import QKMeans
+
+        rng = np.random.default_rng(4)
+        X = np.concatenate([rng.normal(loc=c, size=(40, 6))
+                            for c in (-4, 0, 4)]).astype(np.float32)
+        QKMeans(n_clusters=3, n_init=1, delta=0.5,
+                true_distance_estimate=False, random_state=0).fit(X)
+        recs = [r for r in run.guarantee_records
+                if r["site"] == "qkmeans.delta_window"]
+        assert recs
+        # the δ-window rule satisfies its own contract by construction
+        assert all(not r["violated"] for r in recs)
+
+    def test_qkmeans_classic_fit_short_circuits(self, run):
+        import warnings
+
+        from sq_learn_tpu.models import QKMeans
+
+        X = np.random.default_rng(5).normal(size=(60, 5)).astype(np.float32)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            QKMeans(n_clusters=3, n_init=1, delta=0.0,
+                    random_state=0).fit(X)
+        recs = [r for r in run.guarantee_records
+                if r["site"] == "qkmeans.delta_window"]
+        assert recs and all(r.get("short_circuit") for r in recs)
+        assert guarantees.audit()["qkmeans.delta_window"]["violations"] == 0
+
+    def test_qlssvc_predict_audits_noise_model(self, run):
+        from sq_learn_tpu.models import QLSSVC
+
+        rng = np.random.default_rng(6)
+        X = rng.normal(size=(40, 4))
+        y = np.where(X[:, 0] > 0, 1.0, -1.0)
+        clf = QLSSVC(absolute_error=0.05, random_state=0).fit(X, y)
+        clf.predict(X[:10])
+        recs = [r for r in run.guarantee_records
+                if r["site"] == "qlssvc.noisy_p"]
+        assert recs
+        assert all(not r["violated"] for r in recs)
+
+
+# -- CLI / render ------------------------------------------------------------
+
+
+class TestCLI:
+    def test_audit_cli_green_and_flagged(self, tmp_path, capsys):
+        import json
+
+        path = str(tmp_path / "g.jsonl")
+        with open(path, "w") as fh:
+            for i in range(30):
+                fh.write(json.dumps(
+                    {"v": 3, "schema_version": 3, "ts": 0.0,
+                     "type": "guarantee", "site": "bad", "realized": 1.0,
+                     "tol": 0.1, "violated": True,
+                     "fail_prob": 0.05}) + "\n")
+            fh.write(json.dumps(
+                {"v": 3, "schema_version": 3, "ts": 0.0,
+                 "type": "guarantee", "site": "good", "realized": 0.01,
+                 "tol": 0.1, "violated": False, "fail_prob": 0.05}) + "\n")
+        assert guarantees.main([path]) == 1
+        out = capsys.readouterr().out
+        assert "bad" in out and "FLAGGED" in out
+
+    def test_report_includes_audit_section(self, tmp_path, capsys):
+        from sq_learn_tpu.obs import report
+
+        path = str(tmp_path / "r.jsonl")
+        obs.enable(path)
+        try:
+            guarantees.record_guarantee("s", 0.01, 0.1, fail_prob=0.1)
+        finally:
+            obs.disable()
+        assert report.main([path]) == 0
+        out = capsys.readouterr().out
+        assert "guarantee audit" in out
+        assert "0/1" in out.replace(" ", "")[:10_000] or "s" in out
+
+    def test_log_binom_tail_sane(self):
+        # P(X >= 1 | n=10, p=0.1) = 1 - 0.9^10
+        got = math.exp(guarantees._log_binom_tail_geq(10, 1, 0.1))
+        assert got == pytest.approx(1 - 0.9 ** 10, rel=1e-9)
