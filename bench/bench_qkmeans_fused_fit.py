@@ -1,0 +1,73 @@
+"""Fused-fit pipeline bench (PR 6): classical q-means on MNIST 70k×784,
+measuring the rebuilt init→convergence chain — host NumPy prestats,
+subsampled k-means++ init, async quantum stats (on the δ>0 leg), and the
+native lockstep Lloyd runner — against sklearn's KMeans on the SAME
+classical configuration (δ=0), the honest apples-to-apples runtime
+baseline the headline's δ=0.5 config is not.
+
+vs_baseline = sklearn_seconds / ours (>1 ⇒ faster).
+
+Emits one JSON line (metric ``qkmeans_mnist_70kx784_k10_fused_fit_
+wallclock``); the δ=0.5 leg's wall-clock and the obs stage breakdown ride
+the stderr extras / the suite's per-config obs artifact. SQ_BENCH_SMOKE=1
+subsamples to 4000 rows (full code path, seconds).
+"""
+
+import sys
+import warnings
+
+import numpy as np
+
+warnings.filterwarnings("ignore")
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from bench._common import (emit, maybe_subsample, probe_backend,  # noqa: E402
+                           timed)
+
+
+def main():
+    probe_backend()
+    import jax
+    from sq_learn_tpu.datasets import load_mnist
+    from sq_learn_tpu.models import QKMeans
+    from sq_learn_tpu.parallel.mesh import make_mesh
+
+    X, y, real = load_mnist()
+    X, y = maybe_subsample(X, y)
+    k, n_init, seed = 10, 3, 0
+    mesh = make_mesh() if len(jax.devices()) > 1 else None
+
+    def ours_fit(delta):
+        est = QKMeans(n_clusters=k, n_init=n_init, max_iter=300,
+                      delta=delta, true_distance_estimate=False,
+                      random_state=seed, mesh=mesh)
+        est.fit(X)
+        return est
+
+    ours_t, est = timed(ours_fit, 0.0, warmup=1, reps=1)
+    delta_t, est_d = timed(ours_fit, 0.5, warmup=0, reps=1)
+
+    sk_t, ari = None, None
+    try:
+        from sklearn.cluster import KMeans as SKKMeans
+        from sklearn.metrics import adjusted_rand_score
+
+        def sk_fit():
+            return SKKMeans(n_clusters=k, n_init=n_init, max_iter=300,
+                            random_state=seed).fit(X)
+
+        sk_t, sk = timed(sk_fit, warmup=0, reps=1)
+        ari = float(adjusted_rand_score(sk.labels_, est.labels_))
+    except Exception as exc:
+        print(f"# sklearn baseline unavailable: {exc}", file=sys.stderr)
+
+    emit("qkmeans_mnist_70kx784_k10_fused_fit_wallclock", ours_t,
+         vs_baseline=(sk_t / ours_t) if sk_t else None,
+         sklearn_s=sk_t, ari_vs_sklearn=ari, delta05_s=delta_t,
+         ingest=est.ingest_, n_iter=est.n_iter_,
+         n_iter_delta05=est_d.n_iter_,
+         devices=len(jax.devices()), real_mnist=real)
+
+
+if __name__ == "__main__":
+    main()

@@ -121,7 +121,8 @@ for cmd in "python bench.py" \
            "python -m bench.bench_randomized_svd_covtype" \
            "python -m bench.bench_qkmeans_cicids_sweep" \
            "python -m bench.bench_qpca_mnist" \
-           "python -m bench.bench_qkmeans_mnist"; do
+           "python -m bench.bench_qkmeans_mnist" \
+           "python -m bench.bench_qkmeans_fused_fit"; do
   if ! run_and_record 600 "$cmd" $cmd; then
     # mid-run tunnel wedge (or any accelerator failure): record the CPU
     # fallback number instead of nothing. PYTHONPATH is cleared so the
@@ -151,9 +152,11 @@ env -u PYTHONPATH timeout 60 python -m sq_learn_tpu.obs frontier \
   || echo "# (no tradeoff records this run)" >> "$obs_dir/frontier.txt"
 
 # BASELINE acceptance gate (bench/_gate.py: vs_baseline >= 0.5 on every
-# line, 6 measured + 2 derived lines expected — the sixth measured line
+# line, 7 measured + 2 derived lines expected — the sixth measured line
 # is the streaming-ingest smoke config, whose baseline is the monolithic
-# ingest of the same fit; the derived pair is bench_ipe_digits and the
+# ingest of the same fit; the seventh is the PR 6 fused-fit config
+# (classical 70k×784 q-means vs sklearn on the SAME δ=0 configuration);
+# the derived pair is bench_ipe_digits and the
 # sharded-scaling smoke config; missing/null = fail). This
 # script is where the bar is enforced — the unit suite only warns, since
 # wall-clock there is subject to arbitrary host load.
@@ -161,7 +164,7 @@ env -u PYTHONPATH timeout 60 python -m sq_learn_tpu.obs frontier \
 # pre-imports jax via the axon sitecustomize and would hang on a wedged
 # relay even though this step only parses JSON; -m bench._gate resolves
 # via cwd, which is the repo root here)
-env -u PYTHONPATH timeout 60 python -m bench._gate "$out" 6 2
+env -u PYTHONPATH timeout 60 python -m bench._gate "$out" 7 2
 gate_rc=$?
 echo "# acceptance gate rc=$gate_rc" >> "$out"
 echo "done: $out"

@@ -136,6 +136,18 @@ class BaseEstimator:
             getattr(self, key).set_params(**sub_params)
         return self
 
+    def _validated_X(self, X, **check_kw):
+        """``check_array`` under the estimator's validate-once cache: inside
+        a :func:`~sq_learn_tpu.utils.validation.validation_scope` (opened
+        by ``fit_transform``/``fit_predict`` surfaces), the same input
+        object is fully validated exactly once per estimator call — the
+        dtype/copy/finiteness scans are O(n·m) and were silently re-run by
+        every composed stage. Outside a scope this IS ``check_array``."""
+        from .utils.validation import check_array, validated_once
+
+        return validated_once(self, X,
+                              lambda a: check_array(a, **check_kw))
+
     def __repr__(self):
         cls = type(self)
         try:
@@ -165,12 +177,21 @@ def _param_is_default(value, default):
 
 
 class TransformerMixin:
-    """Mixin providing ``fit_transform`` (reference ``base.py:680``)."""
+    """Mixin providing ``fit_transform`` (reference ``base.py:680``).
+
+    The fit and transform halves run under one validate-once scope
+    (:func:`~sq_learn_tpu.utils.validation.validation_scope`): the
+    transform half reuses the array the fit half already blessed instead
+    of re-running the full ``check_array`` contract on it.
+    """
 
     def fit_transform(self, X, y=None, **fit_params):
-        if y is None:
-            return self.fit(X, **fit_params).transform(X)
-        return self.fit(X, y, **fit_params).transform(X)
+        from .utils.validation import validation_scope
+
+        with validation_scope(self):
+            if y is None:
+                return self.fit(X, **fit_params).transform(X)
+            return self.fit(X, y, **fit_params).transform(X)
 
 
 class ClusterMixin:

@@ -33,7 +33,7 @@ from .._config import as_device_array, with_device_scope
 from ..base import (BaseEstimator, ClusterMixin, TransformerMixin,
                     check_is_fitted, check_n_features)
 from ..ops.linalg import pairwise_sq_distances, row_norms
-from ..utils import as_key, check_array, check_sample_weight
+from ..utils import as_key, check_sample_weight
 from .qkmeans import e_step, kmeans_plusplus, tolerance
 
 
@@ -70,6 +70,29 @@ def _host_reassign(rng, Xb, wb, centers, counts, step_idx,
     centers = np.where(served[:, None], Xb[sel], centers).astype(np.float32)
     counts = np.where(served, keep_min, counts)
     return centers, counts
+
+
+def _host_minibatch_step(rng, Xb, wb, xsqb, centers, counts, step_idx, *,
+                         window, reassignment_ratio):
+    """One Sculley streaming update on the host (the CPU twin of
+    :func:`minibatch_step`): fused BLAS E+M partials via
+    :func:`sq_learn_tpu.native.host_lloyd_step`, the running-mean center
+    move, and the periodic low-count reassignment. Shared by the host fit
+    loop and the host ``partial_fit`` fast path. Returns
+    ``(centers, counts, batch_inertia)``."""
+    from .. import native
+
+    labels, _, sums, bcounts, inertia = native.host_lloyd_step(
+        rng, Xb, wb, xsqb, centers, window)
+    new_counts = counts + bcounts
+    safe = np.where(new_counts > 0, new_counts, 1.0)
+    upd = (sums - bcounts[:, None] * centers) / safe[:, None]
+    centers = np.where((bcounts > 0)[:, None], centers + upd,
+                       centers).astype(np.float32)
+    if reassignment_ratio > 0:
+        centers, new_counts = _host_reassign(
+            rng, Xb, wb, centers, new_counts, step_idx, reassignment_ratio)
+    return centers, new_counts, float(inertia)
 
 
 def _host_minibatch_fit(rng, Xn, wn, *, n_clusters, batch_size, max_iter,
@@ -112,18 +135,9 @@ def _host_minibatch_fit(rng, Xn, wn, *, n_clusters, batch_size, max_iter,
             Xs, xs, k, ws)
 
     def step(Xb, wb, xsqb, centers, counts, step_idx):
-        labels, _, sums, bcounts, inertia = native.host_lloyd_step(
-            rng, Xb, wb, xsqb, centers, window)
-        new_counts = counts + bcounts
-        safe = np.where(new_counts > 0, new_counts, 1.0)
-        upd = (sums - bcounts[:, None] * centers) / safe[:, None]
-        centers = np.where((bcounts > 0)[:, None], centers + upd,
-                           centers).astype(np.float32)
-        if reassignment_ratio > 0:
-            centers, new_counts = _host_reassign(
-                rng, Xb, wb, centers, new_counts, step_idx,
-                reassignment_ratio)
-        return centers, new_counts, float(inertia)
+        return _host_minibatch_step(
+            rng, Xb, wb, xsqb, centers, counts, step_idx, window=window,
+            reassignment_ratio=reassignment_ratio)
 
     # -- init selection (upstream MiniBatchKMeans.fit semantics) --
     if n_init == 1:
@@ -394,7 +408,9 @@ class MiniBatchQKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
 
     @with_device_scope
     def fit(self, X, y=None, sample_weight=None):
-        X = check_array(X)
+        from .. import obs as _obs
+
+        X = self._validated_X(X)
         self.n_features_in_ = X.shape[1]
         if X.shape[0] < self.n_clusters:
             raise ValueError(
@@ -404,9 +420,13 @@ class MiniBatchQKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
 
         # same size-aware dispatch as QKMeans.fit: a digit-scale
         # streaming fit on a remote accelerator is pure tunnel latency
-        out, backend = dispatch_tiny_routed(
-            route_tiny_fit_to_host(X.size),
-            lambda: self._fit_impl(X, sample_weight))
+        with _obs.span("minibatch.fit", n_samples=X.shape[0],
+                       n_features=X.shape[1],
+                       n_clusters=self.n_clusters) as sp:
+            out, backend = dispatch_tiny_routed(
+                route_tiny_fit_to_host(X.size),
+                lambda: self._fit_impl(X, sample_weight))
+            sp.set(backend=backend, n_steps=getattr(self, "n_steps_", None))
         self.fit_backend_ = backend
         return out
 
@@ -617,17 +637,21 @@ class MiniBatchQKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
     def partial_fit(self, X, y=None, sample_weight=None):
         """Incremental update from one batch — the checkpointable streaming
         API (reference ``_dmeans.py:2139``)."""
+        from .. import obs as _obs
+
         # sklearn's partial_fit contract: reject before touching state
-        X = check_n_features(self, check_array(X))
+        X = check_n_features(self, self._validated_X(X))
         self.n_features_in_ = X.shape[1]
         from .._config import dispatch_tiny_routed, route_tiny_fit_to_host
 
         # one tiny batch = one dispatch-bound device round-trip; the
         # inter-call state (cluster_centers_/counts_) lives in numpy,
         # so per-call routing never strands state on either backend
-        out, backend = dispatch_tiny_routed(
-            route_tiny_fit_to_host(X.size),
-            lambda: self._partial_fit_impl(X, sample_weight))
+        with _obs.span("minibatch.partial_fit", batch=X.shape[0]) as sp:
+            out, backend = dispatch_tiny_routed(
+                route_tiny_fit_to_host(X.size),
+                lambda: self._partial_fit_impl(X, sample_weight))
+            sp.set(backend=backend)
         self.fit_backend_ = backend
         return out
 
@@ -639,6 +663,16 @@ class MiniBatchQKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         if self._pf_key is None:
             self._pf_key = as_key(self.random_state)
         self._pf_key, ki, kb = jax.random.split(self._pf_key, 3)
+        # host fast path, same engine as the CPU fit loop: one BLAS fused
+        # E+M step instead of a per-batch XLA dispatch — the expressible
+        # error models only (classic/δ-means), and never the very first
+        # call (the k-means++ init stays on the shared device kernel so
+        # host- and device-started streams init identically)
+        from .qkmeans import QKMeans as _QK
+
+        if (mode in ("classic", "delta") and _QK._on_cpu_backend()
+                and hasattr(self, "cluster_centers_")):
+            return self._partial_fit_host(X, sample_weight, kb, delta, mode)
         if not hasattr(self, "cluster_centers_"):
             centers, counts = self._init_state(
                 ki, as_device_array(X), jnp.asarray(sample_weight, X.dtype),
@@ -663,6 +697,36 @@ class MiniBatchQKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
             self.inertia_ = inertia
         return self
 
+    def _partial_fit_host(self, X, sample_weight, kb, delta, mode):
+        """Host twin of the device ``partial_fit`` step (semantics pinned
+        against it by test): fused BLAS E+M partials, Sculley move,
+        reassignment cadence — zero XLA dispatch per batch."""
+        from .. import native
+
+        Xb = np.ascontiguousarray(X, np.float32)
+        wb = np.ascontiguousarray(sample_weight, np.float32)
+        xsqb = np.einsum("ij,ij->i", Xb, Xb)
+        rng = np.random.default_rng(
+            np.asarray(jax.random.key_data(kb), np.uint32).tolist())
+        centers = np.ascontiguousarray(self.cluster_centers_, np.float32)
+        counts = np.asarray(self.counts_, np.float64)
+        window = delta if mode == "delta" else 0.0
+        centers, counts, _ = _host_minibatch_step(
+            rng, Xb, wb, xsqb, centers, counts,
+            int(getattr(self, "n_steps_", 0)), window=window,
+            reassignment_ratio=float(self.reassignment_ratio))
+        self.cluster_centers_ = np.asarray(centers, np.float32)
+        self.counts_ = np.asarray(counts, np.float32)
+        self.n_steps_ = getattr(self, "n_steps_", 0) + 1
+        if self.compute_labels:
+            labels, _, _, _, inertia = native.host_lloyd_step(
+                rng, Xb, wb, xsqb,
+                np.ascontiguousarray(self.cluster_centers_, np.float32),
+                0.0, e_only=True)
+            self.labels_ = np.asarray(labels)
+            self.inertia_ = float(inertia)
+        return self
+
     def _full_assign(self, X, sample_weight):
         d2 = pairwise_sq_distances(
             jnp.asarray(X), jnp.asarray(self.cluster_centers_, X.dtype))
@@ -674,7 +738,7 @@ class MiniBatchQKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
     @with_device_scope
     def predict(self, X, sample_weight=None):
         check_is_fitted(self, "cluster_centers_")
-        X = check_n_features(self, check_array(X))
+        X = check_n_features(self, self._validated_X(X))
         d2 = pairwise_sq_distances(
             jnp.asarray(X), jnp.asarray(self.cluster_centers_, X.dtype))
         return np.asarray(jnp.argmin(d2, axis=1))
@@ -682,17 +746,20 @@ class MiniBatchQKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
     @with_device_scope
     def transform(self, X):
         check_is_fitted(self, "cluster_centers_")
-        X = check_n_features(self, check_array(X))
+        X = check_n_features(self, self._validated_X(X))
         from ..metrics import euclidean_distances
 
         return np.asarray(euclidean_distances(X, self.cluster_centers_))
 
     def fit_transform(self, X, y=None, sample_weight=None):
-        return self.fit(X, sample_weight=sample_weight).transform(X)
+        from ..utils import validation_scope
+
+        with validation_scope(self):
+            return self.fit(X, sample_weight=sample_weight).transform(X)
 
     def score(self, X, y=None, sample_weight=None):
         check_is_fitted(self, "cluster_centers_")
-        X = check_n_features(self, check_array(X))
+        X = check_n_features(self, self._validated_X(X))
         sample_weight = check_sample_weight(sample_weight, X)
         _, inertia = self._full_assign(X, sample_weight)
         return -inertia

@@ -444,7 +444,7 @@ class QPCA(TransformerMixin, BaseEstimator):
             if hasattr(self, attr):
                 delattr(self, attr)
 
-        X = check_array(X, copy=self.copy)
+        X = self._validated_X(X, copy=self.copy)
         self.n_features_in_ = X.shape[1]
         from .._config import dispatch_tiny_routed, route_tiny_fit_to_host
 
@@ -559,10 +559,15 @@ class QPCA(TransformerMixin, BaseEstimator):
         and crashes (``_qPCA.py:467-473``, SURVEY §2.1); this implements
         the documented intent: every ``fit`` quantum kwarg passes through,
         and the transform-side knobs select the classical or quantum
-        projection of the training data.
+        projection of the training data. The fit and transform halves
+        share one validate-once scope: the transform half reuses the
+        array the fit half blessed.
         """
-        self.fit(X, **fit_kwargs)
-        return self.transform(
+        from ..utils import validation_scope
+
+        with validation_scope(self):
+            self.fit(X, **fit_kwargs)
+            return self.transform(
             X, classic_transform=classic_transform,
             epsilon_delta=epsilon_delta,
             quantum_representation=quantum_representation, norm=norm,
@@ -1125,11 +1130,16 @@ class QPCA(TransformerMixin, BaseEstimator):
 
     # -- transform ------------------------------------------------------------
 
-    def _project(self, X, use_classical_components=True):
+    def _project(self, X, use_classical_components=True, *,
+                 validated=False):
         """(X − mean)·Wᵀ with W either the classical components or the
-        tomography-estimated ones (reference ``_base.py:97-128``)."""
+        tomography-estimated ones (reference ``_base.py:97-128``).
+        ``validated=True`` skips the array contract — for callers that
+        already blessed ``X`` this call (the transform impl, whose
+        tiny-route re-entry used to re-validate every input)."""
         check_is_fitted(self, "components_")
-        X = check_n_features(self, check_array(X))
+        if not validated:
+            X = check_n_features(self, self._validated_X(X))
         Xc = jnp.asarray(X) - jnp.asarray(self.mean_)
         if use_classical_components:
             W = jnp.asarray(self.components_)
@@ -1161,6 +1171,19 @@ class QPCA(TransformerMixin, BaseEstimator):
         'q_state' (a :class:`QuantumState` over rows), 'None' (noisy
         estimate), 'f_norm' (noisy estimate, F-normalized).
         """
+        check_is_fitted(self, "components_")
+        X = check_n_features(self, self._validated_X(X))
+        return self._transform_impl(
+            X, classic_transform, epsilon_delta, quantum_representation,
+            norm, psi, true_tomography, use_classical_components)
+
+    def _transform_impl(self, X, classic_transform, epsilon_delta,
+                        quantum_representation, norm, psi, true_tomography,
+                        use_classical_components):
+        """The transform body proper (``X`` already validated once —
+        the tiny-route re-entry below must not re-run the array contract
+        ``transform``/``fit`` just blessed; pinned by the validation-spy
+        test)."""
         from .._config import (host_routed_scope, on_cpu_backend,
                                route_tiny_fit_to_host)
 
@@ -1174,21 +1197,20 @@ class QPCA(TransformerMixin, BaseEstimator):
             # pin (VERDICT r5 #4 closed the transform-surface gap).
             # fit_transform's transform half routes through here too.
             with host_routed_scope():
-                return self.transform(
-                    X, classic_transform=classic_transform,
-                    epsilon_delta=epsilon_delta,
-                    quantum_representation=quantum_representation,
-                    norm=norm, psi=psi, true_tomography=true_tomography,
-                    use_classical_components=use_classical_components)
+                return self._transform_impl(
+                    X, classic_transform, epsilon_delta,
+                    quantum_representation, norm, psi, true_tomography,
+                    use_classical_components)
         if classic_transform:
             if epsilon_delta != 0 or quantum_representation or psi != 0:
                 warnings.warn(
                     "Warning! You are using the classical transform, so the "
                     "quantum parameters are useless.")
-            return self._project(X)
+            return self._project(X, validated=True)
 
         X_final = self._project(
-            X, use_classical_components=use_classical_components)
+            X, use_classical_components=use_classical_components,
+            validated=True)
         if quantum_representation:
             assert psi > 0 if norm != "est_representation" else psi >= 0
             assert epsilon_delta > 0
@@ -1480,7 +1502,10 @@ class PCA(QPCA):
         return self._project(X)
 
     def fit_transform(self, X, y=None):
-        return self.fit(X).transform(X)
+        from ..utils import validation_scope
+
+        with validation_scope(self):
+            return self.fit(X).transform(X)
 
     @with_device_scope
     def inverse_transform(self, X):

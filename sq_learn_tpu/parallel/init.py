@@ -1,0 +1,349 @@
+"""Sharded / batched k-means++ initialization kernels.
+
+The reference inits q-means with host-looped greedy k-means++
+(``_dmeans.py:153-245``); PR 6 makes initialization a first-class device
+kernel family:
+
+- :func:`kmeans_plusplus_batched` — all restarts' D²-sampling inits in ONE
+  jit (vmapped over the restart axis), with an optional uniform row
+  subsample (the sketch acceleration: on 70k×784 the full-data potential
+  scans are ~90 % of init cost, while a 4-8k-row subsample loses <1 %
+  final inertia — see ``bench/records`` PR 6 profile).
+- :func:`kmeans_plusplus_sharded` — the same kernel under ``shard_map``
+  with the sample axis sharded over a mesh and psum-combined potentials.
+
+**Layout invariance.** Both kernels draw every candidate through the same
+two-stage hierarchical sampler over a fixed grid of ``n_blocks`` row
+blocks (stage 1: inverse-CDF over the per-block potential sums; stage 2:
+inverse-CDF inside the owning block), and reduce every potential sum
+block-wise before the fixed-order cross-block sum. Because the block grid
+is anchored to GLOBAL row indices (never to the shard layout), the
+per-block partials are computed from identical data in identical order on
+any mesh shape — so a fixed PRNG key selects the SAME center indices on 1
+device and on an 8-device mesh (pinned by test and by the driver's
+multichip gate). A plain ``psum`` of per-shard float sums would not give
+this: float reduction order would change with the layout.
+
+Zero-weight rows (mesh padding, masked samples) carry zero potential and
+are never selected — same contract as
+:func:`~sq_learn_tpu.models.qkmeans.kmeans_plusplus`, whose host-loop
+cumsum sampler these kernels replace on the batched/sharded fit paths.
+"""
+
+import functools
+import math
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from .. import obs as _obs
+from .._compat import axis_size, shard_map
+from .mesh import DATA_AXIS, pad_to_multiple
+
+__all__ = [
+    "NBLOCKS",
+    "resolve_init_subsample",
+    "kmeans_plusplus_batched",
+    "kmeans_plusplus_sharded",
+]
+
+#: number of row blocks of the hierarchical sampler — the layout-invariance
+#: anchor. Must be a multiple of the mesh device count (blocks never
+#: straddle shards); 64 covers every mesh this repo builds.
+NBLOCKS = 64
+
+
+def resolve_init_subsample(n_samples, n_clusters, setting="auto"):
+    """Row count of the uniform init subsample (0 = init on the full
+    data). ``setting`` is the estimator's ``init_subsample`` hyperparam:
+    'auto' targets ``max(128·k, 4096)`` rows (rounded up to a block
+    multiple) and only engages when the data is ≥4× larger — small fits
+    keep the exact full-data potentials, so the subsample never changes a
+    digit-scale result. ``SQ_INIT_SUBSAMPLE`` overrides the 'auto' target
+    (0 disables). Explicit integers are used as given (0/None disables).
+    """
+    if setting == "auto":
+        env = os.environ.get("SQ_INIT_SUBSAMPLE")
+        if env is not None:
+            setting = int(env)
+    if setting == "auto":
+        target = max(128 * int(n_clusters), 4096)
+    elif not setting:
+        return 0
+    else:
+        target = int(setting)
+    target = -(-target // NBLOCKS) * NBLOCKS
+    return target if n_samples > 4 * target else 0
+
+
+def _default_trials(n_clusters):
+    return 2 + int(math.log(n_clusters))
+
+
+def _pad_rows(v, n_pad, fill=0.0):
+    n = v.shape[0]
+    if n == n_pad:
+        return v
+    return jnp.concatenate(
+        [v, jnp.full((n_pad - n,) + v.shape[1:], fill, v.dtype)])
+
+
+def _block_sums(v, n_blocks, axis_name):
+    """(rows,) → (n_blocks,) global per-block sums, replicated. The
+    per-block reduction runs over the block's own rows only, so its value
+    is independent of how rows are sharded; the sharded gather uses the
+    psum-slot trick (axis-invariant output keeps shard_map's
+    varying-manual-axes check enabled)."""
+    if axis_name is None:
+        return v.reshape(n_blocks, -1).sum(axis=1)
+    n_sh = axis_size(axis_name)
+    local = v.reshape(n_blocks // n_sh, -1).sum(axis=1)
+    buf = jnp.zeros((n_sh, local.shape[0]), v.dtype)
+    buf = buf.at[lax.axis_index(axis_name)].set(local)
+    return lax.psum(buf, axis_name).reshape(-1)
+
+
+def _pot_total(vals, n_blocks, axis_name):
+    """Layout-invariant Σ vals: block partials, then a fixed-order sum."""
+    return jnp.sum(_block_sums(vals, n_blocks, axis_name))
+
+
+def _draw_index(key, pot, n_blocks, axis_name):
+    """One global categorical draw ∝ ``pot`` via the two-stage block
+    sampler. Returns the global row index (int32). Rows with zero
+    potential are never selected (the stage boundaries are strict)."""
+    bsums = _block_sums(pot, n_blocks, axis_name)
+    cum = jnp.cumsum(bsums)
+    total = cum[-1]
+    u = jax.random.uniform(key, (), pot.dtype)
+    # strictly below the total so side='right' always lands inside a
+    # positive-mass block (and inside a positive-potential row within it)
+    t = jnp.minimum(u, jnp.asarray(0.999999, pot.dtype)) * total
+    b = jnp.clip(jnp.searchsorted(cum, t, side="right"), 0, n_blocks - 1)
+    prev = jnp.where(b > 0, cum[jnp.maximum(b - 1, 0)], 0.0)
+    if axis_name is None:
+        bs = pot.shape[0] // n_blocks
+        block = lax.dynamic_slice(pot, (b * bs,), (bs,))
+        off = jnp.clip(
+            jnp.searchsorted(jnp.cumsum(block), t - prev, side="right"),
+            0, bs - 1)
+        return (b * bs + off).astype(jnp.int32)
+    n_sh = axis_size(axis_name)
+    blocks_local = n_blocks // n_sh
+    bs = pot.shape[0] // blocks_local
+    sh = lax.axis_index(axis_name)
+    owner = b // blocks_local
+    b_loc = jnp.where(owner == sh, b - owner * blocks_local, 0)
+    block = lax.dynamic_slice(pot, (b_loc * bs,), (bs,))
+    off = jnp.clip(
+        jnp.searchsorted(jnp.cumsum(block), t - prev, side="right"),
+        0, bs - 1)
+    idx = jnp.where(owner == sh, b * bs + off, 0)
+    return lax.psum(idx, axis_name).astype(jnp.int32)
+
+
+def _take_row(X, idx, axis_name):
+    """Gather one global row of the (possibly sharded) sample axis."""
+    if axis_name is None:
+        return X[idx]
+    rows_local = X.shape[0]
+    sh = lax.axis_index(axis_name)
+    local = idx - sh * rows_local
+    inside = jnp.logical_and(local >= 0, local < rows_local)
+    row = jnp.where(inside, X[jnp.clip(local, 0, rows_local - 1)], 0.0)
+    return lax.psum(row, axis_name)
+
+
+def _kpp_run(key, X, x_sq, weights, *, n_clusters, n_local_trials,
+             n_blocks=NBLOCKS, axis_name=None):
+    """One greedy best-of-trials D²-sampling init (the layout-invariant
+    core). ``X`` is the local shard (or the whole matrix); the (rows,)
+    potential vectors are padded to a block multiple internally —
+    zero-weight padding carries zero potential throughout.
+
+    Returns (centers (k, m), global indices (k,)).
+    """
+    n, m = X.shape
+    if axis_name is None:
+        bs = -(-n // n_blocks)
+        n_pad = bs * n_blocks
+    else:
+        n_pad = n  # the sharded wrapper pre-pads to a block multiple
+    w_pad = _pad_rows(weights, n_pad)
+
+    key, k0 = jax.random.split(key)
+    first = _draw_index(k0, w_pad, n_blocks, axis_name)
+    c0 = _take_row(X, first, axis_name)
+    d0 = jnp.maximum(
+        x_sq + jnp.sum(c0 * c0) - 2.0 * (X @ c0), 0.0)
+    closest = _pad_rows(d0, n_pad)
+    centers = jnp.zeros((n_clusters, m), X.dtype).at[0].set(c0)
+    indices = jnp.full((n_clusters,), -1, jnp.int32).at[0].set(first)
+
+    def body(c, carry):
+        centers, indices, closest = carry
+        kc = jax.random.fold_in(key, c)
+        pot = closest * w_pad
+        # greedy best-of-trials: each trial is one independent block-
+        # sampler draw; the trial GEMM batches all candidates in one pass
+        cand_idx = jnp.stack([
+            _draw_index(jax.random.fold_in(kc, t), pot, n_blocks, axis_name)
+            for t in range(n_local_trials)])
+        cand_rows = jnp.stack([
+            _take_row(X, cand_idx[t], axis_name)
+            for t in range(n_local_trials)])
+        c_sq = jnp.sum(cand_rows * cand_rows, axis=1)
+        d2 = jnp.maximum(
+            x_sq[None, :] + c_sq[:, None] - 2.0 * (cand_rows @ X.T), 0.0)
+        new_closest = jnp.minimum(
+            closest[None, :],
+            jnp.stack([_pad_rows(d2[t], n_pad)
+                       for t in range(n_local_trials)]))
+        pots = jnp.stack([
+            _pot_total(new_closest[t] * w_pad, n_blocks, axis_name)
+            for t in range(n_local_trials)])
+        best = jnp.argmin(pots)
+        closest = new_closest[best]
+        centers = centers.at[c].set(cand_rows[best])
+        indices = indices.at[c].set(cand_idx[best])
+        return centers, indices, closest
+
+    centers, indices, _ = lax.fori_loop(
+        1, n_clusters, body, (centers, indices, closest))
+    return centers, indices
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_clusters", "n_restarts", "n_local_trials",
+                     "subsample"))
+def _kpp_batched_jit(key, X, x_sq_norms, weights, *, n_clusters,
+                     n_restarts, n_local_trials, subsample):
+    n = X.shape[0]
+    if subsample and subsample < n:
+        key, ks = jax.random.split(key)
+        sub = jax.random.choice(ks, n, (subsample,), replace=False)
+        Xs, xs, ws = X[sub], x_sq_norms[sub], weights[sub]
+    else:
+        sub = None
+        Xs, xs, ws = X, x_sq_norms, weights
+    keys = jax.random.split(key, n_restarts)
+    centers, indices = jax.vmap(
+        lambda k: _kpp_run(k, Xs, xs, ws, n_clusters=n_clusters,
+                           n_local_trials=n_local_trials))(keys)
+    if sub is not None:
+        indices = sub[indices].astype(jnp.int32)
+    return centers, indices
+
+
+def kmeans_plusplus_batched(key, X, x_sq_norms=None, n_clusters=8, *,
+                            n_restarts=1, weights=None, n_local_trials=None,
+                            subsample=0):
+    """All ``n_restarts`` k-means++ inits as ONE dispatch (vmapped
+    restarts). ``subsample`` > 0 draws that many rows uniformly (one
+    shared draw, weights preserved) and runs the D² potentials on them —
+    the sketch-accelerated init. Returns (centers (R, k, m), indices
+    (R, k) into the ORIGINAL rows).
+
+    Traceable: safe to call from inside an enclosing jit (the fused fit
+    does); the public eager call registers the obs watchdog site
+    ``parallel.init.kmeans_plusplus`` with a ≤1-compile-per-signature
+    budget.
+    """
+    X = jnp.asarray(X)
+    if x_sq_norms is None:
+        x_sq_norms = jnp.sum(X * X, axis=1)
+    if weights is None:
+        weights = jnp.ones((X.shape[0],), X.dtype)
+    if n_local_trials is None:
+        n_local_trials = _default_trials(n_clusters)
+    # watchdog accounting only on eager (host-driven) calls — when traced
+    # inside an enclosing jit (the fused fit), the outer site accounts
+    traced = isinstance(X, jax.core.Tracer)
+    if _obs.enabled() and not traced:
+        site = "parallel.init.kmeans_plusplus"
+        _obs.watchdog.track(site, _kpp_batched_jit)
+        _obs.watchdog.allow(site, (X.shape, str(X.dtype), int(n_clusters),
+                                   int(n_restarts), int(subsample)))
+    out = _kpp_batched_jit(key, X, x_sq_norms, weights,
+                           n_clusters=int(n_clusters),
+                           n_restarts=int(n_restarts),
+                           n_local_trials=int(n_local_trials),
+                           subsample=int(subsample))
+    if _obs.enabled() and not traced:
+        _obs.watchdog.observe("parallel.init.kmeans_plusplus")
+    return out
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_kpp(mesh, n_clusters, n_local_trials):
+    run = functools.partial(_kpp_run, n_clusters=n_clusters,
+                            n_local_trials=n_local_trials,
+                            axis_name=DATA_AXIS)
+
+    def one_restart(key, X, x_sq, weights):
+        # same key layout as the batched kernel's n_restarts=1 split, so
+        # the two entry points are interchangeable restart-for-restart
+        return run(jax.random.split(key, 1)[0], X, x_sq, weights)
+
+    return jax.jit(shard_map(
+        one_restart,
+        mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(P(), P()),
+    ))
+
+
+def kmeans_plusplus_sharded(mesh, key, X, x_sq_norms=None, n_clusters=8, *,
+                            weights=None, n_local_trials=None):
+    """One k-means++ init under ``shard_map`` with the sample axis sharded
+    over ``mesh`` — every potential reduction and candidate draw runs
+    through the layout-invariant block sampler, so the selected indices
+    (and therefore the centers, which are exact data rows) are IDENTICAL
+    to ``kmeans_plusplus_batched(key, ..., n_restarts=1)`` on one device
+    with the same key. Zero-weight padding rows are never selected.
+
+    Returns (centers (k, m), indices (k,)).
+    """
+    n_dev = int(mesh.devices.size)
+    if NBLOCKS % n_dev:
+        raise ValueError(
+            f"mesh of {n_dev} devices does not divide the {NBLOCKS}-block "
+            f"sampling grid")
+    X = jnp.asarray(X)
+    if x_sq_norms is None:
+        x_sq_norms = jnp.sum(X * X, axis=1)
+    if weights is None:
+        weights = jnp.ones((X.shape[0],), X.dtype)
+    if n_local_trials is None:
+        n_local_trials = _default_trials(n_clusters)
+    with _obs.span("parallel.init.kmeans_plusplus_sharded",
+                   n_devices=n_dev, n_samples=int(X.shape[0]),
+                   n_clusters=int(n_clusters)) as sp:
+        Xp, _ = pad_to_multiple(X, NBLOCKS)
+        xsq_p, _ = pad_to_multiple(x_sq_norms, NBLOCKS)
+        w_p, _ = pad_to_multiple(weights, NBLOCKS)
+        run = _sharded_kpp(mesh, int(n_clusters), int(n_local_trials))
+        if _obs.enabled():
+            site = "parallel.init.kmeans_plusplus_sharded"
+            _obs.watchdog.track(site, run)
+            _obs.watchdog.allow(site, (Xp.shape, str(Xp.dtype),
+                                       int(n_clusters)))
+        centers, indices = run(key, Xp, xsq_p, w_p)
+        sp.sync(centers)
+    if _obs.enabled():
+        _obs.watchdog.observe("parallel.init.kmeans_plusplus_sharded")
+    return centers, indices
+
+
+def host_subsample_indices(rng, n_samples, target):
+    """Host twin of the in-jit subsample draw (the native engines share
+    the same uniform-without-replacement semantics; streams are
+    engine-local, as everywhere else)."""
+    if not target or target >= n_samples:
+        return None
+    return np.sort(rng.choice(n_samples, target, replace=False))

@@ -75,6 +75,7 @@ __all__ = [
     "streamed_centered_gram",
     "streamed_centered_svd_topk",
     "streamed_randomized_svd",
+    "streamed_kmeans_plusplus",
     "streamed_prestats",
     "kernel_cache_sizes",
     "worth_streaming",
@@ -460,6 +461,27 @@ def _ingest_step(acc, tile, n_valid, start):
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
+def _kpp_score_step(acc, tile, n_valid, start, cand, closest, weights):
+    """One tile of a streamed k-means++ scoring round: distances of the
+    trial candidates ``cand`` (T, m) to the tile's rows, the would-be
+    closest-D² update against the resident ``closest`` buffer, and the
+    per-trial weighted potential partials. Zero-weight (padding) rows
+    contribute nothing to the potentials; their buffer values are
+    multiplied by weight 0 wherever they are consumed."""
+    buf, pots = acc
+    xsq = jnp.sum(tile * tile, axis=1)
+    c_sq = jnp.sum(cand * cand, axis=1)
+    d2 = jnp.maximum(
+        xsq[None, :] + c_sq[:, None] - 2.0 * (cand @ tile.T), 0.0)
+    rows = tile.shape[0]
+    cl = lax.dynamic_slice(closest, (start,), (rows,))
+    wt = lax.dynamic_slice(weights, (start,), (rows,))
+    nc = jnp.minimum(cl[None, :], d2)
+    buf = lax.dynamic_update_slice(buf, nc, (0, start))
+    return buf, pots + jnp.sum(nc * wt[None, :], axis=1)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
 def _matmul_accum_step(acc, tile, Q):
     """acc ← acc + tileᵀ·(tile·Q) — one power-iteration pass of the
     Gram-based range finder, never materializing the (n, size) product."""
@@ -508,6 +530,7 @@ _gram_colsum_step = _xla.instrument("streaming.gram_colsum",
                                     _gram_colsum_step)
 _colsum_step = _xla.instrument("streaming.colsum", _colsum_step)
 _ingest_step = _xla.instrument("streaming.ingest", _ingest_step)
+_kpp_score_step = _xla.instrument("streaming.kpp_score", _kpp_score_step)
 _matmul_accum_step = _xla.instrument("streaming.matmul_accum",
                                      _matmul_accum_step)
 _project_rows_step = _xla.instrument("streaming.project_rows",
@@ -522,6 +545,7 @@ _KERNELS = {
     "gram_colsum": _gram_colsum_step,
     "colsum": _colsum_step,
     "ingest": _ingest_step,
+    "kpp_score": _kpp_score_step,
     "matmul_accum": _matmul_accum_step,
     "project_rows": _project_rows_step,
     "qtb": _qtb_step,
@@ -691,6 +715,79 @@ def streamed_randomized_svd(key, X, n_components, *, n_oversamples=10,
     k = int(n_components)
     out = (U[:, :k], S[:k], Vt[:k])
     return out + (mean,) if center else out
+
+
+def streamed_kmeans_plusplus(key, X, n_clusters, *, weights=None,
+                             n_local_trials=None, max_bytes=None,
+                             device=None):
+    """Greedy best-of-trials k-means++ over HOST data, one streamed pass
+    per round — the out-of-core init primitive (ROADMAP item 3): X is
+    never device-resident, only the (n,) closest-D² buffer and the
+    (trials, n) scoring accumulator live on device, and every candidate
+    row crosses as part of a bounded tile under the transfer supervisor.
+    Each round's scoring kernel (``streaming.kpp_score``) compiles at
+    most once per (bucket, dtype) — the ≤1-compile-per-bucket invariant,
+    watchdog-enforced like every streaming kernel.
+
+    Same distribution family as the resident kernels
+    (:mod:`sq_learn_tpu.parallel.init`): weighted first pick, then k−1
+    rounds of D² sampling keeping the best of ``n_local_trials``
+    candidates; streams are engine-local, as everywhere else. Returns
+    ``(centers (k, m) ndarray, indices (k,) ndarray)``.
+    """
+    import math as _math
+
+    X = np.asarray(X)
+    n, m = X.shape
+    dtype = jax.dtypes.canonicalize_dtype(X.dtype)
+    if n_local_trials is None:
+        n_local_trials = 2 + int(_math.log(n_clusters))
+    n_pad = padded_rows(n, X.nbytes // max(1, n), max_bytes)
+    w = (np.ones(n, dtype) if weights is None
+         else np.asarray(weights, dtype))
+    w_dev = jnp.asarray(np.pad(w, (0, n_pad - n)))
+    with _obs.span("streaming.kmeans_plusplus", n=n, m=m,
+                   n_clusters=int(n_clusters)):
+        key, k0 = jax.random.split(key)
+        first = int(jax.random.categorical(
+            k0, jnp.log(jnp.maximum(jnp.asarray(w), 1e-38))))
+        indices = [first]
+        centers = [np.ascontiguousarray(X[first], dtype)]
+        closest = jnp.full((n_pad,), jnp.inf, dtype)
+
+        def score_pass(cand_rows, closest, tag):
+            init = (jnp.zeros((cand_rows.shape[0], n_pad), dtype),
+                    jnp.zeros((cand_rows.shape[0],), dtype))
+            step = functools.partial(_kpp_score_step,
+                                     cand=jnp.asarray(cand_rows),
+                                     closest=closest, weights=w_dev)
+            return stream_fold(X, step, init, max_bytes=max_bytes,
+                               device=device, with_offsets=True,
+                               site="streaming.kpp_score",
+                               checkpoint=False, pass_tag=tag)
+
+        # the seeding pass replicates the first center across the trial
+        # axis so every round's kernel shares ONE (trials, bucket) shape —
+        # the ≤1-compile-per-bucket invariant would otherwise be broken by
+        # a (1, bucket) first-round signature
+        buf, _ = score_pass(
+            np.broadcast_to(centers[0], (n_local_trials, m)), closest,
+            "round_0")
+        closest = buf[0]
+        for c in range(1, int(n_clusters)):
+            key, kc = jax.random.split(key)
+            pot = closest * w_dev
+            cum = jnp.cumsum(pot)
+            draws = jax.random.uniform(kc, (n_local_trials,), dtype) * cum[-1]
+            cand_idx = np.asarray(
+                jnp.clip(jnp.searchsorted(cum, draws), 0, n - 1))
+            cand_rows = np.ascontiguousarray(X[cand_idx], dtype)
+            buf, pots = score_pass(cand_rows, closest, f"round_{c}")
+            best = int(jnp.argmin(pots))
+            closest = buf[best]
+            indices.append(int(cand_idx[best]))
+            centers.append(cand_rows[best])
+    return np.stack(centers), np.asarray(indices, np.int64)
 
 
 def streamed_prestats(X, *, quantum=False, mu_grid=(), mu_blocked=False,

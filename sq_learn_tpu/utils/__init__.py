@@ -9,6 +9,8 @@ from .validation import (
     check_random_state,
     check_sample_weight,
     check_X_y,
+    validated_once,
+    validation_scope,
 )
 
 __all__ = [
@@ -19,6 +21,8 @@ __all__ = [
     "check_random_state",
     "check_sample_weight",
     "check_X_y",
+    "validated_once",
+    "validation_scope",
     "save_estimator",
     "load_estimator",
     "save_pytree",

@@ -8,6 +8,7 @@ before arrays are shipped to the device; everything returned is a plain
 """
 
 import numbers
+from contextlib import contextmanager
 
 import numpy as np
 
@@ -69,6 +70,56 @@ def check_array(X, *, dtype="float", ensure_2d=True, allow_nd=False, copy=False,
                 f"{ensure_min_features} is required."
             )
     return np.ascontiguousarray(X)
+
+
+@contextmanager
+def validation_scope(estimator):
+    """Open a validate-once scope on ``estimator``: while active, repeated
+    :meth:`~sq_learn_tpu.base.BaseEstimator._validated_X` calls on the
+    SAME input object return the first call's validated array instead of
+    re-running the full :func:`check_array` contract (dtype/copy/finite
+    scans — the finiteness pass alone is a full O(n·m) sweep).
+
+    The cache is keyed by object identity and lives only for the scope
+    (a transient ``_validation_scope`` attr, cleared on exit), so nothing
+    is ever trusted across estimator calls — a mutated or swapped array
+    in a LATER call is always re-validated. Nested scopes share the
+    outermost cache (``fit_transform`` wrapping a ``fit`` that opens its
+    own scope blesses exactly once).
+
+    This is the validate-once contract of the fused fit pipeline
+    (``docs/fit_pipeline.md``): ``fit_transform``/``fit_predict`` surfaces
+    open the scope so their fit and transform halves — and the size-aware
+    host re-entries inside them — validate each input exactly once.
+    """
+    prev = getattr(estimator, "_validation_scope", None)
+    if prev is None:
+        estimator._validation_scope = {}
+    try:
+        yield
+    finally:
+        if prev is None:
+            try:
+                del estimator._validation_scope
+            except AttributeError:
+                pass
+
+
+def validated_once(estimator, X, validator):
+    """Run ``validator(X)`` under the estimator's validate-once cache (a
+    no-op passthrough when no :func:`validation_scope` is open). Both the
+    input object and the validated result are blessed, so validating an
+    already-validated array is also a cache hit."""
+    scope = getattr(estimator, "_validation_scope", None)
+    if scope is None:
+        return validator(X)
+    hit = scope.get(id(X))
+    if hit is not None:
+        return hit
+    out = validator(X)
+    scope[id(X)] = out
+    scope[id(out)] = out
+    return out
 
 
 def check_X_y(X, y, **kwargs):

@@ -1,0 +1,517 @@
+"""PR 6 fused fit pipeline: sharded/batched k-means++ init kernels,
+validate-once array contract, host-prestats native route, and the
+while-loop convergence semantics of the whole-fit jit."""
+
+import warnings
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+import sq_learn_tpu.base as base_mod
+from sq_learn_tpu import obs
+from sq_learn_tpu.models import KMeans, MiniBatchQKMeans, QKMeans, QPCA
+from sq_learn_tpu.parallel.init import (NBLOCKS, kmeans_plusplus_batched,
+                                        kmeans_plusplus_sharded,
+                                        resolve_init_subsample)
+
+
+@pytest.fixture(scope="module")
+def blobs():
+    from sklearn.datasets import make_blobs
+
+    X, y = make_blobs(n_samples=517, centers=5, n_features=12,
+                      cluster_std=1.5, random_state=3)
+    return X.astype(np.float32), y
+
+
+@pytest.fixture
+def mesh8():
+    devs = jax.devices("cpu")
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return Mesh(np.asarray(devs[:8]), ("data",))
+
+
+class TestInitKernelParity:
+    """The layout-invariance contract of parallel/init.py: a fixed PRNG
+    key selects the same centers on 1 device and on an 8-device mesh."""
+
+    def test_sharded_matches_single_device_bitwise(self, blobs, mesh8):
+        X, _ = blobs
+        key = jax.random.PRNGKey(11)
+        c1, i1 = kmeans_plusplus_batched(key, X, n_clusters=6, n_restarts=1)
+        c8, i8 = kmeans_plusplus_sharded(mesh8, key, X, n_clusters=6)
+        np.testing.assert_array_equal(np.asarray(i1[0]), np.asarray(i8))
+        np.testing.assert_array_equal(np.asarray(c1[0]), np.asarray(c8))
+
+    def test_deterministic_under_fixed_key(self, blobs):
+        X, _ = blobs
+        key = jax.random.PRNGKey(5)
+        _, i_a = kmeans_plusplus_batched(key, X, n_clusters=4, n_restarts=3)
+        _, i_b = kmeans_plusplus_batched(key, X, n_clusters=4, n_restarts=3)
+        np.testing.assert_array_equal(np.asarray(i_a), np.asarray(i_b))
+        # restarts draw distinct streams
+        assert len({tuple(r) for r in np.asarray(i_a).tolist()}) > 1
+
+    def test_centers_are_data_rows_and_weighted(self, blobs):
+        X, _ = blobs
+        key = jax.random.PRNGKey(2)
+        c, i = kmeans_plusplus_batched(key, X, n_clusters=5, n_restarts=2)
+        i = np.asarray(i)
+        np.testing.assert_array_equal(np.asarray(c), X[i])
+        # zero-weight rows are never selected
+        w = np.ones(len(X), np.float32)
+        w[64:] = 0.0
+        _, iw = kmeans_plusplus_batched(key, X, n_clusters=5, n_restarts=3,
+                                        weights=w)
+        assert np.asarray(iw).max() < 64
+
+    def test_subsampled_init_quality_and_determinism(self, blobs):
+        X, _ = blobs
+        key = jax.random.PRNGKey(9)
+        c_s, i_s = kmeans_plusplus_batched(key, X, n_clusters=5,
+                                           n_restarts=2, subsample=128)
+        c_s2, i_s2 = kmeans_plusplus_batched(key, X, n_clusters=5,
+                                             n_restarts=2, subsample=128)
+        np.testing.assert_array_equal(np.asarray(i_s), np.asarray(i_s2))
+        # indices map back to ORIGINAL rows
+        np.testing.assert_array_equal(np.asarray(c_s),
+                                      X[np.asarray(i_s)])
+        # quality: subsampled potential stays within 2x of the full-data
+        # potential (D² init is robust to uniform row sketching)
+        xsq = (X**2).sum(1)
+
+        def pot(C):
+            d2 = xsq[:, None] + (C**2).sum(1)[None, :] - 2.0 * X @ C.T
+            return float(np.maximum(d2.min(1), 0).sum())
+
+        c_f, _ = kmeans_plusplus_batched(key, X, n_clusters=5, n_restarts=2)
+        full = min(pot(np.asarray(c_f[r])) for r in range(2))
+        sub = min(pot(np.asarray(c_s[r])) for r in range(2))
+        assert sub <= 2.0 * full
+
+    def test_resolve_policy(self):
+        # 'auto' engages only when the data dwarfs the target
+        assert resolve_init_subsample(70_000, 10) == 4096
+        assert resolve_init_subsample(1_000, 10) == 0
+        assert resolve_init_subsample(70_000, 10, 0) == 0
+        assert resolve_init_subsample(70_000, 10, None) == 0
+        # explicit targets round up to the block grid
+        assert resolve_init_subsample(10**6, 10, 1000) % NBLOCKS == 0
+
+    def test_mesh_estimator_uses_sharded_init(self, blobs, mesh8,
+                                              monkeypatch):
+        X, y = blobs
+        import sq_learn_tpu.parallel.init as pinit
+
+        calls = []
+        real = pinit.kmeans_plusplus_sharded
+
+        def spy(*a, **kw):
+            calls.append(1)
+            return real(*a, **kw)
+
+        monkeypatch.setattr(pinit, "kmeans_plusplus_sharded", spy)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            QKMeans(n_clusters=5, n_init=1, random_state=0,
+                    mesh=mesh8).fit(X)
+        assert calls, "mesh fit did not route init through the sharded kernel"
+
+
+class TestFusedClassicalParity:
+    """δ=0 must short-circuit to the exact classical computation."""
+
+    def _fused(self, X, **kw):
+        est = QKMeans(**kw)
+        delta = 0.0 if est.delta is None else float(est.delta)
+        w = np.ones(len(X), np.float32)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            out = est._fit_fused(X, w, delta, est._mode(delta))
+        assert out is est
+        return est
+
+    def test_delta0_fused_bit_equal_to_classical_kernels(self, blobs):
+        """The two-dispatch fused δ=0 fit reproduces the staged classical
+        XLA kernels (same key discipline) bit for bit."""
+        from sq_learn_tpu.models.qkmeans import fit_prestats
+        from sq_learn_tpu.utils import as_key
+
+        X, _ = blobs
+        est = self._fused(X, n_clusters=4, n_init=3, delta=0.0,
+                          random_state=7)
+        key = as_key(7)
+        # staged twin: same key split as _fit_fused
+        k_init, k_run = jax.random.split(key)
+        stats = fit_prestats(jnp.asarray(X), quantum=False)
+        w = jnp.ones(len(X), jnp.float32)
+        from sq_learn_tpu.models.qkmeans import (_restart_inits,
+                                                 lloyd_restarts_from)
+
+        centers0 = _restart_inits(k_init, stats["Xc"], w, stats["xsq"],
+                                  n_init=3, init="k-means++", n_clusters=4)
+        # fused_fit computes tol in f32 on device; mirror that exactly
+        tol = float(jnp.asarray(1e-4, jnp.float32) * stats["var_mean"])
+        labels, inertia, centers, n_iter, _ = lloyd_restarts_from(
+            k_run, stats["Xc"], w, stats["xsq"], centers0, tol=tol)
+        np.testing.assert_array_equal(est.labels_, np.asarray(labels))
+        np.testing.assert_allclose(
+            est.cluster_centers_,
+            np.asarray(centers) + np.asarray(stats["mean"]), rtol=1e-6)
+        np.testing.assert_allclose(est.inertia_, float(inertia), rtol=1e-6)
+        assert est.n_iter_ == int(n_iter)
+
+    def test_delta0_draws_nothing(self, blobs):
+        """With δ=0 the error model is OFF: different random_state with the
+        same deterministic init stack must give bit-identical fits (the
+        zero-error-budget short-circuit contract)."""
+        X, _ = blobs
+        # deterministic init: disable the k-means++ stream by fixing the
+        # restart count to 1 and comparing two seeds' Lloyd runs from the
+        # SAME centers via the functional kernel
+        from sq_learn_tpu.models.qkmeans import lloyd_single_jit
+
+        Xd = jnp.asarray(X - X.mean(0))
+        xsq = jnp.sum(Xd * Xd, axis=1)
+        w = jnp.ones(len(X), jnp.float32)
+        c0 = Xd[:4]
+        outs = []
+        for seed in (0, 123):
+            labels, inertia, centers, n_iter, _ = lloyd_single_jit(
+                jax.random.PRNGKey(seed), Xd, w, c0, xsq, delta=0.0,
+                mode="classic", tol=1e-5)
+            outs.append((np.asarray(labels), float(inertia),
+                         np.asarray(centers), int(n_iter)))
+        np.testing.assert_array_equal(outs[0][0], outs[1][0])
+        assert outs[0][1] == outs[1][1]
+        np.testing.assert_array_equal(outs[0][2], outs[1][2])
+        assert outs[0][3] == outs[1][3]
+
+    def test_classical_kmeans_facade_matches_delta0(self, blobs):
+        X, _ = blobs
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            a = QKMeans(n_clusters=4, n_init=2, delta=0.0,
+                        random_state=0).fit(X)
+            b = KMeans(n_clusters=4, n_init=2, random_state=0).fit(X)
+        np.testing.assert_array_equal(a.labels_, b.labels_)
+        np.testing.assert_array_equal(a.cluster_centers_, b.cluster_centers_)
+
+
+class TestWhileLoopSemantics:
+    """The lax.while_loop convergence carry (tolerance + patience) matches
+    the documented Python-stepped stopping rules exactly."""
+
+    def _python_n_iter(self, inertia_tr, shift_tr, tol, patience, max_iter):
+        best, best_it = np.inf, 0
+        it = 0
+        while it < max_iter and not np.isnan(shift_tr[it]):
+            if inertia_tr[it] < best:
+                best, best_it = inertia_tr[it], it
+            it += 1
+            if shift_tr[it - 1] <= tol:
+                break
+            if patience is not None and it - best_it > patience:
+                break
+        return it
+
+    @pytest.mark.parametrize("delta,mode,patience", [
+        (0.0, "classic", None),
+        (0.6, "delta", 3),
+        (0.6, "delta", 0),
+    ])
+    def test_n_iter_matches_trace_replay(self, blobs, delta, mode,
+                                         patience):
+        from sq_learn_tpu.models.qkmeans import lloyd_single_jit
+
+        X, _ = blobs
+        Xd = jnp.asarray(X - X.mean(0))
+        xsq = jnp.sum(Xd * Xd, axis=1)
+        w = jnp.ones(len(X), jnp.float32)
+        c0 = Xd[7:12]
+        tol = 1e-3
+        labels, inertia, centers, n_iter, hist = lloyd_single_jit(
+            jax.random.PRNGKey(0), Xd, w, c0, xsq, delta=delta, mode=mode,
+            max_iter=40, tol=tol, patience=patience)
+        replay = self._python_n_iter(
+            np.asarray(hist["inertia"]), np.asarray(hist["center_shift"]),
+            tol, patience, 40)
+        assert int(n_iter) == replay
+        # traces are NaN beyond n_iter and finite before it
+        assert np.all(np.isfinite(np.asarray(hist["inertia"])[:int(n_iter)]))
+        assert np.all(np.isnan(np.asarray(hist["inertia"])[int(n_iter):]))
+
+    def test_host_runner_same_rules(self, blobs):
+        """The native host loop stops by the same (shift<=tol, patience)
+        rules — replaying its own traces reproduces its n_iter."""
+        from sq_learn_tpu import native
+        from sq_learn_tpu.models.qkmeans import _native_lloyd_run
+
+        X, _ = blobs
+        Xn = np.ascontiguousarray(X - X.mean(0), np.float32)
+        wn = np.ones(len(Xn), np.float32)
+        xsq = (Xn**2).sum(1)
+        rng = np.random.default_rng(0)
+        labels, inertia, centers, n_iter, hist = _native_lloyd_run(
+            rng, Xn, wn, xsq, Xn[7:12].copy(), window=0.4, max_iter=40,
+            tol=1e-3, patience=3, use_cpp=native.native_available())
+        replay = self._python_n_iter(hist["inertia"], hist["center_shift"],
+                                     1e-3, 3, 40)
+        assert int(n_iter) == replay
+
+
+class TestFusedFitObs:
+    def test_fused_fit_compile_budget(self, blobs, tmp_path):
+        """Two same-shape fused fits mint at most one compile per kernel
+        signature — the watchdog budget the fused path declares."""
+        X, _ = blobs
+        obs.enable(path=str(tmp_path / "obs.jsonl"))
+        try:
+            for seed in (0, 1):
+                est = QKMeans(n_clusters=4, n_init=2, random_state=seed)
+                w = np.ones(len(X), np.float32)
+                with warnings.catch_warnings():
+                    warnings.simplefilter("ignore")
+                    assert est._fit_fused(X, w, 0.0, "classic") is est
+            report = obs.watchdog.report()
+            for site in ("qkmeans.fused_init", "qkmeans.fused_fit"):
+                assert site in report
+                assert not report[site]["over_budget"], report[site]
+                assert report[site]["compiles"] <= report[site]["budget"]
+        finally:
+            obs.disable()
+
+    def test_native_fit_spans_and_provenance(self, blobs, tmp_path):
+        X, _ = blobs
+        path = tmp_path / "obs.jsonl"
+        obs.enable(path=str(path))
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                est = QKMeans(n_clusters=4, n_init=2, delta=0.5,
+                              true_distance_estimate=False,
+                              random_state=0).fit(X)
+        finally:
+            obs.disable()
+        assert est.ingest_ == "host"
+        import json
+
+        spans = [json.loads(l)["name"] for l in open(path)
+                 if '"type": "span"' in l]
+        for name in ("qkmeans.prestats", "qkmeans.native_init",
+                     "qkmeans.native_lloyd", "qkmeans.quantum_stats",
+                     "qkmeans.fit"):
+            assert name in spans, (name, spans)
+        # quantum stats exist and are real numbers
+        assert est.eta_ > 0 and np.isfinite(est.mu_)
+
+
+class TestHostPrestatsRoute:
+    def test_matches_staged_device_path(self, blobs):
+        """The host-prestats native fit agrees with the staged XLA path on
+        statistics and quality (engines differ, distributions match)."""
+        X, _ = blobs
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            host = QKMeans(n_clusters=5, n_init=2, delta=0.5,
+                           true_distance_estimate=False,
+                           random_state=0).fit(X)
+            # forcing a non-auto kernel disables the native route: the
+            # staged XLA path with streamed/monolithic ingest runs instead
+            staged = QKMeans(n_clusters=5, n_init=2, delta=0.5,
+                             true_distance_estimate=False,
+                             use_pallas=False, random_state=0).fit(X)
+        assert host.ingest_ == "host"
+        assert staged.ingest_ in ("monolithic", "streamed")
+        # deterministic quantum statistics agree across engines
+        np.testing.assert_allclose(host.eta_, staged.eta_, rtol=1e-5)
+        np.testing.assert_allclose(host.mu_, staged.mu_, rtol=1e-4)
+        np.testing.assert_allclose(host.condition_number_,
+                                   staged.condition_number_, rtol=1e-2)
+        from sklearn.metrics import adjusted_rand_score
+
+        assert adjusted_rand_score(host.labels_, staged.labels_) > 0.9
+
+    def test_explicit_init_array_host_route(self, blobs):
+        X, _ = blobs
+        init = X[3:8].copy()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            est = QKMeans(n_clusters=5, init=init, n_init=1,
+                          random_state=0).fit(X)
+        assert est.ingest_ == "host"
+        assert est.cluster_centers_.shape == (5, X.shape[1])
+
+
+class TestValidateOnce:
+    @pytest.fixture
+    def spy(self, monkeypatch):
+        from sq_learn_tpu.utils.validation import check_array as real
+
+        counts = {"n": 0}
+
+        def counting(X, **kw):
+            counts["n"] += 1
+            return real(X, **kw)
+
+        monkeypatch.setattr(base_mod, "check_array", counting,
+                            raising=False)
+        # base._validated_X imports at call time from utils.validation
+        import sq_learn_tpu.utils.validation as val
+
+        monkeypatch.setattr(val, "check_array", counting)
+        return counts
+
+    def test_qkmeans_fit_transform_validates_once(self, blobs, spy):
+        X, _ = blobs
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            QKMeans(n_clusters=4, n_init=1, random_state=0).fit_transform(X)
+        assert spy["n"] == 1, spy
+
+    def test_qkmeans_fit_predict_then_transform_outside_scope(self, blobs,
+                                                              spy):
+        # outside fit_transform, each public call re-validates (nothing is
+        # trusted across estimator calls)
+        X, _ = blobs
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            est = QKMeans(n_clusters=4, n_init=1, random_state=0).fit(X)
+            est.transform(X)
+        assert spy["n"] == 2, spy
+
+    def test_qpca_fit_transform_validates_once(self, blobs, spy):
+        X, _ = blobs
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            QPCA(n_components=3, random_state=0).fit_transform(X)
+        assert spy["n"] == 1, spy
+
+    def test_minibatch_fit_transform_validates_once(self, blobs, spy):
+        X, _ = blobs
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            MiniBatchQKMeans(n_clusters=4, n_init=1, max_iter=2,
+                             batch_size=128,
+                             random_state=0).fit_transform(X)
+        assert spy["n"] == 1, spy
+
+    def test_tiny_routed_transform_validates_once(self, blobs, spy,
+                                                  monkeypatch):
+        """The tiny-route re-entry (transform under the cpu pin) must not
+        re-validate — the latent double-validation this PR fixes."""
+        import sq_learn_tpu._config as cfg
+
+        X, _ = blobs
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            est = QKMeans(n_clusters=4, n_init=1, random_state=0).fit(X)
+        spy["n"] = 0
+        # simulate the accelerator-backend tiny route: the first backend
+        # check says "accelerator", the re-entry (under the cpu pin) says
+        # cpu — exactly the production re-entry shape
+        seq = {"n": 0}
+
+        def fake_cpu():
+            seq["n"] += 1
+            return seq["n"] > 1
+
+        monkeypatch.setattr(cfg, "on_cpu_backend", fake_cpu)
+        monkeypatch.setattr(cfg, "route_tiny_fit_to_host", lambda n: True)
+        est.transform(X)
+        assert spy["n"] == 1, spy
+
+    def test_mutated_input_revalidated_after_scope(self, blobs):
+        """The cache dies with the scope: a NaN injected after
+        fit_transform is caught by the next call."""
+        X, _ = blobs
+        X = X.copy()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            est = QKMeans(n_clusters=4, n_init=1, random_state=0)
+            est.fit_transform(X)
+        X[0, 0] = np.nan
+        with pytest.raises(ValueError, match="NaN"):
+            est.transform(X)
+
+
+class TestStreamedKppInit:
+    def test_transfers_capped_and_compile_bucketed(self, monkeypatch,
+                                                   tmp_path):
+        from sq_learn_tpu import streaming
+
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(1003, 24)).astype(np.float32)
+        tile_bytes = 150 * 24 * 4
+        monkeypatch.setenv("SQ_STREAM_TILE_BYTES", str(tile_bytes))
+        sizes = []
+        real_put = jax.device_put
+
+        def recording(x, *a, **kw):
+            sizes.append(int(getattr(x, "nbytes", 0)))
+            return real_put(x, *a, **kw)
+
+        monkeypatch.setattr(jax, "device_put", recording)
+        obs.enable(path=str(tmp_path / "obs.jsonl"))
+        try:
+            C, idx = streaming.streamed_kmeans_plusplus(
+                jax.random.PRNGKey(3), X, 5)
+            report = obs.watchdog.report()
+        finally:
+            obs.disable()
+        assert C.shape == (5, 24)
+        np.testing.assert_array_equal(C, X[idx])
+        assert max(sizes) <= tile_bytes
+        wd = report.get("streaming.kpp_score")
+        assert wd is not None and not wd["over_budget"], wd
+
+    def test_zero_weight_rows_never_selected(self):
+        from sq_learn_tpu.streaming import streamed_kmeans_plusplus
+
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(400, 16)).astype(np.float32)
+        w = np.zeros(400, np.float32)
+        w[:37] = 1.0
+        _, idx = streamed_kmeans_plusplus(jax.random.PRNGKey(0), X, 6,
+                                          weights=w)
+        assert idx.max() < 37
+
+
+class TestMiniBatchHostStep:
+    def test_partial_fit_host_matches_device_step(self):
+        """partial_fit's host fast path (CPU backend) agrees with the
+        device kernel's Sculley update on the classical mode."""
+        rng = np.random.default_rng(0)
+        X0 = rng.normal(size=(256, 8)).astype(np.float32)
+        Xb = rng.normal(size=(128, 8)).astype(np.float32)
+
+        host = MiniBatchQKMeans(n_clusters=4, random_state=0,
+                                reassignment_ratio=0.0)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            host.partial_fit(X0)   # first call inits on the device kernel
+            host.partial_fit(Xb)   # second call takes the host fast path
+        assert host.fit_backend_ == "cpu"
+        # device twin of the second step, from the same post-init state
+        est_d = MiniBatchQKMeans(n_clusters=4, random_state=0,
+                                 reassignment_ratio=0.0)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            est_d.partial_fit(X0)
+        from sq_learn_tpu.models.minibatch import minibatch_step_jit
+
+        centers, counts, _ = minibatch_step_jit(
+            jax.random.PRNGKey(0), jnp.asarray(Xb),
+            jnp.ones(len(Xb), jnp.float32),
+            jnp.asarray(est_d.cluster_centers_),
+            jnp.asarray(est_d.counts_), jnp.asarray(1),
+            delta=0.0, mode="classic", ipe_q=5, reassignment_ratio=0.0)
+        np.testing.assert_allclose(host.cluster_centers_,
+                                   np.asarray(centers), rtol=1e-4,
+                                   atol=1e-4)
+        np.testing.assert_allclose(host.counts_, np.asarray(counts),
+                                   rtol=1e-5)

@@ -87,12 +87,27 @@ class TestTransferAccounting:
     def test_streamed_qkmeans_fit_transfers_capped(self, monkeypatch,
                                                    recorded_puts):
         monkeypatch.setenv("SQ_STREAM_TILE_BYTES", str(TILE_BYTES))
-        km = QKMeans(n_clusters=3, n_init=1, random_state=0).fit(X_TALL)
+        # a forced (non-'auto') kernel keeps the staged XLA path — the
+        # default CPU fit now runs host-native end to end (see below)
+        km = QKMeans(n_clusters=3, n_init=1, random_state=0,
+                     use_pallas=False).fit(X_TALL)
         assert km.ingest_ == "streamed"
         # the tile uploads are the big transfers; centers/keys are tiny
         big = [s for s in recorded_puts if s > 64 * ROW_BYTES]
         assert big, "no tile-sized transfer was recorded"
         assert max(recorded_puts) <= TILE_BYTES
+
+    def test_default_cpu_qkmeans_fit_never_uploads(self, monkeypatch,
+                                                   recorded_puts):
+        """The PR 6 host route: a default classical CPU-backend fit does
+        the whole pipeline in host memory — zero device_put of the data
+        (the streamed ingest + fetch-back it replaced was ~40 % of
+        non-Lloyd fit time at MNIST scale)."""
+        monkeypatch.setenv("SQ_STREAM_TILE_BYTES", str(TILE_BYTES))
+        km = QKMeans(n_clusters=3, n_init=1, random_state=0).fit(X_TALL)
+        assert km.ingest_ == "host"
+        big = [s for s in recorded_puts if s > 64 * ROW_BYTES]
+        assert not big, f"host-routed fit uploaded tiles: {big}"
 
 
 class TestGramParity:
@@ -200,12 +215,14 @@ class TestPrestatsParity:
             np.testing.assert_array_equal(a, b, err_msg=name)
 
     def test_streamed_qkmeans_fit_matches_monolithic(self, monkeypatch):
+        # use_pallas=False keeps the staged XLA path (the default CPU fit
+        # is host-native since PR 6 and never ingests onto the device)
         init = X_TALL[:3].copy()
         km_m = QKMeans(n_clusters=3, init=init, n_init=1,
-                       random_state=0).fit(X_TALL)
+                       use_pallas=False, random_state=0).fit(X_TALL)
         monkeypatch.setenv("SQ_STREAM_TILE_BYTES", str(TILE_BYTES))
         km_s = QKMeans(n_clusters=3, init=init, n_init=1,
-                       random_state=0).fit(X_TALL)
+                       use_pallas=False, random_state=0).fit(X_TALL)
         assert km_s.ingest_ == "streamed" and km_m.ingest_ == "monolithic"
         np.testing.assert_allclose(km_s.cluster_centers_,
                                    km_m.cluster_centers_,
