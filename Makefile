@@ -139,19 +139,22 @@ obs-audit:
 obs-frontier:
 	$(PYTHON) -m sq_learn_tpu.obs frontier $(OBS)
 
-# Perf-regression gate, standalone: run the headline bench AND the PR 6
-# fused-fit bench (classical 70k×784 q-means — the metric whose band is
-# seeded from the committed bench/records fused-fit record) under
-# SQ_OBS=1 and band every line (latency, compile_count,
-# total_transfer_bytes, peak HBM) against the committed BENCH_r*.json
-# trajectory + bench/records history. Exit 1 on any red verdict. CI runs
-# this after the timed tiers (widened latency tolerance for runner-class
-# variance; the compile/transfer gates stay tight).
+# Perf-regression gate, standalone: run the headline bench, the PR 6
+# fused-fit bench (classical 70k×784 q-means), AND the PR 7 δ=0.5
+# 70k×784 headline (sketched spectral stats — the line whose band pins
+# the sketch engine's win) under SQ_OBS=1 and band every line (latency,
+# compile_count, total_transfer_bytes, peak HBM) against the committed
+# BENCH_r*.json trajectory + bench/records history. Exit 1 on any red
+# verdict. CI runs this after the timed tiers (widened latency tolerance
+# for runner-class variance; the compile/transfer gates stay tight).
 regress:
 	env SQ_OBS=1 SQ_OBS_PATH=/tmp/sq_regress_obs.jsonl \
 	    $(PYTHON) bench.py > /tmp/sq_regress_bench.json
 	env SQ_OBS=1 SQ_OBS_PATH=/tmp/sq_regress_fused_obs.jsonl \
 	    $(PYTHON) -m bench.bench_qkmeans_fused_fit \
+	    >> /tmp/sq_regress_bench.json
+	env SQ_OBS=1 SQ_OBS_PATH=/tmp/sq_regress_mnist_obs.jsonl \
+	    $(PYTHON) -m bench.bench_qkmeans_mnist \
 	    >> /tmp/sq_regress_bench.json
 	cat /tmp/sq_regress_bench.json
 	$(PYTHON) -m sq_learn_tpu.obs regress /tmp/sq_regress_bench.json --root .

@@ -331,15 +331,14 @@ def chunked_device_put(x, device=None, max_bytes=None):
     .. deprecated:: PR 3
         New call sites should use :mod:`sq_learn_tpu.streaming`
         (``stream_fold``/``streamed_prestats`` for accumulations, or
-        ``stream_tiles`` for resident assembly) — it keeps every transfer
-        bounded AND gets double-buffering, compile-bucketing, resumable
-        checkpoints, and per-tile watchdog accounting for free. This
-        helper remains for the whole-array placement surfaces
-        (``as_device_array``); its slices now at least run under the
-        transfer supervisor (:mod:`sq_learn_tpu.resilience.supervisor`:
-        retries/backoff, per-tile deadline, breaker accounting), so a
-        transient relay failure mid-upload retries instead of killing
-        the fit.
+        ``streamed_resident_put`` for whole-array placement). Since PR 7
+        this wrapper IS that path: the slicing branch delegates to
+        ``streaming.streamed_resident_put``, so the remaining whole-array
+        placement surface (``as_device_array``) gets supervised bounded
+        transfers, double-buffering, the ``streaming.assemble``
+        watchdog/xla-cost site, and donated in-place assembly (no
+        slice-then-concatenate 2× peak) — only this compatibility
+        signature is deprecated, not the behavior behind it.
 
     With the default ``max_bytes`` the slicing only engages for non-CPU
     targets (host→host copies can't wedge a relay and the extra
@@ -370,20 +369,12 @@ def chunked_device_put(x, device=None, max_bytes=None):
         x = x.astype(canonical)
     platform = (device.platform if device is not None
                 else jax.default_backend())
-    row_bytes = x.nbytes // max(1, x.shape[0]) if x.ndim else x.nbytes
     if (x.nbytes <= max_bytes or x.ndim == 0
             or (platform == "cpu" and not explicit)):
         return jax.device_put(x, device) if device is not None else jnp.asarray(x)
-    from .resilience import supervisor as _sup
+    from .streaming import streamed_resident_put
 
-    rows = max(1, max_bytes // max(1, row_bytes))
-    parts = [
-        _sup.put(lambda t: jax.device_put(t, device), x[i:i + rows],
-                 tile_index=j, site="config.chunked_device_put")
-        for j, i in enumerate(range(0, x.shape[0], rows))]
-    # The inputs are already committed device buffers, so the concatenate
-    # executes on-device: no further host→device traffic.
-    return jnp.concatenate(parts, axis=0)
+    return streamed_resident_put(x, device=device, max_bytes=max_bytes)
 
 
 def as_device_array(x):

@@ -79,7 +79,8 @@ def tolerance(X, tol):
 
 @functools.partial(jax.jit,
                    static_argnames=("quantum", "mu_grid", "mu_blocked"))
-def fit_prestats(X, *, quantum=False, mu_grid=(), mu_blocked=False):
+def fit_prestats(X, *, quantum=False, mu_grid=(), mu_blocked=False,
+                 sketch_idx=None):
     """Every pre-fit statistic in ONE dispatch — on a tunneled accelerator
     each separate launch pays a host↔device round-trip, so the mean /
     centering / centered row norms / tol variance scale, and (δ>0 only) the
@@ -90,7 +91,14 @@ def fit_prestats(X, *, quantum=False, mu_grid=(), mu_blocked=False):
     ``mu_blocked`` selects the row-tiled μ sweep; X is a tracer here, so
     the caller owns the choice (True on the CPU backend, where the cache
     hierarchy limits the unblocked sweep's repeated passes; False on
-    accelerators/meshes)."""
+    accelerators/meshes).
+
+    ``sketch_idx`` (a (s,) row-index array) replaces the exact σ_min Gram
+    + μ sweep with the sketched estimators of
+    :mod:`sq_learn_tpu.sketch.engine` — the raw components land under a
+    ``"sketch"`` sub-dict and the host folds the certified bounds in
+    after the fetch (``finalize_components``). ``None`` keeps the exact
+    kernels bit-identically."""
     mean = jnp.mean(X, axis=0)
     Xc = X - mean
     out = {
@@ -100,13 +108,20 @@ def fit_prestats(X, *, quantum=False, mu_grid=(), mu_blocked=False):
         "var_mean": jnp.mean(jnp.var(X, axis=0)),
     }
     if quantum:
-        from ..ops.quantum.norms import _mu_grid_blocked, _mu_grid_unblocked
+        if sketch_idx is not None:
+            from ..sketch.engine import sketch_components_traced
 
-        out["eta"] = jnp.max(row_norms(X, squared=True))
-        sweep = _mu_grid_blocked if mu_blocked else _mu_grid_unblocked
-        out["mu_vals"] = sweep(X, mu_grid)
-        out["frob"] = jnp.linalg.norm(X)
-        out["sigma_min"] = smallest_singular_value(X)
+            out["sketch"] = sketch_components_traced(X, sketch_idx,
+                                                     mu_grid)
+        else:
+            from ..ops.quantum.norms import (_mu_grid_blocked,
+                                             _mu_grid_unblocked)
+
+            out["eta"] = jnp.max(row_norms(X, squared=True))
+            sweep = _mu_grid_blocked if mu_blocked else _mu_grid_unblocked
+            out["mu_vals"] = sweep(X, mu_grid)
+            out["frob"] = jnp.linalg.norm(X)
+            out["sigma_min"] = smallest_singular_value(X)
     return out
 
 
@@ -869,16 +884,18 @@ def lloyd_restarts(key, X, weights, x_sq_norms, *, n_init, init, n_clusters,
                      "init_subsample"),
 )
 def fused_init(key, X, weights, *, n_init, init, n_clusters, quantum,
-               mu_grid=(), init_subsample=0):
+               mu_grid=(), init_subsample=0, sketch_idx=None):
     """Dispatch 1 of the two-dispatch fused fit: pre-fit statistics
-    (:func:`fit_prestats`) plus ALL restarts' initial centers
+    (:func:`fit_prestats` — sketched when the host passed sampled row
+    indices, see the sketch engine) plus ALL restarts' initial centers
     (:func:`_restart_inits` — sharded block-sampled k-means++ or random
     rows) in one launch. Everything returned stays on device; nothing is
     fetched between this and :func:`fused_fit`, so the two-dispatch split
     costs one extra async launch, not a round-trip — what it buys is a
     real ``qkmeans.fused_init`` / ``qkmeans.fused_fit`` span + xla-cost
     boundary in the obs layer."""
-    stats = fit_prestats(X, quantum=quantum, mu_grid=mu_grid)
+    stats = fit_prestats(X, quantum=quantum, mu_grid=mu_grid,
+                         sketch_idx=sketch_idx)
     centers0 = _restart_inits(key, stats["Xc"], weights, stats["xsq"],
                               n_init=n_init, init=init,
                               n_clusters=n_clusters,
@@ -907,10 +924,19 @@ def fused_fit(key, stats, weights, centers0, tol_factor, *, quantum,
     integer range) with layout::
 
         [inertia, n_iter, var_mean,
-         (eta, frob, sigma_min, mu_vals[len(mu_grid)])   # iff quantum
+         (eta, frob, sigma_min, mu_vals[len(mu_grid)])   # iff quantum,
+                                                         # exact stats
+         (eta, frob, amax, colsq_max, lam_min,           # iff quantum,
+          row_fac[nq], col_fac[nq])                      # sketched stats
          mean[m], centers[k*m] (centered space),
          inertia_trace[max_iter], center_shift_trace[max_iter],
          labels[n]]
+
+    where ``nq = len(_grid_exponents(mu_grid)[0])`` (the sketch engine's
+    exponent set) — the host folds the certified bounds in at unpack
+    (``sketch.engine.finalize_components``). Which layout applies is
+    decided by the ``stats`` pytree structure (a ``"sketch"`` sub-dict),
+    i.e. by whether :func:`fused_init` ran sketched.
     """
     # tol==0 must short-circuit (zero error budget contract) rather than
     # multiply: 0 * var_mean is NaN when the variance overflows, which would
@@ -926,9 +952,17 @@ def fused_fit(key, stats, weights, centers0, tol_factor, *, quantum,
     parts = [jnp.stack([inertia.astype(pdt), n_iter.astype(pdt),
                         stats["var_mean"].astype(pdt)])]
     if quantum:
-        parts.append(jnp.stack([stats["eta"], stats["frob"],
-                                stats["sigma_min"]]).astype(pdt))
-        parts.append(stats["mu_vals"].astype(pdt))
+        if "sketch" in stats:
+            sk = stats["sketch"]
+            parts.append(jnp.stack([sk["eta"], sk["frob"], sk["amax"],
+                                    sk["colsq_max"],
+                                    sk["lam_min"]]).astype(pdt))
+            parts.append(sk["row_fac"].astype(pdt))
+            parts.append(sk["col_fac"].astype(pdt))
+        else:
+            parts.append(jnp.stack([stats["eta"], stats["frob"],
+                                    stats["sigma_min"]]).astype(pdt))
+            parts.append(stats["mu_vals"].astype(pdt))
     parts += [stats["mean"].astype(pdt), centers.ravel().astype(pdt),
               history["inertia"].astype(pdt),
               history["center_shift"].astype(pdt), labels.astype(pdt)]
@@ -1015,6 +1049,22 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
     (``bench/records`` PR 6 profile). Applies to every engine's
     k-means++ path; explicit/callable inits and 'random' are untouched.
 
+    ``sketch`` ('auto' | 0/None | int) selects the sketched
+    spectral-statistics engine (:mod:`sq_learn_tpu.sketch`) for the δ>0
+    runtime-model inputs (σ_min, μ(A); η and ‖A‖_F stay exact): 'auto'
+    samples ``max(4096, 2·m)`` rows and only engages when the data is ≥4×
+    larger and tall — small fits keep the exact kernels bit-identically
+    (the tiny-shape/zero-budget short-circuit; ``SQ_SKETCH_ROWS``
+    overrides the target, 0 disables). The estimate error is folded
+    CONSERVATIVELY: ``mu_`` is the certified upper bound,
+    ``condition_number_`` uses the certified σ_min lower bound (plug-in
+    fallback when the bound is vacuous), so the theoretical quantum cost
+    stays an upper bound w.p. ≥ 1 − δ_stat (``SQ_SKETCH_DELTA``, default
+    0.05); ``sketch_info_`` records estimates, bounds, and certification
+    flags. Stats are additionally served from the digest-keyed cache
+    (:mod:`sq_learn_tpu.sketch.cache`) across fits over the same data —
+    (ε, δ) sweeps compute them once per dataset.
+
     Determinism: ``random_state`` makes a fit reproducible on a given host
     and backend. The stochastic streams (k-means++ draws, δ-window picks)
     are engine-local — the XLA kernels thread jax PRNG keys, the C++ host
@@ -1034,7 +1084,7 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
                  stop_when_reached_accuracy=True, multiprocess=False,
                  true_distance_estimate=True, ipe_q=5, mesh=None,
                  use_pallas="auto", compute_dtype=None,
-                 init_subsample="auto"):
+                 init_subsample="auto", sketch="auto"):
         self.n_clusters = n_clusters
         self.init = init
         self.n_init = n_init
@@ -1056,6 +1106,7 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         self.use_pallas = use_pallas
         self.compute_dtype = compute_dtype
         self.init_subsample = init_subsample
+        self.sketch = sketch
 
     # -- validation ---------------------------------------------------------
 
@@ -1355,7 +1406,39 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
                       and blocked_worthwhile(*X.shape))
         from ..streaming import streamed_prestats, worth_streaming
 
-        if self.mesh is None and worth_streaming(X):
+        streamed = self.mesh is None and worth_streaming(X)
+        stats_cached = sk_ctx = sk_idx = None
+        compute_quantum = quantum
+        if quantum:
+            from ..sketch import cache as _stats_cache
+            from ..sketch import engine as _sketch
+
+            delta_stat = _sketch.sketch_delta_stat()
+            # the sketched estimators ride the streamed route (computed
+            # on the resident buffer — zero extra transfers); the
+            # monolithic/mesh staged dispatch keeps the exact kernels
+            # (the documented exact-parity path). The digest-keyed cache
+            # serves every route.
+            rows = (_sketch.resolve_sketch_rows(X.shape[0], X.shape[1],
+                                                self.sketch)
+                    if streamed else 0)
+            ckey = self._stats_cache_key(X, rows, delta_stat)
+            stats_cached = _stats_cache.lookup(ckey)
+            if stats_cached is not None:
+                compute_quantum = False  # skip the device scans entirely
+            elif rows:
+                # decorrelated sample stream, derived eagerly (pre-
+                # dispatch, per the head-of-line-blocking contract)
+                rng_sk = np.random.default_rng(np.asarray(
+                    jax.random.key_data(jax.random.fold_in(
+                        as_key(self.random_state), 0x5CE7)),
+                    np.uint32).tolist())
+                sk_idx = _sketch.sample_indices(rng_sk, X.shape[0], rows)
+                sk_ctx = (delta_stat, ckey, rows)
+            else:
+                sk_ctx = (delta_stat, ckey, 0)
+
+        if streamed:
             # streamed ingestion: the device copy assembles tile-by-tile
             # into one donated buffer (every transfer under the tile cap,
             # no concatenate) while the column sums/square-sums accumulate
@@ -1364,8 +1447,10 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
 
             breaker.preflight("qkmeans.fit")
             self.ingest_ = "streamed"
-            stats = streamed_prestats(X, quantum=quantum, mu_grid=mu_grid,
-                                      mu_blocked=mu_blocked)
+            stats = streamed_prestats(
+                X, quantum=compute_quantum, mu_grid=mu_grid,
+                mu_blocked=mu_blocked,
+                sketch_idx=None if sk_idx is None else jnp.asarray(sk_idx))
         else:
             # set_config(device=...) placement — except under an explicit
             # mesh, whose sharding owns placement (committed single-device
@@ -1374,11 +1459,39 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
             Xin = (jnp.asarray(X) if self.mesh is not None
                    else as_device_array(X))
             _obs.xla.capture("qkmeans.fit_prestats", fit_prestats, Xin,
-                             quantum=quantum, mu_grid=mu_grid,
+                             quantum=compute_quantum, mu_grid=mu_grid,
                              mu_blocked=mu_blocked)
-            stats = fit_prestats(Xin, quantum=quantum, mu_grid=mu_grid,
-                                 mu_blocked=mu_blocked)
-        if quantum:
+            stats = fit_prestats(Xin, quantum=compute_quantum,
+                                 mu_grid=mu_grid, mu_blocked=mu_blocked)
+        if quantum and stats_cached is not None:
+            var_mean = float(stats["var_mean"])
+            self._apply_spectral_stats(stats_cached)
+        elif quantum and "sketch" in stats:
+            from ..sketch import cache as _stats_cache
+            from ..sketch import engine as _sketch
+
+            # ONE device→host transfer of var_mean + the raw sketch
+            # components; bounds fold on host (finalize_components)
+            sk = stats["sketch"]
+            dt = stats["var_mean"].dtype
+            flat = np.asarray(jnp.concatenate([
+                jnp.stack([stats["var_mean"], sk["eta"], sk["frob"],
+                           sk["amax"], sk["colsq_max"], sk["lam_min"]]),
+                sk["row_fac"].astype(dt), sk["col_fac"].astype(dt)]))
+            var_mean = float(flat[0])
+            delta_stat, ckey, rows = sk_ctx
+            nq = (len(flat) - 6) // 2
+            sstats = _sketch.finalize_components(
+                {"eta": flat[1], "frob": flat[2], "amax": flat[3],
+                 "colsq_max": flat[4], "lam_min": flat[5],
+                 "row_fac": flat[6:6 + nq], "col_fac": flat[6 + nq:]},
+                n=X.shape[0], m=X.shape[1], s=rows, mu_grid=mu_grid,
+                delta_stat=delta_stat)
+            _sketch.record_sketch_obs(sstats)
+            _sketch.audit_sketch(sstats, np.asarray(X))
+            self._apply_spectral_stats(sstats)
+            _stats_cache.store(ckey, sstats)
+        elif quantum:
             # fetch every host-needed scalar (incl. the μ grid) in ONE
             # device→host transfer
             fetched = np.asarray(jnp.concatenate([
@@ -1386,7 +1499,9 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
                            stats["sigma_min"]]),
                 stats["mu_vals"].astype(stats["var_mean"].dtype)]))
             var_mean = float(fetched[0])
-            self._set_quantum_stats(mu_grid, *fetched[1:4], fetched[4:])
+            self._set_quantum_stats(mu_grid, *fetched[1:4], fetched[4:],
+                                    ckey=sk_ctx[1] if sk_ctx else None,
+                                    shape=X.shape)
         else:
             var_mean = float(stats["var_mean"])
         tol_ = 0.0 if self.tol == 0 else float(self.tol * var_mean)
@@ -1462,16 +1577,40 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         rng = np.random.default_rng(
             np.asarray(jax.random.key_data(key), np.uint32).tolist())
 
-        stats_handle = None
+        stats_handle = sketch_ctx = None
         if quantum:
-            from ..ops.quantum.norms import blocked_worthwhile
+            from ..sketch import cache as _stats_cache
+            from ..sketch import engine as _sketch
 
-            mu_blocked = blocked_worthwhile(*Xn.shape)
-            Xd = jnp.asarray(Xn)
-            _obs.xla.capture("qkmeans.quantum_stats", quantum_fit_stats,
-                             Xd, mu_grid=MU_GRID, mu_blocked=mu_blocked)
-            stats_handle = quantum_fit_stats(Xd, mu_grid=MU_GRID,
-                                             mu_blocked=mu_blocked)
+            delta_stat = _sketch.sketch_delta_stat()
+            rows = _sketch.resolve_sketch_rows(n, Xn.shape[1], self.sketch)
+            ckey = self._stats_cache_key(Xn, rows, delta_stat)
+            cached = _stats_cache.lookup(ckey)
+            if cached is not None:
+                # the digest-keyed cache hit: this exact dataset's stats
+                # were computed by an earlier fit (an (ε, δ) sweep point)
+                self._apply_spectral_stats(cached)
+            elif rows:
+                # sketched route — the sample stream is decorrelated from
+                # the init/Lloyd draws (fold_in runs eagerly, BEFORE the
+                # async dispatch: the head-of-line-blocking note above),
+                # and the cheap pass reuses the prestats column sums
+                rng_sk = np.random.default_rng(np.asarray(
+                    jax.random.key_data(jax.random.fold_in(key, 0x5CE7)),
+                    np.uint32).tolist())
+                disp = _sketch.dispatch_host(Xn, rows, MU_GRID,
+                                             rng=rng_sk, colsq=sqsum)
+                sketch_ctx = (disp, delta_stat, ckey)
+            else:
+                from ..ops.quantum.norms import blocked_worthwhile
+
+                mu_blocked = blocked_worthwhile(*Xn.shape)
+                Xd = jnp.asarray(Xn)
+                _obs.xla.capture("qkmeans.quantum_stats", quantum_fit_stats,
+                                 Xd, mu_grid=MU_GRID, mu_blocked=mu_blocked)
+                stats_handle = (quantum_fit_stats(Xd, mu_grid=MU_GRID,
+                                                  mu_blocked=mu_blocked),
+                                ckey)
         init = self.init
         if hasattr(init, "__array__"):
             init = np.asarray(init, np.float32) - mean
@@ -1489,28 +1628,80 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         (best_labels, best_inertia, best_centers, best_n_iter,
          history) = self._run_native(key, Xc, wn, init, n_init, delta, mode,
                                      tol_, engine, rng=rng)
-        if stats_handle is not None:
+        if sketch_ctx is not None:
+            # one blocking fetch of the async sketch dispatch + the host
+            # bound fold; the span records only the non-overlapped wait
+            disp, delta_stat, ckey = sketch_ctx
+            from ..sketch import cache as _stats_cache
+            from ..sketch import engine as _sketch
+
+            with _obs.span("qkmeans.quantum_stats", overlapped=True,
+                           sketched=True, rows=disp.s):
+                stats = _sketch.finalize_host(disp, delta_stat,
+                                              X_for_audit=Xn)
+            self._apply_spectral_stats(stats)
+            _stats_cache.store(ckey, stats)
+        elif stats_handle is not None:
             # one blocking fetch of the async quantum-stats dispatch; the
             # span records only the wait the native fit did NOT absorb
+            handle, ckey = stats_handle
             with _obs.span("qkmeans.quantum_stats", overlapped=True):
-                fetched = np.asarray(stats_handle)
+                fetched = np.asarray(handle)
             self._set_quantum_stats(MU_GRID, fetched[0], fetched[1],
-                                    fetched[2], fetched[3:])
+                                    fetched[2], fetched[3:], ckey=ckey,
+                                    shape=Xn.shape)
         centers = np.asarray(best_centers) + mean
         return self._set_fit_results(
             np.asarray(best_labels), centers, float(best_inertia),
             int(best_n_iter), np.asarray(history["inertia"]),
             np.asarray(history["center_shift"]))
 
-    def _set_quantum_stats(self, mu_grid, eta, frob, sigma_min, mu_vals):
+    def _set_quantum_stats(self, mu_grid, eta, frob, sigma_min, mu_vals,
+                           ckey=None, shape=None):
         """Set the quantum runtime-model attributes (reference
-        ``_dmeans.py:1242-1245``) — one definition for both fit paths."""
-        from ..ops.quantum.norms import select_mu
+        ``_dmeans.py:1242-1245``) from EXACT fetched statistics — one
+        definition for every exact fit path, now routed through the
+        :class:`~sq_learn_tpu.sketch.engine.SpectralStats` bundle so the
+        exact paths share the stats cache and the ``sketch_info_``
+        introspection surface (values bit-identical to the historical
+        direct computation; exact stats are the zero-budget
+        short-circuit, recorded as such at the ``sketch.stats``
+        guarantee site)."""
+        from ..sketch import cache as _stats_cache
+        from ..sketch.engine import exact_bundle
 
-        self.eta_ = float(eta)
-        self.norm_mu_, self.mu_ = select_mu(mu_grid, mu_vals, float(frob))
-        self.condition_number_ = (
-            1.0 / float(sigma_min) if sigma_min > 0 else np.inf)
+        stats = exact_bundle(mu_grid, eta, frob, sigma_min, mu_vals,
+                             shape=shape)
+        if _obs.guarantees.enabled():
+            _obs.guarantees.record_guarantee(
+                "sketch.stats", 0.0, 0.0, fail_prob=0.0,
+                short_circuit=True, estimator="qkmeans")
+        self._apply_spectral_stats(stats)
+        if ckey is not None:
+            _stats_cache.store(ckey, stats)
+
+    def _apply_spectral_stats(self, stats):
+        """Fold a :class:`~sq_learn_tpu.sketch.engine.SpectralStats`
+        bundle into the runtime-model attributes, CONSERVATIVELY
+        (``docs/fit_pipeline.md`` folding rule): ``mu_`` is the certified
+        upper bound's winner, ``condition_number_`` uses the certified
+        σ_min lower bound (the plug-in estimate only when the bound is
+        vacuous — flagged in ``sketch_info_``). On exact bundles both
+        equal the historical exact values."""
+        self.eta_ = float(stats.eta)
+        self.norm_mu_, self.mu_ = stats.conservative_mu()
+        self.condition_number_ = float(stats.condition_number())
+        self.sketch_info_ = stats.info()
+
+    def _stats_cache_key(self, Xn, rows, delta_stat):
+        """Digest-keyed cache key of this fit's runtime-model stats: the
+        data content (strided CRC), the μ grid, and the sketch
+        configuration (sample size + δ_stat; exact fits key rows=0)."""
+        from ..sketch import cache as _stats_cache
+
+        return _stats_cache.key_for(
+            Xn, "qkmeans.stats", MU_GRID, int(rows),
+            float(delta_stat) if rows else 0.0)
 
     def _set_fit_results(self, labels, centers, inertia, n_iter, inertia_tr,
                          shift_tr):
@@ -1570,6 +1761,31 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         w = jnp.asarray(sample_weight, Xd.dtype)
         key = as_key(self.random_state)
         k_init, k_run = jax.random.split(key)
+        stats_cached = sk_ctx = sk_idx = None
+        if quantum:
+            from ..sketch import cache as _stats_cache
+            from ..sketch import engine as _sketch
+
+            delta_stat = _sketch.sketch_delta_stat()
+            rows = _sketch.resolve_sketch_rows(X.shape[0], X.shape[1],
+                                               self.sketch)
+            ckey = self._stats_cache_key(X, rows, delta_stat)
+            stats_cached = _stats_cache.lookup(ckey)
+            if stats_cached is not None:
+                # cache hit: run the whole fused fit classical-side —
+                # the stats scans are skipped on device entirely
+                quantum, mu_grid = False, ()
+            elif rows:
+                # sampled row indices, decorrelated from the init/Lloyd
+                # key and derived eagerly (pre-dispatch)
+                rng_sk = np.random.default_rng(np.asarray(
+                    jax.random.key_data(jax.random.fold_in(key, 0x5CE7)),
+                    np.uint32).tolist())
+                sk_idx = jnp.asarray(_sketch.sample_indices(
+                    rng_sk, X.shape[0], rows))
+                sk_ctx = (delta_stat, ckey, rows)
+            else:
+                sk_ctx = (delta_stat, ckey, 0)
         sub = 0
         if isinstance(self.init, str) and self.init == "k-means++":
             from ..parallel.init import resolve_init_subsample
@@ -1579,7 +1795,8 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         n_init = self._resolved_n_init(self.init)
         init_kw = dict(n_init=n_init, init=self.init,
                        n_clusters=self.n_clusters, quantum=quantum,
-                       mu_grid=mu_grid, init_subsample=sub)
+                       mu_grid=mu_grid, init_subsample=sub,
+                       sketch_idx=sk_idx)
         fit_kw = dict(quantum=quantum, delta=delta, mode=mode,
                       max_iter=self.max_iter,
                       patience=self._resolved_patience(mode),
@@ -1592,7 +1809,8 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
                 _obs.watchdog.track("qkmeans.fused_init", fused_init)
                 _obs.watchdog.allow(
                     "qkmeans.fused_init",
-                    (Xd.shape, str(Xd.dtype), self.n_clusters, n_init, sub))
+                    (Xd.shape, str(Xd.dtype), self.n_clusters, n_init, sub,
+                     0 if sk_idx is None else int(sk_idx.shape[0])))
                 _obs.watchdog.track("qkmeans.fused_fit", fused_fit)
                 _obs.watchdog.allow(
                     "qkmeans.fused_fit",
@@ -1632,9 +1850,29 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         k, m = self.n_clusters, X.shape[1]
         inertia, n_iter = float(packed[0]), int(packed[1])
         pos = 3
-        if quantum:
+        if quantum and sk_idx is not None:
+            from ..ops.quantum.norms import _grid_exponents
+            from ..sketch import cache as _stats_cache
+            from ..sketch import engine as _sketch
+
+            delta_stat, ckey, rows = sk_ctx
+            nq = len(_grid_exponents(mu_grid)[0])
+            sstats = _sketch.finalize_components(
+                {"eta": packed[3], "frob": packed[4], "amax": packed[5],
+                 "colsq_max": packed[6], "lam_min": packed[7],
+                 "row_fac": packed[8:8 + nq],
+                 "col_fac": packed[8 + nq:8 + 2 * nq]},
+                n=n, m=m, s=rows, mu_grid=mu_grid, delta_stat=delta_stat)
+            _sketch.record_sketch_obs(sstats)
+            _sketch.audit_sketch(sstats, X)
+            self._apply_spectral_stats(sstats)
+            _stats_cache.store(ckey, sstats)
+            pos = 8 + 2 * nq
+        elif quantum:
             self._set_quantum_stats(mu_grid, *packed[3:6],
-                                    packed[6:6 + len(mu_grid)])
+                                    packed[6:6 + len(mu_grid)],
+                                    ckey=sk_ctx[1] if sk_ctx else None,
+                                    shape=(n, m))
             pos = 6 + len(mu_grid)
         mean = packed[pos:pos + m]
         pos += m
@@ -1642,8 +1880,11 @@ class QKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         pos += k * m
         inertia_tr = packed[pos:pos + self.max_iter]
         shift_tr = packed[pos + self.max_iter:pos + 2 * self.max_iter]
-        return self._set_fit_results(labels, centers, inertia, n_iter,
-                                     inertia_tr, shift_tr)
+        out = self._set_fit_results(labels, centers, inertia, n_iter,
+                                    inertia_tr, shift_tr)
+        if stats_cached is not None:
+            self._apply_spectral_stats(stats_cached)
+        return out
 
     @property
     def fit_history_(self):

@@ -231,9 +231,15 @@ class QLSSVC(ClassifierMixin, BaseEstimator):
         self.cond_ = cond
         self.normF_ = normF
 
+        # ‖X‖_F² through the sketch engine's digest-keyed stats cache:
+        # exact (an O(n·m) pass this cheap never warrants an estimate)
+        # but computed once per dataset across repeated fits — the
+        # QLSSVC κ·α_F cost model's input, priced at every (ε, δ)
+        # frontier point over the same training set
+        from ..sketch.engine import frobenius_squared
+
         self.alpha_F_ = float(
-            np.sqrt(len(X)) + self.penalty**-1
-            + np.linalg.norm(X, ord="fro") ** 2)
+            np.sqrt(len(X)) + self.penalty**-1 + frobenius_squared(X))
         row_sq = jnp.sum(Xd * Xd, axis=1)
         self.Nu_ = float(b**2 + jnp.sum(alpha**2 * row_sq))
 

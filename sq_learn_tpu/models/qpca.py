@@ -337,12 +337,22 @@ class QPCA(TransformerMixin, BaseEstimator):
         ``n_components``, tall input, no QADRA estimator — μ(A) needs the
         resident centered matrix). 'monolithic' always materializes
         (the pre-streaming behavior).
+    sketch : 'auto', 0/None, or int
+        Sketched μ(A) estimation (:mod:`sq_learn_tpu.sketch`) for the
+        QADRA runtime path: 'auto' samples ``max(4096, 2·m)`` rows and
+        only engages when the centered matrix is ≥4× larger and tall —
+        smaller fits keep the exact grid sweep bit-identically. The
+        folded ``muA`` is the certified UPPER bound (never above
+        ‖A‖_F), so every downstream runtime estimate stays an upper
+        bound w.p. ≥ 1 − δ_stat (``SQ_SKETCH_DELTA``); ``sketch_info_``
+        carries estimates/bounds, and repeated fits over the same data
+        are served from the digest-keyed stats cache.
     """
 
     def __init__(self, n_components=None, *, copy=True, whiten=False,
                  svd_solver="auto", tol=0.0, iterated_power="auto",
                  random_state=None, name=None, compute_mu="auto", mesh=None,
-                 compute_dtype=None, ingest="auto"):
+                 compute_dtype=None, ingest="auto", sketch="auto"):
         self.n_components = n_components
         self.copy = copy
         self.whiten = whiten
@@ -355,6 +365,7 @@ class QPCA(TransformerMixin, BaseEstimator):
         self.mesh = mesh
         self.compute_dtype = compute_dtype
         self.ingest = ingest
+        self.sketch = sketch
         self.quantum_runtime_container = []
 
     # -- fit ----------------------------------------------------------------
@@ -769,15 +780,42 @@ class QPCA(TransformerMixin, BaseEstimator):
             if self.mesh is not None:
                 # row-sharded centered copy (padding rows exactly zero, so
                 # the power-sum reductions are unchanged) — μ is the one
-                # consumer that needs the centered matrix itself
+                # consumer that needs the centered matrix itself; the
+                # sharded sweep stays exact (the sketch engine's gather
+                # route is single-device)
                 from ..parallel.pca import centered_sharded
 
                 Xc = centered_sharded(self.mesh, X, mean)
+                self.norm_muA, self.muA = best_mu(Xc, 0.0, step=0.1)
+                self.sketch_info_ = None
             else:
+                # sketched/cached route (sq_learn_tpu.sketch): same grid
+                # as the historical best_mu(Xc, 0.0, step=0.1) call, the
+                # conservative certified UPPER bound on μ (never above
+                # ‖A‖_F, so the QADRA runtime model stays an upper
+                # bound), served from the digest-keyed cache across the
+                # (ε, δ) sweep refits of bench_qpca_error_sweep. Tiny
+                # shapes / zero budget short-circuit to the exact sweep
+                # bit-identically.
+                from ..ops.quantum.norms import _search_grid
+                from ..sketch import engine as _sketch
+
                 Xc = jnp.asarray(X) - mean
-            self.norm_muA, self.muA = best_mu(Xc, 0.0, step=0.1)
+                # sample stream decorrelated from the tomography/PE key
+                # threading (fold_in, not _next_key: the sketch must not
+                # shift the reference-pinned draw sequence)
+                rng_sk = np.random.default_rng(np.asarray(
+                    jax.random.key_data(jax.random.fold_in(
+                        as_key(self.random_state), 0x5CE7)),
+                    np.uint32).tolist())
+                stats = _sketch.mu_stats(
+                    Xc, _search_grid(0.0, 1.0, 0.1), sketch=self.sketch,
+                    rng=rng_sk, tag="qpca.mu")
+                self.norm_muA, self.muA = stats.conservative_mu()
+                self.sketch_info_ = stats.info()
         else:
             self.norm_muA = self.muA = None
+            self.sketch_info_ = None
 
         if self.condition_number_est:
             (self.est_sigma_min, self.est_cond_number) = \

@@ -385,6 +385,13 @@ def snapshot():
             1 for g in rec.guarantee_records if g.get("violated")),
         "audit_flagged": audit_flagged,
         "tradeoff_records": len(rec.tradeoff_records),
+        # spectral-stats engine (sq_learn_tpu.sketch): digest-cache
+        # traffic + sketched-estimate count — the per-dataset-not-
+        # per-sweep-point reuse the frontier benches rely on
+        "stats_cache_hits": int(rec.counters.get("stats_cache.hits", 0)),
+        "stats_cache_misses": int(
+            rec.counters.get("stats_cache.misses", 0)),
+        "sketch_estimates": int(rec.counters.get("sketch.estimates", 0)),
     }
 
 

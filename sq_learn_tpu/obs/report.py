@@ -112,6 +112,21 @@ def summarize(records):
         t = r.get("type")
         by_type[t] = by_type.get(t, 0) + 1
 
+    # spectral-stats engine: digest-cache traffic + sketched-vs-exact
+    # estimated FLOPs (engine counters) + measured sketch wall-clock
+    # (every span under the sketch.* namespace, total: the kernels run
+    # async, so self-time would under-count the overlapped work)
+    sketch_wall = sum(agg["total_s"] for name, agg in by_name.items()
+                      if name.startswith("sketch."))
+    sketch = {
+        "cache_hits": counters.get("stats_cache.hits", 0),
+        "cache_misses": counters.get("stats_cache.misses", 0),
+        "estimates": counters.get("sketch.estimates", 0),
+        "sketch_flops": counters.get("sketch.flops"),
+        "exact_equiv_flops": counters.get("sketch.exact_equiv_flops"),
+        "wall_s": round(sketch_wall, 6),
+    }
+
     return {
         "by_type": by_type,
         "spans": by_name,
@@ -123,6 +138,7 @@ def summarize(records):
         "timeline": timeline,
         "probes": probes,
         "gauges": gauges,
+        "sketch": sketch,
         # the statistical-observability sections (v3): per-site
         # Clopper–Pearson audit of the (ε, δ) guarantee draws, and the
         # run's accuracy-vs-theoretical-runtime sweep points
@@ -189,6 +205,26 @@ def render(summary, top=12):
     mfu = summary["gauges"].get("profiling.mfu")
     if isinstance(mfu, (int, float)):
         out(f"  {mfu:10.6f} measured MFU (profiling.mfu)")
+
+    out("")
+    out("-- spectral-stats cache / sketch savings --")
+    sk = summary.get("sketch") or {}
+    hits, misses = sk.get("cache_hits", 0), sk.get("cache_misses", 0)
+    if not (hits or misses or sk.get("estimates")):
+        out("  (no spectral-stats activity)")
+    else:
+        total = hits + misses
+        rate = f" ({hits / total:.0%} hit rate)" if total else ""
+        out(f"  {hits} hits / {misses} misses stats cache{rate}")
+        sf, ef = sk.get("sketch_flops"), sk.get("exact_equiv_flops")
+        if sk.get("estimates"):
+            saved = (f", {1.0 - sf / ef:.0%} of the exact sweep saved"
+                     if sf and ef else "")
+            out(f"  {sk['estimates']:.0f} sketched estimate(s): "
+                f"{_fmt_num(sf)} flops vs {_fmt_num(ef)} exact-equivalent"
+                f"{saved}")
+        out(f"  {sk.get('wall_s', 0.0):.4f}s measured in sketch.* spans "
+            f"(async kernels: total, not self)")
 
     out("")
     out("-- guarantee audit (Clopper-Pearson on declared (eps, delta)) --")

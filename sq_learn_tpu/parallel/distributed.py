@@ -16,6 +16,8 @@ Typical multi-host launch (same script on every host)::
     est = QKMeans(n_clusters=10, mesh=mesh, ...).fit(local_shard)
 """
 
+import os
+
 import numpy as np
 import jax
 
@@ -31,7 +33,26 @@ def initialize(coordinator_address=None, num_processes=None, process_id=None,
     call once per process, before any backend use. No-op if the runtime is
     already initialized (re-initialization raises in JAX; this wrapper
     makes idempotent use possible in launcher scripts).
+
+    Multi-process runs on the **CPU backend** (the hardware-free DCN
+    rehearsal, ``tests/test_distributed_multiprocess.py``) additionally
+    need an explicit CPU collectives implementation: without one the CPU
+    client executes the first cross-process computation into
+    ``INVALID_ARGUMENT: Multiprocess computations aren't implemented on
+    the CPU backend``. jaxlib ships gloo TCP collectives, so when this
+    initialize is a multi-process one we select
+    ``jax_cpu_collectives_implementation=gloo`` before the backend client
+    exists (the config is read at CPU client creation; it is inert for
+    TPU/GPU backends and for single-process runs we leave it alone).
     """
+    n_proc = num_processes
+    if n_proc is None:
+        try:
+            n_proc = int(os.environ.get("JAX_NUM_PROCESSES", "0"))
+        except ValueError:
+            n_proc = 0
+    if n_proc and int(n_proc) > 1:
+        _select_cpu_collectives("gloo")
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
@@ -42,6 +63,31 @@ def initialize(coordinator_address=None, num_processes=None, process_id=None,
         msg = str(exc)
         if "only be called once" not in msg and "already initialized" not in msg:
             raise
+
+
+def _select_cpu_collectives(impl):
+    """Select the CPU backend's cross-process collectives implementation
+    (no-op when already selected, when the option is unknown to this jax,
+    or when the backend client already exists — the flag is read once at
+    CPU client creation). On jax 0.4.x the option is a ``Flag`` (no
+    ``jax.config.update`` surface), so this falls back to the flag's
+    ``_set`` — the same mechanism the ``JAX_CPU_COLLECTIVES_IMPLEMENTATION``
+    env var uses, just late enough to work after import."""
+    try:
+        if jax.config.jax_cpu_collectives_implementation != "none":
+            return
+        jax.config.update("jax_cpu_collectives_implementation", impl)
+        return
+    except AttributeError:
+        pass
+    try:
+        from jax._src import xla_bridge as _xb
+
+        flag = _xb.CPU_COLLECTIVES_IMPLEMENTATION
+        if flag.value == "none":
+            flag._set(impl)
+    except Exception:
+        pass  # older/newer jax without the option: nothing to select
 
 
 def global_mesh(axis_name=DATA_AXIS):

@@ -1,0 +1,51 @@
+"""Sketched spectral-statistics engine.
+
+The quantum runtime models (q-means ``_dmeans.py:1440-1449``, QADRA,
+QLSSVC's κ·α_F) consume four data statistics — σ_min(A), μ(A), ‖A‖_F and
+η = max‖xᵢ‖² — whose exact computation is an O(n·m²)-class sweep (the
+σ_min Gram) plus an O(n·m·|grid|) transcendental sweep (μ). The paper's
+whole thesis is that error budgets are runtime parameters (SURVEY §0);
+this package applies the same treatment to the runtime-model *inputs*:
+estimate them from a uniform row sketch with explicit
+(error_bound, δ_stat) statements, short-circuiting to the exact kernels
+at zero budget or tiny shapes (the framework-wide zero-error-budget
+convention).
+
+Public surface:
+
+- :func:`~sq_learn_tpu.sketch.engine.spectral_stats` — synchronous
+  estimate of any subset of {σ_min, μ grid, ‖A‖_F, η} with certified
+  bounds, returning a :class:`~sq_learn_tpu.sketch.engine.SpectralStats`.
+- :func:`~sq_learn_tpu.sketch.engine.dispatch_host` /
+  :func:`~sq_learn_tpu.sketch.engine.finalize_host` — the async split the
+  q-means host fit route uses (kernel overlapped with the native Lloyd
+  engines, bounds folded in at the single fetch).
+- :mod:`~sq_learn_tpu.sketch.cache` — the digest-keyed stats cache:
+  repeated fits over the same array (every (ε, δ) frontier sweep) compute
+  spectral stats once per dataset; hits/misses are obs counters.
+
+Env knobs (``docs/fit_pipeline.md``): ``SQ_SKETCH_ROWS`` overrides the
+'auto' sample-size target (0 disables sketching), ``SQ_SKETCH_DELTA`` the
+sketch failure budget δ_stat (default 0.05), ``SQ_STATS_CACHE=0``
+disables the cache, ``SQ_SKETCH_AUDIT_ELEMS`` caps the matrix size up to
+which the guarantee auditor affords exact ground truth for the
+``sketch.*`` sites.
+"""
+
+from . import cache
+from .engine import (SpectralStats, dispatch_host, exact_spectral_stats,
+                     finalize_host, frobenius_squared, mu_stats,
+                     resolve_sketch_rows, sketch_delta_stat, spectral_stats)
+
+__all__ = [
+    "SpectralStats",
+    "cache",
+    "dispatch_host",
+    "exact_spectral_stats",
+    "finalize_host",
+    "frobenius_squared",
+    "mu_stats",
+    "resolve_sketch_rows",
+    "sketch_delta_stat",
+    "spectral_stats",
+]

@@ -1,0 +1,629 @@
+"""Randomized spectral-statistics estimators with certified error bounds.
+
+Estimators (all from ONE uniform row sample S of size s, scaled by n/s,
+plus one cheap exact O(n·m) pass over the full matrix):
+
+σ_min(A)
+    Subsampled Gram route: Ĝ = (n/s)·X_Sᵀ X_S is an unbiased estimate of
+    G = AᵀA; λ_min(Ĝ) comes from one m×m ``eigvalsh`` (the sketch Gram is
+    small enough that the exact small-problem eigensolve replaces a
+    shifted-inverse/Lanczos iteration). Weyl's inequality gives
+    |λ_min(Ĝ) − λ_min(G)| ≤ ‖Ĝ − G‖, and a matrix-Bernstein tail bound
+    (Tropp 2015, thm 6.1.1) on ‖Ĝ − G‖ — computable from η = max‖xᵢ‖²
+    and ‖G‖ ≤ min(n·η, ‖A‖_F²) alone — yields the certified lower bound
+    σ_lb = √max(λ_min(Ĝ) − t, 0) with P(σ_lb > σ_min) ≤ δ_σ. The bound
+    direction is the conservative one: the condition number κ = 1/σ_min
+    enters every runtime formula multiplicatively, so a valid *lower*
+    bound on σ_min upper-bounds the cost.
+
+μ_p(A) = √(s_{2p}(A) · s_{2(1−p)}(Aᵀ))
+    Row factor s_q(A) = max_i Σ_j|a_ij|^q: the sampled maximum is the
+    plug-in estimate; a sampled max has no distribution-free upper
+    confidence bound, so the certified upper bound is the deterministic
+    Hölder cap m^{1−q/2}·η^{q/2} (q ≤ 2; a_max^{q−2}·η beyond).
+    Column factor s_q(Aᵀ) = max_j Σ_i|a_ij|^q: per-column sums are plain
+    bounded sums, so the scaled sample sum carries a Hoeffding/Serfling
+    bound (Hoeffding 1963 — valid for sampling without replacement by
+    §6 of the same paper) with per-term range n·a_max^q, union-bounded
+    over the m columns and the exponent set; the certified upper bound
+    is min(estimate + t_q, n^{1−q/2}·(max_j‖A_:j‖²)^{q/2}). μ upper
+    bounds combine per grid point, and since the reference's ``best_mu``
+    takes min(min_p μ_p, ‖A‖_F) the conservative μ never exceeds the
+    (exact) Frobenius norm — the folded estimate cannot blow up a cost
+    model.
+
+‖A‖_F, η, a_max, max column norm
+    One exact O(n·m) pass (NumPy on the host route, fused into the jit on
+    device routes). These are the cheap statistics every bound above
+    feeds on; ‖A‖_F and η are *exact* by construction (bound 0) — a
+    sampled max cannot soundly upper-bound η, and ‖A‖_F at O(n·m) is
+    already ~1 % of the exact sweeps being replaced, so estimating them
+    would spend the error budget on nothing.
+
+Conservative (ε, δ) folding rule (``docs/fit_pipeline.md``): downstream
+consumers take σ_min → its certified lower bound, μ → its certified upper
+bound, η/‖A‖_F → exact; the resulting theoretical quantum cost is then an
+UPPER bound on the true-statistics cost with probability ≥ 1 − δ_stat
+(δ_stat split evenly between the σ and μ claims), and the declared
+contract of any quantity derived from them degrades by at most +δ_stat
+(union bound). The plug-in estimates ride along in ``sketch_info_`` for
+reporting.
+
+Zero-budget / tiny-shape short-circuit: ``delta_stat == 0`` or a shape
+below the engagement rule computes the exact kernels bit-identically
+(:func:`exact_spectral_stats` delegates to the same
+``smallest_singular_value`` / ``_mu_grid`` kernels the fits always used);
+with observability on, the short-circuit emits one zero-violation
+``sketch.stats`` guarantee record, like every other zero-budget route.
+"""
+
+import dataclasses
+import functools
+import math
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .. import obs as _obs
+from ..ops.quantum.norms import _grid_exponents, _power_sweep
+
+__all__ = [
+    "SpectralStats",
+    "dispatch_host",
+    "exact_spectral_stats",
+    "finalize_host",
+    "frobenius_squared",
+    "mu_stats",
+    "resolve_sketch_rows",
+    "sketch_delta_stat",
+    "spectral_stats",
+]
+
+#: default sketch failure budget δ_stat (env ``SQ_SKETCH_DELTA``)
+DEFAULT_DELTA_STAT = 0.05
+
+#: n·m ceiling under which the guarantee auditor affords computing the
+#: exact statistics as ground truth for the ``sketch.*`` sites (env
+#: ``SQ_SKETCH_AUDIT_ELEMS``); above it the audit would rival the sweep
+#: the sketch exists to avoid
+DEFAULT_AUDIT_ELEMS = 8_000_000
+
+
+def sketch_delta_stat():
+    """The sketch engine's failure budget δ_stat (``SQ_SKETCH_DELTA``,
+    default 0.05). 0 disables sketching entirely (zero-budget = exact)."""
+    env = os.environ.get("SQ_SKETCH_DELTA")
+    return float(env) if env else DEFAULT_DELTA_STAT
+
+
+def resolve_sketch_rows(n_samples, n_features, setting="auto"):
+    """Row count of the uniform sketch sample (0 = exact kernels).
+
+    ``setting`` is the estimator-level ``sketch`` hyperparameter: 'auto'
+    targets ``max(4096, 2·m)`` rows — enough for the m×m sketch Gram to
+    be an over-determined estimate — and only engages when the data is
+    ≥4× larger AND tall (n ≥ m), so small fits keep the exact kernels
+    bit-identically (the tiny-shape short-circuit). ``SQ_SKETCH_ROWS``
+    overrides the 'auto' target (0 disables); explicit integers are used
+    as given (0/None/False disables). A zero δ_stat budget also disables
+    (the zero-error-budget convention), checked by the caller via
+    :func:`sketch_delta_stat`.
+    """
+    if setting == "auto":
+        env = os.environ.get("SQ_SKETCH_ROWS")
+        if env is not None:
+            setting = int(env)
+    if setting == "auto":
+        target = max(4096, 2 * int(n_features))
+    elif not setting:
+        return 0
+    else:
+        target = int(setting)
+    if n_samples < 4 * target or n_samples < n_features:
+        return 0
+    if sketch_delta_stat() <= 0:
+        return 0
+    return target
+
+
+@dataclasses.dataclass
+class SpectralStats:
+    """One bundle of runtime-model statistics with certified bounds.
+
+    Plug-in estimates (``sigma_min``, ``mu_vals``) and certified bounds
+    (``sigma_min_lower`` ≤ σ_min w.p. ≥ 1−δ_stat/2; ``mu_upper`` ≥ μ_p
+    w.p. ≥ 1−δ_stat/2) coincide on the exact path. ``cost`` carries the
+    estimated FLOP counts of the sketched computation and of the exact
+    computation it replaced (the obs report's savings line).
+    """
+
+    eta: float
+    frob: float
+    sigma_min: float
+    sigma_min_lower: float
+    mu_grid: tuple
+    mu_vals: np.ndarray
+    mu_upper: np.ndarray
+    delta_stat: float
+    sketched: bool
+    sample_rows: int
+    shape: tuple
+    cost: dict
+
+    def conservative_mu(self):
+        """(description, value) of the conservative μ: the reference's
+        ``best_mu`` winner rule over the certified per-p UPPER bounds vs
+        the exact Frobenius norm — an upper bound on the true best μ
+        (min_p ub_p ≥ min_p μ_p since every ub_p ≥ μ_p, and ‖A‖_F is
+        exact), so the runtime model stays an upper bound."""
+        from ..ops.quantum.norms import select_mu
+
+        return select_mu(self.mu_grid, self.mu_upper, self.frob)
+
+    def condition_number(self):
+        """Conservative κ = 1/σ_lb (an UPPER bound on κ w.p. 1−δ_stat/2).
+        When the Bernstein margin swallows the whole eigenvalue
+        (σ_lb = 0 — the certified bound is vacuous) the plug-in estimate
+        is used instead and :meth:`certified_sigma` reports False."""
+        if self.sigma_min_lower > 0:
+            return 1.0 / self.sigma_min_lower
+        if self.sigma_min > 0:
+            return 1.0 / self.sigma_min
+        return np.inf
+
+    def certified_sigma(self):
+        return (not self.sketched) or self.sigma_min_lower > 0
+
+    def info(self):
+        """JSON-able summary for estimator ``sketch_info_`` attributes."""
+        return {
+            "sketched": self.sketched,
+            "sample_rows": int(self.sample_rows),
+            "delta_stat": float(self.delta_stat),
+            "shape": tuple(int(v) for v in self.shape),
+            "eta": float(self.eta),
+            "frob": float(self.frob),
+            "sigma_min_estimate": float(self.sigma_min),
+            "sigma_min_lower": float(self.sigma_min_lower),
+            "sigma_certified": bool(self.certified_sigma()),
+            "mu_estimate": float(np.min(self.mu_vals)) if len(
+                self.mu_vals) else None,
+            "mu_upper": float(np.min(self.mu_upper)) if len(
+                self.mu_upper) else None,
+            "cost": {k: float(v) for k, v in self.cost.items()},
+        }
+
+
+# ---------------------------------------------------------------------------
+# Kernels (jit; the ``sketch.*`` watchdog / xla_cost sites)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("mu_grid", "with_sigma"))
+def sample_kernel(Xs, scale, *, mu_grid, with_sigma=True):
+    """The sketch pass over the (s, m) sampled rows, ONE dispatch:
+    ``[lam_min?] + row_fac(nq) + col_fac(nq)`` flat in float32, where
+    ``lam_min`` is λ_min of the scaled sketch Gram (``with_sigma`` only),
+    ``row_fac[q]`` the sampled maximum row power sum and ``col_fac[q]``
+    the scaled column power sums' maximum (the μ factor estimates;
+    exponent order = ``_grid_exponents(mu_grid)[0]``). ``scale`` = n/s is
+    traced so a dataset-size change never recompiles."""
+    qs, qpos, uniform = _grid_exponents(mu_grid)
+    row_max, cols = _power_sweep(jnp.asarray(Xs), qs, qpos, uniform)
+    parts = []
+    if with_sigma:
+        G = (Xs.T @ Xs) * scale
+        lam_min = jnp.linalg.eigvalsh(G)[0]
+        parts.append(jnp.reshape(lam_min, (1,)).astype(jnp.float32))
+    parts.append(row_max.astype(jnp.float32))
+    parts.append((jnp.max(cols, axis=1) * scale).astype(jnp.float32))
+    return jnp.concatenate(parts)
+
+
+@jax.jit
+def cheap_pass_kernel(X):
+    """The exact O(n·m) statistics every bound feeds on, fused:
+    ``[eta, frob, amax, colsq_max]`` (max row sq-norm, Frobenius norm,
+    max |entry|, max column sq-norm) — the device twin of the host
+    NumPy pass."""
+    X = jnp.asarray(X)
+    rowsq = jnp.sum(X * X, axis=1)
+    colsq = jnp.sum(X * X, axis=0)
+    return jnp.stack([jnp.max(rowsq), jnp.sqrt(jnp.sum(rowsq)),
+                      jnp.max(jnp.abs(X)), jnp.max(colsq)])
+
+
+def sketch_components_traced(X, idx, mu_grid, with_sigma=True):
+    """In-jit sketched components from a traced full matrix + sampled
+    row indices — the variant ``fit_prestats``/``streamed_prestats`` fuse
+    into their own dispatch. Returns the component dict whose flat fetch
+    :func:`finalize_components` turns into a :class:`SpectralStats`."""
+    Xv = jnp.asarray(X)
+    rowsq = jnp.sum(Xv * Xv, axis=1)
+    colsq = jnp.sum(Xv * Xv, axis=0)
+    Xs = Xv[idx]
+    scale = jnp.asarray(Xv.shape[0] / idx.shape[0], Xv.dtype)
+    qs, qpos, uniform = _grid_exponents(mu_grid)
+    row_max, cols = _power_sweep(Xs, qs, qpos, uniform)
+    out = {
+        "eta": jnp.max(rowsq),
+        "frob": jnp.sqrt(jnp.sum(rowsq)),
+        "amax": jnp.max(jnp.abs(Xv)),
+        "colsq_max": jnp.max(colsq),
+        "row_fac": row_max.astype(Xv.dtype),
+        "col_fac": (jnp.max(cols, axis=1) * scale).astype(Xv.dtype),
+    }
+    if with_sigma:
+        G = (Xs.T @ Xs) * scale
+        out["lam_min"] = jnp.linalg.eigvalsh(G)[0]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Bound math (host side — everything below is plain NumPy/floats)
+# ---------------------------------------------------------------------------
+
+
+def _row_cap(q, m, eta, amax):
+    """Deterministic Hölder cap on s_q(A) = max row power sum."""
+    if q == 0:
+        return float(m)
+    if q <= 2:
+        return float(m) ** (1.0 - q / 2.0) * float(eta) ** (q / 2.0)
+    return float(amax) ** (q - 2.0) * float(eta)
+
+
+def _col_cap(q, n, colsq_max, amax):
+    """Deterministic Hölder cap on s_q(Aᵀ) = max column power sum
+    (monotone in the column sq-norm, so the max column suffices)."""
+    if q == 0:
+        return float(n)
+    if q <= 2:
+        return float(n) ** (1.0 - q / 2.0) * float(colsq_max) ** (q / 2.0)
+    return float(amax) ** (q - 2.0) * float(colsq_max)
+
+
+def _bernstein_gram_deviation(n, s, m, eta, frob, delta):
+    """Matrix-Bernstein tail t with P(‖Ĝ − G‖ ≥ t) ≤ δ for the scaled
+    row-sampled Gram: per-sample operator range L ≤ n·η + ‖G‖ and
+    variance proxy v ≤ n·η·‖G‖, with the deterministic ‖G‖ upper bound
+    min(n·η, ‖A‖_F²)."""
+    g_ub = min(float(n) * float(eta), float(frob) ** 2)
+    ell = math.log(2.0 * max(int(m), 1) / float(delta))
+    v = float(n) * float(eta) * g_ub
+    L = float(n) * float(eta) + g_ub
+    return math.sqrt(2.0 * v * ell / s) + 2.0 * L * ell / (3.0 * s)
+
+
+def _flop_costs(n, s, m, n_qpos):
+    """Estimated FLOPs of the sketched computation vs the exact one it
+    replaces (Gram + μ sweep + cheap pass; transcendentals counted 1)."""
+    sweep = 2 * n_qpos + 2
+    return {
+        "sketch_flops": float(s) * m * m + float(s) * m * sweep
+        + 4.0 * n * m,
+        "exact_flops": float(n) * m * m + float(n) * m * sweep,
+    }
+
+
+def finalize_components(comp, *, n, m, s, mu_grid, delta_stat):
+    """Fold the fetched sketch components into a :class:`SpectralStats`
+    with certified bounds (the conservative folding rule of the module
+    docstring). ``comp`` maps the :func:`sketch_components_traced` keys
+    to host floats/arrays."""
+    qs, qpos, _ = _grid_exponents(mu_grid)
+    eta = float(comp["eta"])
+    frob = float(comp["frob"])
+    amax = float(comp["amax"])
+    colsq_max = float(comp["colsq_max"])
+    row_fac = np.asarray(comp["row_fac"], np.float64)
+    col_fac = np.asarray(comp["col_fac"], np.float64)
+    d_sigma = d_mu = float(delta_stat) / 2.0
+
+    lam_min = comp.get("lam_min")
+    if lam_min is not None:
+        lam_min = float(lam_min)
+        t = _bernstein_gram_deviation(n, s, m, eta, frob, d_sigma)
+        sigma_est = math.sqrt(max(lam_min, 0.0))
+        sigma_lb = math.sqrt(max(lam_min - t, 0.0))
+    else:
+        sigma_est = sigma_lb = 0.0
+
+    idx = {q: i for i, q in enumerate(qs)}
+    # Hoeffding deviation per exponent for the scaled column sums, union
+    # over the m columns and the exponent set (sampling without
+    # replacement: Hoeffding 1963 §6 keeps the with-replacement bound)
+    ell_mu = math.log(max(int(m), 1) * max(len(qs), 1) / d_mu)
+    mu_vals, mu_upper = [], []
+    for p in mu_grid:
+        qr, qc = round(2 * p, 12), round(2 * (1 - p), 12)
+        r_est, c_est = row_fac[idx[qr]], col_fac[idx[qc]]
+        r_ub = _row_cap(qr, m, eta, amax)
+        amax_qc = float(amax) ** qc if qc > 0 else 1.0
+        t_c = float(n) * amax_qc * math.sqrt(ell_mu / (2.0 * s))
+        c_ub = min(float(c_est) + t_c, _col_cap(qc, n, colsq_max, amax))
+        mu_vals.append(math.sqrt(max(float(r_est) * float(c_est), 0.0)))
+        mu_upper.append(math.sqrt(max(r_ub * c_ub, 0.0)))
+    return SpectralStats(
+        eta=eta, frob=frob, sigma_min=sigma_est, sigma_min_lower=sigma_lb,
+        mu_grid=tuple(mu_grid), mu_vals=np.asarray(mu_vals),
+        mu_upper=np.asarray(mu_upper), delta_stat=float(delta_stat),
+        sketched=True, sample_rows=int(s), shape=(int(n), int(m)),
+        cost=_flop_costs(n, s, m, len(qpos)))
+
+
+# ---------------------------------------------------------------------------
+# Exact short-circuit
+# ---------------------------------------------------------------------------
+
+
+def exact_bundle(mu_grid, eta, frob, sigma_min, mu_vals, shape=None):
+    """Wrap already-computed EXACT statistics into a
+    :class:`SpectralStats` (bounds equal the values) — the adapter the
+    exact fit paths use so every path shares the cache and the
+    ``sketch_info_`` surface without recomputing anything."""
+    mu_vals = np.asarray(mu_vals, np.float64)
+    qs, qpos, _ = _grid_exponents(mu_grid)
+    n, m = (int(shape[0]), int(shape[1])) if shape is not None else (0, 0)
+    return SpectralStats(
+        eta=float(eta), frob=float(frob), sigma_min=float(sigma_min),
+        sigma_min_lower=float(sigma_min), mu_grid=tuple(mu_grid),
+        mu_vals=mu_vals, mu_upper=mu_vals.copy(), delta_stat=0.0,
+        sketched=False, sample_rows=0, shape=(n, m),
+        cost=_flop_costs(n, max(n, 1), m, len(qpos)))
+
+
+def exact_spectral_stats(X, mu_grid, with_sigma=True):
+    """The exact kernels, packaged: delegates to the SAME
+    ``smallest_singular_value`` / ``_mu_grid`` code every fit path always
+    used (bit-identical values to the pre-sketch pipeline), with bounds
+    equal to the values. Emits the zero-budget ``sketch.stats``
+    short-circuit guarantee record when observability is on."""
+    from ..ops.linalg import row_norms, smallest_singular_value
+    from ..ops.quantum.norms import _mu_grid
+
+    Xd = jnp.asarray(X)
+    n, m = Xd.shape
+    eta = float(jnp.max(row_norms(Xd, squared=True)))
+    frob = float(jnp.linalg.norm(Xd))
+    sigma = float(smallest_singular_value(Xd)) if with_sigma else 0.0
+    mu_vals = np.asarray(_mu_grid(Xd, tuple(mu_grid)), np.float64)
+    qs, qpos, _ = _grid_exponents(mu_grid)
+    if _obs.guarantees.enabled():
+        _obs.guarantees.record_guarantee(
+            "sketch.stats", 0.0, 0.0, fail_prob=0.0, short_circuit=True,
+            estimator="sketch")
+    return SpectralStats(
+        eta=eta, frob=frob, sigma_min=sigma, sigma_min_lower=sigma,
+        mu_grid=tuple(mu_grid), mu_vals=mu_vals, mu_upper=mu_vals.copy(),
+        delta_stat=0.0, sketched=False, sample_rows=0,
+        shape=(int(n), int(m)),
+        cost=_flop_costs(n, max(int(n), 1), m, len(qpos)))
+
+
+# ---------------------------------------------------------------------------
+# Host-route async dispatch (the q-means fit pipeline's shape)
+# ---------------------------------------------------------------------------
+
+
+class _HostDispatch:
+    """In-flight sketch: the async device handle plus the host header the
+    bound math needs at the fetch."""
+
+    __slots__ = ("handle", "header", "n", "s", "m", "mu_grid", "with_sigma",
+                 "idx")
+
+    def __init__(self, handle, header, n, s, m, mu_grid, with_sigma, idx):
+        self.handle = handle
+        self.header = header
+        self.n, self.s, self.m = n, s, m
+        self.mu_grid = mu_grid
+        self.with_sigma = with_sigma
+        self.idx = idx
+
+
+def sample_indices(rng, n, rows):
+    """Sorted uniform without-replacement row sample (sorted: the gather
+    walks memory forward; the estimators are permutation-invariant)."""
+    return np.sort(rng.choice(int(n), size=int(rows), replace=False))
+
+
+def dispatch_sample(Xs, scale, mu_grid, with_sigma=True,
+                    site="sketch.stats_kernel"):
+    """Dispatch :func:`sample_kernel` asynchronously under the site's
+    watchdog budget + xla-cost capture — the one instrumented entry every
+    route (host, device, streamed) shares."""
+    if _obs.enabled():
+        _obs.watchdog.track(site, sample_kernel)
+        _obs.watchdog.allow(site, (Xs.shape, str(Xs.dtype),
+                                   tuple(mu_grid), with_sigma))
+        _obs.xla.capture(site, sample_kernel, Xs, scale, mu_grid=mu_grid,
+                         with_sigma=with_sigma)
+    handle = sample_kernel(Xs, scale, mu_grid=mu_grid,
+                           with_sigma=with_sigma)
+    if _obs.enabled():
+        _obs.watchdog.observe(site)
+    return handle
+
+
+def dispatch_host(Xn, rows, mu_grid, *, rng, colsq=None, with_sigma=True,
+                  site="sketch.stats_kernel"):
+    """Host-route sketch, async: one exact NumPy cheap pass (reusing the
+    caller's column square sums when it already accumulated them — the
+    q-means prestats do), then the fused :func:`sample_kernel` dispatched
+    WITHOUT blocking, so on an idle accelerator backend it overlaps the
+    native engines. The caller fetches via :func:`finalize_host`.
+
+    NOTE (CLAUDE.md head-of-line hazard): derive every host RNG you need
+    BEFORE calling this — jax ops issued after the dispatch queue behind
+    the running kernel on the CPU client's execution stream.
+    """
+    n, m = Xn.shape
+    idx = sample_indices(rng, n, rows)
+    with _obs.span("sketch.cheap_pass", n=n, m=m):
+        # native-dtype einsum (the f64-upcast variant runs off numpy's
+        # SIMD path, ~2× the wall-clock at 70k×784); η's precision class
+        # matches the exact device kernel, which accumulates row norms
+        # in the input dtype too
+        rowsq = np.einsum("ij,ij->i", Xn, Xn)
+        eta = float(rowsq.max())
+        # max|a_ij| without materializing a dataset-sized |X| temp
+        amax = float(max(Xn.max(), -float(Xn.min())))
+        if colsq is None:
+            colsq = np.einsum("ij,ij->j", Xn, Xn, dtype=np.float64)
+        frob = float(math.sqrt(float(np.sum(colsq))))
+        colsq_max = float(np.max(colsq))
+    Xs = jnp.asarray(np.ascontiguousarray(Xn[idx]))
+    scale = jnp.asarray(n / rows, Xs.dtype)
+    handle = dispatch_sample(Xs, scale, tuple(mu_grid), with_sigma, site)
+    return _HostDispatch(handle, (eta, frob, amax, colsq_max), n, rows, m,
+                         tuple(mu_grid), with_sigma, idx)
+
+
+def finalize_host(disp, delta_stat, X_for_audit=None):
+    """Block on a :func:`dispatch_host` handle and fold bounds. With
+    observability on and an affordable matrix, also emits the
+    ``sketch.*`` guarantee draws against exact ground truth."""
+    flat = np.asarray(disp.handle, np.float64)
+    off = 1 if disp.with_sigma else 0
+    nq = (len(flat) - off) // 2
+    eta, frob, amax, colsq_max = disp.header
+    comp = {"eta": eta, "frob": frob, "amax": amax,
+            "colsq_max": colsq_max,
+            "row_fac": flat[off:off + nq],
+            "col_fac": flat[off + nq:off + 2 * nq]}
+    if disp.with_sigma:
+        comp["lam_min"] = flat[0]
+    stats = finalize_components(comp, n=disp.n, m=disp.m, s=disp.s,
+                                mu_grid=disp.mu_grid,
+                                delta_stat=delta_stat)
+    record_sketch_obs(stats)
+    if X_for_audit is not None:
+        audit_sketch(stats, X_for_audit)
+    return stats
+
+
+def record_sketch_obs(stats):
+    """Obs counters for the report's savings section: estimated FLOPs of
+    the sketched computation and of the exact sweep it replaced."""
+    if not _obs.enabled() or not stats.sketched:
+        return
+    _obs.counter_add("sketch.flops", stats.cost["sketch_flops"])
+    _obs.counter_add("sketch.exact_equiv_flops", stats.cost["exact_flops"])
+    _obs.counter_add("sketch.estimates", 1)
+
+
+def audit_sketch(stats, X):
+    """Guarantee draws for the sketch's own contract: with observability
+    on and the matrix under the audit ceiling, compute the EXACT σ_min
+    and μ grid and record the realized bound violations (zero, unless
+    the math above is wrong) against the declared δ_stat at the
+    ``sketch.sigma_min`` / ``sketch.mu`` sites. Above the ceiling the
+    audit is skipped — it would rival the sweep the sketch replaces."""
+    if not _obs.guarantees.enabled() or not stats.sketched:
+        return
+    n, m = stats.shape
+    cap = int(os.environ.get("SQ_SKETCH_AUDIT_ELEMS", DEFAULT_AUDIT_ELEMS))
+    if n * m > cap:
+        return
+    try:
+        from ..ops.linalg import smallest_singular_value
+        from ..ops.quantum.norms import _mu_grid
+
+        Xd = jnp.asarray(X)
+        tol = 1e-5 * max(1.0, stats.frob)  # float-noise allowance
+        if stats.sigma_min_lower > 0:
+            sigma_exact = float(smallest_singular_value(Xd))
+            _obs.guarantees.observe(
+                "sketch.sigma_min",
+                [max(0.0, stats.sigma_min_lower - sigma_exact)], tol,
+                fail_prob=stats.delta_stat / 2.0, estimator="sketch",
+                sample_rows=stats.sample_rows)
+        mu_exact = np.asarray(_mu_grid(Xd, stats.mu_grid), np.float64)
+        _obs.guarantees.observe(
+            "sketch.mu",
+            np.maximum(0.0, mu_exact - np.asarray(stats.mu_upper)), tol,
+            fail_prob=stats.delta_stat / 2.0, estimator="sketch",
+            sample_rows=stats.sample_rows)
+    except Exception:
+        pass  # the audit must never break a fit that already succeeded
+
+
+# ---------------------------------------------------------------------------
+# Synchronous convenience (qPCA μ route, QLSSVC, tests)
+# ---------------------------------------------------------------------------
+
+
+def spectral_stats(X, mu_grid, *, delta_stat=None, sketch="auto",
+                   with_sigma=True, rng=None, audit=True):
+    """Estimate the spectral statistics of ``X`` (host ndarray or
+    single-device jax array), sketched when the engagement rule fires,
+    exact otherwise. Synchronous: blocks on the result."""
+    n, m = X.shape
+    if delta_stat is None:
+        delta_stat = sketch_delta_stat()
+    rows = resolve_sketch_rows(n, m, sketch) if delta_stat > 0 else 0
+    if not rows:
+        return exact_spectral_stats(X, mu_grid, with_sigma=with_sigma)
+    if rng is None:
+        rng = np.random.default_rng(0)
+    with _obs.span("sketch.stats", n=n, m=m, rows=rows,
+                   with_sigma=with_sigma):
+        if isinstance(X, jax.Array):
+            idx = sample_indices(rng, n, rows)
+            cheap = np.asarray(cheap_pass_kernel(X), np.float64)
+            Xs = X[jnp.asarray(idx)]
+            scale = jnp.asarray(n / rows, X.dtype)
+            handle = dispatch_sample(Xs, scale, tuple(mu_grid), with_sigma)
+            disp = _HostDispatch(handle, tuple(cheap), n, rows, m,
+                                 tuple(mu_grid), with_sigma, idx)
+        else:
+            Xn = np.ascontiguousarray(X)
+            disp = dispatch_host(Xn, rows, mu_grid, rng=rng,
+                                 with_sigma=with_sigma)
+        return finalize_host(disp, delta_stat,
+                             X_for_audit=X if audit else None)
+
+
+def mu_stats(X, mu_grid, *, sketch="auto", rng=None, tag="mu",
+             audit=True):
+    """Digest-cached conservative μ-route statistics (no σ_min — the μ
+    consumers, e.g. the qPCA QADRA estimators, never read it): one
+    :func:`spectral_stats` per (dataset, grid, sketch config), every
+    repeat served from the cache. Returns a :class:`SpectralStats`;
+    consumers take ``stats.conservative_mu()`` — on the exact path this
+    is bit-identical to the historical ``best_mu`` winner rule."""
+    from . import cache as _cache
+
+    delta_stat = sketch_delta_stat()
+    n, m = X.shape
+    rows = resolve_sketch_rows(n, m, sketch) if delta_stat > 0 else 0
+    key = _cache.key_for(X, tag, tuple(mu_grid), int(rows),
+                         float(delta_stat) if rows else 0.0)
+    hit = _cache.lookup(key)
+    if hit is not None:
+        return hit
+    stats = spectral_stats(X, mu_grid, delta_stat=delta_stat,
+                           sketch=rows if rows else 0, with_sigma=False,
+                           rng=rng, audit=audit)
+    _cache.store(key, stats)
+    return stats
+
+
+def frobenius_squared(X):
+    """‖X‖_F² through the engine's digest-keyed cache — exact (one
+    O(n·m) pass; estimating a statistic this cheap would spend error
+    budget on nothing) but computed once per dataset across repeated
+    fits. The uniform entry point the QLSSVC cost model rides."""
+    from . import cache as _cache
+
+    key = _cache.key_for(X, "frob2")
+    hit = _cache.lookup(key)
+    if hit is not None:
+        return float(hit)
+    Xn = np.asarray(X)
+    val = float(np.einsum("ij,ij->", Xn, Xn, dtype=np.float64))
+    _cache.store(key, val)
+    return val

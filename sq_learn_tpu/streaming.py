@@ -482,6 +482,32 @@ def _kpp_score_step(acc, tile, n_valid, start, cand, closest, weights):
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
+def _assemble_step(acc, tile, n_valid, start):
+    """Pure resident assembly: write the tile into the donated device
+    buffer, nothing else — the streamed replacement for the deprecated
+    ``chunked_device_put`` slice-and-concatenate (which held every slice
+    AND the concatenated output live: a 2× peak the donated in-place
+    write avoids)."""
+    return lax.dynamic_update_slice(acc, tile,
+                                    (start,) + (0,) * (tile.ndim - 1))
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _sketch_cheap_step(acc, tile):
+    """One tile of the out-of-core sketch cheap pass: running max row
+    sq-norm (η), column square-sum partials (‖A‖_F² / max column), and
+    max |entry| — every deterministic input the sketch engine's bound
+    math needs, accumulated without the matrix ever being resident.
+    Zero-padded rows contribute 0 to each (power sums are non-negative,
+    so a padding row can never win a max over real data)."""
+    eta, colsq, amax = acc
+    sq = tile * tile
+    return (jnp.maximum(eta, jnp.max(jnp.sum(sq, axis=1))),
+            colsq + jnp.sum(sq, axis=0),
+            jnp.maximum(amax, jnp.max(jnp.abs(tile))))
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
 def _matmul_accum_step(acc, tile, Q):
     """acc ← acc + tileᵀ·(tile·Q) — one power-iteration pass of the
     Gram-based range finder, never materializing the (n, size) product."""
@@ -531,6 +557,9 @@ _gram_colsum_step = _xla.instrument("streaming.gram_colsum",
 _colsum_step = _xla.instrument("streaming.colsum", _colsum_step)
 _ingest_step = _xla.instrument("streaming.ingest", _ingest_step)
 _kpp_score_step = _xla.instrument("streaming.kpp_score", _kpp_score_step)
+_assemble_step = _xla.instrument("streaming.assemble", _assemble_step)
+_sketch_cheap_step = _xla.instrument("streaming.sketch_cheap",
+                                     _sketch_cheap_step)
 _matmul_accum_step = _xla.instrument("streaming.matmul_accum",
                                      _matmul_accum_step)
 _project_rows_step = _xla.instrument("streaming.project_rows",
@@ -542,11 +571,13 @@ _topk_u_step = _xla.instrument("streaming.topk_u", _topk_u_step)
 #: ``"streaming.<short name>"``; :func:`kernel_cache_sizes` reads the same
 #: registry.
 _KERNELS = {
+    "assemble": _assemble_step,
     "gram_colsum": _gram_colsum_step,
     "colsum": _colsum_step,
     "ingest": _ingest_step,
     "kpp_score": _kpp_score_step,
     "matmul_accum": _matmul_accum_step,
+    "sketch_cheap": _sketch_cheap_step,
     "project_rows": _project_rows_step,
     "qtb": _qtb_step,
     "topk_u": _topk_u_step,
@@ -791,7 +822,7 @@ def streamed_kmeans_plusplus(key, X, n_clusters, *, weights=None,
 
 
 def streamed_prestats(X, *, quantum=False, mu_grid=(), mu_blocked=False,
-                      max_bytes=None, device=None):
+                      sketch_idx=None, max_bytes=None, device=None):
     """Streamed twin of :func:`~sq_learn_tpu.models.qkmeans.fit_prestats`:
     assemble the device copy tile-by-tile into ONE donated buffer (bounded
     transfers, no concatenate, upload overlapped with the running
@@ -802,6 +833,12 @@ def streamed_prestats(X, *, quantum=False, mu_grid=(), mu_blocked=False,
     it every iteration), so unlike the Gram consumers this path keeps X on
     device — what streaming buys is the bounded per-transfer size and the
     in-place assembly. Returns the same dict as ``fit_prestats``.
+
+    ``sketch_idx`` ((s,) sampled row indices, quantum only) swaps the
+    exact σ_min Gram + μ sweep for the sketched component kernel of
+    :mod:`sq_learn_tpu.sketch.engine` running on the resident buffer —
+    zero extra transfers; the raw components land under a ``"sketch"``
+    key and the caller folds the certified bounds in on host.
     """
     X = np.asarray(X)
     n, m = X.shape
@@ -824,7 +861,14 @@ def streamed_prestats(X, *, quantum=False, mu_grid=(), mu_blocked=False,
         # the quantum runtime-model stats read the UNCENTERED matrix;
         # compute them on the resident buffer before it is donated away
         # by the centering finalize
-        out.update(_prestats_quantum(buf, n, mu_grid, mu_blocked))
+        if sketch_idx is not None:
+            _obs.xla.capture("sketch.prestats_kernel",
+                             _prestats_quantum_sketched, buf, sketch_idx,
+                             n=n, mu_grid=mu_grid)
+            out["sketch"] = _prestats_quantum_sketched(buf, sketch_idx,
+                                                       n=n, mu_grid=mu_grid)
+        else:
+            out.update(_prestats_quantum(buf, n, mu_grid, mu_blocked))
     import warnings
 
     with warnings.catch_warnings():
@@ -836,6 +880,93 @@ def streamed_prestats(X, *, quantum=False, mu_grid=(), mu_blocked=False,
         mean, Xc, xsq, var_mean = _finalize_prestats(buf, colsum, sqsum, n)
     out.update({"mean": mean, "Xc": Xc, "xsq": xsq, "var_mean": var_mean})
     return out
+
+
+def streamed_resident_put(x, device=None, max_bytes=None):
+    """Whole-array host→device placement through the streaming engine —
+    the supervised successor of the deprecated
+    :func:`~sq_learn_tpu._config.chunked_device_put` slicing branch.
+
+    Each bounded tile crosses under the transfer supervisor
+    (retry/backoff, deadline, breaker accounting) with double-buffered
+    uploads and the ``streaming.assemble`` watchdog/xla-cost site, and
+    assembles IN PLACE into one donated device buffer — no
+    slice-then-concatenate 2× peak. Semantically identical to
+    ``jax.device_put(np.asarray(x), device)`` (dtype canonicalization
+    included)."""
+    Xn = np.asarray(x)
+    canonical = jax.dtypes.canonicalize_dtype(Xn.dtype)
+    if Xn.dtype != canonical:
+        Xn = Xn.astype(canonical)
+    n = Xn.shape[0]
+    n_pad = padded_rows(n, Xn.nbytes // max(1, n), max_bytes)
+    init = jnp.zeros((n_pad,) + Xn.shape[1:], Xn.dtype)
+    buf = stream_fold(Xn, _assemble_step, init, max_bytes=max_bytes,
+                      device=device, with_offsets=True,
+                      site="streaming.assemble", checkpoint=False)
+    # a ragged tail pads the buffer past n; the slice is the one
+    # remaining transient copy (bounded by a single tile's bucket)
+    return buf[:n] if n_pad > n else buf
+
+
+def streamed_spectral_stats(X, mu_grid, *, delta_stat=None, sketch="auto",
+                            rng=None, max_bytes=None, device=None,
+                            audit=False):
+    """Out-of-core sketched spectral statistics: only the (s, m) sampled
+    rows and the (m,)-sized cheap-pass accumulators ever live on device —
+    X streams tile-by-tile through :func:`stream_fold` (bounded supervised
+    transfers, ``streaming.sketch_cheap`` site, ≤1 compile per bucket)
+    while the sample kernel runs async on the already-uploaded sample.
+    This is the route for matrices too large to sit resident whose cost
+    model still wants (σ_min, μ, ‖A‖_F, η) with certified bounds.
+
+    Zero budget / tiny shapes fall back to the exact engine kernels
+    (which do require a resident upload — the exactness contract wins
+    over memory by convention; callers that cannot afford it pass an
+    explicit ``sketch`` row count). Returns a
+    :class:`~sq_learn_tpu.sketch.engine.SpectralStats`.
+    """
+    from .sketch import engine as _sk
+
+    X = np.asarray(X)
+    n, m = X.shape
+    if delta_stat is None:
+        delta_stat = _sk.sketch_delta_stat()
+    rows = _sk.resolve_sketch_rows(n, m, sketch) if delta_stat > 0 else 0
+    if not rows:
+        return _sk.exact_spectral_stats(X, mu_grid)
+    if rng is None:
+        rng = np.random.default_rng(0)
+    dtype = jax.dtypes.canonicalize_dtype(X.dtype)
+    # sample indices BEFORE any dispatch (head-of-line blocking contract)
+    idx = _sk.sample_indices(rng, n, rows)
+    with _obs.span("sketch.streamed_stats", n=n, m=m, rows=rows):
+        Xs = jnp.asarray(np.ascontiguousarray(X[idx], dtype))
+        scale = jnp.asarray(n / rows, dtype)
+        handle = _sk.dispatch_sample(Xs, scale, tuple(mu_grid), True)
+        init = (jnp.zeros((), dtype), jnp.zeros((m,), dtype),
+                jnp.zeros((), dtype))
+        eta, colsq, amax = stream_fold(
+            X, _sketch_cheap_step, init, max_bytes=max_bytes,
+            device=device, site="streaming.sketch_cheap")
+        colsq = np.asarray(colsq, np.float64)
+        header = (float(eta), float(np.sqrt(colsq.sum())), float(amax),
+                  float(colsq.max()))
+        disp = _sk._HostDispatch(handle, header, n, rows, m,
+                                 tuple(mu_grid), True, idx)
+        return _sk.finalize_host(disp, delta_stat,
+                                 X_for_audit=X if audit else None)
+
+
+@functools.partial(jax.jit, static_argnames=("n", "mu_grid"))
+def _prestats_quantum_sketched(buf, idx, *, n, mu_grid):
+    """Sketched twin of :func:`_prestats_quantum`: the component kernel of
+    the spectral-stats engine over the resident buffer's real rows — one
+    extra dispatch on data already on device, replacing the O(n·m²)-class
+    exact sweep (``sketch.prestats_kernel`` xla-cost site)."""
+    from .sketch.engine import sketch_components_traced
+
+    return sketch_components_traced(buf[:n], idx, mu_grid)
 
 
 @functools.partial(jax.jit, static_argnames=("n", "mu_grid", "mu_blocked"))

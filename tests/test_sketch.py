@@ -1,0 +1,454 @@
+"""PR 7 sketched spectral-stats engine (sq_learn_tpu.sketch): exact
+short-circuits, certified-bound validity, the digest-keyed stats cache,
+the streamed routes, and the estimator wiring (QKMeans/QPCA/QLSSVC)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from sq_learn_tpu import obs
+from sq_learn_tpu.models import QKMeans, QPCA
+from sq_learn_tpu.models.qkmeans import MU_GRID
+from sq_learn_tpu.ops.linalg import row_norms, smallest_singular_value
+from sq_learn_tpu.ops.quantum.norms import _mu_grid, select_mu
+from sq_learn_tpu.sketch import cache as stats_cache
+from sq_learn_tpu.sketch import engine
+
+GRID = (0.0, 0.25, 0.5, 0.75, 1.0)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    stats_cache.clear()
+    yield
+    stats_cache.clear()
+
+
+@pytest.fixture
+def run():
+    rec = obs.enable()
+    yield rec
+    obs.disable()
+
+
+def _data(n=2000, m=12, seed=0, dtype=np.float32):
+    rng = np.random.default_rng(seed)
+    # anisotropic + shifted so σ_min / μ are non-degenerate
+    X = rng.normal(size=(n, m)) * np.linspace(0.5, 3.0, m) + 0.3
+    return X.astype(dtype)
+
+
+# -- engagement rule / short-circuits ---------------------------------------
+
+
+class TestEngagement:
+    def test_tiny_shapes_disable(self):
+        assert engine.resolve_sketch_rows(500, 8, "auto") == 0
+        assert engine.resolve_sketch_rows(100, 200, 4096) == 0  # wide
+        assert engine.resolve_sketch_rows(70_000, 784, "auto") == 4096
+
+    def test_explicit_and_env_overrides(self, monkeypatch):
+        assert engine.resolve_sketch_rows(70_000, 784, 0) == 0
+        assert engine.resolve_sketch_rows(70_000, 784, None) == 0
+        assert engine.resolve_sketch_rows(70_000, 784, 1024) == 1024
+        monkeypatch.setenv("SQ_SKETCH_ROWS", "512")
+        assert engine.resolve_sketch_rows(70_000, 784, "auto") == 512
+        monkeypatch.setenv("SQ_SKETCH_ROWS", "0")
+        assert engine.resolve_sketch_rows(70_000, 784, "auto") == 0
+
+    def test_zero_delta_budget_disables(self, monkeypatch):
+        monkeypatch.setenv("SQ_SKETCH_DELTA", "0")
+        assert engine.resolve_sketch_rows(70_000, 784, "auto") == 0
+        X = _data(400, 6)
+        st = engine.spectral_stats(X, GRID)
+        assert not st.sketched
+
+    def test_exact_path_matches_exact_kernels(self):
+        """Zero-budget/tiny-shape stats are the SAME kernels the fits
+        always used — values bit-identical, bounds equal to values."""
+        X = _data(400, 6)
+        st = engine.spectral_stats(X, GRID)
+        assert not st.sketched and st.sample_rows == 0
+        Xd = jnp.asarray(X)
+        assert st.eta == float(jnp.max(row_norms(Xd, squared=True)))
+        assert st.frob == float(jnp.linalg.norm(Xd))
+        assert st.sigma_min == float(smallest_singular_value(Xd))
+        np.testing.assert_array_equal(
+            st.mu_vals, np.asarray(_mu_grid(Xd, GRID), np.float64))
+        np.testing.assert_array_equal(st.mu_vals, st.mu_upper)
+        assert st.sigma_min_lower == st.sigma_min
+        assert st.conservative_mu() == select_mu(GRID, st.mu_vals, st.frob)
+
+
+# -- certified bounds --------------------------------------------------------
+
+
+class TestBounds:
+    def _check(self, X, seed):
+        Xd = jnp.asarray(X)
+        st = engine.spectral_stats(
+            X, GRID, sketch=256, rng=np.random.default_rng(seed),
+            audit=False)
+        assert st.sketched and st.sample_rows == 256
+        # η / ‖A‖_F are exact by construction (one full cheap pass)
+        assert st.eta == pytest.approx(
+            float(jnp.max(row_norms(Xd, squared=True))), rel=1e-5)
+        assert st.frob == pytest.approx(float(jnp.linalg.norm(Xd)),
+                                        rel=1e-5)
+        # σ lower bound: never above the true σ_min (float-noise slack)
+        sigma_true = float(smallest_singular_value(Xd))
+        assert st.sigma_min_lower <= sigma_true * (1 + 1e-5)
+        # μ upper bounds: per grid point, never below the true μ_p
+        mu_true = np.asarray(_mu_grid(Xd, GRID), np.float64)
+        assert np.all(st.mu_upper >= mu_true * (1 - 1e-5))
+        # the conservative winner never exceeds the exact Frobenius norm
+        assert st.conservative_mu()[1] <= st.frob * (1 + 1e-12)
+
+    def test_bounds_hold_single_seed(self):
+        self._check(_data(2000, 12), seed=7)
+
+    @pytest.mark.slow
+    def test_bounds_hold_across_seeds(self):
+        """Statistical tier: the (ε_stat, δ_stat) claims across many
+        sample draws and data distributions. With δ_stat = 0.05 a single
+        violated seed among 20×2 draws is already unlikely but possible;
+        the engine's bounds are distribution-free finite-sample results,
+        so zero violations is the expected outcome."""
+        violations = 0
+        for seed in range(20):
+            X = _data(2000, 12, seed=seed % 5)
+            Xd = jnp.asarray(X)
+            st = engine.spectral_stats(
+                X, GRID, sketch=256, rng=np.random.default_rng(100 + seed),
+                audit=False)
+            sigma_true = float(smallest_singular_value(Xd))
+            mu_true = np.asarray(_mu_grid(Xd, GRID), np.float64)
+            if st.sigma_min_lower > sigma_true * (1 + 1e-5):
+                violations += 1
+            if np.any(st.mu_upper < mu_true * (1 - 1e-5)):
+                violations += 1
+        assert violations == 0
+
+    def test_vacuous_sigma_bound_falls_back_to_plugin(self):
+        st = engine.spectral_stats(_data(2000, 12), GRID, sketch=256,
+                                   audit=False)
+        if st.sigma_min_lower == 0.0:
+            assert not st.certified_sigma()
+            assert st.condition_number() == 1.0 / st.sigma_min
+        else:
+            assert st.certified_sigma()
+            assert st.condition_number() == 1.0 / st.sigma_min_lower
+
+    def test_info_is_jsonable(self):
+        import json
+
+        st = engine.spectral_stats(_data(2000, 12), GRID, sketch=256,
+                                   audit=False)
+        json.dumps(st.info())
+
+
+# -- digest-keyed stats cache ------------------------------------------------
+
+
+class TestStatsCache:
+    def test_hit_and_miss_counters(self, run):
+        key = stats_cache.key_for(_data(), "t", 1)
+        assert stats_cache.lookup(key) is None
+        stats_cache.store(key, "payload")
+        assert stats_cache.lookup(key) == "payload"
+        counters = run.counters
+        assert counters["stats_cache.misses"] == 1
+        assert counters["stats_cache.hits"] == 1
+
+    def test_mutation_invalidates(self):
+        X = _data()
+        k1 = stats_cache.key_for(X, "t")
+        X[0, 0] += 1.0  # first row is always in the strided digest
+        k2 = stats_cache.key_for(X, "t")
+        assert k1 != k2
+        X[-1, -1] += 1.0  # so is the last
+        assert stats_cache.key_for(X, "t") != k2
+
+    def test_config_is_part_of_the_key(self):
+        X = _data()
+        assert (stats_cache.key_for(X, "t", 256, 0.05)
+                != stats_cache.key_for(X, "t", 512, 0.05))
+        assert (stats_cache.key_for(X, "t", 256, 0.05)
+                != stats_cache.key_for(X, "u", 256, 0.05))
+
+    def test_disabled_by_env(self, monkeypatch):
+        monkeypatch.setenv("SQ_STATS_CACHE", "0")
+        assert stats_cache.key_for(_data(), "t") is None
+        stats_cache.store(None, "x")
+        assert stats_cache.lookup(None) is None
+
+    def test_lru_bound(self):
+        for i in range(stats_cache.MAX_ENTRIES + 3):
+            stats_cache.store(("k", i), i)
+        assert stats_cache.lookup(("k", 0)) is None
+        assert stats_cache.lookup(
+            ("k", stats_cache.MAX_ENTRIES + 2)) is not None
+
+
+# -- estimator wiring: QKMeans -----------------------------------------------
+
+
+class TestQKMeansWiring:
+    def test_small_fit_stays_exact_and_matches_sketch_off(self):
+        X = _data(600, 8)
+        a = QKMeans(n_clusters=3, delta=0.5, true_distance_estimate=False,
+                    random_state=0, max_iter=10, sketch="auto").fit(X)
+        stats_cache.clear()
+        b = QKMeans(n_clusters=3, delta=0.5, true_distance_estimate=False,
+                    random_state=0, max_iter=10, sketch=0).fit(X)
+        assert not a.sketch_info_["sketched"]
+        assert a.eta_ == b.eta_
+        assert a.mu_ == b.mu_
+        assert a.condition_number_ == b.condition_number_
+        np.testing.assert_array_equal(a.labels_, b.labels_)
+
+    def test_host_route_sketched_is_conservative(self, monkeypatch):
+        monkeypatch.setenv("SQ_SKETCH_ROWS", "256")
+        X = _data(2000, 12)
+        sk = QKMeans(n_clusters=3, delta=0.5, true_distance_estimate=False,
+                     random_state=0, max_iter=10).fit(X)
+        stats_cache.clear()
+        monkeypatch.setenv("SQ_SKETCH_ROWS", "0")
+        ex = QKMeans(n_clusters=3, delta=0.5, true_distance_estimate=False,
+                     random_state=0, max_iter=10).fit(X)
+        assert sk.ingest_ == "host" and ex.ingest_ == "host"
+        assert sk.sketch_info_["sketched"]
+        assert not ex.sketch_info_["sketched"]
+        # clustering identical — the sketch only feeds the cost model
+        np.testing.assert_array_equal(sk.labels_, ex.labels_)
+        # conservative folding: μ never below the exact winner, and the
+        # runtime model inputs stay finite
+        assert sk.mu_ >= ex.mu_ * (1 - 1e-6)
+        assert np.isfinite(sk.condition_number_)
+
+    def test_sweep_computes_stats_once_per_dataset(self, run, monkeypatch):
+        """The frontier-sweep contract (acceptance criterion): refits over
+        the SAME data at different (ε, δ) recompute spectral stats at most
+        once — every later fit is a digest-cache hit."""
+        monkeypatch.setenv("SQ_SKETCH_ROWS", "256")
+        X = _data(2000, 12)
+        for i, delta in enumerate((0.5, 0.7, 0.3, 0.9)):
+            QKMeans(n_clusters=3, delta=delta, max_iter=5,
+                    true_distance_estimate=False, random_state=i).fit(X)
+        counters = run.counters
+        assert counters["stats_cache.misses"] == 1
+        assert counters["stats_cache.hits"] == 3
+        assert counters["sketch.estimates"] == 1
+        snap = obs.snapshot()
+        assert snap["stats_cache_hits"] == 3
+        assert snap["sketch_estimates"] == 1
+
+    def test_mutated_input_recomputes(self, run, monkeypatch):
+        monkeypatch.setenv("SQ_SKETCH_ROWS", "256")
+        X = _data(2000, 12)
+        QKMeans(n_clusters=3, delta=0.5, max_iter=5, random_state=0,
+                true_distance_estimate=False).fit(X)
+        X[0] += 1.0
+        QKMeans(n_clusters=3, delta=0.5, max_iter=5, random_state=0,
+                true_distance_estimate=False).fit(X)
+        assert run.counters["stats_cache.misses"] == 2
+        assert run.counters.get("stats_cache.hits", 0) == 0
+
+    def test_fused_path_sketched(self, monkeypatch):
+        """The accelerator fused fit consumes the sketched prestats
+        components and folds bounds at the single fetch."""
+        monkeypatch.setenv("SQ_SKETCH_ROWS", "256")
+        X = _data(2000, 12)
+        est = QKMeans(n_clusters=3, delta=0.5, max_iter=5, random_state=0,
+                      true_distance_estimate=False)
+        w = np.ones(X.shape[0], np.float32)
+        out = est._fit_fused(X, w, 0.5, "delta")
+        assert out is est
+        assert est.sketch_info_["sketched"]
+        assert est.sketch_info_["sample_rows"] == 256
+        assert np.isfinite(est.mu_) and np.isfinite(est.condition_number_)
+
+    def test_fused_path_serves_cache_hit(self, run, monkeypatch):
+        monkeypatch.setenv("SQ_SKETCH_ROWS", "256")
+        X = _data(2000, 12)
+        w = np.ones(X.shape[0], np.float32)
+        a = QKMeans(n_clusters=3, delta=0.5, max_iter=5, random_state=0,
+                    true_distance_estimate=False)
+        assert a._fit_fused(X, w, 0.5, "delta") is a
+        b = QKMeans(n_clusters=3, delta=0.7, max_iter=5, random_state=1,
+                    true_distance_estimate=False)
+        assert b._fit_fused(X, w, 0.7, "delta") is b
+        assert run.counters["stats_cache.hits"] == 1
+        assert b.sketch_info_ == a.sketch_info_
+
+    def test_streamed_route_sketched(self, monkeypatch):
+        monkeypatch.setenv("SQ_SKETCH_ROWS", "256")
+        monkeypatch.setenv("SQ_STREAM_TILE_BYTES", str(64 * 1024))
+        X = _data(4000, 12)
+        est = QKMeans(n_clusters=3, delta=0.5, max_iter=5, random_state=0,
+                      use_pallas=False, true_distance_estimate=False).fit(X)
+        assert est.ingest_ == "streamed"
+        assert est.sketch_info_["sketched"]
+        assert np.isfinite(est.mu_)
+
+
+# -- estimator wiring: QPCA / QLSSVC ----------------------------------------
+
+
+class TestQPCAWiring:
+    def test_tiny_mu_parity_with_best_mu(self):
+        from sq_learn_tpu.ops.quantum import best_mu
+
+        X = _data(300, 10, dtype=np.float64)
+        p = QPCA(n_components=4, svd_solver="full", random_state=0,
+                 compute_mu=True).fit(X)
+        Xc = jnp.asarray(X) - jnp.mean(jnp.asarray(X), axis=0)
+        desc, val = best_mu(Xc, 0.0, step=0.1)
+        assert (p.norm_muA, p.muA) == (desc, val)
+        assert not p.sketch_info_["sketched"]
+
+    def test_sketched_mu_is_upper_bound_and_cached(self, run, monkeypatch):
+        from sq_learn_tpu.ops.quantum import best_mu
+
+        monkeypatch.setenv("SQ_SKETCH_ROWS", "256")
+        X = _data(2000, 12, dtype=np.float64)
+        p = QPCA(n_components=4, svd_solver="full", random_state=0,
+                 compute_mu=True).fit(X)
+        assert p.sketch_info_["sketched"]
+        Xc = jnp.asarray(X) - jnp.mean(jnp.asarray(X), axis=0)
+        _, exact = best_mu(Xc, 0.0, step=0.1)
+        assert p.muA >= exact * (1 - 1e-6)
+        p2 = QPCA(n_components=4, svd_solver="full", random_state=0,
+                  compute_mu=True).fit(X)
+        assert p2.muA == p.muA
+        assert run.counters["stats_cache.hits"] == 1
+
+    def test_no_mu_fit_clears_sketch_info(self):
+        X = _data(300, 10, dtype=np.float64)
+        p = QPCA(n_components=4, svd_solver="full", random_state=0,
+                 compute_mu=True).fit(X)
+        assert p.sketch_info_ is not None
+        p.compute_mu = False
+        p.fit(X)
+        assert p.sketch_info_ is None
+
+
+class TestQLSSVCWiring:
+    def test_alpha_f_parity_and_cache(self, run):
+        from sq_learn_tpu.models import QLSSVC
+
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(60, 5))
+        y = np.where(rng.normal(size=60) > 0, 1.0, -1.0)
+        clf = QLSSVC().fit(X, y)
+        ref = float(np.sqrt(60) + 1.0 / clf.penalty
+                    + np.linalg.norm(X, ord="fro") ** 2)
+        assert clf.alpha_F_ == pytest.approx(ref, rel=1e-12)
+        QLSSVC(penalty=0.5).fit(X, y)  # same data: ‖X‖_F² served cached
+        assert run.counters["stats_cache.hits"] == 1
+
+
+# -- streaming routes --------------------------------------------------------
+
+
+class TestStreamingRoutes:
+    def test_streamed_spectral_stats_matches_host(self, monkeypatch):
+        from sq_learn_tpu.streaming import streamed_spectral_stats
+
+        monkeypatch.setenv("SQ_STREAM_TILE_BYTES", str(32 * 1024))
+        X = _data(2000, 12)
+        st_s = streamed_spectral_stats(X, GRID, sketch=256,
+                                       rng=np.random.default_rng(9))
+        st_h = engine.spectral_stats(X, GRID, sketch=256,
+                                     rng=np.random.default_rng(9),
+                                     audit=False)
+        assert st_s.sketched and st_h.sketched
+        # identical sample (same rng), cheap pass differs only in
+        # accumulation dtype (device f32 tiles vs host f64 einsum)
+        assert st_s.eta == pytest.approx(st_h.eta, rel=1e-4)
+        assert st_s.frob == pytest.approx(st_h.frob, rel=1e-4)
+        assert st_s.sigma_min == pytest.approx(st_h.sigma_min, rel=1e-4)
+        np.testing.assert_allclose(st_s.mu_upper, st_h.mu_upper, rtol=1e-3)
+
+    def test_streamed_spectral_stats_zero_budget_exact(self):
+        from sq_learn_tpu.streaming import streamed_spectral_stats
+
+        X = _data(500, 8)
+        st = streamed_spectral_stats(X, GRID)  # tiny: short-circuit
+        assert not st.sketched
+        assert st.sigma_min == float(
+            smallest_singular_value(jnp.asarray(X)))
+
+    def test_streamed_resident_put_round_trip(self, run, monkeypatch):
+        from sq_learn_tpu.streaming import streamed_resident_put
+
+        X = _data(300, 7)
+        out = streamed_resident_put(X, max_bytes=4096)
+        np.testing.assert_array_equal(np.asarray(out), X)
+        assert "streaming.assemble" in obs.watchdog.report()
+
+    def test_chunked_device_put_delegates_to_streaming(self, run):
+        from sq_learn_tpu._config import chunked_device_put
+
+        X = _data(300, 7)
+        out = chunked_device_put(X, None, max_bytes=4096)
+        np.testing.assert_array_equal(np.asarray(out), X)
+        # the deprecated wrapper now rides the supervised streaming path
+        assert "streaming.assemble" in obs.watchdog.report()
+        assert run.counters["streaming.tiles"] >= 2
+
+
+# -- observability: auditor, guarantee sites, report section ----------------
+
+
+class TestSketchObservability:
+    def test_sketched_run_audits_clean(self, run, monkeypatch):
+        monkeypatch.setenv("SQ_SKETCH_ROWS", "256")
+        X = _data(2000, 12)
+        QKMeans(n_clusters=3, delta=0.5, max_iter=5, random_state=0,
+                true_distance_estimate=False).fit(X)
+        summary = obs.guarantees.audit()
+        assert "sketch.mu" in summary
+        assert summary["sketch.mu"]["violations"] == 0
+        assert not any(a["flagged"] for a in summary.values())
+
+    def test_exact_route_records_short_circuit(self, run):
+        X = _data(600, 8)
+        QKMeans(n_clusters=3, delta=0.5, max_iter=5, random_state=0,
+                true_distance_estimate=False).fit(X)
+        sc = [g for g in run.guarantee_records
+              if g.get("site") == "sketch.stats"]
+        assert sc and all(g.get("short_circuit") for g in sc)
+        assert not any(g.get("violated") for g in sc)
+
+    def test_report_section_and_schema(self, monkeypatch, tmp_path):
+        from sq_learn_tpu.obs import report
+        from sq_learn_tpu.obs.schema import validate_jsonl
+        from sq_learn_tpu.obs.trace import load_jsonl
+
+        path = str(tmp_path / "run.jsonl")
+        monkeypatch.setenv("SQ_SKETCH_ROWS", "256")
+        obs.enable(path)
+        try:
+            X = _data(2000, 12)
+            for d in (0.5, 0.7):
+                QKMeans(n_clusters=3, delta=d, max_iter=5, random_state=0,
+                        true_distance_estimate=False).fit(X)
+        finally:
+            obs.disable()
+        result = validate_jsonl(path)
+        assert result["errors"] == []
+        summary = report.summarize(load_jsonl(path))
+        assert summary["sketch"]["cache_hits"] == 1
+        assert summary["sketch"]["estimates"] == 1
+        text = report.render(summary)
+        assert "spectral-stats cache / sketch savings" in text
+        assert "1 hits / 1 misses" in text
+
+    def test_audit_cap_skips_large_matrices(self, run, monkeypatch):
+        monkeypatch.setenv("SQ_SKETCH_AUDIT_ELEMS", "100")
+        st = engine.spectral_stats(_data(2000, 12), GRID, sketch=256)
+        assert st.sketched
+        assert not [g for g in run.guarantee_records
+                    if g.get("site") == "sketch.mu"]
