@@ -9,8 +9,8 @@ SHELL := /bin/bash
 
 .PHONY: test test-fast test-timed test-fast-tier test-slow-tier lint bench \
     bench-smoke bench-suite multichip examples \
-    hunt obs-smoke faults-smoke regress-selftest smoke obs-report \
-    obs-trace obs-frontier obs-audit regress all
+    hunt obs-smoke faults-smoke oocore-smoke regress-selftest smoke \
+    obs-report obs-trace obs-frontier obs-audit regress all
 
 all: lint test
 
@@ -118,8 +118,18 @@ faults-smoke:
 regress-selftest:
 	$(PYTHON) -m sq_learn_tpu.obs regress --selftest
 
-# All contract smokes (observability + resilience + regression gate).
-smoke: obs-smoke faults-smoke regress-selftest
+# Out-of-core smoke: tiny shard store -> fault-injected multi-epoch fit
+# (read_fail + corrupt_shard absorbed with bit parity) -> REAL subprocess
+# SIGKILL mid-epoch -> resume from the mid-epoch checkpoint -> bit-parity
+# assert vs the uninterrupted fit, plus schema validation of the read-
+# fault JSONL. The CI-runnable contract check for sq_learn_tpu.oocore.
+oocore-smoke:
+	env SQ_OBS=1 SQ_OBS_PATH=/tmp/sq_oocore_smoke.jsonl \
+	    $(PYTHON) -m sq_learn_tpu.oocore.smoke
+
+# All contract smokes (observability + resilience + out-of-core +
+# regression gate).
+smoke: obs-smoke faults-smoke oocore-smoke regress-selftest
 
 # Render the human report / Chrome trace of an obs JSONL artifact
 # (default: the obs-smoke artifact; override with OBS=<path>).
@@ -140,9 +150,11 @@ obs-frontier:
 	$(PYTHON) -m sq_learn_tpu.obs frontier $(OBS)
 
 # Perf-regression gate, standalone: run the headline bench, the PR 6
-# fused-fit bench (classical 70k×784 q-means), AND the PR 7 δ=0.5
+# fused-fit bench (classical 70k×784 q-means), the PR 7 δ=0.5
 # 70k×784 headline (sketched spectral stats — the line whose band pins
-# the sketch engine's win) under SQ_OBS=1 and band every line (latency,
+# the sketch engine's win), AND the PR 8 out-of-core fit (100k×784 shard
+# store over a 96 MB RAM budget, with the killed-and-resumed leg) under
+# SQ_OBS=1 and band every line (latency,
 # compile_count, total_transfer_bytes, peak HBM) against the committed
 # BENCH_r*.json trajectory + bench/records history. Exit 1 on any red
 # verdict. CI runs this after the timed tiers (widened latency tolerance
@@ -155,6 +167,9 @@ regress:
 	    >> /tmp/sq_regress_bench.json
 	env SQ_OBS=1 SQ_OBS_PATH=/tmp/sq_regress_mnist_obs.jsonl \
 	    $(PYTHON) -m bench.bench_qkmeans_mnist \
+	    >> /tmp/sq_regress_bench.json
+	env SQ_OBS=1 SQ_OBS_PATH=/tmp/sq_regress_oocore_obs.jsonl \
+	    $(PYTHON) -m bench.bench_oocore_fit \
 	    >> /tmp/sq_regress_bench.json
 	cat /tmp/sq_regress_bench.json
 	$(PYTHON) -m sq_learn_tpu.obs regress /tmp/sq_regress_bench.json --root .

@@ -1,0 +1,150 @@
+"""Out-of-core fit bench (PR 8): multi-epoch mini-batch q-means over a
+disk-backed shard store LARGER than an enforced host-RAM budget
+(``SQ_OOC_RAM_BUDGET_BYTES``), on the CPU backend.
+
+Measures the three numbers the out-of-core story lives on:
+
+- **wall-clock** of the uninterrupted 2-epoch fit (the JSON line's
+  value, banded by ``make regress``);
+- **peak RSS delta** across the fit — the proof the dataset never
+  materialized (a resident fit would grow RSS by the store size);
+- **resume overhead**: the same fit killed mid-epoch-2 by an injected
+  interrupt, then resumed from its mid-epoch checkpoint — the extra
+  wall-clock a death costs, with bit-parity asserted against the
+  uninterrupted result.
+
+vs_baseline = in-RAM host fit seconds / out-of-core seconds (<1 ⇒ the
+disk pass costs more than residency, the expected direction; the point
+is bounded memory, not speed). SQ_BENCH_SMOKE=1 shrinks the store to
+seconds while keeping every code path (budget guard, faults, resume).
+"""
+
+import json
+import os
+import resource
+import shutil
+import sys
+import tempfile
+import time
+import warnings
+
+import numpy as np
+
+warnings.filterwarnings("ignore")
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from bench._common import emit, timed  # noqa: E402
+
+
+def _rss_bytes():
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from sq_learn_tpu import oocore
+    from sq_learn_tpu.models import MiniBatchQKMeans
+    from sq_learn_tpu.resilience import faults
+    from sq_learn_tpu.resilience.faults import InjectedInterrupt
+
+    smoke = os.environ.get("SQ_BENCH_SMOKE") == "1"
+    if smoke:
+        n, m, k, batch = 20_000, 64, 8, 1024
+        shard_bytes, budget = 256 * 1024, 1 << 20
+    else:
+        n, m, k, batch = 100_000, 784, 10, 2048
+        shard_bytes, budget = 16 << 20, 96 << 20
+
+    tmp = tempfile.mkdtemp(prefix="sq_oocore_bench_")
+    ckpt_dir = os.path.join(tmp, "ckpt")
+    os.makedirs(ckpt_dir)
+    try:
+        build_s, store = timed(
+            oocore.create_synthetic_store, os.path.join(tmp, "store"),
+            n, m, n_classes=k, seed=0, shard_bytes=shard_bytes,
+            warmup=0, reps=1)
+        assert store.nbytes > budget, "store must exceed the RAM budget"
+
+        est_kw = dict(n_clusters=k, batch_size=batch, max_iter=2,
+                      max_no_improvement=None, tol=0.0, n_init=1,
+                      compute_labels=False, random_state=0)
+
+        os.environ["SQ_OOC_RAM_BUDGET_BYTES"] = str(budget)
+        # the budget guard must refuse a whole-store materialization
+        try:
+            store.read_rows(0, n)
+            budget_guard = False
+        except oocore.RamBudgetError:
+            budget_guard = True
+
+        rss0 = _rss_bytes()
+        # warmup=1: the first walk pays the cold page cache for every
+        # shard; the timed legs (uninterrupted vs killed+resumed) must
+        # compare warm-to-warm or the resume overhead goes negative
+        fit_s, est = timed(
+            lambda: MiniBatchQKMeans(**est_kw).fit(store),
+            warmup=1, reps=1)
+        rss_delta = _rss_bytes() - rss0
+
+        # killed-and-resumed leg: mid-epoch-2 interrupt, checkpointed
+        # every 8 batches, resume must be bit-identical
+        os.environ["SQ_STREAM_CKPT_DIR"] = ckpt_dir
+        os.environ["SQ_STREAM_CKPT_EVERY"] = "8"
+        n_batches = -(-n // batch)
+        faults.arm(f"abort:tile={n_batches + 2},times=1")
+        t0 = time.perf_counter()
+        try:
+            MiniBatchQKMeans(**est_kw).fit(store)
+            raise RuntimeError("injected interrupt did not fire")
+        except InjectedInterrupt:
+            pass
+        dead_s = time.perf_counter() - t0
+        faults.disarm()
+        resume_s, est_r = timed(
+            lambda: MiniBatchQKMeans(**est_kw).fit(store),
+            warmup=0, reps=1)
+        parity = bool(np.array_equal(est.cluster_centers_,
+                                     est_r.cluster_centers_))
+        del os.environ["SQ_STREAM_CKPT_DIR"]
+        del os.environ["SQ_STREAM_CKPT_EVERY"]
+
+        # in-RAM baseline: lift the budget, materialize, same config
+        del os.environ["SQ_OOC_RAM_BUDGET_BYTES"]
+        X = store.read_rows(0, n)
+        ram_s, _ = timed(lambda: MiniBatchQKMeans(**est_kw).fit(X),
+                         warmup=0, reps=1)
+
+        art_dir = os.environ.get("SQ_OOC_BENCH_ARTIFACT_DIR")
+        if art_dir:
+            # run_suite.sh archives the store manifest next to the
+            # config's obs JSONL — the record stays traceable to the
+            # exact shard split and CRCs it measured
+            shutil.copy(os.path.join(store.path, "manifest.json"),
+                        os.path.join(art_dir, "oocore_manifest.json"))
+
+        emit(f"oocore_minibatch_{n // 1000}kx{m}_k{k}_2epoch_wallclock",
+             fit_s, vs_baseline=(ram_s / fit_s),
+             store_mb=round(store.nbytes / 2**20, 1),
+             ram_budget_mb=round(budget / 2**20, 1),
+             budget_guard=budget_guard,
+             peak_rss_mb=round(_rss_bytes() / 2**20, 1),
+             peak_rss_delta_mb=round(rss_delta / 2**20, 1),
+             oocore_resident=bool(rss_delta < store.nbytes),
+             build_s=round(build_s, 3), ram_fit_s=round(ram_s, 3),
+             dead_fit_s=round(dead_s, 3), resume_fit_s=round(resume_s, 3),
+             resume_overhead_s=round(dead_s + resume_s - fit_s, 3),
+             resume_parity=parity, n_shards=store.n_shards,
+             smoke=smoke)
+        if not parity:
+            print(json.dumps({"error": "resume parity violated"}),
+                  file=sys.stderr)
+            return 1
+        return 0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

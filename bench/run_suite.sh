@@ -114,6 +114,13 @@ run_and_record() {  # run_and_record <timeout_s> <header> <cmd...>; returns the 
 # baseline_kind="derived" since its vs_baseline is a scaling ratio, not
 # a measured-sklearn ratio. Small config, so it rides in the
 # small-config-first block.
+# bench_oocore_fit (PR 8) is CPU/disk-only (no accelerator transfers to
+# wedge) and runs last; SQ_OOC_BENCH_ARTIFACT_DIR makes it archive the
+# shard-store manifest next to its obs JSONL, and the generic
+# resilience-record extraction below captures its injected read faults —
+# so the committed record stays traceable to the exact shard split and
+# fault schedule it measured.
+export SQ_OOC_BENCH_ARTIFACT_DIR="$obs_dir"
 for cmd in "python bench.py" \
            "python -m bench.bench_ipe_digits" \
            "env SQ_BENCH_SMOKE=1 python -m bench.bench_streaming_ingest" \
@@ -122,7 +129,8 @@ for cmd in "python bench.py" \
            "python -m bench.bench_qkmeans_cicids_sweep" \
            "python -m bench.bench_qpca_mnist" \
            "python -m bench.bench_qkmeans_mnist" \
-           "python -m bench.bench_qkmeans_fused_fit"; do
+           "python -m bench.bench_qkmeans_fused_fit" \
+           "python -m bench.bench_oocore_fit"; do
   if ! run_and_record 600 "$cmd" $cmd; then
     # mid-run tunnel wedge (or any accelerator failure): record the CPU
     # fallback number instead of nothing. PYTHONPATH is cleared so the
@@ -152,10 +160,13 @@ env -u PYTHONPATH timeout 60 python -m sq_learn_tpu.obs frontier \
   || echo "# (no tradeoff records this run)" >> "$obs_dir/frontier.txt"
 
 # BASELINE acceptance gate (bench/_gate.py: vs_baseline >= 0.5 on every
-# line, 7 measured + 2 derived lines expected — the sixth measured line
+# line, 8 measured + 2 derived lines expected — the sixth measured line
 # is the streaming-ingest smoke config, whose baseline is the monolithic
 # ingest of the same fit; the seventh is the PR 6 fused-fit config
 # (classical 70k×784 q-means vs sklearn on the SAME δ=0 configuration);
+# the eighth is the PR 8 out-of-core config, whose baseline is the
+# in-RAM fit of the same store — vs_baseline >= 0.5 reads "fitting from
+# disk under a RAM budget costs at most 2x residency";
 # the derived pair is bench_ipe_digits and the
 # sharded-scaling smoke config; missing/null = fail). This
 # script is where the bar is enforced — the unit suite only warns, since
@@ -164,7 +175,7 @@ env -u PYTHONPATH timeout 60 python -m sq_learn_tpu.obs frontier \
 # pre-imports jax via the axon sitecustomize and would hang on a wedged
 # relay even though this step only parses JSON; -m bench._gate resolves
 # via cwd, which is the repo root here)
-env -u PYTHONPATH timeout 60 python -m bench._gate "$out" 7 2
+env -u PYTHONPATH timeout 60 python -m bench._gate "$out" 8 2
 gate_rc=$?
 echo "# acceptance gate rc=$gate_rc" >> "$out"
 echo "done: $out"

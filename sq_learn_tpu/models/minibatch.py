@@ -409,7 +409,14 @@ class MiniBatchQKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
     @with_device_scope
     def fit(self, X, y=None, sample_weight=None):
         from .. import obs as _obs
+        from ..streaming import is_row_source
 
+        if is_row_source(X):
+            # out-of-core: the dataset lives as a shard store and never
+            # materializes — the resumable multi-epoch engine
+            # (sq_learn_tpu.oocore.fit) replaces the padded resident
+            # shuffle; validation is the store's manifest + per-read CRCs
+            return self._fit_store(X, sample_weight)
         X = self._validated_X(X)
         self.n_features_in_ = X.shape[1]
         if X.shape[0] < self.n_clusters:
@@ -536,6 +543,135 @@ class MiniBatchQKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
             self.inertia_ = float(inertia)
         return self
 
+    def _store_mode(self):
+        """Resolve (delta, window) for a store-backed fit: the host
+        epoch engine expresses the classic and δ-means error models
+        (exactly the CPU fast path's envelope); IPE is a device-kernel
+        model with no host twin, so it cannot run out-of-core."""
+        delta = self._delta()
+        mode = self._mode(delta)
+        if mode not in ("classic", "delta"):
+            raise ValueError(
+                "store-backed fits support the classic (delta=0) and "
+                "delta-means error models; true_distance_estimate/IPE "
+                "needs a resident array")
+        if delta == 0:
+            warnings.warn("Attention! You are running the classic version "
+                          "of mini-batch k-means (delta=0).")
+        return delta, (delta if mode == "delta" else 0.0)
+
+    def _store_seed(self):
+        """Integer seed for the epoch engine's keyed RNG streams (an
+        integral random_state passes through; anything else derives from
+        the estimator key)."""
+        if isinstance(self.random_state, numbers.Integral):
+            return int(self.random_state)
+        key = as_key(self.random_state)
+        return int(np.asarray(jax.random.key_data(key)).ravel()[-1])
+
+    def _fit_store(self, store, sample_weight):
+        """Multi-epoch fit over a shard store (ROADMAP item 3): epochs of
+        the deterministic shard-shuffled batch walk, mid-epoch
+        checkpoints at every batch boundary (``SQ_STREAM_CKPT_DIR``), a
+        SIGKILL'd fit resumes bit-for-bit. ``max_iter`` counts epochs,
+        as in the in-RAM loop."""
+        from .. import obs as _obs
+        from .. import oocore as _ooc
+
+        if sample_weight is not None:
+            raise ValueError(
+                "store-backed fits take no per-row sample_weight (the "
+                "store has no aligned resident weight array); materialize "
+                "the data to use weights")
+        n, m = store.shape
+        self.n_features_in_ = m
+        if n < self.n_clusters:
+            raise ValueError(
+                f"n_samples={n} should be >= n_clusters={self.n_clusters}.")
+        delta, window = self._store_mode()
+        # tolerance scale from the manifest's build-time column stats —
+        # the O(n·m) variance pass the in-RAM path folds into prestats
+        tol_ = 0.0 if self.tol == 0 else float(self.tol) * store.var_mean()
+        init = (np.asarray(self.init) if hasattr(self.init, "__array__")
+                else None)
+        if isinstance(self.init, str) and self.init == "random":
+            raise ValueError(
+                "store-backed fits init with 'k-means++' (subsampled) or "
+                "an explicit center array")
+        with _obs.span("minibatch.fit_store", n_samples=n, n_features=m,
+                       n_clusters=self.n_clusters) as sp:
+            out = _ooc.minibatch_epoch_fit(
+                store, n_clusters=self.n_clusters,
+                batch_rows=self.batch_size, max_epochs=self.max_iter,
+                seed=self._store_seed(), window=window,
+                reassignment_ratio=float(self.reassignment_ratio),
+                tol=tol_, max_no_improvement=self.max_no_improvement,
+                init=init, verbose=self.verbose)
+            sp.set(backend="host", n_steps=out["n_steps"],
+                   resumed_from=out["resumed_from"] or None)
+        self.cluster_centers_ = np.asarray(out["centers"], np.float32)
+        self.counts_ = np.asarray(out["counts"], np.float32)
+        self.n_iter_ = int(out["n_epochs"])
+        self.n_steps_ = int(out["n_steps"])
+        self.fit_backend_ = "host"
+        if self.compute_labels:
+            labels, inertia = _ooc.assign_labels(
+                store, self.cluster_centers_,
+                batch_rows=max(self.batch_size, 1024))
+            self.labels_ = labels
+            self.inertia_ = float(inertia)
+        return self
+
+    def _partial_fit_store(self, store):
+        """One incremental epoch over the store: each call walks a fresh
+        deterministic shuffle (the epoch index is the number of store
+        epochs this estimator has consumed) and advances the same
+        centers/counts state ``partial_fit`` batches would."""
+        from .. import obs as _obs
+        from .. import oocore as _ooc
+        from ..oocore.fit import _init_centers
+
+        n, m = store.shape
+        self.n_features_in_ = m
+        _, window = self._store_mode()
+        seed = self._store_seed()
+        b = min(self.batch_size, n)
+        epoch = int(getattr(self, "_store_epochs_", 0))
+        if not hasattr(self, "cluster_centers_"):
+            init = (np.asarray(self.init)
+                    if hasattr(self.init, "__array__") else None)
+            centers = _init_centers(store, self.n_clusters, b, seed, init)
+            counts = np.zeros(self.n_clusters, np.float64)
+            self.n_steps_ = 0
+        else:
+            centers = np.ascontiguousarray(self.cluster_centers_,
+                                           np.float32)
+            counts = np.asarray(self.counts_, np.float64)
+        plan = _ooc.EpochPlan(seed=seed, batch_rows=b)
+        with _obs.span("minibatch.partial_fit_store", epoch=epoch,
+                       n_samples=n) as sp:
+            for bi, Xb in plan.iter_batches(store, epoch):
+                Xb = np.ascontiguousarray(Xb, np.float32)
+                wb = np.ones(Xb.shape[0], np.float32)
+                xsqb = np.einsum("ij,ij->i", Xb, Xb)
+                rng = np.random.default_rng((seed, epoch, bi, 0xBA7C))
+                centers, counts, _ = _host_minibatch_step(
+                    rng, Xb, wb, xsqb, centers, counts,
+                    int(getattr(self, "n_steps_", 0)), window=window,
+                    reassignment_ratio=float(self.reassignment_ratio))
+                self.n_steps_ = int(getattr(self, "n_steps_", 0)) + 1
+            sp.set(backend="host", n_steps=self.n_steps_)
+        self._store_epochs_ = epoch + 1
+        self.cluster_centers_ = np.asarray(centers, np.float32)
+        self.counts_ = np.asarray(counts, np.float32)
+        self.fit_backend_ = "host"
+        if self.compute_labels:
+            labels, inertia = _ooc.assign_labels(
+                store, self.cluster_centers_, batch_rows=max(b, 1024))
+            self.labels_ = labels
+            self.inertia_ = float(inertia)
+        return self
+
     def _resolve_init_size(self, b, n):
         """Upstream init_size resolution (default 3·batch_size; values
         below n_clusters warn and fall back to 3·n_clusters; clamp to
@@ -638,7 +774,14 @@ class MiniBatchQKMeans(TransformerMixin, ClusterMixin, BaseEstimator):
         """Incremental update from one batch — the checkpointable streaming
         API (reference ``_dmeans.py:2139``)."""
         from .. import obs as _obs
+        from ..streaming import is_row_source
 
+        if is_row_source(X):
+            if sample_weight is not None:
+                raise ValueError(
+                    "store-backed partial_fit takes no per-row "
+                    "sample_weight (no aligned resident weight array)")
+            return self._partial_fit_store(X)
         # sklearn's partial_fit contract: reject before touching state
         X = check_n_features(self, self._validated_X(X))
         self.n_features_in_ = X.shape[1]

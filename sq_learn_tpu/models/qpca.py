@@ -455,7 +455,19 @@ class QPCA(TransformerMixin, BaseEstimator):
             if hasattr(self, attr):
                 delattr(self, attr)
 
-        X = self._validated_X(X, copy=self.copy)
+        from ..streaming import is_row_source
+
+        if is_row_source(X):
+            # out-of-core: a shard store streams tile-by-tile through
+            # the partial-U Gram route (the only route that never needs
+            # X resident); validation is the store's manifest + per-read
+            # CRCs, so check_array has nothing to scan
+            if self.mesh is not None:
+                raise ValueError(
+                    "store-backed qPCA fits are single-device (the "
+                    "sharded streamed route takes host arrays)")
+        else:
+            X = self._validated_X(X, copy=self.copy)
         self.n_features_in_ = X.shape[1]
         from .._config import dispatch_tiny_routed, route_tiny_fit_to_host
 
@@ -494,8 +506,14 @@ class QPCA(TransformerMixin, BaseEstimator):
             self.quantum_retained_variance or self.theta_estimate
             or self.estimate_all or self.estimate_least_k
             or self.spectral_norm_est or self.condition_number_est)
+        from ..streaming import is_row_source
+
         solver = self.svd_solver
-        if solver == "auto":
+        if solver == "auto" and is_row_source(X):
+            # a shard store streams through the full-solver Gram route;
+            # the truncated path materializes X for its range finder
+            solver = "full"
+        elif solver == "auto":
             if quantum_requested:
                 # the QADRA estimators need the full spectrum; the truncated
                 # path would silently drop every quantum kwarg
@@ -616,6 +634,27 @@ class QPCA(TransformerMixin, BaseEstimator):
             raise ValueError(
                 f"ingest must be 'auto', 'monolithic' or 'streamed', got "
                 f"{self.ingest!r}")
+        from ..streaming import is_row_source
+
+        if is_row_source(X):
+            # a shard store has no resident form: it MUST take the
+            # streamed partial-U Gram route, so the structural
+            # requirements become hard errors instead of fallbacks
+            if self.ingest == "monolithic":
+                raise ValueError(
+                    "ingest='monolithic' cannot materialize a shard "
+                    "store; store-backed fits stream")
+            if not (solver == "full" and not self._need_mu()
+                    and isinstance(n_components, numbers.Integral)
+                    and n_components > 0
+                    and self._partial_u_route(n_components, *X.shape)):
+                raise ValueError(
+                    "store-backed qPCA fits require the streamed "
+                    "partial-U Gram route: svd_solver='full' (or 'auto'),"
+                    " integral n_components > 0, n_samples >= "
+                    "8*n_features, and no QADRA estimator (mu(A) needs "
+                    "the resident centered matrix)")
+            return True
         if self.ingest == "monolithic":
             return False
         import jax as _jax

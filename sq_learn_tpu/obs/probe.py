@@ -90,16 +90,35 @@ def _cache_read(platform):
 
 
 def _cache_write(outcome, latency_s, platform):
-    """Persist a fresh real-probe result for sibling processes (atomic
-    write; a full disk must not break the probe)."""
+    """Persist a fresh real-probe result for sibling processes.
+
+    Atomic AND durable: a per-call-unique temp file (``mkstemp`` —
+    pid-suffixed names still collide between THREADS of one process,
+    where one writer's truncate can race another's rename) is fsynced
+    before the atomic rename, so a concurrent reader — sibling bench
+    process or probing thread — only ever observes a complete JSON
+    document, never a partial or empty one, and a crash after the rename
+    cannot lose the data pages. Best-effort: a full disk must not break
+    the probe."""
+    import tempfile
+
     try:
         path = _cache_path()
-        tmp = f"{path}.{os.getpid()}.tmp"
-        with open(tmp, "w") as fh:
-            json.dump({"outcome": outcome, "latency_s": round(latency_s, 3),
-                       "platform": platform, "ts": round(time.time(), 3)},
-                      fh)
-        os.replace(tmp, path)
+        fd, tmp = tempfile.mkstemp(
+            dir=os.path.dirname(path) or ".",
+            prefix=os.path.basename(path) + ".", suffix=".tmp")
+        try:
+            with os.fdopen(fd, "w") as fh:
+                json.dump({"outcome": outcome,
+                           "latency_s": round(latency_s, 3),
+                           "platform": platform,
+                           "ts": round(time.time(), 3)}, fh)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, path)
+        except Exception:
+            os.unlink(tmp)
+            raise
     except Exception:
         pass
 

@@ -28,7 +28,10 @@ watchdog   site (str), compiles (int ≥ 0), budget (int | null),
 probe      outcome (str ∈ {ok, timeout, error, cpu, skipped}),
            latency_s (number ≥ 0), platform (str); optional cached (bool)
 fault      kind (str), tile (int | null) — one injected fault from the
-           ``SQ_FAULTS`` harness (:mod:`sq_learn_tpu.resilience.faults`)
+           ``SQ_FAULTS`` harness (:mod:`sq_learn_tpu.resilience.faults`);
+           for the read-side kinds (``read_fail`` / ``read_stall`` /
+           ``corrupt_shard``) ``tile`` carries the SHARD index of the
+           out-of-core store (:mod:`sq_learn_tpu.oocore`)
 breaker    state (str ∈ {closed, open, half_open}), prev (str),
            reason (str), consecutive (int ≥ 0) — one circuit-breaker
            transition (:mod:`sq_learn_tpu.resilience.supervisor`)
@@ -58,6 +61,14 @@ tradeoff   sweep (str), point (number), accuracy (number),
            accuracy_metric (str), budget (object: str → number),
            attrs (object)
 =========  ==============================================================
+
+The out-of-core layer (PR 8) rides the generic types rather than minting
+new ones: shard-store reads surface as ``counter`` records
+(``oocore.shard_reads`` / ``oocore.shard_read_bytes`` /
+``oocore.crc_failures`` / ``oocore.rereads``) and ``span`` records
+(``oocore.create_store`` / ``oocore.minibatch_fit`` / ``oocore.epoch`` /
+``oocore.assign_labels``), and read faults are ``fault`` records — one
+schema reads every layer.
 
 The validator is hand-rolled (no jsonschema in the image — CLAUDE.md: no
 installs) and is the contract ``make obs-smoke``, the bench suite, and the

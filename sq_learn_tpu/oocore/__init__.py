@@ -1,0 +1,39 @@
+"""Out-of-core shard store + crash-resumable multi-epoch streaming.
+
+The package behind ROADMAP item 3: datasets larger than host RAM live as
+CRC-manifested memmap shards (:mod:`.store`), deterministic epoch plans
+schedule multi-pass batch walks over them (:mod:`.epochs`), and the
+resumable mini-batch engine (:mod:`.fit`) survives a SIGKILL mid-epoch
+bit-for-bit. The streaming engine (:mod:`sq_learn_tpu.streaming`) reads
+stores directly — ``stream_fold`` and the Gram-route consumers accept a
+:class:`ShardStore` wherever they accept a host array — and
+:class:`~sq_learn_tpu.models.minibatch.MiniBatchQKMeans` /
+:class:`~sq_learn_tpu.models.qpca.QPCA` fit straight off disk.
+
+``make oocore-smoke`` runs the acceptance scenario end to end (store
+build → fault-injected multi-epoch fit → real SIGKILL → resume → bit
+parity); ``docs/resilience.md`` §out-of-core and
+``docs/fit_pipeline.md`` §epoch-plans document the design and knobs
+(``SQ_OOC_SHARD_BYTES`` / ``SQ_OOC_VERIFY`` / ``SQ_OOC_REREAD_MAX`` /
+``SQ_OOC_RAM_BUDGET_BYTES``).
+"""
+
+from .epochs import EpochPlan
+from .fit import assign_labels, minibatch_epoch_fit
+from .store import (ArraySource, RamBudgetError, ShardCorruptionError,
+                    ShardStore, create_synthetic_store, is_source,
+                    open_store, store_from_array)
+
+__all__ = [
+    "ArraySource",
+    "EpochPlan",
+    "RamBudgetError",
+    "ShardCorruptionError",
+    "ShardStore",
+    "assign_labels",
+    "create_synthetic_store",
+    "is_source",
+    "minibatch_epoch_fit",
+    "open_store",
+    "store_from_array",
+]

@@ -1,0 +1,89 @@
+"""Epoch plans: deterministic multi-pass batch schedules over a shard
+store, resumable at any batch boundary.
+
+A full-dataset shuffle of a 10M-row store cannot be a resident
+permutation (the index array alone is 80 MB, and gathering it would
+random-access every shard per batch). The plan here is the standard
+out-of-core compromise, made bit-reproducible:
+
+- **shard-order shuffle**: each epoch visits the shards in an order drawn
+  from an RNG keyed on ``(seed, epoch)``;
+- **within-shard shuffle**: each shard's rows are permuted by an RNG
+  keyed on ``(seed, epoch, shard)``;
+- the epoch's virtual row sequence is the concatenation of the permuted
+  shards in the shuffled order, and **batch j is rows
+  [j·b, (j+1)·b) of that sequence** — so a batch touches at most the two
+  shards its window spans, and host RAM holds one shard plus the batch.
+
+Every RNG stream is keyed, never sequential, so the schedule for
+``(seed, epoch, batch)`` is a pure function — which is what makes
+**mid-epoch resume bit-for-bit**: restarting iteration at batch ``B``
+(``start_batch``) skips the shards wholly before the resume point
+without reading them and replays the exact remaining batch sequence an
+uninterrupted run would have produced.
+"""
+
+import numpy as np
+
+__all__ = ["EpochPlan"]
+
+
+class EpochPlan:
+    """The deterministic multi-epoch batch schedule over a row source
+    (:class:`~sq_learn_tpu.oocore.store.ShardStore` or
+    :class:`~sq_learn_tpu.oocore.store.ArraySource`)."""
+
+    def __init__(self, seed=0, batch_rows=1024):
+        self.seed = int(seed)
+        self.batch_rows = int(batch_rows)
+        if self.batch_rows < 1:
+            raise ValueError(f"batch_rows must be >= 1, got {batch_rows}")
+
+    def n_batches(self, n_rows):
+        return -(-int(n_rows) // self.batch_rows)
+
+    def shard_order(self, source, epoch):
+        rng = np.random.default_rng((self.seed, int(epoch), 0xE90C))
+        return rng.permutation(source.n_shards)
+
+    def shard_perm(self, source, epoch, shard):
+        rng = np.random.default_rng(
+            (self.seed, int(epoch), int(shard), 0x5E0))
+        return rng.permutation(source.shard_sizes[int(shard)])
+
+    def iter_batches(self, source, epoch, start_batch=0):
+        """Yield ``(batch_index, batch_rows_array)`` for one epoch,
+        starting at ``start_batch`` (the resume cursor). The tail batch
+        carries the real remainder rows (no padding — host consumers
+        take any batch length). Shards wholly before the resume point
+        are skipped without being read."""
+        n = source.shape[0]
+        b = self.batch_rows
+        skip = int(start_batch) * b
+        if skip >= n:
+            return
+        chunks, have = [], 0
+        bi = int(start_batch)
+        for s in self.shard_order(source, epoch):
+            rows_s = source.shard_sizes[int(s)]
+            if skip >= rows_s:
+                skip -= rows_s
+                continue
+            perm = self.shard_perm(source, epoch, s)
+            if skip:
+                perm = perm[skip:]
+                skip = 0
+            arr = source.read_shard(int(s))[perm]
+            chunks.append(arr)
+            have += arr.shape[0]
+            while have >= b:
+                block = chunks[0] if len(chunks) == 1 \
+                    else np.concatenate(chunks, axis=0)
+                yield bi, block[:b]
+                rest = block[b:]
+                chunks, have = ([rest], rest.shape[0]) if rest.size \
+                    else ([], 0)
+                bi += 1
+        if have:
+            yield bi, (chunks[0] if len(chunks) == 1
+                       else np.concatenate(chunks, axis=0))

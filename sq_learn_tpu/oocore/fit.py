@@ -1,0 +1,239 @@
+"""Crash-resumable multi-epoch mini-batch fit over a row source.
+
+This is the consumer the shard store exists for: the host mini-batch
+q-means engine (:func:`~sq_learn_tpu.models.minibatch._host_minibatch_step`
+— fused BLAS E+M partials, Sculley move, low-count reassignment) driven
+by an :class:`~sq_learn_tpu.oocore.epochs.EpochPlan` instead of a
+resident padded shuffle. Three properties the in-RAM loop does not have:
+
+- **bounded residency**: each batch is assembled from at most two
+  shards; the dataset never materializes.
+- **keyed batch RNG**: every batch's stochasticity (δ-window sampling,
+  reassignment picks) draws from an RNG keyed on
+  ``(seed, epoch, batch)`` — a pure function of the schedule, never a
+  sequential stream — so any suffix of the fit can be replayed from any
+  batch boundary.
+- **mid-epoch checkpoints**: with a checkpoint configured
+  (``SQ_STREAM_CKPT_DIR`` or explicit), the full loop state (centers,
+  counts, EWA early-stop state, epoch/batch cursor) is snapshotted every
+  ``SQ_STREAM_CKPT_EVERY`` batches through
+  :func:`~sq_learn_tpu.utils.checkpoint.save_stream_state` (atomic,
+  torn-write-hardened), keyed on a fingerprint that folds in the store's
+  **content-complete** manifest fingerprint. A SIGKILL'd fit rerun with
+  the same arguments resumes at the last snapshot and finishes
+  **bit-for-bit identical** to an uninterrupted run (the npz round-trip
+  is lossless and the replayed batches are the same pure functions).
+
+The epoch boundary the ROADMAP asked for is therefore just the cursor:
+epochs are not a special checkpoint shape, every batch boundary is one.
+"""
+
+import os
+
+import numpy as np
+
+from .. import obs as _obs
+from ..resilience import faults as _faults
+from .epochs import EpochPlan
+
+__all__ = ["assign_labels", "minibatch_epoch_fit"]
+
+_FMT = "oocore-mbfit-v1"
+
+
+def _state_template(k, m):
+    """The checkpointable loop state as a flat dict pytree of arrays
+    (scalars as 0-d arrays: the npz round-trip must be lossless and
+    structure-stable)."""
+    return {
+        "batch": np.zeros((), np.int64),
+        "best_ewa": np.asarray(np.inf, np.float64),
+        "centers": np.zeros((k, m), np.float32),
+        "counts": np.zeros((k,), np.float64),
+        "epoch": np.zeros((), np.int64),
+        "ewa": np.asarray(np.nan, np.float64),
+        "no_improve": np.zeros((), np.int64),
+        "prev_centers": np.full((k, m), np.nan, np.float32),
+        "step": np.zeros((), np.int64),
+    }
+
+
+def _init_centers(source, k, batch_rows, seed, init):
+    """k-means++ on a keyed-RNG row subsample (or the caller's explicit
+    array). The subsample is a shard-grouped gather, so init reads a few
+    shards, not the store."""
+    from ..models.qkmeans import _kmeans_plusplus_np
+
+    n, m = source.shape
+    if init is not None:
+        centers = np.ascontiguousarray(init, np.float32)
+        if centers.shape != (k, m):
+            raise ValueError(
+                f"init centers shape {centers.shape} != ({k}, {m})")
+        return centers
+    rng = np.random.default_rng((int(seed), 0x1A17))
+    isize = min(n, max(3 * int(batch_rows), 3 * int(k)))
+    idx = np.unique(rng.integers(0, n, isize))
+    Xs = np.ascontiguousarray(source.take(idx), np.float32)
+    xsq = np.einsum("ij,ij->i", Xs, Xs)
+    return _kmeans_plusplus_np(rng, Xs, xsq, k,
+                               np.ones(Xs.shape[0], np.float32))
+
+
+def _fingerprint(source, k, b, max_epochs, seed, window, ratio, tol,
+                 max_no_improvement, init):
+    """Checkpoint identity: config plus the source's content-complete
+    fingerprint — a mutated shard, a different schedule, or a different
+    error budget can never resume a stale snapshot."""
+    import zlib
+
+    init_tag = ("kpp" if init is None else
+                f"arr:{zlib.crc32(np.ascontiguousarray(init)) & 0xFFFFFFFF:08x}")
+    return (f"{_FMT}|data={source.fingerprint}|shape={tuple(source.shape)}"
+            f"|dtype={source.dtype}|k={k}|b={b}|epochs={max_epochs}"
+            f"|seed={seed}|window={window}|ratio={ratio}|tol={tol}"
+            f"|mni={max_no_improvement}|init={init_tag}")
+
+
+def minibatch_epoch_fit(source, *, n_clusters, batch_rows=1024,
+                        max_epochs=10, seed=0, window=0.0,
+                        reassignment_ratio=0.01, tol=0.0,
+                        max_no_improvement=10, init=None, checkpoint=None,
+                        verbose=0):
+    """Run the resumable multi-epoch fit; returns a dict with ``centers``
+    (k, m) f32, ``counts`` (k,) f64, ``n_epochs`` (epochs entered),
+    ``n_steps`` (batches consumed), ``ewa`` and ``resumed_from`` (the
+    batch-cursor a checkpoint restored, 0 for a fresh run).
+
+    ``tol`` here is the ABSOLUTE center-shift threshold (the estimator
+    scales its ``tol`` hyperparameter by the store's variance first).
+    Early stop follows the in-RAM loop: per-batch EWA-inertia
+    no-improvement count plus the per-epoch center shift."""
+    from ..models.minibatch import _host_minibatch_step
+    from ..streaming import _resolve_checkpoint
+    from ..utils.checkpoint import load_stream_state, save_stream_state
+
+    n, m = source.shape
+    k = int(n_clusters)
+    if n < k:
+        raise ValueError(f"n_samples={n} should be >= n_clusters={k}.")
+    b = min(int(batch_rows), n)
+    plan = EpochPlan(seed=seed, batch_rows=b)
+    n_batches = plan.n_batches(n)
+    alpha = 2.0 * b / (n + 1)
+
+    state = _state_template(k, m)
+    ckpt = _resolve_checkpoint(checkpoint, "oocore.minibatch_fit")
+    fingerprint = _fingerprint(source, k, b, int(max_epochs), int(seed),
+                               float(window), float(reassignment_ratio),
+                               float(tol), max_no_improvement, init)
+    resumed_from = 0
+    loaded = None
+    if ckpt is not None:
+        loaded = load_stream_state(ckpt.path, state, fingerprint)
+    if loaded is not None:
+        state = loaded[0]
+        resumed_from = int(loaded[1])
+        _obs.gauge("resilience.resume_cursor", resumed_from,
+                   site="oocore.minibatch_fit")
+        _obs.counter_add("resilience.resumed_passes", 1)
+    else:
+        state["centers"] = _init_centers(source, k, b, seed, init)
+
+    every = ckpt.every if ckpt is not None else 0
+    stop = False
+    with _obs.span("oocore.minibatch_fit", n=n, m=m, k=k,
+                   n_batches=n_batches, resumed_from=resumed_from or None):
+        for epoch in range(int(state["epoch"]), int(max_epochs)):
+            with _obs.span("oocore.epoch", epoch=epoch):
+                for bi, Xb in plan.iter_batches(source, epoch,
+                                                int(state["batch"])):
+                    if _faults._active is not None:
+                        # batch-boundary interrupt hook: the abort
+                        # injector kills an epoch fit exactly like it
+                        # kills a streamed pass
+                        _faults._active.on_tile(int(state["step"]))
+                    Xb = np.ascontiguousarray(Xb, np.float32)
+                    wb = np.ones(Xb.shape[0], np.float32)
+                    xsqb = np.einsum("ij,ij->i", Xb, Xb)
+                    rng = np.random.default_rng(
+                        (int(seed), epoch, bi, 0xBA7C))
+                    centers, counts, inertia = _host_minibatch_step(
+                        rng, Xb, wb, xsqb, state["centers"],
+                        state["counts"], int(state["step"]),
+                        window=float(window),
+                        reassignment_ratio=float(reassignment_ratio))
+                    state["centers"] = np.asarray(centers, np.float32)
+                    state["counts"] = np.asarray(counts, np.float64)
+                    state["step"] += 1
+                    state["batch"] = np.asarray(bi + 1, np.int64)
+                    ewa = (inertia if np.isnan(state["ewa"])
+                           else float(state["ewa"]) * (1 - alpha)
+                           + inertia * alpha)
+                    state["ewa"] = np.asarray(ewa, np.float64)
+                    if ewa < float(state["best_ewa"]) - 1e-12:
+                        state["best_ewa"] = np.asarray(ewa, np.float64)
+                        state["no_improve"] = np.zeros((), np.int64)
+                    else:
+                        state["no_improve"] += 1
+                    if (every and int(state["step"]) % every == 0
+                            and not (epoch == int(max_epochs) - 1
+                                     and bi + 1 >= n_batches)):
+                        save_stream_state(ckpt.path, state,
+                                          int(state["step"]), fingerprint)
+            if verbose:
+                print(f"oocore epoch {epoch + 1}: "
+                      f"ewa inertia {float(state['ewa']):.3f}")
+            if (max_no_improvement is not None
+                    and int(state["no_improve"]) >= max_no_improvement):
+                stop = True
+            prev = state["prev_centers"]
+            if not np.isnan(prev).all() and tol > 0:
+                shift = float(((state["centers"] - prev) ** 2).sum())
+                if shift <= tol:
+                    stop = True
+            state["prev_centers"] = state["centers"].copy()
+            state["epoch"] = np.asarray(epoch + 1, np.int64)
+            state["batch"] = np.zeros((), np.int64)
+            if stop:
+                break
+    if ckpt is not None:
+        # a finished fit must not leave snapshots a rerun could resume
+        for path in (ckpt.path, str(ckpt.path) + ".prev"):
+            if os.path.exists(path):
+                os.remove(path)
+    return {
+        "centers": state["centers"],
+        "counts": state["counts"],
+        "n_epochs": int(state["epoch"]),
+        "n_steps": int(state["step"]),
+        "ewa": float(state["ewa"]),
+        "resumed_from": resumed_from,
+    }
+
+
+def assign_labels(source, centers, *, batch_rows=8192):
+    """Deterministic full-store labeling pass (the ``compute_labels``
+    epilogue): argmin distances batch-by-batch in natural row order,
+    returning ``(labels (n,) int32, inertia float)``. Reads are
+    supervised/verified like every store access; nothing resides beyond
+    one batch."""
+    from .. import native
+
+    n, m = source.shape
+    centers = np.ascontiguousarray(centers, np.float32)
+    labels = np.empty(n, np.int32)
+    inertia = 0.0
+    rng = np.random.default_rng(0)  # unused: e_only is deterministic
+    with _obs.span("oocore.assign_labels", n=n, m=m):
+        for start in range(0, n, int(batch_rows)):
+            stop = min(n, start + int(batch_rows))
+            Xb = np.ascontiguousarray(source.read_rows(start, stop),
+                                      np.float32)
+            wb = np.ones(Xb.shape[0], np.float32)
+            xsqb = np.einsum("ij,ij->i", Xb, Xb)
+            lb, _, _, _, bi = native.host_lloyd_step(
+                rng, Xb, wb, xsqb, centers, 0.0, e_only=True)
+            labels[start:stop] = lb
+            inertia += float(bi)
+    return labels, inertia

@@ -1,0 +1,183 @@
+"""Out-of-core smoke: the ISSUE 8 acceptance scenario end to end.
+
+``make oocore-smoke`` runs this module on the CPU backend:
+
+1. build a tiny deterministic synthetic shard store;
+2. a **fault-free** multi-epoch mini-batch fit (the reference result);
+3. the same fit under ``read_fail`` (one transient shard-read failure —
+   the supervisor's retry absorbs it) plus ``corrupt_shard`` (a
+   corrupted materialization the manifest CRC must catch, quarantine,
+   and recover through the bounded re-read) — the faulted fit must match
+   the reference **bit-for-bit**;
+4. a REAL subprocess kill: a child process runs the same fit with
+   mid-epoch checkpoints under injected read stalls (so the parent can
+   catch it mid-flight), the parent SIGKILLs it the moment the first
+   checkpoint lands, and a clean rerun **resumes from the checkpoint**
+   and finishes bit-identical to the reference;
+5. schema validation of the emitted JSONL: the read-side ``fault``
+   records and the ``oocore.*`` counters must be present and valid.
+
+Exit code 0 = contract holds; 1 = violation (printed as JSON). Pins the
+CPU backend in-process first, like every resilience check.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+#: one fit configuration, shared verbatim by every leg (reference,
+#: faulted, killed child, resumed child) — parity only means anything if
+#: the schedule fingerprint is identical
+FIT = dict(n_clusters=6, batch_rows=256, max_epochs=4, seed=5)
+STORE = dict(n_samples=6000, n_features=32, n_classes=6, seed=11)
+
+
+def _child(store_path, out_path):
+    """Child mode: run the fit (checkpointing via the inherited
+    ``SQ_STREAM_CKPT_DIR`` env) and save the result."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from . import minibatch_epoch_fit, open_store
+
+    out = minibatch_epoch_fit(open_store(store_path), **FIT)
+    np.savez(out_path, centers=out["centers"], counts=out["counts"],
+             resumed_from=np.asarray(out["resumed_from"]))
+    return 0
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from ..obs import disable, enable, get_recorder
+    from ..obs.schema import validate_jsonl
+    from ..resilience import faults
+    from . import create_synthetic_store, minibatch_epoch_fit, open_store
+
+    path = os.environ.get("SQ_OBS_PATH", "/tmp/sq_oocore_smoke.jsonl")
+    open(path, "w").close()
+    enable(path)
+
+    tmp = tempfile.mkdtemp(prefix="sq_oocore_smoke_")
+    store_path = os.path.join(tmp, "store")
+    ckpt_dir = os.path.join(tmp, "ckpt")
+    os.makedirs(ckpt_dir)
+    out_path = os.path.join(tmp, "resumed.npz")
+
+    failures = []
+
+    def check(cond, msg):
+        if not cond:
+            failures.append(msg)
+
+    store = create_synthetic_store(store_path, shard_bytes=64 * 1024,
+                                   **STORE)
+    reference = minibatch_epoch_fit(store, **FIT)
+
+    # -- read faults: transient failure + corruption, absorbed with
+    # bit parity ------------------------------------------------------------
+    plan = faults.arm("read_fail:tiles=1,times=1;"
+                      "corrupt_shard:tiles=2,times=1")
+    faulted = minibatch_epoch_fit(open_store(store_path), **FIT)
+    faults.disarm()
+    check(any(ev["kind"] == "read_fail" for ev in plan.events),
+          "no transient read failure was injected")
+    check(any(ev["kind"] == "corrupt_shard" for ev in plan.events),
+          "no shard corruption was injected")
+    check(np.array_equal(faulted["centers"], reference["centers"]),
+          "fault-injected fit diverged from the fault-free fit")
+    rec = get_recorder()
+    check(rec.counters.get("oocore.rereads", 0) >= 1,
+          "corrupted shard was not re-read")
+    check(rec.counters.get("oocore.crc_failures", 0) >= 1,
+          "manifest CRC did not catch the corruption")
+
+    # -- the real kill: SIGKILL mid-epoch, then resume ----------------------
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               SQ_STREAM_CKPT_DIR=ckpt_dir,
+               SQ_STREAM_CKPT_EVERY="2",
+               SQ_OBS="0",
+               # every shard read stalls 0.1 s so the parent reliably
+               # catches the child mid-epoch — the CI-scaled wedge
+               SQ_FAULTS="read_stall:p=1,s=0.1,times=999")
+    cmd = [sys.executable, "-m", "sq_learn_tpu.oocore.smoke", "--child",
+           store_path, out_path]
+    child = subprocess.Popen(cmd, env=env, stdout=subprocess.DEVNULL,
+                             stderr=subprocess.DEVNULL)
+    def _ckpts():
+        # the atomic-write temp ("*.npz.tmp.npz") is transient — only a
+        # completed rename counts as "a checkpoint landed"
+        return [os.path.join(ckpt_dir, f) for f in os.listdir(ckpt_dir)
+                if f.endswith(".npz") and not f.endswith(".tmp.npz")]
+
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline and child.poll() is None:
+        if _ckpts():
+            break
+        time.sleep(0.01)
+    if child.poll() is None:
+        child.send_signal(signal.SIGKILL)
+    rc = child.wait()
+    ckpt_file = (sorted(_ckpts()) or [None])[0]
+    check(rc == -signal.SIGKILL,
+          f"child was not SIGKILLed mid-fit (rc={rc}; a 0 means it "
+          f"finished before the kill — stalls too short)")
+    check(ckpt_file is not None and os.path.exists(ckpt_file),
+          "killed child left no checkpoint behind")
+    check(not os.path.exists(out_path),
+          "killed child somehow wrote its result")
+    cursor = None
+    if ckpt_file:
+        with np.load(ckpt_file, allow_pickle=False) as npz:
+            cursor = int(npz["__cursor__"])
+        check(cursor >= 1, f"checkpoint cursor {cursor} is pre-first-batch")
+
+    env_resume = dict(env)
+    env_resume.pop("SQ_FAULTS")  # clean rerun: no stalls, same ckpt dir
+    rc = subprocess.run(cmd, env=env_resume, stdout=subprocess.DEVNULL,
+                        stderr=subprocess.DEVNULL, timeout=600).returncode
+    check(rc == 0, f"resume run failed (rc={rc})")
+    if rc == 0:
+        with np.load(out_path, allow_pickle=False) as npz:
+            check(int(npz["resumed_from"]) >= 1,
+                  "rerun did not resume from the checkpoint")
+            check(np.array_equal(npz["centers"], reference["centers"]),
+                  "resumed fit diverged from the uninterrupted fit")
+            check(np.array_equal(npz["counts"], reference["counts"]),
+                  "resumed counts diverged from the uninterrupted fit")
+    check(not os.listdir(ckpt_dir),
+          "completed fit left checkpoint files behind")
+
+    rec = disable()
+    summary = validate_jsonl(path)
+    failures.extend(summary["errors"])
+    by_type = summary["by_type"]
+    if by_type.get("fault", 0) < 2:
+        failures.append(f"expected >=2 fault records, got {by_type}")
+
+    print(json.dumps({
+        "oocore_smoke": "fail" if failures else "ok",
+        "path": path,
+        "jsonl": by_type,
+        "kill_cursor": cursor,
+        "fault_events": len(rec.fault_events),
+        "errors": failures,
+    }))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    if "--child" in sys.argv:
+        i = sys.argv.index("--child")
+        sys.exit(_child(sys.argv[i + 1], sys.argv[i + 2]))
+    sys.exit(main())

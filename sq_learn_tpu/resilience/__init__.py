@@ -8,12 +8,16 @@ interrupts — CLAUDE.md's observed incident catalogue):
 
 - :mod:`.faults` — deterministic, env-armed (``SQ_FAULTS=<spec>``)
   injectors for transfer failures/stalls, NaN-corrupted tiles, mid-pass
-  interrupts, and probe timeouts, so every observed failure mode is
+  interrupts, probe timeouts, and — for the out-of-core shard store —
+  read failures/stalls and shard corruption (``read_fail`` /
+  ``read_stall`` / ``corrupt_shard``), so every observed failure mode is
   reproducible in CI on the CPU backend.
 - :mod:`.supervisor` — bounded retries + keyed exponential backoff +
-  per-tile deadlines around every streamed ``device_put``, and the
-  probe-fed circuit breaker that routes work to the in-process CPU escape
-  after K consecutive failures.
+  per-tile deadlines around every streamed ``device_put`` (:func:`~.
+  supervisor.put`) AND every shard-store disk read
+  (:func:`~.supervisor.supervised_read`), and the probe-fed circuit
+  breaker that routes work to the in-process CPU escape after K
+  consecutive failures.
 - Resumable streaming passes live in :mod:`sq_learn_tpu.streaming`
   (``SQ_STREAM_CKPT_DIR``): host-snapshotted accumulator + tile cursor
   every M tiles via :mod:`sq_learn_tpu.utils.checkpoint`, so a wedge
@@ -34,13 +38,14 @@ Full docs: ``docs/resilience.md``.
 
 from . import faults, supervisor
 from .faults import (FaultSpecError, InjectedFault, InjectedInterrupt,
-                     InjectedTransferError)
+                     InjectedReadError, InjectedTransferError)
 from .supervisor import NonFiniteAccumulatorError, breaker
 
 __all__ = [
     "FaultSpecError",
     "InjectedFault",
     "InjectedInterrupt",
+    "InjectedReadError",
     "InjectedTransferError",
     "NonFiniteAccumulatorError",
     "breaker",

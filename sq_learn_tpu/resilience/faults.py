@@ -51,6 +51,22 @@ Kinds and their params (every param optional unless noted):
 ``probe_timeout``
     The next ``n=1`` device-health probes report ``"timeout"`` without
     spawning a subprocess — feeds the circuit breaker the wedge signal.
+``read_fail``
+    Transient shard-read failure: raises :class:`InjectedReadError` from
+    the supervised read path (:func:`~sq_learn_tpu.resilience.supervisor.
+    supervised_read`) — the disk-side twin of ``put_fail``, absorbed by
+    the same retry loop. Selection params as for ``put_fail`` (the tile
+    index is the shard index).
+``read_stall``
+    Shard-read stall: sleeps ``s=0.25`` seconds inside the timed read
+    attempt, so a deadline shorter than ``s`` counts a breaker timeout —
+    a dying disk's leading edge, scaled down to CI.
+``corrupt_shard``
+    Shard corruption: the materialized shard's first bytes are flipped
+    AFTER the read, so the manifest-CRC verification in
+    :meth:`sq_learn_tpu.oocore.store.ShardStore.read_shard` must detect
+    it, quarantine the shard, and recover through the bounded re-read
+    (``times=N`` injections, then clean reads).
 
 Example: ``SQ_FAULTS="put_fail:tiles=2,times=1;probe_timeout:n=2"``.
 
@@ -68,6 +84,7 @@ __all__ = [
     "FaultSpecError",
     "InjectedFault",
     "InjectedInterrupt",
+    "InjectedReadError",
     "InjectedTransferError",
     "active",
     "arm",
@@ -75,7 +92,8 @@ __all__ = [
     "get_plan",
 ]
 
-_KINDS = ("put_fail", "put_stall", "nan", "abort", "probe_timeout")
+_KINDS = ("put_fail", "put_stall", "nan", "abort", "probe_timeout",
+          "read_fail", "read_stall", "corrupt_shard")
 
 
 class FaultSpecError(ValueError):
@@ -89,6 +107,11 @@ class InjectedFault(RuntimeError):
 
 class InjectedTransferError(InjectedFault):
     """A transient device_put failure (the supervisor retries these)."""
+
+
+class InjectedReadError(InjectedTransferError):
+    """A transient shard-read failure (retried exactly like a transfer
+    failure — the supervisor's transient classification is shared)."""
 
 
 class InjectedInterrupt(InjectedFault):
@@ -242,6 +265,35 @@ class FaultPlan:
                 raise InjectedTransferError(
                     f"injected transient transfer failure at tile "
                     f"{tile_index}")
+
+    def on_read(self, shard_index):
+        """Pre-read hook inside the supervisor's timed read attempt
+        (disk-side twin of :meth:`on_put`): stalls sleep, transient
+        failures raise."""
+        for inj in self._by_kind("read_stall"):
+            if inj.matches(shard_index):
+                self._record("read_stall", shard_index, stall_s=inj.stall_s)
+                time.sleep(inj.stall_s)
+        for inj in self._by_kind("read_fail"):
+            if inj.matches(shard_index):
+                self._record("read_fail", shard_index)
+                raise InjectedReadError(
+                    f"injected transient shard-read failure at shard "
+                    f"{shard_index}")
+
+    def corrupt_read(self, arr, shard_index):
+        """Flip the first bytes of a materialized shard (returns the
+        array, corrupted or not) — the payload the manifest-CRC check
+        must catch. Byte-level, so any dtype corrupts."""
+        import numpy as np
+
+        for inj in self._by_kind("corrupt_shard"):
+            if inj.matches(shard_index):
+                self._record("corrupt_shard", shard_index)
+                arr = np.array(arr, copy=True)
+                view = arr.view(np.uint8).reshape(-1)
+                view[:8] ^= 0xFF
+        return arr
 
     def corrupt(self, tile, tile_index):
         """NaN-poison the selected tiles' payload (returns the tile,

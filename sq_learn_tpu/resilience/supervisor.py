@@ -60,6 +60,7 @@ __all__ = [
     "backoff_delay",
     "breaker",
     "put",
+    "supervised_read",
 ]
 
 CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
@@ -304,6 +305,61 @@ def _pre_retry(exc, site, attempt, tile_index):
 
     recorder.counter_add("resilience.retries", 1)
     time.sleep(backoff_delay(attempt, tile_index))
+
+
+def supervised_read(read_fn, index=0, site=None):
+    """Run one supervised disk read ``read_fn()`` — the shard-store twin
+    of :func:`put`, sharing the whole supervision contract: transient
+    errors (``OSError`` — the real disk-flake shape — and injected
+    :class:`~.faults.InjectedReadError`) retry with keyed backoff and
+    feed the breaker; an attempt that outlives ``SQ_TILE_DEADLINE_S``
+    counts a breaker timeout (a stalling read is a dying disk's leading
+    edge exactly as a stalling upload is the relay wedge's). The fast
+    path (no faults armed, breaker closed) is one ``perf_counter`` pair
+    around the raw read; armed ``read_stall``/``read_fail`` injectors
+    hook the timed attempt. ``index`` is the shard index — the
+    provenance retries and breaker records carry.
+    """
+    if _faults._active is None and breaker._state == CLOSED:
+        t0 = time.perf_counter()
+        try:
+            out = read_fn()
+        except Exception as exc:
+            if not _is_transient(exc):
+                raise
+            _pre_retry(exc, site, 0, index)
+            return _read_supervised(read_fn, index, site, first_attempt=1)
+        elapsed = time.perf_counter() - t0
+        if elapsed > _deadline_s():
+            breaker.record_timeout(site=site, elapsed=elapsed)
+        elif breaker._consecutive:
+            breaker.record_success()
+        return out
+    return _read_supervised(read_fn, index, site)
+
+
+def _read_supervised(read_fn, index, site, first_attempt=0):
+    plan = _faults._active
+    deadline = _deadline_s()
+    attempt = first_attempt
+    while True:
+        try:
+            t0 = time.perf_counter()
+            if plan is not None:
+                plan.on_read(index)  # may stall (timed) or raise
+            out = read_fn()
+        except Exception as exc:
+            if not _is_transient(exc):
+                raise
+            _pre_retry(exc, site, attempt, index)  # raises on last
+            attempt += 1
+            continue
+        elapsed = time.perf_counter() - t0
+        if elapsed > deadline:
+            breaker.record_timeout(site=site, elapsed=elapsed)
+        else:
+            breaker.record_success()
+        return out
 
 
 def _put_supervised(put_fn, tile, tile_index, site, first_attempt=0):

@@ -67,6 +67,7 @@ from .resilience import supervisor as _sup
 
 __all__ = [
     "StreamCheckpoint",
+    "is_row_source",
     "stream_tile_bytes",
     "plan_row_tiles",
     "stream_tiles",
@@ -99,13 +100,27 @@ def stream_tile_bytes():
     return _TRANSFER_CHUNK_BYTES
 
 
+def is_row_source(X):
+    """True for out-of-core row sources (the shard-store protocol:
+    ``shape``/``dtype``/``nbytes``/``fingerprint``/``read_rows`` —
+    :mod:`sq_learn_tpu.oocore`). Duck-typed here so the streaming engine
+    never imports oocore; a source's rows are read straight from disk
+    per tile instead of sliced from a resident ndarray."""
+    return all(hasattr(X, a) for a in
+               ("shape", "dtype", "nbytes", "fingerprint", "read_rows"))
+
+
 def worth_streaming(X, max_bytes=None):
     """True when ``X`` is host data large enough that a monolithic upload
     would exceed the per-tile transfer cap — the 'auto' engagement rule
     every streamed consumer shares. jax Arrays are already placed (their
-    upload, if any, already happened); only host numpy data streams."""
+    upload, if any, already happened); only host numpy data streams.
+    A disk-backed row source always streams: it has no resident form to
+    upload monolithically."""
     if isinstance(X, jax.Array):
         return False
+    if is_row_source(X):
+        return True
     nbytes = getattr(X, "nbytes", None)
     if nbytes is None:
         return False
@@ -161,6 +176,11 @@ def stream_tiles(X, max_bytes=None, device=None, put=None, multiple=1,
     issued before tile *i* is yielded (i.e. before the consumer dispatches
     tile *i*'s kernel), and nothing blocks between tiles — on an
     accelerator the next upload overlaps the current tile's compute.
+    ``X`` may also be an out-of-core row source (:func:`is_row_source` —
+    a :class:`~sq_learn_tpu.oocore.ShardStore`): each tile is then read
+    straight from disk (supervised, CRC-verified shard reads) instead of
+    sliced from a resident ndarray, so the dataset never materializes on
+    the host either.
 
     Tiles are zero-padded to bucketed row counts (:func:`_bucket_rows`);
     ``n_valid`` is the true row count of each tile and ``start`` its row
@@ -178,11 +198,14 @@ def stream_tiles(X, max_bytes=None, device=None, put=None, multiple=1,
     ``streaming.transfer_bytes``/``streaming.tiles`` counters and each
     planned (bucket, dtype) signature raises the site's compile budget.
     """
-    X = np.asarray(X)
+    source = is_row_source(X)
+    if not source:
+        X = np.asarray(X)
     # canonicalize on the host exactly like chunked_device_put: without it
     # the f64→f32 cast would happen device-side, doubling the upload
+    # (sources canonicalize at build time; a foreign one casts per tile)
     canonical = jax.dtypes.canonicalize_dtype(X.dtype)
-    if X.dtype != canonical:
+    if not source and X.dtype != canonical:
         X = X.astype(canonical)
     n = X.shape[0]
     rows, n_tiles = plan_row_tiles(n, X.nbytes // max(1, n), max_bytes,
@@ -202,9 +225,12 @@ def stream_tiles(X, max_bytes=None, device=None, put=None, multiple=1,
         stop = min(start + rows, n)
         valid = stop - start
         bucket = _bucket_rows(valid, rows, multiple)
-        tile = X[start:stop]
+        tile = X.read_rows(start, stop) if source else X[start:stop]
+        if tile.dtype != canonical:
+            tile = tile.astype(canonical)
         if valid < bucket:
-            pad = np.zeros((bucket - valid,) + X.shape[1:], X.dtype)
+            pad = np.zeros((bucket - valid,) + tuple(X.shape[1:]),
+                           tile.dtype)
             tile = np.concatenate([tile, pad], axis=0)
         if observing:
             _obs.counter_add("streaming.transfer_bytes", int(tile.nbytes))
@@ -251,7 +277,11 @@ def _data_digest(Xn, max_rows=64):
     pass. It is NOT content-complete: rows between sample points can in
     principle differ undetected, so callers who rewrite data in place
     between runs should clear ``SQ_STREAM_CKPT_DIR`` rather than rely on
-    the digest (datasets with ≤ ``max_rows`` rows ARE hashed fully)."""
+    the digest (datasets with ≤ ``max_rows`` rows ARE hashed fully).
+    Store-backed passes never use this sample: their fingerprint is the
+    manifest's content-complete per-shard-CRC digest (see
+    :func:`stream_fold`), so the caveat is closed for the out-of-core
+    path."""
     import zlib
 
     n = Xn.shape[0]
@@ -344,10 +374,14 @@ def stream_fold(X, step, init, *, max_bytes=None, device=None, put=None,
     uninterrupted pass: the npz round-trip is lossless and the remaining
     tiles replay the same kernels in the same order.
     """
-    Xn = np.asarray(X)
-    canonical = jax.dtypes.canonicalize_dtype(Xn.dtype)
-    if Xn.dtype != canonical:
-        Xn = Xn.astype(canonical)
+    source = is_row_source(X)
+    if source:
+        Xn = X  # out-of-core: rows are read per tile, never materialized
+    else:
+        Xn = np.asarray(X)
+        canonical = jax.dtypes.canonicalize_dtype(Xn.dtype)
+        if Xn.dtype != canonical:
+            Xn = Xn.astype(canonical)
     if device is not None:
         init = jax.tree.map(lambda a: jax.device_put(a, device), init)
     acc = init
@@ -363,10 +397,16 @@ def stream_fold(X, step, init, *, max_bytes=None, device=None, put=None,
                                        multiple)
         # v2: the data digest grew from first/last-row to a strided
         # sample — the version bump keeps a v1 checkpoint from ever
-        # matching by coincidence
-        fingerprint = (f"v2|{site}|tag={pass_tag}|shape={Xn.shape}"
+        # matching by coincidence. Store-backed passes use the manifest's
+        # CONTENT-COMPLETE fingerprint (CRC over every shard's CRC)
+        # instead of the strided sample: any interior mutation of any
+        # shard invalidates the checkpoint, closing the documented
+        # _data_digest caveat for the out-of-core path.
+        data = (f"store:{Xn.fingerprint}" if source
+                else f"{_data_digest(Xn):08x}")
+        fingerprint = (f"v2|{site}|tag={pass_tag}|shape={tuple(Xn.shape)}"
                        f"|dtype={Xn.dtype}|rows={rows}|multiple={multiple}"
-                       f"|data={_data_digest(Xn):08x}")
+                       f"|data={data}")
         loaded = load_stream_state(ckpt.path, init, fingerprint)
         if loaded is not None:
             host_acc, start_tile = loaded
@@ -389,10 +429,13 @@ def stream_fold(X, step, init, *, max_bytes=None, device=None, put=None,
             if ckpt is not None and i < n_tiles and i % ckpt.every == 0:
                 host = jax.tree.map(lambda a: np.asarray(a), acc)
                 save_stream_state(ckpt.path, host, i, fingerprint)
-    if ckpt is not None and os.path.exists(ckpt.path):
+    if ckpt is not None:
         # a finished pass must not leave state a LATER same-tagged pass
-        # (or a rerun) could mistake for its own mid-pass snapshot
-        os.remove(ckpt.path)
+        # (or a rerun) could mistake for its own mid-pass snapshot — the
+        # torn-write fallback copy included
+        for stale in (ckpt.path, str(ckpt.path) + ".prev"):
+            if os.path.exists(stale):
+                os.remove(stale)
     if _obs.enabled() and site is not None and site in _KERNEL_SITES:
         # track() is idempotent (first call anchors the compile baseline);
         # re-calling here covers a recorder enabled mid-pass
@@ -612,7 +655,8 @@ def streamed_centered_gram(X, *, max_bytes=None, device=None,
     fine at explained-variance scale, not for σ ≈ 0 tails of badly
     uncentered data). ``checkpoint`` (or ``SQ_STREAM_CKPT_DIR``) makes
     the Gram pass resumable — see :func:`stream_fold`."""
-    X = np.asarray(X)
+    if not is_row_source(X):
+        X = np.asarray(X)
     n, m = X.shape
     dtype = jax.dtypes.canonicalize_dtype(X.dtype)
     init = (jnp.zeros((m, m), dtype), jnp.zeros((m,), dtype))
@@ -645,7 +689,8 @@ def streamed_centered_svd_topk(X, n_left, *, compute_dtype=None,
     """
     from .ops.linalg import gram_spectrum, svd_flip_v
 
-    X = np.asarray(X)
+    if not is_row_source(X):
+        X = np.asarray(X)
     n, m = X.shape
     mean, Gc, _ = streamed_centered_gram(X, max_bytes=max_bytes,
                                          device=device)

@@ -177,44 +177,80 @@ def save_stream_state(path, acc, cursor, fingerprint):
     tile plan, pass sequence — that :func:`load_stream_state` matches
     against so a stale file can never resume the wrong pass).
 
-    The write is atomic (temp file + ``os.replace``): a wedge or kill
-    mid-write leaves the previous checkpoint intact, never a torn one —
-    the whole point is surviving exactly that kind of death.
+    The write is torn-write-hardened in three steps: the temp file is
+    **fsynced** before it is renamed (a crash after ``os.replace`` must
+    never surface a file whose data pages were still in the page cache),
+    the previous checkpoint is **retained** as ``<path>.prev`` rather
+    than overwritten, and only then does the new file take the primary
+    name. A SIGKILL at ANY instant therefore leaves at least one
+    complete, durable snapshot for :func:`load_stream_state` — the whole
+    point is surviving exactly that kind of death.
     """
     leaves, _ = jax.tree_util.tree_flatten(acc)
     arrays = {f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)}
     arrays["__cursor__"] = np.asarray(int(cursor))
     arrays["__fingerprint__"] = np.asarray(str(fingerprint))
     tmp = str(path) + ".tmp.npz"
-    np.savez(tmp, **arrays)
+    with open(tmp, "wb") as fh:
+        np.savez(fh, **arrays)
+        fh.flush()
+        os.fsync(fh.fileno())
+    if os.path.exists(path):
+        os.replace(path, str(path) + ".prev")
     os.replace(tmp, path)
     return path
+
+
+def _read_stream_state(path, like, fingerprint):
+    """One checkpoint-file read attempt. Returns ``("ok", payload)``,
+    ``("absent", None)``, ``("corrupt", None)`` (unreadable/truncated/
+    structurally wrong — the torn-write shapes), or
+    ``("mismatch", None)`` (a complete checkpoint of a DIFFERENT pass —
+    never fall back past it: its ``.prev`` sibling is older still)."""
+    if not os.path.exists(path):
+        return "absent", None
+    try:
+        npz = np.load(path, allow_pickle=False)
+    except Exception:
+        return "corrupt", None
+    try:
+        with npz:
+            if ("__fingerprint__" not in npz.files
+                    or "__cursor__" not in npz.files):
+                return "corrupt", None
+            if str(npz["__fingerprint__"]) != str(fingerprint):
+                return "mismatch", None
+            treedef = jax.tree_util.tree_structure(like)
+            n = sum(1 for k in npz.files if k.startswith("leaf_"))
+            if treedef.num_leaves != n:
+                return "mismatch", None
+            leaves = [npz[f"leaf_{i}"] for i in range(n)]
+            cursor = int(npz["__cursor__"])
+    except Exception:
+        # a zip central directory can parse while a member is truncated:
+        # the torn tail surfaces here, on the member read
+        return "corrupt", None
+    return "ok", (jax.tree_util.tree_unflatten(treedef, leaves), cursor)
 
 
 def load_stream_state(path, like, fingerprint):
     """Load a streamed-pass checkpoint saved by :func:`save_stream_state`.
 
     Returns ``(acc_tree, cursor)`` with ``acc_tree`` unflattened against
-    the structure of ``like`` (leaf values ignored), or ``None`` when the
-    file is absent, unreadable, or carries a different ``fingerprint`` /
-    leaf count — a mismatched checkpoint is silently ignored (the pass
-    simply starts fresh), never trusted.
+    the structure of ``like`` (leaf values ignored), or ``None`` when no
+    usable checkpoint exists. A newest file that is truncated/corrupt —
+    or absent while ``<path>.prev`` exists (the kill-between-renames
+    window) — falls back to the retained previous snapshot instead of
+    cold-starting: losing one checkpoint interval is recoverable, losing
+    the whole pass is the failure this file exists to prevent. A
+    complete checkpoint with a different ``fingerprint`` is a different
+    pass: ignored without fallback (its ``.prev`` is older still), never
+    trusted.
     """
-    if not os.path.exists(path):
+    status, out = _read_stream_state(path, like, fingerprint)
+    if status == "ok":
+        return out
+    if status == "mismatch":
         return None
-    try:
-        npz = np.load(path, allow_pickle=False)
-    except Exception:
-        return None
-    with npz:
-        if "__fingerprint__" not in npz.files or "__cursor__" not in npz.files:
-            return None
-        if str(npz["__fingerprint__"]) != str(fingerprint):
-            return None
-        treedef = jax.tree_util.tree_structure(like)
-        n = sum(1 for k in npz.files if k.startswith("leaf_"))
-        if treedef.num_leaves != n:
-            return None
-        leaves = [npz[f"leaf_{i}"] for i in range(n)]
-        cursor = int(npz["__cursor__"])
-    return jax.tree_util.tree_unflatten(treedef, leaves), cursor
+    status, out = _read_stream_state(str(path) + ".prev", like, fingerprint)
+    return out if status == "ok" else None

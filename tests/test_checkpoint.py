@@ -1,6 +1,8 @@
 """Checkpoint / resume tests (SURVEY §5: fitted-state serialization +
 mid-run Lloyd state recovery)."""
 
+import os
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -149,3 +151,71 @@ def test_profiling_flop_accounting(monkeypatch):
 
     monkeypatch.delenv("SQ_TPU_PEAK_FLOPS", raising=False)
     assert prof.device_peak_flops(FakeDev()) == prof.TPU_PEAK_FLOPS["v4"]
+
+
+# ---------------------------------------------------------------------------
+# stream-state torn-write hardening (ISSUE 8 satellite): fsync-before-
+# rename, .prev retention, and the corrupt-newest fallback
+# ---------------------------------------------------------------------------
+
+
+def _stream_tree():
+    return {"a": np.arange(6, dtype=np.float32).reshape(2, 3),
+            "b": np.zeros((), np.int64)}
+
+
+def test_stream_state_retains_prev_and_falls_back(tmp_path):
+    from sq_learn_tpu.utils import load_stream_state, save_stream_state
+
+    path = str(tmp_path / "ck.npz")
+    t1 = _stream_tree()
+    save_stream_state(path, t1, 4, "fp")
+    t2 = {"a": t1["a"] + 1.0, "b": np.asarray(9, np.int64)}
+    save_stream_state(path, t2, 8, "fp")
+    assert os.path.exists(path) and os.path.exists(path + ".prev")
+    tree, cursor = load_stream_state(path, _stream_tree(), "fp")
+    assert cursor == 8
+    np.testing.assert_array_equal(tree["a"], t2["a"])
+    # truncate the newest (the torn-write shape): the retained .prev
+    # must serve the pass instead of a cold start
+    with open(path, "r+b") as fh:
+        fh.truncate(12)
+    tree, cursor = load_stream_state(path, _stream_tree(), "fp")
+    assert cursor == 4
+    np.testing.assert_array_equal(tree["a"], t1["a"])
+
+
+def test_stream_state_kill_between_renames_window(tmp_path):
+    """SIGKILL between the two os.replace calls leaves only ``.prev`` —
+    the load must recover it."""
+    from sq_learn_tpu.utils import load_stream_state, save_stream_state
+
+    path = str(tmp_path / "ck.npz")
+    save_stream_state(path, _stream_tree(), 3, "fp")
+    os.replace(path, path + ".prev")  # simulate the torn window
+    tree, cursor = load_stream_state(path, _stream_tree(), "fp")
+    assert cursor == 3
+
+
+def test_stream_state_mismatch_never_falls_back(tmp_path):
+    """A COMPLETE newest checkpoint of a different pass is a different
+    pass, not a torn write: no resurrection of the older .prev."""
+    from sq_learn_tpu.utils import load_stream_state, save_stream_state
+
+    path = str(tmp_path / "ck.npz")
+    save_stream_state(path, _stream_tree(), 4, "fp-old")
+    save_stream_state(path, _stream_tree(), 8, "fp-new")
+    # .prev carries fp-old; the newest is complete but fp-different
+    assert load_stream_state(path, _stream_tree(), "fp-old") is None
+
+
+def test_stream_state_both_corrupt_cold_starts(tmp_path):
+    from sq_learn_tpu.utils import load_stream_state, save_stream_state
+
+    path = str(tmp_path / "ck.npz")
+    save_stream_state(path, _stream_tree(), 4, "fp")
+    save_stream_state(path, _stream_tree(), 8, "fp")
+    for p in (path, path + ".prev"):
+        with open(p, "wb") as fh:
+            fh.write(b"garbage")
+    assert load_stream_state(path, _stream_tree(), "fp") is None

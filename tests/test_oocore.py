@@ -1,0 +1,456 @@
+"""Out-of-core shard store + crash-resumable multi-epoch streaming
+(sq_learn_tpu.oocore — ISSUE 8's contract).
+
+Parity discipline (inherited from test_resilience): a fault-injected-
+and-recovered, interrupted-and-resumed, or disk-round-tripped
+computation must agree with its clean in-RAM twin BIT-FOR-BIT wherever
+the design promises it — the shard store's whole point is that moving
+the dataset out of RAM changes nothing but residency.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from sq_learn_tpu import obs, oocore, streaming
+from sq_learn_tpu.oocore import (ArraySource, EpochPlan, RamBudgetError,
+                                 ShardCorruptionError)
+from sq_learn_tpu.resilience import faults, supervisor
+from sq_learn_tpu.resilience.faults import (InjectedInterrupt,
+                                            InjectedReadError)
+
+RNG = np.random.default_rng(7)
+#: 2003 rows / small shards: many shards with a ragged tail (the shape
+#: discipline of test_streaming, at shard granularity)
+X_TALL = (RNG.normal(size=(2003, 16)) + 1.0).astype(np.float32)
+SHARD_BYTES = 16 * 1024  # 256 rows/shard -> 8 shards, ragged tail
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_compiled_kernels_after_module():
+    """This module streams shard-split shapes through the shared
+    streaming kernels; clear the compile caches at module teardown so
+    test_streaming's ABSOLUTE cache-size discipline pins (which predate
+    this module) still measure only their own sweep when the suite runs
+    without SQ_TEST_CLEAR_CACHES (the ROADMAP tier-1 command)."""
+    yield
+    import jax
+
+    jax.clear_caches()
+
+
+@pytest.fixture()
+def store(tmp_path):
+    return oocore.store_from_array(str(tmp_path / "store"), X_TALL,
+                                   shard_bytes=SHARD_BYTES)
+
+
+@pytest.fixture()
+def recorder(tmp_path):
+    rec = obs.enable(str(tmp_path / "obs.jsonl"))
+    yield rec
+    obs.disable()
+
+
+class TestShardStore:
+    def test_create_open_roundtrip(self, tmp_path):
+        st = oocore.create_synthetic_store(
+            str(tmp_path / "syn"), 1500, 12, n_classes=3, seed=9,
+            shard_bytes=8 * 1024)
+        st2 = oocore.open_store(str(tmp_path / "syn"))
+        assert st2.fingerprint == st.fingerprint
+        assert st2.shape == (1500, 12) and st2.dtype == np.float32
+        np.testing.assert_array_equal(st2.read_rows(0, 1500),
+                                      st.read_rows(0, 1500))
+
+    def test_synthetic_rebuild_is_bit_identical(self, tmp_path):
+        a = oocore.create_synthetic_store(
+            str(tmp_path / "a"), 800, 8, seed=4, shard_bytes=4 * 1024)
+        b = oocore.create_synthetic_store(
+            str(tmp_path / "b"), 800, 8, seed=4, shard_bytes=4 * 1024)
+        assert a.fingerprint == b.fingerprint
+        np.testing.assert_array_equal(a.read_rows(0, 800),
+                                      b.read_rows(0, 800))
+
+    def test_read_rows_across_shards(self, store):
+        # slices spanning 2+ shard boundaries, ragged tail included
+        for lo, hi in [(0, 2003), (250, 600), (700, 701), (1900, 2003)]:
+            np.testing.assert_array_equal(store.read_rows(lo, hi),
+                                          X_TALL[lo:hi])
+        np.testing.assert_array_equal(store[250:600], X_TALL[250:600])
+
+    def test_take_gather(self, store):
+        idx = np.array([0, 255, 256, 1024, 2002])
+        np.testing.assert_array_equal(store.take(idx), X_TALL[idx])
+
+    def test_fingerprint_is_content_complete(self, tmp_path):
+        """The satellite pin: an interior mutation the strided
+        ``_data_digest`` sample MISSES still changes the store
+        fingerprint — the caveat is closed for store-backed passes."""
+        Xm = X_TALL.copy()
+        sampled = np.unique(np.linspace(0, 2002, num=64, dtype=np.int64))
+        row = next(r for r in range(2003) if r not in sampled)
+        Xm[row, 3] += 1.0
+        assert streaming._data_digest(Xm) == streaming._data_digest(X_TALL)
+        a = oocore.store_from_array(str(tmp_path / "a"), X_TALL,
+                                    shard_bytes=SHARD_BYTES)
+        b = oocore.store_from_array(str(tmp_path / "b"), Xm,
+                                    shard_bytes=SHARD_BYTES)
+        assert a.fingerprint != b.fingerprint
+
+    def test_on_disk_corruption_quarantines_and_raises(self, store):
+        # flip bytes INSIDE shard 2's data region on disk: every re-read
+        # sees the same rot, so the bounded re-read must exhaust and
+        # surface with provenance
+        path = store._shard_path(2)
+        with open(path, "r+b") as fh:
+            fh.seek(-16, os.SEEK_END)
+            fh.write(b"\xff" * 16)
+        with pytest.raises(ShardCorruptionError, match="shard 2"):
+            store.read_shard(2)
+        assert 2 in store.quarantined
+
+    def test_verify_off_trusts_bytes(self, store, monkeypatch):
+        path = store._shard_path(1)
+        with open(path, "r+b") as fh:
+            fh.seek(-16, os.SEEK_END)
+            fh.write(b"\xff" * 16)
+        monkeypatch.setenv("SQ_OOC_VERIFY", "off")
+        store.read_shard(1)  # no CRC pass, no raise — documented opt-out
+
+    def test_ram_budget_guard(self, store, monkeypatch):
+        monkeypatch.setenv("SQ_OOC_RAM_BUDGET_BYTES",
+                           str(store.nbytes // 4))
+        with pytest.raises(RamBudgetError):
+            store.read_rows(0, store.shape[0])
+        # shard-sized reads stay under the budget and work
+        np.testing.assert_array_equal(store.read_shard(0),
+                                      X_TALL[:store.shard_sizes[0]])
+
+    def test_store_slicing_rejects_gather_keys(self, store):
+        with pytest.raises(TypeError):
+            store[np.array([1, 2, 3])]
+
+
+class TestReadFaults:
+    def test_transient_read_failure_recovers_with_parity(self, store,
+                                                         recorder):
+        faults.arm("read_fail:tiles=1,times=1")
+        try:
+            arr = store.read_shard(1)
+        finally:
+            plan = faults.disarm()
+            supervisor.breaker.reset("test teardown")
+        assert any(ev["kind"] == "read_fail" for ev in plan.events)
+        np.testing.assert_array_equal(
+            arr, X_TALL[store.shard_sizes[0]:2 * store.shard_sizes[0]])
+        assert recorder.counters.get("resilience.retries", 0) >= 1
+
+    def test_read_failures_exhaust_to_terminal(self, store, monkeypatch):
+        monkeypatch.setenv("SQ_RETRY_MAX", "2")
+        monkeypatch.setenv("SQ_RETRY_BACKOFF_S", "0.001")
+        faults.arm("read_fail:tiles=0,times=10")
+        try:
+            with pytest.raises(InjectedReadError):
+                store.read_shard(0)
+        finally:
+            faults.disarm()
+            supervisor.breaker.reset("test teardown")
+
+    def test_corrupt_shard_quarantine_then_reread_recovers(self, store,
+                                                           recorder):
+        faults.arm("corrupt_shard:tiles=3,times=1")
+        try:
+            arr = store.read_shard(3)
+        finally:
+            plan = faults.disarm()
+        assert any(ev["kind"] == "corrupt_shard" for ev in plan.events)
+        lo = 3 * store.shard_sizes[0]
+        np.testing.assert_array_equal(
+            arr, X_TALL[lo:lo + store.shard_sizes[3]])
+        assert 3 not in store.quarantined  # recovered -> unquarantined
+        assert recorder.counters.get("oocore.crc_failures", 0) >= 1
+        assert recorder.counters.get("oocore.rereads", 0) >= 1
+
+    def test_persistent_corruption_exhausts_rereads(self, store,
+                                                    monkeypatch):
+        monkeypatch.setenv("SQ_OOC_REREAD_MAX", "1")
+        faults.arm("corrupt_shard:tiles=0,times=10")
+        try:
+            with pytest.raises(ShardCorruptionError):
+                store.read_shard(0)
+        finally:
+            faults.disarm()
+        assert 0 in store.quarantined
+
+    def test_read_stall_past_deadline_feeds_breaker(self, store,
+                                                    monkeypatch):
+        monkeypatch.setenv("SQ_TILE_DEADLINE_S", "0.01")
+        supervisor.breaker.reset("test setup")
+        faults.arm("read_stall:tiles=0,times=1,s=0.05")
+        try:
+            store.read_shard(0)  # data arrives, but counts as a timeout
+            assert supervisor.breaker.consecutive_failures >= 1
+        finally:
+            faults.disarm()
+            supervisor.breaker.reset("test teardown")
+
+    def test_stream_fold_over_store_absorbs_read_faults(self, store):
+        from sq_learn_tpu.streaming import streamed_centered_gram
+
+        _, G_ref, _ = streamed_centered_gram(X_TALL, max_bytes=32 * 1024)
+        faults.arm("read_fail:tiles=2,times=1;corrupt_shard:tiles=4,times=1")
+        try:
+            _, G, _ = streamed_centered_gram(store, max_bytes=32 * 1024)
+        finally:
+            faults.disarm()
+            supervisor.breaker.reset("test teardown")
+        np.testing.assert_array_equal(np.asarray(G), np.asarray(G_ref))
+
+
+class TestEpochEngine:
+    def test_epoch_covers_every_row_exactly_once(self, store):
+        plan = EpochPlan(seed=3, batch_rows=300)
+        for epoch in (0, 1):
+            seen = np.concatenate(
+                [b[:, 0] for _, b in plan.iter_batches(store, epoch)])
+            assert seen.shape[0] == 2003
+            np.testing.assert_array_equal(np.sort(seen),
+                                          np.sort(X_TALL[:, 0]))
+
+    def test_epochs_shuffle_differently(self, store):
+        plan = EpochPlan(seed=3, batch_rows=300)
+        b0 = next(iter(plan.iter_batches(store, 0)))[1]
+        b1 = next(iter(plan.iter_batches(store, 1)))[1]
+        assert not np.array_equal(b0, b1)
+
+    def test_resume_replays_identical_batches(self, store):
+        plan = EpochPlan(seed=5, batch_rows=256)
+        full = [b for _, b in plan.iter_batches(store, 2)]
+        tail = [b for _, b in plan.iter_batches(store, 2, start_batch=4)]
+        assert len(tail) == len(full) - 4
+        for a, b in zip(full[4:], tail):
+            np.testing.assert_array_equal(a, b)
+
+    def test_disk_vs_ram_source_fit_bit_parity(self, store):
+        kw = dict(n_clusters=5, batch_rows=256, max_epochs=3, seed=11)
+        disk = oocore.minibatch_epoch_fit(store, **kw)
+        ram = oocore.minibatch_epoch_fit(
+            ArraySource(X_TALL, shard_rows=store.shard_sizes[0]), **kw)
+        np.testing.assert_array_equal(disk["centers"], ram["centers"])
+        np.testing.assert_array_equal(disk["counts"], ram["counts"])
+
+    def test_interrupt_then_resume_bitwise_parity(self, store, tmp_path,
+                                                  monkeypatch):
+        monkeypatch.setenv("SQ_STREAM_CKPT_EVERY", "2")
+        ck = str(tmp_path / "mb.npz")
+        kw = dict(n_clusters=4, batch_rows=256, max_epochs=3, seed=1)
+        ref = oocore.minibatch_epoch_fit(store, **kw)
+        faults.arm("abort:tile=9,times=1")  # mid-epoch-2
+        try:
+            with pytest.raises(InjectedInterrupt):
+                oocore.minibatch_epoch_fit(store, checkpoint=ck, **kw)
+        finally:
+            faults.disarm()
+        assert os.path.exists(ck)
+        out = oocore.minibatch_epoch_fit(store, checkpoint=ck, **kw)
+        assert out["resumed_from"] >= 1
+        np.testing.assert_array_equal(out["centers"], ref["centers"])
+        np.testing.assert_array_equal(out["counts"], ref["counts"])
+        # a finished fit cleans up its snapshots, fallback copy included
+        assert not os.path.exists(ck) and not os.path.exists(ck + ".prev")
+
+    def test_mutated_store_invalidates_checkpoint(self, store, tmp_path,
+                                                  monkeypatch):
+        monkeypatch.setenv("SQ_STREAM_CKPT_EVERY", "2")
+        ck = str(tmp_path / "mb.npz")
+        kw = dict(n_clusters=4, batch_rows=256, max_epochs=2, seed=1)
+        faults.arm("abort:tile=5,times=1")
+        try:
+            with pytest.raises(InjectedInterrupt):
+                oocore.minibatch_epoch_fit(store, checkpoint=ck, **kw)
+        finally:
+            faults.disarm()
+        # same data, different shard split -> different fingerprint ->
+        # the stale snapshot must be ignored, not resumed
+        store2 = oocore.store_from_array(
+            str(tmp_path / "resharded"), X_TALL,
+            shard_bytes=2 * SHARD_BYTES)
+        out = oocore.minibatch_epoch_fit(store2, checkpoint=ck, **kw)
+        assert out["resumed_from"] == 0
+
+
+class TestEstimatorSurfaces:
+    def test_minibatch_store_fit_matches_source_twin(self, store):
+        from sq_learn_tpu.models import MiniBatchQKMeans
+
+        kw = dict(n_clusters=5, batch_size=256, max_iter=3,
+                  random_state=3)
+        with pytest.warns(UserWarning, match="classic"):
+            disk = MiniBatchQKMeans(**kw).fit(store)
+        with pytest.warns(UserWarning, match="classic"):
+            mem = MiniBatchQKMeans(**kw).fit(
+                ArraySource(X_TALL, shard_rows=store.shard_sizes[0]))
+        np.testing.assert_array_equal(disk.cluster_centers_,
+                                      mem.cluster_centers_)
+        assert disk.n_steps_ == mem.n_steps_ > 0
+        assert disk.labels_.shape == (2003,)
+        # the epoch engine must land in the same quality regime as the
+        # in-RAM padded-shuffle fit (different schedule: not bitwise)
+        with pytest.warns(UserWarning, match="classic"):
+            ram = MiniBatchQKMeans(**kw).fit(X_TALL)
+        assert disk.inertia_ <= 1.5 * ram.inertia_
+
+    def test_minibatch_store_delta_means(self, store):
+        from sq_learn_tpu.models import MiniBatchQKMeans
+
+        est = MiniBatchQKMeans(n_clusters=4, batch_size=256, max_iter=2,
+                               random_state=0, delta=0.4).fit(store)
+        assert est.cluster_centers_.shape == (4, 16)
+        assert np.isfinite(est.inertia_)
+
+    def test_minibatch_store_rejects_unsupported(self, store):
+        from sq_learn_tpu.models import MiniBatchQKMeans
+
+        with pytest.raises(ValueError, match="sample_weight"):
+            MiniBatchQKMeans(n_clusters=3).fit(
+                store, sample_weight=np.ones(2003))
+        with pytest.raises(ValueError, match="IPE"):
+            MiniBatchQKMeans(n_clusters=3, delta=0.2,
+                             true_distance_estimate=True).fit(store)
+
+    def test_minibatch_partial_fit_epochs_over_store(self, store):
+        from sq_learn_tpu.models import MiniBatchQKMeans
+
+        est = MiniBatchQKMeans(n_clusters=4, batch_size=256,
+                               random_state=0)
+        est.partial_fit(store)
+        steps1 = est.n_steps_
+        c1 = est.cluster_centers_.copy()
+        est.partial_fit(store)
+        assert est.n_steps_ == 2 * steps1
+        assert not np.array_equal(c1, est.cluster_centers_)
+        assert est.predict(X_TALL[:7]).shape == (7,)
+
+    def test_qpca_store_fit_bit_matches_streamed_array(self, store):
+        from sq_learn_tpu.models import QPCA
+
+        disk = QPCA(n_components=3, random_state=0).fit(store)
+        assert disk.ingest_ == "streamed"
+        ram = QPCA(n_components=3, random_state=0, svd_solver="full",
+                   ingest="streamed").fit(X_TALL)
+        np.testing.assert_array_equal(disk.components_, ram.components_)
+        np.testing.assert_array_equal(disk.singular_values_,
+                                      ram.singular_values_)
+        np.testing.assert_array_equal(disk.left_sv, ram.left_sv)
+        assert disk.transform(X_TALL[:5]).shape == (5, 3)
+
+    def test_qpca_store_rejects_structural_misfits(self, store):
+        from sq_learn_tpu.models import QPCA
+
+        with pytest.raises(ValueError, match="partial-U Gram route"):
+            # mu(A) needs the resident centered matrix
+            QPCA(n_components=3, random_state=0).fit(
+                store, theta_estimate=True, eps=0.1)
+        with pytest.raises(ValueError, match="monolithic"):
+            QPCA(n_components=3, ingest="monolithic",
+                 random_state=0).fit(store)
+
+
+@pytest.mark.slow
+class TestKillResume:
+    def test_sigkill_mid_epoch_then_resume_bit_parity(self, tmp_path):
+        """The acceptance pin: a REAL SIGKILL (not an in-process
+        exception) mid-epoch, then a clean rerun that must resume from
+        the mid-epoch checkpoint and finish bit-identical to an
+        uninterrupted fit."""
+        from sq_learn_tpu.oocore.smoke import FIT, STORE
+
+        store_path = str(tmp_path / "store")
+        store = oocore.create_synthetic_store(
+            store_path, shard_bytes=64 * 1024, **STORE)
+        reference = oocore.minibatch_epoch_fit(store, **FIT)
+
+        ckpt_dir = str(tmp_path / "ckpt")
+        os.makedirs(ckpt_dir)
+        out_path = str(tmp_path / "resumed.npz")
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   SQ_STREAM_CKPT_DIR=ckpt_dir, SQ_STREAM_CKPT_EVERY="2",
+                   SQ_FAULTS="read_stall:p=1,s=0.1,times=999")
+        cmd = [sys.executable, "-m", "sq_learn_tpu.oocore.smoke",
+               "--child", store_path, out_path]
+        child = subprocess.Popen(cmd, env=env,
+                                 stdout=subprocess.DEVNULL,
+                                 stderr=subprocess.DEVNULL)
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline and child.poll() is None:
+            if any(f.endswith(".npz") and not f.endswith(".tmp.npz")
+                   for f in os.listdir(ckpt_dir)):
+                break
+            time.sleep(0.01)
+        assert child.poll() is None, \
+            "child finished before the kill (stalls too short)"
+        child.send_signal(signal.SIGKILL)
+        assert child.wait() == -signal.SIGKILL
+        assert any(f.endswith(".npz") for f in os.listdir(ckpt_dir))
+        assert not os.path.exists(out_path)
+
+        env.pop("SQ_FAULTS")
+        rc = subprocess.run(cmd, env=env, timeout=600).returncode
+        assert rc == 0
+        with np.load(out_path, allow_pickle=False) as npz:
+            assert int(npz["resumed_from"]) >= 1
+            np.testing.assert_array_equal(npz["centers"],
+                                          reference["centers"])
+            np.testing.assert_array_equal(npz["counts"],
+                                          reference["counts"])
+        assert not os.listdir(ckpt_dir)
+
+
+class TestProbeCacheAtomicity:
+    def test_concurrent_writers_never_expose_partial_json(self, tmp_path,
+                                                          monkeypatch):
+        """The satellite pin: the cross-process probe-TTL cache is
+        written via fsynced tmp + atomic rename, so a reader racing any
+        number of writers sees only complete JSON documents."""
+        import json
+        import threading
+
+        from sq_learn_tpu.obs import probe as probe_mod
+
+        cache = str(tmp_path / "probe_cache.json")
+        monkeypatch.setenv("SQ_PROBE_CACHE", cache)
+        stop = threading.Event()
+        bad = []
+
+        def writer(tag):
+            i = 0
+            while not stop.is_set():
+                probe_mod._cache_write("ok", 0.001 * i, f"plat-{tag}-{i}")
+                i += 1
+
+        def reader():
+            while not stop.is_set():
+                try:
+                    with open(cache) as fh:
+                        json.load(fh)
+                except FileNotFoundError:
+                    pass
+                except ValueError as exc:  # partial JSON observed
+                    bad.append(str(exc))
+
+        threads = ([threading.Thread(target=writer, args=(t,))
+                    for t in range(3)]
+                   + [threading.Thread(target=reader) for _ in range(2)])
+        for t in threads:
+            t.start()
+        time.sleep(0.5)
+        stop.set()
+        for t in threads:
+            t.join()
+        assert not bad, f"torn cache reads observed: {bad[:3]}"
