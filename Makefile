@@ -9,8 +9,8 @@ SHELL := /bin/bash
 
 .PHONY: test test-fast test-timed test-fast-tier test-slow-tier lint bench \
     bench-smoke bench-suite multichip examples \
-    hunt obs-smoke faults-smoke oocore-smoke regress-selftest smoke \
-    obs-report obs-trace obs-frontier obs-audit regress all
+    hunt obs-smoke faults-smoke oocore-smoke serve-smoke regress-selftest \
+    smoke obs-report obs-trace obs-frontier obs-audit regress all
 
 all: lint test
 
@@ -127,9 +127,19 @@ oocore-smoke:
 	env SQ_OBS=1 SQ_OBS_PATH=/tmp/sq_oocore_smoke.jsonl \
 	    $(PYTHON) -m sq_learn_tpu.oocore.smoke
 
+# Serving smoke: two checkpointed tenants behind the micro-batching
+# dispatcher — digest-verified registry loads, mixed-size/type/tenant
+# load with estimator parity, result-cache hit, one absorbed transfer
+# fault with bit parity, and schema validation of the emitted JSONL
+# incl. >=1 `slo` record. The CI-runnable contract check for
+# sq_learn_tpu.serving.
+serve-smoke:
+	env SQ_OBS=1 SQ_OBS_PATH=/tmp/sq_serve_smoke.jsonl \
+	    $(PYTHON) -m sq_learn_tpu.serving.smoke
+
 # All contract smokes (observability + resilience + out-of-core +
-# regression gate).
-smoke: obs-smoke faults-smoke oocore-smoke regress-selftest
+# serving + regression gate).
+smoke: obs-smoke faults-smoke oocore-smoke serve-smoke regress-selftest
 
 # Render the human report / Chrome trace of an obs JSONL artifact
 # (default: the obs-smoke artifact; override with OBS=<path>).
@@ -153,7 +163,10 @@ obs-frontier:
 # fused-fit bench (classical 70k×784 q-means), the PR 7 δ=0.5
 # 70k×784 headline (sketched spectral stats — the line whose band pins
 # the sketch engine's win), AND the PR 8 out-of-core fit (100k×784 shard
-# store over a 96 MB RAM budget, with the killed-and-resumed leg) under
+# store over a 96 MB RAM budget, with the killed-and-resumed leg), AND
+# the PR 9 serving load bench (12k mixed requests through the
+# micro-batching dispatcher: QPS lower-bounded by the `throughput` gate,
+# p99 upper-bounded by the latency gate) under
 # SQ_OBS=1 and band every line (latency,
 # compile_count, total_transfer_bytes, peak HBM) against the committed
 # BENCH_r*.json trajectory + bench/records history. Exit 1 on any red
@@ -170,6 +183,9 @@ regress:
 	    >> /tmp/sq_regress_bench.json
 	env SQ_OBS=1 SQ_OBS_PATH=/tmp/sq_regress_oocore_obs.jsonl \
 	    $(PYTHON) -m bench.bench_oocore_fit \
+	    >> /tmp/sq_regress_bench.json
+	env SQ_OBS=1 SQ_OBS_PATH=/tmp/sq_regress_serving_obs.jsonl \
+	    $(PYTHON) -m bench.bench_serving_load \
 	    >> /tmp/sq_regress_bench.json
 	cat /tmp/sq_regress_bench.json
 	$(PYTHON) -m sq_learn_tpu.obs regress /tmp/sq_regress_bench.json --root .

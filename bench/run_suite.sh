@@ -130,7 +130,8 @@ for cmd in "python bench.py" \
            "python -m bench.bench_qpca_mnist" \
            "python -m bench.bench_qkmeans_mnist" \
            "python -m bench.bench_qkmeans_fused_fit" \
-           "python -m bench.bench_oocore_fit"; do
+           "python -m bench.bench_oocore_fit" \
+           "python -m bench.bench_serving_load"; do
   if ! run_and_record 600 "$cmd" $cmd; then
     # mid-run tunnel wedge (or any accelerator failure): record the CPU
     # fallback number instead of nothing. PYTHONPATH is cleared so the
@@ -160,13 +161,16 @@ env -u PYTHONPATH timeout 60 python -m sq_learn_tpu.obs frontier \
   || echo "# (no tradeoff records this run)" >> "$obs_dir/frontier.txt"
 
 # BASELINE acceptance gate (bench/_gate.py: vs_baseline >= 0.5 on every
-# line, 8 measured + 2 derived lines expected — the sixth measured line
+# line, 10 measured + 2 derived lines expected — the sixth measured line
 # is the streaming-ingest smoke config, whose baseline is the monolithic
 # ingest of the same fit; the seventh is the PR 6 fused-fit config
 # (classical 70k×784 q-means vs sklearn on the SAME δ=0 configuration);
 # the eighth is the PR 8 out-of-core config, whose baseline is the
 # in-RAM fit of the same store — vs_baseline >= 0.5 reads "fitting from
 # disk under a RAM budget costs at most 2x residency";
+# the ninth and tenth are the PR 9 serving load bench's pair (sustained
+# micro-batched QPS vs the sequential per-request arm, and p99 vs the
+# same — vs_baseline >= 0.5 reads "micro-batching never halves either");
 # the derived pair is bench_ipe_digits and the
 # sharded-scaling smoke config; missing/null = fail). This
 # script is where the bar is enforced — the unit suite only warns, since
@@ -175,7 +179,7 @@ env -u PYTHONPATH timeout 60 python -m sq_learn_tpu.obs frontier \
 # pre-imports jax via the axon sitecustomize and would hang on a wedged
 # relay even though this step only parses JSON; -m bench._gate resolves
 # via cwd, which is the repo root here)
-env -u PYTHONPATH timeout 60 python -m bench._gate "$out" 8 2
+env -u PYTHONPATH timeout 60 python -m bench._gate "$out" 10 2
 gate_rc=$?
 echo "# acceptance gate rc=$gate_rc" >> "$out"
 echo "done: $out"

@@ -29,6 +29,7 @@ from . import ops, utils  # noqa: E402
 
 from . import datasets, metrics, model_selection, models, native, parallel  # noqa: E402
 from . import streaming  # noqa: E402
+from . import serving  # noqa: E402  (after streaming: buckets come from it)
 from . import feature_extraction, pipeline, preprocessing  # noqa: E402
 # reference-namespace facades (sklearn/cluster, decomposition, svm,
 # neighbors, QuantumUtility) so reference users find familiar paths
@@ -65,6 +66,7 @@ __all__ = [
     "obs",
     "ops",
     "resilience",
+    "serving",
     "utils",
     "native",
     "parallel",

@@ -26,7 +26,7 @@ Design constraints, in order:
    arrays, so their spans are synced by construction.
 
 JSONL schema: one JSON object per line, every line carrying
-``{"v": 2, "schema_version": 2, "ts": <unix seconds>, "type": <record
+``{"v": 4, "schema_version": 4, "ts": <unix seconds>, "type": <record
 type>}`` plus per-type fields — see :mod:`sq_learn_tpu.obs.schema` (the
 validator) and ``docs/observability.md`` (the prose). ``v`` is the
 original envelope key (kept so pre-2 readers don't break);
@@ -42,7 +42,9 @@ import time
 # v2: +xla_cost / regression record types, +schema_version envelope field
 # v3: +guarantee / tradeoff record types (the statistical-observability
 #     layer: (ε, δ)-contract audits and accuracy-vs-runtime sweep points)
-SCHEMA_VERSION = 3
+# v4: +slo record type (the serving layer's per-run p50/p99 latency,
+#     sustained QPS, batch-occupancy and degrade accounting)
+SCHEMA_VERSION = 4
 
 #: default sink path when SQ_OBS=1 and SQ_OBS_PATH is unset
 DEFAULT_PATH = "sq_obs.jsonl"
@@ -156,8 +158,8 @@ class Recorder:
     Public views: ``spans``, ``counters``, ``gauges``, ``ledger_entries``,
     ``watchdog_events``, ``probe_events``, ``fault_events``,
     ``breaker_events``, ``xla_cost_records``, ``guarantee_records``,
-    ``tradeoff_records`` — all plain Python containers, safe to read at
-    any point in the run.
+    ``tradeoff_records``, ``slo_records`` — all plain Python containers,
+    safe to read at any point in the run.
     """
 
     def __init__(self, path=None):
@@ -173,6 +175,7 @@ class Recorder:
         self.xla_cost_records = []
         self.guarantee_records = []
         self.tradeoff_records = []
+        self.slo_records = []
         self._xla_seen = set()  # (site, signature) dedup for obs.xla
         self.path = path
         self._seq = 0
@@ -392,6 +395,16 @@ def snapshot():
         "stats_cache_misses": int(
             rec.counters.get("stats_cache.misses", 0)),
         "sketch_estimates": int(rec.counters.get("sketch.estimates", 0)),
+        # serving layer (sq_learn_tpu.serving): SLO summaries emitted,
+        # batches that degraded to the host route, and transform-cache
+        # traffic — the bench lines' evidence that a load run's numbers
+        # came from the micro-batched device path, not the fallback
+        "slo_records": len(rec.slo_records),
+        "serving_degraded": int(
+            rec.counters.get("serving.degraded_batches", 0)),
+        "serve_cache_hits": int(rec.counters.get("serving.cache_hits", 0)),
+        "serve_cache_misses": int(
+            rec.counters.get("serving.cache_misses", 0)),
     }
 
 

@@ -23,6 +23,11 @@ accuracy               value of a ``unit: "accuracy"`` line (the frontier
                        sweeps' headlines) UNDER ratio × median − slack —
                        the lower-bounded quality band (replaces the
                        latency gate on those lines)
+throughput             value of a ``unit: "qps"`` line (the serving load
+                       bench's sustained-QPS headline) UNDER
+                       ratio × median − slack — the lower-bounded
+                       serving band (replaces the latency gate on those
+                       lines)
 =====================  ====================================================
 
 Verdicts are ``green`` / ``red`` / ``skip`` (skip = no reference on that
@@ -47,7 +52,7 @@ import os
 import time
 from statistics import median
 
-SCHEMA_VERSION = 3  # keep in sync with recorder.SCHEMA_VERSION (no import:
+SCHEMA_VERSION = 4  # keep in sync with recorder.SCHEMA_VERSION (no import:
 # this module must stay loadable from a bare checkout for CI tooling)
 
 __all__ = ["load_history", "check_record", "check_file", "selftest", "main"]
@@ -57,18 +62,26 @@ __all__ = ["load_history", "check_record", "check_file", "selftest", "main"]
 #: the absolute slack keeps tiny references from banning tiny noise
 #: (ref compile_count=1 must not make 2 compiles red). Env-overridable
 #: per gate via SQ_REGRESS_TOL_<GATE> / SQ_REGRESS_SLACK_<GATE>.
-#: ``accuracy`` is the one LOWER-bounded gate (red when the value DROPS
-#: below ratio × reference − slack): it bands the frontier sweeps'
-#: accuracy headlines, whose ``unit`` is "accuracy" rather than seconds —
-#: a quality regression must trip the same analyzer a latency regression
-#: does.
+#: ``accuracy`` and ``throughput`` are the LOWER-bounded gates (red when
+#: the value DROPS below ratio × reference − slack): ``accuracy`` bands
+#: the frontier sweeps' accuracy headlines (``unit: "accuracy"``),
+#: ``throughput`` bands the serving load bench's sustained-QPS headline
+#: (``unit: "qps"``) — a throughput collapse must trip the same analyzer
+#: a latency regression does.
 TOLERANCES = {
     "latency": (2.0, 0.05),
     "compile_count": (1.5, 2),
     "total_transfer_bytes": (1.25, 4096),
     "peak_hbm_bytes": (1.25, 1 << 20),
     "accuracy": (0.9, 0.02),
+    "throughput": (0.5, 0.0),
 }
+
+#: value-gate selection by the record's unit (default: latency)
+_UNIT_GATES = {"accuracy": "accuracy", "qps": "throughput"}
+
+#: the lower-bounded gates (value must stay ABOVE ratio × ref − slack)
+_LOWER_BOUNDED = ("accuracy", "throughput")
 
 #: gates read from the record's obs object (latency reads "value")
 OBS_GATES = ("compile_count", "total_transfer_bytes", "peak_hbm_bytes")
@@ -133,7 +146,7 @@ def _reference(history_recs, gate):
     obs layer landed)."""
     vals = []
     for rec in history_recs:
-        if gate in ("latency", "accuracy"):
+        if gate not in OBS_GATES:
             v = rec.get("value")
         else:
             v = (rec.get("obs") or {}).get(gate)
@@ -143,7 +156,7 @@ def _reference(history_recs, gate):
 
 
 def _current(rec, gate):
-    if gate in ("latency", "accuracy"):
+    if gate not in OBS_GATES:
         v = rec.get("value")
     else:
         v = (rec.get("obs") or {}).get(gate)
@@ -157,12 +170,14 @@ def check_record(rec, history):
 
     The value gate depends on the record's unit: seconds-valued lines
     get the UPPER-bounded ``latency`` band; ``unit: "accuracy"`` lines
-    (the frontier sweeps' headlines) get the LOWER-bounded ``accuracy``
-    band — a drop below ratio × median(history) − slack is red.
+    (the frontier sweeps' headlines) and ``unit: "qps"`` lines (the
+    serving load bench's sustained-throughput headline) get the
+    LOWER-bounded ``accuracy``/``throughput`` bands — a drop below
+    ratio × median(history) − slack is red.
     """
     metric = rec.get("metric", "?")
     past = history.get(metric, [])
-    value_gate = "accuracy" if rec.get("unit") == "accuracy" else "latency"
+    value_gate = _UNIT_GATES.get(rec.get("unit"), "latency")
     verdicts = []
     for gate in (value_gate,) + OBS_GATES:
         cur = _current(rec, gate)
@@ -170,7 +185,7 @@ def check_record(rec, history):
         tol, slack = _tolerance(gate)
         if cur is None or ref is None:
             verdict, allowed = "skip", None
-        elif gate == "accuracy":
+        elif gate in _LOWER_BOUNDED:
             allowed = ref * tol - slack
             verdict = "red" if cur < allowed else "green"
         else:

@@ -127,10 +127,13 @@ def summarize(records):
         "wall_s": round(sketch_wall, 6),
     }
 
+    slo = [r for r in records if r.get("type") == "slo"]
+
     return {
         "by_type": by_type,
         "spans": by_name,
         "watchdog": watchdog,
+        "slo": slo,
         "counters": counters,
         "xla": xla,
         "ledger": {"queries": ledger_queries,
@@ -238,6 +241,23 @@ def render(summary, top=12):
     else:
         for line in _frontier.render(tr).splitlines():
             out("  " + line)
+
+    out("")
+    out("-- serving SLOs (p50/p99 latency, sustained QPS) --")
+    slo = summary.get("slo") or []
+    if not slo:
+        out("  (no slo records)")
+    for r in slo:
+        tgt = r.get("targets") or {}
+        tgt_s = (" targets p50<=" + _fmt_num(tgt.get("p50_ms"))
+                 + "ms p99<=" + _fmt_num(tgt.get("p99_ms")) + "ms"
+                 if tgt else "")
+        flag = "  SLO VIOLATED" if r.get("violated") else ""
+        out(f"  {r.get('site')}: {r.get('requests', 0)} req @ "
+            f"{_fmt_num(r.get('qps'))} qps  p50 {r.get('p50_ms')}ms  "
+            f"p99 {r.get('p99_ms')}ms  occupancy "
+            f"{r.get('batch_occupancy')}  degraded {r.get('degraded')}"
+            f"{tgt_s}{flag}")
 
     out("")
     out("-- fault / breaker / regression timeline --")

@@ -1,15 +1,17 @@
 """JSONL schema for obs records, and a dependency-free validator.
 
 Every line of an obs JSONL file is one JSON object carrying the common
-envelope ``{"v": 3, "schema_version": 3, "ts": <unix seconds>,
+envelope ``{"v": 4, "schema_version": 4, "ts": <unix seconds>,
 "type": <t>}`` plus per-type required fields. Version history: v1 (PR 2)
 had neither the ``schema_version`` alias nor the ``xla_cost`` /
 ``regression`` types; v2 (PR 4) added those; v3 (PR 5) adds the
 statistical-observability types ``guarantee`` (one realized-vs-declared
 (ε, δ) draw) and ``tradeoff`` (one accuracy-vs-theoretical-runtime sweep
-point). Older versions still validate (their types are a strict subset),
-any other version is rejected — an unknown version means a reader that
-would silently misinterpret fields, so it must fail loudly.
+point); v4 (PR 9) adds ``slo`` (one serving-run latency/throughput
+summary from :mod:`sq_learn_tpu.serving`). Older versions still validate
+(their types are a strict subset), any other version is rejected — an
+unknown version means a reader that would silently misinterpret fields,
+so it must fail loudly.
 
 =========  ==============================================================
 type       required fields (beyond the envelope)
@@ -60,6 +62,14 @@ tradeoff   sweep (str), point (number), accuracy (number),
            budget buys (:mod:`sq_learn_tpu.obs.frontier`); optional
            accuracy_metric (str), budget (object: str → number),
            attrs (object)
+slo        site (str), requests (int ≥ 0), p50_ms (number ≥ 0),
+           p99_ms (number ≥ 0), qps (number ≥ 0),
+           batch_occupancy (number in [0, 1]), degraded (int ≥ 0),
+           violated (bool) — one serving run's latency/throughput
+           summary against its declared SLO targets
+           (:mod:`sq_learn_tpu.serving.slo`); optional batches (int),
+           window_s (number ≥ 0), targets (object: str → number),
+           attrs (object)
 =========  ==============================================================
 
 The out-of-core layer (PR 8) rides the generic types rather than minting
@@ -83,8 +93,8 @@ _NUM = (int, float)
 
 #: versions this validator knows how to read (v1 = PR 2's envelope
 #: without schema_version/xla_cost/regression; v2 = PR 4's, without
-#: guarantee/tradeoff)
-KNOWN_VERSIONS = {1, 2, SCHEMA_VERSION}
+#: guarantee/tradeoff; v3 = PR 5's, without slo)
+KNOWN_VERSIONS = {1, 2, 3, SCHEMA_VERSION}
 
 _PROBE_OUTCOMES = {"ok", "timeout", "error", "cpu", "skipped"}
 
@@ -250,6 +260,41 @@ def validate_record(rec):
                 isinstance(k, str) and isinstance(vv, _NUM)
                 for k, vv in obj.items()), errors,
                 "tradeoff.budget object of str → number")
+    elif t == "slo":
+        _check(isinstance(rec.get("site"), str), errors, "slo.site str")
+        _check(isinstance(rec.get("requests"), int)
+               and not isinstance(rec.get("requests"), bool)
+               and rec["requests"] >= 0, errors,
+               "slo.requests non-negative int")
+        for field in ("p50_ms", "p99_ms", "qps"):
+            _check(isinstance(rec.get(field), _NUM)
+                   and not isinstance(rec.get(field), bool)
+                   and rec[field] >= 0, errors,
+                   f"slo.{field} non-negative number")
+        occ = rec.get("batch_occupancy")
+        _check(isinstance(occ, _NUM) and not isinstance(occ, bool)
+               and 0.0 <= occ <= 1.0, errors,
+               "slo.batch_occupancy number in [0, 1]")
+        _check(isinstance(rec.get("degraded"), int)
+               and not isinstance(rec.get("degraded"), bool)
+               and rec["degraded"] >= 0, errors,
+               "slo.degraded non-negative int")
+        _check(isinstance(rec.get("violated"), bool), errors,
+               "slo.violated bool")
+        if "batches" in rec:
+            _check(isinstance(rec["batches"], int)
+                   and not isinstance(rec["batches"], bool), errors,
+                   "slo.batches int")
+        if "window_s" in rec:
+            _check(isinstance(rec["window_s"], _NUM)
+                   and rec["window_s"] >= 0, errors,
+                   "slo.window_s non-negative number")
+        if "targets" in rec:
+            obj = rec["targets"]
+            _check(isinstance(obj, dict) and all(
+                isinstance(k, str) and isinstance(vv, _NUM)
+                for k, vv in obj.items()), errors,
+                "slo.targets object of str → number")
     else:
         errors.append(f"unknown record type {t!r}")
     return errors

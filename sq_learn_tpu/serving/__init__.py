@@ -1,0 +1,62 @@
+"""Online serving: async micro-batching dispatch over a multi-tenant
+fitted-model registry, with p50/p99 SLO accounting.
+
+The paper makes (ε, δ) *runtime* parameters; serving is where runtime
+actually happens. This package turns fitted estimators into a service:
+
+- :class:`~.dispatcher.MicroBatchDispatcher` — coalesces concurrent
+  predict/transform requests into the streaming engine's padded
+  power-of-two buckets (one compile per (bucket, dtype, model-shape),
+  watchdog-enforced), dispatches once per batch through the transfer
+  supervisor, and scatters results back per request. An OPEN circuit
+  breaker or an exhausted placement degrades a batch to the host route —
+  zero requests lost, the queue never stalls behind a wedged backend.
+- :class:`~.registry.ModelRegistry` — tenant id → servable model,
+  checkpoint-backed (digest-verified loads) with LRU residency.
+- :mod:`~.cache` — digest-keyed transform-result cache for repeated
+  identical requests (``SQ_SERVE_CACHE=0`` disables).
+- :class:`~.slo.SloTracker` — per-run p50/p99 latency, sustained QPS,
+  batch occupancy and degrade counts, emitted as the v4 ``slo`` obs
+  record and gated against ``SQ_SERVE_SLO_P50_MS``/``SQ_SERVE_SLO_P99_MS``
+  (``SQ_SERVE_SLO_STRICT=1`` raises on violation).
+
+Quickstart::
+
+    from sq_learn_tpu import serving
+
+    reg = serving.ModelRegistry()
+    reg.register("tenant-a", "/models/tenant_a_qkmeans")   # checkpoint dir
+    with serving.MicroBatchDispatcher(reg) as d:
+        labels = d.submit("tenant-a", "predict", X_rows).result()
+
+Env knobs: ``SQ_SERVE_MAX_WAIT_MS`` (2.0) coalescing window,
+``SQ_SERVE_MAX_BATCH_ROWS`` (512) batch cap / largest bucket,
+``SQ_SERVE_MIN_BUCKET_ROWS`` (8) smallest bucket,
+``SQ_SERVE_REGISTRY_CAP`` (8) resident models, ``SQ_SERVE_CACHE`` /
+``SQ_SERVE_CACHE_ENTRIES`` result cache, ``SQ_SERVE_SLO_*`` targets.
+Full docs: ``docs/serving.md``; load bench:
+``bench/bench_serving_load.py``; contract smoke: ``make serve-smoke``.
+"""
+
+from . import cache, dispatcher, registry, slo
+from .dispatcher import (MicroBatchDispatcher, kernel_cache_sizes,
+                         serve_max_batch_rows, serve_max_wait_ms,
+                         serve_min_bucket_rows)
+from .registry import ModelRegistry, ServingModel
+from .slo import SloTracker, SloViolation
+
+__all__ = [
+    "MicroBatchDispatcher",
+    "ModelRegistry",
+    "ServingModel",
+    "SloTracker",
+    "SloViolation",
+    "cache",
+    "dispatcher",
+    "kernel_cache_sizes",
+    "registry",
+    "serve_max_batch_rows",
+    "serve_max_wait_ms",
+    "serve_min_bucket_rows",
+    "slo",
+]

@@ -1,0 +1,160 @@
+"""Digest-keyed transform-result cache for the serving layer.
+
+Production predict/transform traffic repeats itself — the same feature
+rows arrive from the same upstream batch pipelines over and over — and a
+deterministic transform of identical bytes is pure recompute. This cache
+is the serving twin of the spectral-stats cache
+(:mod:`sq_learn_tpu.sketch.cache`), sharing its keying recipe: keys are
+``(model fingerprint, op, shape, dtype, strided-CRC content digest)``
+where the digest is the stream-checkpoint sampler (CRC32 over ≤64 evenly
+strided rows, first and last always included — serving requests are
+bounded at micro-batch scale, so requests up to 64 rows are hashed in
+FULL and the documented interior-mutation caveat only applies beyond
+that). The model fingerprint (the registry entry's checkpoint
+``state_digest``, or a params digest for in-memory models) keys a
+re-registered tenant out of its predecessor's results.
+
+Only **deterministic** ops are cacheable — the dispatcher consults the
+cache for ``transform`` (a pure function of the fitted state), never for
+δ>0 stochastic predicts. Hits and misses are obs counters
+(``serving.cache_hits`` / ``serving.cache_misses``, surfaced in bench
+``obs`` objects and the report CLI); ``SQ_SERVE_CACHE=0`` kills the
+cache entirely; ``SQ_SERVE_CACHE_ENTRIES`` bounds the LRU (default 256
+request-sized results). Process-global, thread-safe; stored results are
+returned as copies so a caller mutating its response can never poison a
+later hit.
+"""
+
+import collections
+import os
+import threading
+
+import numpy as np
+
+from .. import obs as _obs
+from ..sketch.cache import data_digest
+
+__all__ = ["clear", "enabled", "flush_counters", "key_for", "lookup",
+           "stats", "store"]
+
+
+def _max_entries():
+    return int(os.environ.get("SQ_SERVE_CACHE_ENTRIES", 256))
+
+
+_lock = threading.Lock()
+_store = collections.OrderedDict()
+
+#: hit/miss tallies are PRE-AGGREGATED and flushed to the obs counters
+#: every ``_FLUSH_EVERY`` events (and on :func:`flush_counters`, which
+#: the dispatcher calls at close) — at serving rates a JSONL counter
+#: line per lookup floods the run artifact with tens of thousands of
+#: records that say nothing the totals don't (measured: >50k lines,
+#: >10 MB per load-bench artifact before aggregation)
+_FLUSH_EVERY = 256
+_hits = 0
+_misses = 0
+_pending_hits = 0
+_pending_misses = 0
+
+
+def stats():
+    """Cumulative process-wide {hits, misses} (includes not-yet-flushed
+    events — the fine-grained view tests and smokes read)."""
+    with _lock:
+        return {"hits": _hits, "misses": _misses}
+
+
+def _count(hit):
+    global _hits, _misses, _pending_hits, _pending_misses
+    with _lock:
+        if hit:
+            _hits += 1
+            _pending_hits += 1
+        else:
+            _misses += 1
+            _pending_misses += 1
+        if _pending_hits + _pending_misses < _FLUSH_EVERY:
+            return
+        ph, pm = _pending_hits, _pending_misses
+        _pending_hits = _pending_misses = 0
+    _flush(ph, pm)
+
+
+def _flush(ph, pm):
+    if ph:
+        _obs.counter_add("serving.cache_hits", ph)
+    if pm:
+        _obs.counter_add("serving.cache_misses", pm)
+
+
+def flush_counters():
+    """Push the pending hit/miss deltas into the obs counters (one JSONL
+    line per counter, not per event). Dispatchers call this at close so
+    bench ``obs`` objects and reports carry exact totals."""
+    global _pending_hits, _pending_misses
+    with _lock:
+        ph, pm = _pending_hits, _pending_misses
+        _pending_hits = _pending_misses = 0
+    _flush(ph, pm)
+
+
+def enabled():
+    """True unless ``SQ_SERVE_CACHE=0``."""
+    return os.environ.get("SQ_SERVE_CACHE", "1") != "0"
+
+
+def _request_digest(X, max_rows=64):
+    """The strided-CRC recipe with a serving fast path: payloads of
+    ≤``max_rows`` rows (the overwhelming serving case) hash their whole
+    contiguous buffer directly — same digest semantics (a full hash),
+    none of the index-building overhead the submit path would pay per
+    request. Larger payloads fall back to the shared strided sampler."""
+    import zlib
+
+    if X.shape[0] <= max_rows and X.flags.c_contiguous:
+        return zlib.crc32(X)
+    return data_digest(X, max_rows)
+
+
+def key_for(fingerprint, op, X):
+    """Cache key for one request payload under one model, or None when
+    caching is disabled (None keys make lookup/store no-ops)."""
+    if not enabled():
+        return None
+    try:
+        return (fingerprint, op, X.shape, str(X.dtype),
+                _request_digest(X))
+    except Exception:
+        return None  # exotic payloads: skip the cache, never the request
+
+
+def lookup(key):
+    """Cached response rows for ``key`` (LRU-touch on hit; returns a
+    copy), tallying the outcome into the pre-aggregated
+    ``serving.cache_hits`` / ``serving.cache_misses`` counters."""
+    if key is None:
+        return None
+    with _lock:
+        hit = _store.get(key)
+        if hit is not None:
+            _store.move_to_end(key)
+    _count(hit is not None)
+    return np.array(hit, copy=True) if hit is not None else None
+
+
+def store(key, result):
+    if key is None:
+        return
+    result = np.array(result, copy=True)
+    with _lock:
+        _store[key] = result
+        _store.move_to_end(key)
+        cap = _max_entries()
+        while len(_store) > cap:
+            _store.popitem(last=False)
+
+
+def clear():
+    with _lock:
+        _store.clear()

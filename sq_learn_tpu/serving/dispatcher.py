@@ -1,0 +1,646 @@
+"""Async micro-batching dispatcher: many small requests, few padded
+dispatches.
+
+Per-request predict on an accelerator pays the whole dispatch stack —
+host→device placement, kernel launch, result fetch — for a handful of
+rows, and under concurrent load those fixed costs ARE the latency (the
+bench: one 512-row batched dispatch serves hundreds of requests in the
+time per-request dispatch serves a dozen). This dispatcher coalesces
+concurrent predict/transform requests into the streaming engine's
+bucketed shapes:
+
+- **Coalescing window.** Requests enqueue with a submit timestamp; the
+  worker opens a batch at the head request's group key ``(tenant, op,
+  dtype, n_features)`` and closes it when ``SQ_SERVE_MAX_BATCH_ROWS``
+  rows have accumulated or the head request has waited
+  ``SQ_SERVE_MAX_WAIT_MS`` — the classic wait-vs-occupancy trade the SLO
+  record's ``batch_occupancy`` field makes visible.
+- **Bucketed shapes.** The batch pads to the streaming engine's
+  power-of-two buckets (:func:`sq_learn_tpu.streaming.bucket_rows`, with
+  the serving-sized per-call floor ``SQ_SERVE_MIN_BUCKET_ROWS`` — no env
+  mutation), so mixed request sizes compile each serving kernel at most
+  once per (bucket, dtype, model-shape) signature. The retracing
+  watchdog enforces exactly that budget per kernel site; under
+  ``SQ_OBS_STRICT=1`` the first excess compile raises.
+- **One dispatch, scattered results.** The padded batch crosses once
+  through the transfer supervisor (:func:`~sq_learn_tpu.resilience.
+  supervisor.put`: retries, keyed backoff, deadline, breaker
+  accounting), one instrumented kernel call serves every request in it,
+  and the host-side rows scatter back per-request in submission order.
+- **Degradation, not stalls.** Every dispatch preflights the circuit
+  breaker; an OPEN breaker — or a placement whose retries exhausted —
+  degrades the batch to the **host route**: the same kernel on a plain
+  uncommitted placement, skipping the supervised transfer entirely. The
+  breaker's trip action has already repinned the process to the CPU
+  backend (the documented wedge escape), so on the CPU mesh degraded
+  responses are bit-identical to supervised ones and, crucially, zero
+  requests are lost and the queue never stalls behind a wedged relay.
+  Degrades count into the SLO record and the
+  ``serving.degraded_batches`` counter.
+
+Determinism: with ``background=False`` the dispatcher never starts a
+worker thread — callers submit and then :meth:`~MicroBatchDispatcher.
+flush`, and grouping depends only on submission order and sizes, never
+on timing. That is the mode the fault-parity tests (and any bit-equality
+claim) use; ``background=True`` adds the timing-dependent coalescing
+window for live traffic.
+"""
+
+import collections
+import os
+import threading
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .. import obs as _obs
+from ..obs import xla as _xla
+from ..resilience import supervisor as _sup
+from ..streaming import bucket_rows
+from . import cache as _cache
+from .slo import SloTracker
+
+__all__ = ["MicroBatchDispatcher", "ServeFuture", "serve_max_batch_rows",
+           "serve_max_wait_ms", "serve_min_bucket_rows"]
+
+
+def serve_max_wait_ms():
+    """Coalescing window in milliseconds (``SQ_SERVE_MAX_WAIT_MS``,
+    default 2.0): the longest a head-of-batch request waits for company
+    before dispatching under-full."""
+    return float(os.environ.get("SQ_SERVE_MAX_WAIT_MS", 2.0))
+
+
+def serve_max_batch_rows():
+    """Row cap per dispatched batch (``SQ_SERVE_MAX_BATCH_ROWS``,
+    default 512) — also the largest serving bucket."""
+    return int(os.environ.get("SQ_SERVE_MAX_BATCH_ROWS", 512))
+
+
+def serve_min_bucket_rows():
+    """Smallest serving bucket (``SQ_SERVE_MIN_BUCKET_ROWS``, default
+    8): single-row requests dispatch at this padding, NOT the streaming
+    engine's 64-row ingest floor — passed per call to
+    :func:`~sq_learn_tpu.streaming.bucket_rows`, never via env
+    mutation."""
+    return int(os.environ.get("SQ_SERVE_MIN_BUCKET_ROWS", 8))
+
+
+# ---------------------------------------------------------------------------
+# Serving kernels (module-level jits: one compile cache per process, at
+# most one entry per (bucket, dtype, model-shape) signature — the
+# streaming engine's invariant applied to inference)
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def _predict_centers_kernel(tile, centers):
+    """Closest-center labels for a padded request batch. Row-wise and
+    padding-safe: a zero row gets a label like any other and is sliced
+    away by the scatter — no cross-row reduction, so a request's labels
+    are independent of its batch-mates."""
+    xsq = jnp.sum(tile * tile, axis=1)
+    csq = jnp.sum(centers * centers, axis=1)
+    d2 = xsq[:, None] + csq[None, :] - 2.0 * (tile @ centers.T)
+    return jnp.argmin(d2, axis=1).astype(jnp.int32)
+
+
+@jax.jit
+def _transform_centers_kernel(tile, centers):
+    """Center-distance transform (the q-means transform surface) of a
+    padded request batch."""
+    xsq = jnp.sum(tile * tile, axis=1)
+    csq = jnp.sum(centers * centers, axis=1)
+    d2 = xsq[:, None] + csq[None, :] - 2.0 * (tile @ centers.T)
+    return jnp.sqrt(jnp.maximum(d2, 0.0))
+
+
+@jax.jit
+def _transform_components_kernel(tile, mean, components):
+    """Projection transform ((x − μ)·Vᵀ — the qPCA/SVD surface) of a
+    padded request batch."""
+    return (tile - mean) @ components.T
+
+
+_predict_centers_kernel = _xla.instrument("serving.predict_centers",
+                                          _predict_centers_kernel)
+_transform_centers_kernel = _xla.instrument("serving.transform_centers",
+                                            _transform_centers_kernel)
+_transform_components_kernel = _xla.instrument(
+    "serving.transform_components", _transform_components_kernel)
+
+#: kernel name (what ServingModel.ops binds) → instrumented jit
+_KERNELS = {
+    "predict_centers": _predict_centers_kernel,
+    "transform_centers": _transform_centers_kernel,
+    "transform_components": _transform_components_kernel,
+}
+
+#: watchdog site → kernel, streaming.py's registry convention
+_KERNEL_SITES = {f"serving.{name}": fn for name, fn in _KERNELS.items()}
+
+
+def kernel_cache_sizes():
+    """Compile-cache entry count per serving kernel — the hook the
+    no-per-shape-recompile tests and the load bench read."""
+    return {name: int(fn._cache_size()) for name, fn in _KERNELS.items()}
+
+
+class ServeFuture:
+    """Slim future for one request's response — the per-request framework
+    cost IS the micro-batching amortization floor (one dispatch serves
+    dozens of these), so this is an Event around a slot rather than a
+    ``concurrent.futures.Future`` (whose per-result condition/callback
+    machinery measures ~3× heavier on the scatter path). API subset:
+    ``result(timeout)``, ``exception(timeout)``, ``done()``."""
+
+    __slots__ = ("_event", "_value", "_exc")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._value = None
+        self._exc = None
+
+    def set_result(self, value):
+        self._value = value
+        self._event.set()
+
+    def set_exception(self, exc):
+        self._exc = exc
+        self._event.set()
+
+    def done(self):
+        return self._event.is_set()
+
+    def exception(self, timeout=None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("request did not complete in time")
+        return self._exc
+
+    def result(self, timeout=None):
+        exc = self.exception(timeout)
+        if exc is not None:
+            raise exc
+        return self._value
+
+
+#: per-process canonical-dtype memo: jax.dtypes.canonicalize_dtype costs
+#: ~µs and the submit path runs per request
+_CANONICAL = {}
+
+
+def _canonical(dtype):
+    got = _CANONICAL.get(dtype)
+    if got is None:
+        got = _CANONICAL[dtype] = jax.dtypes.canonicalize_dtype(dtype)
+    return got
+
+
+class _Request:
+    __slots__ = ("tenant", "op", "rows", "n_rows", "future", "submitted",
+                 "cache_key", "model", "group_key", "consumed")
+
+    def __init__(self, tenant, op, rows, model, cache_key, submitted):
+        self.tenant = tenant
+        self.op = op
+        self.rows = rows
+        self.n_rows = rows.shape[0]
+        self.model = model
+        self.cache_key = cache_key
+        self.submitted = submitted
+        self.future = ServeFuture()
+        self.group_key = (tenant, op, rows.dtype, rows.shape[1])
+        self.consumed = False
+
+
+class MicroBatchDispatcher:
+    """Coalesce concurrent predict/transform requests into padded
+    bucketed dispatches against a :class:`~sq_learn_tpu.serving.
+    registry.ModelRegistry`.
+
+    ``background=True`` starts the worker thread (live traffic);
+    ``background=False`` is the deterministic mode — callers
+    :meth:`submit` then :meth:`flush` (or use :meth:`serve`), and
+    batching depends only on submission order. Use as a context manager
+    or call :meth:`close`, which drains the queue, stops the worker, and
+    emits the run's ``slo`` record.
+    """
+
+    def __init__(self, registry, *, max_wait_ms=None, max_batch_rows=None,
+                 min_bucket_rows=None, slo_p50_ms=None, slo_p99_ms=None,
+                 background=True, coalesce=True,
+                 site="serving.dispatcher"):
+        self.registry = registry
+        #: coalesce=False is the sequential per-request baseline: every
+        #: dispatch serves exactly one request (no companions, no
+        #: coalescing wait) — the load bench's control arm, same queue
+        #: and supervision, none of the micro-batching
+        self._coalesce = bool(coalesce)
+        self._max_wait_s = (serve_max_wait_ms() if max_wait_ms is None
+                            else float(max_wait_ms)) / 1e3
+        self._max_batch_rows = (serve_max_batch_rows()
+                                if max_batch_rows is None
+                                else int(max_batch_rows))
+        self._min_bucket = (serve_min_bucket_rows()
+                            if min_bucket_rows is None
+                            else int(min_bucket_rows))
+        self._site = site
+        self.slo = SloTracker(site, slo_p50_ms=slo_p50_ms,
+                              slo_p99_ms=slo_p99_ms)
+        self._cond = threading.Condition()
+        #: arrival-order index (head-of-line discovery; entries are
+        #: lazily skipped once consumed) + per-group-key subqueues (the
+        #: batch pull: O(batch) per batch — a single arrival deque was
+        #: O(queue depth) per batch, quadratic under deep backlogs)
+        self._queue = collections.deque()
+        self._by_key = {}
+        self._key_rows = {}
+        self._pending_count = 0
+        self._stopping = False
+        self._closed = False
+        self._batch_seq = 0
+        self._sites_seen = set()
+        self._worker = None
+        if background:
+            self._worker = threading.Thread(
+                target=self._worker_loop, name=site, daemon=True)
+            self._worker.start()
+
+    # -- client surface ----------------------------------------------------
+
+    def _prepare(self, tenant, op, X):
+        """Validate and normalize one request. Returns a queued-ready
+        :class:`_Request`, or an already-resolved :class:`ServeFuture`
+        on a result-cache hit. Shape, dtype, tenant, and op problems
+        raise HERE, synchronously — a malformed request must never
+        occupy the queue."""
+        if self._closed:
+            raise RuntimeError("dispatcher is closed")
+        model = self.registry.resolve(tenant)
+        model.op(op)  # validates the op against the model, raises KeyError
+        rows = np.asarray(X)
+        if rows.ndim == 1:
+            rows = rows[None, :]
+        if rows.ndim != 2:
+            raise ValueError(f"request must be a row batch (2D), "
+                             f"got ndim={rows.ndim}")
+        if rows.shape[1] != model.n_features:
+            raise ValueError(
+                f"request has {rows.shape[1]} features; tenant "
+                f"{tenant!r} serves {model.n_features}")
+        if not np.issubdtype(rows.dtype, np.floating):
+            rows = rows.astype(model.dtype)
+        else:
+            canonical = _canonical(rows.dtype)
+            if rows.dtype != canonical:
+                rows = rows.astype(canonical)
+        if not rows.flags.c_contiguous:
+            rows = np.ascontiguousarray(rows)
+        submitted = self.slo.note_submit()
+        cache_key = None
+        if op in model.cacheable:
+            cache_key = _cache.key_for(model.fingerprint, op, rows)
+            hit = _cache.lookup(cache_key)
+            if hit is not None:
+                fut = ServeFuture()
+                fut.set_result(hit)
+                self.slo.note_request_done(submitted)
+                return fut
+        return _Request(str(tenant), op, rows, model, cache_key, submitted)
+
+    def submit(self, tenant, op, X):
+        """Enqueue one request; returns a :class:`ServeFuture` resolving
+        to the response rows (row-aligned with ``X``). Malformed
+        requests raise synchronously; identical repeated ``transform``
+        payloads may resolve immediately from the digest-keyed result
+        cache."""
+        req = self._prepare(tenant, op, X)
+        if isinstance(req, ServeFuture):
+            return req  # cache hit: already resolved
+        with self._cond:
+            self._enqueue_locked(req)
+            self._cond.notify()  # the worker is the only cond waiter
+        return req.future
+
+    def submit_many(self, requests):
+        """Enqueue a burst of ``(tenant, op, X)`` requests under ONE
+        lock acquisition and ONE worker wakeup; returns the futures in
+        order. This is the client-side half of the amortization story:
+        a serving frontend reads requests off its transport in bursts,
+        and per-request lock/notify traffic at 10⁴ QPS is measurable —
+        the load bench's clients submit their windows through here."""
+        prepared = [self._prepare(t, op, X) for t, op, X in requests]
+        with self._cond:
+            for req in prepared:
+                if not isinstance(req, ServeFuture):
+                    self._enqueue_locked(req)
+            self._cond.notify()
+        return [r if isinstance(r, ServeFuture) else r.future
+                for r in prepared]
+
+    def _enqueue_locked(self, req):
+        self._queue.append(req)
+        key = req.group_key
+        kq = self._by_key.get(key)
+        if kq is None:
+            kq = self._by_key[key] = collections.deque()
+        kq.append(req)
+        # O(1) per-key row accounting so the coalescing wait never
+        # rescans the queue (the scan was quadratic in queue depth)
+        self._key_rows[key] = self._key_rows.get(key, 0) + req.n_rows
+        self._pending_count += 1
+
+    def serve(self, tenant, op, X):
+        """Blocking convenience: submit, flush when deterministic, and
+        return the response rows."""
+        fut = self.submit(tenant, op, X)
+        if self._worker is None:
+            self.flush()
+        return fut.result()
+
+    def flush(self):
+        """Drain the queue synchronously in the caller's thread —
+        deterministic grouping (submission order and the row cap only;
+        no coalescing timer). The deterministic-mode counterpart of the
+        worker loop; safe to call alongside a worker too (both pull
+        from the same locked queue)."""
+        while True:
+            group = self._collect_group(wait=False)
+            if not group:
+                return
+            self._dispatch_guarded(group)
+
+    def pending(self):
+        with self._cond:
+            return self._pending_count
+
+    def close(self):
+        """Drain, stop the worker, emit the run's ``slo`` record.
+        Idempotent; returns the SLO summary dict."""
+        if self._closed:
+            return self.slo.summary()
+        with self._cond:
+            self._stopping = True
+            self._cond.notify_all()
+        if self._worker is not None:
+            self._worker.join(timeout=30.0)
+        self.flush()  # anything the worker left behind
+        self._closed = True
+        if _obs.enabled():
+            _cache.flush_counters()
+            for site in sorted(self._sites_seen):
+                _obs.watchdog.observe(site)
+        return self.slo.emit()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- batching core -----------------------------------------------------
+
+    def _collect_group(self, wait):
+        """Pop the next batch: the head request plus every queued
+        same-key request, FIFO, until the row cap. With ``wait=True``
+        (worker mode) the head request's coalescing window is honored:
+        the pop blocks until ``max_wait_ms`` after its submit, the row
+        cap filling early, or shutdown."""
+        with self._cond:
+            head = self._head_locked()
+            if wait:
+                while head is None and not self._stopping:
+                    self._cond.wait()
+                    head = self._head_locked()
+                if head is None:
+                    return []
+                deadline = head.submitted + self._max_wait_s
+                while not self._stopping and self._coalesce:
+                    remaining = deadline - time.perf_counter()
+                    if (self._key_rows.get(head.group_key, 0)
+                            >= self._max_batch_rows or remaining <= 0):
+                        break
+                    self._cond.wait(timeout=remaining)
+            # re-resolve the head: a concurrent flush may have consumed
+            # it during the coalescing wait
+            head = self._head_locked()
+            if head is None:
+                return []
+            key = head.group_key
+            kq = self._by_key[key]
+            if not self._coalesce:
+                kq.popleft().consumed = True
+                self._key_rows[key] -= head.n_rows
+                self._pending_count -= 1
+                return [head]
+            group, rows = [], 0
+            while kq and (not group
+                          or rows + kq[0].n_rows <= self._max_batch_rows):
+                req = kq.popleft()
+                req.consumed = True
+                group.append(req)
+                rows += req.n_rows
+            self._key_rows[key] -= rows
+            self._pending_count -= len(group)
+            return group
+
+    def _head_locked(self):
+        """Oldest unconsumed request (lazily dropping consumed entries
+        off the arrival-order index), or None."""
+        q = self._queue
+        while q and q[0].consumed:
+            q.popleft()
+        return q[0] if q else None
+
+    def _worker_loop(self):
+        """Double-buffered serving loop: batch *t+1* is collected,
+        padded, placed, and its kernel DISPATCHED before batch *t*'s
+        results are fetched — jax dispatch is async, so batch *t*
+        computes under batch *t+1*'s host-side assembly (the streaming
+        engine's overlap discipline applied to inference; nothing blocks
+        between batches except the result fetch itself)."""
+        pending = None
+        while True:
+            if not self._coalesce:
+                # sequential per-request mode (the bench's control arm):
+                # strictly one dispatch at a time, no overlap — that IS
+                # the baseline being measured
+                group = self._collect_group(wait=True)
+                if not group:
+                    with self._cond:
+                        if (self._stopping
+                                and self._head_locked() is None):
+                            return
+                    continue
+                try:
+                    self._dispatch_guarded(group)
+                except Exception:
+                    pass  # futures already carry the failure
+                continue
+            if pending is not None:
+                # a batch is in flight: NEVER block with its clients
+                # waiting — take whatever is queued right now (the
+                # in-flight compute was the coalescing window), launch
+                # it, then fetch the finished batch
+                group = self._collect_group(wait=False)
+                if not group:
+                    self._resolve_guarded(pending)
+                    pending = None
+                    continue
+                launched = self._launch_guarded(group)
+                self._resolve_guarded(pending)
+                pending = launched
+                continue
+            group = self._collect_group(wait=True)
+            if not group:
+                with self._cond:
+                    if self._stopping and self._head_locked() is None:
+                        return
+                continue
+            pending = self._launch_guarded(group)
+
+    def _dispatch_guarded(self, group):
+        """Zero-requests-lost wrapper: ANY dispatch failure lands on the
+        group's futures (so no caller blocks forever) before
+        propagating."""
+        try:
+            self._dispatch(group)
+        except Exception as exc:
+            for r in group:
+                if not r.future.done():
+                    r.future.set_exception(exc)
+            raise
+
+    def _launch_guarded(self, group):
+        """Worker-loop launch stage: returns the in-flight state, or
+        None after landing a launch failure on the group's futures."""
+        try:
+            return self._launch(group)
+        except Exception as exc:
+            for r in group:
+                if not r.future.done():
+                    r.future.set_exception(exc)
+            return None
+
+    def _resolve_guarded(self, state):
+        if state is None:
+            return
+        try:
+            self._resolve(state)
+        except Exception:
+            pass  # _resolve already landed the error on the futures
+
+    # -- dispatch ----------------------------------------------------------
+
+    def _dispatch(self, group):
+        """One padded-bucket dispatch serving every request in
+        ``group``: supervised placement (or the degraded host route),
+        one kernel call, per-request scatter. The synchronous form
+        (flush / deterministic mode); the worker loop runs the same two
+        stages split for overlap."""
+        self._resolve(self._launch(group))
+
+    def _launch(self, group):
+        """Stage 1: pad, place (supervised or degraded), dispatch the
+        kernel WITHOUT blocking on its result. Returns the in-flight
+        state for :meth:`_resolve`."""
+        head = group[0]
+        kernel_name, params = head.model.op(head.op)
+        site = f"serving.{kernel_name}"
+        kernel = _KERNELS[kernel_name]
+        n = sum(r.n_rows for r in group)
+        full = self._max_batch_rows
+        if n > full:  # one oversized request: pad to its own pow2 bucket
+            full = 1 << max(0, int(n - 1).bit_length())
+        bucket = bucket_rows(max(n, 1), full, min_rows=self._min_bucket)
+        padded = np.zeros((bucket, head.rows.shape[1]), head.rows.dtype)
+        off = 0
+        for r in group:
+            padded[off:off + r.n_rows] = r.rows
+            off += r.n_rows
+
+        observing = _obs.enabled()
+        if observing:
+            _obs.watchdog.track(site, kernel)
+            _obs.watchdog.allow(
+                site, (bucket, str(padded.dtype),
+                       head.model.param_signature(head.op)))
+            self._sites_seen.add(site)
+
+        with self._cond:
+            seq = self._batch_seq
+            self._batch_seq += 1
+
+        degraded = False
+        dev = None
+        state = _sup.breaker.preflight(site=self._site)
+        if state != _sup.CLOSED:
+            # OPEN breaker: the backend is known-wedged and the trip
+            # action already repinned the process to CPU — go straight
+            # to the host route instead of stalling the queue on
+            # retries that cannot succeed
+            degraded = True
+        else:
+            try:
+                dev = _sup.put(lambda t: jax.device_put(t), padded, seq,
+                               site=self._site)
+            except (RuntimeError, OSError):
+                # terminal placement failure (retries exhausted): the
+                # request stream must survive it — degrade this batch
+                degraded = True
+        if degraded:
+            _obs.counter_add("serving.degraded_batches", 1)
+            # host route: plain uncommitted placement on the post-trip
+            # default backend; same kernel, so on the CPU mesh degraded
+            # responses stay bit-identical to supervised ones
+            dev = jnp.asarray(padded)
+
+        try:
+            # async dispatch: the returned array is a handle; the fetch
+            # (and therefore the block) happens in _resolve, so the
+            # worker can assemble the NEXT batch under this compute
+            out_dev = kernel(dev, *params)
+        except Exception as exc:
+            for r in group:
+                if not r.future.done():
+                    r.future.set_exception(exc)
+            self.slo.note_batch(n, bucket, degraded)
+            if observing:
+                _obs.watchdog.observe(site)
+            raise
+        return (group, out_dev, n, bucket, degraded, site, observing)
+
+    def _resolve(self, state):
+        """Stage 2: fetch the batch's device result and scatter it back
+        per request (cache store, future resolution, SLO accounting)."""
+        group, out_dev, n, bucket, degraded, site, observing = state
+        try:
+            out = np.asarray(out_dev)
+        except Exception as exc:
+            for r in group:
+                if not r.future.done():
+                    r.future.set_exception(exc)
+            self.slo.note_batch(n, bucket, degraded)
+            if observing:
+                _obs.watchdog.observe(site)
+            raise
+        done = time.perf_counter()
+        off = 0
+        for r in group:
+            res = np.array(out[off:off + r.n_rows], copy=True)
+            off += r.n_rows
+            if r.cache_key is not None:
+                _cache.store(r.cache_key, res)
+            r.future.set_result(res)
+        self.slo.note_batch_done([r.submitted for r in group], done, n,
+                                 bucket, degraded)
+        # per-batch totals live in the run's `slo` record; emitting
+        # counter/watchdog JSONL per batch at serving rates floods the
+        # artifact (measured: ~75k lines per load-bench run), so budget
+        # enforcement is per-batch only under SQ_OBS_STRICT and every
+        # tracked site gets its one watchdog observation at close()
+        if observing and os.environ.get("SQ_OBS_STRICT") == "1":
+            _obs.watchdog.observe(site)

@@ -1,0 +1,219 @@
+"""Multi-tenant fitted-model registry with LRU residency.
+
+The serving story is multi-tenant by construction — "millions of users"
+means many fitted models behind one dispatcher, not one — and fitted
+models arrive as :func:`~sq_learn_tpu.utils.checkpoint.save_estimator`
+directories (the repo's one durable estimator form). This module is the
+routing table between tenant ids and servable device state:
+
+- :func:`ModelRegistry.register` binds a tenant id to either a
+  checkpoint directory (the production shape: models live on disk, cold)
+  or an in-memory fitted estimator (tests, notebooks). Re-registering a
+  tenant replaces the binding AND evicts any resident copy — a stale
+  resident model must never outlive its registration.
+- :func:`ModelRegistry.resolve` returns the tenant's resident
+  :class:`ServingModel`, loading (digest-verified — checkpoint.py v2
+  refuses a state.npz that does not match its manifest) and wrapping on
+  miss, LRU-evicting beyond ``SQ_SERVE_REGISTRY_CAP`` (default 8
+  resident models): the registry can front arbitrarily many tenants
+  while bounding device residency to the hot set.
+
+:class:`ServingModel` is the adapter the dispatcher batches against: it
+sniffs the fitted surface (``cluster_centers_`` → predict/transform
+against centers; ``components_`` (+ optional ``mean_``) → projection
+transform) into per-op kernel bindings — the params are placed once at
+residency time in the canonical compute dtype, so a dispatch is one
+padded-batch kernel call with no per-request placement. Its
+``fingerprint`` (the checkpoint's ``state_digest``, or a content CRC for
+in-memory models) keys the serving result cache, so a re-registered
+tenant can never be served its predecessor's cached responses.
+
+Registry traffic is observable: ``serving.registry_loads`` /
+``serving.registry_evictions`` counters, and a
+``serving.registry.resolve`` span around each cold load.
+"""
+
+import collections
+import json
+import os
+import threading
+import zlib
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .. import obs as _obs
+from ..utils.checkpoint import load_estimator
+
+__all__ = ["ModelRegistry", "ServingModel"]
+
+
+def _params_digest(arrays):
+    crc = 0
+    for a in arrays:
+        crc = zlib.crc32(np.ascontiguousarray(np.asarray(a)).tobytes(), crc)
+    return f"{crc:08x}"
+
+
+class ServingModel:
+    """One tenant's resident, batch-servable form of a fitted estimator.
+
+    ``ops`` maps op name → ``(kernel name, device params)`` where the
+    kernel name resolves against the dispatcher's instrumented kernel
+    registry (:data:`sq_learn_tpu.serving.dispatcher._KERNELS`) and the
+    params are canonical-dtype device arrays placed once, here. Raises
+    :class:`TypeError` for estimators with no servable surface rather
+    than guessing.
+    """
+
+    __slots__ = ("estimator", "ops", "n_features", "dtype", "fingerprint",
+                 "cacheable")
+
+    def __init__(self, estimator, fingerprint=None):
+        self.estimator = estimator
+        self.ops = {}
+        host_params = []
+        if hasattr(estimator, "cluster_centers_"):
+            centers = np.asarray(estimator.cluster_centers_)
+            self.dtype = jax.dtypes.canonicalize_dtype(centers.dtype)
+            centers_d = jnp.asarray(centers.astype(self.dtype))
+            self.ops["predict"] = ("predict_centers", (centers_d,))
+            self.ops["transform"] = ("transform_centers", (centers_d,))
+            self.n_features = int(centers.shape[1])
+            host_params = [centers]
+        elif hasattr(estimator, "components_"):
+            comps = np.asarray(estimator.components_)
+            self.dtype = jax.dtypes.canonicalize_dtype(comps.dtype)
+            mean = getattr(estimator, "mean_", None)
+            mean = (np.zeros(comps.shape[1], comps.dtype) if mean is None
+                    else np.asarray(mean))
+            comps_d = jnp.asarray(comps.astype(self.dtype))
+            mean_d = jnp.asarray(mean.astype(self.dtype))
+            self.ops["transform"] = ("transform_components",
+                                     (mean_d, comps_d))
+            self.n_features = int(comps.shape[1])
+            host_params = [mean, comps]
+        else:
+            raise TypeError(
+                f"{type(estimator).__name__} has no servable fitted "
+                "surface (expected cluster_centers_ or components_)")
+        #: deterministic ops eligible for the serving result cache —
+        #: transform is a pure function of the fitted state; predict may
+        #: carry a δ>0 noise model, so it never caches
+        self.cacheable = frozenset({"transform"})
+        self.fingerprint = (str(fingerprint) if fingerprint
+                            else _params_digest(host_params))
+
+    def op(self, name):
+        """(kernel name, device params) for ``name``; KeyError lists the
+        ops this model actually serves."""
+        try:
+            return self.ops[name]
+        except KeyError:
+            raise KeyError(
+                f"op {name!r} not served by {type(self.estimator).__name__}"
+                f" (available: {sorted(self.ops)})") from None
+
+    def param_signature(self, name):
+        """Shape signature of the op's params — the watchdog
+        allowed-signature component that keeps two tenants with
+        different model shapes from sharing one compile budget slot."""
+        return tuple(tuple(int(d) for d in p.shape)
+                     for p in self.ops[name][1])
+
+
+def _is_path(source):
+    return isinstance(source, (str, os.PathLike))
+
+
+class ModelRegistry:
+    """tenant id → servable model, with bounded LRU residency."""
+
+    def __init__(self, capacity=None):
+        self._capacity = (int(os.environ.get("SQ_SERVE_REGISTRY_CAP", 8))
+                          if capacity is None else int(capacity))
+        if self._capacity < 1:
+            raise ValueError(f"registry capacity must be >= 1, "
+                             f"got {self._capacity}")
+        self._lock = threading.RLock()
+        self._sources = {}
+        self._resident = collections.OrderedDict()
+
+    def register(self, tenant, source):
+        """Bind ``tenant`` to a checkpoint directory or fitted estimator.
+        Replaces any previous binding and evicts the resident copy."""
+        tenant = str(tenant)
+        if not _is_path(source) and not hasattr(source, "get_params"):
+            raise TypeError("source must be a checkpoint path or a fitted "
+                            f"estimator, got {type(source).__name__}")
+        with self._lock:
+            self._sources[tenant] = source
+            self._resident.pop(tenant, None)
+        return self
+
+    def unregister(self, tenant):
+        with self._lock:
+            self._sources.pop(str(tenant), None)
+            self._resident.pop(str(tenant), None)
+
+    def tenants(self):
+        with self._lock:
+            return sorted(self._sources)
+
+    def resident_tenants(self):
+        with self._lock:
+            return list(self._resident)
+
+    def __contains__(self, tenant):
+        with self._lock:
+            return str(tenant) in self._sources
+
+    def resolve(self, tenant):
+        """The tenant's resident :class:`ServingModel` (LRU-touch),
+        loading on miss. Unknown tenants raise KeyError; a checkpoint
+        whose digest mismatches raises straight through — serving a
+        model whose state cannot be trusted is worse than a 500."""
+        tenant = str(tenant)
+        with self._lock:
+            model = self._resident.get(tenant)
+            if model is not None:
+                self._resident.move_to_end(tenant)
+                return model
+            try:
+                source = self._sources[tenant]
+            except KeyError:
+                raise KeyError(f"tenant {tenant!r} is not registered "
+                               f"(known: {sorted(self._sources)})") from None
+        # load OUTSIDE the lock: a cold checkpoint read must not stall
+        # every concurrent resolve of already-resident tenants
+        with _obs.span("serving.registry.resolve", tenant=tenant,
+                       cold=True):
+            if _is_path(source):
+                fingerprint = self._checkpoint_digest(source)
+                est = load_estimator(source)
+            else:
+                fingerprint = None
+                est = source
+            model = ServingModel(est, fingerprint)
+        _obs.counter_add("serving.registry_loads", 1)
+        with self._lock:
+            # another thread may have raced the same cold load; last
+            # writer wins either way (the models are equivalent)
+            self._resident[tenant] = model
+            self._resident.move_to_end(tenant)
+            while len(self._resident) > self._capacity:
+                evicted, _ = self._resident.popitem(last=False)
+                _obs.counter_add("serving.registry_evictions", 1)
+                _obs.gauge("serving.registry_evicted", evicted)
+        return model
+
+    @staticmethod
+    def _checkpoint_digest(path):
+        """The checkpoint's recorded state digest (None for v1
+        checkpoints — the ServingModel falls back to a params CRC)."""
+        try:
+            with open(os.path.join(path, "meta.json")) as fh:
+                return json.load(fh).get("state_digest")
+        except Exception:
+            return None

@@ -1,0 +1,179 @@
+"""Serving SLO accounting: p50/p99 latency, sustained QPS, batch
+occupancy, degrade counts — and the gate that judges them.
+
+The ROADMAP's north star is "heavy traffic from millions of users", and
+a serving layer without latency-distribution accounting cannot state
+whether it meets that bar — means hide tail latency, and tail latency is
+the serving metric (every queueing effect, compile hiccup, and degrade
+lands in the p99). This module is the dispatcher's scoreboard:
+
+- :class:`SloTracker` collects one entry per served request (queue wait +
+  dispatch, measured submit→result on the host clock) and one entry per
+  dispatched batch (valid rows vs bucket rows — the padding-efficiency
+  number — plus whether the batch degraded to the host route).
+- :meth:`SloTracker.emit` folds the run into ONE ``slo`` obs record
+  (schema v4, validated by :mod:`sq_learn_tpu.obs.schema`): p50/p99 in
+  milliseconds, sustained QPS over the submit→last-result window, mean
+  batch occupancy, degrade count, and a ``violated`` flag against the
+  declared targets. The record lands in the run's JSONL sink like every
+  other observation, renders in the report CLI, and its headline numbers
+  ride the bench lines the regression gate bands.
+
+SLO **gating**: targets come from the dispatcher's ``slo_p50_ms`` /
+``slo_p99_ms`` arguments or the ``SQ_SERVE_SLO_P50_MS`` /
+``SQ_SERVE_SLO_P99_MS`` env knobs (unset = no target on that percentile;
+no targets at all = the record is informational and ``violated`` is
+always False). ``SQ_SERVE_SLO_STRICT=1`` turns a violated emit into a
+raised :class:`SloViolation` — the serving twin of
+``SQ_OBS_STRICT``/``SQ_OBS_AUDIT_STRICT``: CI jobs that declare a latency
+contract fail loudly instead of shipping a red dashboard.
+
+Percentiles use the nearest-rank definition (ceil(q·n)-th order
+statistic) — the conventional SLO read: p99 is an actually-observed
+latency, never an interpolation below the worst request.
+"""
+
+import os
+import threading
+import time
+
+from .. import obs as _obs
+
+__all__ = ["SloTracker", "SloViolation", "percentile"]
+
+
+class SloViolation(RuntimeError):
+    """A declared p50/p99 target was exceeded under
+    ``SQ_SERVE_SLO_STRICT=1``; the message carries the realized and
+    declared numbers."""
+
+
+def percentile(values, q):
+    """Nearest-rank percentile (q in (0, 1]) of a non-empty sequence."""
+    import math
+
+    ordered = sorted(values)
+    rank = min(len(ordered), max(1, math.ceil(len(ordered) * q)))
+    return ordered[rank - 1]
+
+
+def _env_target(name):
+    raw = os.environ.get(name)
+    return float(raw) if raw else None
+
+
+class SloTracker:
+    """Thread-safe per-run serving scoreboard (one per dispatcher)."""
+
+    def __init__(self, site="serving.dispatcher", slo_p50_ms=None,
+                 slo_p99_ms=None):
+        self.site = site
+        self.slo_p50_ms = (slo_p50_ms if slo_p50_ms is not None
+                           else _env_target("SQ_SERVE_SLO_P50_MS"))
+        self.slo_p99_ms = (slo_p99_ms if slo_p99_ms is not None
+                           else _env_target("SQ_SERVE_SLO_P99_MS"))
+        self._lock = threading.Lock()
+        self._latencies_s = []
+        self._batches = 0
+        self._occupancy_sum = 0.0
+        self._degraded = 0
+        self._first_submit = None
+        self._last_done = None
+
+    # -- inputs ------------------------------------------------------------
+
+    def note_submit(self, ts=None):
+        ts = time.perf_counter() if ts is None else ts
+        with self._lock:
+            if self._first_submit is None or ts < self._first_submit:
+                self._first_submit = ts
+        return ts
+
+    def note_request_done(self, submitted_ts, ts=None):
+        ts = time.perf_counter() if ts is None else ts
+        with self._lock:
+            self._latencies_s.append(ts - submitted_ts)
+            if self._last_done is None or ts > self._last_done:
+                self._last_done = ts
+
+    def note_batch(self, valid_rows, bucket_rows, degraded):
+        with self._lock:
+            self._batches += 1
+            self._occupancy_sum += (valid_rows / bucket_rows
+                                    if bucket_rows else 0.0)
+            if degraded:
+                self._degraded += 1
+
+    def note_batch_done(self, submit_timestamps, done_ts, valid_rows,
+                        bucket_rows, degraded):
+        """One dispatched batch's whole scoreboard update under a single
+        lock — the scatter path runs per batch, not per request (the
+        per-request lock traffic was a measurable slice of the
+        micro-batching amortization floor)."""
+        with self._lock:
+            for ts in submit_timestamps:
+                self._latencies_s.append(done_ts - ts)
+            if self._last_done is None or done_ts > self._last_done:
+                self._last_done = done_ts
+            self._batches += 1
+            self._occupancy_sum += (valid_rows / bucket_rows
+                                    if bucket_rows else 0.0)
+            if degraded:
+                self._degraded += 1
+
+    # -- outputs -----------------------------------------------------------
+
+    def summary(self):
+        """The run-so-far numbers as a plain dict (ms/qps scale)."""
+        with self._lock:
+            lat = list(self._latencies_s)
+            batches = self._batches
+            occ_sum = self._occupancy_sum
+            degraded = self._degraded
+            window = ((self._last_done - self._first_submit)
+                      if lat and self._last_done is not None
+                      and self._first_submit is not None else 0.0)
+        n = len(lat)
+        p50 = percentile(lat, 0.50) * 1e3 if lat else 0.0
+        p99 = percentile(lat, 0.99) * 1e3 if lat else 0.0
+        qps = (n / window) if window > 0 else 0.0
+        occupancy = (occ_sum / batches) if batches else 0.0
+        targets = {}
+        if self.slo_p50_ms is not None:
+            targets["p50_ms"] = self.slo_p50_ms
+        if self.slo_p99_ms is not None:
+            targets["p99_ms"] = self.slo_p99_ms
+        violated = bool(
+            (self.slo_p50_ms is not None and p50 > self.slo_p50_ms)
+            or (self.slo_p99_ms is not None and p99 > self.slo_p99_ms))
+        return {
+            "site": self.site,
+            "requests": n,
+            "batches": batches,
+            "p50_ms": round(p50, 4),
+            "p99_ms": round(p99, 4),
+            "qps": round(qps, 3),
+            "batch_occupancy": round(min(1.0, occupancy), 4),
+            "degraded": degraded,
+            "window_s": round(window, 6),
+            "violated": violated,
+            **({"targets": targets} if targets else {}),
+        }
+
+    def emit(self):
+        """One ``slo`` obs record for the run so far. Always returns the
+        summary dict (recorded only when a recorder is active); under
+        ``SQ_SERVE_SLO_STRICT=1`` a violated target raises
+        :class:`SloViolation` AFTER the record lands — the artifact must
+        carry the evidence of the violation it reports."""
+        summary = self.summary()
+        rec = _obs.get_recorder()
+        if rec is not None:
+            rec.record(dict(summary, type="slo"), kind="slo_records")
+        if summary["violated"] and \
+                os.environ.get("SQ_SERVE_SLO_STRICT") == "1":
+            raise SloViolation(
+                f"serving SLO violated at {self.site}: realized "
+                f"p50={summary['p50_ms']}ms p99={summary['p99_ms']}ms "
+                f"against targets {summary.get('targets')}")
+        return summary

@@ -1,0 +1,151 @@
+"""Serving smoke: the ISSUE 9 contract end to end, in seconds.
+
+``make serve-smoke`` runs this module on the CPU backend:
+
+1. fit two tiny tenants (a q-means predict/transform surface and an SVD
+   projection surface), **checkpoint them to disk**, and register the
+   checkpoint directories — so every resolve exercises the
+   digest-verified v2 load path;
+2. a deterministic micro-batched load (mixed tenants, ops, request
+   sizes, and input dtypes) through the dispatcher; every response must
+   row-match the estimator's own predict/transform surface;
+3. a repeated identical transform request — the digest-keyed result
+   cache must hit;
+4. a fault leg: one transient injected transfer failure absorbed by the
+   supervised placement, responses bit-equal to the clean run's;
+5. SLO emission + schema validation: the run's JSONL must validate and
+   carry ≥1 ``slo`` record (the v4 type this PR mints).
+
+Exit code 0 = contract holds; 1 = violation (printed as JSON). Pins the
+CPU backend in-process first, like every contract smoke.
+"""
+
+import json
+import os
+import tempfile
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from ..models import QKMeans, TruncatedSVD
+    from ..obs import disable, enable, get_recorder
+    from ..obs.schema import validate_jsonl
+    from ..resilience import faults
+    from ..resilience.supervisor import breaker
+    from ..utils.checkpoint import save_estimator
+    from . import MicroBatchDispatcher, ModelRegistry
+    from . import cache as serve_cache
+
+    path = os.environ.get("SQ_OBS_PATH", "/tmp/sq_serve_smoke.jsonl")
+    open(path, "w").close()
+    enable(path)
+
+    failures = []
+
+    def check(cond, msg):
+        if not cond:
+            failures.append(msg)
+
+    rng = np.random.default_rng(0)
+    m = 16
+    X = (rng.normal(size=(600, m))
+         + 6.0 * rng.integers(0, 4, size=(600, 1))).astype(np.float32)
+    qkm = QKMeans(n_clusters=4, random_state=0).fit(X)
+    svd = TruncatedSVD(n_components=4, random_state=0).fit(X)
+
+    tmp = tempfile.mkdtemp(prefix="sq_serve_smoke_")
+    reg = ModelRegistry()
+    reg.register("alpha", save_estimator(qkm, os.path.join(tmp, "alpha")))
+    reg.register("beta", save_estimator(svd, os.path.join(tmp, "beta")))
+
+    sizes = [1, 3, 8, 21, 64]
+    requests = []
+    for i in range(40):
+        rows = rng.normal(size=(sizes[i % len(sizes)], m))
+        rows = rows.astype(np.float32 if i % 2 else np.float64)
+        tenant, op = [("alpha", "predict"), ("alpha", "transform"),
+                      ("beta", "transform")][i % 3]
+        requests.append((tenant, op, rows))
+
+    def run_load():
+        serve_cache.clear()
+        d = MicroBatchDispatcher(reg, background=False, max_batch_rows=128)
+        futs = [d.submit(t, op, rows) for t, op, rows in requests]
+        d.flush()
+        outs = [f.result(timeout=30) for f in futs]
+        slo = d.close()
+        return outs, slo
+
+    clean, slo = run_load()
+    check(len(clean) == len(requests), "a request was lost")
+    check(slo["requests"] == len(requests),
+          f"slo counted {slo['requests']} of {len(requests)} requests")
+    check(slo["p99_ms"] >= slo["p50_ms"] >= 0.0, "percentiles disordered")
+
+    # parity against the estimators' own surfaces
+    for (tenant, op, rows), out in zip(requests, clean):
+        r32 = rows.astype(np.float32)
+        if tenant == "alpha" and op == "predict":
+            ref = qkm.predict(r32)
+            check(np.array_equal(out, ref),
+                  "predict response != estimator predict")
+        elif tenant == "alpha":
+            ref = qkm.transform(r32)
+            check(np.allclose(out, ref, atol=1e-4),
+                  "transform response != estimator transform")
+        else:
+            ref = svd.transform(r32)
+            check(np.allclose(out, ref, atol=1e-4),
+                  "projection response != estimator transform")
+
+    # repeated identical transform: digest-keyed cache must hit
+    rec = get_recorder()
+    probe_rows = requests[1][2]
+    d = MicroBatchDispatcher(reg, background=False)
+    first = d.serve("alpha", "transform", probe_rows)
+    hits0 = serve_cache.stats()["hits"]
+    second = d.serve("alpha", "transform", probe_rows)
+    d.close()
+    check(serve_cache.stats()["hits"] == hits0 + 1,
+          "repeated identical transform did not hit the result cache")
+    check(rec.counters.get("serving.cache_hits", 0) >= 1,
+          "close() did not flush the aggregated cache counters")
+    check(np.array_equal(first, second), "cache hit diverged from compute")
+
+    # fault leg: one transient transfer failure, absorbed — bit parity
+    os.environ["SQ_RETRY_BACKOFF_S"] = "0.001"
+    faults.arm("put_fail:tiles=0,times=1")
+    try:
+        faulted, _ = run_load()
+    finally:
+        faults.disarm()
+        del os.environ["SQ_RETRY_BACKOFF_S"]
+        breaker.reset("serve smoke teardown")
+    check(all(np.array_equal(a, b) for a, b in zip(clean, faulted)),
+          "faulted responses are not bit-equal to the clean run")
+
+    disable()
+    summary = validate_jsonl(path)
+    check(not summary["errors"], f"schema errors: {summary['errors'][:5]}")
+    check(summary["by_type"].get("slo", 0) >= 1,
+          f"expected >=1 slo record, got {summary['by_type']}")
+    check(summary["by_type"].get("fault", 0) >= 1,
+          f"expected >=1 fault record, got {summary['by_type']}")
+
+    print(json.dumps({
+        "serve_smoke": "fail" if failures else "ok",
+        "requests": len(requests),
+        "slo": {k: slo[k] for k in ("requests", "p50_ms", "p99_ms", "qps",
+                                    "batch_occupancy", "degraded")},
+        "jsonl": summary["by_type"],
+        "errors": failures,
+    }))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -67,6 +67,7 @@ from .resilience import supervisor as _sup
 
 __all__ = [
     "StreamCheckpoint",
+    "bucket_rows",
     "is_row_source",
     "stream_tile_bytes",
     "plan_row_tiles",
@@ -127,21 +128,33 @@ def worth_streaming(X, max_bytes=None):
     return nbytes > (stream_tile_bytes() if max_bytes is None else max_bytes)
 
 
-def _bucket_rows(n, full_rows, multiple=1):
+def _bucket_rows(n, full_rows, multiple=1, min_rows=None):
     """Bucketed row count for a tile holding ``n`` valid rows: the full
     tile size for full tiles, else the smallest power-of-two ≥ n (floored
-    at ``_MIN_BUCKET_ROWS``, capped at the full tile size). The bucket
-    set for a pass is therefore {full_rows} ∪ {2^j}, so a sweep of
-    dataset sizes compiles each kernel at most once per bucket.
-    ``multiple`` rounds every bucket up to a device-count multiple (the
-    mesh variant's equal-shard requirement)."""
+    at ``min_rows``, default the module-level ``_MIN_BUCKET_ROWS`` env
+    knob, capped at the full tile size). The bucket set for a pass is
+    therefore {full_rows} ∪ {2^j}, so a sweep of dataset sizes compiles
+    each kernel at most once per bucket. ``multiple`` rounds every bucket
+    up to a device-count multiple (the mesh variant's equal-shard
+    requirement)."""
     if n >= full_rows:
         return full_rows
-    b = _MIN_BUCKET_ROWS
+    b = _MIN_BUCKET_ROWS if min_rows is None else int(min_rows)
     while b < n:
         b <<= 1
     b = -(-b // multiple) * multiple
     return min(b, full_rows)
+
+
+def bucket_rows(n, full_rows, multiple=1, min_rows=None):
+    """Public bucket helper: the padded row count a tile of ``n`` valid
+    rows dispatches at. ``min_rows`` floors the tail buckets PER CALL —
+    consumers with their own bucket regime (the serving dispatcher's
+    request-sized 8/64/512 buckets) pick it here instead of mutating the
+    process-wide ``SQ_STREAM_MIN_BUCKET_ROWS`` env; ``min_rows=None``
+    keeps the env-derived default, bit-identical to the historical
+    behavior."""
+    return _bucket_rows(int(n), int(full_rows), multiple, min_rows)
 
 
 def plan_row_tiles(n_rows, row_bytes, max_bytes=None, multiple=1):
@@ -370,9 +383,10 @@ def stream_fold(X, step, init, *, max_bytes=None, device=None, put=None,
     opts out even of the env-derived default — required for folds whose
     accumulator contains a dataset-sized resident buffer (the q-means
     ingest), where every snapshot would host-sync and write O(n·m)
-    bytes. A completed pass deletes its checkpoint. Resumed results are bit-identical to an
-    uninterrupted pass: the npz round-trip is lossless and the remaining
-    tiles replay the same kernels in the same order.
+    bytes. A completed pass deletes its checkpoint. Resumed results are
+    bit-identical to an uninterrupted pass: the npz round-trip is
+    lossless and the remaining tiles replay the same kernels in the
+    same order.
     """
     source = is_row_source(X)
     if source:

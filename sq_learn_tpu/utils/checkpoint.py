@@ -27,12 +27,31 @@ kilobytes, not terabytes.
 import importlib
 import json
 import os
+import zlib
 
 import numpy as np
 import jax
 
 
 _SCALARS = (int, float, bool, str, type(None))
+
+#: estimator-checkpoint format version history: 1 = PR 1's meta.json +
+#: state.npz layout; 2 (PR 9) adds ``state_digest`` (CRC32 over the
+#: state.npz bytes) + this ``format_version`` field so a consumer — the
+#: serving model registry above all — can reject a stale/bit-rotted/
+#: hand-edited checkpoint with a clear error instead of silently serving
+#: it. v1 checkpoints (no digest) still load; a FUTURE format version is
+#: refused (an unknown layout must fail loudly, the schema-validator
+#: rule applied to checkpoints).
+FORMAT_VERSION = 2
+
+
+def _file_crc32(path):
+    crc = 0
+    with open(path, "rb") as fh:
+        for chunk in iter(lambda: fh.read(1 << 20), b""):
+            crc = zlib.crc32(chunk, crc)
+    return f"{crc:08x}"
 
 
 def _class_path(obj):
@@ -94,8 +113,14 @@ def save_estimator(estimator, path):
         if isinstance(v, (np.ndarray, jax.Array)):
             arrays[f"param_{k}"] = np.asarray(v)
 
+    # the npz is written FIRST so its content digest can ride in the
+    # meta — load_estimator verifies the digest before reconstructing,
+    # turning silent state corruption/substitution into a loud error
+    np.savez(os.path.join(path, "state.npz"), **arrays)
     meta = {
         "format": "sq-learn-tpu-estimator-v1",
+        "format_version": FORMAT_VERSION,
+        "state_digest": _file_crc32(os.path.join(path, "state.npz")),
         "class": _class_path(estimator),
         "params": params,
         "skipped_params": skipped_params,
@@ -105,16 +130,37 @@ def save_estimator(estimator, path):
     }
     with open(os.path.join(path, "meta.json"), "w") as f:
         json.dump(meta, f, indent=1, default=str)
-    np.savez(os.path.join(path, "state.npz"), **arrays)
     return path
 
 
 def load_estimator(path):
-    """Reconstruct an estimator saved by :func:`save_estimator`."""
+    """Reconstruct an estimator saved by :func:`save_estimator`.
+
+    v2 checkpoints are digest-verified: the CRC32 of ``state.npz`` must
+    match ``meta.state_digest`` or a :class:`ValueError` names the
+    mismatch — the serving registry's stale-model guard. v1 checkpoints
+    (no digest) load unchecked; a checkpoint claiming a FUTURE format
+    version is refused rather than misread.
+    """
     with open(os.path.join(path, "meta.json")) as f:
         meta = json.load(f)
     if meta.get("format") != "sq-learn-tpu-estimator-v1":
         raise ValueError(f"not an estimator checkpoint: {path}")
+    version = meta.get("format_version", 1)
+    if version > FORMAT_VERSION:
+        raise ValueError(
+            f"estimator checkpoint {path} has format_version {version}; "
+            f"this build reads <= {FORMAT_VERSION} — refusing to guess "
+            "at an unknown layout")
+    digest = meta.get("state_digest")
+    if digest is not None:
+        actual = _file_crc32(os.path.join(path, "state.npz"))
+        if actual != digest:
+            raise ValueError(
+                f"estimator checkpoint {path} is stale or corrupt: "
+                f"state.npz digest {actual} != recorded {digest} "
+                "(refusing to serve a fitted model whose state does not "
+                "match its manifest)")
     npz = np.load(os.path.join(path, "state.npz"))
     params = {}
     for k, v in meta["params"].items():

@@ -37,6 +37,42 @@ def test_estimator_roundtrip_kmeans(tmp_path, blobs):
     np.testing.assert_array_equal(km2.predict(X[:20]), km.predict(X[:20]))
 
 
+def test_checkpoint_digest_and_format_version(tmp_path, blobs):
+    """v2 checkpoints carry a content digest + format version; a
+    tampered state.npz is refused on load, as is a future format."""
+    import json
+
+    X, _ = blobs
+    km = KMeans(n_clusters=3, n_init=2, random_state=0).fit(X)
+    path = save_estimator(km, str(tmp_path / "km_digest"))
+    meta = json.load(open(tmp_path / "km_digest" / "meta.json"))
+    assert meta["format_version"] == 2
+    assert len(meta["state_digest"]) == 8
+    load_estimator(path)  # clean digest verifies
+
+    # flip one byte of the fitted state behind the manifest's back
+    state = tmp_path / "km_digest" / "state.npz"
+    blob = bytearray(state.read_bytes())
+    blob[-1] ^= 0xFF
+    state.write_bytes(bytes(blob))
+    with pytest.raises(ValueError, match="stale or corrupt"):
+        load_estimator(path)
+    state.write_bytes(bytes(blob[:-1] + bytearray([blob[-1] ^ 0xFF])))
+
+    # a FUTURE format version must be refused, not misread
+    meta["format_version"] = 99
+    json.dump(meta, open(tmp_path / "km_digest" / "meta.json", "w"))
+    with pytest.raises(ValueError, match="format_version"):
+        load_estimator(path)
+
+    # v1 checkpoints (no digest/version keys) still load
+    for k in ("format_version", "state_digest"):
+        meta.pop(k)
+    json.dump(meta, open(tmp_path / "km_digest" / "meta.json", "w"))
+    km2 = load_estimator(path)
+    np.testing.assert_allclose(km2.cluster_centers_, km.cluster_centers_)
+
+
 def test_estimator_roundtrip_qpca(tmp_path, blobs):
     X, _ = blobs
     p = QPCA(n_components=3, random_state=0).fit(X)

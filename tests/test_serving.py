@@ -1,0 +1,376 @@
+"""Serving-layer contract tests (ISSUE 9).
+
+The load-bearing ones: per-request responses are bit-equal between a
+fault-injected run (supervised-put retries, breaker trip, host-route
+degrade) and a clean run with ZERO requests lost; the retracing
+watchdog's ≤1-compile-per-(bucket, dtype, model-shape) budget holds
+under mixed request sizes with ``SQ_OBS_STRICT=1`` armed; and the
+registry refuses digest-mismatched checkpoints instead of serving them.
+All deterministic legs run the dispatcher in ``background=False`` mode
+(submission-order batching, no timers), so the parity claims are exact,
+not probabilistic.
+"""
+
+import numpy as np
+import pytest
+
+from sq_learn_tpu import obs
+from sq_learn_tpu.models import QKMeans, TruncatedSVD
+from sq_learn_tpu.obs.schema import validate_record
+from sq_learn_tpu.resilience import faults
+from sq_learn_tpu.resilience.supervisor import breaker
+from sq_learn_tpu.serving import (MicroBatchDispatcher, ModelRegistry,
+                                  ServingModel, SloTracker, SloViolation)
+from sq_learn_tpu.serving import cache as serve_cache
+from sq_learn_tpu.serving.slo import percentile
+from sq_learn_tpu.utils.checkpoint import save_estimator
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    rng = np.random.default_rng(0)
+    m = 12
+    X = (rng.normal(size=(400, m))
+         + 5.0 * rng.integers(0, 3, size=(400, 1))).astype(np.float32)
+    qkm = QKMeans(n_clusters=3, random_state=0, n_init=1).fit(X)
+    svd = TruncatedSVD(n_components=3, random_state=0).fit(X)
+    return {"X": X, "m": m, "qkm": qkm, "svd": svd}
+
+
+@pytest.fixture
+def registry(fitted):
+    reg = ModelRegistry()
+    reg.register("a", fitted["qkm"])
+    reg.register("b", fitted["svd"])
+    return reg
+
+
+@pytest.fixture(autouse=True)
+def _serving_hygiene():
+    serve_cache.clear()
+    yield
+    serve_cache.clear()
+    faults.disarm()
+    breaker.reset("test teardown")
+    if obs.enabled():
+        obs.disable()
+
+
+def _requests(fitted, n=24, sizes=(1, 5, 17, 40)):
+    rng = np.random.default_rng(7)
+    return [rng.normal(size=(sizes[i % len(sizes)], fitted["m"]))
+            .astype(np.float32) for i in range(n)]
+
+
+# -- batching / parity -------------------------------------------------------
+
+
+def test_microbatch_parity_and_ordering(registry, fitted):
+    reqs = _requests(fitted)
+    d = MicroBatchDispatcher(registry, background=False, max_batch_rows=64)
+    futs = [d.submit("a", "predict", r) for r in reqs]
+    d.flush()
+    qkm = fitted["qkm"]
+    for r, f in zip(reqs, futs):
+        out = f.result(timeout=10)
+        assert out.shape == (r.shape[0],)
+        assert np.array_equal(out, qkm.predict(r))
+    slo = d.close()
+    assert slo["requests"] == len(reqs)
+    # coalescing really happened: far fewer dispatches than requests
+    assert slo["batches"] < len(reqs)
+
+
+def test_transform_ops_and_projection(registry, fitted):
+    d = MicroBatchDispatcher(registry, background=False)
+    r = _requests(fitted, n=1)[0]
+    dist = d.serve("a", "transform", r)
+    np.testing.assert_allclose(dist, fitted["qkm"].transform(r), atol=1e-4)
+    proj = d.serve("b", "transform", r)
+    np.testing.assert_allclose(proj, fitted["svd"].transform(r), atol=1e-4)
+    d.close()
+
+
+def test_single_row_and_validation_errors(registry, fitted):
+    d = MicroBatchDispatcher(registry, background=False)
+    row = np.zeros(fitted["m"], np.float32)  # 1D: one sample
+    assert d.serve("a", "predict", row).shape == (1,)
+    with pytest.raises(KeyError):
+        d.submit("nope", "predict", row)
+    with pytest.raises(KeyError):
+        d.submit("b", "predict", row)  # SVD surface serves no predict
+    with pytest.raises(ValueError):
+        d.submit("a", "predict", np.zeros((2, fitted["m"] + 1), np.float32))
+    with pytest.raises(ValueError):
+        d.submit("a", "predict", np.zeros((1, 2, 3), np.float32))
+    d.close()
+    with pytest.raises(RuntimeError):
+        d.submit("a", "predict", row)  # closed dispatcher refuses
+
+
+def test_background_worker_serves_concurrent_clients(registry, fitted):
+    import threading
+
+    reqs = _requests(fitted, n=40)
+    qkm = fitted["qkm"]
+    with MicroBatchDispatcher(registry, max_wait_ms=1.0) as d:
+        outs = [None] * 4
+
+        def client(i):
+            outs[i] = [(r, d.submit("a", "predict", r).result(timeout=30))
+                       for r in reqs[i::4]]
+
+        ts = [threading.Thread(target=client, args=(i,)) for i in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    for chunk in outs:
+        for r, o in chunk:
+            assert np.array_equal(o, qkm.predict(r))
+
+
+def test_submit_many_matches_submit(registry, fitted):
+    reqs = _requests(fitted, n=8)
+    d = MicroBatchDispatcher(registry, background=False)
+    futs = d.submit_many([("a", "predict", r) for r in reqs])
+    d.flush()
+    many = [f.result(timeout=10) for f in futs]
+    d.close()
+    d2 = MicroBatchDispatcher(registry, background=False)
+    one = [d2.serve("a", "predict", r) for r in reqs]
+    d2.close()
+    assert all(np.array_equal(x, y) for x, y in zip(many, one))
+
+
+# -- watchdog / compile budget ----------------------------------------------
+
+
+def test_compile_budget_under_mixed_sizes_strict(registry, fitted,
+                                                 monkeypatch):
+    """Mixed request sizes must stay within ≤1 compile per (bucket,
+    dtype, model-shape) — enforced by the watchdog, with strict mode
+    armed so an excess compile would RAISE, failing this test."""
+    monkeypatch.setenv("SQ_OBS_STRICT", "1")
+    obs.enable()
+    d = MicroBatchDispatcher(registry, background=False, max_batch_rows=64)
+    for r in _requests(fitted, n=30, sizes=(1, 2, 3, 5, 9, 17, 33, 40)):
+        d.submit("a", "predict", r)
+    d.flush()
+    d.close()
+    report = obs.watchdog.report()
+    site = report["serving.predict_centers"]
+    assert not site["over_budget"]
+    assert site["compiles"] <= site["budget"]
+    obs.disable()
+
+
+# -- degradation under failure ----------------------------------------------
+
+
+def test_degrade_path_zero_lost_bit_equal(registry, fitted, monkeypatch):
+    """The ISSUE 9 acceptance scenario: under an SQ_FAULTS schedule that
+    exhausts the supervised put's retries AND trips the breaker, every
+    request is still answered, responses are bit-equal to the unfaulted
+    run, ordering is preserved, and the watchdog budget holds under
+    SQ_OBS_STRICT=1."""
+    monkeypatch.setenv("SQ_RETRY_BACKOFF_S", "0.001")
+    monkeypatch.setenv("SQ_BREAKER_K", "3")
+    monkeypatch.setenv("SQ_OBS_STRICT", "1")
+    reqs = _requests(fitted, n=24)
+
+    def run():
+        serve_cache.clear()
+        obs.enable()
+        d = MicroBatchDispatcher(registry, background=False,
+                                 max_batch_rows=64)
+        futs = [d.submit("a", "predict", r) for r in reqs]
+        d.flush()
+        outs = [f.result(timeout=30) for f in futs]
+        slo = d.close()
+        rec = obs.disable()
+        return outs, slo, rec
+
+    clean, slo_clean, _ = run()
+    assert slo_clean["degraded"] == 0
+
+    # batch 1 fails every put attempt: retries exhaust (terminal put
+    # failure -> degrade) and the 3rd consecutive failure trips the
+    # breaker, so later batches preflight straight to the host route
+    faults.arm("put_fail:tiles=1,times=10")
+    faulted, slo_faulted, rec = run()
+    faults.disarm()
+    breaker.reset("test: degrade leg done")
+
+    assert len(faulted) == len(reqs)  # zero requests lost
+    assert all(np.array_equal(a, b) for a, b in zip(clean, faulted))
+    assert slo_faulted["degraded"] >= 1
+    assert slo_faulted["requests"] == len(reqs)
+    trip = [e for e in rec.breaker_events if e.get("state") == "open"]
+    assert trip, "breaker never tripped under the fault schedule"
+
+
+def test_open_breaker_routes_host_without_supervised_put(registry, fitted,
+                                                         monkeypatch):
+    """With the breaker already OPEN, dispatch must not touch the
+    supervised put at all (a wedged relay would stall it) — straight to
+    the host route, still answering every request."""
+    monkeypatch.setenv("SQ_BREAKER_COOLDOWN_S", "3600")
+    breaker.reset("test setup")
+    for _ in range(3):
+        breaker.record_failure("test wedge")
+    assert breaker.state() == "open"
+    calls = {"puts": 0}
+    from sq_learn_tpu.resilience import supervisor as sup
+
+    real_put = sup.put
+
+    def counting_put(*a, **k):
+        calls["puts"] += 1
+        return real_put(*a, **k)
+
+    monkeypatch.setattr(sup, "put", counting_put)
+    d = MicroBatchDispatcher(registry, background=False)
+    out = d.serve("a", "predict", _requests(fitted, n=1)[0])
+    slo = d.close()
+    assert out is not None and calls["puts"] == 0
+    assert slo["degraded"] == 1
+    breaker.reset("test: open-breaker leg done")
+
+
+# -- result cache ------------------------------------------------------------
+
+
+def test_transform_cache_hits_and_kill_switch(registry, fitted,
+                                              monkeypatch):
+    obs.enable()
+    rec = obs.get_recorder()
+    s0 = serve_cache.stats()
+    r = _requests(fitted, n=1)[0]
+    d = MicroBatchDispatcher(registry, background=False)
+    first = d.serve("a", "transform", r)
+    assert serve_cache.stats()["misses"] == s0["misses"] + 1
+    second = d.serve("a", "transform", r)
+    assert serve_cache.stats()["hits"] == s0["hits"] + 1
+    assert np.array_equal(first, second)
+    # predict is stochastic-capable: never cached
+    d.serve("a", "predict", r)
+    d.serve("a", "predict", r)
+    assert serve_cache.stats()["hits"] == s0["hits"] + 1
+    d.close()
+    # tallies are pre-aggregated: close() flushed them into the obs
+    # counters as deltas, not one JSONL line per lookup
+    assert rec.counters.get("serving.cache_hits", 0) >= 1
+    assert rec.counters.get("serving.cache_misses", 0) >= 1
+    # kill switch
+    monkeypatch.setenv("SQ_SERVE_CACHE", "0")
+    serve_cache.clear()
+    s1 = serve_cache.stats()
+    d = MicroBatchDispatcher(registry, background=False)
+    d.serve("a", "transform", r)
+    d.serve("a", "transform", r)
+    assert serve_cache.stats() == s1  # disabled: no tallies at all
+    d.close()
+    obs.disable()
+
+
+def test_cache_keys_isolate_models_and_payloads(fitted):
+    a = ServingModel(fitted["qkm"])
+    b = ServingModel(fitted["svd"])
+    r = _requests(fitted, n=1)[0]
+    k1 = serve_cache.key_for(a.fingerprint, "transform", r)
+    k2 = serve_cache.key_for(b.fingerprint, "transform", r)
+    assert k1 != k2
+    r2 = r.copy()
+    r2[0, 0] += 1.0
+    assert serve_cache.key_for(a.fingerprint, "transform", r2) != k1
+
+
+# -- registry ----------------------------------------------------------------
+
+
+def test_registry_checkpoint_roundtrip_lru_and_digest_reject(tmp_path,
+                                                             fitted):
+    paths = {}
+    for name, est in (("t0", fitted["qkm"]), ("t1", fitted["svd"]),
+                      ("t2", fitted["qkm"])):
+        paths[name] = save_estimator(est, str(tmp_path / name))
+    reg = ModelRegistry(capacity=2)
+    for name, p in paths.items():
+        reg.register(name, p)
+    m0 = reg.resolve("t0")
+    assert reg.resolve("t0") is m0  # LRU hit returns the resident model
+    reg.resolve("t1")
+    reg.resolve("t2")  # capacity 2: t0 evicted
+    assert "t0" not in reg.resident_tenants()
+    m0b = reg.resolve("t0")  # cold re-load works
+    assert m0b is not m0 and m0b.fingerprint == m0.fingerprint
+
+    # digest verification: corrupt the checkpoint state behind the meta
+    state = tmp_path / "t1" / "state.npz"
+    blob = bytearray(state.read_bytes())
+    blob[-1] ^= 0xFF
+    state.write_bytes(bytes(blob))
+    reg2 = ModelRegistry(capacity=2)
+    reg2.register("t1", paths["t1"])
+    with pytest.raises(ValueError, match="stale or corrupt"):
+        reg2.resolve("t1")
+
+
+def test_reregister_evicts_and_rekeys_cache(registry, fitted):
+    r = _requests(fitted, n=1)[0]
+    d = MicroBatchDispatcher(registry, background=False)
+    before = d.serve("a", "transform", r)
+    old_fp = registry.resolve("a").fingerprint
+    registry.register("a", fitted["svd"])  # new model under the tenant
+    assert "a" not in registry.resident_tenants()
+    after = d.serve("a", "transform", r)
+    assert registry.resolve("a").fingerprint != old_fp
+    # the new model's transform is the projection, not center distances
+    assert not np.allclose(after, before)
+    d.close()
+
+
+def test_serving_model_rejects_unservable():
+    with pytest.raises(TypeError):
+        ServingModel(object())
+
+
+# -- SLO ---------------------------------------------------------------------
+
+
+def test_slo_record_schema_valid_and_gating(monkeypatch):
+    tr = SloTracker("serving.test", slo_p50_ms=1e4, slo_p99_ms=1e4)
+    t0 = tr.note_submit()
+    tr.note_batch_done([t0], t0 + 0.001, valid_rows=4, bucket_rows=8,
+                       degraded=False)
+    obs.enable()
+    rec = tr.emit()
+    stored = obs.get_recorder().slo_records[-1]
+    assert validate_record(stored) == []
+    obs.disable()
+    assert rec["violated"] is False
+    assert rec["requests"] == 1 and rec["batches"] == 1
+    assert rec["batch_occupancy"] == 0.5
+
+    tight = SloTracker("serving.test", slo_p50_ms=1e-6, slo_p99_ms=1e-6)
+    ts = tight.note_submit()
+    tight.note_batch_done([ts], ts + 0.05, 4, 8, False)
+    assert tight.emit()["violated"] is True
+    monkeypatch.setenv("SQ_SERVE_SLO_STRICT", "1")
+    with pytest.raises(SloViolation):
+        tight.emit()
+
+
+def test_percentile_nearest_rank():
+    vals = list(range(1, 101))
+    assert percentile(vals, 0.50) == 50
+    assert percentile(vals, 0.99) == 99
+    assert percentile(vals, 1.0) == 100
+    assert percentile([7.0], 0.99) == 7.0
+
+
+def test_slo_env_targets(monkeypatch):
+    monkeypatch.setenv("SQ_SERVE_SLO_P99_MS", "123.5")
+    tr = SloTracker("serving.test")
+    assert tr.slo_p99_ms == 123.5 and tr.slo_p50_ms is None

@@ -48,6 +48,18 @@ class TestTiler:
         assert streaming._bucket_rows(65, 150, multiple=8) == 128
         assert streaming._bucket_rows(3, 150, multiple=8) == 64
 
+    def test_bucket_rows_per_call_min_rows(self):
+        """The serving dispatcher's per-call floor: serving-sized
+        buckets without mutating SQ_STREAM_MIN_BUCKET_ROWS — and the
+        default path stays bit-identical to the env-derived floor."""
+        assert streaming.bucket_rows(3, 512, min_rows=8) == 8
+        assert streaming.bucket_rows(9, 512, min_rows=8) == 16
+        assert streaming.bucket_rows(600, 512, min_rows=8) == 512
+        assert streaming.bucket_rows(3, 512, min_rows=8, multiple=8) == 8
+        # default min_rows: identical to the module-level floor
+        assert (streaming.bucket_rows(3, 150)
+                == streaming._bucket_rows(3, 150) == 64)
+
     def test_tiles_cover_rows_with_zero_padding(self):
         seen = np.zeros(1003, bool)
         for tile, n_valid, start in streaming.stream_tiles(
