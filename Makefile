@@ -119,10 +119,13 @@ regress-selftest:
 	$(PYTHON) -m sq_learn_tpu.obs regress --selftest
 
 # Out-of-core smoke: tiny shard store -> fault-injected multi-epoch fit
-# (read_fail + corrupt_shard absorbed with bit parity) -> REAL subprocess
-# SIGKILL mid-epoch -> resume from the mid-epoch checkpoint -> bit-parity
-# assert vs the uninterrupted fit, plus schema validation of the read-
-# fault JSONL. The CI-runnable contract check for sq_learn_tpu.oocore.
+# WITH the shard readahead prefetcher enabled (read_fail + corrupt_shard
+# fire on worker threads, absorbed with bit parity vs the serial
+# depth-0 reference) -> REAL subprocess SIGKILL mid-epoch mid-prefetch
+# -> resume from the mid-epoch checkpoint -> bit-parity assert vs the
+# uninterrupted fit, plus schema validation of the read-fault JSONL and
+# the prefetch counters. The CI-runnable contract check for
+# sq_learn_tpu.oocore.
 oocore-smoke:
 	env SQ_OBS=1 SQ_OBS_PATH=/tmp/sq_oocore_smoke.jsonl \
 	    $(PYTHON) -m sq_learn_tpu.oocore.smoke

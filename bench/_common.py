@@ -32,12 +32,18 @@ def obs_snapshot():
 
 
 def emit(metric, value, unit="s", vs_baseline=1.0, baseline_kind=None,
-         **extra):
+         vs_baseline_floor=None, **extra):
     """Print the ONE machine-readable JSON line (extras go to stderr).
 
     ``vs_baseline=None`` means "no baseline was measured" and is emitted
     as JSON null — run_suite.sh's acceptance gate counts that as a MISS,
     so a failed baseline can never silently pass as a 1.0 ratio.
+
+    ``vs_baseline_floor`` also rides IN the JSON line: it is the bench's
+    own declared contract ("this ratio may never drop below X") and the
+    regression gate (`obs/regress.py`) bands ``vs_baseline`` against it
+    as the history-free lower-bounded ``vs_baseline`` gate — a floor in
+    the stderr extras would be invisible to every record consumer.
 
     ``baseline_kind`` rides IN the JSON line (not the stderr extras)
     because cross-record consumers parse only the line: the suite-wide
@@ -62,6 +68,8 @@ def emit(metric, value, unit="s", vs_baseline=1.0, baseline_kind=None,
     }
     if baseline_kind is not None:
         line["baseline_kind"] = baseline_kind
+    if vs_baseline_floor is not None:
+        line["vs_baseline_floor"] = float(vs_baseline_floor)
     snap = obs_snapshot()
     if snap is not None:
         line["obs"] = snap

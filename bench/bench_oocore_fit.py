@@ -13,10 +13,18 @@ Measures the three numbers the out-of-core story lives on:
   wall-clock a death costs, with bit-parity asserted against the
   uninterrupted result.
 
-vs_baseline = in-RAM host fit seconds / out-of-core seconds (<1 ⇒ the
-disk pass costs more than residency, the expected direction; the point
-is bounded memory, not speed). SQ_BENCH_SMOKE=1 shrinks the store to
-seconds while keeping every code path (budget guard, faults, resume).
+vs_baseline = in-RAM host fit seconds / out-of-core seconds. PR 8's
+record sat at 0.818 (the serial read/verify/checkpoint tax); ISSUE 10's
+native-CRC verify + readahead prefetcher + async checkpoints set a
+declared floor of 0.95 — emitted as ``vs_baseline_floor``, which
+`make regress` bands as the history-free lower-bounded ``vs_baseline``
+gate. The explicit prefetch-OFF/ON arms (``fit_noprefetch_s`` /
+``fit_prefetch_s``, bit-parity asserted against the headline) make the
+overlap delta a measured pair in the extras rather than a claim — on a
+single-core host the ON arm trails (nothing to overlap with; the 'auto'
+depth resolves to 0 there), on multi-core it leads. SQ_BENCH_SMOKE=1
+shrinks the store to seconds while keeping every code path (budget
+guard, faults, resume).
 """
 
 import json
@@ -88,6 +96,27 @@ def main():
             warmup=1, reps=1)
         rss_delta = _rss_bytes() - rss0
 
+        # explicit prefetch-OFF / prefetch-ON arms (the headline above
+        # runs the 'auto' depth): the readahead's overlap delta lands in
+        # the extras as a measured pair (and its bit parity as asserts),
+        # not a claim. On a single-core host the ON arm is EXPECTED to
+        # trail slightly (threads time-slice the one core — why 'auto'
+        # resolves to 0 there); multi-core hosts show the overlap win.
+        os.environ["SQ_OOC_PREFETCH_DEPTH"] = "0"
+        serial_s, est_serial = timed(
+            lambda: MiniBatchQKMeans(**est_kw).fit(store),
+            warmup=0, reps=1)
+        os.environ["SQ_OOC_PREFETCH_DEPTH"] = "2"
+        prefetch_s, est_pf = timed(
+            lambda: MiniBatchQKMeans(**est_kw).fit(store),
+            warmup=0, reps=1)
+        del os.environ["SQ_OOC_PREFETCH_DEPTH"]
+        serial_parity = bool(
+            np.array_equal(est.cluster_centers_,
+                           est_serial.cluster_centers_)
+            and np.array_equal(est.cluster_centers_,
+                               est_pf.cluster_centers_))
+
         # killed-and-resumed leg: mid-epoch-2 interrupt, checkpointed
         # every 8 batches, resume must be bit-identical
         os.environ["SQ_STREAM_CKPT_DIR"] = ckpt_dir
@@ -124,8 +153,12 @@ def main():
             shutil.copy(os.path.join(store.path, "manifest.json"),
                         os.path.join(art_dir, "oocore_manifest.json"))
 
+        from sq_learn_tpu.oocore.prefetch import (prefetch_depth,
+                                                  prefetch_threads)
+
         emit(f"oocore_minibatch_{n // 1000}kx{m}_k{k}_2epoch_wallclock",
              fit_s, vs_baseline=(ram_s / fit_s),
+             vs_baseline_floor=0.95,
              store_mb=round(store.nbytes / 2**20, 1),
              ram_budget_mb=round(budget / 2**20, 1),
              budget_guard=budget_guard,
@@ -133,6 +166,12 @@ def main():
              peak_rss_delta_mb=round(rss_delta / 2**20, 1),
              oocore_resident=bool(rss_delta < store.nbytes),
              build_s=round(build_s, 3), ram_fit_s=round(ram_s, 3),
+             fit_noprefetch_s=round(serial_s, 3),
+             fit_prefetch_s=round(prefetch_s, 3),
+             prefetch_speedup=round(serial_s / prefetch_s, 3),
+             prefetch_parity=serial_parity,
+             prefetch_depth=prefetch_depth(),
+             prefetch_threads=prefetch_threads(),
              dead_fit_s=round(dead_s, 3), resume_fit_s=round(resume_s, 3),
              resume_overhead_s=round(dead_s + resume_s - fit_s, 3),
              resume_parity=parity, n_shards=store.n_shards,
@@ -140,6 +179,11 @@ def main():
         if not parity:
             print(json.dumps({"error": "resume parity violated"}),
                   file=sys.stderr)
+            return 1
+        if not serial_parity:
+            print(json.dumps(
+                {"error": "prefetch-on vs prefetch-off parity violated"}),
+                file=sys.stderr)
             return 1
         return 0
     finally:

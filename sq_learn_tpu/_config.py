@@ -313,38 +313,20 @@ _TRANSFER_CHUNK_BYTES = int(
     os.environ.get("SQ_TRANSFER_CHUNK_BYTES", 128 * 2 ** 20))
 
 
-def chunked_device_put(x, device=None, max_bytes=None):
-    """Place host data on ``device`` in row slices of at most ``max_bytes``.
+def _put_host(x, device=None, max_bytes=None):
+    """Place host data on ``device``, streaming anything larger than
+    ``max_bytes`` through the supervised tiled engine.
 
     Semantically identical to ``jax.device_put(np.asarray(x), device)``
-    (dtype canonicalization included), but a large host array crosses the
-    host→device link as several independent transfers that are assembled
-    in device memory — dodging the accelerator-relay hazard documented in
-    CLAUDE.md where one oversized upload wedges the tunnel.
-
-    NOTE: this still ends with the WHOLE array resident (the concatenate
-    doubles peak HBM transiently). Fit paths that only need tile-sequential
-    accumulations should ride :mod:`sq_learn_tpu.streaming` instead — the
-    double-buffered tiled-ingestion engine overlaps each upload with the
-    previous tile's compute and never materializes the input.
-
-    .. deprecated:: PR 3
-        New call sites should use :mod:`sq_learn_tpu.streaming`
-        (``stream_fold``/``streamed_prestats`` for accumulations, or
-        ``streamed_resident_put`` for whole-array placement). Since PR 7
-        this wrapper IS that path: the slicing branch delegates to
-        ``streaming.streamed_resident_put``, so the remaining whole-array
-        placement surface (``as_device_array``) gets supervised bounded
-        transfers, double-buffering, the ``streaming.assemble``
-        watchdog/xla-cost site, and donated in-place assembly (no
-        slice-then-concatenate 2× peak) — only this compatibility
-        signature is deprecated, not the behavior behind it.
-
-    With the default ``max_bytes`` the slicing only engages for non-CPU
-    targets (host→host copies can't wedge a relay and the extra
-    concatenate would be pure overhead); passing ``max_bytes`` explicitly
-    forces slicing on any backend, which is how the CPU-backend tests
-    exercise the assembly path.
+    (dtype canonicalization included). Small operands (and host→host
+    copies under the default cap, which can't wedge a relay) take the
+    direct ``device_put`` fast path; a large host operand bound for an
+    accelerator rides :func:`sq_learn_tpu.streaming.streamed_resident_put`
+    — supervised bounded transfers, double-buffered uploads, donated
+    in-place assembly (no slice-then-concatenate 2× peak), the
+    ``streaming.assemble`` watchdog/xla-cost site. Passing ``max_bytes``
+    explicitly forces the streamed assembly on any backend, which is how
+    the CPU-backend tests exercise it.
     """
     import jax
     import numpy as np
@@ -377,6 +359,20 @@ def chunked_device_put(x, device=None, max_bytes=None):
     return streamed_resident_put(x, device=device, max_bytes=max_bytes)
 
 
+def chunked_device_put(x, device=None, max_bytes=None):
+    """REMOVED (deprecated since PR 3, all in-repo callers migrated by
+    PR 7). The slice-then-concatenate wrapper this name survived for no
+    longer exists; raising keeps external callers' failures loud and
+    actionable instead of silently changing semantics."""
+    raise RuntimeError(
+        "chunked_device_put was removed: use "
+        "sq_learn_tpu.streaming.streamed_resident_put(x, device=..., "
+        "max_bytes=...) for whole-array placement (supervised bounded "
+        "tiles, donated in-place assembly), stream_fold for "
+        "tile-sequential accumulations, or as_device_array for "
+        "config-routed placement.")
+
+
 def as_device_array(x):
     """``jnp.asarray`` honoring ``set_config(device=...)`` — the dispatch
     hook BASELINE designates on the reference's config system
@@ -390,11 +386,11 @@ def as_device_array(x):
     accelerator is never touched when a CPU device is requested.
 
     Large host operands bound for an accelerator are streamed through
-    :func:`chunked_device_put` (see the relay-wedge note there).
+    the supervised tiled engine (see :func:`_put_host`).
     """
     if _get_threadlocal_config()["device"] == "auto":
-        return chunked_device_put(x, None)
-    return chunked_device_put(x, resolve_device())
+        return _put_host(x, None)
+    return _put_host(x, resolve_device())
 
 
 def default_dtype():

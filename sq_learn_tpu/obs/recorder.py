@@ -395,6 +395,14 @@ def snapshot():
         "stats_cache_misses": int(
             rec.counters.get("stats_cache.misses", 0)),
         "sketch_estimates": int(rec.counters.get("sketch.estimates", 0)),
+        # out-of-core prefetch (oocore.prefetch): readahead hit/stall
+        # traffic — a store-backed bench line's evidence that the shard
+        # reads overlapped compute instead of serializing on it
+        "prefetch_hits": int(rec.counters.get("oocore.prefetch_hits", 0)),
+        "prefetch_stalls": int(
+            rec.counters.get("oocore.prefetch_stalls", 0)),
+        "prefetch_stall_s": round(float(
+            rec.counters.get("oocore.prefetch_stall_s", 0.0)), 6),
         # serving layer (sq_learn_tpu.serving): SLO summaries emitted,
         # batches that degraded to the host route, and transform-cache
         # traffic — the bench lines' evidence that a load run's numbers

@@ -28,6 +28,11 @@ throughput             value of a ``unit: "qps"`` line (the serving load
                        ratio × median − slack — the lower-bounded
                        serving band (replaces the latency gate on those
                        lines)
+vs_baseline            a record carrying ``vs_baseline_floor`` whose
+                       ``vs_baseline`` drops UNDER floor × ratio − slack
+                       — the history-free declared-floor band (the
+                       out-of-core fit declares 0.95: store-backed
+                       within 5% of in-RAM)
 =====================  ====================================================
 
 Verdicts are ``green`` / ``red`` / ``skip`` (skip = no reference on that
@@ -75,6 +80,12 @@ TOLERANCES = {
     "peak_hbm_bytes": (1.25, 1 << 20),
     "accuracy": (0.9, 0.02),
     "throughput": (0.5, 0.0),
+    # declared-floor gate: a record carrying "vs_baseline_floor" bands
+    # its own vs_baseline against it (red when vs_baseline < floor × tol
+    # − slack). History-free: the floor is the bench's own contract —
+    # the out-of-core fit declares 0.95 ("store-backed within 5% of
+    # in-RAM", ISSUE 10 acceptance).
+    "vs_baseline": (1.0, 0.0),
 }
 
 #: value-gate selection by the record's unit (default: latency)
@@ -198,6 +209,25 @@ def check_record(rec, history):
             "current": cur, "reference": ref,
             "tolerance": (round(allowed, 6) if allowed is not None
                           else None),
+            "history_n": len(past),
+        })
+    floor = rec.get("vs_baseline_floor")
+    if isinstance(floor, (int, float)) and not isinstance(floor, bool):
+        # a record that declares its own vs_baseline floor gets the
+        # history-free lower-bounded band (see TOLERANCES["vs_baseline"])
+        cur = rec.get("vs_baseline")
+        cur = (float(cur) if isinstance(cur, (int, float))
+               and not isinstance(cur, bool) else None)
+        tol, slack = _tolerance("vs_baseline")
+        allowed = float(floor) * tol - slack
+        verdicts.append({
+            "v": SCHEMA_VERSION, "schema_version": SCHEMA_VERSION,
+            "ts": round(time.time(), 3), "type": "regression",
+            "gate": "vs_baseline", "metric": metric,
+            "verdict": ("skip" if cur is None
+                        else "red" if cur < allowed else "green"),
+            "current": cur, "reference": float(floor),
+            "tolerance": round(allowed, 6),
             "history_n": len(past),
         })
     return verdicts

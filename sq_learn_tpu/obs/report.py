@@ -129,6 +129,20 @@ def summarize(records):
 
     slo = [r for r in records if r.get("type") == "slo"]
 
+    # out-of-core prefetch: readahead hit/stall traffic plus the measured
+    # stall seconds — the numbers that say whether shard reads overlapped
+    # compute or the consumer sat waiting on the disk/CRC pass
+    pf_spans = by_name.get("oocore.prefetch", {})
+    prefetch = {
+        "hits": counters.get("oocore.prefetch_hits", 0),
+        "stalls": counters.get("oocore.prefetch_stalls", 0),
+        "stall_s": counters.get("oocore.prefetch_stall_s", 0.0),
+        "occupancy": counters.get("oocore.prefetch_occupancy", 0),
+        "prefetchers": pf_spans.get("count", 0),
+        "async_ckpt_writes": counters.get("oocore.async_ckpt_writes", 0),
+        "async_ckpt_dropped": counters.get("oocore.async_ckpt_dropped", 0),
+    }
+
     return {
         "by_type": by_type,
         "spans": by_name,
@@ -142,6 +156,7 @@ def summarize(records):
         "probes": probes,
         "gauges": gauges,
         "sketch": sketch,
+        "prefetch": prefetch,
         # the statistical-observability sections (v3): per-site
         # Clopper–Pearson audit of the (ε, δ) guarantee draws, and the
         # run's accuracy-vs-theoretical-runtime sweep points
@@ -241,6 +256,26 @@ def render(summary, top=12):
     else:
         for line in _frontier.render(tr).splitlines():
             out("  " + line)
+
+    out("")
+    out("-- out-of-core prefetch (shard readahead / async checkpoints) --")
+    pf = summary.get("prefetch") or {}
+    gets = pf.get("hits", 0) + pf.get("stalls", 0)
+    if not gets and not pf.get("async_ckpt_writes"):
+        out("  (no prefetch activity)")
+    else:
+        if gets:
+            occ = pf.get("occupancy", 0) / gets
+            out(f"  {pf.get('hits', 0)} hits / {pf.get('stalls', 0)} "
+                f"stalls across {pf.get('prefetchers', 0)} prefetcher(s) "
+                f"({pf.get('hits', 0) / gets:.0%} hit rate, avg depth "
+                f"occupancy {occ:.2f})")
+            out(f"  {pf.get('stall_s', 0.0):.4f}s total consumer stall "
+                f"waiting on shard reads")
+        if pf.get("async_ckpt_writes"):
+            out(f"  {pf.get('async_ckpt_writes', 0)} async checkpoint "
+                f"write(s), {pf.get('async_ckpt_dropped', 0)} superseded "
+                f"before writing (latest-wins)")
 
     out("")
     out("-- serving SLOs (p50/p99 latency, sustained QPS) --")

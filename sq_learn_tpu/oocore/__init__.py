@@ -2,9 +2,11 @@
 
 The package behind ROADMAP item 3: datasets larger than host RAM live as
 CRC-manifested memmap shards (:mod:`.store`), deterministic epoch plans
-schedule multi-pass batch walks over them (:mod:`.epochs`), and the
+schedule multi-pass batch walks over them (:mod:`.epochs`), the
 resumable mini-batch engine (:mod:`.fit`) survives a SIGKILL mid-epoch
-bit-for-bit. The streaming engine (:mod:`sq_learn_tpu.streaming`) reads
+bit-for-bit, and the bounded readahead prefetcher (:mod:`.prefetch`,
+ISSUE 10) overlaps shard materialization + CRC verify with compute —
+depth 0 is the serial path bit-for-bit. The streaming engine (:mod:`sq_learn_tpu.streaming`) reads
 stores directly — ``stream_fold`` and the Gram-route consumers accept a
 :class:`ShardStore` wherever they accept a host array — and
 :class:`~sq_learn_tpu.models.minibatch.MiniBatchQKMeans` /
@@ -20,6 +22,8 @@ parity); ``docs/resilience.md`` §out-of-core and
 
 from .epochs import EpochPlan
 from .fit import assign_labels, minibatch_epoch_fit
+from .prefetch import (PrefetchingSource, ShardPrefetcher, iter_shards,
+                       prefetch_depth, prefetch_threads)
 from .store import (ArraySource, RamBudgetError, ShardCorruptionError,
                     ShardStore, create_synthetic_store, is_source,
                     open_store, store_from_array)
@@ -27,13 +31,18 @@ from .store import (ArraySource, RamBudgetError, ShardCorruptionError,
 __all__ = [
     "ArraySource",
     "EpochPlan",
+    "PrefetchingSource",
     "RamBudgetError",
     "ShardCorruptionError",
+    "ShardPrefetcher",
     "ShardStore",
     "assign_labels",
     "create_synthetic_store",
     "is_source",
+    "iter_shards",
     "minibatch_epoch_fit",
     "open_store",
+    "prefetch_depth",
+    "prefetch_threads",
     "store_from_array",
 ]

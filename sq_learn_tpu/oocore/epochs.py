@@ -56,34 +56,53 @@ class EpochPlan:
         starting at ``start_batch`` (the resume cursor). The tail batch
         carries the real remainder rows (no padding — host consumers
         take any batch length). Shards wholly before the resume point
-        are skipped without being read."""
+        are skipped without being read.
+
+        Shard materialization + CRC verify run ahead of the consumer on
+        the bounded prefetcher (:mod:`sq_learn_tpu.oocore.prefetch`,
+        ``SQ_OOC_PREFETCH_DEPTH``; 0 = serial reads) — the prefetch
+        order IS this plan's visit order, so a skipped shard is never
+        read and depth changes nothing but overlap (bit parity pinned
+        by ``tests/test_oocore.py``)."""
+        from .prefetch import iter_shards
+
         n = source.shape[0]
         b = self.batch_rows
         skip = int(start_batch) * b
         if skip >= n:
             return
-        chunks, have = [], 0
-        bi = int(start_batch)
+        # resolve the visit order (shard, rows-to-drop) up front: only
+        # the first visited shard carries a resume drop, and the order
+        # is what the prefetcher reads ahead
+        visit = []
         for s in self.shard_order(source, epoch):
             rows_s = source.shard_sizes[int(s)]
             if skip >= rows_s:
                 skip -= rows_s
                 continue
-            perm = self.shard_perm(source, epoch, s)
-            if skip:
-                perm = perm[skip:]
-                skip = 0
-            arr = source.read_shard(int(s))[perm]
-            chunks.append(arr)
-            have += arr.shape[0]
-            while have >= b:
-                block = chunks[0] if len(chunks) == 1 \
-                    else np.concatenate(chunks, axis=0)
-                yield bi, block[:b]
-                rest = block[b:]
-                chunks, have = ([rest], rest.shape[0]) if rest.size \
-                    else ([], 0)
-                bi += 1
+            visit.append((int(s), skip))
+            skip = 0
+        chunks, have = [], 0
+        bi = int(start_batch)
+        shards = iter_shards(source, [s for s, _ in visit])
+        try:
+            for (s, drop), raw in zip(visit, shards):
+                perm = self.shard_perm(source, epoch, s)
+                if drop:
+                    perm = perm[drop:]
+                arr = raw[perm]
+                chunks.append(arr)
+                have += arr.shape[0]
+                while have >= b:
+                    block = chunks[0] if len(chunks) == 1 \
+                        else np.concatenate(chunks, axis=0)
+                    yield bi, block[:b]
+                    rest = block[b:]
+                    chunks, have = ([rest], rest.shape[0]) if rest.size \
+                        else ([], 0)
+                    bi += 1
+        finally:
+            shards.close()
         if have:
             yield bi, (chunks[0] if len(chunks) == 1
                        else np.concatenate(chunks, axis=0))

@@ -111,7 +111,8 @@ def minibatch_epoch_fit(source, *, n_clusters, batch_rows=1024,
     no-improvement count plus the per-epoch center shift."""
     from ..models.minibatch import _host_minibatch_step
     from ..streaming import _resolve_checkpoint
-    from ..utils.checkpoint import load_stream_state, save_stream_state
+    from ..utils.checkpoint import (AsyncStreamCheckpointer,
+                                    load_stream_state, save_stream_state)
 
     n, m = source.shape
     k = int(n_clusters)
@@ -141,62 +142,89 @@ def minibatch_epoch_fit(source, *, n_clusters, batch_rows=1024,
         state["centers"] = _init_centers(source, k, b, seed, init)
 
     every = ckpt.every if ckpt is not None else 0
+    # mid-epoch snapshots go to one async writer thread so the batch loop
+    # never stalls on npz + fsync (SQ_OOC_ASYNC_CKPT=0 restores the
+    # serial write); the writer drains before checkpoint deletion AND on
+    # the failure path, so an interrupt still leaves the newest snapshot
+    writer = None
+    if every and os.environ.get("SQ_OOC_ASYNC_CKPT", "1") != "0":
+        writer = AsyncStreamCheckpointer(ckpt.path)
     stop = False
-    with _obs.span("oocore.minibatch_fit", n=n, m=m, k=k,
-                   n_batches=n_batches, resumed_from=resumed_from or None):
-        for epoch in range(int(state["epoch"]), int(max_epochs)):
-            with _obs.span("oocore.epoch", epoch=epoch):
-                for bi, Xb in plan.iter_batches(source, epoch,
-                                                int(state["batch"])):
-                    if _faults._active is not None:
-                        # batch-boundary interrupt hook: the abort
-                        # injector kills an epoch fit exactly like it
-                        # kills a streamed pass
-                        _faults._active.on_tile(int(state["step"]))
-                    Xb = np.ascontiguousarray(Xb, np.float32)
-                    wb = np.ones(Xb.shape[0], np.float32)
-                    xsqb = np.einsum("ij,ij->i", Xb, Xb)
-                    rng = np.random.default_rng(
-                        (int(seed), epoch, bi, 0xBA7C))
-                    centers, counts, inertia = _host_minibatch_step(
-                        rng, Xb, wb, xsqb, state["centers"],
-                        state["counts"], int(state["step"]),
-                        window=float(window),
-                        reassignment_ratio=float(reassignment_ratio))
-                    state["centers"] = np.asarray(centers, np.float32)
-                    state["counts"] = np.asarray(counts, np.float64)
-                    state["step"] += 1
-                    state["batch"] = np.asarray(bi + 1, np.int64)
-                    ewa = (inertia if np.isnan(state["ewa"])
-                           else float(state["ewa"]) * (1 - alpha)
-                           + inertia * alpha)
-                    state["ewa"] = np.asarray(ewa, np.float64)
-                    if ewa < float(state["best_ewa"]) - 1e-12:
-                        state["best_ewa"] = np.asarray(ewa, np.float64)
-                        state["no_improve"] = np.zeros((), np.int64)
-                    else:
-                        state["no_improve"] += 1
-                    if (every and int(state["step"]) % every == 0
-                            and not (epoch == int(max_epochs) - 1
-                                     and bi + 1 >= n_batches)):
-                        save_stream_state(ckpt.path, state,
-                                          int(state["step"]), fingerprint)
-            if verbose:
-                print(f"oocore epoch {epoch + 1}: "
-                      f"ewa inertia {float(state['ewa']):.3f}")
-            if (max_no_improvement is not None
-                    and int(state["no_improve"]) >= max_no_improvement):
-                stop = True
-            prev = state["prev_centers"]
-            if not np.isnan(prev).all() and tol > 0:
-                shift = float(((state["centers"] - prev) ** 2).sum())
-                if shift <= tol:
+    try:
+        with _obs.span("oocore.minibatch_fit", n=n, m=m, k=k,
+                       n_batches=n_batches,
+                       resumed_from=resumed_from or None):
+            for epoch in range(int(state["epoch"]), int(max_epochs)):
+                with _obs.span("oocore.epoch", epoch=epoch):
+                    for bi, Xb in plan.iter_batches(source, epoch,
+                                                    int(state["batch"])):
+                        if _faults._active is not None:
+                            # batch-boundary interrupt hook: the abort
+                            # injector kills an epoch fit exactly like it
+                            # kills a streamed pass
+                            _faults._active.on_tile(int(state["step"]))
+                        Xb = np.ascontiguousarray(Xb, np.float32)
+                        wb = np.ones(Xb.shape[0], np.float32)
+                        xsqb = np.einsum("ij,ij->i", Xb, Xb)
+                        rng = np.random.default_rng(
+                            (int(seed), epoch, bi, 0xBA7C))
+                        centers, counts, inertia = _host_minibatch_step(
+                            rng, Xb, wb, xsqb, state["centers"],
+                            state["counts"], int(state["step"]),
+                            window=float(window),
+                            reassignment_ratio=float(reassignment_ratio))
+                        state["centers"] = np.asarray(centers, np.float32)
+                        state["counts"] = np.asarray(counts, np.float64)
+                        state["step"] += 1
+                        state["batch"] = np.asarray(bi + 1, np.int64)
+                        ewa = (inertia if np.isnan(state["ewa"])
+                               else float(state["ewa"]) * (1 - alpha)
+                               + inertia * alpha)
+                        state["ewa"] = np.asarray(ewa, np.float64)
+                        if ewa < float(state["best_ewa"]) - 1e-12:
+                            state["best_ewa"] = np.asarray(ewa, np.float64)
+                            state["no_improve"] = np.zeros((), np.int64)
+                        else:
+                            state["no_improve"] += 1
+                        if (every and int(state["step"]) % every == 0
+                                and not (epoch == int(max_epochs) - 1
+                                         and bi + 1 >= n_batches)):
+                            if writer is not None:
+                                writer.submit(state, int(state["step"]),
+                                              fingerprint)
+                            else:
+                                save_stream_state(ckpt.path, state,
+                                                  int(state["step"]),
+                                                  fingerprint)
+                if verbose:
+                    print(f"oocore epoch {epoch + 1}: "
+                          f"ewa inertia {float(state['ewa']):.3f}")
+                if (max_no_improvement is not None
+                        and int(state["no_improve"]) >= max_no_improvement):
                     stop = True
-            state["prev_centers"] = state["centers"].copy()
-            state["epoch"] = np.asarray(epoch + 1, np.int64)
-            state["batch"] = np.zeros((), np.int64)
-            if stop:
-                break
+                prev = state["prev_centers"]
+                if not np.isnan(prev).all() and tol > 0:
+                    shift = float(((state["centers"] - prev) ** 2).sum())
+                    if shift <= tol:
+                        stop = True
+                state["prev_centers"] = state["centers"].copy()
+                state["epoch"] = np.asarray(epoch + 1, np.int64)
+                state["batch"] = np.zeros((), np.int64)
+                if stop:
+                    break
+    except BaseException:
+        if writer is not None:
+            # drain so the interrupt leaves its newest snapshot behind,
+            # but never let a writer error mask the real failure
+            try:
+                writer.close()
+            except Exception:
+                pass
+        raise
+    if writer is not None:
+        writer.close()  # drain BEFORE deletion — no resurrecting write
+        _obs.counter_add("oocore.async_ckpt_writes", writer.writes)
+        _obs.counter_add("oocore.async_ckpt_dropped", writer.dropped)
     if ckpt is not None:
         # a finished fit must not leave snapshots a rerun could resume
         for path in (ckpt.path, str(ckpt.path) + ".prev"):
@@ -225,15 +253,22 @@ def assign_labels(source, centers, *, batch_rows=8192):
     labels = np.empty(n, np.int32)
     inertia = 0.0
     rng = np.random.default_rng(0)  # unused: e_only is deterministic
-    with _obs.span("oocore.assign_labels", n=n, m=m):
-        for start in range(0, n, int(batch_rows)):
-            stop = min(n, start + int(batch_rows))
-            Xb = np.ascontiguousarray(source.read_rows(start, stop),
-                                      np.float32)
-            wb = np.ones(Xb.shape[0], np.float32)
-            xsqb = np.einsum("ij,ij->i", Xb, Xb)
-            lb, _, _, _, bi = native.host_lloyd_step(
-                rng, Xb, wb, xsqb, centers, 0.0, e_only=True)
-            labels[start:stop] = lb
-            inertia += float(bi)
+    # natural-order sequential walk: serve it through the bounded shard
+    # readahead when the source opts in (depth 0 returns source itself)
+    walk = source.prefetched() if hasattr(source, "prefetched") else source
+    try:
+        with _obs.span("oocore.assign_labels", n=n, m=m):
+            for start in range(0, n, int(batch_rows)):
+                stop = min(n, start + int(batch_rows))
+                Xb = np.ascontiguousarray(walk.read_rows(start, stop),
+                                          np.float32)
+                wb = np.ones(Xb.shape[0], np.float32)
+                xsqb = np.einsum("ij,ij->i", Xb, Xb)
+                lb, _, _, _, bi = native.host_lloyd_step(
+                    rng, Xb, wb, xsqb, centers, 0.0, e_only=True)
+                labels[start:stop] = lb
+                inertia += float(bi)
+    finally:
+        if walk is not source:
+            walk.close()
     return labels, inertia

@@ -1,0 +1,316 @@
+"""Bounded shard readahead: overlap disk reads + CRC verify with compute.
+
+PR 8 made the out-of-core route correct in bounded RAM; this module makes
+it fast. The serial store walk paid every shard materialization, every
+manifest-CRC verification, and every supervised-read retry ON the compute
+thread — an I/O tax the error-budget thesis can't buy back (ISSUE 10).
+The fix is the same double-buffering discipline the streaming engine uses
+for host→device tiles: while the consumer computes on shard *i*, worker
+threads materialize and CRC-verify shards *i+1..i+d*.
+
+Design contract, in order of importance:
+
+- **bit parity**: the prefetcher calls the store's own
+  :meth:`~sq_learn_tpu.oocore.store.ShardStore.read_shard` — the SAME
+  supervised read, CRC verification, quarantine and bounded re-read, just
+  on a worker thread. Depth 0 (``SQ_OOC_PREFETCH_DEPTH=0``) degrades to
+  the serial path bit-for-bit; any depth > 0 produces identical arrays in
+  identical order by construction.
+- **error provenance**: a worker failure (``ShardCorruptionError``,
+  exhausted retries, an injected fault) is captured and re-raised on the
+  consumer at the position of the shard it belongs to — never earlier,
+  never attributed to a different shard. Shards that were already
+  verified ahead of a failing one still serve.
+- **plan awareness**: the prefetcher reads a caller-supplied shard ORDER
+  (the epoch plan's shuffled visit sequence, or a tile walk's natural
+  order starting at the resume cursor) and touches nothing outside it —
+  a skipped shard is never read.
+- **RAM-budget awareness**: with ``SQ_OOC_RAM_BUDGET_BYTES`` armed,
+  completed-but-unconsumed plus in-flight prefetch bytes stay under
+  ``budget − resident_floor`` (the floor defaults to two shards' worth:
+  the shard the consumer holds plus its assembly buffer). The position
+  the consumer is actually waiting on is always allowed to claim — the
+  store's own single-materialization check still guards it — so a budget
+  too small for readahead degrades to serial, never deadlocks.
+- **observability**: one ``oocore.prefetch`` span per prefetcher lifetime
+  plus ``oocore.prefetch_hits`` / ``oocore.prefetch_stalls`` /
+  ``oocore.prefetch_stall_s`` / ``oocore.prefetch_occupancy`` counters,
+  so a bench record shows where the stall time went instead of claiming.
+
+Knobs: ``SQ_OOC_PREFETCH_DEPTH`` (default 2; 0 = serial),
+``SQ_OOC_PREFETCH_THREADS`` (default 2 workers — also the build pool
+width of :func:`~sq_learn_tpu.oocore.store.create_synthetic_store`).
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+
+from .. import obs as _obs
+from .store import _budget_check, ram_budget_bytes
+
+__all__ = [
+    "PrefetchingSource",
+    "ShardPrefetcher",
+    "iter_shards",
+    "prefetch_depth",
+    "prefetch_threads",
+]
+
+
+def prefetch_depth():
+    """Shard readahead depth. ``SQ_OOC_PREFETCH_DEPTH`` wins when set
+    (0 = the serial path, bit-for-bit); the 'auto' default is 2 on
+    multi-core hosts and 0 on a single-core one — with one CPU the
+    readahead threads can only time-slice the core the consumer computes
+    on (measured ~12% overhead on the dev container), so overlap is only
+    worth buying when there is a second core (or real blocking I/O, at
+    which point the operator sets the knob)."""
+    env = os.environ.get("SQ_OOC_PREFETCH_DEPTH")
+    if env is not None:
+        return int(env)
+    return 2 if (os.cpu_count() or 1) > 1 else 0
+
+
+def prefetch_threads():
+    """Prefetch worker count (``SQ_OOC_PREFETCH_THREADS``, default 2 —
+    enough to overlap one read with one CRC pass; the depth bound, not
+    the thread count, is what limits memory)."""
+    return int(os.environ.get("SQ_OOC_PREFETCH_THREADS", 2))
+
+
+class ShardPrefetcher:
+    """Bounded readahead over a known shard visit ``order``.
+
+    Worker threads claim positions in order and run the source's full
+    verified ``read_shard``; the consumer drains positions strictly
+    sequentially through :meth:`get`. See the module docstring for the
+    contract. ``resident_bytes`` declares the consumer's own residency
+    for the RAM-budget ledger (default: two max-size shards).
+    """
+
+    def __init__(self, source, order, *, depth=None, threads=None,
+                 resident_bytes=None):
+        self.source = source
+        self.order = [int(s) for s in order]
+        self.depth = prefetch_depth() if depth is None else max(0, int(depth))
+        nthreads = prefetch_threads() if threads is None else int(threads)
+        self._threads = max(1, min(nthreads, max(1, self.depth),
+                                   max(1, len(self.order))))
+        itemsize = np.dtype(source.dtype).itemsize
+        row = int(np.prod(source.shape[1:], dtype=np.int64)) * itemsize
+        self._sz = [int(source.shard_sizes[s]) * row for s in self.order]
+        budget = ram_budget_bytes()
+        self._avail = None
+        if budget:
+            floor = (2 * max(self._sz, default=0) if resident_bytes is None
+                     else int(resident_bytes))
+            self._avail = max(0, budget - floor)
+        self._cond = threading.Condition()
+        self._results = {}
+        self._claimed = 0    # next position a worker may claim
+        self._consumed = 0   # next position get() will hand out
+        self._held = 0       # bytes in flight + completed-but-unconsumed
+        self._closed = False
+        self._hits = self._stalls = self._occupancy = 0
+        self._stall_s = 0.0
+        self._span = _obs.span("oocore.prefetch", shards=len(self.order),
+                               depth=self.depth, threads=self._threads)
+        self._span.__enter__()
+        self._workers = [
+            threading.Thread(target=self._worker, daemon=True,
+                             name=f"sq-ooc-prefetch-{i}")
+            for i in range(self._threads)]
+        for t in self._workers:
+            t.start()
+
+    # -- scheduling (caller holds self._cond) --------------------------------
+
+    def _claimable(self):
+        p = self._claimed
+        if p >= len(self.order) or p > self._consumed + self.depth:
+            return False
+        if (p != self._consumed and self._avail is not None
+                and self._held + self._sz[p] > self._avail):
+            # readahead would break the resident+in-flight budget rule;
+            # the position the consumer is waiting on always claims (the
+            # store's single-materialization check still guards it)
+            return False
+        return True
+
+    def _worker(self):
+        while True:
+            with self._cond:
+                while not self._closed and not self._claimable():
+                    self._cond.wait()
+                if self._closed:
+                    return
+                p = self._claimed
+                self._claimed += 1
+                self._held += self._sz[p]
+            try:
+                out = ("ok", self.source.read_shard(self.order[p]))
+            except BaseException as exc:  # surfaces on the consumer at p
+                out = ("err", exc)
+            with self._cond:
+                self._results[p] = out
+                self._cond.notify_all()
+
+    # -- consumer side -------------------------------------------------------
+
+    def get(self, pos):
+        """Shard ``order[pos]``, strictly sequential: ``pos`` must be the
+        next unconsumed position. Blocks until the worker read lands;
+        re-raises a worker-side failure at the position it belongs to."""
+        pos = int(pos)
+        with self._cond:
+            if pos != self._consumed:
+                raise RuntimeError(
+                    f"ShardPrefetcher.get is sequential: expected position "
+                    f"{self._consumed}, got {pos}")
+            self._occupancy += sum(1 for q in self._results if q > pos)
+            if pos in self._results:
+                self._hits += 1
+            else:
+                self._stalls += 1
+                t0 = time.perf_counter()
+                while pos not in self._results and not self._closed:
+                    self._cond.wait()
+                self._stall_s += time.perf_counter() - t0
+                if pos not in self._results:
+                    raise RuntimeError(
+                        "ShardPrefetcher closed while waiting for shard "
+                        f"{self.order[pos]}")
+            kind, payload = self._results.pop(pos)
+            self._consumed = pos + 1
+            self._held -= self._sz[pos]
+            self._cond.notify_all()
+        if kind == "err":
+            raise payload
+        return payload
+
+    def close(self):
+        """Stop the workers, flush the stats into the recorder, and close
+        the lifetime span. Idempotent; always call (the iterator helpers
+        do it from their ``finally``)."""
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            self._cond.notify_all()
+        for t in self._workers:
+            t.join()
+        _obs.counter_add("oocore.prefetch_hits", self._hits)
+        _obs.counter_add("oocore.prefetch_stalls", self._stalls)
+        _obs.counter_add("oocore.prefetch_stall_s",
+                         round(self._stall_s, 6))
+        _obs.counter_add("oocore.prefetch_occupancy", self._occupancy)
+        self._span.set(hits=self._hits, stalls=self._stalls,
+                       stall_s=round(self._stall_s, 6),
+                       consumed=self._consumed)
+        self._span.__exit__(None, None, None)
+        self._results.clear()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def iter_shards(source, shards, *, depth=None, threads=None,
+                resident_bytes=None):
+    """Yield the materialized arrays of ``shards`` (a visit order) with
+    bounded readahead. Depth 0, a single shard, or a source that does not
+    opt in (``prefetchable`` attr — :class:`ArraySource` reads are free
+    slices) degrade to serial ``read_shard`` calls, bit-identically."""
+    d = prefetch_depth() if depth is None else max(0, int(depth))
+    shards = [int(s) for s in shards]
+    if (d <= 0 or len(shards) <= 1
+            or not getattr(source, "prefetchable", False)):
+        for s in shards:
+            yield source.read_shard(s)
+        return
+    pf = ShardPrefetcher(source, shards, depth=d, threads=threads,
+                         resident_bytes=resident_bytes)
+    try:
+        for pos in range(len(shards)):
+            yield pf.get(pos)
+    finally:
+        pf.close()
+
+
+class PrefetchingSource:
+    """Row-source view of a shard store whose sequential row walks are
+    served from a bounded readahead of the underlying shards.
+
+    This is what :func:`sq_learn_tpu.streaming.stream_tiles` wraps a
+    store in (via :meth:`ShardStore.prefetched`): ``read_rows`` walks
+    shards in natural order starting at the first row requested (the
+    resume cursor — shards before it are never read), pulling each from
+    the prefetcher while workers verify the ones ahead. Everything else
+    (``take``, ``fingerprint``, stats) delegates to the store. A read
+    outside the sequential walk falls back to the store's own path.
+    Call :meth:`close` when the pass ends (the streaming engine does).
+    """
+
+    def __init__(self, store, *, depth=None, threads=None):
+        self._store = store
+        self._depth = depth
+        self._threads = threads
+        self._pf = None
+        self._order = None
+        self._pos = 0
+        self._cur = (None, None)
+
+    def __getattr__(self, name):
+        return getattr(self._store, name)
+
+    def __len__(self):
+        return len(self._store)
+
+    def _shard(self, i):
+        idx, arr = self._cur
+        if idx == i:
+            return arr
+        if self._pf is None:
+            self._order = list(range(i, self._store.n_shards))
+            self._pos = 0
+            self._pf = ShardPrefetcher(self._store, self._order,
+                                       depth=self._depth,
+                                       threads=self._threads)
+        if self._pos < len(self._order) and self._order[self._pos] == i:
+            arr = self._pf.get(self._pos)
+            self._pos += 1
+            self._cur = (i, arr)
+            return arr
+        return self._store.read_shard(i)  # out-of-sequence: serial path
+
+    def read_rows(self, start, stop):
+        store = self._store
+        start, stop = int(start), int(stop)
+        n = store.shape[0]
+        m = int(np.prod(store.shape[1:], dtype=np.int64))
+        if not 0 <= start <= stop <= n:
+            raise IndexError(f"rows [{start}, {stop}) out of [0, {n})")
+        _budget_check((stop - start) * m * store.dtype.itemsize,
+                      f"row read [{start}, {stop}) of {store.path}")
+        out = np.empty((stop - start,) + tuple(store.shape[1:]), store.dtype)
+        i = int(np.searchsorted(store._offsets, start, side="right")) - 1
+        pos = start
+        while pos < stop:
+            lo, hi = int(store._offsets[i]), int(store._offsets[i + 1])
+            take = min(stop, hi)
+            out[pos - start:take - start] = self._shard(i)[pos - lo:take - lo]
+            pos = take
+            i += 1
+        return out
+
+    def close(self):
+        if self._pf is not None:
+            self._pf.close()
+            self._pf = None
+        self._cur = (None, None)

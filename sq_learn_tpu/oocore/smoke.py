@@ -3,19 +3,26 @@
 ``make oocore-smoke`` runs this module on the CPU backend:
 
 1. build a tiny deterministic synthetic shard store;
-2. a **fault-free** multi-epoch mini-batch fit (the reference result);
+2. a **fault-free** multi-epoch mini-batch fit on the SERIAL read path
+   (``SQ_OOC_PREFETCH_DEPTH=0`` — the reference result);
 3. the same fit under ``read_fail`` (one transient shard-read failure —
    the supervisor's retry absorbs it) plus ``corrupt_shard`` (a
    corrupted materialization the manifest CRC must catch, quarantine,
-   and recover through the bounded re-read) — the faulted fit must match
-   the reference **bit-for-bit**;
+   and recover through the bounded re-read) **with the shard readahead
+   prefetcher enabled at depth 3** — retries, quarantine and the bounded
+   re-read all fire from worker threads, and the faulted prefetched fit
+   must match the serial reference **bit-for-bit** (ISSUE 10's
+   depth-0-vs-depth-d acceptance pin);
 4. a REAL subprocess kill: a child process runs the same fit with
-   mid-epoch checkpoints under injected read stalls (so the parent can
-   catch it mid-flight), the parent SIGKILLs it the moment the first
-   checkpoint lands, and a clean rerun **resumes from the checkpoint**
-   and finishes bit-identical to the reference;
+   mid-epoch checkpoints AND prefetch enabled, under injected read
+   stalls (so the parent can catch it mid-flight — the stalls now land
+   on prefetch worker threads), the parent SIGKILLs it the moment the
+   first checkpoint lands (mid-prefetch, mid-epoch), and a clean rerun
+   **resumes from the checkpoint** and finishes bit-identical to the
+   reference;
 5. schema validation of the emitted JSONL: the read-side ``fault``
-   records and the ``oocore.*`` counters must be present and valid.
+   records, the ``oocore.*`` counters, and the prefetch hit/stall
+   counters must be present and valid.
 
 Exit code 0 = contract holds; 1 = violation (printed as JSON). Pins the
 CPU backend in-process first, like every resilience check.
@@ -81,25 +88,37 @@ def main():
 
     store = create_synthetic_store(store_path, shard_bytes=64 * 1024,
                                    **STORE)
+    # the reference runs the SERIAL read path: the prefetched legs below
+    # must reproduce it bit-for-bit (depth-0-vs-depth-d acceptance pin)
+    os.environ["SQ_OOC_PREFETCH_DEPTH"] = "0"
     reference = minibatch_epoch_fit(store, **FIT)
 
-    # -- read faults: transient failure + corruption, absorbed with
-    # bit parity ------------------------------------------------------------
+    # -- read faults UNDER PREFETCH: transient failure + corruption fire
+    # on worker threads, absorbed with bit parity vs the serial run ----------
+    os.environ["SQ_OOC_PREFETCH_DEPTH"] = "3"
+    os.environ["SQ_OOC_PREFETCH_THREADS"] = "2"
     plan = faults.arm("read_fail:tiles=1,times=1;"
                       "corrupt_shard:tiles=2,times=1")
     faulted = minibatch_epoch_fit(open_store(store_path), **FIT)
     faults.disarm()
+    for knob in ("SQ_OOC_PREFETCH_DEPTH", "SQ_OOC_PREFETCH_THREADS"):
+        os.environ.pop(knob, None)
     check(any(ev["kind"] == "read_fail" for ev in plan.events),
           "no transient read failure was injected")
     check(any(ev["kind"] == "corrupt_shard" for ev in plan.events),
           "no shard corruption was injected")
     check(np.array_equal(faulted["centers"], reference["centers"]),
-          "fault-injected fit diverged from the fault-free fit")
+          "fault-injected prefetched fit diverged from the serial fit")
     rec = get_recorder()
     check(rec.counters.get("oocore.rereads", 0) >= 1,
           "corrupted shard was not re-read")
     check(rec.counters.get("oocore.crc_failures", 0) >= 1,
           "manifest CRC did not catch the corruption")
+    pf_gets = (rec.counters.get("oocore.prefetch_hits", 0)
+               + rec.counters.get("oocore.prefetch_stalls", 0))
+    check(pf_gets >= store.n_shards,
+          f"prefetcher served {pf_gets} shard reads; expected at least "
+          f"one epoch's worth ({store.n_shards})")
 
     # -- the real kill: SIGKILL mid-epoch, then resume ----------------------
     env = dict(os.environ,
@@ -107,6 +126,11 @@ def main():
                SQ_STREAM_CKPT_DIR=ckpt_dir,
                SQ_STREAM_CKPT_EVERY="2",
                SQ_OBS="0",
+               # prefetch ON in the killed child: the SIGKILL lands
+               # mid-epoch AND mid-prefetch (workers mid-stall), and the
+               # resume must still be bit-for-bit
+               SQ_OOC_PREFETCH_DEPTH="3",
+               SQ_OOC_PREFETCH_THREADS="2",
                # every shard read stalls 0.1 s so the parent reliably
                # catches the child mid-epoch — the CI-scaled wedge
                SQ_FAULTS="read_stall:p=1,s=0.1,times=999")

@@ -117,9 +117,15 @@ def _budget_check(nbytes, what):
 
 
 def _crc(arr):
-    """CRC32 of an array's contiguous bytes (the buffer protocol — no
-    ``tobytes`` copy)."""
-    return zlib.crc32(np.ascontiguousarray(arr)) & 0xFFFFFFFF
+    """CRC32 of an array's contiguous bytes — zlib-compatible values via
+    the native PCLMUL/slice-by-16 kernel
+    (:func:`sq_learn_tpu.native.crc32`; falls back to ``zlib.crc32``
+    toolchain-less, bit-identically). The manifest verify pass runs this
+    over every materialized shard read, so its throughput IS the
+    out-of-core read tax on a warm page cache."""
+    from .. import native
+
+    return native.crc32(np.ascontiguousarray(arr))
 
 
 def _fingerprint(shape, dtype, crcs):
@@ -170,6 +176,10 @@ class ShardStore:
     (``n_shards``/``shard_sizes``/``read_shard``). Open is metadata-only;
     no shard bytes are touched until read.
     """
+
+    #: opt-in marker for the bounded shard readahead (oocore.prefetch):
+    #: disk-backed reads are worth overlapping; ArraySource slices are not
+    prefetchable = True
 
     def __init__(self, path, manifest):
         self.path = str(path)
@@ -325,6 +335,20 @@ class ShardStore:
         n = self.shape[0]
         return float(np.mean(np.maximum(sqsum / n - (colsum / n) ** 2, 0.0)))
 
+    def prefetched(self, *, depth=None, threads=None):
+        """A sequential-walk view of this store with bounded shard
+        readahead (:class:`~sq_learn_tpu.oocore.prefetch.
+        PrefetchingSource`): worker threads materialize + CRC-verify the
+        next shards while the consumer computes. Returns ``self`` when
+        the depth resolves to 0 or there is nothing to read ahead —
+        callers may wrap unconditionally; the streaming engine does."""
+        from .prefetch import PrefetchingSource, prefetch_depth
+
+        d = prefetch_depth() if depth is None else int(depth)
+        if d <= 0 or self.n_shards <= 1:
+            return self
+        return PrefetchingSource(self, depth=d, threads=threads)
+
 
 def open_store(path):
     """Open an existing store directory (metadata only — no shard bytes
@@ -338,7 +362,15 @@ def open_store(path):
 
 class _StoreWriter:
     """Shard-by-shard store builder: bounded RAM, per-shard CRCs, and the
-    running column stats the manifest publishes."""
+    running column stats the manifest publishes.
+
+    Split for the parallel build path: :meth:`write_shard` (file write +
+    CRC + per-shard column stats — touches no shared state, safe from a
+    worker thread) and :meth:`commit` (folds shard ``i``'s stats into the
+    manifest state, and must run IN SHARD ORDER: float accumulation order
+    is part of the bit-identical-rebuild contract). :meth:`append` is the
+    serial composition of the two.
+    """
 
     def __init__(self, path, n_rows, n_features, dtype):
         self.path = str(path)
@@ -350,21 +382,29 @@ class _StoreWriter:
         self.sqsum = np.zeros(self.n_features, np.float64)
         self._written = 0
 
-    def append(self, block):
+    def write_shard(self, i, block):
+        """Write shard ``i``'s file (fsynced) and return
+        ``(meta, colsum_i, sqsum_i)`` for :meth:`commit`."""
         block = np.ascontiguousarray(block, self.dtype)
-        i = len(self.shards)
         fname = f"shard_{i:05d}.npy"
         fpath = os.path.join(self.path, fname)
         with open(fpath, "wb") as fh:
             np.save(fh, block)
             fh.flush()
             os.fsync(fh.fileno())
-        self.shards.append({"file": fname, "rows": int(block.shape[0]),
-                            "crc32": _crc(block),
-                            "nbytes": int(block.nbytes)})
-        self.colsum += block.sum(axis=0, dtype=np.float64)
-        self.sqsum += (block.astype(np.float64) ** 2).sum(axis=0)
-        self._written += int(block.shape[0])
+        meta = {"file": fname, "rows": int(block.shape[0]),
+                "crc32": _crc(block), "nbytes": int(block.nbytes)}
+        return (meta, block.sum(axis=0, dtype=np.float64),
+                (block.astype(np.float64) ** 2).sum(axis=0))
+
+    def commit(self, meta, colsum_i, sqsum_i):
+        self.shards.append(meta)
+        self.colsum += colsum_i
+        self.sqsum += sqsum_i
+        self._written += int(meta["rows"])
+
+    def append(self, block):
+        self.commit(*self.write_shard(len(self.shards), block))
 
     def finish(self, provenance):
         if self._written != self.n_rows:
@@ -398,30 +438,62 @@ def create_synthetic_store(path, n_samples, n_features, *, n_classes=10,
     per-feature scale decay); rows are generated per shard from an RNG
     keyed on ``(seed, shard index)``, so shard ``i``'s bytes depend only
     on the seed and the shard split — a rebuild with identical arguments
-    is bit-identical (and so is the manifest fingerprint). Host RAM holds
-    one shard at a time. Returns the opened :class:`ShardStore`."""
+    is bit-identical (and so is the manifest fingerprint), which is also
+    what makes the build PARALLEL: shards generate and write on a small
+    thread pool (``SQ_OOC_PREFETCH_THREADS``-wide; the fsyncs overlap the
+    generation of the next shards) while the manifest stats fold in shard
+    order on the caller's thread — the manifest is byte-identical to a
+    serial build's. Host RAM holds at most the in-flight window of shards
+    (bounded by the pool width, and by ``SQ_OOC_RAM_BUDGET_BYTES`` when
+    armed). Returns the opened :class:`ShardStore`."""
     import jax
 
     from .. import obs as _obs
+    from .prefetch import prefetch_threads
 
     dtype = jax.dtypes.canonicalize_dtype(np.dtype(dtype))
     rows, n_shards = _plan_shards(
         n_samples, int(n_features) * np.dtype(dtype).itemsize, shard_bytes)
-    _budget_check(rows * int(n_features) * np.dtype(dtype).itemsize,
-                  f"synthetic shard build of {path}")
+    shard_nbytes = rows * int(n_features) * np.dtype(dtype).itemsize
+    _budget_check(shard_nbytes, f"synthetic shard build of {path}")
     rng0 = np.random.default_rng(seed)
     centers = rng0.normal(scale=10.0, size=(n_classes, n_features))
     scales = np.geomspace(1.0, 0.05, n_features)
     writer = _StoreWriter(path, n_samples, n_features, dtype)
+
+    def gen(i):
+        r = min(rows, int(n_samples) - i * rows)
+        rng = np.random.default_rng((int(seed), i))
+        y = rng.integers(0, n_classes, size=r)
+        return (centers[y] + rng.normal(
+            scale=cluster_std, size=(r, n_features)) * scales)
+
+    threads = max(1, min(prefetch_threads(), n_shards))
+    # in-flight window: one block per worker plus one queued; the f64
+    # stats temp makes a building shard ~3x its bytes, so a budget caps
+    # the window rather than trusting the pool width
+    window = threads + 1
+    budget = ram_budget_bytes()
+    if budget:
+        window = max(1, min(window, budget // max(1, 3 * shard_nbytes)))
     with _obs.span("oocore.create_store", n=int(n_samples),
-                   m=int(n_features), shards=n_shards):
-        for i in range(n_shards):
-            r = min(rows, int(n_samples) - i * rows)
-            rng = np.random.default_rng((int(seed), i))
-            y = rng.integers(0, n_classes, size=r)
-            block = (centers[y] + rng.normal(
-                scale=cluster_std, size=(r, n_features)) * scales)
-            writer.append(block)
+                   m=int(n_features), shards=n_shards,
+                   threads=threads if window > 1 else 1):
+        if window <= 1 or n_shards <= 1:
+            for i in range(n_shards):
+                writer.append(gen(i))
+        else:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(
+                    threads, thread_name_prefix="sq-ooc-build") as ex:
+                pending, nxt = {}, 0
+                for i in range(n_shards):
+                    while nxt < n_shards and nxt - i < window:
+                        pending[nxt] = ex.submit(
+                            lambda j: writer.write_shard(j, gen(j)), nxt)
+                        nxt += 1
+                    writer.commit(*pending.pop(i).result())
     return writer.finish({"kind": "synthetic", "seed": int(seed),
                           "n_classes": int(n_classes),
                           "cluster_std": float(cluster_std)})

@@ -77,6 +77,7 @@ discipline, applied to failure).
 """
 
 import os
+import threading
 import time
 
 __all__ = [
@@ -147,8 +148,13 @@ class _Injector:
             raise FaultSpecError(
                 f"unknown param(s) {sorted(params)} for fault {kind!r}")
         #: per-tile remaining-failure countdowns (transient faults succeed
-        #: once their countdown is spent)
+        #: once their countdown is spent). Guarded by a lock: the prefetch
+        #: layer fires read-side injectors from worker threads, and a
+        #: ``times=N`` countdown must spend exactly N injections no matter
+        #: which thread asks (the stall sleeps themselves stay unlocked —
+        #: concurrent stalls must overlap like concurrent reads do)
         self._remaining = {}
+        self._lock = threading.Lock()
 
     def matches(self, tile_index):
         if self.tiles is not None:
@@ -160,18 +166,20 @@ class _Injector:
         elif self.p is not None:
             if _u01(self.seed, tile_index, self.index) >= self.p:
                 return False
-        rem = self._remaining.setdefault(tile_index, self.times)
-        if rem <= 0:
-            return False
-        self._remaining[tile_index] = rem - 1
-        return True
+        with self._lock:
+            rem = self._remaining.setdefault(tile_index, self.times)
+            if rem <= 0:
+                return False
+            self._remaining[tile_index] = rem - 1
+            return True
 
     def consume(self):
         """Countdown for tile-free injectors (probe_timeout)."""
-        if self.count <= 0:
-            return False
-        self.count -= 1
-        return True
+        with self._lock:
+            if self.count <= 0:
+                return False
+            self.count -= 1
+            return True
 
 
 def _parse_value(key, raw):

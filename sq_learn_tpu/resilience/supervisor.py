@@ -1,9 +1,9 @@
 """Transfer supervisor: bounded retries, keyed backoff, per-tile deadlines,
 and the probe-fed circuit breaker.
 
-Every host→device transfer in the streaming engine (and the legacy
-``chunked_device_put``) goes through :func:`put`. The supervision contract,
-in failure order:
+Every host→device transfer in the streaming engine goes through
+:func:`put`; every shard-store disk read goes through
+:func:`supervised_read`. The supervision contract, in failure order:
 
 1. **Retry with backoff.** A transient put failure (injected
    :class:`~.faults.InjectedTransferError`, or a real ``RuntimeError`` /
@@ -46,6 +46,7 @@ per-attempt bookkeeping, not the retry/breaker machinery.
 """
 
 import os
+import threading
 import time
 
 from .faults import InjectedFault, InjectedTransferError, _u01
@@ -162,6 +163,11 @@ class CircuitBreaker:
         self._opened_at = None
         self.trips = 0
         self.transitions = []
+        # the prefetch layer feeds failures/timeouts from worker threads
+        # concurrently with the consumer's supervised puts — the state
+        # machine must count them atomically (an RLock: preflight's
+        # forced probe re-enters through on_probe on the same thread)
+        self._lock = threading.RLock()
 
     # -- state ---------------------------------------------------------------
 
@@ -172,10 +178,12 @@ class CircuitBreaker:
     def state(self):
         """Current state, lazily advancing ``open`` → ``half_open`` once
         the cooldown has elapsed."""
-        if (self._state == OPEN and self._opened_at is not None
-                and self._clock() - self._opened_at >= self._cooldown_s()):
-            self._transition(HALF_OPEN, "cooldown elapsed")
-        return self._state
+        with self._lock:
+            if (self._state == OPEN and self._opened_at is not None
+                    and self._clock() - self._opened_at
+                    >= self._cooldown_s()):
+                self._transition(HALF_OPEN, "cooldown elapsed")
+            return self._state
 
     def _k(self):
         return int(os.environ.get("SQ_BREAKER_K", 3))
@@ -198,21 +206,23 @@ class CircuitBreaker:
     # -- inputs --------------------------------------------------------------
 
     def record_failure(self, reason, site=None, elapsed=None):
-        """One transfer failure or timeout. Trips on the K-th consecutive
-        one; in ``half_open`` a single failure re-opens immediately (the
-        trial transfer failed — no K grace)."""
-        self._consecutive += 1
-        state = self.state()
-        if state == HALF_OPEN:
-            self._opened_at = self._clock()
-            self._transition(OPEN, f"half-open trial failed ({reason})")
-        elif state == CLOSED and self._consecutive >= self._k():
-            self._opened_at = self._clock()
-            self.trips += 1
-            self._transition(
-                OPEN, f"{self._consecutive} consecutive failures "
-                      f"(last: {reason}{f' at {site}' if site else ''})")
-            self.trip_action()
+        """One transfer failure or timeout (thread-safe — prefetch
+        workers feed concurrently). Trips on the K-th consecutive one; in
+        ``half_open`` a single failure re-opens immediately (the trial
+        transfer failed — no K grace)."""
+        with self._lock:
+            self._consecutive += 1
+            state = self.state()
+            if state == HALF_OPEN:
+                self._opened_at = self._clock()
+                self._transition(OPEN, f"half-open trial failed ({reason})")
+            elif state == CLOSED and self._consecutive >= self._k():
+                self._opened_at = self._clock()
+                self.trips += 1
+                self._transition(
+                    OPEN, f"{self._consecutive} consecutive failures "
+                          f"(last: {reason}{f' at {site}' if site else ''})")
+                self.trip_action()
 
     def record_timeout(self, site=None, elapsed=None):
         self.record_failure("deadline exceeded", site=site, elapsed=elapsed)
@@ -220,9 +230,10 @@ class CircuitBreaker:
     def record_success(self):
         """One healthy transfer: resets the consecutive count; in
         ``half_open`` it closes the breaker."""
-        self._consecutive = 0
-        if self.state() == HALF_OPEN:
-            self._transition(CLOSED, "half-open trial succeeded")
+        with self._lock:
+            self._consecutive = 0
+            if self.state() == HALF_OPEN:
+                self._transition(CLOSED, "half-open trial succeeded")
 
     def on_probe(self, outcome):
         """Device-health probe outcomes feed the same state machine:
@@ -251,10 +262,11 @@ class CircuitBreaker:
     def reset(self, reason="reset"):
         """Back to a fresh closed breaker (tests, smoke teardown). Emits a
         transition record only if the state actually changes."""
-        self._consecutive = 0
-        self._opened_at = None
-        if self._state != CLOSED:
-            self._transition(CLOSED, reason)
+        with self._lock:
+            self._consecutive = 0
+            self._opened_at = None
+            if self._state != CLOSED:
+                self._transition(CLOSED, reason)
 
 
 #: the process-wide breaker every supervised put and probe feeds

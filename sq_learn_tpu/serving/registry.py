@@ -208,6 +208,54 @@ class ModelRegistry:
                 _obs.gauge("serving.registry_evicted", evicted)
         return model
 
+    def warm(self, tenants=None, threads=None):
+        """Prefetch cold checkpoint loads on a bounded thread pool — the
+        serving-side twin of the shard readahead: a tenant's first
+        request after registration should hit a resident model, not pay
+        the digest-verified disk load inline.
+
+        ``tenants`` defaults to every registered tenant; only the LAST
+        ``capacity`` of the requested list actually warm (warming more
+        would LRU-thrash — earlier ones report ``"skipped_capacity"``).
+        Loads run concurrently (``threads`` defaults to min(4, n)) via
+        the same :meth:`resolve` the dispatcher uses, so the digest
+        verification and LRU accounting are identical to a cold hit.
+        Returns ``{tenant: "resident" | "loaded" | "skipped_capacity" |
+        "error: ..."}`` — a failed load never aborts the rest of the
+        warm-up (that tenant fails again, loudly, at request time).
+        """
+        with self._lock:
+            known = list(self._sources)
+            resident = set(self._resident)
+        sel = known if tenants is None else [str(t) for t in tenants]
+        out = {t: "skipped_capacity" for t in sel[:-self._capacity]}
+        sel = sel[-self._capacity:]
+        nthreads = max(1, min(4, len(sel)) if threads is None
+                       else int(threads))
+        with _obs.span("serving.registry.warm", tenants=len(sel),
+                       threads=nthreads):
+            def load(tenant):
+                if tenant in resident:
+                    return tenant, "resident"
+                try:
+                    self.resolve(tenant)
+                except Exception as exc:
+                    return tenant, f"error: {exc}"
+                _obs.counter_add("serving.registry_warm_loads", 1)
+                return tenant, "loaded"
+
+            if nthreads <= 1 or len(sel) <= 1:
+                results = [load(t) for t in sel]
+            else:
+                from concurrent.futures import ThreadPoolExecutor
+
+                with ThreadPoolExecutor(
+                        nthreads,
+                        thread_name_prefix="sq-serve-warm") as ex:
+                    results = list(ex.map(load, sel))
+        out.update(dict(results))
+        return out
+
     @staticmethod
     def _checkpoint_digest(path):
         """The checkpoint's recorded state digest (None for v1

@@ -214,8 +214,19 @@ def stream_tiles(X, max_bytes=None, device=None, put=None, multiple=1,
     source = is_row_source(X)
     if not source:
         X = np.asarray(X)
-    # canonicalize on the host exactly like chunked_device_put: without it
-    # the f64→f32 cast would happen device-side, doubling the upload
+    view = None
+    if source and hasattr(X, "prefetched"):
+        # disk-backed stores opt into the bounded shard readahead
+        # (sq_learn_tpu.oocore.prefetch): worker threads materialize and
+        # CRC-verify the shards AHEAD of the tile walk; depth 0 returns
+        # the store itself (bit-identical serial path). The view starts
+        # reading at the first row requested, so a resume's skipped
+        # tiles never stage their shards.
+        wrapped = X.prefetched()
+        if wrapped is not X:
+            X = view = wrapped
+    # canonicalize on the host exactly like streamed_resident_put: without
+    # it the f64→f32 cast would happen device-side, doubling the upload
     # (sources canonicalize at build time; a foreign one casts per tile)
     canonical = jax.dtypes.canonicalize_dtype(X.dtype)
     if not source and X.dtype != canonical:
@@ -252,15 +263,19 @@ def stream_tiles(X, max_bytes=None, device=None, put=None, multiple=1,
                 _obs.watchdog.allow(site, (bucket, str(tile.dtype)))
         return _sup.put(put, tile, i, site=site), valid, start
 
-    nxt = staged(start_tile)
-    for i in range(start_tile, n_tiles):
-        cur = nxt
-        if i + 1 < n_tiles:
-            # stage tile i+1 BEFORE the consumer dispatches tile i's
-            # kernel: both are async, so the transfer rides under the
-            # accumulation compute
-            nxt = staged(i + 1)
-        yield cur
+    try:
+        nxt = staged(start_tile)
+        for i in range(start_tile, n_tiles):
+            cur = nxt
+            if i + 1 < n_tiles:
+                # stage tile i+1 BEFORE the consumer dispatches tile i's
+                # kernel: both are async, so the transfer rides under the
+                # accumulation compute
+                nxt = staged(i + 1)
+            yield cur
+    finally:
+        if view is not None:
+            view.close()  # joins the prefetch workers, closes the span
 
 
 class StreamCheckpoint:
@@ -943,8 +958,8 @@ def streamed_prestats(X, *, quantum=False, mu_grid=(), mu_blocked=False,
 
 def streamed_resident_put(x, device=None, max_bytes=None):
     """Whole-array host→device placement through the streaming engine —
-    the supervised successor of the deprecated
-    :func:`~sq_learn_tpu._config.chunked_device_put` slicing branch.
+    the supervised successor of the removed ``chunked_device_put``
+    slicing branch (``_config.py``).
 
     Each bounded tile crosses under the transfer supervisor
     (retry/backoff, deadline, breaker accounting) with double-buffered

@@ -247,6 +247,89 @@ def save_stream_state(path, acc, cursor, fingerprint):
     return path
 
 
+class AsyncStreamCheckpointer:
+    """Background writer for :func:`save_stream_state` snapshots.
+
+    A mid-epoch checkpoint used to stall the batch loop for the full
+    npz-write + fsync + rename; this moves the write to ONE worker thread
+    while keeping every durability property of the serial path (the
+    worker calls the same :func:`save_stream_state` — fsync-before-
+    rename, ``.prev`` retention, torn-newest fallback all unchanged):
+
+    - :meth:`submit` deep-copies the accumulator ON THE CALLER'S thread
+      (the fit loop mutates its 0-d scalars in place) and hands it to the
+      writer — the caller pays a small-array copy, never the I/O.
+    - **latest-wins**: a snapshot submitted while the previous one is
+      still writing replaces any not-yet-started pending snapshot (the
+      ``dropped`` count); resume then replays a few more batches — the
+      keyed batch schedule makes any boundary an equally valid resume
+      point, so bit-for-bit parity is unaffected.
+    - :meth:`close` DRAINS the pending write before returning, so a
+      finished fit can delete its checkpoint files without racing a
+      late write that would resurrect one; a writer-side error is
+      re-raised on the next :meth:`submit`/:meth:`close`.
+    """
+
+    def __init__(self, path):
+        import threading
+
+        self.path = str(path)
+        self.writes = 0
+        self.dropped = 0
+        self._cond = threading.Condition()
+        self._pending = None
+        self._writing = False
+        self._error = None
+        self._stop = False
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="sq-stream-ckpt-writer")
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            with self._cond:
+                while self._pending is None and not self._stop:
+                    self._cond.wait()
+                if self._pending is None:
+                    return  # stopped with nothing left to drain
+                acc, cursor, fingerprint = self._pending
+                self._pending = None
+                self._writing = True
+            try:
+                save_stream_state(self.path, acc, cursor, fingerprint)
+            except Exception as exc:  # surfaced on next submit/close
+                with self._cond:
+                    self._error = exc
+            finally:
+                with self._cond:
+                    self._writing = False
+                    self.writes += 1
+                    self._cond.notify_all()
+
+    def submit(self, acc, cursor, fingerprint):
+        """Queue one snapshot (latest-wins). Raises a previous write's
+        error here rather than losing it."""
+        host = jax.tree_util.tree_map(
+            lambda a: np.array(a, copy=True), acc)
+        with self._cond:
+            if self._error is not None:
+                raise self._error
+            if self._pending is not None:
+                self.dropped += 1
+            self._pending = (host, int(cursor), str(fingerprint))
+            self._cond.notify_all()
+
+    def close(self):
+        """Drain the pending write, stop the worker, re-raise any writer
+        error. Idempotent."""
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        self._thread.join()
+        if self._error is not None:
+            raise self._error
+
+
 def _read_stream_state(path, like, fingerprint):
     """One checkpoint-file read attempt. Returns ``("ok", payload)``,
     ``("absent", None)``, ``("corrupt", None)`` (unreadable/truncated/

@@ -62,47 +62,60 @@ def test_set_config_rejects_bogus_device():
             set_config(device=bogus)
 
 
-class TestChunkedDevicePut:
-    """Streamed host→device placement (the ≥200 MB relay-wedge dodge).
+class TestHostPut:
+    """Streamed host→device placement (the ≥200 MB relay-wedge dodge),
+    through the internal ``_put_host`` that ``as_device_array`` routes
+    every placement through (the public streamed surface is
+    ``streaming.streamed_resident_put``; the removed ``chunked_device_put``
+    wrapper is pinned below to fail loudly).
 
     On the CPU backend the slicing only engages when max_bytes is passed
     explicitly, which is exactly how these tests force the assembly path."""
 
     def test_parity_with_plain_asarray(self):
-        from sq_learn_tpu._config import chunked_device_put
+        from sq_learn_tpu._config import _put_host
 
         x = np.random.RandomState(0).randn(97, 13).astype(np.float32)
-        out = chunked_device_put(x, None, max_bytes=512)  # ~10 rows/slice
+        out = _put_host(x, None, max_bytes=512)  # ~10 rows/slice
         np.testing.assert_array_equal(np.asarray(out), x)
         assert out.dtype == jax.numpy.asarray(x).dtype
 
     def test_committed_placement_survives_chunking(self):
-        from sq_learn_tpu._config import chunked_device_put
+        from sq_learn_tpu._config import _put_host
 
         cpus = jax.devices("cpu")
         x = np.ones((64, 8), np.float32)
-        out = chunked_device_put(x, cpus[2], max_bytes=256)
+        out = _put_host(x, cpus[2], max_bytes=256)
         assert out.devices() == {cpus[2]}
         np.testing.assert_array_equal(np.asarray(out), x)
 
     def test_dtype_canonicalization_matches_asarray(self):
-        from sq_learn_tpu._config import chunked_device_put
+        from sq_learn_tpu._config import _put_host
 
         x64 = np.random.RandomState(1).randn(40, 4)  # float64 host data
-        out = chunked_device_put(x64, None, max_bytes=128)
+        out = _put_host(x64, None, max_bytes=128)
         expected = jax.numpy.asarray(x64)
         assert out.dtype == expected.dtype
         np.testing.assert_array_equal(np.asarray(out), np.asarray(expected))
 
     def test_one_dim_and_small_inputs_pass_through(self):
-        from sq_learn_tpu._config import chunked_device_put
+        from sq_learn_tpu._config import _put_host
 
         v = np.arange(1000, dtype=np.float32)
         np.testing.assert_array_equal(
-            np.asarray(chunked_device_put(v, None, max_bytes=400)), v)
+            np.asarray(_put_host(v, None, max_bytes=400)), v)
         small = np.ones((3, 3), np.float32)
         np.testing.assert_array_equal(
-            np.asarray(chunked_device_put(small, None)), small)
+            np.asarray(_put_host(small, None)), small)
+
+    def test_removed_chunked_device_put_raises_with_pointer(self):
+        """ISSUE 10 satellite: the long-deprecated compatibility wrapper
+        is gone; external callers get a loud, actionable error instead of
+        silently changed semantics."""
+        from sq_learn_tpu._config import chunked_device_put
+
+        with pytest.raises(RuntimeError, match="streamed_resident_put"):
+            chunked_device_put(np.ones((4, 4), np.float32))
 
     def test_cpu_targets_skip_slicing_by_default(self, monkeypatch):
         """With the default max_bytes a CPU-bound transfer stays one piece
@@ -124,10 +137,10 @@ class TestChunkedDevicePut:
         np.testing.assert_array_equal(np.asarray(out), x)
 
     def test_single_row_larger_than_budget_still_transfers(self):
-        from sq_learn_tpu._config import chunked_device_put
+        from sq_learn_tpu._config import _put_host
 
         x = np.random.RandomState(3).randn(4, 64).astype(np.float32)
-        out = chunked_device_put(x, None, max_bytes=16)  # 256 B rows
+        out = _put_host(x, None, max_bytes=16)  # 256 B rows
         np.testing.assert_array_equal(np.asarray(out), x)
 
 

@@ -483,3 +483,63 @@ def test_argkmin_stable_tie_order():
     # four zero-distance duplicates (0,3,4,5), then the nearer of {1,6}
     np.testing.assert_array_equal(idx[0], [0, 3, 4, 5, 1])
     np.testing.assert_allclose(d2[0], [0, 0, 0, 0, 9.0], atol=1e-5)
+
+
+class TestCrc32:
+    """Native CRC-32 (oocore shard-verify fast path): values must be
+    bit-identical to zlib.crc32 for every buffer shape and chained init —
+    manifests written by either implementation verify under the other."""
+
+    def test_matches_zlib_across_sizes(self):
+        import zlib
+
+        rng = np.random.default_rng(0)
+        # spans every code path: empty, sub-16B tail loop, slice-by-16
+        # alignment head, the >=128B PCLMUL fold threshold, odd tails
+        for size in (0, 1, 3, 7, 8, 15, 16, 17, 63, 64, 127, 128, 129,
+                     255, 4097, 1 << 20):
+            buf = rng.integers(0, 255, size=size, dtype=np.uint8)
+            assert native.crc32(buf) == (zlib.crc32(buf) & 0xFFFFFFFF)
+            assert native.crc32(buf.tobytes()) == \
+                (zlib.crc32(buf) & 0xFFFFFFFF)
+
+    def test_chained_init_matches_zlib(self):
+        import zlib
+
+        rng = np.random.default_rng(1)
+        buf = rng.integers(0, 255, size=4096, dtype=np.uint8)
+        a, b = buf[:1234], buf[1234:]
+        chained = native.crc32(b, native.crc32(a))
+        assert chained == native.crc32(buf)
+        assert chained == (zlib.crc32(b, zlib.crc32(a)) & 0xFFFFFFFF)
+
+    def test_unaligned_starts_match_zlib(self):
+        import zlib
+
+        rng = np.random.default_rng(2)
+        buf = rng.integers(0, 255, size=1 << 14, dtype=np.uint8)
+        for off in range(1, 9):
+            assert native.crc32(buf[off:].copy()) == \
+                (zlib.crc32(buf[off:].tobytes()) & 0xFFFFFFFF)
+
+    def test_float_arrays_and_noncontiguous(self):
+        import zlib
+
+        rng = np.random.default_rng(3)
+        f = rng.normal(size=(257, 13)).astype(np.float32)
+        assert native.crc32(f) == \
+            (zlib.crc32(np.ascontiguousarray(f)) & 0xFFFFFFFF)
+        strided = f[::2]  # non-contiguous: must hash the compacted bytes
+        assert native.crc32(strided) == \
+            (zlib.crc32(np.ascontiguousarray(strided)) & 0xFFFFFFFF)
+
+    def test_fallback_path_matches(self, monkeypatch):
+        import zlib
+
+        import sq_learn_tpu.native as nat
+
+        monkeypatch.setattr(nat, "_load", lambda: None)
+        rng = np.random.default_rng(4)
+        buf = rng.integers(0, 255, size=1000, dtype=np.uint8)
+        assert nat.crc32(buf) == (zlib.crc32(buf) & 0xFFFFFFFF)
+        assert nat.crc32(buf, 7) == (zlib.crc32(buf, 7) & 0xFFFFFFFF)

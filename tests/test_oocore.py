@@ -454,3 +454,307 @@ class TestProbeCacheAtomicity:
         for t in threads:
             t.join()
         assert not bad, f"torn cache reads observed: {bad[:3]}"
+
+
+class TestPrefetch:
+    """ISSUE 10: the bounded shard-readahead prefetcher. Depth 0 IS the
+    serial path; any depth produces bit-identical results under the full
+    read-fault matrix, never reads a skipped shard, respects the RAM
+    budget, and surfaces worker-side errors at the shard they belong to."""
+
+    def _depth(self, monkeypatch, d):
+        monkeypatch.setenv("SQ_OOC_PREFETCH_DEPTH", str(d))
+
+    def test_engine_depth_parity(self, store, monkeypatch):
+        kw = dict(n_clusters=5, batch_rows=256, max_epochs=3, seed=11)
+        self._depth(monkeypatch, 0)
+        serial = oocore.minibatch_epoch_fit(store, **kw)
+        self._depth(monkeypatch, 3)
+        deep = oocore.minibatch_epoch_fit(store, **kw)
+        np.testing.assert_array_equal(serial["centers"], deep["centers"])
+        np.testing.assert_array_equal(serial["counts"], deep["counts"])
+
+    def test_stream_fold_depth_parity(self, store, monkeypatch):
+        from sq_learn_tpu.streaming import streamed_centered_gram
+
+        self._depth(monkeypatch, 0)
+        _, G0, _ = streamed_centered_gram(store, max_bytes=32 * 1024)
+        self._depth(monkeypatch, 2)
+        _, G2, _ = streamed_centered_gram(store, max_bytes=32 * 1024)
+        np.testing.assert_array_equal(np.asarray(G0), np.asarray(G2))
+
+    def test_estimator_depth_parity(self, store, monkeypatch):
+        from sq_learn_tpu.models import QPCA, MiniBatchQKMeans
+
+        kw = dict(n_clusters=5, batch_size=256, max_iter=3, random_state=3)
+        self._depth(monkeypatch, 0)
+        with pytest.warns(UserWarning, match="classic"):
+            mb0 = MiniBatchQKMeans(**kw).fit(store)
+        q0 = QPCA(n_components=3, random_state=0).fit(store)
+        self._depth(monkeypatch, 3)
+        with pytest.warns(UserWarning, match="classic"):
+            mb3 = MiniBatchQKMeans(**kw).fit(store)
+        q3 = QPCA(n_components=3, random_state=0).fit(store)
+        np.testing.assert_array_equal(mb0.cluster_centers_,
+                                      mb3.cluster_centers_)
+        np.testing.assert_array_equal(mb0.labels_, mb3.labels_)
+        np.testing.assert_array_equal(q0.components_, q3.components_)
+        np.testing.assert_array_equal(q0.singular_values_,
+                                      q3.singular_values_)
+
+    def test_fault_matrix_under_prefetch(self, store, recorder,
+                                         monkeypatch):
+        """read_fail (worker retry), corrupt_shard (worker quarantine +
+        bounded re-read) with depth >= 2: absorbed bit-for-bit."""
+        kw = dict(n_clusters=4, batch_rows=256, max_epochs=2, seed=1)
+        self._depth(monkeypatch, 0)
+        ref = oocore.minibatch_epoch_fit(store, **kw)
+        self._depth(monkeypatch, 3)
+        faults.arm("read_fail:tiles=1,times=1;"
+                   "corrupt_shard:tiles=3,times=1")
+        try:
+            out = oocore.minibatch_epoch_fit(
+                oocore.open_store(store.path), **kw)
+        finally:
+            plan = faults.disarm()
+            supervisor.breaker.reset("test teardown")
+        kinds = {ev["kind"] for ev in plan.events}
+        assert {"read_fail", "corrupt_shard"} <= kinds
+        np.testing.assert_array_equal(out["centers"], ref["centers"])
+        assert recorder.counters.get("oocore.rereads", 0) >= 1
+        assert recorder.counters.get("resilience.retries", 0) >= 1
+        assert recorder.counters.get("oocore.prefetch_hits", 0) \
+            + recorder.counters.get("oocore.prefetch_stalls", 0) >= 1
+
+    def test_worker_read_stall_feeds_breaker_thread_safely(
+            self, store, monkeypatch):
+        """Stalling reads on PREFETCH WORKERS count breaker timeouts
+        exactly like consumer-thread reads (the feed is now locked):
+        every shard read stalls past the deadline, so the consecutive
+        count crosses K and the breaker trips — fed from two worker
+        threads concurrently without losing a count."""
+        from sq_learn_tpu.oocore.prefetch import iter_shards
+
+        monkeypatch.setenv("SQ_TILE_DEADLINE_S", "0.01")
+        supervisor.breaker.reset("test setup")
+        trips0 = supervisor.breaker.trips
+        faults.arm("read_stall:p=1,s=0.05,times=1")
+        try:
+            arrs = list(iter_shards(store, range(store.n_shards),
+                                    depth=3, threads=2))
+            assert supervisor.breaker.trips > trips0, (
+                "worker-thread timeouts never tripped the breaker")
+        finally:
+            faults.disarm()
+            supervisor.breaker.reset("test teardown")
+        for i, arr in enumerate(arrs):  # the data still arrived, intact
+            lo = int(store._offsets[i])
+            np.testing.assert_array_equal(
+                arr, X_TALL[lo:lo + store.shard_sizes[i]])
+
+    def test_worker_error_surfaces_at_owner_shard(self, store,
+                                                  monkeypatch):
+        """Persistent corruption of shard 3 raises ShardCorruptionError
+        with shard-3 provenance AT position 3 — after shards 0-2 served."""
+        from sq_learn_tpu.oocore.prefetch import iter_shards
+
+        monkeypatch.setenv("SQ_OOC_REREAD_MAX", "1")
+        faults.arm("corrupt_shard:tiles=3,times=10")
+        got = []
+        try:
+            with pytest.raises(ShardCorruptionError, match="shard 3"):
+                for arr in iter_shards(store, range(store.n_shards),
+                                       depth=3, threads=2):
+                    got.append(arr)
+        finally:
+            faults.disarm()
+        assert len(got) == 3  # shards 0..2 served before the error
+        for i, arr in enumerate(got):
+            lo = int(store._offsets[i])
+            np.testing.assert_array_equal(
+                arr, X_TALL[lo:lo + store.shard_sizes[i]])
+
+    def test_skipped_shards_never_read(self, store, monkeypatch):
+        """Epoch-plan awareness: a resume that skips leading shards must
+        not prefetch them either."""
+        self._depth(monkeypatch, 3)
+        plan = EpochPlan(seed=5, batch_rows=256)
+        full = [b for _, b in plan.iter_batches(store, 2)]
+
+        reads = []
+        real = oocore.ShardStore.read_shard
+
+        def spy_read(self, i):
+            reads.append(int(i))
+            return real(self, i)
+
+        monkeypatch.setattr(oocore.ShardStore, "read_shard", spy_read)
+        tail = [b for _, b in plan.iter_batches(store, 2, start_batch=4)]
+        # bit parity of the replayed suffix
+        assert len(tail) == len(full) - 4
+        for a, b in zip(full[4:], tail):
+            np.testing.assert_array_equal(a, b)
+        # 4 batches * 256 rows skip the first 1024 rows: the shards
+        # wholly inside that prefix must never have been read
+        skipped, skip = [], 4 * 256
+        for s in plan.shard_order(store, 2):
+            if skip >= store.shard_sizes[int(s)]:
+                skipped.append(int(s))
+                skip -= store.shard_sizes[int(s)]
+            else:
+                break
+        assert skipped, "test store too small to skip a whole shard"
+        assert reads, "spy never saw a read (prefetch bypassed it?)"
+        assert not (set(reads) & set(skipped)), (
+            f"prefetcher read skipped shards {set(reads) & set(skipped)}")
+
+    def test_ram_budget_bounds_readahead(self, store, monkeypatch):
+        """With a budget barely above two shards, readahead degrades
+        toward serial but still completes with parity (the consumer's
+        own position is always allowed to claim)."""
+        from sq_learn_tpu.oocore.prefetch import ShardPrefetcher
+
+        shard_b = store.shard_sizes[0] * 16 * 4
+        monkeypatch.setenv("SQ_OOC_RAM_BUDGET_BYTES", str(3 * shard_b))
+        pf = ShardPrefetcher(store, range(store.n_shards), depth=4,
+                             threads=2)
+        try:
+            assert pf._avail is not None and pf._avail <= shard_b
+            for pos in range(store.n_shards):
+                arr = pf.get(pos)
+                lo = int(store._offsets[pos])
+                np.testing.assert_array_equal(
+                    arr, X_TALL[lo:lo + store.shard_sizes[pos]])
+        finally:
+            pf.close()
+
+    def test_sequential_contract_and_close(self, store):
+        from sq_learn_tpu.oocore.prefetch import ShardPrefetcher
+
+        pf = ShardPrefetcher(store, [0, 1, 2], depth=2, threads=2)
+        try:
+            pf.get(0)
+            with pytest.raises(RuntimeError, match="sequential"):
+                pf.get(2)
+        finally:
+            pf.close()
+        pf.close()  # idempotent
+
+    def test_prefetched_view_serves_row_walks(self, store, monkeypatch):
+        self._depth(monkeypatch, 2)
+        view = store.prefetched()
+        assert view is not store
+        try:
+            np.testing.assert_array_equal(view.read_rows(300, 900),
+                                          X_TALL[300:900])
+            np.testing.assert_array_equal(view.read_rows(900, 2003),
+                                          X_TALL[900:2003])
+            assert view.fingerprint == store.fingerprint
+            assert streaming.is_row_source(view)
+        finally:
+            view.close()
+        self._depth(monkeypatch, 0)
+        assert store.prefetched() is store  # depth 0: no wrapper
+
+    def test_prefetch_counters_and_span(self, store, recorder,
+                                        monkeypatch):
+        self._depth(monkeypatch, 2)
+        kw = dict(n_clusters=4, batch_rows=256, max_epochs=1, seed=0)
+        oocore.minibatch_epoch_fit(store, **kw)
+        gets = (recorder.counters.get("oocore.prefetch_hits", 0)
+                + recorder.counters.get("oocore.prefetch_stalls", 0))
+        assert gets == store.n_shards  # one epoch visits every shard once
+        assert any(s["name"] == "oocore.prefetch" for s in recorder.spans)
+
+
+class TestAsyncCheckpoints:
+    """ISSUE 10: mid-epoch snapshots move to a background writer thread —
+    same save_stream_state durability, zero batch-loop stall, drain-
+    before-delete so a finished fit can never be resurrected."""
+
+    def test_async_writer_drains_and_loads(self, tmp_path):
+        from sq_learn_tpu.utils.checkpoint import (AsyncStreamCheckpointer,
+                                                   load_stream_state)
+
+        path = str(tmp_path / "ck.npz")
+        w = AsyncStreamCheckpointer(path)
+        tpl = {"a": np.zeros(3, np.float32)}
+        for cursor in range(1, 6):
+            w.submit({"a": np.full(3, cursor, np.float32)}, cursor, "fp")
+        w.close()
+        assert w.writes >= 1
+        assert w.writes + w.dropped == 5  # every submit written or
+        # superseded by a newer one (latest-wins)
+        loaded = load_stream_state(path, tpl, "fp")
+        assert loaded is not None
+        acc, cursor = loaded
+        # the LAST submitted snapshot is what survives
+        assert cursor == 5
+        np.testing.assert_array_equal(acc["a"], np.full(3, 5, np.float32))
+
+    def test_async_writer_snapshot_isolated_from_mutation(self, tmp_path):
+        """submit() deep-copies: mutating the live state after submit
+        must not corrupt the written snapshot."""
+        from sq_learn_tpu.utils.checkpoint import (AsyncStreamCheckpointer,
+                                                   load_stream_state)
+
+        path = str(tmp_path / "ck.npz")
+        w = AsyncStreamCheckpointer(path)
+        state = {"step": np.zeros((), np.int64)}
+        w.submit(state, 1, "fp")
+        state["step"] += 41  # in-place mutation after the snapshot
+        w.close()
+        acc, _ = load_stream_state(path, state, "fp")
+        assert int(acc["step"]) == 0
+
+    def test_async_writer_error_surfaces(self, tmp_path):
+        from sq_learn_tpu.utils.checkpoint import AsyncStreamCheckpointer
+
+        w = AsyncStreamCheckpointer(str(tmp_path / "no_dir" / "ck.npz"))
+        w.submit({"a": np.zeros(2)}, 1, "fp")
+        with pytest.raises(Exception):
+            w.close()
+
+    def test_interrupt_resume_parity_serial_ckpt_mode(self, store,
+                                                      tmp_path,
+                                                      monkeypatch):
+        """SQ_OOC_ASYNC_CKPT=0 restores the synchronous write path —
+        parity and cleanup contracts identical (the default async mode
+        is covered by the pre-existing interrupt/resume + SIGKILL tests)."""
+        monkeypatch.setenv("SQ_OOC_ASYNC_CKPT", "0")
+        monkeypatch.setenv("SQ_STREAM_CKPT_EVERY", "2")
+        ck = str(tmp_path / "mb.npz")
+        kw = dict(n_clusters=4, batch_rows=256, max_epochs=3, seed=1)
+        ref = oocore.minibatch_epoch_fit(store, **kw)
+        faults.arm("abort:tile=9,times=1")
+        try:
+            with pytest.raises(InjectedInterrupt):
+                oocore.minibatch_epoch_fit(store, checkpoint=ck, **kw)
+        finally:
+            faults.disarm()
+        out = oocore.minibatch_epoch_fit(store, checkpoint=ck, **kw)
+        assert out["resumed_from"] >= 1
+        np.testing.assert_array_equal(out["centers"], ref["centers"])
+        assert not os.path.exists(ck) and not os.path.exists(ck + ".prev")
+
+
+class TestParallelStoreBuild:
+    def test_parallel_build_matches_serial_manifest(self, tmp_path,
+                                                    monkeypatch):
+        """The thread-pool build must be byte-identical to the serial
+        one: same shard files, same CRCs, same fingerprint, same
+        float-accumulated column stats (commit order is shard order)."""
+        import json
+
+        kw = dict(n_samples=900, n_features=8, n_classes=3, seed=4,
+                  shard_bytes=4 * 1024)
+        monkeypatch.setenv("SQ_OOC_PREFETCH_THREADS", "3")
+        par = oocore.create_synthetic_store(str(tmp_path / "par"), **kw)
+        monkeypatch.setenv("SQ_OOC_PREFETCH_THREADS", "1")
+        ser = oocore.create_synthetic_store(str(tmp_path / "ser"), **kw)
+        assert par.fingerprint == ser.fingerprint
+        mp = json.load(open(os.path.join(par.path, "manifest.json")))
+        ms = json.load(open(os.path.join(ser.path, "manifest.json")))
+        assert mp == ms
+        np.testing.assert_array_equal(par.read_rows(0, 900),
+                                      ser.read_rows(0, 900))

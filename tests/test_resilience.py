@@ -584,15 +584,15 @@ class TestResume:
                                       np.asarray(mean_ref))
 
 
-# -- supervised chunked_device_put ------------------------------------------
+# -- supervised whole-array placement ---------------------------------------
 
 
-class TestChunkedPutSupervised:
+class TestResidentPutSupervised:
     def test_transient_failure_recovers(self):
-        from sq_learn_tpu._config import chunked_device_put
+        from sq_learn_tpu.streaming import streamed_resident_put
 
         plan = faults.arm("put_fail:tiles=1,times=1")
-        out = chunked_device_put(X_TALL, max_bytes=TILE_BYTES)
+        out = streamed_resident_put(X_TALL, max_bytes=TILE_BYTES)
         assert [ev["kind"] for ev in plan.events] == ["put_fail"]
         np.testing.assert_array_equal(np.asarray(out), X_TALL)
 

@@ -336,6 +336,45 @@ def test_serving_model_rejects_unservable():
         ServingModel(object())
 
 
+def test_registry_warm_prefetches_cold_loads(tmp_path, fitted):
+    """ISSUE 10: warm() loads checkpoint-backed tenants on a thread pool
+    so the first request hits a resident model — same digest-verified
+    resolve path, LRU accounting included; over-capacity requests are
+    skipped (warming them would thrash), and a broken checkpoint reports
+    an error without aborting the rest."""
+    paths = {n: save_estimator(est, str(tmp_path / n))
+             for n, est in (("t0", fitted["qkm"]), ("t1", fitted["svd"]),
+                            ("t2", fitted["qkm"]))}
+    reg = ModelRegistry(capacity=2)
+    for n, p in paths.items():
+        reg.register(n, p)
+    rec = obs.enable()
+    out = reg.warm()
+    assert out == {"t0": "skipped_capacity", "t1": "loaded",
+                   "t2": "loaded"}
+    assert set(reg.resident_tenants()) == {"t1", "t2"}
+    assert rec.counters.get("serving.registry_warm_loads", 0) == 2
+    loads = rec.counters.get("serving.registry_loads", 0)
+    # warm hits: resolving the warmed tenants does no further cold load
+    m1 = reg.resolve("t1")
+    assert reg.resolve("t1") is m1
+    assert rec.counters.get("serving.registry_loads", 0) == loads
+    # already-resident tenants report as such on a second warm
+    assert reg.warm(["t1", "t2"]) == {"t1": "resident", "t2": "resident"}
+    obs.disable()
+
+    # a corrupt checkpoint fails ITS tenant only, loudly at resolve time
+    state = tmp_path / "t0" / "state.npz"
+    blob = bytearray(state.read_bytes())
+    blob[-1] ^= 0xFF
+    state.write_bytes(bytes(blob))
+    out = reg.warm(["t0", "t1"])
+    assert out["t1"] == "resident"
+    assert out["t0"].startswith("error:")
+    with pytest.raises(ValueError, match="stale or corrupt"):
+        reg.resolve("t0")
+
+
 # -- SLO ---------------------------------------------------------------------
 
 

@@ -388,13 +388,15 @@ class TestStreamingRoutes:
         np.testing.assert_array_equal(np.asarray(out), X)
         assert "streaming.assemble" in obs.watchdog.report()
 
-    def test_chunked_device_put_delegates_to_streaming(self, run):
-        from sq_learn_tpu._config import chunked_device_put
+    def test_put_host_delegates_to_streaming(self, run):
+        from sq_learn_tpu._config import _put_host
 
         X = _data(300, 7)
-        out = chunked_device_put(X, None, max_bytes=4096)
+        out = _put_host(X, None, max_bytes=4096)
         np.testing.assert_array_equal(np.asarray(out), X)
-        # the deprecated wrapper now rides the supervised streaming path
+        # as_device_array's placement helper rides the supervised
+        # streaming path above the byte cap (the removed
+        # chunked_device_put wrapper is pinned in test_config_device)
         assert "streaming.assemble" in obs.watchdog.report()
         assert run.counters["streaming.tiles"] >= 2
 
