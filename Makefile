@@ -130,11 +130,17 @@ oocore-smoke:
 	env SQ_OBS=1 SQ_OBS_PATH=/tmp/sq_oocore_smoke.jsonl \
 	    $(PYTHON) -m sq_learn_tpu.oocore.smoke
 
-# Serving smoke: two checkpointed tenants behind the micro-batching
-# dispatcher — digest-verified registry loads, mixed-size/type/tenant
-# load with estimator parity, result-cache hit, one absorbed transfer
-# fault with bit parity, and schema validation of the emitted JSONL
-# incl. >=1 `slo` record. The CI-runnable contract check for
+# Serving smoke: checkpointed tenants (plus bf16/int8 quantized
+# registrations) behind the micro-batching dispatcher — AOT warm FIRST
+# (whole bucket ladder, persistent compile cache armed at a fresh dir),
+# then watchdog budgets pinned to 0 under SQ_OBS_STRICT=1: a single
+# serving-path jit compile fails the smoke. Digest-verified registry
+# loads, mixed-size/type/tenant load with estimator parity, result-cache
+# hit, one absorbed transfer fault with bit parity, quantized responses
+# within the declared (ε, δ) fold on EVERY request under
+# SQ_OBS_AUDIT_STRICT=1, >=1 persistent-cache hit in a second process,
+# and schema validation of the emitted JSONL incl. >=1 `slo` +
+# `guarantee` record. The CI-runnable contract check for
 # sq_learn_tpu.serving.
 serve-smoke:
 	env SQ_OBS=1 SQ_OBS_PATH=/tmp/sq_serve_smoke.jsonl \
@@ -167,9 +173,11 @@ obs-frontier:
 # 70k×784 headline (sketched spectral stats — the line whose band pins
 # the sketch engine's win), AND the PR 8 out-of-core fit (100k×784 shard
 # store over a 96 MB RAM budget, with the killed-and-resumed leg), AND
-# the PR 9 serving load bench (12k mixed requests through the
-# micro-batching dispatcher: QPS lower-bounded by the `throughput` gate,
-# p99 upper-bounded by the latency gate) under
+# the PR 9/11 serving load bench (12k mixed requests through the
+# AOT-warmed micro-batching dispatcher: QPS lower-bounded by the
+# `throughput` gate, p99 upper-bounded by the latency gate, cold-start
+# p99 ratio floored at 5.0 and the bf16 bytes ratio floored at 1.8 by
+# the history-free vs_baseline gate) under
 # SQ_OBS=1 and band every line (latency,
 # compile_count, total_transfer_bytes, peak HBM) against the committed
 # BENCH_r*.json trajectory + bench/records history. Exit 1 on any red

@@ -161,17 +161,19 @@ env -u PYTHONPATH timeout 60 python -m sq_learn_tpu.obs frontier \
   || echo "# (no tradeoff records this run)" >> "$obs_dir/frontier.txt"
 
 # BASELINE acceptance gate (bench/_gate.py: vs_baseline >= 0.5 on every
-# line, 10 measured + 2 derived lines expected — the sixth measured line
+# line, 12 measured + 2 derived lines expected — the sixth measured line
 # is the streaming-ingest smoke config, whose baseline is the monolithic
 # ingest of the same fit; the seventh is the PR 6 fused-fit config
 # (classical 70k×784 q-means vs sklearn on the SAME δ=0 configuration);
 # the eighth is the PR 8 out-of-core config, whose baseline is the
 # in-RAM fit of the same store — vs_baseline >= 0.5 reads "fitting from
 # disk under a RAM budget costs at most 2x residency";
-# the ninth and tenth are the PR 9 serving load bench's pair (sustained
-# micro-batched QPS vs the sequential per-request arm, and p99 vs the
-# same — vs_baseline >= 0.5 reads "micro-batching never halves either");
-# the derived pair is bench_ipe_digits and the
+# the ninth through twelfth are the PR 9/11 serving load bench's quad
+# (sustained micro-batched QPS vs the sequential per-request arm, p99
+# vs the same, the AOT-warmed cold-start-p99 ratio vs the unwarmed arm
+# — its own floor is 5.0 via the vs_baseline regression gate — and the
+# bf16 bytes ratio vs the f32 arm, floor 1.8 ⇔ "quantized moves
+# ≤ 0.55× the bytes"); the derived pair is bench_ipe_digits and the
 # sharded-scaling smoke config; missing/null = fail). This
 # script is where the bar is enforced — the unit suite only warns, since
 # wall-clock there is subject to arbitrary host load.
@@ -179,7 +181,7 @@ env -u PYTHONPATH timeout 60 python -m sq_learn_tpu.obs frontier \
 # pre-imports jax via the axon sitecustomize and would hang on a wedged
 # relay even though this step only parses JSON; -m bench._gate resolves
 # via cwd, which is the repo root here)
-env -u PYTHONPATH timeout 60 python -m bench._gate "$out" 10 2
+env -u PYTHONPATH timeout 60 python -m bench._gate "$out" 12 2
 gate_rc=$?
 echo "# acceptance gate rc=$gate_rc" >> "$out"
 echo "done: $out"

@@ -304,6 +304,38 @@ def with_device_scope(method):
     return wrapper
 
 
+def enable_persistent_compilation_cache(path=None, min_entry_bytes=0,
+                                        min_compile_secs=0.0):
+    """Point jax's persistent compilation cache at ``path`` (default
+    ``SQ_COMPILE_CACHE_DIR``); returns the directory used, or None when
+    neither is set (no-op).
+
+    Process-global, like every ``jax.config`` mutation this module owns
+    (x64 above): once enabled, EVERY compile in the process persists
+    under the thresholds given. The serving AOT warm
+    (:mod:`sq_learn_tpu.serving.aot`) calls this with zero thresholds so
+    a restarted server re-loads its warmed executables from disk instead
+    of re-lowering them; accelerator bench runs keep using
+    ``bench._common._enable_compilation_cache`` (same jax knobs, probe-
+    gated so a wedged tunnel is never touched). The CPU-backend caveat
+    recorded there (host-specific AOT code + loader warnings after a
+    host rotation) applies to long-lived cache dirs; serving smokes use
+    a fresh directory per run.
+    """
+    if path is None:
+        path = os.environ.get("SQ_COMPILE_CACHE_DIR")
+    if not path:
+        return None
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", str(path))
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                      int(min_entry_bytes))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      float(min_compile_secs))
+    return str(path)
+
+
 #: Host→device transfers are streamed in slices no larger than this. Every
 #: observed axon-relay wedge hit during a single ≥200 MB host→device upload
 #: (never during small transfers), so keeping each relay transaction under

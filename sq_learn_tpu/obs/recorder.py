@@ -44,7 +44,9 @@ import time
 #     layer: (ε, δ)-contract audits and accuracy-vs-runtime sweep points)
 # v4: +slo record type (the serving layer's per-run p50/p99 latency,
 #     sustained QPS, batch-occupancy and degrade accounting)
-SCHEMA_VERSION = 4
+# v5: +slo.transfer_bytes optional field (the quantized serving route's
+#     bytes-moved evidence, PR 11 — no new record types)
+SCHEMA_VERSION = 5
 
 #: default sink path when SQ_OBS=1 and SQ_OBS_PATH is unset
 DEFAULT_PATH = "sq_obs.jsonl"
@@ -413,6 +415,20 @@ def snapshot():
         "serve_cache_hits": int(rec.counters.get("serving.cache_hits", 0)),
         "serve_cache_misses": int(
             rec.counters.get("serving.cache_misses", 0)),
+        # AOT-warmed serving (serving.aot, PR 11): executables minted at
+        # warm time, dispatch-time executable-cache traffic, persistent
+        # compile-cache reloads, and the bytes serving moved host→device
+        # (its own counter — streaming.transfer_bytes stays the streamed
+        # ingest tally the historical bands were cut against)
+        "aot_compiles": int(rec.counters.get("serving.aot_compiles", 0)),
+        "aot_cache_hits": int(
+            rec.counters.get("serving.aot_cache_hits", 0)),
+        "aot_cache_misses": int(
+            rec.counters.get("serving.aot_cache_misses", 0)),
+        "persistent_cache_hits": int(
+            rec.counters.get("serving.persistent_cache_hits", 0)),
+        "serving_transfer_bytes": int(
+            rec.counters.get("serving.transfer_bytes", 0)),
     }
 
 

@@ -129,6 +129,24 @@ def summarize(records):
 
     slo = [r for r in records if r.get("type") == "slo"]
 
+    # AOT-warmed / quantized serving (PR 11): executables minted at warm
+    # time vs dispatch-time executable-cache traffic (hits at 100% =
+    # zero serving-path compiles), persistent compile-cache reloads, the
+    # bytes serving moved, and each quantized residency's declared fold
+    serving = {
+        "aot_compiles": counters.get("serving.aot_compiles", 0),
+        "aot_cache_hits": counters.get("serving.aot_cache_hits", 0),
+        "aot_cache_misses": counters.get("serving.aot_cache_misses", 0),
+        "persistent_cache_hits": counters.get(
+            "serving.persistent_cache_hits", 0),
+        "persistent_cache_misses": counters.get(
+            "serving.persistent_cache_misses", 0),
+        "transfer_bytes": counters.get("serving.transfer_bytes", 0),
+        "quant_folds": [r.get("value") for r in records
+                        if r.get("type") == "gauge"
+                        and r.get("name") == "serving.quant_fold"],
+    }
+
     # out-of-core prefetch: readahead hit/stall traffic plus the measured
     # stall seconds — the numbers that say whether shard reads overlapped
     # compute or the consumer sat waiting on the disk/CRC pass
@@ -148,6 +166,7 @@ def summarize(records):
         "spans": by_name,
         "watchdog": watchdog,
         "slo": slo,
+        "serving": serving,
         "counters": counters,
         "xla": xla,
         "ledger": {"queries": ledger_queries,
@@ -288,11 +307,41 @@ def render(summary, top=12):
                  + "ms p99<=" + _fmt_num(tgt.get("p99_ms")) + "ms"
                  if tgt else "")
         flag = "  SLO VIOLATED" if r.get("violated") else ""
+        tb = r.get("transfer_bytes")
+        tb_s = f"  moved {tb} B" if tb else ""
         out(f"  {r.get('site')}: {r.get('requests', 0)} req @ "
             f"{_fmt_num(r.get('qps'))} qps  p50 {r.get('p50_ms')}ms  "
             f"p99 {r.get('p99_ms')}ms  occupancy "
             f"{r.get('batch_occupancy')}  degraded {r.get('degraded')}"
-            f"{tgt_s}{flag}")
+            f"{tb_s}{tgt_s}{flag}")
+
+    srv = summary.get("serving") or {}
+    if (srv.get("aot_compiles") or srv.get("aot_cache_hits")
+            or srv.get("quant_folds")):
+        out("")
+        out("-- serving AOT / quantized routes --")
+        gets = srv.get("aot_cache_hits", 0) + srv.get("aot_cache_misses", 0)
+        if gets or srv.get("aot_compiles"):
+            rate = (srv.get("aot_cache_hits", 0) / gets) if gets else 0.0
+            out(f"  {srv.get('aot_compiles', 0)} executable(s) warmed; "
+                f"{srv.get('aot_cache_hits', 0)}/{gets} dispatches served "
+                f"AOT ({rate:.0%} — 100% means zero serving-path "
+                f"compiles)")
+        if srv.get("persistent_cache_hits") \
+                or srv.get("persistent_cache_misses"):
+            out(f"  persistent compile cache: "
+                f"{srv.get('persistent_cache_hits', 0)} reload(s), "
+                f"{srv.get('persistent_cache_misses', 0)} cold "
+                f"compile(s)")
+        if srv.get("transfer_bytes"):
+            out(f"  {srv.get('transfer_bytes', 0)} padded payload bytes "
+                f"moved host->device")
+        for fold in srv.get("quant_folds") or []:
+            if isinstance(fold, dict):
+                out(f"  fold {fold.get('op')}[{fold.get('mode')}]: "
+                    f"tol = {fold.get('coef_const')} + "
+                    f"{fold.get('coef_amax')}*amax_x ({fold.get('kind')}), "
+                    f"delta_q {fold.get('delta')}")
 
     out("")
     out("-- fault / breaker / regression timeline --")

@@ -1,17 +1,19 @@
 """JSONL schema for obs records, and a dependency-free validator.
 
 Every line of an obs JSONL file is one JSON object carrying the common
-envelope ``{"v": 4, "schema_version": 4, "ts": <unix seconds>,
+envelope ``{"v": 5, "schema_version": 5, "ts": <unix seconds>,
 "type": <t>}`` plus per-type required fields. Version history: v1 (PR 2)
 had neither the ``schema_version`` alias nor the ``xla_cost`` /
 ``regression`` types; v2 (PR 4) added those; v3 (PR 5) adds the
 statistical-observability types ``guarantee`` (one realized-vs-declared
 (ε, δ) draw) and ``tradeoff`` (one accuracy-vs-theoretical-runtime sweep
 point); v4 (PR 9) adds ``slo`` (one serving-run latency/throughput
-summary from :mod:`sq_learn_tpu.serving`). Older versions still validate
-(their types are a strict subset), any other version is rejected — an
-unknown version means a reader that would silently misinterpret fields,
-so it must fail loudly.
+summary from :mod:`sq_learn_tpu.serving`); v5 (PR 11) adds the optional
+``slo.transfer_bytes`` field (the quantized serving route's bytes-moved
+evidence — no new record types). Older versions still validate (their
+types are a strict subset), any other version is rejected — an unknown
+version means a reader that would silently misinterpret fields, so it
+must fail loudly.
 
 =========  ==============================================================
 type       required fields (beyond the envelope)
@@ -68,8 +70,10 @@ slo        site (str), requests (int ≥ 0), p50_ms (number ≥ 0),
            violated (bool) — one serving run's latency/throughput
            summary against its declared SLO targets
            (:mod:`sq_learn_tpu.serving.slo`); optional batches (int),
-           window_s (number ≥ 0), targets (object: str → number),
-           attrs (object)
+           window_s (number ≥ 0), transfer_bytes (int ≥ 0 — padded
+           payload bytes moved host→device; the quantized route's
+           bytes-halved claim reads off this, v5),
+           targets (object: str → number), attrs (object)
 =========  ==============================================================
 
 The out-of-core layer (PR 8) rides the generic types rather than minting
@@ -93,8 +97,9 @@ _NUM = (int, float)
 
 #: versions this validator knows how to read (v1 = PR 2's envelope
 #: without schema_version/xla_cost/regression; v2 = PR 4's, without
-#: guarantee/tradeoff; v3 = PR 5's, without slo)
-KNOWN_VERSIONS = {1, 2, 3, SCHEMA_VERSION}
+#: guarantee/tradeoff; v3 = PR 5's, without slo; v4 = PR 9's, without
+#: slo.transfer_bytes)
+KNOWN_VERSIONS = {1, 2, 3, 4, SCHEMA_VERSION}
 
 _PROBE_OUTCOMES = {"ok", "timeout", "error", "cpu", "skipped"}
 
@@ -285,6 +290,11 @@ def validate_record(rec):
             _check(isinstance(rec["batches"], int)
                    and not isinstance(rec["batches"], bool), errors,
                    "slo.batches int")
+        if "transfer_bytes" in rec:
+            _check(isinstance(rec["transfer_bytes"], int)
+                   and not isinstance(rec["transfer_bytes"], bool)
+                   and rec["transfer_bytes"] >= 0, errors,
+                   "slo.transfer_bytes non-negative int")
         if "window_s" in rec:
             _check(isinstance(rec["window_s"], _NUM)
                    and rec["window_s"] >= 0, errors,

@@ -91,14 +91,15 @@ def _cost_dict(lowered):
     return {"flops": num("flops"), "bytes_accessed": num("bytes accessed")}
 
 
-def _memory_dict(lowered):
-    """Normalized buffer sizes from ``compiled.memory_analysis()``.
-    ``peak_bytes`` prefers the executable's own peak stat and falls back
-    to argument+output+temp+generated-code (the live set at launch)."""
+def _memory_dict(compiled):
+    """Normalized buffer sizes from a ``Compiled``'s
+    ``memory_analysis()``. ``peak_bytes`` prefers the executable's own
+    peak stat and falls back to argument+output+temp+generated-code (the
+    live set at launch)."""
     out = {"peak_bytes": None, "argument_bytes": None, "output_bytes": None,
            "temp_bytes": None, "generated_code_bytes": None}
     try:
-        ma = lowered.compile().memory_analysis()
+        ma = compiled.memory_analysis()
     except Exception:
         return out
     if ma is None:
@@ -158,7 +159,46 @@ def capture(site, fn, *args, _extra_key=None, **kwargs):
         return entry
     entry.update(_cost_dict(lowered))
     if os.environ.get("SQ_OBS_XLA_MEMORY") != "0":
-        entry.update(_memory_dict(lowered))
+        try:
+            entry.update(_memory_dict(lowered.compile()))
+        except Exception:
+            pass
+    try:
+        import jax
+
+        entry["backend"] = jax.default_backend()
+    except Exception:
+        pass
+    rec.record(entry, kind="xla_cost_records")
+    return entry
+
+
+def capture_compiled(site, lowered, compiled, *args, **kwargs):
+    """Record one ``xla_cost`` line from an ALREADY-lowered-and-compiled
+    kernel — the AOT warm path (:mod:`sq_learn_tpu.serving.aot`), where
+    the lowering exists anyway and re-lowering for analysis (what
+    :func:`capture` must do against a jit cache it cannot reach into)
+    would double the warm cost. Same dedup key, record shape, and
+    never-raises contract as :func:`capture`; ``args``/``kwargs`` are
+    the abstract call signature (``ShapeDtypeStruct``s sign identically
+    to the concrete arrays they stand for)."""
+    rec = recorder._active
+    if rec is None:
+        return None
+    try:
+        sig = signature_of(args, kwargs)
+    except Exception:
+        return None
+    key = (site, sig)
+    with recorder._lock:
+        if key in rec._xla_seen:
+            return None
+        rec._xla_seen.add(key)
+    entry = {"type": "xla_cost", "site": site, "signature": sig,
+             "flops": None, "bytes_accessed": None, "peak_bytes": None}
+    entry.update(_cost_dict(lowered))
+    if os.environ.get("SQ_OBS_XLA_MEMORY") != "0":
+        entry.update(_memory_dict(compiled))
     try:
         import jax
 

@@ -16,9 +16,19 @@ actually happens. This package turns fitted estimators into a service:
 - :mod:`~.cache` — digest-keyed transform-result cache for repeated
   identical requests (``SQ_SERVE_CACHE=0`` disables).
 - :class:`~.slo.SloTracker` — per-run p50/p99 latency, sustained QPS,
-  batch occupancy and degrade counts, emitted as the v4 ``slo`` obs
-  record and gated against ``SQ_SERVE_SLO_P50_MS``/``SQ_SERVE_SLO_P99_MS``
+  batch occupancy, transfer bytes and degrade counts, emitted as the
+  ``slo`` obs record (schema v5) and gated against
+  ``SQ_SERVE_SLO_P50_MS``/``SQ_SERVE_SLO_P99_MS``
   (``SQ_SERVE_SLO_STRICT=1`` raises on violation).
+- :mod:`~.aot` — ahead-of-time compiled serving kernels: ``registry.
+  warm()`` (or ``dispatcher.warm()``) compiles the whole bucket ladder
+  before traffic, so p99 is flat from request one and the serving path
+  mints ZERO jit compiles post-warm; ``SQ_COMPILE_CACHE_DIR`` persists
+  executables across process restarts.
+- :mod:`~.quantize` — bf16/int8 serving with the quantization error
+  folded CONSERVATIVELY into the tenant's declared (ε, δ) (the PR 7
+  sketch-fold rule), live-audited via guarantee draws;
+  ``quantize=None`` stays bit-identical to the f32 route.
 
 Quickstart::
 
@@ -33,15 +43,20 @@ Env knobs: ``SQ_SERVE_MAX_WAIT_MS`` (2.0) coalescing window,
 ``SQ_SERVE_MAX_BATCH_ROWS`` (512) batch cap / largest bucket,
 ``SQ_SERVE_MIN_BUCKET_ROWS`` (8) smallest bucket,
 ``SQ_SERVE_REGISTRY_CAP`` (8) resident models, ``SQ_SERVE_CACHE`` /
-``SQ_SERVE_CACHE_ENTRIES`` result cache, ``SQ_SERVE_SLO_*`` targets.
+``SQ_SERVE_CACHE_ENTRIES`` result cache, ``SQ_SERVE_SLO_*`` targets,
+``SQ_SERVE_AOT`` (1) AOT warm on ``registry.warm()``,
+``SQ_COMPILE_CACHE_DIR`` persistent compile cache,
+``SQ_SERVE_QUANTIZE`` (unset) process-default quantized route,
+``SQ_SERVE_QUANT_DELTA`` (1e-3) fold audit budget,
+``SQ_SERVE_AUDIT_EVERY`` (8) live-audit batch stride.
 Full docs: ``docs/serving.md``; load bench:
 ``bench/bench_serving_load.py``; contract smoke: ``make serve-smoke``.
 """
 
-from . import cache, dispatcher, registry, slo
+from . import aot, cache, dispatcher, quantize, registry, slo
 from .dispatcher import (MicroBatchDispatcher, kernel_cache_sizes,
-                         serve_max_batch_rows, serve_max_wait_ms,
-                         serve_min_bucket_rows)
+                         pin_compile_budgets, serve_max_batch_rows,
+                         serve_max_wait_ms, serve_min_bucket_rows)
 from .registry import ModelRegistry, ServingModel
 from .slo import SloTracker, SloViolation
 
@@ -51,9 +66,12 @@ __all__ = [
     "ServingModel",
     "SloTracker",
     "SloViolation",
+    "aot",
     "cache",
     "dispatcher",
     "kernel_cache_sizes",
+    "pin_compile_budgets",
+    "quantize",
     "registry",
     "serve_max_batch_rows",
     "serve_max_wait_ms",

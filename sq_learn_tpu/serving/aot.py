@@ -1,0 +1,262 @@
+"""Ahead-of-time compiled serving kernels: flat p99 from request one.
+
+PR 9's dispatcher compiles each serving kernel lazily — the first
+request per (bucket, dtype, model-shape) pays a full XLA lowering ON
+the serving path (~100-400 ms on this host against a ~1-3 ms warm
+dispatch: the cold-start p99 the load bench's open-loop leg measures).
+This module moves every one of those compiles to warm time:
+
+- **The ladder is enumerable.** Serving shapes are not open-ended: the
+  dispatcher pads every batch to the pow2 bucket ladder between
+  ``SQ_SERVE_MIN_BUCKET_ROWS`` and ``SQ_SERVE_MAX_BATCH_ROWS``, each
+  model serves a fixed kernel set with fixed param shapes, and the
+  request dtypes are the canonical float set (or the model's single
+  quantized transfer dtype). :func:`warm_model` walks that product and
+  ``jax.jit(...).lower(...).compile()``s each signature from
+  ``ShapeDtypeStruct``s — no example batch needed — on the registry
+  warm pool (the PR 10 prefetch pattern), holding the executables in a
+  process-global cache keyed by the exact (kernel, arg-shapes/dtypes)
+  signature.
+- **The dispatcher hits executables, not the tracing cache.**
+  :func:`lookup` resolves a dispatch to its warmed executable; the jit
+  wrapper is only the fallback for signatures outside the warmed ladder
+  (an oversized single request pads past ``max_batch_rows``). Because
+  AOT executables never enter the jit's compile cache, the retracing
+  watchdog's count stays at ZERO for warmed traffic — ``make
+  serve-smoke`` pins exactly that with a flat budget of 0 under
+  ``SQ_OBS_STRICT=1``.
+- **Restarts start warm too.** ``SQ_COMPILE_CACHE_DIR`` arms jax's
+  persistent compilation cache (``jax_compilation_cache_dir``) so a new
+  process re-*loads* each warmed executable from disk instead of
+  re-lowering it (~4× faster on this host's CPU backend, more where
+  compiles are slower); :func:`persistent_cache_stats` counts the
+  hits/misses via jax's monitoring events, mirrored into the
+  ``serving.persistent_cache_hits/misses`` obs counters.
+- **Costs are captured at warm time.** Each warm compile records its
+  ``xla_cost`` line (FLOPs, bytes, peak HBM) through
+  :func:`sq_learn_tpu.obs.xla.capture_compiled` — the analysis rides
+  the lowering the warm already paid for, instead of re-lowering on the
+  first request like the instrument wrapper would.
+
+Obs counters: ``serving.aot_compiles`` (executables minted at warm
+time), ``serving.aot_cache_hits`` / ``serving.aot_cache_misses``
+(dispatch-time executable-cache traffic, pre-aggregated by the
+dispatcher and flushed at close).
+"""
+
+import os
+import threading
+
+from .. import obs as _obs
+from ..obs import xla as _xla
+
+__all__ = ["bucket_ladder", "cache_size", "clear", "compile_cache_dir",
+           "enable_persistent_cache", "lookup", "persistent_cache_stats",
+           "serve_dtypes", "warm", "warm_model"]
+
+_lock = threading.Lock()
+
+#: (kernel name, ((shape, dtype), ...)) → compiled executable. Keyed by
+#: the full abstract call signature, so two tenants with equal shapes
+#: share one executable and a re-registered tenant with new shapes can
+#: never hit its predecessor's.
+_executables = {}
+
+_persistent = {"registered": False, "enabled": False, "hits": 0,
+               "misses": 0, "path": None}
+
+
+def compile_cache_dir():
+    """The persistent compilation cache directory (``SQ_COMPILE_CACHE_DIR``,
+    unset = per-process compiles only)."""
+    return os.environ.get("SQ_COMPILE_CACHE_DIR") or None
+
+
+def enable_persistent_cache(path=None):
+    """Point jax's persistent compilation cache at ``path`` (default
+    ``SQ_COMPILE_CACHE_DIR``; no-op returning False when neither is
+    set). Thresholds drop to zero so every serving-kernel compile
+    persists — they are small and the whole point is that a restarted
+    process re-loads them. Safe to call repeatedly."""
+    from .._config import enable_persistent_compilation_cache
+
+    with _lock:
+        used = enable_persistent_compilation_cache(
+            path or compile_cache_dir())
+        if used is None:
+            return False
+        if _persistent["path"] != used:
+            # jax latches the persistent cache's enabled/dir state at
+            # its first compile; a server enables the cache AFTER its
+            # models fit (which compiled plenty), so the latch must be
+            # dropped for the new dir to take effect mid-process
+            try:
+                from jax.experimental.compilation_cache import (
+                    compilation_cache as _cc)
+
+                _cc.reset_cache()
+            except Exception:
+                pass  # older jax: the dir only binds pre-first-compile
+            _persistent["path"] = used
+        _register_listener()
+        _persistent["enabled"] = True
+    return True
+
+
+def _register_listener():
+    """Count jax's compilation-cache monitoring events (process-wide —
+    jax exposes no per-callsite hook) into module tallies + obs
+    counters. Registered once; the listener must never raise into jax."""
+    if _persistent["registered"]:
+        return
+    try:
+        from jax import monitoring
+
+        def _on_event(event, **kw):
+            try:
+                if event == "/jax/compilation_cache/cache_hits":
+                    _persistent["hits"] += 1
+                    _obs.counter_add("serving.persistent_cache_hits", 1)
+                elif event == "/jax/compilation_cache/cache_misses":
+                    _persistent["misses"] += 1
+                    _obs.counter_add("serving.persistent_cache_misses", 1)
+            except Exception:
+                pass
+
+        monitoring.register_event_listener(_on_event)
+        _persistent["registered"] = True
+    except Exception:
+        pass  # old jax without monitoring: stats stay at zero
+
+
+def persistent_cache_stats():
+    """{enabled, hits, misses} of the persistent compilation cache this
+    process (counts every jax compile, serving or not — the smoke's
+    second-process assertion reads ``hits``)."""
+    with _lock:
+        return {"enabled": _persistent["enabled"],
+                "hits": _persistent["hits"],
+                "misses": _persistent["misses"]}
+
+
+def bucket_ladder(min_rows=None, max_rows=None):
+    """The dispatcher's padded-shape ladder: pow2 buckets from the
+    serving floor up to (and always including) the batch row cap —
+    exactly the set ``streaming.bucket_rows`` can emit for in-cap
+    batches."""
+    from .dispatcher import serve_max_batch_rows, serve_min_bucket_rows
+
+    lo = serve_min_bucket_rows() if min_rows is None else int(min_rows)
+    hi = serve_max_batch_rows() if max_rows is None else int(max_rows)
+    b, out = max(1, lo), []
+    while b < hi:
+        out.append(b)
+        b <<= 1
+    out.append(hi)
+    return out
+
+
+def serve_dtypes(model):
+    """The transfer dtypes worth warming for a model: its single
+    quantized dtype, or the canonical floats a request can arrive in
+    (f32, and f64 only when x64 is on — ``_canonical`` folds everything
+    else into those before grouping)."""
+    import numpy as np
+    import jax
+
+    if model.quantize is not None:
+        return [model.transfer_dtype(np.dtype(np.float32))]
+    seen, out = set(), []
+    for d in (np.float32, np.float64):
+        c = jax.dtypes.canonicalize_dtype(d)
+        if c not in seen:
+            seen.add(c)
+            out.append(np.dtype(c))
+    return out
+
+
+def _key(kernel_name, sds):
+    return (kernel_name,
+            tuple((tuple(s.shape), str(s.dtype)) for s in sds))
+
+
+def lookup(model, op, bucket, dtype):
+    """The warmed executable serving ``(model, op)`` at ``(bucket,
+    dtype)``, or None (the dispatcher then falls back to the jit
+    wrapper, which compiles lazily as before)."""
+    kernel_name, sds = model.aot_signature(op, bucket, dtype)
+    return _executables.get(_key(kernel_name, sds))
+
+
+def warm_model(model, *, buckets=None, dtypes=None):
+    """Mint every executable in ``model``'s serving ladder (kernel set ×
+    buckets × transfer dtypes). Idempotent per signature; returns
+    ``{"compiled": n, "cached": m}``. One compile failure skips that
+    signature (the dispatcher's jit fallback still serves it) rather
+    than aborting the warm."""
+    from .dispatcher import _KERNELS
+
+    if buckets is None:
+        buckets = bucket_ladder()
+    if dtypes is None:
+        dtypes = serve_dtypes(model)
+    enable_persistent_cache()
+    compiled = cached = 0
+    for op in model.ops:
+        for dtype in dtypes:
+            for bucket in buckets:
+                kernel_name, sds = model.aot_signature(op, bucket, dtype)
+                key = _key(kernel_name, sds)
+                with _lock:
+                    if key in _executables:
+                        cached += 1
+                        continue
+                site = f"serving.{kernel_name}"
+                try:
+                    lowered = _KERNELS[kernel_name].lower(*sds)
+                    exe = lowered.compile()
+                except Exception:
+                    continue
+                with _lock:
+                    _executables[key] = exe
+                compiled += 1
+                _xla.capture_compiled(site, lowered, exe, *sds)
+    if compiled:
+        _obs.counter_add("serving.aot_compiles", compiled)
+    return {"compiled": compiled, "cached": cached}
+
+
+def warm(models, *, buckets=None, dtypes=None, threads=None):
+    """Warm several models' ladders on a bounded pool (the registry's
+    warm-pool shape). Returns the summed :func:`warm_model` stats."""
+    models = list(models)
+    nthreads = max(1, min(4, len(models)) if threads is None
+                   else int(threads))
+    with _obs.span("serving.aot.warm", models=len(models),
+                   threads=nthreads):
+        if nthreads <= 1 or len(models) <= 1:
+            stats = [warm_model(m, buckets=buckets, dtypes=dtypes)
+                     for m in models]
+        else:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(
+                    nthreads, thread_name_prefix="sq-serve-aot") as ex:
+                stats = list(ex.map(
+                    lambda m: warm_model(m, buckets=buckets, dtypes=dtypes),
+                    models))
+    return {"compiled": sum(s["compiled"] for s in stats),
+            "cached": sum(s["cached"] for s in stats)}
+
+
+def cache_size():
+    """Resident executable count (tests and the smoke read this)."""
+    with _lock:
+        return len(_executables)
+
+
+def clear():
+    """Drop every resident executable (tests; a fresh warm re-mints —
+    or re-loads from the persistent cache when one is armed)."""
+    with _lock:
+        _executables.clear()

@@ -10,8 +10,9 @@ concurrent predict/transform requests into the streaming engine's
 bucketed shapes:
 
 - **Coalescing window.** Requests enqueue with a submit timestamp; the
-  worker opens a batch at the head request's group key ``(tenant, op,
-  dtype, n_features)`` and closes it when ``SQ_SERVE_MAX_BATCH_ROWS``
+  worker opens a batch at the head request's group key (the model's
+  memoized ``(fingerprint, op, transfer dtype)`` token — one dict
+  lookup per submit) and closes it when ``SQ_SERVE_MAX_BATCH_ROWS``
   rows have accumulated or the head request has waited
   ``SQ_SERVE_MAX_WAIT_MS`` — the classic wait-vs-occupancy trade the SLO
   record's ``batch_occupancy`` field makes visible.
@@ -21,22 +22,30 @@ bucketed shapes:
   mutation), so mixed request sizes compile each serving kernel at most
   once per (bucket, dtype, model-shape) signature. The retracing
   watchdog enforces exactly that budget per kernel site; under
-  ``SQ_OBS_STRICT=1`` the first excess compile raises.
-- **One dispatch, scattered results.** The padded batch crosses once
-  through the transfer supervisor (:func:`~sq_learn_tpu.resilience.
-  supervisor.put`: retries, keyed backoff, deadline, breaker
-  accounting), one instrumented kernel call serves every request in it,
-  and the host-side rows scatter back per-request in submission order.
+  ``SQ_OBS_STRICT=1`` the first excess compile raises. With an
+  AOT-warmed ladder (:mod:`~sq_learn_tpu.serving.aot`) the dispatch
+  resolves to a pre-compiled executable and the serving path mints
+  ZERO compiles at all — the post-warm budget is 0, not 1
+  (:func:`pin_compile_budgets`).
+- **One dispatch, scattered results.** The padded batch — quantized to
+  the model's ``quantize`` mode first, when one is set
+  (:mod:`~sq_learn_tpu.serving.quantize`: bf16 halves, int8 quarters
+  the bytes crossing the boundary, and the declared (ε, δ) fold prices
+  the error) — crosses once through the transfer supervisor
+  (:func:`~sq_learn_tpu.resilience.supervisor.put`: retries, keyed
+  backoff, deadline, breaker accounting), one instrumented kernel call
+  serves every request in it, and the host-side rows scatter back
+  per-request in submission order.
 - **Degradation, not stalls.** Every dispatch preflights the circuit
   breaker; an OPEN breaker — or a placement whose retries exhausted —
-  degrades the batch to the **host route**: the same kernel on a plain
-  uncommitted placement, skipping the supervised transfer entirely. The
-  breaker's trip action has already repinned the process to the CPU
-  backend (the documented wedge escape), so on the CPU mesh degraded
-  responses are bit-identical to supervised ones and, crucially, zero
-  requests are lost and the queue never stalls behind a wedged relay.
-  Degrades count into the SLO record and the
-  ``serving.degraded_batches`` counter.
+  degrades the batch to the **host route**: the same kernel (and the
+  same already-quantized padded batch) on a plain uncommitted
+  placement, skipping the supervised transfer entirely. The breaker's
+  trip action has already repinned the process to the CPU backend (the
+  documented wedge escape), so on the CPU mesh degraded responses are
+  bit-identical to supervised ones and, crucially, zero requests are
+  lost and the queue never stalls behind a wedged relay. Degrades count
+  into the SLO record and the ``serving.degraded_batches`` counter.
 
 Determinism: with ``background=False`` the dispatcher never starts a
 worker thread — callers submit and then :meth:`~MicroBatchDispatcher.
@@ -59,10 +68,13 @@ from .. import obs as _obs
 from ..obs import xla as _xla
 from ..resilience import supervisor as _sup
 from ..streaming import bucket_rows
+from . import aot as _aot
 from . import cache as _cache
+from . import quantize as _quant
 from .slo import SloTracker
 
-__all__ = ["MicroBatchDispatcher", "ServeFuture", "serve_max_batch_rows",
+__all__ = ["MicroBatchDispatcher", "ServeFuture", "kernel_cache_sizes",
+           "pin_compile_budgets", "serve_max_batch_rows",
            "serve_max_wait_ms", "serve_min_bucket_rows"]
 
 
@@ -91,7 +103,9 @@ def serve_min_bucket_rows():
 # ---------------------------------------------------------------------------
 # Serving kernels (module-level jits: one compile cache per process, at
 # most one entry per (bucket, dtype, model-shape) signature — the
-# streaming engine's invariant applied to inference)
+# streaming engine's invariant applied to inference; the AOT executable
+# cache in serving.aot serves warmed signatures without touching these
+# caches at all)
 # ---------------------------------------------------------------------------
 
 
@@ -131,11 +145,13 @@ _transform_centers_kernel = _xla.instrument("serving.transform_centers",
 _transform_components_kernel = _xla.instrument(
     "serving.transform_components", _transform_components_kernel)
 
-#: kernel name (what ServingModel.ops binds) → instrumented jit
+#: kernel name (what ServingModel.ops binds) → instrumented jit —
+#: the f32 trio plus the quantized variants of serving.quantize
 _KERNELS = {
     "predict_centers": _predict_centers_kernel,
     "transform_centers": _transform_centers_kernel,
     "transform_components": _transform_components_kernel,
+    **_quant.KERNELS,
 }
 
 #: watchdog site → kernel, streaming.py's registry convention
@@ -144,8 +160,20 @@ _KERNEL_SITES = {f"serving.{name}": fn for name, fn in _KERNELS.items()}
 
 def kernel_cache_sizes():
     """Compile-cache entry count per serving kernel — the hook the
-    no-per-shape-recompile tests and the load bench read."""
+    no-per-shape-recompile tests and the load bench read. AOT-served
+    dispatches never grow these: a warmed ladder reads 0 here."""
     return {name: int(fn._cache_size()) for name, fn in _KERNELS.items()}
+
+
+def pin_compile_budgets(budget=0):
+    """Track every serving kernel site with a FLAT watchdog budget —
+    the post-AOT-warm contract: a warmed serving plane mints zero jit
+    compiles, so any compile is a regression, and under
+    ``SQ_OBS_STRICT=1`` the first one raises. Call after
+    ``registry.warm()``/:func:`~sq_learn_tpu.serving.aot.warm` (the
+    smoke and the load bench do)."""
+    for site, fn in _KERNEL_SITES.items():
+        _obs.watchdog.track(site, fn, budget=budget)
 
 
 class ServeFuture:
@@ -211,7 +239,11 @@ class _Request:
         self.cache_key = cache_key
         self.submitted = submitted
         self.future = ServeFuture()
-        self.group_key = (tenant, op, rows.dtype, rows.shape[1])
+        # the memoized model token: tenant identity rides the content
+        # fingerprint (a re-registered tenant gets a new one), and a
+        # quantized model folds f32/f64 streams into ONE transfer-dtype
+        # group — fewer, fuller buckets
+        self.group_key = model.group_key(op, rows.dtype)
         self.consumed = False
 
 
@@ -262,6 +294,10 @@ class MicroBatchDispatcher:
         self._closed = False
         self._batch_seq = 0
         self._sites_seen = set()
+        #: AOT executable-cache traffic, pre-aggregated (one counter
+        #: flush at close, not a JSONL line per batch)
+        self._aot_hits = 0
+        self._aot_misses = 0
         self._worker = None
         if background:
             self._worker = threading.Thread(
@@ -269,6 +305,16 @@ class MicroBatchDispatcher:
             self._worker.start()
 
     # -- client surface ----------------------------------------------------
+
+    def warm(self, tenants=None, aot=None):
+        """Warm the registry AND the AOT ladder for THIS dispatcher's
+        bucket configuration (``min_bucket_rows``..``max_batch_rows`` —
+        the env-derived defaults may differ). Returns the registry's
+        per-tenant warm statuses."""
+        return self.registry.warm(
+            tenants, aot=aot,
+            buckets=_aot.bucket_ladder(self._min_bucket,
+                                       self._max_batch_rows))
 
     def _prepare(self, tenant, op, X):
         """Validate and normalize one request. Returns a queued-ready
@@ -390,9 +436,23 @@ class MicroBatchDispatcher:
         self._closed = True
         if _obs.enabled():
             _cache.flush_counters()
+            if self._aot_hits:
+                _obs.counter_add("serving.aot_cache_hits", self._aot_hits)
+            if self._aot_misses:
+                _obs.counter_add("serving.aot_cache_misses",
+                                 self._aot_misses)
+            nbytes = self.slo.transfer_bytes()
+            if nbytes:
+                _obs.counter_add("serving.transfer_bytes", nbytes)
             for site in sorted(self._sites_seen):
                 _obs.watchdog.observe(site)
         return self.slo.emit()
+
+    def aot_stats(self):
+        """{hits, misses} of the AOT executable cache, this dispatcher
+        (pre-aggregation view — the counters flush at close)."""
+        with self._cond:
+            return {"hits": self._aot_hits, "misses": self._aot_misses}
 
     def __enter__(self):
         return self
@@ -543,36 +603,78 @@ class MicroBatchDispatcher:
         stages split for overlap."""
         self._resolve(self._launch(group))
 
-    def _launch(self, group):
-        """Stage 1: pad, place (supervised or degraded), dispatch the
-        kernel WITHOUT blocking on its result. Returns the in-flight
-        state for :meth:`_resolve`."""
+    def _assemble(self, group, bucket, model):
+        """Build the padded host batch in the group's transfer dtype:
+        the request rows verbatim (exact route), or quantized to the
+        model's mode — ONE rounding pass on the host, so the supervised
+        and degraded placements carry byte-identical payloads. Returns
+        ``(padded, extra_args, amax_x)`` where ``extra_args`` is the
+        int8 route's () f32 batch scale and ``amax_x`` the batch dynamic
+        range the declared fold is evaluated at (None when no audit can
+        consume it)."""
         head = group[0]
-        kernel_name, params = head.model.op(head.op)
+        mode = model.quantize
+        m = head.rows.shape[1]
+        if mode is None:
+            padded = np.zeros((bucket, m), head.rows.dtype)
+            off = 0
+            for r in group:
+                padded[off:off + r.n_rows] = r.rows
+                off += r.n_rows
+            return padded, (), None
+        amax_x = None
+        if mode == "int8" or _obs.guarantees.enabled():
+            amax_x = max((float(np.max(np.abs(r.rows))) if r.rows.size
+                          else 0.0) for r in group)
+        padded = np.zeros((bucket, m), _quant.transfer_dtype(mode))
+        extra = ()
+        scale = None
+        if mode == "int8":
+            scale = _quant.int8_scale(amax_x)
+            extra = (np.float32(scale),)
+        off = 0
+        for r in group:
+            _quant.quantize_rows(r.rows, mode,
+                                 out=padded[off:off + r.n_rows],
+                                 scale=scale)
+            off += r.n_rows
+        return padded, extra, amax_x
+
+    def _launch(self, group):
+        """Stage 1: pad (quantizing when the model says so), place
+        (supervised or degraded), dispatch the kernel WITHOUT blocking
+        on its result — through the AOT executable when the signature
+        was warmed, the lazily-compiling jit wrapper otherwise. Returns
+        the in-flight state for :meth:`_resolve`."""
+        head = group[0]
+        model = head.model
+        kernel_name, params = model.op(head.op)
         site = f"serving.{kernel_name}"
-        kernel = _KERNELS[kernel_name]
         n = sum(r.n_rows for r in group)
         full = self._max_batch_rows
         if n > full:  # one oversized request: pad to its own pow2 bucket
             full = 1 << max(0, int(n - 1).bit_length())
         bucket = bucket_rows(max(n, 1), full, min_rows=self._min_bucket)
-        padded = np.zeros((bucket, head.rows.shape[1]), head.rows.dtype)
-        off = 0
-        for r in group:
-            padded[off:off + r.n_rows] = r.rows
-            off += r.n_rows
+        padded, extra, amax_x = self._assemble(group, bucket, model)
 
         observing = _obs.enabled()
         if observing:
-            _obs.watchdog.track(site, kernel)
+            kernel_fn = _KERNELS[kernel_name]
+            _obs.watchdog.track(site, kernel_fn)
             _obs.watchdog.allow(
                 site, (bucket, str(padded.dtype),
-                       head.model.param_signature(head.op)))
+                       model.param_signature(head.op)))
             self._sites_seen.add(site)
+
+        compiled = _aot.lookup(model, head.op, bucket, padded.dtype)
 
         with self._cond:
             seq = self._batch_seq
             self._batch_seq += 1
+            if compiled is not None:
+                self._aot_hits += 1
+            else:
+                self._aot_misses += 1
 
         degraded = False
         dev = None
@@ -594,49 +696,69 @@ class MicroBatchDispatcher:
         if degraded:
             _obs.counter_add("serving.degraded_batches", 1)
             # host route: plain uncommitted placement on the post-trip
-            # default backend; same kernel, so on the CPU mesh degraded
-            # responses stay bit-identical to supervised ones
+            # default backend; same kernel AND the same pre-quantized
+            # payload, so on the CPU mesh degraded responses stay
+            # bit-identical to supervised ones — quantized routes
+            # included
             dev = jnp.asarray(padded)
 
         try:
             # async dispatch: the returned array is a handle; the fetch
             # (and therefore the block) happens in _resolve, so the
             # worker can assemble the NEXT batch under this compute
-            out_dev = kernel(dev, *params)
+            if compiled is not None:
+                out_dev = compiled(dev, *extra, *params)
+            else:
+                out_dev = _KERNELS[kernel_name](dev, *extra, *params)
         except Exception as exc:
             for r in group:
                 if not r.future.done():
                     r.future.set_exception(exc)
-            self.slo.note_batch(n, bucket, degraded)
+            self.slo.note_batch(n, bucket, degraded,
+                                nbytes=padded.nbytes)
             if observing:
                 _obs.watchdog.observe(site)
             raise
-        return (group, out_dev, n, bucket, degraded, site, observing)
+        return (group, out_dev, n, bucket, degraded, site, observing,
+                padded.nbytes, amax_x, seq)
 
     def _resolve(self, state):
         """Stage 2: fetch the batch's device result and scatter it back
-        per request (cache store, future resolution, SLO accounting)."""
-        group, out_dev, n, bucket, degraded, site, observing = state
+        per request (cache store, future resolution, SLO accounting,
+        and — for a quantized batch under observability — the strided
+        live guarantee draw against the declared fold)."""
+        (group, out_dev, n, bucket, degraded, site, observing,
+         nbytes, amax_x, seq) = state
         try:
             out = np.asarray(out_dev)
         except Exception as exc:
             for r in group:
                 if not r.future.done():
                     r.future.set_exception(exc)
-            self.slo.note_batch(n, bucket, degraded)
+            self.slo.note_batch(n, bucket, degraded, nbytes=nbytes)
             if observing:
                 _obs.watchdog.observe(site)
             raise
         done = time.perf_counter()
         off = 0
+        head_res = None
         for r in group:
             res = np.array(out[off:off + r.n_rows], copy=True)
             off += r.n_rows
+            if head_res is None:
+                head_res = res
             if r.cache_key is not None:
                 _cache.store(r.cache_key, res)
             r.future.set_result(res)
         self.slo.note_batch_done([r.submitted for r in group], done, n,
-                                 bucket, degraded)
+                                 bucket, degraded, nbytes=nbytes)
+        head = group[0]
+        if observing and head.model.quant_folds and amax_x is not None:
+            # one live draw per audited batch: the head request replayed
+            # against the exact f64 reference, realized error vs the
+            # declared fold (strided; see quantize._audit_every)
+            _quant.audit_batch(head.model, head.op, head.rows, head_res,
+                               amax_x, seq)
         # per-batch totals live in the run's `slo` record; emitting
         # counter/watchdog JSONL per batch at serving rates floods the
         # artifact (measured: ~75k lines per load-bench run), so budget

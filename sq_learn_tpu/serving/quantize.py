@@ -1,0 +1,400 @@
+"""Quantized serving: halve the bytes, fold the error into (ε, δ).
+
+The paper's thesis makes error budgets *runtime* parameters you spend
+for speed (SURVEY §0); PR 7 proved the repo can price a new error
+source into the declared contract conservatively (the sketch fold).
+This module does it again for serving-time quantization: the three
+serving kernels (center-argmin predict, center-distance transform,
+(x − μ)·Vᵀ projection) are row-independent elementwise/contraction ops
+whose quantization error is **boundable from the params' dynamic
+range**, so serving in bf16 or int8 is not an accuracy leap of faith —
+it is a declared, audited degrade of the tenant's (ε, δ):
+
+- **Representation error is exact math.** Round-to-nearest into bf16
+  (8 significand bits) perturbs every element by at most ``2⁻⁸·|x|``;
+  symmetric int8 at scale ``s = amax/127`` by at most ``s/2 =
+  amax/254``. Those per-element bounds propagate through each kernel:
+
+  =====================  ==================================================
+  op                     per-entry bound on the quantized output
+  =====================  ==================================================
+  transform (centers)    ``|d̃ − d| ≤ √m·(q_x + q_c)`` — perturbing x and c
+                         moves the distance by at most the perturbations'
+                         L2 norms
+  transform (projection) ``|ỹ − y| ≤ m·amax_V·r·(2 + r)·(amax_x+amax_μ)``
+                         (δ of the (x−μ)·Vᵀ contraction, params + rows)
+  predict                **near-optimality**: the returned label's EXACT
+                         distance is within ``2·√m·(q_x + q_c)`` of the
+                         exact minimum (an argmin can only flip across a
+                         margin smaller than twice the distance bound)
+  =====================  ==================================================
+
+  where ``r`` is the mode's relative step (bf16 ``2⁻⁸``, int8
+  ``1/254``), ``q_x = r·amax_x`` (request rows), ``q_c/q_μ/q_V =
+  r·amax_param``. Param terms are computed ONCE at registry-load time;
+  the row term is linear in the request batch's ``amax_x``, so the
+  declared per-request bound is two coefficients, not a recompute.
+- **Conservative fold.** The served contract degrades additively and
+  declaredly, the PR 7 rule: a tenant whose estimator declares (ε, δ)
+  serves at (ε + ε_q(amax_x), δ + δ_q) where ε_q is the table above and
+  ``δ_q`` (``SQ_SERVE_QUANT_DELTA``, default 1e-3) is the audit budget
+  of the quantization claim itself — the bound is deterministic, so its
+  own failure probability is nominally zero and δ_q is pure headroom
+  for float arithmetic outside the model (the audit's float-noise
+  allowance mirrors ``sketch.audit_sketch``).
+- **Live audit.** With observability on, sampled served batches replay
+  their head request through the exact float64 host reference and
+  record one ``guarantee`` draw per op site (``serving.quant.<kernel>``)
+  — realized error against the declared fold, Clopper–Pearson-flagged
+  against δ_q like every other contract in the repo
+  (``SQ_OBS_AUDIT_STRICT=1`` raises the moment the data is
+  statistically inconsistent with the declared bound).
+- **``quantize=None`` is bit-identical** to the PR 9 route: the f32
+  kernels, param placement, and group keys are untouched by this module
+  unless a mode is set (parity pinned by tests).
+
+Bytes: a bf16 request batch moves half the bytes of f32 across the
+host→device boundary (int8 a quarter), and quantized group keys merge
+f32/f64 request streams into ONE transfer dtype — fewer buckets, fewer
+compiles, better occupancy. ``serving.transfer_bytes`` (and the SLO
+record's ``transfer_bytes``) carries the evidence.
+
+Modes: ``'bf16'`` | ``'int8'`` | ``'auto'`` (→ bf16, the
+accuracy-conservative default) | ``None`` (exact f32 route). Per-tenant
+via ``ModelRegistry.register(..., quantize=...)``; process default via
+``SQ_SERVE_QUANTIZE``.
+"""
+
+import math
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .. import obs as _obs
+from ..obs import xla as _xla
+
+__all__ = ["DEFAULT_QUANT_DELTA", "REL_STEP", "QuantFold", "audit_batch",
+           "quant_delta", "quantize_params", "quantize_rows",
+           "resolve_mode", "serve_quantize"]
+
+#: relative per-element representation error of round-to-nearest into
+#: each mode: bf16 keeps 8 significand bits (|δ| ≤ 2⁻⁸·|x|); symmetric
+#: int8 at scale amax/127 rounds within half a step (|δ| ≤ amax/254)
+REL_STEP = {"bf16": 2.0 ** -8, "int8": 1.0 / 254.0}
+
+#: default audit budget δ_q of the quantization claim (the declared
+#: failure probability of the fold's own guarantee site — the bound is
+#: deterministic, so this is headroom, not an expected failure rate)
+DEFAULT_QUANT_DELTA = 1e-3
+
+
+def serve_quantize():
+    """Process-default serving quantization mode (``SQ_SERVE_QUANTIZE``:
+    ``bf16`` | ``int8`` | ``auto`` | unset/``none``/``0`` = off)."""
+    return resolve_mode(os.environ.get("SQ_SERVE_QUANTIZE") or None)
+
+
+def resolve_mode(quantize):
+    """Normalize a ``quantize`` argument to ``'bf16' | 'int8' | None``.
+    ``'auto'`` resolves to bf16 — the mode whose relative error is
+    data-independent (no scale estimate to get wrong)."""
+    if quantize is None:
+        return None
+    mode = str(quantize).lower()
+    if mode in ("none", "0", "off", ""):
+        return None
+    if mode == "auto":
+        return "bf16"
+    if mode not in REL_STEP:
+        raise ValueError(
+            f"quantize must be one of 'auto', 'bf16', 'int8', or None, "
+            f"got {quantize!r}")
+    return mode
+
+
+def quant_delta():
+    """The fold's declared audit budget δ_q (``SQ_SERVE_QUANT_DELTA``)."""
+    return float(os.environ.get("SQ_SERVE_QUANT_DELTA",
+                                DEFAULT_QUANT_DELTA))
+
+
+def _bf16_dtype():
+    """numpy's view of bfloat16 (ml_dtypes ships with jax — CLAUDE.md:
+    no installs, and none needed)."""
+    import ml_dtypes
+
+    return ml_dtypes.bfloat16
+
+
+def transfer_dtype(mode):
+    """The numpy dtype quantized request batches cross the host→device
+    boundary in."""
+    return np.dtype(_bf16_dtype()) if mode == "bf16" else np.dtype(np.int8)
+
+
+# ---------------------------------------------------------------------------
+# Array quantization (host side: the bytes that cross the boundary)
+# ---------------------------------------------------------------------------
+
+
+def quantize_rows(rows, mode, out=None, scale=None):
+    """Quantize a host row block into ``out`` (or a fresh array).
+
+    bf16 ignores ``scale``; int8 requires the caller-computed symmetric
+    scale (``amax/127`` over the whole batch — one scale per dispatch,
+    so every request in the batch shares one dequant multiply). Returns
+    the quantized array.
+    """
+    if out is None:
+        out = np.empty(rows.shape, transfer_dtype(mode))
+    if mode == "bf16":
+        out[...] = rows.astype(_bf16_dtype())
+    else:
+        out[...] = np.clip(np.rint(rows / scale), -127, 127)
+    return out
+
+
+def int8_scale(amax):
+    """Symmetric int8 scale for a dynamic range of ``amax`` (1.0 for an
+    all-zero block: any scale represents zeros exactly)."""
+    return float(amax) / 127.0 if amax > 0 else 1.0
+
+
+def quantize_params(arrays, mode):
+    """Quantize fitted params once, at registry-load time.
+
+    Returns ``(device_params, amaxes)``: for bf16 one device array per
+    input; for int8 an ``(int8 array, () f32 scale)`` pair per input —
+    flattened in order, matching the quantized kernels' signatures.
+    ``amaxes`` feeds the fold-coefficient computation.
+    """
+    device_params, amaxes = [], []
+    for a in arrays:
+        a = np.asarray(a, np.float64)
+        amax = float(np.max(np.abs(a))) if a.size else 0.0
+        amaxes.append(amax)
+        if mode == "bf16":
+            device_params.append(jnp.asarray(a.astype(_bf16_dtype())))
+        else:
+            s = int8_scale(amax)
+            q = np.clip(np.rint(a / s), -127, 127).astype(np.int8)
+            device_params.append(jnp.asarray(q))
+            device_params.append(jnp.asarray(np.float32(s)))
+    return tuple(device_params), amaxes
+
+
+# ---------------------------------------------------------------------------
+# Quantized serving kernels (dequantize on device, compute in f32 — the
+# transfer is quantized, the arithmetic is not, so the error is the
+# representation error the fold declares and nothing else)
+# ---------------------------------------------------------------------------
+
+
+def _deq(x, scale=None):
+    t = x.astype(jnp.float32)
+    return t if scale is None else t * scale
+
+
+def _centers_d2(tile, centers):
+    xsq = jnp.sum(tile * tile, axis=1)
+    csq = jnp.sum(centers * centers, axis=1)
+    return xsq[:, None] + csq[None, :] - 2.0 * (tile @ centers.T)
+
+
+@jax.jit
+def _predict_centers_bf16(tile, centers):
+    """bf16-transferred closest-center labels (dequant → f32 math)."""
+    return jnp.argmin(_centers_d2(_deq(tile), _deq(centers)),
+                      axis=1).astype(jnp.int32)
+
+
+@jax.jit
+def _transform_centers_bf16(tile, centers):
+    d2 = _centers_d2(_deq(tile), _deq(centers))
+    return jnp.sqrt(jnp.maximum(d2, 0.0))
+
+
+@jax.jit
+def _transform_components_bf16(tile, mean, components):
+    return (_deq(tile) - _deq(mean)) @ _deq(components).T
+
+
+@jax.jit
+def _predict_centers_i8(tile, xscale, centers, cscale):
+    """int8-transferred closest-center labels (symmetric per-batch row
+    scale, per-param scale; dequant → f32 math)."""
+    return jnp.argmin(_centers_d2(_deq(tile, xscale), _deq(centers, cscale)),
+                      axis=1).astype(jnp.int32)
+
+
+@jax.jit
+def _transform_centers_i8(tile, xscale, centers, cscale):
+    d2 = _centers_d2(_deq(tile, xscale), _deq(centers, cscale))
+    return jnp.sqrt(jnp.maximum(d2, 0.0))
+
+
+@jax.jit
+def _transform_components_i8(tile, xscale, mean, mscale, components, cscale):
+    return ((_deq(tile, xscale) - _deq(mean, mscale))
+            @ _deq(components, cscale).T)
+
+
+#: kernel name → instrumented jit, merged into the dispatcher's registry
+#: (same watchdog/xla-cost conventions as the f32 kernels)
+KERNELS = {
+    "predict_centers_bf16": _predict_centers_bf16,
+    "transform_centers_bf16": _transform_centers_bf16,
+    "transform_components_bf16": _transform_components_bf16,
+    "predict_centers_i8": _predict_centers_i8,
+    "transform_centers_i8": _transform_centers_i8,
+    "transform_components_i8": _transform_components_i8,
+}
+KERNELS = {name: _xla.instrument(f"serving.{name}", fn)
+           for name, fn in KERNELS.items()}
+
+#: (base op kernel, mode) → quantized kernel name
+QUANT_KERNELS = {
+    ("predict_centers", "bf16"): "predict_centers_bf16",
+    ("transform_centers", "bf16"): "transform_centers_bf16",
+    ("transform_components", "bf16"): "transform_components_bf16",
+    ("predict_centers", "int8"): "predict_centers_i8",
+    ("transform_centers", "int8"): "transform_centers_i8",
+    ("transform_components", "int8"): "transform_components_i8",
+}
+
+
+# ---------------------------------------------------------------------------
+# The fold: declared per-request error bounds, computed at load time
+# ---------------------------------------------------------------------------
+
+
+class QuantFold:
+    """One op's declared quantization bound, as coefficients.
+
+    ``tol(amax_x) = coef_const + coef_amax · amax_x`` upper-bounds the
+    realized per-entry error of the quantized op for any request batch
+    whose dynamic range is ``amax_x`` (for predict, it bounds the exact
+    decision margin across which the argmin label can flip — the served
+    label's exact distance is within ``tol`` of the exact minimum).
+    ``delta`` is the claim's declared audit failure budget δ_q.
+    """
+
+    __slots__ = ("op", "mode", "coef_const", "coef_amax", "delta", "kind")
+
+    def __init__(self, op, mode, coef_const, coef_amax, delta, kind):
+        self.op = op
+        self.mode = mode
+        self.coef_const = float(coef_const)
+        self.coef_amax = float(coef_amax)
+        self.delta = float(delta)
+        self.kind = kind  # 'abs' (transforms) | 'margin' (predict)
+
+    def tol(self, amax_x):
+        """The declared bound for a request batch of dynamic range
+        ``amax_x``, plus the float-noise allowance (the quantized kernel
+        computes in f32 after dequant; the audit reference is f64 — the
+        allowance mirrors ``sketch.audit_sketch``'s)."""
+        bound = self.coef_const + self.coef_amax * float(amax_x)
+        return bound + 1e-4 * max(1.0, bound)
+
+    def as_dict(self):
+        return {"op": self.op, "mode": self.mode,
+                "coef_const": round(self.coef_const, 9),
+                "coef_amax": round(self.coef_amax, 9),
+                "delta": self.delta, "kind": self.kind}
+
+
+def fold_for(op, kernel_name, mode, m, param_amaxes, estimator_delta=None):
+    """Build the op's :class:`QuantFold` from the params' dynamic range.
+
+    ``param_amaxes`` follows the op's host-param order: ``[centers]`` for
+    the center ops, ``[mean, components]`` for the projection. The
+    declared contract degrade is ``(ε + tol(amax_x), δ + δ_q)`` against
+    the estimator's own declared δ (``estimator_delta``, recorded for
+    the fold gauge; None = the estimator is exact).
+    """
+    r = REL_STEP[mode]
+    dq = quant_delta()
+    if kernel_name in ("predict_centers", "transform_centers"):
+        amax_c = param_amaxes[0]
+        # |d̃ − d| ≤ ‖δx‖₂ + ‖δc‖₂ ≤ √m·(r·amax_x + r·amax_c)
+        coef_amax = math.sqrt(m) * r
+        coef_const = math.sqrt(m) * r * amax_c
+        if kernel_name == "predict_centers":
+            # argmin flips only across a margin ≤ 2× the distance bound
+            coef_amax, coef_const = 2 * coef_amax, 2 * coef_const
+            return QuantFold(op, mode, coef_const, coef_amax, dq, "margin")
+        return QuantFold(op, mode, coef_const, coef_amax, dq, "abs")
+    # projection: |ỹ − y| ≤ m·amax_V·r·(2 + r)·(amax_x + amax_μ)
+    amax_mu, amax_v = param_amaxes
+    k = m * amax_v * r * (2.0 + r)
+    return QuantFold(op, mode, k * amax_mu, k, dq, "abs")
+
+
+# ---------------------------------------------------------------------------
+# Live audit (guarantee draws against exact f64 host references)
+# ---------------------------------------------------------------------------
+
+
+def _audit_every():
+    """Audit stride in batches (``SQ_SERVE_AUDIT_EVERY``, default 8):
+    every Nth dispatched quantized batch replays its head request
+    through the f64 reference — a statistical check, not a census (the
+    guarantee-record flood rules of ``serving.cache`` apply here too)."""
+    return max(1, int(os.environ.get("SQ_SERVE_AUDIT_EVERY", 8)))
+
+
+def reference(op_kind, rows, host_params):
+    """Exact float64 host reference of one serving op (the ground truth
+    the audit and the fold-validity tests compare against)."""
+    x = np.asarray(rows, np.float64)
+    if op_kind in ("predict_centers", "transform_centers"):
+        # predict audits against the same exact distance matrix: its
+        # claim (label near-optimality) is a statement about distances
+        c = np.asarray(host_params[0], np.float64)
+        d2 = (np.sum(x * x, axis=1)[:, None] + np.sum(c * c, axis=1)[None, :]
+              - 2.0 * (x @ c.T))
+        return np.sqrt(np.maximum(d2, 0.0))
+    mean = np.asarray(host_params[0], np.float64)
+    comps = np.asarray(host_params[1], np.float64)
+    return (x - mean) @ comps.T
+
+
+def realized_errors(kind, base_kernel, rows, out, host_params):
+    """Per-request realized error of a served quantized response against
+    the exact reference: max-abs per row block for the transforms, the
+    exact decision margin of the returned label for predict."""
+    ref = reference(base_kernel, rows, host_params)
+    if kind == "margin":
+        labels = np.asarray(out).astype(int)
+        picked = ref[np.arange(ref.shape[0]), labels]
+        return float(np.max(picked - np.min(ref, axis=1)))
+    return float(np.max(np.abs(np.asarray(out, np.float64) - ref)))
+
+
+def audit_batch(model, op, head_rows, head_out, amax_x, seq):
+    """One live guarantee draw for a dispatched quantized batch (head
+    request only, strided by :func:`_audit_every`): realized error vs
+    the declared fold at the op's ``serving.quant.<kernel>`` site. Obs
+    off or an off-stride batch = no work; the audit must never break a
+    dispatch that already succeeded (exception-safe like the sketch's).
+    """
+    if not _obs.guarantees.enabled() or seq % _audit_every():
+        return
+    fold = model.quant_folds.get(op)
+    if fold is None:
+        return
+    try:
+        base, _mode = model.base_kernel(op), model.quantize
+        realized = realized_errors(fold.kind, base, head_rows, head_out,
+                                   model.host_params)
+        _obs.guarantees.observe(
+            f"serving.quant.{base}", [realized], fold.tol(amax_x),
+            fail_prob=fold.delta, estimator=type(model.estimator).__name__,
+            mode=fold.mode, amax_x=round(float(amax_x), 6))
+    except _obs.guarantees.GuaranteeViolationError:
+        raise  # strict mode must propagate — that IS the contract check
+    except Exception:
+        pass

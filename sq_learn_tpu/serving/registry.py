@@ -22,15 +22,26 @@ routing table between tenant ids and servable device state:
 sniffs the fitted surface (``cluster_centers_`` → predict/transform
 against centers; ``components_`` (+ optional ``mean_``) → projection
 transform) into per-op kernel bindings — the params are placed once at
-residency time in the canonical compute dtype, so a dispatch is one
+residency time (canonical compute dtype, or the registration's
+``quantize`` mode: bf16/int8 params + the conservative (ε, δ) fold of
+:mod:`~sq_learn_tpu.serving.quantize`), so a dispatch is one
 padded-batch kernel call with no per-request placement. Its
 ``fingerprint`` (the checkpoint's ``state_digest``, or a content CRC for
-in-memory models) keys the serving result cache, so a re-registered
-tenant can never be served its predecessor's cached responses.
+in-memory models, suffixed with the quantize mode) keys the serving
+result cache, so a re-registered tenant — or the same tenant under a
+different quantization — can never be served a stale cached response.
+
+Everything shape-invariant is precomputed here, once, instead of per
+request or per batch: the per-op param signatures the watchdog keys on,
+the ``(fingerprint, op, dtype)`` group keys the dispatcher buckets by
+(one dict lookup per submit — rebuilding the tuple per request was
+measurable at load-bench rates), and the abstract AOT signatures
+:mod:`~sq_learn_tpu.serving.aot` compiles from.
 
 Registry traffic is observable: ``serving.registry_loads`` /
-``serving.registry_evictions`` counters, and a
-``serving.registry.resolve`` span around each cold load.
+``serving.registry_evictions`` counters, a ``serving.registry.resolve``
+span around each cold load, and a ``serving.quant_fold`` gauge per
+quantized residency (the declared contract degrade, in coefficients).
 """
 
 import collections
@@ -45,6 +56,7 @@ import jax.numpy as jnp
 
 from .. import obs as _obs
 from ..utils.checkpoint import load_estimator
+from . import quantize as _quant
 
 __all__ = ["ModelRegistry", "ServingModel"]
 
@@ -62,48 +74,78 @@ class ServingModel:
     ``ops`` maps op name → ``(kernel name, device params)`` where the
     kernel name resolves against the dispatcher's instrumented kernel
     registry (:data:`sq_learn_tpu.serving.dispatcher._KERNELS`) and the
-    params are canonical-dtype device arrays placed once, here. Raises
-    :class:`TypeError` for estimators with no servable surface rather
-    than guessing.
+    params are device arrays placed once, here — canonical-dtype for the
+    exact route, quantized (bf16, or int8 + () f32 scales) under a
+    ``quantize`` mode. Raises :class:`TypeError` for estimators with no
+    servable surface rather than guessing.
     """
 
     __slots__ = ("estimator", "ops", "n_features", "dtype", "fingerprint",
-                 "cacheable")
+                 "cacheable", "quantize", "host_params", "quant_folds",
+                 "_base_kernels", "_param_sigs", "_group_keys", "_aot_sigs")
 
-    def __init__(self, estimator, fingerprint=None):
+    def __init__(self, estimator, fingerprint=None, quantize=None):
         self.estimator = estimator
+        self.quantize = _quant.resolve_mode(quantize)
         self.ops = {}
-        host_params = []
+        self.quant_folds = {}
+        self._base_kernels = {}
         if hasattr(estimator, "cluster_centers_"):
             centers = np.asarray(estimator.cluster_centers_)
             self.dtype = jax.dtypes.canonicalize_dtype(centers.dtype)
-            centers_d = jnp.asarray(centers.astype(self.dtype))
-            self.ops["predict"] = ("predict_centers", (centers_d,))
-            self.ops["transform"] = ("transform_centers", (centers_d,))
             self.n_features = int(centers.shape[1])
-            host_params = [centers]
+            self.host_params = [centers]
+            self._bind("predict", "predict_centers", [centers])
+            self._bind("transform", "transform_centers", [centers])
         elif hasattr(estimator, "components_"):
             comps = np.asarray(estimator.components_)
             self.dtype = jax.dtypes.canonicalize_dtype(comps.dtype)
             mean = getattr(estimator, "mean_", None)
             mean = (np.zeros(comps.shape[1], comps.dtype) if mean is None
                     else np.asarray(mean))
-            comps_d = jnp.asarray(comps.astype(self.dtype))
-            mean_d = jnp.asarray(mean.astype(self.dtype))
-            self.ops["transform"] = ("transform_components",
-                                     (mean_d, comps_d))
             self.n_features = int(comps.shape[1])
-            host_params = [mean, comps]
+            self.host_params = [mean, comps]
+            self._bind("transform", "transform_components", [mean, comps])
         else:
             raise TypeError(
                 f"{type(estimator).__name__} has no servable fitted "
                 "surface (expected cluster_centers_ or components_)")
         #: deterministic ops eligible for the serving result cache —
-        #: transform is a pure function of the fitted state; predict may
-        #: carry a δ>0 noise model, so it never caches
+        #: transform is a pure function of the fitted state (under a
+        #: fixed quantize mode, which the fingerprint carries); predict
+        #: may carry a δ>0 noise model, so it never caches
         self.cacheable = frozenset({"transform"})
-        self.fingerprint = (str(fingerprint) if fingerprint
-                            else _params_digest(host_params))
+        base = (str(fingerprint) if fingerprint
+                else _params_digest(self.host_params))
+        self.fingerprint = (base if self.quantize is None
+                            else f"{base}:q={self.quantize}")
+        #: shape-invariant per-op precomputes (the per-request/-batch
+        #: hot paths read these as dict lookups, never rebuild them)
+        self._param_sigs = {
+            op: tuple(tuple(int(d) for d in p.shape)
+                      for p in params)
+            for op, (_, params) in self.ops.items()}
+        self._group_keys = {}
+        self._aot_sigs = {}
+
+    def _bind(self, op, base_kernel, host_arrays):
+        """Bind one op: exact-route device params, or the quantized
+        params + the declared fold of the quantize module."""
+        self._base_kernels[op] = base_kernel
+        if self.quantize is None:
+            self.ops[op] = (base_kernel, tuple(
+                jnp.asarray(np.asarray(a).astype(self.dtype))
+                for a in host_arrays))
+            return
+        kernel = _quant.QUANT_KERNELS[(base_kernel, self.quantize)]
+        params, amaxes = _quant.quantize_params(host_arrays, self.quantize)
+        self.ops[op] = (kernel, params)
+        fold = _quant.fold_for(
+            op, base_kernel, self.quantize, self.n_features, amaxes,
+            estimator_delta=getattr(self.estimator, "delta", None))
+        self.quant_folds[op] = fold
+        _obs.gauge("serving.quant_fold", fold.as_dict(),
+                   estimator=type(self.estimator).__name__)
 
     def op(self, name):
         """(kernel name, device params) for ``name``; KeyError lists the
@@ -115,12 +157,60 @@ class ServingModel:
                 f"op {name!r} not served by {type(self.estimator).__name__}"
                 f" (available: {sorted(self.ops)})") from None
 
+    def base_kernel(self, name):
+        """The op's f32 kernel family (``predict_centers``, ...) — the
+        audit-reference selector, invariant under quantization."""
+        return self._base_kernels[name]
+
     def param_signature(self, name):
         """Shape signature of the op's params — the watchdog
         allowed-signature component that keeps two tenants with
-        different model shapes from sharing one compile budget slot."""
-        return tuple(tuple(int(d) for d in p.shape)
-                     for p in self.ops[name][1])
+        different model shapes from sharing one compile budget slot.
+        Precomputed: the dispatcher reads this per batch."""
+        return self._param_sigs[name]
+
+    def transfer_dtype(self, request_dtype):
+        """The dtype a request batch crosses the host→device boundary
+        in: the model's quantized dtype (merging f32/f64 streams into
+        one bucket ladder), or the request's own canonical dtype."""
+        if self.quantize is None:
+            return np.dtype(request_dtype)
+        return _quant.transfer_dtype(self.quantize)
+
+    def group_key(self, op, request_dtype):
+        """The dispatcher's batch group key for (op, request dtype) —
+        memoized: one dict lookup per submit instead of a per-request
+        tuple rebuild (the model-shape portion is invariant per model,
+        carried by the content fingerprint). Two tenants sharing a
+        fingerprint serve byte-identical params, so co-batching them is
+        sound by construction."""
+        got = self._group_keys.get((op, request_dtype))
+        if got is None:
+            got = (self.fingerprint, op,
+                   str(self.transfer_dtype(request_dtype)))
+            self._group_keys[(op, request_dtype)] = got
+        return got
+
+    def aot_signature(self, op, bucket, dtype):
+        """(kernel name, ShapeDtypeStruct call signature) of this op at
+        a padded ``bucket`` with transfer dtype ``dtype`` — what
+        :func:`sq_learn_tpu.serving.aot.warm_model` lowers from and
+        :func:`~sq_learn_tpu.serving.aot.lookup` resolves dispatches
+        with. Memoized per (op, bucket, dtype)."""
+        memo_key = (op, int(bucket), str(dtype))
+        got = self._aot_sigs.get(memo_key)
+        if got is None:
+            kernel_name, params = self.ops[op]
+            sds = [jax.ShapeDtypeStruct((int(bucket), self.n_features),
+                                        dtype)]
+            if self.quantize == "int8":
+                # the per-batch row scale rides as a () f32 operand
+                sds.append(jax.ShapeDtypeStruct((), jnp.float32))
+            sds.extend(jax.ShapeDtypeStruct(p.shape, p.dtype)
+                       for p in params)
+            got = (kernel_name, tuple(sds))
+            self._aot_sigs[memo_key] = got
+        return got
 
 
 def _is_path(source):
@@ -138,23 +228,34 @@ class ModelRegistry:
                              f"got {self._capacity}")
         self._lock = threading.RLock()
         self._sources = {}
+        self._quantize = {}
         self._resident = collections.OrderedDict()
 
-    def register(self, tenant, source):
+    def register(self, tenant, source, quantize="env"):
         """Bind ``tenant`` to a checkpoint directory or fitted estimator.
-        Replaces any previous binding and evicts the resident copy."""
+        Replaces any previous binding and evicts the resident copy.
+
+        ``quantize`` selects the tenant's serving route: ``None`` (the
+        exact f32 kernels, bit-identical to PR 9), ``'bf16'``/``'int8'``/
+        ``'auto'`` (the quantized route with its declared fold), or the
+        default ``"env"`` — defer to ``SQ_SERVE_QUANTIZE`` at resolve
+        time (unset = exact)."""
         tenant = str(tenant)
+        if quantize != "env":
+            _quant.resolve_mode(quantize)  # validate eagerly, at bind time
         if not _is_path(source) and not hasattr(source, "get_params"):
             raise TypeError("source must be a checkpoint path or a fitted "
                             f"estimator, got {type(source).__name__}")
         with self._lock:
             self._sources[tenant] = source
+            self._quantize[tenant] = quantize
             self._resident.pop(tenant, None)
         return self
 
     def unregister(self, tenant):
         with self._lock:
             self._sources.pop(str(tenant), None)
+            self._quantize.pop(str(tenant), None)
             self._resident.pop(str(tenant), None)
 
     def tenants(self):
@@ -185,6 +286,9 @@ class ModelRegistry:
             except KeyError:
                 raise KeyError(f"tenant {tenant!r} is not registered "
                                f"(known: {sorted(self._sources)})") from None
+            quantize = self._quantize.get(tenant, "env")
+        if quantize == "env":
+            quantize = _quant.serve_quantize()
         # load OUTSIDE the lock: a cold checkpoint read must not stall
         # every concurrent resolve of already-resident tenants
         with _obs.span("serving.registry.resolve", tenant=tenant,
@@ -195,7 +299,7 @@ class ModelRegistry:
             else:
                 fingerprint = None
                 est = source
-            model = ServingModel(est, fingerprint)
+            model = ServingModel(est, fingerprint, quantize=quantize)
         _obs.counter_add("serving.registry_loads", 1)
         with self._lock:
             # another thread may have raced the same cold load; last
@@ -208,11 +312,15 @@ class ModelRegistry:
                 _obs.gauge("serving.registry_evicted", evicted)
         return model
 
-    def warm(self, tenants=None, threads=None):
+    def warm(self, tenants=None, threads=None, aot=None, buckets=None):
         """Prefetch cold checkpoint loads on a bounded thread pool — the
         serving-side twin of the shard readahead: a tenant's first
         request after registration should hit a resident model, not pay
-        the digest-verified disk load inline.
+        the digest-verified disk load inline — and (by default)
+        AOT-compile each warmed model's full serving ladder
+        (:func:`sq_learn_tpu.serving.aot.warm_model`: kernel set × pow2
+        buckets × transfer dtypes) on the same pool, so the first
+        request also never pays an XLA lowering.
 
         ``tenants`` defaults to every registered tenant; only the LAST
         ``capacity`` of the requested list actually warm (warming more
@@ -220,10 +328,18 @@ class ModelRegistry:
         Loads run concurrently (``threads`` defaults to min(4, n)) via
         the same :meth:`resolve` the dispatcher uses, so the digest
         verification and LRU accounting are identical to a cold hit.
-        Returns ``{tenant: "resident" | "loaded" | "skipped_capacity" |
-        "error: ..."}`` — a failed load never aborts the rest of the
-        warm-up (that tenant fails again, loudly, at request time).
+        ``aot=False`` skips the compile pass (``SQ_SERVE_AOT=0`` flips
+        the default); ``buckets`` overrides the env-derived ladder (the
+        dispatcher's :meth:`~sq_learn_tpu.serving.dispatcher.
+        MicroBatchDispatcher.warm` passes its own). Returns ``{tenant:
+        "resident" | "loaded" | "skipped_capacity" | "error: ..."}`` —
+        a failed load never aborts the rest of the warm-up (that tenant
+        fails again, loudly, at request time).
         """
+        from . import aot as _aot
+
+        if aot is None:
+            aot = os.environ.get("SQ_SERVE_AOT", "1") != "0"
         with self._lock:
             known = list(self._sources)
             resident = set(self._resident)
@@ -233,16 +349,19 @@ class ModelRegistry:
         nthreads = max(1, min(4, len(sel)) if threads is None
                        else int(threads))
         with _obs.span("serving.registry.warm", tenants=len(sel),
-                       threads=nthreads):
+                       threads=nthreads, aot=bool(aot)):
             def load(tenant):
-                if tenant in resident:
-                    return tenant, "resident"
+                status = "resident" if tenant in resident else None
                 try:
-                    self.resolve(tenant)
+                    model = self.resolve(tenant)
                 except Exception as exc:
                     return tenant, f"error: {exc}"
-                _obs.counter_add("serving.registry_warm_loads", 1)
-                return tenant, "loaded"
+                if status is None:
+                    _obs.counter_add("serving.registry_warm_loads", 1)
+                    status = "loaded"
+                if aot:
+                    _aot.warm_model(model, buckets=buckets)
+                return tenant, status
 
             if nthreads <= 1 or len(sel) <= 1:
                 results = [load(t) for t in sel]

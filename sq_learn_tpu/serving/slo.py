@@ -77,6 +77,7 @@ class SloTracker:
         self._batches = 0
         self._occupancy_sum = 0.0
         self._degraded = 0
+        self._transfer_bytes = 0
         self._first_submit = None
         self._last_done = None
 
@@ -96,20 +97,23 @@ class SloTracker:
             if self._last_done is None or ts > self._last_done:
                 self._last_done = ts
 
-    def note_batch(self, valid_rows, bucket_rows, degraded):
+    def note_batch(self, valid_rows, bucket_rows, degraded, nbytes=0):
         with self._lock:
             self._batches += 1
             self._occupancy_sum += (valid_rows / bucket_rows
                                     if bucket_rows else 0.0)
+            self._transfer_bytes += int(nbytes)
             if degraded:
                 self._degraded += 1
 
     def note_batch_done(self, submit_timestamps, done_ts, valid_rows,
-                        bucket_rows, degraded):
+                        bucket_rows, degraded, nbytes=0):
         """One dispatched batch's whole scoreboard update under a single
         lock — the scatter path runs per batch, not per request (the
         per-request lock traffic was a measurable slice of the
-        micro-batching amortization floor)."""
+        micro-batching amortization floor). ``nbytes`` is the padded
+        payload the batch moved host→device — the quantized route's
+        bytes-halved claim is read off this tally."""
         with self._lock:
             for ts in submit_timestamps:
                 self._latencies_s.append(done_ts - ts)
@@ -118,8 +122,16 @@ class SloTracker:
             self._batches += 1
             self._occupancy_sum += (valid_rows / bucket_rows
                                     if bucket_rows else 0.0)
+            self._transfer_bytes += int(nbytes)
             if degraded:
                 self._degraded += 1
+
+    def transfer_bytes(self):
+        """Total padded payload bytes moved so far (the dispatcher
+        flushes this into the ``serving.transfer_bytes`` counter at
+        close)."""
+        with self._lock:
+            return self._transfer_bytes
 
     # -- outputs -----------------------------------------------------------
 
@@ -130,6 +142,7 @@ class SloTracker:
             batches = self._batches
             occ_sum = self._occupancy_sum
             degraded = self._degraded
+            transfer_bytes = self._transfer_bytes
             window = ((self._last_done - self._first_submit)
                       if lat and self._last_done is not None
                       and self._first_submit is not None else 0.0)
@@ -155,6 +168,7 @@ class SloTracker:
             "qps": round(qps, 3),
             "batch_occupancy": round(min(1.0, occupancy), 4),
             "degraded": degraded,
+            "transfer_bytes": transfer_bytes,
             "window_s": round(window, 6),
             "violated": violated,
             **({"targets": targets} if targets else {}),

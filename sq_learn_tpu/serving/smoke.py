@@ -1,20 +1,34 @@
-"""Serving smoke: the ISSUE 9 contract end to end, in seconds.
+"""Serving smoke: the ISSUE 9 + ISSUE 11 contracts end to end, in
+seconds.
 
 ``make serve-smoke`` runs this module on the CPU backend:
 
 1. fit two tiny tenants (a q-means predict/transform surface and an SVD
    projection surface), **checkpoint them to disk**, and register the
    checkpoint directories — so every resolve exercises the
-   digest-verified v2 load path;
-2. a deterministic micro-batched load (mixed tenants, ops, request
+   digest-verified v2 load path — plus a bf16 and an int8 **quantized**
+   registration of the same checkpoints;
+2. **AOT-warm the whole ladder first** (``registry.warm``: digest-
+   verified loads + every (kernel, bucket, dtype) executable, with the
+   persistent compile cache armed at a fresh ``SQ_COMPILE_CACHE_DIR``),
+   then pin every serving kernel site to a flat watchdog budget of
+   **0** and arm ``SQ_OBS_STRICT=1`` — from here on, a single
+   serving-path jit compile raises;
+3. a deterministic micro-batched load (mixed tenants, ops, request
    sizes, and input dtypes) through the dispatcher; every response must
    row-match the estimator's own predict/transform surface;
-3. a repeated identical transform request — the digest-keyed result
+4. a repeated identical transform request — the digest-keyed result
    cache must hit;
-4. a fault leg: one transient injected transfer failure absorbed by the
+5. a fault leg: one transient injected transfer failure absorbed by the
    supervised placement, responses bit-equal to the clean run's;
-5. SLO emission + schema validation: the run's JSONL must validate and
-   carry ≥1 ``slo`` record (the v4 type this PR mints).
+6. a quantized leg under ``SQ_OBS_AUDIT_STRICT=1``: bf16/int8 responses
+   within the declared fold of the exact f64 reference on EVERY
+   request (not just the audited draws), zero jit compiles still;
+7. a **second process** re-warms a subset of the ladder against the
+   same persistent cache directory and must report ≥1 persistent-cache
+   hit — the restart-starts-warm claim;
+8. SLO emission + schema validation: the run's JSONL must validate and
+   carry ≥1 ``slo``, ≥1 ``fault``, and ≥1 ``guarantee`` record.
 
 Exit code 0 = contract holds; 1 = violation (printed as JSON). Pins the
 CPU backend in-process first, like every contract smoke.
@@ -22,7 +36,27 @@ CPU backend in-process first, like every contract smoke.
 
 import json
 import os
+import subprocess
+import sys
 import tempfile
+
+
+def persistent_probe(ckpt_dir):
+    """Second-process leg: warm a ladder subset against the parent's
+    ``SQ_COMPILE_CACHE_DIR`` and report the persistent-cache traffic as
+    one JSON line (the parent asserts ``hits >= 1``)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from . import ModelRegistry, aot
+
+    reg = ModelRegistry()
+    reg.register("probe", ckpt_dir)
+    reg.warm(buckets=aot.bucket_ladder(8, 64))
+    stats = aot.persistent_cache_stats()
+    print(json.dumps({"persistent_probe": stats,
+                      "aot_executables": aot.cache_size()}))
+    return 0
 
 
 def main():
@@ -32,13 +66,15 @@ def main():
     import numpy as np
 
     from ..models import QKMeans, TruncatedSVD
-    from ..obs import disable, enable, get_recorder
+    from ..obs import disable, enable, get_recorder, watchdog
     from ..obs.schema import validate_jsonl
     from ..resilience import faults
     from ..resilience.supervisor import breaker
     from ..utils.checkpoint import save_estimator
-    from . import MicroBatchDispatcher, ModelRegistry
+    from . import (MicroBatchDispatcher, ModelRegistry, aot,
+                   kernel_cache_sizes, pin_compile_budgets)
     from . import cache as serve_cache
+    from . import quantize as quant
 
     path = os.environ.get("SQ_OBS_PATH", "/tmp/sq_serve_smoke.jsonl")
     open(path, "w").close()
@@ -58,9 +94,25 @@ def main():
     svd = TruncatedSVD(n_components=4, random_state=0).fit(X)
 
     tmp = tempfile.mkdtemp(prefix="sq_serve_smoke_")
+    alpha_dir = save_estimator(qkm, os.path.join(tmp, "alpha"))
+    beta_dir = save_estimator(svd, os.path.join(tmp, "beta"))
     reg = ModelRegistry()
-    reg.register("alpha", save_estimator(qkm, os.path.join(tmp, "alpha")))
-    reg.register("beta", save_estimator(svd, os.path.join(tmp, "beta")))
+    reg.register("alpha", alpha_dir)
+    reg.register("beta", beta_dir)
+    reg.register("alpha_q", alpha_dir, quantize="bf16")
+    reg.register("beta_q", beta_dir, quantize="int8")
+
+    # -- AOT warm FIRST (fresh persistent cache dir), then the zero-
+    # compile contract is armed for everything that follows
+    cache_dir = os.environ.setdefault(
+        "SQ_COMPILE_CACHE_DIR", os.path.join(tmp, "compile_cache"))
+    warm = reg.warm(buckets=aot.bucket_ladder(8, 512))
+    check(all(v == "loaded" for v in warm.values()),
+          f"warm did not load every tenant: {warm}")
+    check(aot.cache_size() > 0, "AOT warm minted no executables")
+    pin_compile_budgets(0)
+    os.environ["SQ_OBS_STRICT"] = "1"
+    os.environ["SQ_SERVE_AUDIT_EVERY"] = "1"
 
     sizes = [1, 3, 8, 21, 64]
     requests = []
@@ -78,13 +130,16 @@ def main():
         d.flush()
         outs = [f.result(timeout=30) for f in futs]
         slo = d.close()
-        return outs, slo
+        return outs, slo, d
 
-    clean, slo = run_load()
+    clean, slo, d0 = run_load()
     check(len(clean) == len(requests), "a request was lost")
     check(slo["requests"] == len(requests),
           f"slo counted {slo['requests']} of {len(requests)} requests")
     check(slo["p99_ms"] >= slo["p50_ms"] >= 0.0, "percentiles disordered")
+    check(slo["transfer_bytes"] > 0, "slo recorded no transfer bytes")
+    check(d0.aot_stats()["misses"] == 0,
+          f"warmed load missed the AOT cache: {d0.aot_stats()}")
 
     # parity against the estimators' own surfaces
     for (tenant, op, rows), out in zip(requests, clean):
@@ -120,13 +175,63 @@ def main():
     os.environ["SQ_RETRY_BACKOFF_S"] = "0.001"
     faults.arm("put_fail:tiles=0,times=1")
     try:
-        faulted, _ = run_load()
+        faulted, _, _ = run_load()
     finally:
         faults.disarm()
         del os.environ["SQ_RETRY_BACKOFF_S"]
         breaker.reset("serve smoke teardown")
     check(all(np.array_equal(a, b) for a, b in zip(clean, faulted)),
           "faulted responses are not bit-equal to the clean run")
+
+    # quantized leg under strict audit: every response (not just the
+    # audited draws) within the declared fold of the f64 reference
+    os.environ["SQ_OBS_AUDIT_STRICT"] = "1"
+    dq = MicroBatchDispatcher(reg, background=False, max_batch_rows=128)
+    for tenant, host_est in (("alpha_q", qkm), ("beta_q", svd)):
+        model = reg.resolve(tenant)
+        for op in sorted(model.ops):
+            for rows in (requests[0][2], requests[3][2]):
+                out = dq.serve(tenant, op, rows)
+                fold = model.quant_folds[op]
+                amax = float(np.max(np.abs(rows)))
+                realized = quant.realized_errors(
+                    fold.kind, model.base_kernel(op), rows, out,
+                    model.host_params)
+                check(realized <= fold.tol(amax),
+                      f"{tenant}/{op}: realized quantization error "
+                      f"{realized} exceeds declared fold {fold.tol(amax)}")
+    dq.close()
+    del os.environ["SQ_OBS_AUDIT_STRICT"]
+
+    # the zero-compile contract held through every leg: the jit caches
+    # never grew and no pinned site went over its flat 0 budget
+    compiles = kernel_cache_sizes()
+    check(all(v == 0 for v in compiles.values()),
+          f"serving path minted jit compiles post-warm: {compiles}")
+    report = watchdog.report()
+    over = [s for s, st in report.items() if st["over_budget"]]
+    check(not over, f"watchdog sites over the post-warm 0 budget: {over}")
+
+    # restart-starts-warm: a second process against the same persistent
+    # cache dir must RELOAD executables, not re-lower them
+    probe = subprocess.run(
+        [sys.executable, "-m", "sq_learn_tpu.serving.smoke",
+         "--persistent-probe", alpha_dir],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "SQ_COMPILE_CACHE_DIR": cache_dir,
+             "SQ_OBS": "0"})
+    hits = 0
+    for line in probe.stdout.splitlines():
+        try:
+            hits = json.loads(line)["persistent_probe"]["hits"]
+            break
+        except (ValueError, KeyError):
+            continue
+    check(probe.returncode == 0,
+          f"persistent probe failed rc={probe.returncode}: "
+          f"{probe.stderr[-500:]}")
+    check(hits >= 1,
+          f"second process saw no persistent compile-cache hits ({hits})")
 
     disable()
     summary = validate_jsonl(path)
@@ -135,12 +240,18 @@ def main():
           f"expected >=1 slo record, got {summary['by_type']}")
     check(summary["by_type"].get("fault", 0) >= 1,
           f"expected >=1 fault record, got {summary['by_type']}")
+    check(summary["by_type"].get("guarantee", 0) >= 1,
+          f"expected >=1 guarantee record, got {summary['by_type']}")
 
     print(json.dumps({
         "serve_smoke": "fail" if failures else "ok",
         "requests": len(requests),
         "slo": {k: slo[k] for k in ("requests", "p50_ms", "p99_ms", "qps",
-                                    "batch_occupancy", "degraded")},
+                                    "batch_occupancy", "degraded",
+                                    "transfer_bytes")},
+        "aot": {"executables": aot.cache_size(),
+                "persistent_hits_second_process": hits,
+                "jit_compiles": sum(compiles.values())},
         "jsonl": summary["by_type"],
         "errors": failures,
     }))
@@ -148,4 +259,7 @@ def main():
 
 
 if __name__ == "__main__":
+    argv = sys.argv[1:]
+    if argv[:1] == ["--persistent-probe"]:
+        raise SystemExit(persistent_probe(argv[1]))
     raise SystemExit(main())

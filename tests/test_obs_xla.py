@@ -150,8 +150,8 @@ def test_snapshot_carries_peak_hbm_and_measured_mfu(run):
 # -- v2 schema ---------------------------------------------------------------
 
 
-def test_schema_v4_envelope_and_new_types(run, tmp_path):
-    path = str(tmp_path / "v4.jsonl")
+def test_schema_v5_envelope_and_new_types(run, tmp_path):
+    path = str(tmp_path / "v5.jsonl")
     obs.enable(path)
     try:
         with obs.span("s"):
@@ -161,7 +161,7 @@ def test_schema_v4_envelope_and_new_types(run, tmp_path):
     finally:
         obs.disable()
     recs = [json.loads(l) for l in open(path)]
-    assert all(r["v"] == 4 and r["schema_version"] == 4 for r in recs)
+    assert all(r["v"] == 5 and r["schema_version"] == 5 for r in recs)
     summary = validate_jsonl(path)
     assert summary["errors"] == []
     assert summary["by_type"]["xla_cost"] == 1
@@ -177,23 +177,23 @@ def test_schema_validates_regression_records():
 
 
 def test_schema_rejects_unknown_version_and_mismatch():
-    assert validate_record({"v": 5, "schema_version": 5, "ts": 0.0,
+    assert validate_record({"v": 6, "schema_version": 6, "ts": 0.0,
                             "type": "gauge", "name": "g", "value": 1})
     assert validate_record({"v": 2, "schema_version": 1, "ts": 0.0,
                             "type": "gauge", "name": "g", "value": 1})
     # v2+ records must carry the schema_version alias
     assert validate_record({"v": 2, "ts": 0.0, "type": "gauge",
                             "name": "g", "value": 1})
-    assert validate_record({"v": 4, "ts": 0.0, "type": "gauge",
+    assert validate_record({"v": 5, "ts": 0.0, "type": "gauge",
                             "name": "g", "value": 1})
-    # v1 lines (pre-v2 files) still validate without it, and v2/v3 lines
-    # (pre-v4 files) validate with it
+    # v1 lines (pre-v2 files) still validate without it, and v2/v3/v4
+    # lines (pre-v5 files) validate with it
     assert validate_record({"v": 1, "ts": 0.0, "type": "gauge",
                             "name": "g", "value": 1}) == []
-    assert validate_record({"v": 2, "schema_version": 2, "ts": 0.0,
-                            "type": "gauge", "name": "g", "value": 1}) == []
-    assert validate_record({"v": 3, "schema_version": 3, "ts": 0.0,
-                            "type": "gauge", "name": "g", "value": 1}) == []
+    for v in (2, 3, 4):
+        assert validate_record({"v": v, "schema_version": v, "ts": 0.0,
+                                "type": "gauge", "name": "g",
+                                "value": 1}) == []
 
 
 # -- chrome trace export -----------------------------------------------------

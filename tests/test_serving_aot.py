@@ -1,0 +1,166 @@
+"""AOT-warmed serving contract tests (ISSUE 11, tentpole a).
+
+The load-bearing ones: a warmed bucket ladder serves a mixed load with
+ZERO serving-path jit compiles under a flat watchdog budget of 0 with
+``SQ_OBS_STRICT=1`` armed (an excess compile would RAISE, failing the
+test); executables are shared across tenants by abstract signature; and
+an out-of-ladder shape falls back to the lazily-compiling jit wrapper
+without losing the request.
+"""
+
+import numpy as np
+import pytest
+
+from sq_learn_tpu import obs
+from sq_learn_tpu.models import QKMeans, TruncatedSVD
+from sq_learn_tpu.serving import (MicroBatchDispatcher, ModelRegistry,
+                                  aot, kernel_cache_sizes,
+                                  pin_compile_budgets)
+from sq_learn_tpu.serving import cache as serve_cache
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    rng = np.random.default_rng(0)
+    m = 12
+    X = (rng.normal(size=(400, m))
+         + 5.0 * rng.integers(0, 3, size=(400, 1))).astype(np.float32)
+    qkm = QKMeans(n_clusters=3, random_state=0, n_init=1).fit(X)
+    svd = TruncatedSVD(n_components=3, random_state=0).fit(X)
+    return {"X": X, "m": m, "qkm": qkm, "svd": svd}
+
+
+@pytest.fixture(autouse=True)
+def _aot_hygiene():
+    aot.clear()
+    serve_cache.clear()
+    yield
+    aot.clear()
+    serve_cache.clear()
+    if obs.enabled():
+        obs.disable()
+
+
+def test_bucket_ladder_covers_pow2_run_and_cap():
+    assert aot.bucket_ladder(8, 512) == [8, 16, 32, 64, 128, 256, 512]
+    # a non-pow2 cap still terminates the ladder (bucket_rows clamps
+    # every in-cap batch to it)
+    assert aot.bucket_ladder(8, 100) == [8, 16, 32, 64, 100]
+    assert aot.bucket_ladder(16, 16) == [16]
+
+
+def test_warm_then_zero_compiles_under_strict(fitted, monkeypatch):
+    """The tentpole claim: after registry.warm, a mixed-size mixed-dtype
+    load mints not one jit compile — pinned by flat budget 0 + strict
+    mode, and by the jit caches' own entry counts."""
+    monkeypatch.setenv("SQ_OBS_STRICT", "1")
+    reg = ModelRegistry()
+    reg.register("a", fitted["qkm"])
+    reg.register("b", fitted["svd"])
+    obs.enable()
+    stats = reg.warm(buckets=aot.bucket_ladder(8, 64))
+    assert stats == {"a": "loaded", "b": "loaded"}
+    assert aot.cache_size() > 0
+    before = kernel_cache_sizes()
+    pin_compile_budgets(0)
+
+    rng = np.random.default_rng(7)
+    d = MicroBatchDispatcher(reg, background=False, max_batch_rows=64)
+    for i, size in enumerate((1, 2, 5, 9, 17, 33, 40, 64)):
+        rows = rng.normal(size=(size, fitted["m"]))
+        rows = rows.astype(np.float32 if i % 2 else np.float64)
+        out = d.serve("a", "predict", rows)
+        assert np.array_equal(
+            out, fitted["qkm"].predict(rows.astype(np.float32)))
+        d.serve("b", "transform", rows)
+    d.close()
+
+    assert d.aot_stats()["misses"] == 0
+    assert d.aot_stats()["hits"] > 0
+    after = kernel_cache_sizes()
+    assert after == before  # the jit caches never grew
+    report = obs.watchdog.report()
+    for name in ("serving.predict_centers", "serving.transform_centers",
+                 "serving.transform_components"):
+        assert report[name]["budget"] == 0
+        assert report[name]["compiles"] == 0
+    rec = obs.get_recorder()
+    assert rec.counters.get("serving.aot_compiles", 0) == aot.cache_size()
+    assert rec.counters.get("serving.aot_cache_hits", 0) > 0
+    obs.disable()
+
+
+def test_executables_shared_across_equal_shapes(fitted):
+    """Two tenants with identical param shapes share one executable set
+    — the cache keys on the abstract signature, not the tenant."""
+    reg = ModelRegistry()
+    reg.register("a", fitted["qkm"])
+    reg.warm(["a"], buckets=[8, 16])
+    minted = aot.cache_size()
+    # same estimator under a second tenant: everything already warm
+    reg.register("a2", fitted["qkm"])
+    stats = aot.warm_model(reg.resolve("a2"), buckets=[8, 16])
+    assert stats["compiled"] == 0
+    assert stats["cached"] > 0
+    assert aot.cache_size() == minted
+
+
+def test_out_of_ladder_shape_falls_back_to_jit(fitted):
+    """An oversized single request pads past max_batch_rows into a
+    bucket the ladder never warmed: the dispatch must miss the AOT
+    cache, compile lazily, and still answer correctly."""
+    reg = ModelRegistry()
+    reg.register("a", fitted["qkm"])
+    reg.warm(["a"], buckets=aot.bucket_ladder(8, 64))
+    d = MicroBatchDispatcher(reg, background=False, max_batch_rows=64)
+    rows = np.random.default_rng(3).normal(
+        size=(100, fitted["m"])).astype(np.float32)  # pads to 128
+    out = d.serve("a", "predict", rows)
+    d.close()
+    assert np.array_equal(out, fitted["qkm"].predict(rows))
+    assert d.aot_stats()["misses"] >= 1
+
+
+def test_dispatcher_warm_uses_its_own_ladder(fitted):
+    """dispatcher.warm() must warm THIS dispatcher's bucket config, not
+    the env defaults — its smallest and largest buckets both resolve."""
+    reg = ModelRegistry()
+    reg.register("a", fitted["qkm"])
+    d = MicroBatchDispatcher(reg, background=False, max_batch_rows=32,
+                             min_bucket_rows=4)
+    d.warm()
+    model = reg.resolve("a")
+    for bucket in (4, 8, 16, 32):
+        assert aot.lookup(model, "predict", bucket,
+                          np.dtype(np.float32)) is not None
+    d.close()
+
+
+def test_enable_persistent_cache_noop_without_dir(monkeypatch):
+    monkeypatch.delenv("SQ_COMPILE_CACHE_DIR", raising=False)
+    assert aot.enable_persistent_cache() is False
+
+
+def test_warm_returns_cached_on_second_call(fitted):
+    reg = ModelRegistry()
+    reg.register("a", fitted["qkm"])
+    model = reg.resolve("a")
+    first = aot.warm_model(model, buckets=[8])
+    second = aot.warm_model(model, buckets=[8])
+    assert first["compiled"] == second["cached"]
+    assert second["compiled"] == 0
+
+
+def test_warm_captures_xla_cost_at_warm_time(fitted):
+    """The cost accounting rides the warm's own lowering — records
+    exist before any request is served."""
+    reg = ModelRegistry()
+    reg.register("a", fitted["qkm"])
+    rec = obs.enable()
+    reg.warm(["a"], buckets=[8, 16])
+    sites = {r["site"] for r in rec.xla_cost_records}
+    assert "serving.predict_centers" in sites
+    assert all(isinstance(r.get("flops"), float)
+               for r in rec.xla_cost_records
+               if r["site"].startswith("serving."))
+    obs.disable()

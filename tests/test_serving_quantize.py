@@ -1,0 +1,325 @@
+"""Quantized-serving contract tests (ISSUE 11, tentpole b).
+
+The load-bearing ones: the realized per-request error of the bf16/int8
+routes never exceeds the declared fold — across seeds and five decades
+of dynamic range (the slow statistical tier); ``quantize=None`` stays
+bit-identical to the PR 9 kernels; the live guarantee auditor stays
+clean under ``SQ_OBS_AUDIT_STRICT=1``; and a degraded-to-host quantized
+batch is bit-identical to the supervised one (the degrade path reuses
+the same kernel AND the same pre-quantized payload).
+"""
+
+import numpy as np
+import pytest
+
+from sq_learn_tpu import obs
+from sq_learn_tpu.models import QKMeans, TruncatedSVD
+from sq_learn_tpu.resilience import faults
+from sq_learn_tpu.resilience.supervisor import breaker
+from sq_learn_tpu.serving import (MicroBatchDispatcher, ModelRegistry,
+                                  ServingModel)
+from sq_learn_tpu.serving import aot
+from sq_learn_tpu.serving import cache as serve_cache
+from sq_learn_tpu.serving import quantize as quant
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    rng = np.random.default_rng(0)
+    m = 12
+    X = (rng.normal(size=(400, m))
+         + 5.0 * rng.integers(0, 3, size=(400, 1))).astype(np.float32)
+    qkm = QKMeans(n_clusters=3, random_state=0, n_init=1).fit(X)
+    svd = TruncatedSVD(n_components=3, random_state=0).fit(X)
+    return {"X": X, "m": m, "qkm": qkm, "svd": svd}
+
+
+@pytest.fixture(autouse=True)
+def _hygiene():
+    serve_cache.clear()
+    aot.clear()
+    yield
+    serve_cache.clear()
+    aot.clear()
+    faults.disarm()
+    breaker.reset("test teardown")
+    if obs.enabled():
+        obs.disable()
+
+
+def _serve_all(reg, tenant, op, payloads, **dispatcher_kw):
+    d = MicroBatchDispatcher(reg, background=False, **dispatcher_kw)
+    outs = [d.serve(tenant, op, r) for r in payloads]
+    d.close()
+    return outs
+
+
+# -- mode resolution ---------------------------------------------------------
+
+
+def test_mode_resolution_and_validation():
+    assert quant.resolve_mode(None) is None
+    assert quant.resolve_mode("none") is None
+    assert quant.resolve_mode("auto") == "bf16"
+    assert quant.resolve_mode("bf16") == "bf16"
+    assert quant.resolve_mode("int8") == "int8"
+    with pytest.raises(ValueError):
+        quant.resolve_mode("fp8")
+    with pytest.raises(ValueError):
+        ModelRegistry().register("t", object(), quantize="fp8")
+
+
+def test_env_default_applies_at_resolve(fitted, monkeypatch):
+    monkeypatch.setenv("SQ_SERVE_QUANTIZE", "bf16")
+    reg = ModelRegistry()
+    reg.register("t", fitted["qkm"])
+    assert reg.resolve("t").quantize == "bf16"
+    # an explicit None registration overrides the env default
+    reg.register("exact", fitted["qkm"], quantize=None)
+    assert reg.resolve("exact").quantize is None
+
+
+# -- quantize=None bit-parity ------------------------------------------------
+
+
+def test_quantize_none_binds_pr9_kernels_bit_identical(fitted):
+    """The exact route is untouched by the quantize module: same kernel
+    names, and responses bit-equal to the raw kernels' own output."""
+    import jax.numpy as jnp
+
+    from sq_learn_tpu.serving.dispatcher import _KERNELS
+
+    model = ServingModel(fitted["qkm"])
+    assert model.quantize is None
+    assert model.ops["predict"][0] == "predict_centers"
+    assert model.quant_folds == {}
+
+    rng = np.random.default_rng(5)
+    rows = rng.normal(size=(8, fitted["m"])).astype(np.float32)
+    reg = ModelRegistry()
+    reg.register("a", fitted["qkm"])
+    served = _serve_all(reg, "a", "transform", [rows])[0]
+    centers = jnp.asarray(
+        np.asarray(fitted["qkm"].cluster_centers_, np.float32))
+    direct = np.asarray(_KERNELS["transform_centers"](
+        jnp.asarray(rows), centers))
+    assert np.array_equal(served, direct)
+
+
+# -- the fold: bound validity ------------------------------------------------
+
+
+def _check_fold_holds(est, mode, payloads, label):
+    reg = ModelRegistry()
+    reg.register("t", est, quantize=mode)
+    model = reg.resolve("t")
+    d = MicroBatchDispatcher(reg, background=False)
+    for rows in payloads:
+        for op in sorted(model.ops):
+            out = d.serve("t", op, rows)
+            fold = model.quant_folds[op]
+            amax = float(np.max(np.abs(rows)))
+            realized = quant.realized_errors(
+                fold.kind, model.base_kernel(op), rows, out,
+                model.host_params)
+            tol = fold.tol(amax)
+            assert realized <= tol, (
+                f"{label}/{mode}/{op}: realized {realized} > declared "
+                f"fold {tol} (amax_x={amax})")
+    d.close()
+
+
+def test_fold_holds_smoke(fitted):
+    rng = np.random.default_rng(1)
+    payloads = [rng.normal(size=(n, fitted["m"])).astype(np.float32)
+                for n in (1, 7, 33)]
+    for mode in ("bf16", "int8"):
+        _check_fold_holds(fitted["qkm"], mode, payloads, "qkm")
+        _check_fold_holds(fitted["svd"], mode, payloads, "svd")
+
+
+@pytest.mark.slow
+def test_fold_holds_across_seeds_and_dynamic_ranges():
+    """The statistical leg: five decades of data scale × seeds × both
+    modes × both surfaces — the declared fold (two coefficients computed
+    at load time) upper-bounds the realized error on EVERY request."""
+    m = 10
+    for seed in range(4):
+        rng = np.random.default_rng(seed)
+        for scale in (1e-3, 1e-1, 1.0, 1e1, 1e3):
+            X = (scale * (rng.normal(size=(300, m))
+                          + 4.0 * rng.integers(0, 3, size=(300, 1)))
+                 ).astype(np.float32)
+            qkm = QKMeans(n_clusters=3, random_state=seed,
+                          n_init=1).fit(X)
+            svd = TruncatedSVD(n_components=3, random_state=seed).fit(X)
+            payloads = [
+                (scale * rng.normal(size=(n, m))).astype(np.float32)
+                for n in (1, 16)]
+            for mode in ("bf16", "int8"):
+                _check_fold_holds(qkm, mode, payloads,
+                                  f"seed{seed}/scale{scale}")
+                _check_fold_holds(svd, mode, payloads,
+                                  f"seed{seed}/scale{scale}")
+            aot.clear()
+
+
+def test_int8_scale_edge_cases():
+    assert quant.int8_scale(0.0) == 1.0
+    z = quant.quantize_rows(np.zeros((2, 3), np.float32), "int8",
+                            scale=1.0)
+    assert z.dtype == np.int8 and not z.any()
+    b = quant.quantize_rows(np.zeros((2, 3), np.float32), "bf16")
+    assert not np.asarray(b, np.float32).any()
+
+
+# -- live audit --------------------------------------------------------------
+
+
+def test_auditor_clean_under_strict(fitted, monkeypatch):
+    """A quantized load with the auditor armed strict must neither raise
+    nor flag — the draws exist and every one honors the declared fold."""
+    monkeypatch.setenv("SQ_OBS_AUDIT_STRICT", "1")
+    monkeypatch.setenv("SQ_SERVE_AUDIT_EVERY", "1")
+    reg = ModelRegistry()
+    reg.register("q", fitted["qkm"], quantize="bf16")
+    reg.register("qi", fitted["svd"], quantize="int8")
+    rec = obs.enable()
+    rng = np.random.default_rng(9)
+    payloads = [rng.normal(size=(n, fitted["m"])).astype(np.float32)
+                for n in (1, 5, 20)]
+    _serve_all(reg, "q", "predict", payloads)
+    _serve_all(reg, "q", "transform", payloads)
+    _serve_all(reg, "qi", "transform", payloads)
+    summary = obs.guarantees.audit(rec.guarantee_records)
+    quant_sites = {s: a for s, a in summary.items()
+                   if s.startswith("serving.quant.")}
+    assert quant_sites, "no quantization guarantee draws recorded"
+    assert all(a["violations"] == 0 for a in quant_sites.values())
+    assert all(not a["flagged"] for a in quant_sites.values())
+    obs.disable()
+
+
+def test_quant_fold_gauge_recorded(fitted):
+    rec = obs.enable()
+    ServingModel(fitted["qkm"], quantize="int8")
+    folds = [g for g in rec.gauge_events
+             if g.get("name") == "serving.quant_fold"]
+    assert len(folds) == 2  # predict + transform
+    for g in folds:
+        v = g["value"]
+        assert v["mode"] == "int8"
+        assert v["coef_amax"] > 0 and v["delta"] > 0
+    obs.disable()
+
+
+# -- degrade parity ----------------------------------------------------------
+
+
+def test_degraded_quantized_batches_bit_identical(fitted, monkeypatch):
+    """Exhausted retries degrade a quantized batch to the host route:
+    same kernel, same pre-quantized payload — responses bit-equal to the
+    supervised run, zero requests lost."""
+    monkeypatch.setenv("SQ_RETRY_BACKOFF_S", "0.001")
+    monkeypatch.setenv("SQ_BREAKER_K", "3")
+    reg = ModelRegistry()
+    reg.register("q", fitted["qkm"], quantize="int8")
+    rng = np.random.default_rng(11)
+    payloads = [rng.normal(size=(n, fitted["m"])).astype(np.float32)
+                for n in (3, 9, 17, 2, 40, 1)]
+
+    def run():
+        serve_cache.clear()
+        d = MicroBatchDispatcher(reg, background=False, max_batch_rows=32)
+        futs = [d.submit("q", "predict", r) for r in payloads]
+        d.flush()
+        outs = [f.result(timeout=30) for f in futs]
+        slo = d.close()
+        return outs, slo
+
+    clean, slo_clean = run()
+    assert slo_clean["degraded"] == 0
+    faults.arm("put_fail:tiles=1,times=10")
+    faulted, slo_faulted = run()
+    faults.disarm()
+    breaker.reset("test: quantized degrade leg done")
+    assert len(faulted) == len(payloads)
+    assert all(np.array_equal(a, b) for a, b in zip(clean, faulted))
+    assert slo_faulted["degraded"] >= 1
+
+
+# -- bytes / grouping --------------------------------------------------------
+
+
+def test_quantized_group_merges_dtypes_and_halves_bytes(fitted):
+    """bf16 serving folds f32 and f64 request streams into ONE transfer
+    dtype (one batch where the exact route needs two) and moves half
+    the bytes."""
+    reg = ModelRegistry()
+    reg.register("x", fitted["qkm"])
+    reg.register("q", fitted["qkm"], quantize="bf16")
+    rng = np.random.default_rng(13)
+    r32 = rng.normal(size=(8, fitted["m"])).astype(np.float32)
+    r64 = rng.normal(size=(8, fitted["m"])).astype(np.float64)
+
+    def run(tenant):
+        d = MicroBatchDispatcher(reg, background=False)
+        f1 = d.submit(tenant, "predict", r32)
+        f2 = d.submit(tenant, "predict", r64)
+        d.flush()
+        f1.result(timeout=10), f2.result(timeout=10)
+        return d.close()
+
+    exact = run("x")
+    quantized = run("q")
+    # x64 off: both exact requests canonicalize to f32 and share a
+    # group; the quantized group transfers bf16 — exactly half
+    assert quantized["transfer_bytes"] * 2 == exact["transfer_bytes"]
+    assert quantized["batches"] <= exact["batches"]
+
+
+def test_fingerprint_and_cache_isolate_quantize_modes(fitted):
+    a = ServingModel(fitted["qkm"])
+    b = ServingModel(fitted["qkm"], quantize="bf16")
+    c = ServingModel(fitted["qkm"], quantize="int8")
+    assert len({a.fingerprint, b.fingerprint, c.fingerprint}) == 3
+    r = np.ones((2, fitted["m"]), np.float32)
+    k_exact = serve_cache.key_for(a.fingerprint, "transform", r)
+    k_bf16 = serve_cache.key_for(b.fingerprint, "transform", r)
+    assert k_exact != k_bf16
+
+
+def test_group_key_is_memoized_per_model(fitted):
+    """The satellite fix: the group key is one dict lookup per submit —
+    repeated calls return the SAME tuple object."""
+    model = ServingModel(fitted["qkm"])
+    k1 = model.group_key("predict", np.dtype(np.float32))
+    k2 = model.group_key("predict", np.dtype(np.float32))
+    assert k1 is k2
+    assert model.group_key("transform", np.dtype(np.float32)) is not k1
+    # and the param signature is precomputed (dict lookup, stable value)
+    centers_shape = tuple(
+        int(d) for d in np.asarray(fitted["qkm"].cluster_centers_).shape)
+    assert model.param_signature("predict") == (centers_shape,)
+
+
+def test_realized_errors_margin_semantics(fitted):
+    """Predict's fold is a near-optimality claim: realized = the exact
+    margin between the returned label and the exact best."""
+    centers = np.array([[0.0, 0.0], [10.0, 0.0]])
+
+    class Est:
+        cluster_centers_ = centers
+
+        def get_params(self):
+            return {}
+
+    model = ServingModel(Est())
+    rows = np.array([[1.0, 0.0]])
+    # correct label: zero realized error
+    assert quant.realized_errors("margin", "predict_centers", rows,
+                                 np.array([0]), [centers]) == 0.0
+    # wrong label: realized = d(row, c1) - d(row, c0) = 9 - 1 = 8
+    assert quant.realized_errors("margin", "predict_centers", rows,
+                                 np.array([1]), [centers]) == \
+        pytest.approx(8.0)
