@@ -10,7 +10,7 @@ SHELL := /bin/bash
 .PHONY: test test-fast test-timed test-fast-tier test-slow-tier lint bench \
     bench-smoke bench-suite multichip examples \
     hunt obs-smoke faults-smoke oocore-smoke serve-smoke regress-selftest \
-    smoke obs-report obs-trace obs-frontier obs-audit regress all
+    smoke obs-report obs-trace obs-frontier obs-audit obs-budget regress all
 
 all: lint test
 
@@ -167,6 +167,12 @@ obs-audit:
 
 obs-frontier:
 	$(PYTHON) -m sq_learn_tpu.obs frontier $(OBS)
+
+# Per-tenant error-budget view of the same artifact: rolling-window
+# latency-SLO + statistical burn rates per tenant (exit 1 when any
+# multi-window burn alert fired — the CI-friendly burn check).
+obs-budget:
+	$(PYTHON) -m sq_learn_tpu.obs budget $(OBS)
 
 # Perf-regression gate, standalone: run the headline bench, the PR 6
 # fused-fit bench (classical 70k×784 q-means), the PR 7 δ=0.5

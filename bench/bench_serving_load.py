@@ -43,6 +43,13 @@ Four JSON lines land in the record (all banded by ``make regress``):
 Per-request parity is spot-checked against the estimators' own
 predict/transform surfaces. SQ_BENCH_SMOKE=1 shrinks the stream (600
 requests) while keeping every code path.
+
+Under ``SQ_OBS=1`` (the ``make regress`` run) the obs artifact
+additionally carries the ISSUE 12 per-tenant telemetry — one ``slo``
+record per tenant (declared targets, latency decomposition) plus the
+error-budget ``budget`` records — and the bench ASSERTS the three
+tenants' request counts sum to the batched arm's aggregate: an
+attribution leak fails the run like a lost request does.
 """
 
 import json
@@ -114,6 +121,12 @@ def _run_arm(reg, requests, *, coalesce, threads, max_batch_rows,
     if errors:
         raise RuntimeError(f"requests failed: {errors[:3]}")
     slo["wall_s"] = round(wall, 4)
+    # per-tenant attribution (ISSUE 12; populated only under SQ_OBS=1):
+    # the regress run reconciles these counts against the aggregate —
+    # an attribution leak (a request billed to no tenant, or twice)
+    # breaks the error-budget ledger's arithmetic
+    slo["tenant_requests"] = {t: s["requests"]
+                              for t, s in d.slo.tenant_summaries().items()}
     return slo
 
 
@@ -195,9 +208,12 @@ def main():
     gamma = TruncatedSVD(n_components=8, random_state=0).fit(X)
 
     reg = ModelRegistry(capacity=16)
-    reg.register("alpha", alpha)
-    reg.register("beta", beta)
-    reg.register("gamma", gamma)
+    # declared per-tenant SLOs (generous — telemetry, not a gate): the
+    # per-tenant slo/budget records in the obs artifact burn against
+    # these instead of run-level targets (ISSUE 12)
+    reg.register("alpha", alpha, slo_p50_ms=2500.0, slo_p99_ms=5000.0)
+    reg.register("beta", beta, slo_p50_ms=2500.0, slo_p99_ms=5000.0)
+    reg.register("gamma", gamma, slo_p50_ms=2500.0, slo_p99_ms=5000.0)
     # the quantized leg's registrations: same fitted models, bf16 route
     reg.register("alpha_q", alpha, quantize="bf16")
     reg.register("beta_q", beta, quantize="bf16")
@@ -292,6 +308,30 @@ def main():
     bytes_q = quant["transfer_bytes"]
     bytes_ratio = (bytes_q / bytes_f32) if bytes_f32 else None
 
+    # per-tenant attribution reconciliation (ISSUE 12): with a recorder
+    # active the dispatcher bills every request — batched-path AND
+    # result-cache hits — to exactly one tenant, so the three tenants'
+    # per-tenant slo counts must sum to the run aggregate. An
+    # attribution leak here would silently corrupt every burn rate the
+    # budget ledger reports, so a mismatch fails the bench like a lost
+    # request does. (SQ_OBS unset: the dispatcher tracks no tenants by
+    # design — the check arms only when the artifact exists.)
+    from sq_learn_tpu import obs as _obs
+
+    tenant_counts = batched.get("tenant_requests") or {}
+    reconciled = None
+    if _obs.enabled():
+        reconciled = (len(tenant_counts) == 3
+                      and sum(tenant_counts.values())
+                      == batched["requests"])
+        if not reconciled:
+            print(json.dumps({
+                "error": "per-tenant request counts do not reconcile "
+                         "with the run aggregate",
+                "tenant_requests": tenant_counts,
+                "aggregate": batched["requests"]}), file=sys.stderr)
+            return 1
+
     qps_ratio = (batched["qps"] / sequential["qps"]
                  if sequential["qps"] else None)
     p99_ratio = (sequential["p99_ms"] / batched["p99_ms"]
@@ -300,6 +340,8 @@ def main():
     extras = dict(threads=threads, parity=parity,
                   batched=batched, sequential=sequential,
                   open_loop=open_loop,
+                  tenant_requests=tenant_counts,
+                  tenants_reconciled=reconciled,
                   kernel_compiles=kernel_cache_sizes(),
                   aot_executables=aot.cache_size())
     emit(f"{tag}_microbatch_qps", batched["qps"], unit="qps",

@@ -85,6 +85,16 @@ run_and_record() {  # run_and_record <timeout_s> <header> <cmd...>; returns the 
     env -u PYTHONPATH timeout 60 python -m sq_learn_tpu.obs report \
       "$obs_dir/${slug}.jsonl" > "$obs_dir/${slug}_report.txt" \
       2>/dev/null || true
+    # per-tenant error-budget view (serving configs emit `budget`
+    # records; non-serving configs archive the empty table) — the burn
+    # evidence is committed next to the number it explains, like the
+    # resilience extract above
+    if grep -aq '"type": "budget"' "$obs_dir/${slug}.jsonl" \
+        2>/dev/null; then
+      env -u PYTHONPATH timeout 60 python -m sq_learn_tpu.obs budget \
+        "$obs_dir/${slug}.jsonl" > "$obs_dir/${slug}_budget.txt" \
+        2>/dev/null || true
+    fi
   fi
   return $rc
 }

@@ -19,15 +19,18 @@ Env knobs: ``SQ_OBS=1`` auto-enables with a JSONL sink at ``SQ_OBS_PATH``
 (default ``sq_obs.jsonl``); ``SQ_OBS_STRICT=1`` makes watchdog budget
 violations raise instead of warn; ``SQ_OBS_AUDIT_STRICT=1`` makes
 guarantee-audit flags raise (:mod:`~sq_learn_tpu.obs.guarantees`);
+``SQ_OBS_BUDGET_STRICT=1`` makes tripped multi-window error-budget
+burn alerts raise (:mod:`~sq_learn_tpu.obs.budget`, with
+``SQ_OBS_BUDGET_WINDOWS``/``SQ_OBS_BUDGET_BURN`` tuning);
 ``SQ_OBS_TRACE=<path>`` renders the closing run's JSONL into Chrome
 trace-event JSON. Analysis tooling:
-``python -m sq_learn_tpu.obs {trace,report,regress,audit,frontier}`` and
-:mod:`~sq_learn_tpu.obs.xla` (per-compilation FLOP/byte/peak-HBM
+``python -m sq_learn_tpu.obs {trace,report,regress,audit,frontier,budget}``
+and :mod:`~sq_learn_tpu.obs.xla` (per-compilation FLOP/byte/peak-HBM
 accounting). Full docs: ``docs/observability.md``.
 """
 
-from . import (frontier, guarantees, ledger, probe, regress, report, schema,
-               trace, xla)
+from . import (budget, frontier, guarantees, ledger, probe, regress, report,
+               schema, trace, xla)
 from .recorder import (NULL_SPAN, Recorder, counter_add, disable, enable,
                        enabled, gauge, get_recorder, record_span, snapshot,
                        span)
@@ -43,6 +46,7 @@ __all__ = [
     "RetracingError",
     "RetracingWarning",
     "RetracingWatchdog",
+    "budget",
     "counter_add",
     "disable",
     "enable",

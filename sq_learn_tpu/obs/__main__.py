@@ -1,5 +1,6 @@
 """CLI dispatcher:
-``python -m sq_learn_tpu.obs <trace|report|regress|audit|frontier>``.
+``python -m sq_learn_tpu.obs
+<trace|report|regress|audit|frontier|budget>``.
 
 - ``trace <jsonl> [...] [-o out.json]`` — render a run's JSONL into
   Chrome trace-event JSON (Perfetto-viewable), merging multiple files
@@ -15,8 +16,13 @@
   audit of the run's (ε, δ) guarantee records; exits 1 on any flagged
   site (:mod:`~sq_learn_tpu.obs.guarantees`).
 - ``frontier <jsonl> [...] [--json]`` — the accuracy-vs-theoretical-
-  quantum-runtime table with its Pareto frontier
+  quantum-runtime table with its Pareto frontier, plus the per-tenant
+  effective-(ε, δ) table from live guarantee draws
   (:mod:`~sq_learn_tpu.obs.frontier`).
+- ``budget <jsonl> [...] [--json]`` — the per-tenant error-budget
+  table (rolling-window latency-SLO and statistical burn rates); exits
+  1 when any tenant's multi-window burn alert fired
+  (:mod:`~sq_learn_tpu.obs.budget`).
 
 All subcommands are dependency-free file tools (no jax import on the
 comparison/render paths), safe to run with PYTHONPATH cleared while the
@@ -42,9 +48,11 @@ def main(argv=None):
         from .guarantees import main as run
     elif cmd == "frontier":
         from .frontier import main as run
+    elif cmd == "budget":
+        from .budget import main as run
     else:
         print(f"unknown subcommand {cmd!r} (expected trace, report, "
-              "regress, audit, or frontier)", file=sys.stderr)
+              "regress, audit, frontier, or budget)", file=sys.stderr)
         return 2
     return run(rest)
 

@@ -26,7 +26,7 @@ Design constraints, in order:
    arrays, so their spans are synced by construction.
 
 JSONL schema: one JSON object per line, every line carrying
-``{"v": 4, "schema_version": 4, "ts": <unix seconds>, "type": <record
+``{"v": 6, "schema_version": 6, "ts": <unix seconds>, "type": <record
 type>}`` plus per-type fields — see :mod:`sq_learn_tpu.obs.schema` (the
 validator) and ``docs/observability.md`` (the prose). ``v`` is the
 original envelope key (kept so pre-2 readers don't break);
@@ -46,7 +46,12 @@ import time
 #     sustained QPS, batch-occupancy and degrade accounting)
 # v5: +slo.transfer_bytes optional field (the quantized serving route's
 #     bytes-moved evidence, PR 11 — no new record types)
-SCHEMA_VERSION = 5
+# v6: +budget / alert record types (the per-tenant error-budget ledger:
+#     rolling-window latency-SLO and (ε, δ) burn rates with multi-window
+#     alerting, PR 12) and the optional slo.tenant / slo.stages fields
+#     (per-tenant SLO records and the queue/coalesce/transfer/compute/
+#     scatter latency decomposition)
+SCHEMA_VERSION = 6
 
 #: default sink path when SQ_OBS=1 and SQ_OBS_PATH is unset
 DEFAULT_PATH = "sq_obs.jsonl"
@@ -160,8 +165,9 @@ class Recorder:
     Public views: ``spans``, ``counters``, ``gauges``, ``ledger_entries``,
     ``watchdog_events``, ``probe_events``, ``fault_events``,
     ``breaker_events``, ``xla_cost_records``, ``guarantee_records``,
-    ``tradeoff_records``, ``slo_records`` — all plain Python containers,
-    safe to read at any point in the run.
+    ``tradeoff_records``, ``slo_records``, ``budget_records``,
+    ``alert_records`` — all plain Python containers, safe to read at any
+    point in the run.
     """
 
     def __init__(self, path=None):
@@ -178,6 +184,8 @@ class Recorder:
         self.guarantee_records = []
         self.tradeoff_records = []
         self.slo_records = []
+        self.budget_records = []
+        self.alert_records = []
         self._xla_seen = set()  # (site, signature) dedup for obs.xla
         self.path = path
         self._seq = 0
@@ -429,6 +437,14 @@ def snapshot():
             rec.counters.get("serving.persistent_cache_hits", 0)),
         "serving_transfer_bytes": int(
             rec.counters.get("serving.transfer_bytes", 0)),
+        # per-tenant error-budget ledger (obs.budget, PR 12): budget
+        # evaluations recorded, multi-window burn alerts fired, and the
+        # tenants whose budgets tripped — a bench line's evidence that a
+        # load run's tenants stayed inside their declared budgets
+        "budget_records": len(rec.budget_records),
+        "budget_alerts": len(rec.alert_records),
+        "budget_alerting_tenants": sorted(
+            {str(a.get("tenant")) for a in rec.alert_records}),
     }
 
 

@@ -15,6 +15,7 @@ accelerator relay is wedged.
 
 import json
 
+from . import budget as _budget
 from . import frontier as _frontier
 from . import guarantees as _guarantees
 from .trace import load_jsonl
@@ -181,6 +182,11 @@ def summarize(records):
         # run's accuracy-vs-theoretical-runtime sweep points
         "audit": _guarantees.audit(records),
         "tradeoffs": _frontier.collect(records),
+        # the per-tenant error-budget sections (v6): rolling-window
+        # burn rates + tripped alerts, and the effective (ε, δ) each
+        # tenant's live draws say it was actually served
+        "budgets": _budget.collect(records),
+        "effective": _frontier.effective_contracts(records),
     }
 
 
@@ -309,11 +315,29 @@ def render(summary, top=12):
         flag = "  SLO VIOLATED" if r.get("violated") else ""
         tb = r.get("transfer_bytes")
         tb_s = f"  moved {tb} B" if tb else ""
-        out(f"  {r.get('site')}: {r.get('requests', 0)} req @ "
+        who = r.get("site")
+        if r.get("tenant"):
+            who = f"{who}[{r['tenant']}]"
+        if (r.get("attrs") or {}).get("windowed"):
+            who = f"{who} (window #{r['attrs'].get('flush_seq')})"
+        out(f"  {who}: {r.get('requests', 0)} req @ "
             f"{_fmt_num(r.get('qps'))} qps  p50 {r.get('p50_ms')}ms  "
             f"p99 {r.get('p99_ms')}ms  occupancy "
             f"{r.get('batch_occupancy')}  degraded {r.get('degraded')}"
             f"{tb_s}{tgt_s}{flag}")
+        stages = r.get("stages")
+        if stages:
+            decomp = "  ".join(f"{k}={v:.4f}s"
+                               for k, v in sorted(stages.items()))
+            out(f"    stages: {decomp}")
+
+    out("")
+    out("-- tenant error budgets (multi-window burn rates) --")
+    out(_budget.render(summary.get("budgets") or {}))
+
+    out("")
+    out("-- effective (eps, delta) per tenant (live draws) --")
+    out(_frontier.render_effective(summary.get("effective") or {}))
 
     srv = summary.get("serving") or {}
     if (srv.get("aot_compiles") or srv.get("aot_cache_hits")

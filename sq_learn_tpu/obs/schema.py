@@ -1,7 +1,7 @@
 """JSONL schema for obs records, and a dependency-free validator.
 
 Every line of an obs JSONL file is one JSON object carrying the common
-envelope ``{"v": 5, "schema_version": 5, "ts": <unix seconds>,
+envelope ``{"v": 6, "schema_version": 6, "ts": <unix seconds>,
 "type": <t>}`` plus per-type required fields. Version history: v1 (PR 2)
 had neither the ``schema_version`` alias nor the ``xla_cost`` /
 ``regression`` types; v2 (PR 4) added those; v3 (PR 5) adds the
@@ -10,10 +10,15 @@ statistical-observability types ``guarantee`` (one realized-vs-declared
 point); v4 (PR 9) adds ``slo`` (one serving-run latency/throughput
 summary from :mod:`sq_learn_tpu.serving`); v5 (PR 11) adds the optional
 ``slo.transfer_bytes`` field (the quantized serving route's bytes-moved
-evidence — no new record types). Older versions still validate (their
-types are a strict subset), any other version is rejected — an unknown
-version means a reader that would silently misinterpret fields, so it
-must fail loudly.
+evidence — no new record types); v6 (PR 12) adds the per-tenant
+error-budget types ``budget`` (one tenant × rolling-window burn-rate
+evaluation from :mod:`sq_learn_tpu.obs.budget`) and ``alert`` (one
+tripped multi-window burn alert), plus the optional ``slo.tenant`` /
+``slo.stages`` fields (per-tenant SLO records and the queue/coalesce/
+transfer/compute/scatter latency decomposition). Older versions still
+validate (their types are a strict subset), any other version is
+rejected — an unknown version means a reader that would silently
+misinterpret fields, so it must fail loudly.
 
 =========  ==============================================================
 type       required fields (beyond the envelope)
@@ -73,7 +78,25 @@ slo        site (str), requests (int ≥ 0), p50_ms (number ≥ 0),
            window_s (number ≥ 0), transfer_bytes (int ≥ 0 — padded
            payload bytes moved host→device; the quantized route's
            bytes-halved claim reads off this, v5),
-           targets (object: str → number), attrs (object)
+           targets (object: str → number),
+           tenant (str — a per-tenant record next to the run
+           aggregate, v6), stages (object: str → number ≥ 0 — the
+           queue/coalesce/assemble/transfer/compute/scatter latency
+           decomposition in seconds, v6), attrs (object)
+budget     tenant (str), window_s (number > 0), slo_burn (number in
+           [0, 1] | null), stat_burn (number in [0, 1] | null),
+           cp_lower_bound (number in [0, 1] | null), burn_rate
+           (number ≥ 0 | null), alerting (bool) — one tenant ×
+           rolling-window error-budget evaluation
+           (:mod:`sq_learn_tpu.obs.budget`); optional requests /
+           over_p50 / over_p99 / draws / draw_violations (int ≥ 0),
+           p50_ms / p99_ms (number ≥ 0), slo_burn_rate /
+           stat_burn_rate (number ≥ 0), fail_prob (number in [0, 1]),
+           targets (object: str → number), site (str), attrs (object)
+alert      tenant (str), kind (str), threshold (number ≥ 0),
+           burn_rates (object: str → number) — one tripped
+           multi-window burn-rate alert (every configured window at or
+           past the threshold); optional site (str), attrs (object)
 =========  ==============================================================
 
 The out-of-core layer (PR 8) rides the generic types rather than minting
@@ -98,8 +121,8 @@ _NUM = (int, float)
 #: versions this validator knows how to read (v1 = PR 2's envelope
 #: without schema_version/xla_cost/regression; v2 = PR 4's, without
 #: guarantee/tradeoff; v3 = PR 5's, without slo; v4 = PR 9's, without
-#: slo.transfer_bytes)
-KNOWN_VERSIONS = {1, 2, 3, 4, SCHEMA_VERSION}
+#: slo.transfer_bytes; v5 = PR 11's, without budget/alert)
+KNOWN_VERSIONS = {1, 2, 3, 4, 5, SCHEMA_VERSION}
 
 _PROBE_OUTCOMES = {"ok", "timeout", "error", "cpu", "skipped"}
 
@@ -305,6 +328,69 @@ def validate_record(rec):
                 isinstance(k, str) and isinstance(vv, _NUM)
                 for k, vv in obj.items()), errors,
                 "slo.targets object of str → number")
+        if "tenant" in rec:
+            _check(isinstance(rec["tenant"], str), errors,
+                   "slo.tenant str")
+        if "stages" in rec:
+            obj = rec["stages"]
+            _check(isinstance(obj, dict) and all(
+                isinstance(k, str) and isinstance(vv, _NUM)
+                and not isinstance(vv, bool) and vv >= 0
+                for k, vv in obj.items()), errors,
+                "slo.stages object of str → non-negative number")
+    elif t == "budget":
+        _check(isinstance(rec.get("tenant"), str), errors,
+               "budget.tenant str")
+        w = rec.get("window_s")
+        _check(isinstance(w, _NUM) and not isinstance(w, bool) and w > 0,
+               errors, "budget.window_s positive number")
+        for field in ("slo_burn", "stat_burn", "cp_lower_bound"):
+            v_ = rec.get(field, None)
+            _check(field in rec
+                   and (v_ is None or (isinstance(v_, _NUM)
+                                       and not isinstance(v_, bool)
+                                       and 0.0 <= v_ <= 1.0)),
+                   errors, f"budget.{field} number in [0, 1] or null")
+        br = rec.get("burn_rate", None)
+        _check("burn_rate" in rec
+               and (br is None or (isinstance(br, _NUM)
+                                   and not isinstance(br, bool)
+                                   and br >= 0)),
+               errors, "budget.burn_rate non-negative number or null")
+        _check(isinstance(rec.get("alerting"), bool), errors,
+               "budget.alerting bool")
+        for field in ("requests", "over_p50", "over_p99", "draws",
+                      "draw_violations"):
+            if rec.get(field) is not None and field in rec:
+                _check(isinstance(rec[field], int)
+                       and not isinstance(rec[field], bool)
+                       and rec[field] >= 0, errors,
+                       f"budget.{field} non-negative int")
+        for field in ("p50_ms", "p99_ms", "slo_burn_rate",
+                      "stat_burn_rate"):
+            if rec.get(field) is not None and field in rec:
+                _check(isinstance(rec[field], _NUM)
+                       and not isinstance(rec[field], bool)
+                       and rec[field] >= 0, errors,
+                       f"budget.{field} non-negative number")
+        if "targets" in rec:
+            obj = rec["targets"]
+            _check(isinstance(obj, dict) and all(
+                isinstance(k, str) and isinstance(vv, _NUM)
+                for k, vv in obj.items()), errors,
+                "budget.targets object of str → number")
+    elif t == "alert":
+        _check(isinstance(rec.get("tenant"), str), errors,
+               "alert.tenant str")
+        _check(isinstance(rec.get("kind"), str), errors, "alert.kind str")
+        th = rec.get("threshold")
+        _check(isinstance(th, _NUM) and not isinstance(th, bool)
+               and th >= 0, errors, "alert.threshold non-negative number")
+        obj = rec.get("burn_rates")
+        _check(isinstance(obj, dict) and all(
+            isinstance(k, str) and isinstance(vv, _NUM)
+            and not isinstance(vv, bool) for k, vv in obj.items()),
+            errors, "alert.burn_rates object of str → number")
     else:
         errors.append(f"unknown record type {t!r}")
     return errors

@@ -1,11 +1,14 @@
 """Observability smoke: tiny instrumented fits + JSONL schema validation.
 
 ``make obs-smoke`` runs this module: a streamed qPCA Gram fit (streaming
-counters + retracing watchdog) and a quantum top-k extraction (nonzero
-tomography shots in the ledger) under an active recorder, then validates
-the emitted JSONL against :mod:`sq_learn_tpu.obs.schema` and asserts the
-run artifact carries the signals the layer exists for. Exit code 0 =
-contract holds; 1 = schema or content violation (printed).
+counters + retracing watchdog), a quantum top-k extraction (nonzero
+tomography shots in the ledger), and a tiny served tenant with a
+declared SLO (per-tenant ``slo`` + error-budget ``budget`` records,
+schema v6) under an active recorder, then validates the emitted JSONL
+against :mod:`sq_learn_tpu.obs.schema` (legacy v1–v5 records must keep
+validating) and asserts the run artifact carries the signals the layer
+exists for. Exit code 0 = contract holds; 1 = schema or content
+violation (printed).
 
 Pins the CPU backend in-process first (the documented wedge-proof
 override, CLAUDE.md) — a health check must never hang on the thing whose
@@ -64,6 +67,18 @@ def main():
         accuracy_metric="neg_inertia",
         q_runtime=float(np.ravel(quantum)[0]), c_runtime=float(classical))
 
+    # v6 contract: a tiny serving run with a declared tenant SLO — the
+    # dispatcher's close must emit the per-tenant slo record and the
+    # per-tenant error-budget evaluations (obs.budget)
+    from ..serving import MicroBatchDispatcher, ModelRegistry
+
+    sreg = ModelRegistry()
+    sreg.register("smoke_tenant", qk, slo_p50_ms=5e3, slo_p99_ms=1e4)
+    sd = MicroBatchDispatcher(sreg, background=False)
+    for i in range(4):
+        sd.serve("smoke_tenant", "predict", X[: 4 + i])
+    sd.close()
+
     report = watchdog.report()
     totals = ledger.totals()
     audit = guarantees.audit()
@@ -107,6 +122,31 @@ def main():
                  for t in rec.tradeoff_records):
         failures.append("tradeoff records carry no finite theoretical "
                         "quantum runtime")
+    # v6 contract: the serving leg's per-tenant error budgets landed,
+    # the tenant's slo record carries its declared targets, and legacy
+    # schema versions (v1-v5 files) still validate
+    if summary["by_type"].get("budget", 0) <= 0:
+        failures.append("no budget records from the serving leg")
+    if not any(r.get("tenant") == "smoke_tenant" for r in rec.slo_records):
+        failures.append("no per-tenant slo record from the serving leg")
+    if any(a for a in rec.alert_records):
+        failures.append(f"burn alert fired under a generous declared "
+                        f"SLO: {rec.alert_records}")
+    from .schema import validate_record
+
+    legacy = [
+        {"v": 1, "ts": 0.0, "type": "counter", "name": "x", "value": 1,
+         "delta": 1},
+        {"v": 5, "schema_version": 5, "ts": 0.0, "type": "slo",
+         "site": "s", "requests": 1, "p50_ms": 1.0, "p99_ms": 2.0,
+         "qps": 3.0, "batch_occupancy": 0.5, "degraded": 0,
+         "violated": False},
+    ]
+    for r_ in legacy:
+        errs = validate_record(r_)
+        if errs:
+            failures.append(f"legacy schema version v{r_['v']} "
+                            f"rejected: {errs}")
 
     print(json.dumps({
         "obs_smoke": "fail" if failures else "ok",
@@ -116,6 +156,8 @@ def main():
         "watchdog": report,
         "audit_sites": {s: [a["violations"], a["trials"]]
                         for s, a in sorted(audit.items())},
+        "budget_tenants": sorted({r.get("tenant")
+                                  for r in rec.budget_records}),
         "errors": failures,
     }))
     return 1 if failures else 0

@@ -45,7 +45,18 @@ _LANES = {
     "regression": (7, "regression gate"),
     "guarantee": (8, "guarantee audit"),
     "tradeoff": (9, "tradeoff frontier"),
+    "slo": (10, "serving slo"),
+    "budget": (11, "error budgets"),
+    "alert": (12, "budget alerts"),
 }
+
+#: records that move onto a per-tenant lane when they carry a tenant
+#: (the serving plane's per-tenant telemetry reads as one lane per
+#: tenant: its slo windows, budget evaluations, and alerts together)
+_TENANT_TYPES = ("slo", "budget", "alert")
+
+#: first tid of the dynamically-allocated per-tenant lanes
+_TENANT_TID0 = 16
 
 
 def load_jsonl(path):
@@ -106,6 +117,16 @@ def _instant_name(rec):
     if t == "tradeoff":
         return (f"tradeoff {rec.get('sweep')}@{rec.get('point')}: "
                 f"acc={rec.get('accuracy')}")
+    if t == "slo":
+        who = rec.get("tenant") or rec.get("site")
+        return (f"slo {who}: p99={rec.get('p99_ms')}ms "
+                f"qps={rec.get('qps')}")
+    if t == "budget":
+        state = "ALERTING" if rec.get("alerting") else "ok"
+        return (f"budget {rec.get('tenant')}@{rec.get('window_s')}s: "
+                f"burn={rec.get('burn_rate')} {state}")
+    if t == "alert":
+        return f"ALERT {rec.get('tenant')}:{rec.get('kind')}"
     return t
 
 
@@ -118,6 +139,7 @@ def to_chrome_trace(record_groups):
     events = []
     named_pids = set()
     named_lanes = set()
+    tenant_tids = {}  # (pid, tenant) -> dedicated lane tid
 
     def name_process(pid, label):
         if pid in named_pids:
@@ -170,8 +192,20 @@ def to_chrome_trace(record_groups):
                     "pid": pid, "tid": 0, "args": {"value": val},
                 })
             elif t in _LANES:
-                tid, lane = _LANES[t]
-                name_lane(pid, tid, lane)
+                tenant = (rec.get("tenant") if t in _TENANT_TYPES
+                          else None)
+                if tenant is not None:
+                    # per-tenant lane: a tenant's slo windows, budget
+                    # evaluations, and alerts read as one timeline
+                    key = (pid, str(tenant))
+                    tid = tenant_tids.get(key)
+                    if tid is None:
+                        tid = _TENANT_TID0 + len(tenant_tids)
+                        tenant_tids[key] = tid
+                    name_lane(pid, tid, f"tenant:{tenant}")
+                else:
+                    tid, lane = _LANES[t]
+                    name_lane(pid, tid, lane)
                 events.append({
                     "ph": "i", "s": "t", "cat": t, "name": _instant_name(rec),
                     "ts": us, "pid": pid, "tid": tid, "args": _args_of(rec),

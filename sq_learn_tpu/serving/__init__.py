@@ -15,11 +15,18 @@ actually happens. This package turns fitted estimators into a service:
   checkpoint-backed (digest-verified loads) with LRU residency.
 - :mod:`~.cache` — digest-keyed transform-result cache for repeated
   identical requests (``SQ_SERVE_CACHE=0`` disables).
-- :class:`~.slo.SloTracker` — per-run p50/p99 latency, sustained QPS,
-  batch occupancy, transfer bytes and degrade counts, emitted as the
-  ``slo`` obs record (schema v5) and gated against
-  ``SQ_SERVE_SLO_P50_MS``/``SQ_SERVE_SLO_P99_MS``
-  (``SQ_SERVE_SLO_STRICT=1`` raises on violation).
+- :class:`~.slo.SloTracker` — per-run AND per-tenant p50/p99 latency,
+  sustained QPS, batch occupancy, transfer bytes, degrade counts, and
+  the queue/coalesce/transfer/compute/scatter latency decomposition,
+  emitted as ``slo`` obs records (schema v6: one per tenant + the run
+  aggregate, plus a windowed record every
+  ``SQ_SERVE_SLO_FLUSH_BATCHES`` batches) and gated against
+  ``SQ_SERVE_SLO_P50_MS``/``SQ_SERVE_SLO_P99_MS`` — or the tenant's own
+  ``register(..., slo_p50_ms=, slo_p99_ms=)`` declaration
+  (``SQ_SERVE_SLO_STRICT=1`` raises on violation). Per-tenant burn of
+  the latency AND statistical budgets feeds the error-budget ledger
+  (:mod:`sq_learn_tpu.obs.budget`: ``budget``/``alert`` records,
+  ``SQ_OBS_BUDGET_STRICT=1`` raises on a tripped multi-window alert).
 - :mod:`~.aot` — ahead-of-time compiled serving kernels: ``registry.
   warm()`` (or ``dispatcher.warm()``) compiles the whole bucket ladder
   before traffic, so p99 is flat from request one and the serving path
@@ -44,6 +51,7 @@ Env knobs: ``SQ_SERVE_MAX_WAIT_MS`` (2.0) coalescing window,
 ``SQ_SERVE_MIN_BUCKET_ROWS`` (8) smallest bucket,
 ``SQ_SERVE_REGISTRY_CAP`` (8) resident models, ``SQ_SERVE_CACHE`` /
 ``SQ_SERVE_CACHE_ENTRIES`` result cache, ``SQ_SERVE_SLO_*`` targets,
+``SQ_SERVE_SLO_FLUSH_BATCHES`` (256) windowed slo/budget flush stride,
 ``SQ_SERVE_AOT`` (1) AOT warm on ``registry.warm()``,
 ``SQ_COMPILE_CACHE_DIR`` persistent compile cache,
 ``SQ_SERVE_QUANTIZE`` (unset) process-default quantized route,

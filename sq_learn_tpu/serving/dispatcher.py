@@ -47,6 +47,22 @@ bucketed shapes:
   lost and the queue never stalls behind a wedged relay. Degrades count
   into the SLO record and the ``serving.degraded_batches`` counter.
 
+- **Per-tenant attribution (PR 12).** Under an active recorder every
+  request's latency, every batch's queue-wait / coalesce / assemble /
+  transfer / compute / scatter decomposition (``_Request`` carries the
+  monotonic timestamps; batches are single-tenant by construction —
+  the group key rides the model fingerprint), and every live fold-audit
+  draw is attributed to its tenant: the
+  :class:`~sq_learn_tpu.obs.budget.BudgetLedger` tracks each tenant's
+  latency-SLO and (ε, δ) burn over rolling windows, per-tenant ``slo``
+  records land next to the run aggregate, and every
+  ``SQ_SERVE_SLO_FLUSH_BATCHES`` (256) batches the tracker flushes a
+  windowed ``slo`` record plus the tenant ``budget`` records — a
+  long-running server telemeters continuously and a crash keeps the
+  history. With ``SQ_OBS`` unset none of this exists: no ledger is
+  allocated, no timestamps are taken, the hot path is byte-identical
+  (pinned by test).
+
 Determinism: with ``background=False`` the dispatcher never starts a
 worker thread — callers submit and then :meth:`~MicroBatchDispatcher.
 flush`, and grouping depends only on submission order and sizes, never
@@ -71,7 +87,7 @@ from ..streaming import bucket_rows
 from . import aot as _aot
 from . import cache as _cache
 from . import quantize as _quant
-from .slo import SloTracker
+from .slo import SloTracker, slo_flush_batches
 
 __all__ = ["MicroBatchDispatcher", "ServeFuture", "kernel_cache_sizes",
            "pin_compile_budgets", "serve_max_batch_rows",
@@ -228,7 +244,7 @@ def _canonical(dtype):
 
 class _Request:
     __slots__ = ("tenant", "op", "rows", "n_rows", "future", "submitted",
-                 "cache_key", "model", "group_key", "consumed")
+                 "cache_key", "model", "group_key", "consumed", "collected")
 
     def __init__(self, tenant, op, rows, model, cache_key, submitted):
         self.tenant = tenant
@@ -238,6 +254,10 @@ class _Request:
         self.model = model
         self.cache_key = cache_key
         self.submitted = submitted
+        #: monotonic batch-pop timestamp — the queue-wait/coalesce split
+        #: of the latency decomposition; stamped only under an active
+        #: recorder (the disabled path takes no extra clock reads)
+        self.collected = None
         self.future = ServeFuture()
         # the memoized model token: tenant identity rides the content
         # fingerprint (a re-registered tenant gets a new one), and a
@@ -294,6 +314,11 @@ class MicroBatchDispatcher:
         self._closed = False
         self._batch_seq = 0
         self._sites_seen = set()
+        #: per-tenant error-budget ledger (obs.budget) + windowed-flush
+        #: stride: the ledger is created lazily and ONLY under an
+        #: active recorder — SQ_OBS unset allocates nothing here
+        self._budget = None
+        self._flush_every = slo_flush_batches()
         #: AOT executable-cache traffic, pre-aggregated (one counter
         #: flush at close, not a JSONL line per batch)
         self._aot_hits = 0
@@ -352,9 +377,44 @@ class MicroBatchDispatcher:
             if hit is not None:
                 fut = ServeFuture()
                 fut.set_result(hit)
-                self.slo.note_request_done(submitted)
+                if _obs.enabled():
+                    done = time.perf_counter()
+                    p50_t, p99_t = self._targets_for(model)
+                    tenant = str(tenant)
+                    self.slo.note_request_done(
+                        submitted, ts=done, tenant=tenant,
+                        targets=(p50_t, p99_t))
+                    self._budget_ledger().note_request(
+                        tenant, done - submitted, p50_ms=p50_t,
+                        p99_ms=p99_t, ts=done)
+                else:
+                    self.slo.note_request_done(submitted)
                 return fut
         return _Request(str(tenant), op, rows, model, cache_key, submitted)
+
+    def _targets_for(self, model):
+        """The (p50, p99) targets a tenant's requests burn against: its
+        own declared registration targets, falling back per percentile
+        to the dispatcher's run-level ones."""
+        return (model.slo_p50_ms if model.slo_p50_ms is not None
+                else self.slo.slo_p50_ms,
+                model.slo_p99_ms if model.slo_p99_ms is not None
+                else self.slo.slo_p99_ms)
+
+    def _budget_ledger(self):
+        """The per-tenant :class:`~sq_learn_tpu.obs.budget.BudgetLedger`,
+        created on first use under an active recorder (never on the
+        disabled path — the zero-overhead invariant)."""
+        led = self._budget
+        if led is None:
+            led = self._budget = _obs.budget.BudgetLedger(site=self._site)
+        return led
+
+    def budget_ledger(self):
+        """The dispatcher's error-budget ledger, or None when no
+        observed traffic has been served (``SQ_OBS`` unset ⇒ always
+        None — the invariant the overhead-pin test reads)."""
+        return self._budget
 
     def submit(self, tenant, op, X):
         """Enqueue one request; returns a :class:`ServeFuture` resolving
@@ -423,8 +483,11 @@ class MicroBatchDispatcher:
             return self._pending_count
 
     def close(self):
-        """Drain, stop the worker, emit the run's ``slo`` record.
-        Idempotent; returns the SLO summary dict."""
+        """Drain, stop the worker, emit the run's ``slo`` records (per
+        tenant + aggregate) and the final per-tenant ``budget``/``alert``
+        records. Idempotent; returns the aggregate SLO summary dict. A
+        strict SLO violation (``SQ_SERVE_SLO_STRICT=1``) or budget burn
+        (``SQ_OBS_BUDGET_STRICT=1``) raises AFTER its records land."""
         if self._closed:
             return self.slo.summary()
         with self._cond:
@@ -446,7 +509,10 @@ class MicroBatchDispatcher:
                 _obs.counter_add("serving.transfer_bytes", nbytes)
             for site in sorted(self._sites_seen):
                 _obs.watchdog.observe(site)
-        return self.slo.emit()
+        summary = self.slo.emit()
+        if self._budget is not None:
+            self._budget.emit()
+        return summary
 
     def aot_stats(self):
         """{hits, misses} of the AOT executable cache, this dispatcher
@@ -645,7 +711,10 @@ class MicroBatchDispatcher:
         (supervised or degraded), dispatch the kernel WITHOUT blocking
         on its result — through the AOT executable when the signature
         was warmed, the lazily-compiling jit wrapper otherwise. Returns
-        the in-flight state for :meth:`_resolve`."""
+        the in-flight state for :meth:`_resolve`. Under an active
+        recorder the stage boundaries are stamped (collect → assembled →
+        placed → dispatched) so :meth:`_resolve` can attribute the
+        latency decomposition to the batch's tenant."""
         head = group[0]
         model = head.model
         kernel_name, params = model.op(head.op)
@@ -655,9 +724,14 @@ class MicroBatchDispatcher:
         if n > full:  # one oversized request: pad to its own pow2 bucket
             full = 1 << max(0, int(n - 1).bit_length())
         bucket = bucket_rows(max(n, 1), full, min_rows=self._min_bucket)
-        padded, extra, amax_x = self._assemble(group, bucket, model)
 
         observing = _obs.enabled()
+        t_collect = time.perf_counter() if observing else 0.0
+        if observing:
+            for r in group:
+                r.collected = t_collect
+        padded, extra, amax_x = self._assemble(group, bucket, model)
+        t_assembled = time.perf_counter() if observing else 0.0
         if observing:
             kernel_fn = _KERNELS[kernel_name]
             _obs.watchdog.track(site, kernel_fn)
@@ -701,6 +775,7 @@ class MicroBatchDispatcher:
             # bit-identical to supervised ones — quantized routes
             # included
             dev = jnp.asarray(padded)
+        t_placed = time.perf_counter() if observing else 0.0
 
         try:
             # async dispatch: the returned array is a handle; the fetch
@@ -719,16 +794,20 @@ class MicroBatchDispatcher:
             if observing:
                 _obs.watchdog.observe(site)
             raise
+        stamps = ((t_collect, t_assembled, t_placed) if observing
+                  else None)
         return (group, out_dev, n, bucket, degraded, site, observing,
-                padded.nbytes, amax_x, seq)
+                padded.nbytes, amax_x, seq, stamps)
 
     def _resolve(self, state):
         """Stage 2: fetch the batch's device result and scatter it back
-        per request (cache store, future resolution, SLO accounting,
-        and — for a quantized batch under observability — the strided
-        live guarantee draw against the declared fold)."""
+        per request (cache store, future resolution, SLO accounting —
+        per tenant under an active recorder, with the batch's latency
+        decomposition — and, for a quantized batch under observability,
+        the strided live guarantee draw against the declared fold, fed
+        into the tenant's error-budget ledger)."""
         (group, out_dev, n, bucket, degraded, site, observing,
-         nbytes, amax_x, seq) = state
+         nbytes, amax_x, seq, stamps) = state
         try:
             out = np.asarray(out_dev)
         except Exception as exc:
@@ -750,19 +829,61 @@ class MicroBatchDispatcher:
             if r.cache_key is not None:
                 _cache.store(r.cache_key, res)
             r.future.set_result(res)
-        self.slo.note_batch_done([r.submitted for r in group], done, n,
-                                 bucket, degraded, nbytes=nbytes)
         head = group[0]
+        tenant = targets = stages = None
+        if observing:
+            tenant = head.tenant
+            targets = self._targets_for(head.model)
+            if stamps is not None:
+                # the decomposition the budget telemetry reports: where
+                # a request's submit→response time actually went.
+                # "queue" is the non-head requests' wait for the batch
+                # to open (the head's wait IS the coalescing window);
+                # "compute" spans dispatch→fetch-complete, so in worker
+                # mode it includes the async overlap window by design
+                t_collect, t_assembled, t_placed = stamps
+                t_scatter = time.perf_counter()
+                stages = {
+                    "queue": sum(max(0.0, t_collect - r.submitted)
+                                 for r in group[1:]),
+                    "coalesce": max(0.0, t_collect - head.submitted),
+                    "assemble": max(0.0, t_assembled - t_collect),
+                    "transfer": max(0.0, t_placed - t_assembled),
+                    "compute": max(0.0, done - t_placed),
+                    "scatter": max(0.0, t_scatter - done),
+                }
+        self.slo.note_batch_done([r.submitted for r in group], done, n,
+                                 bucket, degraded, nbytes=nbytes,
+                                 tenant=tenant, targets=targets,
+                                 stages=stages)
+        if observing:
+            self._budget_ledger().note_requests(
+                tenant, [done - r.submitted for r in group],
+                p50_ms=targets[0], p99_ms=targets[1], ts=done)
         if observing and head.model.quant_folds and amax_x is not None:
             # one live draw per audited batch: the head request replayed
             # against the exact f64 reference, realized error vs the
-            # declared fold (strided; see quantize._audit_every)
-            _quant.audit_batch(head.model, head.op, head.rows, head_res,
-                               amax_x, seq)
+            # declared fold (strided; see quantize._audit_every),
+            # attributed to the tenant and burned against its δ_q
+            draw = _quant.audit_batch(head.model, head.op, head.rows,
+                                      head_res, amax_x, seq,
+                                      tenant=tenant)
+            if draw is not None:
+                self._budget_ledger().note_draw(
+                    tenant, draw["violated"], draw["fail_prob"])
         # per-batch totals live in the run's `slo` record; emitting
         # counter/watchdog JSONL per batch at serving rates floods the
         # artifact (measured: ~75k lines per load-bench run), so budget
         # enforcement is per-batch only under SQ_OBS_STRICT and every
-        # tracked site gets its one watchdog observation at close()
+        # tracked site gets its one watchdog observation at close().
+        # The windowed flush rides the batch seq: every Nth batch emits
+        # the since-last-flush slo window plus the tenant budget/alert
+        # records (a strict budget alert raises from here on the
+        # deterministic paths — background workers surface it at close)
+        if observing and self._flush_every > 0 \
+                and (seq + 1) % self._flush_every == 0:
+            self.slo.flush_window()
+            if self._budget is not None:
+                self._budget.emit()
         if observing and os.environ.get("SQ_OBS_STRICT") == "1":
             _obs.watchdog.observe(site)

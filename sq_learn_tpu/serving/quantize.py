@@ -374,27 +374,38 @@ def realized_errors(kind, base_kernel, rows, out, host_params):
     return float(np.max(np.abs(np.asarray(out, np.float64) - ref)))
 
 
-def audit_batch(model, op, head_rows, head_out, amax_x, seq):
+def audit_batch(model, op, head_rows, head_out, amax_x, seq, tenant=None):
     """One live guarantee draw for a dispatched quantized batch (head
     request only, strided by :func:`_audit_every`): realized error vs
-    the declared fold at the op's ``serving.quant.<kernel>`` site. Obs
-    off or an off-stride batch = no work; the audit must never break a
-    dispatch that already succeeded (exception-safe like the sketch's).
+    the declared fold at the op's ``serving.quant.<kernel>`` site,
+    attributed to ``tenant`` (the attr the per-tenant error-budget
+    ledger and the effective-(ε, δ) table key on). Obs off or an
+    off-stride batch = no work; the audit must never break a dispatch
+    that already succeeded (exception-safe like the sketch's). Returns
+    the draw's ``{realized, tol, violated, fail_prob}`` (the budget
+    ledger's statistical-burn input), or None when no draw was taken.
     """
     if not _obs.guarantees.enabled() or seq % _audit_every():
-        return
+        return None
     fold = model.quant_folds.get(op)
     if fold is None:
-        return
+        return None
     try:
         base, _mode = model.base_kernel(op), model.quantize
         realized = realized_errors(fold.kind, base, head_rows, head_out,
                                    model.host_params)
+        tol = fold.tol(amax_x)
+        attrs = dict(estimator=type(model.estimator).__name__,
+                     mode=fold.mode, amax_x=round(float(amax_x), 6))
+        if tenant is not None:
+            attrs["tenant"] = str(tenant)
         _obs.guarantees.observe(
-            f"serving.quant.{base}", [realized], fold.tol(amax_x),
-            fail_prob=fold.delta, estimator=type(model.estimator).__name__,
-            mode=fold.mode, amax_x=round(float(amax_x), 6))
+            f"serving.quant.{base}", [realized], tol,
+            fail_prob=fold.delta, **attrs)
+        return {"realized": realized, "tol": tol,
+                "violated": bool(realized > tol),
+                "fail_prob": fold.delta}
     except _obs.guarantees.GuaranteeViolationError:
         raise  # strict mode must propagate — that IS the contract check
     except Exception:
-        pass
+        return None

@@ -82,11 +82,19 @@ class ServingModel:
 
     __slots__ = ("estimator", "ops", "n_features", "dtype", "fingerprint",
                  "cacheable", "quantize", "host_params", "quant_folds",
+                 "slo_p50_ms", "slo_p99_ms",
                  "_base_kernels", "_param_sigs", "_group_keys", "_aot_sigs")
 
-    def __init__(self, estimator, fingerprint=None, quantize=None):
+    def __init__(self, estimator, fingerprint=None, quantize=None,
+                 slo_p50_ms=None, slo_p99_ms=None):
         self.estimator = estimator
         self.quantize = _quant.resolve_mode(quantize)
+        #: the tenant's DECLARED latency targets (registration-time;
+        #: None = defer to the dispatcher's run-level targets) — the
+        #: per-tenant slo records and the error-budget ledger
+        #: (obs.budget) burn against these
+        self.slo_p50_ms = None if slo_p50_ms is None else float(slo_p50_ms)
+        self.slo_p99_ms = None if slo_p99_ms is None else float(slo_p99_ms)
         self.ops = {}
         self.quant_folds = {}
         self._base_kernels = {}
@@ -229,9 +237,11 @@ class ModelRegistry:
         self._lock = threading.RLock()
         self._sources = {}
         self._quantize = {}
+        self._slo_targets = {}
         self._resident = collections.OrderedDict()
 
-    def register(self, tenant, source, quantize="env"):
+    def register(self, tenant, source, quantize="env", *,
+                 slo_p50_ms=None, slo_p99_ms=None):
         """Bind ``tenant`` to a checkpoint directory or fitted estimator.
         Replaces any previous binding and evicts the resident copy.
 
@@ -239,7 +249,13 @@ class ModelRegistry:
         exact f32 kernels, bit-identical to PR 9), ``'bf16'``/``'int8'``/
         ``'auto'`` (the quantized route with its declared fold), or the
         default ``"env"`` — defer to ``SQ_SERVE_QUANTIZE`` at resolve
-        time (unset = exact)."""
+        time (unset = exact).
+
+        ``slo_p50_ms``/``slo_p99_ms`` DECLARE the tenant's latency SLO:
+        its per-tenant ``slo`` records and its error-budget burn
+        (:mod:`sq_learn_tpu.obs.budget`) are judged against these
+        instead of the dispatcher's run-level targets (None = inherit
+        them)."""
         tenant = str(tenant)
         if quantize != "env":
             _quant.resolve_mode(quantize)  # validate eagerly, at bind time
@@ -249,6 +265,7 @@ class ModelRegistry:
         with self._lock:
             self._sources[tenant] = source
             self._quantize[tenant] = quantize
+            self._slo_targets[tenant] = (slo_p50_ms, slo_p99_ms)
             self._resident.pop(tenant, None)
         return self
 
@@ -256,6 +273,7 @@ class ModelRegistry:
         with self._lock:
             self._sources.pop(str(tenant), None)
             self._quantize.pop(str(tenant), None)
+            self._slo_targets.pop(str(tenant), None)
             self._resident.pop(str(tenant), None)
 
     def tenants(self):
@@ -287,6 +305,8 @@ class ModelRegistry:
                 raise KeyError(f"tenant {tenant!r} is not registered "
                                f"(known: {sorted(self._sources)})") from None
             quantize = self._quantize.get(tenant, "env")
+            slo_p50_ms, slo_p99_ms = self._slo_targets.get(tenant,
+                                                           (None, None))
         if quantize == "env":
             quantize = _quant.serve_quantize()
         # load OUTSIDE the lock: a cold checkpoint read must not stall
@@ -299,7 +319,9 @@ class ModelRegistry:
             else:
                 fingerprint = None
                 est = source
-            model = ServingModel(est, fingerprint, quantize=quantize)
+            model = ServingModel(est, fingerprint, quantize=quantize,
+                                 slo_p50_ms=slo_p50_ms,
+                                 slo_p99_ms=slo_p99_ms)
         _obs.counter_add("serving.registry_loads", 1)
         with self._lock:
             # another thread may have raced the same cold load; last

@@ -27,8 +27,15 @@ seconds.
 7. a **second process** re-warms a subset of the ladder against the
    same persistent cache directory and must report ≥1 persistent-cache
    hit — the restart-starts-warm claim;
-8. SLO emission + schema validation: the run's JSONL must validate and
-   carry ≥1 ``slo``, ≥1 ``fault``, and ≥1 ``guarantee`` record.
+8. a **forced SLO violation** (ISSUE 12): a tenant registered with an
+   impossible p99 target must burn its error budget in every window —
+   ``alerting`` budget records + an ``alert`` record land at close, a
+   violated per-tenant ``slo`` record carries the evidence, and
+   ``SQ_OBS_BUDGET_STRICT=1`` escalates the same close to a raised
+   ``BudgetBurnError`` (records land first);
+9. SLO emission + schema validation: the run's JSONL must validate and
+   carry ≥1 ``slo``, ≥1 ``fault``, ≥1 ``guarantee``, ≥1 ``budget``,
+   and ≥1 ``alert`` record.
 
 Exit code 0 = contract holds; 1 = violation (printed as JSON). Pins the
 CPU backend in-process first, like every contract smoke.
@@ -203,6 +210,45 @@ def main():
     dq.close()
     del os.environ["SQ_OBS_AUDIT_STRICT"]
 
+    # forced-violation leg (ISSUE 12): a tenant with an impossible p99
+    # target burns its whole latency budget in every window — the close
+    # must emit `alerting` budget records + an `alert` record, and
+    # SQ_OBS_BUDGET_STRICT=1 must escalate the same close to a raise
+    # (records land BEFORE the raise: the artifact carries the
+    # evidence). Same checkpoint as alpha, so the AOT executables are
+    # shared and the zero-compile contract stays armed throughout.
+    from ..obs.budget import BudgetBurnError
+
+    reg.register("hot", alpha_dir, slo_p99_ms=1e-6)
+    dv = MicroBatchDispatcher(reg, background=False, max_batch_rows=128)
+    for _ in range(6):
+        dv.serve("hot", "predict", requests[0][2])
+    dv.close()
+    rec2 = get_recorder()
+    check(any(r.get("alerting") and r.get("tenant") == "hot"
+              for r in rec2.budget_records),
+          "forced SLO violation produced no alerting budget record")
+    check(any(a.get("tenant") == "hot" for a in rec2.alert_records),
+          "forced SLO violation fired no alert record")
+    check(any(r.get("tenant") == "hot" and r.get("violated")
+              for r in rec2.slo_records),
+          "forced violation left no violated per-tenant slo record")
+    os.environ["SQ_OBS_BUDGET_STRICT"] = "1"
+    alerts_before = len(rec2.alert_records)
+    dv2 = MicroBatchDispatcher(reg, background=False, max_batch_rows=128)
+    dv2.serve("hot", "predict", requests[0][2])
+    raised = False
+    try:
+        dv2.close()
+    except BudgetBurnError:
+        raised = True
+    finally:
+        del os.environ["SQ_OBS_BUDGET_STRICT"]
+    check(raised, "SQ_OBS_BUDGET_STRICT=1 did not raise on a tripped "
+                  "burn alert")
+    check(len(rec2.alert_records) > alerts_before,
+          "the strict raise did not land its alert record first")
+
     # the zero-compile contract held through every leg: the jit caches
     # never grew and no pinned site went over its flat 0 budget
     compiles = kernel_cache_sizes()
@@ -242,6 +288,10 @@ def main():
           f"expected >=1 fault record, got {summary['by_type']}")
     check(summary["by_type"].get("guarantee", 0) >= 1,
           f"expected >=1 guarantee record, got {summary['by_type']}")
+    check(summary["by_type"].get("budget", 0) >= 1,
+          f"expected >=1 budget record, got {summary['by_type']}")
+    check(summary["by_type"].get("alert", 0) >= 1,
+          f"expected >=1 alert record, got {summary['by_type']}")
 
     print(json.dumps({
         "serve_smoke": "fail" if failures else "ok",

@@ -1,0 +1,507 @@
+"""Per-tenant error-budget ledger (ISSUE 12, ``sq_learn_tpu.obs.budget``).
+
+The load-bearing contracts: hand-computed rolling-window burn math
+(percentiles, burn fractions, Clopper–Pearson bounds) on synthetic
+sequences with explicit timestamps; multi-window alert suppression (a
+short-window spike diluted over the long window must NOT alert); a
+forced burn producing ``alerting`` budget records + an ``alert`` record
+and raising under ``SQ_OBS_BUDGET_STRICT=1`` AFTER the records land;
+schema-v6 validation (v1–v5 records keep validating); per-tenant ``slo``
+records with tenant-target precedence and the windowed flush; the
+per-tenant trace lanes and report/frontier surfacing; and the
+disabled-path zero-overhead pin — with ``SQ_OBS`` unset the dispatcher
+allocates no ledger and tracks no tenants.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from sq_learn_tpu import obs
+from sq_learn_tpu.obs import budget as budget_mod
+from sq_learn_tpu.obs import frontier
+from sq_learn_tpu.obs.budget import BudgetBurnError, BudgetLedger
+from sq_learn_tpu.obs.guarantees import clopper_pearson_lower
+from sq_learn_tpu.obs.schema import validate_record
+from sq_learn_tpu.models import QKMeans
+from sq_learn_tpu.serving import (MicroBatchDispatcher, ModelRegistry,
+                                  SloTracker)
+from sq_learn_tpu.serving import cache as serve_cache
+
+
+@pytest.fixture(autouse=True)
+def _hygiene():
+    serve_cache.clear()
+    yield
+    serve_cache.clear()
+    if obs.enabled():
+        obs.disable()
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    rng = np.random.default_rng(0)
+    m = 8
+    X = (rng.normal(size=(200, m))
+         + 5.0 * rng.integers(0, 3, size=(200, 1))).astype(np.float32)
+    return {"X": X, "m": m,
+            "qkm": QKMeans(n_clusters=3, random_state=0, n_init=1).fit(X)}
+
+
+# -- burn math (hand-computed) -----------------------------------------------
+
+
+def test_window_stats_latency_burn_hand_computed():
+    """10 requests, 3 over the 100 ms p99 target, 6 over the 10 ms p50
+    target: slo_burn = 3/10 (the p99 budget event), p99 burn rate =
+    0.3/0.01 = 30, p50 burn rate = 0.6/0.5 = 1.2 — the record carries
+    the max (30) — and the window percentiles are the nearest-rank
+    order statistics of the window latencies."""
+    led = BudgetLedger(window_seconds=(60.0, 600.0), threshold=2.0)
+    lats_ms = [1, 2, 5, 8, 20, 30, 50, 200, 300, 400]
+    led.note_requests("t", [v / 1e3 for v in lats_ms], p50_ms=10.0,
+                      p99_ms=100.0, ts=1000.0)
+    s = led.window_stats("t", 60.0, now=1000.0)
+    assert s["requests"] == 10
+    assert s["over_p50"] == 6 and s["over_p99"] == 3
+    assert s["slo_burn"] == pytest.approx(0.3)
+    assert s["slo_burn_rate"] == pytest.approx(30.0)
+    assert s["burn_rate"] == pytest.approx(30.0)
+    # nearest-rank: p50 = 5th of 10 ordered, p99 = ceil(9.9) = 10th
+    assert s["p50_ms"] == pytest.approx(20.0)
+    assert s["p99_ms"] == pytest.approx(400.0)
+    assert s["targets"] == {"p50_ms": 10.0, "p99_ms": 100.0}
+    assert s["alerting"] is True  # 30 >= 2.0
+
+
+def test_window_membership_prunes_and_dilutes():
+    """Events age out of the short window but stay in the long one: a
+    burst of slow requests 5 minutes ago burns the 600 s window, not
+    the 60 s window."""
+    led = BudgetLedger(window_seconds=(60.0, 600.0), threshold=2.0)
+    led.note_requests("t", [0.5] * 4, p99_ms=100.0, ts=700.0)  # slow, old
+    led.note_requests("t", [0.001] * 16, ts=995.0)             # fast, fresh
+    short = led.window_stats("t", 60.0, now=1000.0)
+    long_ = led.window_stats("t", 600.0, now=1000.0)
+    assert short["requests"] == 16 and short["over_p99"] == 0
+    assert short["slo_burn"] == 0.0 and short["alerting"] is False
+    assert long_["requests"] == 20 and long_["over_p99"] == 4
+    assert long_["slo_burn"] == pytest.approx(0.2)
+    assert long_["slo_burn_rate"] == pytest.approx(20.0)
+
+
+def test_multi_window_alert_requires_every_window():
+    """The SRE pattern: a short-window spike whose long-window rate sits
+    below the threshold must NOT alert; sustained burn in both windows
+    must."""
+    led = BudgetLedger(window_seconds=(60.0, 600.0), threshold=50.0)
+    # 100 fast requests long ago + 10 slow now: short window rate = 100
+    # (>= 50), long window rate = 10/110/0.01 ≈ 9.1 (< 50) -> suppressed
+    led.note_requests("spiky", [0.001] * 100, p99_ms=100.0, ts=450.0)
+    led.note_requests("spiky", [0.5] * 10, ts=995.0)
+    assert led.alerts(now=1000.0) == []
+    summary = led.summary(now=1000.0)
+    assert summary["spiky"][60.0]["alerting"] is True
+    assert summary["spiky"][600.0]["alerting"] is False
+    # sustained: every request slow in both windows -> alert fires
+    led2 = BudgetLedger(window_seconds=(60.0, 600.0), threshold=50.0)
+    led2.note_requests("burning", [0.5] * 10, p99_ms=100.0, ts=995.0)
+    alerts = led2.alerts(now=1000.0)
+    assert len(alerts) == 1
+    a = alerts[0]
+    assert a["tenant"] == "burning" and a["kind"] == "slo_burn"
+    assert a["burn_rates"] == {"60s": 100.0, "600s": 100.0}
+
+
+def test_stat_burn_clopper_pearson_hand_computed():
+    """Statistical burn: 4 violated of 20 draws at declared δ=0.05 —
+    stat_burn = 0.2, cp_lower_bound matches the exact binomial bound,
+    and the rate divides by the declared failure probability (never the
+    raw fraction — one unlucky draw must not alarm)."""
+    led = BudgetLedger(window_seconds=(60.0,), threshold=2.0)
+    for i in range(20):
+        led.note_draw("t", violated=(i < 4), fail_prob=0.05, ts=999.0)
+    s = led.window_stats("t", 60.0, now=1000.0)
+    assert s["draws"] == 20 and s["draw_violations"] == 4
+    assert s["stat_burn"] == pytest.approx(0.2)
+    cp = clopper_pearson_lower(4, 20)
+    assert s["cp_lower_bound"] == pytest.approx(cp, abs=1e-6)
+    assert s["stat_burn_rate"] == pytest.approx(cp / 0.05, rel=1e-4)
+    assert s["burn_rate"] == s["stat_burn_rate"]  # no latency targets
+    # a single violated draw of many: CP bound ~0 -> no burn signal
+    led2 = BudgetLedger(window_seconds=(60.0,), threshold=2.0)
+    for i in range(200):
+        led2.note_draw("t", violated=(i == 0), fail_prob=0.05, ts=999.0)
+    s2 = led2.window_stats("t", 60.0, now=1000.0)
+    assert s2["stat_burn_rate"] < 1.0 and s2["alerting"] is False
+
+
+def test_zero_fail_prob_burn_rate_is_capped_not_inf():
+    led = BudgetLedger(window_seconds=(60.0,))
+    led.note_draw("t", violated=True, fail_prob=0.0, ts=999.0)
+    s = led.window_stats("t", 60.0, now=1000.0)
+    assert s["stat_burn_rate"] == budget_mod.MAX_BURN_RATE
+    assert json.loads(json.dumps(s))  # records stay JSON-portable
+
+
+def test_undeclared_tenant_has_no_burn_rate():
+    led = BudgetLedger(window_seconds=(60.0,))
+    led.note_requests("t", [0.5] * 5, ts=999.0)  # no targets declared
+    s = led.window_stats("t", 60.0, now=1000.0)
+    assert s["slo_burn"] is None and s["burn_rate"] is None
+    assert s["alerting"] is False
+    assert led.alerts(now=1000.0) == []
+
+
+# -- emission / strict escalation --------------------------------------------
+
+
+def test_forced_burn_emits_records_then_strict_raises(monkeypatch):
+    led = BudgetLedger(window_seconds=(60.0, 600.0), threshold=2.0,
+                       site="serving.test")
+    led.note_requests("hot", [0.5] * 8, p99_ms=1e-6, ts=999.0)
+    rec = obs.enable()
+    summary, alerts = led.emit(now=1000.0)
+    assert len(rec.budget_records) == 2  # one per window
+    assert all(r["tenant"] == "hot" and r["alerting"]
+               for r in rec.budget_records)
+    assert len(rec.alert_records) == 1
+    assert rec.alert_records[0]["kind"] == "slo_burn"
+    for r in rec.budget_records + rec.alert_records:
+        assert validate_record(r) == [], r
+    # strict: the raise happens AFTER the records land
+    monkeypatch.setenv("SQ_OBS_BUDGET_STRICT", "1")
+    with pytest.raises(BudgetBurnError, match="hot"):
+        led.emit(now=1000.0)
+    assert len(rec.budget_records) == 4 and len(rec.alert_records) == 2
+    obs.disable()
+    # no recorder: emit still evaluates (and still raises under strict)
+    with pytest.raises(BudgetBurnError):
+        led.emit(now=1000.0)
+
+
+def test_budget_records_validate_and_bad_ones_reject():
+    good = {"v": 6, "schema_version": 6, "ts": 0.0, "type": "budget",
+            "tenant": "t", "window_s": 60.0, "slo_burn": 0.1,
+            "stat_burn": None, "cp_lower_bound": None, "burn_rate": 10.0,
+            "alerting": True, "requests": 5}
+    assert validate_record(good) == []
+    bad = dict(good, window_s=0)
+    assert any("window_s" in e for e in validate_record(bad))
+    bad = dict(good, slo_burn=1.5)
+    assert any("slo_burn" in e for e in validate_record(bad))
+    bad = {k: v for k, v in good.items() if k != "alerting"}
+    assert any("alerting" in e for e in validate_record(bad))
+    alert = {"v": 6, "schema_version": 6, "ts": 0.0, "type": "alert",
+             "tenant": "t", "kind": "slo_burn", "threshold": 2.0,
+             "burn_rates": {"60s": 100.0}}
+    assert validate_record(alert) == []
+    assert any("burn_rates" in e
+               for e in validate_record(dict(alert, burn_rates=None)))
+
+
+def test_legacy_versions_still_validate_and_v6_slo_fields():
+    v1 = {"v": 1, "ts": 0.0, "type": "span", "name": "s", "seq": 1,
+          "dur_s": 0.1, "depth": 0, "parent": None, "synced": False}
+    assert validate_record(v1) == []
+    v5 = {"v": 5, "schema_version": 5, "ts": 0.0, "type": "slo",
+          "site": "s", "requests": 1, "p50_ms": 1.0, "p99_ms": 2.0,
+          "qps": 3.0, "batch_occupancy": 0.5, "degraded": 0,
+          "violated": False, "transfer_bytes": 10}
+    assert validate_record(v5) == []
+    v6 = dict(v5, v=6, schema_version=6, tenant="a",
+              stages={"queue": 0.1, "compute": 0.2})
+    assert validate_record(v6) == []
+    assert any("stages" in e for e in validate_record(
+        dict(v6, stages={"queue": -1.0})))
+    assert any("tenant" in e for e in validate_record(dict(v6, tenant=3)))
+    assert any("unknown schema version" in e
+               for e in validate_record(dict(v5, v=7, schema_version=7)))
+
+
+# -- SloTracker: per-tenant records, windowed flush ---------------------------
+
+
+def test_slo_tracker_per_tenant_records_and_target_precedence():
+    obs.enable()
+    rec = obs.get_recorder()
+    tr = SloTracker("serving.test", slo_p50_ms=1e4, slo_p99_ms=1e4)
+    t0 = tr.note_submit(ts=100.0)
+    # tenant "a" declares its own (tight) targets; "b" inherits the run's
+    tr.note_batch_done([t0], t0 + 0.05, 4, 8, False, tenant="a",
+                       targets=(1e-3, 1e-3),
+                       stages={"queue": 0.01, "compute": 0.04})
+    tr.note_batch_done([t0, t0], t0 + 0.02, 6, 8, True, tenant="b",
+                       targets=(None, None), nbytes=128)
+    tenants = tr.tenant_summaries()
+    assert set(tenants) == {"a", "b"}
+    assert tenants["a"]["tenant"] == "a"
+    assert tenants["a"]["requests"] == 1
+    assert tenants["a"]["violated"] is True  # 50 ms > 1e-3 ms target
+    assert tenants["a"]["targets"] == {"p50_ms": 1e-3, "p99_ms": 1e-3}
+    assert tenants["a"]["stages"] == {"compute": 0.04, "queue": 0.01}
+    assert tenants["b"]["violated"] is False  # inherits the loose run SLO
+    assert tenants["b"]["requests"] == 2 and tenants["b"]["degraded"] == 1
+    summary = tr.emit()
+    # per-tenant records land before the aggregate, all schema-valid
+    assert [r.get("tenant") for r in rec.slo_records] == ["a", "b", None]
+    for r in rec.slo_records:
+        assert validate_record(r) == [], r
+    assert summary["requests"] == 3
+    assert summary["stages"]["queue"] == pytest.approx(0.01)
+
+
+def test_slo_windowed_flush_resets_and_marks():
+    obs.enable()
+    rec = obs.get_recorder()
+    tr = SloTracker("serving.test")
+    t0 = tr.note_submit(ts=10.0)
+    tr.note_batch_done([t0], t0 + 0.01, 2, 8, False, tenant="a")
+    w1 = tr.flush_window()
+    assert w1["requests"] == 1
+    assert rec.slo_records[-1]["attrs"] == {"windowed": True,
+                                            "flush_seq": 1}
+    assert validate_record(rec.slo_records[-1]) == []
+    assert tr.flush_window() is None  # window empty after reset
+    tr.note_batch_done([t0], t0 + 0.03, 2, 8, False, tenant="a")
+    w2 = tr.flush_window()
+    assert w2["requests"] == 1 and w2["attrs"]["flush_seq"] == 2
+    # the run aggregate still carries everything
+    assert tr.summary()["requests"] == 2
+
+
+# -- dispatcher integration ---------------------------------------------------
+
+
+def test_dispatcher_attributes_tenants_and_burns(fitted, monkeypatch):
+    monkeypatch.setenv("SQ_OBS_BUDGET_STRICT", "1")
+    rec = obs.enable()
+    reg = ModelRegistry()
+    reg.register("ok", fitted["qkm"], slo_p50_ms=5e3, slo_p99_ms=1e4)
+    reg.register("hot", fitted["qkm"], slo_p99_ms=1e-6)  # impossible
+    d = MicroBatchDispatcher(reg, background=False)
+    for i in range(4):
+        d.serve("ok", "predict", fitted["X"][: 2 + i])
+        d.serve("hot", "predict", fitted["X"][:3])
+    with pytest.raises(BudgetBurnError, match="hot"):
+        d.close()
+    # the evidence landed before the raise: per-tenant slo records with
+    # the declared targets, per-window budget records, and the alert
+    tenants = {r.get("tenant") for r in rec.slo_records}
+    assert {"ok", "hot"} <= tenants
+    hot_slo = next(r for r in rec.slo_records if r.get("tenant") == "hot")
+    assert hot_slo["violated"] is True
+    assert hot_slo["targets"]["p99_ms"] == 1e-6
+    assert "stages" in hot_slo and "compute" in hot_slo["stages"]
+    led = d.budget_ledger()
+    assert led.total_requests("ok") == 4 and led.total_requests("hot") == 4
+    hot_budget = [r for r in rec.budget_records if r["tenant"] == "hot"]
+    assert hot_budget and all(r["alerting"] for r in hot_budget)
+    ok_budget = [r for r in rec.budget_records if r["tenant"] == "ok"]
+    assert ok_budget and not any(r["alerting"] for r in ok_budget)
+    assert any(a["tenant"] == "hot" for a in rec.alert_records)
+    for r in rec.budget_records + rec.alert_records + rec.slo_records:
+        assert validate_record(r) == [], r
+
+
+def test_dispatcher_counts_cache_hits_per_tenant(fitted):
+    rec = obs.enable()
+    reg = ModelRegistry()
+    reg.register("a", fitted["qkm"])
+    d = MicroBatchDispatcher(reg, background=False)
+    r = fitted["X"][:4]
+    d.serve("a", "transform", r)
+    d.serve("a", "transform", r)  # result-cache hit
+    d.close()
+    assert rec.counters.get("serving.cache_hits", 0) >= 1
+    # the cache hit is still billed to the tenant: no attribution leak
+    assert d.budget_ledger().total_requests("a") == 2
+    agg = next(r_ for r_ in rec.slo_records if r_.get("tenant") is None)
+    ten = next(r_ for r_ in rec.slo_records if r_.get("tenant") == "a")
+    assert ten["requests"] == agg["requests"] == 2
+
+
+def test_periodic_flush_emits_windows_and_budgets(fitted, monkeypatch):
+    monkeypatch.setenv("SQ_SERVE_SLO_FLUSH_BATCHES", "2")
+    rec = obs.enable()
+    reg = ModelRegistry()
+    reg.register("a", fitted["qkm"], slo_p99_ms=1e4)
+    d = MicroBatchDispatcher(reg, background=False)
+    for i in range(6):
+        d.serve("a", "predict", fitted["X"][: 2 + i])
+    # windowed slo records landed BEFORE close (the crash-resilience
+    # satellite: a long-running server emits windows continuously)
+    windowed = [r for r in rec.slo_records
+                if (r.get("attrs") or {}).get("windowed")]
+    assert len(windowed) >= 2
+    assert rec.budget_records, "periodic flush emitted no budget records"
+    pre_close = len(rec.budget_records)
+    d.close()
+    assert len(rec.budget_records) > pre_close  # close emits the final set
+
+
+# -- disabled-path zero overhead ---------------------------------------------
+
+
+def test_disabled_path_allocates_no_tenant_state(fitted):
+    """The ISSUE 12 invariant: SQ_OBS unset ⇒ the serving hot path is
+    byte-identical — no ledger, no per-tenant accumulators, no window
+    accumulators, no stage stamps."""
+    obs.disable()
+    reg = ModelRegistry()
+    reg.register("a", fitted["qkm"], slo_p50_ms=1.0, slo_p99_ms=1.0)
+    d = MicroBatchDispatcher(reg, background=False)
+    for i in range(4):
+        d.serve("a", "predict", fitted["X"][: 2 + i])
+    slo = d.close()
+    assert d.budget_ledger() is None
+    assert d.slo.tenant_summaries() == {}
+    assert d.slo._win.batches == 0 and d.slo._win.latencies_s == []
+    assert "stages" not in slo and "tenant" not in slo
+    # declared-but-unobserved targets never raise either (no strict env)
+    assert slo["requests"] == 4
+
+
+def test_disabled_note_paths_stay_cheap():
+    """note_batch_done with no tenant and no recorder must do exactly
+    the pre-PR-12 work — the micro-bound is loose against host noise
+    but catches an accidental window/tenant allocation."""
+    import time
+
+    obs.disable()
+    tr = SloTracker("serving.micro")
+    t0 = 0.0
+    n = 20_000
+    start = time.perf_counter()
+    for _ in range(n):
+        tr.note_batch_done([t0], t0 + 0.001, 4, 8, False)
+    elapsed = time.perf_counter() - start
+    assert tr._win.batches == 0 and tr._tenants == {}
+    assert elapsed < 2.0, f"disabled-mode slo overhead: {elapsed:.3f}s"
+
+
+# -- registry plumbing --------------------------------------------------------
+
+
+def test_registry_slo_targets_reach_model_and_rebind_clears(fitted):
+    reg = ModelRegistry()
+    reg.register("a", fitted["qkm"], slo_p50_ms=10.0, slo_p99_ms=20.0)
+    model = reg.resolve("a")
+    assert model.slo_p50_ms == 10.0 and model.slo_p99_ms == 20.0
+    reg.register("a", fitted["qkm"])  # rebind without targets
+    model = reg.resolve("a")
+    assert model.slo_p50_ms is None and model.slo_p99_ms is None
+
+
+# -- surfacing: trace lanes, report, frontier, CLI ---------------------------
+
+
+def _forced_burn_artifact(tmp_path, fitted):
+    path = str(tmp_path / "burn.jsonl")
+    obs.enable(path)
+    reg = ModelRegistry()
+    reg.register("hot", fitted["qkm"], slo_p99_ms=1e-6)
+    d = MicroBatchDispatcher(reg, background=False)
+    for _ in range(3):
+        d.serve("hot", "predict", fitted["X"][:3])
+    d.close()
+    obs.disable()
+    return path
+
+
+def test_trace_puts_tenant_records_on_tenant_lanes(tmp_path, fitted):
+    from sq_learn_tpu.obs.trace import load_jsonl, to_chrome_trace
+
+    path = _forced_burn_artifact(tmp_path, fitted)
+    trace = to_chrome_trace([("burn", load_jsonl(path))])
+    lanes = {e["args"]["name"] for e in trace["traceEvents"]
+             if e.get("name") == "thread_name"}
+    assert "tenant:hot" in lanes
+    tenant_tid = next(
+        e["tid"] for e in trace["traceEvents"]
+        if e.get("name") == "thread_name"
+        and e["args"]["name"] == "tenant:hot")
+    kinds = {e["cat"] for e in trace["traceEvents"]
+             if e.get("tid") == tenant_tid and e["ph"] == "i"}
+    assert {"budget", "slo", "alert"} <= kinds
+    # the aggregate slo record stays on the shared slo lane
+    assert "serving slo" in lanes
+
+
+def test_report_renders_tenant_budget_sections(tmp_path, fitted, capsys):
+    from sq_learn_tpu.obs import report
+
+    path = _forced_burn_artifact(tmp_path, fitted)
+    assert report.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "tenant error budgets" in out
+    assert "ALERTING" in out and "ALERT hot" in out
+    assert "effective (eps, delta) per tenant" in out
+    assert "stages:" in out
+
+
+def test_budget_cli_exit_codes(tmp_path, fitted, capsys):
+    path = _forced_burn_artifact(tmp_path, fitted)
+    assert budget_mod.main([path]) == 1  # an alert fired
+    out = capsys.readouterr().out
+    assert "hot" in out and "ALERT" in out
+    assert budget_mod.main([path, "--json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["burning"] is True and doc["alerts"]
+    clean = str(tmp_path / "clean.jsonl")
+    with open(clean, "w") as fh:
+        fh.write(json.dumps({
+            "v": 6, "schema_version": 6, "ts": 0.0, "type": "budget",
+            "tenant": "t", "window_s": 60.0, "slo_burn": 0.0,
+            "stat_burn": None, "cp_lower_bound": None, "burn_rate": 0.0,
+            "alerting": False}) + "\n")
+    assert budget_mod.main([clean]) == 0
+    capsys.readouterr()
+
+
+def test_frontier_effective_contracts_hand_computed():
+    def draw(realized, violated, tenant="t", tol=0.5, fp=0.1):
+        return {"type": "guarantee", "site": "serving.quant.k",
+                "realized": realized, "tol": tol, "violated": violated,
+                "fail_prob": fp, "attrs": {"tenant": tenant}}
+
+    records = ([draw(0.1 * i, False) for i in range(1, 10)]
+               + [draw(0.95, True)]
+               + [{"type": "guarantee", "site": "fit.site",
+                   "realized": 0.0, "tol": 1.0, "violated": False,
+                   "fail_prob": None}])  # no tenant attr: skipped
+    eff = frontier.effective_contracts(records)
+    assert set(eff) == {"t"}
+    e = eff["t"]
+    assert e["draws"] == 10 and e["violations"] == 1
+    assert e["delta_declared"] == 0.1
+    assert e["delta_lower_bound"] == pytest.approx(
+        clopper_pearson_lower(1, 10), abs=1e-9)
+    assert e["eps_declared"] == 0.5
+    assert e["eps_max"] == pytest.approx(0.95)
+    # (1 - 0.1)-quantile nearest-rank of 10 ordered draws = the 9th
+    assert e["eps_effective"] == pytest.approx(0.9)
+    assert e["sites"] == ["serving.quant.k"]
+    text = frontier.render_effective(eff)
+    assert "t" in text and "delta_lcb" in text
+
+
+def test_quant_draws_carry_tenant_and_burn(fitted, monkeypatch):
+    monkeypatch.setenv("SQ_SERVE_AUDIT_EVERY", "1")
+    rec = obs.enable()
+    reg = ModelRegistry()
+    reg.register("q", fitted["qkm"], quantize="bf16", slo_p99_ms=1e4)
+    d = MicroBatchDispatcher(reg, background=False)
+    for _ in range(3):
+        d.serve("q", "predict", fitted["X"][:4])
+    d.close()
+    draws = [g for g in rec.guarantee_records
+             if (g.get("attrs") or {}).get("tenant") == "q"]
+    assert draws, "quantized serving drew no tenant-attributed audits"
+    led = d.budget_ledger()
+    s = led.window_stats("q", led.windows[0])
+    assert s["draws"] == len(draws)
+    assert s["fail_prob"] == draws[0]["fail_prob"]
+    eff = frontier.effective_contracts(rec.guarantee_records)
+    assert "q" in eff and eff["q"]["draws"] == len(draws)

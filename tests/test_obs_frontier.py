@@ -80,7 +80,8 @@ class TestCLI:
         assert "sweep_a" in out and "frontier" in out
         assert frontier.main([path, "--json"]) == 0
         doc = json.loads(capsys.readouterr().out)
-        assert doc["sweep_a"]["pareto"] == [0]
+        assert doc["sweeps"]["sweep_a"]["pareto"] == [0]
+        assert doc["effective"] == {}  # no tenant-attributed draws
 
     def test_frontier_cli_empty_artifact_exits_1(self, tmp_path, capsys):
         path = str(tmp_path / "empty.jsonl")
