@@ -118,13 +118,16 @@ faults-smoke:
 regress-selftest:
 	$(PYTHON) -m sq_learn_tpu.obs regress --selftest
 
-# Out-of-core smoke: tiny shard store -> fault-injected multi-epoch fit
-# WITH the shard readahead prefetcher enabled (read_fail + corrupt_shard
-# fire on worker threads, absorbed with bit parity vs the serial
-# depth-0 reference) -> REAL subprocess SIGKILL mid-epoch mid-prefetch
-# -> resume from the mid-epoch checkpoint -> bit-parity assert vs the
-# uninterrupted fit, plus schema validation of the read-fault JSONL and
-# the prefetch counters. The CI-runnable contract check for
+# Out-of-core smoke: tiny shard store + its lz4-compressed twin ->
+# fault-injected multi-epoch fit over the COMPRESSED store WITH the
+# shard readahead prefetcher enabled (read_fail + corrupt_shard fire on
+# worker threads, the stored-payload corruption is caught by the
+# compressed-bytes CRC before decode, absorbed with bit parity vs the
+# uncompressed serial depth-0 reference) -> REAL subprocess SIGKILL
+# mid-epoch mid-prefetch on the compressed store -> resume from the
+# mid-epoch checkpoint -> bit-parity assert vs the uninterrupted fit,
+# plus schema validation of the read-fault JSONL and the
+# prefetch/codec counters. The CI-runnable contract check for
 # sq_learn_tpu.oocore.
 oocore-smoke:
 	env SQ_OBS=1 SQ_OBS_PATH=/tmp/sq_oocore_smoke.jsonl \
@@ -138,7 +141,10 @@ oocore-smoke:
 # loads, mixed-size/type/tenant load with estimator parity, result-cache
 # hit, one absorbed transfer fault with bit parity, quantized responses
 # within the declared (ε, δ) fold on EVERY request under
-# SQ_OBS_AUDIT_STRICT=1, >=1 persistent-cache hit in a second process,
+# SQ_OBS_AUDIT_STRICT=1, a feature-cache spill leg (RAM eviction ->
+# compressed disk entry -> digest-verified disk hit -> FRESH process
+# replays the same bytes off disk with zero jit compiles), >=1
+# persistent-cache hit in a second process,
 # and schema validation of the emitted JSONL incl. >=1 `slo` +
 # `guarantee` record. The CI-runnable contract check for
 # sq_learn_tpu.serving.

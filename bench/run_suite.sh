@@ -171,18 +171,23 @@ env -u PYTHONPATH timeout 60 python -m sq_learn_tpu.obs frontier \
   || echo "# (no tradeoff records this run)" >> "$obs_dir/frontier.txt"
 
 # BASELINE acceptance gate (bench/_gate.py: vs_baseline >= 0.5 on every
-# line, 12 measured + 2 derived lines expected — the sixth measured line
+# line, 14 measured + 2 derived lines expected — the sixth measured line
 # is the streaming-ingest smoke config, whose baseline is the monolithic
 # ingest of the same fit; the seventh is the PR 6 fused-fit config
 # (classical 70k×784 q-means vs sklearn on the SAME δ=0 configuration);
 # the eighth is the PR 8 out-of-core config, whose baseline is the
 # in-RAM fit of the same store — vs_baseline >= 0.5 reads "fitting from
 # disk under a RAM budget costs at most 2x residency";
-# the ninth through twelfth are the PR 9/11 serving load bench's quad
-# (sustained micro-batched QPS vs the sequential per-request arm, p99
-# vs the same, the AOT-warmed cold-start-p99 ratio vs the unwarmed arm
-# — its own floor is 5.0 via the vs_baseline regression gate — and the
-# bf16 bytes ratio vs the f32 arm, floor 1.8 ⇔ "quantized moves
+# the ninth and tenth are the PR 13 compressed-store pair out of the
+# same bench (bytes-on-disk ratio of the pixel-kind store, vs_baseline
+# = raw/stored with floor 1.4 ⇔ ratio ≤ 0.7; and the cold-tier fit
+# pair, vs_baseline = uncompressed/compressed under the same injected
+# tier profile with floor 0.95 — fewer bytes ⇔ less tier time);
+# the eleventh through fourteenth are the PR 9/11 serving load bench's
+# quad (sustained micro-batched QPS vs the sequential per-request arm,
+# p99 vs the same, the AOT-warmed cold-start-p99 ratio vs the unwarmed
+# arm — its own floor is 5.0 via the vs_baseline regression gate — and
+# the bf16 bytes ratio vs the f32 arm, floor 1.8 ⇔ "quantized moves
 # ≤ 0.55× the bytes"); the derived pair is bench_ipe_digits and the
 # sharded-scaling smoke config; missing/null = fail). This
 # script is where the bar is enforced — the unit suite only warns, since
@@ -191,7 +196,7 @@ env -u PYTHONPATH timeout 60 python -m sq_learn_tpu.obs frontier \
 # pre-imports jax via the axon sitecustomize and would hang on a wedged
 # relay even though this step only parses JSON; -m bench._gate resolves
 # via cwd, which is the repo root here)
-env -u PYTHONPATH timeout 60 python -m bench._gate "$out" 12 2
+env -u PYTHONPATH timeout 60 python -m bench._gate "$out" 14 2
 gate_rc=$?
 echo "# acceptance gate rc=$gate_rc" >> "$out"
 echo "done: $out"

@@ -10,6 +10,12 @@ native layer the reference builds in Cython/C++ (SURVEY §2.2):
   ``utils/src/MurmurHash3.cpp``; ours re-implements the public algorithm).
 - :func:`csv_read_floats` — threaded float-CSV ingest for large host-side
   datasets (CICIDS et al.).
+- :func:`crc32` — zlib-identical CRC-32 at PCLMUL speed (the oocore
+  shard-verify fast path).
+- :func:`lz4_compress` / :func:`compress_array` — the LZ4-class block
+  codec behind compressed shard stores (``SQ_OOC_CODEC=lz4``) and the
+  serving feature-cache spill tier, with a byte-identical pure-Python
+  fallback (same greedy matcher — streams, not just values, match).
 
 The shared library is compiled on first use with ``g++`` and cached next to
 the source; every entry point has a NumPy fallback so the package works on
@@ -147,6 +153,14 @@ def _load():
         lib.crc32_fast.restype = ctypes.c_uint32
         lib.crc32_fast.argtypes = [ctypes.c_void_p, ctypes.c_int64,
                                    ctypes.c_uint32]
+        lib.lz4_bound.restype = ctypes.c_int64
+        lib.lz4_bound.argtypes = [ctypes.c_int64]
+        lib.lz4_compress.restype = ctypes.c_int64
+        lib.lz4_compress.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                     ctypes.c_void_p, ctypes.c_int64]
+        lib.lz4_decompress.restype = ctypes.c_int64
+        lib.lz4_decompress.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                       ctypes.c_void_p, ctypes.c_int64]
         _lib = lib
         return _lib
 
@@ -572,6 +586,265 @@ def crc32(data, value=0):
 
 
 # ---------------------------------------------------------------------------
+# LZ4-class block codec (sq-lz)
+# ---------------------------------------------------------------------------
+#
+# The byte-stream codec behind the compressed shard store
+# (``SQ_OOC_CODEC=lz4``, ``oocore/store.py``) and the serving feature-cache
+# spill tier (``serving/cache.py``). Standard LZ4 block format compressed by
+# a deliberately minimal greedy matcher (single-slot 2^16 hash, insert at
+# every scanned position, forward extension only) so this pure-Python
+# portable fallback produces BYTE-IDENTICAL streams to the C++ kernel — a
+# store written by either path re-opens under the other with the same
+# manifest CRCs (cross-parity pinned by ``tests/test_native.py``).
+
+_LZ_MFLIMIT = 12   # no match search this close to the end
+_LZ_LASTLIT = 5    # the final 5 bytes stay literal
+_LZ_HBITS = 16
+
+#: in-band filter codes of :func:`compress_array` payloads (header byte 0)
+_ENC_PLAIN, _ENC_SHUFFLE, _ENC_RAW = 0, 1, 2
+
+
+def lz4_bound(n):
+    """Worst-case compressed size for ``n`` input bytes."""
+    n = int(n)
+    return n + n // 255 + 16
+
+
+def _as_u8(data):
+    """A C-contiguous uint8 view/copy of a bytes-like or ndarray."""
+    if isinstance(data, np.ndarray):
+        buf = np.ascontiguousarray(data)
+        return buf.reshape(-1).view(np.uint8) if buf.size else \
+            np.empty(0, np.uint8)
+    return np.frombuffer(data, np.uint8)
+
+
+def lz4_compress(data):
+    """Compress a bytes-like/ndarray buffer into an LZ4 block (bytes).
+
+    Native path: the C++ greedy matcher; fallback: the byte-identical
+    pure-Python twin (slow — fallback hosts trade speed, never format).
+    """
+    flat = _as_u8(data)
+    n = flat.size
+    if n == 0:
+        return b""
+    lib = _load()
+    if lib is not None:
+        out = np.empty(lz4_bound(n), np.uint8)
+        got = lib.lz4_compress(flat.ctypes.data, n, out.ctypes.data,
+                               out.size)
+        if got >= 0:
+            return out[:got].tobytes()
+    return _lz4_compress_py(flat.tobytes())
+
+
+def lz4_decompress(data, raw_n):
+    """Decompress an LZ4 block into a writable uint8 array of ``raw_n``
+    bytes. Raises ``ValueError`` on malformed input (both paths bounds-
+    check every read/write — corrupt bytes surface as errors, never as
+    overruns)."""
+    flat = _as_u8(data)
+    raw_n = int(raw_n)
+    if raw_n == 0:
+        if flat.size:
+            raise ValueError("malformed LZ4 block: bytes after empty raw")
+        return np.empty(0, np.uint8)
+    lib = _load()
+    if lib is not None:
+        out = np.empty(raw_n, np.uint8)
+        got = lib.lz4_decompress(flat.ctypes.data, flat.size,
+                                 out.ctypes.data, raw_n)
+        if got != raw_n:
+            raise ValueError(
+                f"malformed LZ4 block ({flat.size} bytes for {raw_n} raw)")
+        return out
+    return np.frombuffer(_lz4_decompress_py(flat.tobytes(), raw_n),
+                         np.uint8).copy()
+
+
+def _lz4_compress_py(src):
+    """Pure-Python twin of the C++ ``lz4_compress`` — same greedy matcher,
+    byte-identical output (pinned by tests)."""
+    n = len(src)
+    out = bytearray()
+    if n == 0:
+        return bytes(out)
+    table = [-1] * (1 << _LZ_HBITS)
+    pos = anchor = 0
+    limit = n - _LZ_MFLIMIT
+
+    def emit(lit, mlen_m4, off):
+        out.append((min(lit, 15) << 4) | (min(mlen_m4, 15) if off else 0))
+        rem = lit - 15
+        while rem >= 0:
+            out.append(min(rem, 255))
+            if rem < 255:
+                break
+            rem -= 255
+        out.extend(src[anchor:anchor + lit])
+        if off:
+            out.append(off & 0xFF)
+            out.append(off >> 8)
+            rem = mlen_m4 - 15
+            while rem >= 0:
+                out.append(min(rem, 255))
+                if rem < 255:
+                    break
+                rem -= 255
+
+    while pos <= limit:
+        seq = src[pos:pos + 4]
+        h = ((int.from_bytes(seq, "little") * 2654435761)
+             & 0xFFFFFFFF) >> (32 - _LZ_HBITS)
+        cand = table[h]
+        table[h] = pos
+        if cand >= 0 and pos - cand <= 0xFFFF and src[cand:cand + 4] == seq:
+            mlen = 4
+            end = n - _LZ_LASTLIT
+            while pos + mlen < end and src[pos + mlen] == src[cand + mlen]:
+                mlen += 1
+            emit(pos - anchor, mlen - 4, pos - cand)
+            pos += mlen
+            anchor = pos
+        else:
+            pos += 1
+    emit(n - anchor, 0, 0)
+    return bytes(out)
+
+
+def _lz4_decompress_py(buf, raw_n):
+    """Pure-Python twin of the C++ ``lz4_decompress`` (same bounds checks,
+    ``ValueError`` on any malformed input)."""
+    n = len(buf)
+    out = bytearray(raw_n)
+    ip = op = 0
+    while ip < n:
+        token = buf[ip]
+        ip += 1
+        lit = token >> 4
+        if lit == 15:
+            while True:
+                if ip >= n:
+                    raise ValueError("truncated literal length")
+                b = buf[ip]
+                ip += 1
+                lit += b
+                if b != 255:
+                    break
+        if ip + lit > n or op + lit > raw_n:
+            raise ValueError("literal overrun")
+        out[op:op + lit] = buf[ip:ip + lit]
+        ip += lit
+        op += lit
+        if ip >= n:
+            break  # final literal-only sequence
+        if ip + 2 > n:
+            raise ValueError("truncated match offset")
+        off = buf[ip] | (buf[ip + 1] << 8)
+        ip += 2
+        if off == 0 or off > op:
+            raise ValueError("bad match offset")
+        mlen = (token & 0xF) + 4
+        if (token & 0xF) == 15:
+            while True:
+                if ip >= n:
+                    raise ValueError("truncated match length")
+                b = buf[ip]
+                ip += 1
+                mlen += b
+                if b != 255:
+                    break
+        if op + mlen > raw_n:
+            raise ValueError("match overrun")
+        src_i = op - off
+        for k in range(mlen):
+            out[op + k] = out[src_i + k]
+        op += mlen
+    if op != raw_n:
+        raise ValueError(f"decompressed {op} of {raw_n} bytes")
+    return bytes(out)
+
+
+def byte_shuffle(arr):
+    """Blosc-style byte-plane transpose: itemsize-w elements become w
+    contiguous byte planes (plane k = byte k of every element, row-major).
+    Groups the low-entropy bytes of float data (sign/exponent, shared
+    high mantissa bits) into long matchable runs the LZ4 matcher can see;
+    which filter wins is data-dependent, so :func:`compress_array` tries
+    both and keeps the smaller. Vectorized numpy both ways — no native
+    dependency, no parity risk."""
+    flat = _as_u8(arr)
+    w = arr.dtype.itemsize if isinstance(arr, np.ndarray) else 1
+    if w == 1 or flat.size == 0:
+        return flat.copy()
+    return np.ascontiguousarray(flat.reshape(-1, w).T).reshape(-1)
+
+
+def byte_unshuffle(flat, itemsize):
+    """Inverse of :func:`byte_shuffle` (returns a contiguous uint8
+    array)."""
+    flat = _as_u8(flat)
+    w = int(itemsize)
+    if w == 1 or flat.size == 0:
+        return flat.copy()
+    if flat.size % w:
+        raise ValueError(f"{flat.size} bytes is not a multiple of "
+                         f"itemsize {w}")
+    return np.ascontiguousarray(flat.reshape(w, -1).T).reshape(-1)
+
+
+def compress_array(arr):
+    """Codec payload for one array: a 1-byte in-band filter header
+    (0 = plain LZ4, 1 = byte-shuffled LZ4, 2 = stored raw) + body.
+
+    Tries the plain and byte-shuffled LZ4 streams and keeps the smaller;
+    a shard that compresses to >= its raw size stores raw (+1 header
+    byte) — incompressible data costs one byte, never a blowup. The
+    choice is deterministic (both candidates are), so rebuild
+    bit-identity (``oocore/store.py``) holds through the codec.
+    """
+    a = np.ascontiguousarray(arr)
+    raw = _as_u8(a)
+    best, code = lz4_compress(raw), _ENC_PLAIN
+    if a.dtype.itemsize > 1 and a.size:
+        shuffled = lz4_compress(byte_shuffle(a))
+        if len(shuffled) < len(best):
+            best, code = shuffled, _ENC_SHUFFLE
+    if len(best) >= raw.size:
+        return bytes([_ENC_RAW]) + raw.tobytes()
+    return bytes([code]) + best
+
+
+def decompress_array(payload, dtype, shape):
+    """Decode a :func:`compress_array` payload back to the exact array
+    (bit-identical round trip). Raises ``ValueError`` on malformed
+    payloads — including a decoded size that disagrees with
+    ``dtype``/``shape``."""
+    dtype = np.dtype(dtype)
+    shape = tuple(int(s) for s in shape)
+    raw_n = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+    buf = _as_u8(payload)
+    if buf.size == 0:
+        raise ValueError("empty codec payload")
+    code, body = int(buf[0]), buf[1:]
+    if code == _ENC_RAW:
+        if body.size != raw_n:
+            raise ValueError(
+                f"raw payload is {body.size} bytes, expected {raw_n}")
+        flat = body.copy()
+    elif code == _ENC_PLAIN:
+        flat = lz4_decompress(body, raw_n)
+    elif code == _ENC_SHUFFLE:
+        flat = byte_unshuffle(lz4_decompress(body, raw_n), dtype.itemsize)
+    else:
+        raise ValueError(f"unknown codec filter byte {code}")
+    return flat.view(dtype).reshape(shape)
+
+
+# ---------------------------------------------------------------------------
 # MurmurHash3
 # ---------------------------------------------------------------------------
 
@@ -799,4 +1072,6 @@ def _stream_batches(path, batch_rows, delimiter, skip_header, n_cols):
 __all__ = ["native_available", "crc32", "lloyd_iter", "elkan_iter",
            "lloyd_run_batched", "kmeans_pp_batched", "argkmin",
            "murmurhash3_32", "murmurhash3_bulk", "csv_read_floats",
-           "csv_stream_batches"]
+           "csv_stream_batches", "lz4_bound", "lz4_compress",
+           "lz4_decompress", "byte_shuffle", "byte_unshuffle",
+           "compress_array", "decompress_array"]

@@ -1298,3 +1298,162 @@ extern "C" uint32_t crc32_fast(const uint8_t* p, int64_t len, uint32_t init) {
 #endif
   return ~crc32_raw(p, len, c);
 }
+
+// ---------------------------------------------------------------------------
+// LZ4-class block codec (sq-lz: byte-stream match compression)
+// ---------------------------------------------------------------------------
+//
+// The out-of-core shard store reads raw `.npy` at disk bandwidth; at the
+// 100x-RAM scale bytes-on-disk and cold-tier latency dominate a store walk
+// (ROADMAP item 5). This is the byte-stream codec behind SQ_OOC_CODEC=lz4
+// (oocore/store.py) and the serving feature-cache spill tier
+// (serving/cache.py): the standard LZ4 block format (token byte = literal
+// length nibble | match length nibble, 255-continued extension bytes,
+// 2-byte little-endian offsets, min match 4), compressed by a greedy
+// single-slot 2^16-entry hash matcher. The matcher is deliberately the
+// SIMPLEST deterministic variant — insert at every scanned position,
+// forward extension only, no backward extension, no skip acceleration —
+// because the pure-Python portable fallback (sq_learn_tpu/native) must
+// produce BYTE-IDENTICAL compressed streams (pinned by tests/test_native.py:
+// a store written by either path re-opens under the other with the same
+// manifest CRCs).
+//
+// Format invariants (shared with the Python twin):
+//  - last LASTLIT(5) bytes are always literals; match search stops
+//    MFLIMIT(12) bytes before the end (the classic LZ4 end conditions);
+//  - the final sequence is literals-only (no offset follows it);
+//  - empty input compresses to an empty stream.
+// The decoder bounds-checks every read/write and returns -1 on malformed
+// input instead of overrunning — a corrupted compressed shard whose CRC
+// was skipped (SQ_OOC_VERIFY=off) must surface as an error with shard
+// provenance, not as a segfault.
+
+namespace {
+
+constexpr int64_t kLzMfLimit = 12;   // no match search this close to end
+constexpr int64_t kLzLastLit = 5;    // the final 5 bytes stay literal
+constexpr int kLzHashBits = 16;
+
+inline uint32_t lz_read32(const uint8_t* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;
+}
+
+inline uint32_t lz_hash(uint32_t x) {
+  return (uint32_t)((x * 2654435761u) >> (32 - kLzHashBits));
+}
+
+}  // namespace
+
+// worst-case compressed size for n input bytes (literal-only stream plus
+// extension bytes; matches only shrink the output)
+extern "C" int64_t lz4_bound(int64_t n) { return n + n / 255 + 16; }
+
+// compress src[0..n) into dst (capacity >= lz4_bound(n)); returns the
+// compressed size, or -1 on a capacity overrun (never happens with a
+// bound-sized dst — the guard is against caller mistakes).
+extern "C" int64_t lz4_compress(const uint8_t* src, int64_t n, uint8_t* dst,
+                                int64_t cap) {
+  if (n < 0 || (n > 0 && (src == nullptr || dst == nullptr))) return -1;
+  if (n == 0) return 0;
+  std::vector<int64_t> table((size_t)1 << kLzHashBits, -1);
+  int64_t ip = 0, anchor = 0, op = 0;
+  const int64_t limit = n - kLzMfLimit;
+
+  // one sequence: literals [anchor, anchor+lit), then (off, mlen) unless
+  // off == 0 (the final literal-only sequence)
+  auto emit = [&](int64_t lit, int64_t mlen_m4, int64_t off) -> bool {
+    int64_t need = 1 + lit + lit / 255 + 1 + (off ? 2 + mlen_m4 / 255 + 1 : 0);
+    if (op + need > cap) return false;
+    uint8_t tok_lit = lit >= 15 ? 15 : (uint8_t)lit;
+    uint8_t tok_mat = off ? (mlen_m4 >= 15 ? 15 : (uint8_t)mlen_m4) : 0;
+    dst[op++] = (uint8_t)((tok_lit << 4) | tok_mat);
+    for (int64_t rem = lit - 15; rem >= 0; rem -= 255) {
+      dst[op++] = (uint8_t)(rem < 255 ? rem : 255);
+      if (rem < 255) break;
+    }
+    std::memcpy(dst + op, src + anchor, (size_t)lit);
+    op += lit;
+    if (off) {
+      dst[op++] = (uint8_t)(off & 0xFF);
+      dst[op++] = (uint8_t)(off >> 8);
+      for (int64_t rem = mlen_m4 - 15; rem >= 0; rem -= 255) {
+        dst[op++] = (uint8_t)(rem < 255 ? rem : 255);
+        if (rem < 255) break;
+      }
+    }
+    return true;
+  };
+
+  while (ip <= limit) {
+    uint32_t seq = lz_read32(src + ip);
+    uint32_t h = lz_hash(seq);
+    int64_t cand = table[h];
+    table[h] = ip;
+    if (cand >= 0 && ip - cand <= 0xFFFF && lz_read32(src + cand) == seq) {
+      int64_t mlen = 4;
+      const int64_t end = n - kLzLastLit;
+      while (ip + mlen < end && src[ip + mlen] == src[cand + mlen]) mlen++;
+      if (!emit(ip - anchor, mlen - 4, ip - cand)) return -1;
+      ip += mlen;
+      anchor = ip;
+    } else {
+      ip++;
+    }
+  }
+  if (!emit(n - anchor, 0, 0)) return -1;
+  return op;
+}
+
+// decompress src[0..n) into dst[0..raw_n); returns raw_n, or -1 on any
+// malformed input (truncated lengths, bad offsets, size mismatch).
+extern "C" int64_t lz4_decompress(const uint8_t* src, int64_t n,
+                                  uint8_t* dst, int64_t raw_n) {
+  if (n < 0 || raw_n < 0) return -1;
+  if (raw_n == 0) return n == 0 ? 0 : -1;
+  if (src == nullptr || dst == nullptr) return -1;
+  int64_t ip = 0, op = 0;
+  while (ip < n) {
+    uint8_t token = src[ip++];
+    int64_t lit = token >> 4;
+    if (lit == 15) {
+      uint8_t b;
+      do {
+        if (ip >= n) return -1;
+        b = src[ip++];
+        lit += b;
+      } while (b == 255);
+    }
+    if (ip + lit > n || op + lit > raw_n) return -1;
+    std::memcpy(dst + op, src + ip, (size_t)lit);
+    ip += lit;
+    op += lit;
+    if (ip >= n) break;  // final literal-only sequence
+    if (ip + 2 > n) return -1;
+    int64_t off = (int64_t)src[ip] | ((int64_t)src[ip + 1] << 8);
+    ip += 2;
+    if (off == 0 || off > op) return -1;
+    int64_t mlen = (token & 0xF) + 4;
+    if ((token & 0xF) == 15) {
+      uint8_t b;
+      do {
+        if (ip >= n) return -1;
+        b = src[ip++];
+        mlen += b;
+      } while (b == 255);
+    }
+    if (op + mlen > raw_n) return -1;
+    // overlapping copies (off < mlen) replicate the match window; copy in
+    // offset-sized chunks, which is exact for both cases
+    int64_t from = op - off;
+    while (mlen > 0) {
+      int64_t chunk = mlen < off ? mlen : off;
+      std::memmove(dst + op, dst + from, (size_t)chunk);
+      op += chunk;
+      from += chunk;
+      mlen -= chunk;
+    }
+  }
+  return op == raw_n ? op : -1;
+}

@@ -51,7 +51,13 @@ import time
 #     alerting, PR 12) and the optional slo.tenant / slo.stages fields
 #     (per-tenant SLO records and the queue/coalesce/transfer/compute/
 #     scatter latency decomposition)
-SCHEMA_VERSION = 6
+# v7: +the compressed-tier codec counters (PR 13 — no new record types):
+#     oocore.codec_bytes_in/out (stored vs decoded bytes through the
+#     shard codec), serving.cache_spills / serving.cache_disk_hits (the
+#     feature-cache disk tier), the cold_tier fault kind, and the
+#     oocore.create_store span's codec attr; snapshot grows the matching
+#     codec/spill fields
+SCHEMA_VERSION = 7
 
 #: default sink path when SQ_OBS=1 and SQ_OBS_PATH is unset
 DEFAULT_PATH = "sq_obs.jsonl"
@@ -413,6 +419,13 @@ def snapshot():
             rec.counters.get("oocore.prefetch_stalls", 0)),
         "prefetch_stall_s": round(float(
             rec.counters.get("oocore.prefetch_stall_s", 0.0)), 6),
+        # shard codec (oocore.store, PR 13): stored (compressed) bytes
+        # read vs raw bytes decoded — a compressed-store bench line's
+        # bytes-on-disk evidence rides this pair
+        "codec_bytes_in": int(
+            rec.counters.get("oocore.codec_bytes_in", 0)),
+        "codec_bytes_out": int(
+            rec.counters.get("oocore.codec_bytes_out", 0)),
         # serving layer (sq_learn_tpu.serving): SLO summaries emitted,
         # batches that degraded to the host route, and transform-cache
         # traffic — the bench lines' evidence that a load run's numbers
@@ -423,6 +436,13 @@ def snapshot():
         "serve_cache_hits": int(rec.counters.get("serving.cache_hits", 0)),
         "serve_cache_misses": int(
             rec.counters.get("serving.cache_misses", 0)),
+        # feature-cache disk tier (serving.cache, PR 13): RAM-LRU
+        # evictions spilled to the SQ_SERVE_CACHE_DIR store and the
+        # digest-verified hits served back off disk
+        "serve_cache_spills": int(
+            rec.counters.get("serving.cache_spills", 0)),
+        "serve_cache_disk_hits": int(
+            rec.counters.get("serving.cache_disk_hits", 0)),
         # AOT-warmed serving (serving.aot, PR 11): executables minted at
         # warm time, dispatch-time executable-cache traffic, persistent
         # compile-cache reloads, and the bytes serving moved host→device

@@ -57,7 +57,7 @@ import os
 import time
 from statistics import median
 
-SCHEMA_VERSION = 5  # keep in sync with recorder.SCHEMA_VERSION (no import:
+SCHEMA_VERSION = 7  # keep in sync with recorder.SCHEMA_VERSION (no import:
 # this module must stay loadable from a bare checkout for CI tooling)
 
 __all__ = ["load_history", "check_record", "check_file", "selftest", "main"]

@@ -162,6 +162,16 @@ def summarize(records):
         "async_ckpt_dropped": counters.get("oocore.async_ckpt_dropped", 0),
     }
 
+    # compressed tier (v7): stored-vs-decoded bytes through the shard
+    # codec, and the serving feature-cache's spill/disk-hit traffic —
+    # the numbers behind the bytes-on-disk and survives-restart claims
+    codec = {
+        "bytes_in": counters.get("oocore.codec_bytes_in", 0),
+        "bytes_out": counters.get("oocore.codec_bytes_out", 0),
+        "cache_spills": counters.get("serving.cache_spills", 0),
+        "cache_disk_hits": counters.get("serving.cache_disk_hits", 0),
+    }
+
     return {
         "by_type": by_type,
         "spans": by_name,
@@ -177,6 +187,7 @@ def summarize(records):
         "gauges": gauges,
         "sketch": sketch,
         "prefetch": prefetch,
+        "codec": codec,
         # the statistical-observability sections (v3): per-site
         # Clopper–Pearson audit of the (ε, δ) guarantee draws, and the
         # run's accuracy-vs-theoretical-runtime sweep points
@@ -301,6 +312,22 @@ def render(summary, top=12):
             out(f"  {pf.get('async_ckpt_writes', 0)} async checkpoint "
                 f"write(s), {pf.get('async_ckpt_dropped', 0)} superseded "
                 f"before writing (latest-wins)")
+
+    out("")
+    out("-- compressed tier (shard codec / serving feature cache) --")
+    cd = summary.get("codec") or {}
+    if not any(cd.values()):
+        out("  (no codec or spill activity)")
+    else:
+        if cd.get("bytes_out"):
+            ratio = cd.get("bytes_in", 0) / cd["bytes_out"]
+            out(f"  shard codec: {_fmt_bytes(cd.get('bytes_in', 0))} "
+                f"stored -> {_fmt_bytes(cd['bytes_out'])} decoded "
+                f"(bytes-on-disk ratio {ratio:.3f})")
+        if cd.get("cache_spills") or cd.get("cache_disk_hits"):
+            out(f"  feature cache: {cd.get('cache_spills', 0)} spill(s) "
+                f"to disk, {cd.get('cache_disk_hits', 0)} digest-verified "
+                f"disk hit(s)")
 
     out("")
     out("-- serving SLOs (p50/p99 latency, sustained QPS) --")

@@ -15,8 +15,16 @@ error-budget types ``budget`` (one tenant × rolling-window burn-rate
 evaluation from :mod:`sq_learn_tpu.obs.budget`) and ``alert`` (one
 tripped multi-window burn alert), plus the optional ``slo.tenant`` /
 ``slo.stages`` fields (per-tenant SLO records and the queue/coalesce/
-transfer/compute/scatter latency decomposition). Older versions still
-validate (their types are a strict subset), any other version is
+transfer/compute/scatter latency decomposition); v7 (PR 13) adds the
+compressed-tier codec conventions over the EXISTING generic types (no
+new record types): the ``oocore.codec_bytes_in`` /
+``oocore.codec_bytes_out`` counters (stored vs decoded bytes through
+the shard codec, :mod:`sq_learn_tpu.oocore.store`), the
+``serving.cache_spills`` / ``serving.cache_disk_hits`` counters (the
+feature-cache disk tier, :mod:`sq_learn_tpu.serving.cache`), the
+``cold_tier`` fault kind (per-shard remote-storage latency model), and
+the ``codec`` attr on ``oocore.create_store`` spans. Older versions
+still validate (their types are a strict subset), any other version is
 rejected — an unknown version means a reader that would silently
 misinterpret fields, so it must fail loudly.
 
@@ -39,8 +47,8 @@ probe      outcome (str ∈ {ok, timeout, error, cpu, skipped}),
 fault      kind (str), tile (int | null) — one injected fault from the
            ``SQ_FAULTS`` harness (:mod:`sq_learn_tpu.resilience.faults`);
            for the read-side kinds (``read_fail`` / ``read_stall`` /
-           ``corrupt_shard``) ``tile`` carries the SHARD index of the
-           out-of-core store (:mod:`sq_learn_tpu.oocore`)
+           ``corrupt_shard`` / ``cold_tier``) ``tile`` carries the SHARD
+           index of the out-of-core store (:mod:`sq_learn_tpu.oocore`)
 breaker    state (str ∈ {closed, open, half_open}), prev (str),
            reason (str), consecutive (int ≥ 0) — one circuit-breaker
            transition (:mod:`sq_learn_tpu.resilience.supervisor`)
@@ -102,7 +110,10 @@ alert      tenant (str), kind (str), threshold (number ≥ 0),
 The out-of-core layer (PR 8) rides the generic types rather than minting
 new ones: shard-store reads surface as ``counter`` records
 (``oocore.shard_reads`` / ``oocore.shard_read_bytes`` /
-``oocore.crc_failures`` / ``oocore.rereads``) and ``span`` records
+``oocore.crc_failures`` / ``oocore.rereads``, plus the v7 codec pair
+``oocore.codec_bytes_in`` / ``oocore.codec_bytes_out`` and the serving
+feature-cache tier's ``serving.cache_spills`` /
+``serving.cache_disk_hits``) and ``span`` records
 (``oocore.create_store`` / ``oocore.minibatch_fit`` / ``oocore.epoch`` /
 ``oocore.assign_labels``), and read faults are ``fault`` records — one
 schema reads every layer.
@@ -121,8 +132,9 @@ _NUM = (int, float)
 #: versions this validator knows how to read (v1 = PR 2's envelope
 #: without schema_version/xla_cost/regression; v2 = PR 4's, without
 #: guarantee/tradeoff; v3 = PR 5's, without slo; v4 = PR 9's, without
-#: slo.transfer_bytes; v5 = PR 11's, without budget/alert)
-KNOWN_VERSIONS = {1, 2, 3, 4, 5, SCHEMA_VERSION}
+#: slo.transfer_bytes; v5 = PR 11's, without budget/alert; v6 = PR 12's,
+#: without the codec/spill counter conventions)
+KNOWN_VERSIONS = {1, 2, 3, 4, 5, 6, SCHEMA_VERSION}
 
 _PROBE_OUTCOMES = {"ok", "timeout", "error", "cpu", "skipped"}
 

@@ -4,8 +4,8 @@
 counters + retracing watchdog), a quantum top-k extraction (nonzero
 tomography shots in the ledger), and a tiny served tenant with a
 declared SLO (per-tenant ``slo`` + error-budget ``budget`` records,
-schema v6) under an active recorder, then validates the emitted JSONL
-against :mod:`sq_learn_tpu.obs.schema` (legacy v1–v5 records must keep
+schema v7) under an active recorder, then validates the emitted JSONL
+against :mod:`sq_learn_tpu.obs.schema` (legacy v1–v6 records must keep
 validating) and asserts the run artifact carries the signals the layer
 exists for. Exit code 0 = contract holds; 1 = schema or content
 violation (printed).
@@ -124,7 +124,7 @@ def main():
                         "quantum runtime")
     # v6 contract: the serving leg's per-tenant error budgets landed,
     # the tenant's slo record carries its declared targets, and legacy
-    # schema versions (v1-v5 files) still validate
+    # schema versions (v1-v6 files) still validate
     if summary["by_type"].get("budget", 0) <= 0:
         failures.append("no budget records from the serving leg")
     if not any(r.get("tenant") == "smoke_tenant" for r in rec.slo_records):
@@ -141,6 +141,10 @@ def main():
          "site": "s", "requests": 1, "p50_ms": 1.0, "p99_ms": 2.0,
          "qps": 3.0, "batch_occupancy": 0.5, "degraded": 0,
          "violated": False},
+        {"v": 6, "schema_version": 6, "ts": 0.0, "type": "budget",
+         "tenant": "t", "window_s": 60.0, "slo_burn": 0.1,
+         "stat_burn": None, "cp_lower_bound": None, "burn_rate": 0.2,
+         "alerting": False},
     ]
     for r_ in legacy:
         errs = validate_record(r_)

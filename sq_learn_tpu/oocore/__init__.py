@@ -1,12 +1,15 @@
 """Out-of-core shard store + crash-resumable multi-epoch streaming.
 
 The package behind ROADMAP item 3: datasets larger than host RAM live as
-CRC-manifested memmap shards (:mod:`.store`), deterministic epoch plans
+CRC-manifested memmap shards (:mod:`.store` — optionally compressed per
+shard with the native LZ4-class codec, ``SQ_OOC_CODEC=lz4``, CRC over
+the stored bytes so corruption is caught before decode; ISSUE 13),
+deterministic epoch plans
 schedule multi-pass batch walks over them (:mod:`.epochs`), the
 resumable mini-batch engine (:mod:`.fit`) survives a SIGKILL mid-epoch
 bit-for-bit, and the bounded readahead prefetcher (:mod:`.prefetch`,
-ISSUE 10) overlaps shard materialization + CRC verify with compute —
-depth 0 is the serial path bit-for-bit. The streaming engine (:mod:`sq_learn_tpu.streaming`) reads
+ISSUE 10) overlaps shard materialization + CRC verify + decompression
+with compute — depth 0 is the serial path bit-for-bit. The streaming engine (:mod:`sq_learn_tpu.streaming`) reads
 stores directly — ``stream_fold`` and the Gram-route consumers accept a
 :class:`ShardStore` wherever they accept a host array — and
 :class:`~sq_learn_tpu.models.minibatch.MiniBatchQKMeans` /

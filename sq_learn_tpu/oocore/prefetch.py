@@ -32,6 +32,14 @@ Design contract, in order of importance:
   the consumer is actually waiting on is always allowed to claim — the
   store's own single-materialization check still guards it — so a budget
   too small for readahead degrades to serial, never deadlocks.
+- **decompress-ahead** (``SQ_OOC_CODEC=lz4`` stores, ISSUE 13): the
+  workers run the store's own ``read_shard``, which now includes the
+  CRC-before-decode pass AND the LZ4 decode — decompression rides the
+  existing pool and lands ahead of the consumer like the read itself.
+  The budget ledger accounts it honestly: an in-flight compressed shard
+  claims compressed+raw (payload and decoded array are resident
+  together while the decoder runs); a completed-but-unconsumed shard
+  accounts raw bytes only.
 - **observability**: one ``oocore.prefetch`` span per prefetcher lifetime
   plus ``oocore.prefetch_hits`` / ``oocore.prefetch_stalls`` /
   ``oocore.prefetch_stall_s`` / ``oocore.prefetch_occupancy`` counters,
@@ -102,6 +110,16 @@ class ShardPrefetcher:
         itemsize = np.dtype(source.dtype).itemsize
         row = int(np.prod(source.shape[1:], dtype=np.int64)) * itemsize
         self._sz = [int(source.shard_sizes[s]) * row for s in self.order]
+        # a codec store's worker holds stored payload + decoded array
+        # while it decompresses: the ledger claims compressed+raw for
+        # in-flight positions and releases the compressed part when the
+        # read lands (completed-but-unconsumed shards account RAW bytes —
+        # the payload is gone by then). Codec "none" has no extra claim.
+        stored = getattr(source, "shard_stored_sizes", None)
+        if stored is not None and getattr(source, "codec", "none") != "none":
+            self._extra = [int(stored[s]) for s in self.order]
+        else:
+            self._extra = [0] * len(self.order)
         budget = ram_budget_bytes()
         self._avail = None
         if budget:
@@ -133,7 +151,8 @@ class ShardPrefetcher:
         if p >= len(self.order) or p > self._consumed + self.depth:
             return False
         if (p != self._consumed and self._avail is not None
-                and self._held + self._sz[p] > self._avail):
+                and self._held + self._sz[p] + self._extra[p]
+                > self._avail):
             # readahead would break the resident+in-flight budget rule;
             # the position the consumer is waiting on always claims (the
             # store's single-materialization check still guards it)
@@ -149,13 +168,16 @@ class ShardPrefetcher:
                     return
                 p = self._claimed
                 self._claimed += 1
-                self._held += self._sz[p]
+                self._held += self._sz[p] + self._extra[p]
             try:
                 out = ("ok", self.source.read_shard(self.order[p]))
             except BaseException as exc:  # surfaces on the consumer at p
                 out = ("err", exc)
             with self._cond:
                 self._results[p] = out
+                # the stored payload frees once the read lands; only the
+                # decoded raw bytes stay resident until get() drains it
+                self._held -= self._extra[p]
                 self._cond.notify_all()
 
     # -- consumer side -------------------------------------------------------

@@ -1,28 +1,34 @@
-"""Out-of-core smoke: the ISSUE 8 acceptance scenario end to end.
+"""Out-of-core smoke: the ISSUE 8/10/13 acceptance scenario end to end.
 
 ``make oocore-smoke`` runs this module on the CPU backend:
 
-1. build a tiny deterministic synthetic shard store;
+1. build a tiny deterministic synthetic shard store AND its
+   ``codec="lz4"`` compressed twin (same seed, same shard split — the
+   decoded rows are bit-identical by construction);
 2. a **fault-free** multi-epoch mini-batch fit on the SERIAL read path
-   (``SQ_OOC_PREFETCH_DEPTH=0`` — the reference result);
-3. the same fit under ``read_fail`` (one transient shard-read failure —
-   the supervisor's retry absorbs it) plus ``corrupt_shard`` (a
-   corrupted materialization the manifest CRC must catch, quarantine,
+   over the UNCOMPRESSED store (``SQ_OOC_PREFETCH_DEPTH=0`` — the
+   reference result every later leg must reproduce bit-for-bit);
+3. the same fit over the **compressed store** under ``read_fail`` (one
+   transient shard-read failure — the supervisor's retry absorbs it)
+   plus ``corrupt_shard`` (a corrupted STORED payload the
+   compressed-bytes CRC must catch BEFORE the decoder runs, quarantine,
    and recover through the bounded re-read) **with the shard readahead
-   prefetcher enabled at depth 3** — retries, quarantine and the bounded
-   re-read all fire from worker threads, and the faulted prefetched fit
-   must match the serial reference **bit-for-bit** (ISSUE 10's
-   depth-0-vs-depth-d acceptance pin);
-4. a REAL subprocess kill: a child process runs the same fit with
-   mid-epoch checkpoints AND prefetch enabled, under injected read
-   stalls (so the parent can catch it mid-flight — the stalls now land
-   on prefetch worker threads), the parent SIGKILLs it the moment the
-   first checkpoint lands (mid-prefetch, mid-epoch), and a clean rerun
-   **resumes from the checkpoint** and finishes bit-identical to the
-   reference;
+   prefetcher enabled at depth 3** — retries, quarantine, re-read and
+   the LZ4 decode all fire from worker threads, and the faulted
+   compressed prefetched fit must match the uncompressed serial
+   reference **bit-for-bit** (ISSUE 13's codec-parity acceptance pin on
+   top of ISSUE 10's depth-0-vs-depth-d pin);
+4. a REAL subprocess kill ON THE COMPRESSED STORE: a child process runs
+   the same fit with mid-epoch checkpoints AND prefetch enabled, under
+   injected read stalls (so the parent can catch it mid-flight — the
+   stalls land on prefetch worker threads), the parent SIGKILLs it the
+   moment the first checkpoint lands (mid-prefetch, mid-epoch,
+   mid-decode), and a clean rerun **resumes from the checkpoint** and
+   finishes bit-identical to the uncompressed reference;
 5. schema validation of the emitted JSONL: the read-side ``fault``
-   records, the ``oocore.*`` counters, and the prefetch hit/stall
-   counters must be present and valid.
+   records, the ``oocore.*`` counters (including the v7 codec byte
+   pair), and the prefetch hit/stall counters must be present and
+   valid.
 
 Exit code 0 = contract holds; 1 = violation (printed as JSON). Pins the
 CPU backend in-process first, like every resilience check.
@@ -88,18 +94,29 @@ def main():
 
     store = create_synthetic_store(store_path, shard_bytes=64 * 1024,
                                    **STORE)
+    # the compressed twin: same seed + shard split => decoded rows are
+    # bit-identical; everything from here on reads THIS store, pinned
+    # against the uncompressed serial reference
+    cstore_path = os.path.join(tmp, "store_lz4")
+    cstore = create_synthetic_store(cstore_path, shard_bytes=64 * 1024,
+                                    codec="lz4", **STORE)
+    check(cstore.codec == "lz4", "compressed twin did not record codec")
+    check(cstore.stored_nbytes < cstore.nbytes,
+          "compressed twin stored no fewer bytes than raw")
     # the reference runs the SERIAL read path: the prefetched legs below
     # must reproduce it bit-for-bit (depth-0-vs-depth-d acceptance pin)
     os.environ["SQ_OOC_PREFETCH_DEPTH"] = "0"
     reference = minibatch_epoch_fit(store, **FIT)
 
-    # -- read faults UNDER PREFETCH: transient failure + corruption fire
-    # on worker threads, absorbed with bit parity vs the serial run ----------
+    # -- read faults UNDER PREFETCH, over the COMPRESSED store: transient
+    # failure + stored-payload corruption fire on worker threads (the CRC
+    # catches the corruption BEFORE decode), absorbed with bit parity
+    # vs the uncompressed serial run --------------------------------------
     os.environ["SQ_OOC_PREFETCH_DEPTH"] = "3"
     os.environ["SQ_OOC_PREFETCH_THREADS"] = "2"
     plan = faults.arm("read_fail:tiles=1,times=1;"
                       "corrupt_shard:tiles=2,times=1")
-    faulted = minibatch_epoch_fit(open_store(store_path), **FIT)
+    faulted = minibatch_epoch_fit(open_store(cstore_path), **FIT)
     faults.disarm()
     for knob in ("SQ_OOC_PREFETCH_DEPTH", "SQ_OOC_PREFETCH_THREADS"):
         os.environ.pop(knob, None)
@@ -108,34 +125,39 @@ def main():
     check(any(ev["kind"] == "corrupt_shard" for ev in plan.events),
           "no shard corruption was injected")
     check(np.array_equal(faulted["centers"], reference["centers"]),
-          "fault-injected prefetched fit diverged from the serial fit")
+          "fault-injected compressed prefetched fit diverged from the "
+          "uncompressed serial fit")
     rec = get_recorder()
     check(rec.counters.get("oocore.rereads", 0) >= 1,
           "corrupted shard was not re-read")
     check(rec.counters.get("oocore.crc_failures", 0) >= 1,
           "manifest CRC did not catch the corruption")
+    check(rec.counters.get("oocore.codec_bytes_out", 0)
+          >= cstore.nbytes,
+          "codec counters did not account one epoch of decoded bytes")
     pf_gets = (rec.counters.get("oocore.prefetch_hits", 0)
                + rec.counters.get("oocore.prefetch_stalls", 0))
     check(pf_gets >= store.n_shards,
           f"prefetcher served {pf_gets} shard reads; expected at least "
           f"one epoch's worth ({store.n_shards})")
 
-    # -- the real kill: SIGKILL mid-epoch, then resume ----------------------
+    # -- the real kill: SIGKILL mid-epoch ON THE COMPRESSED STORE, then
+    # resume ----------------------------------------------------------------
     env = dict(os.environ,
                JAX_PLATFORMS="cpu",
                SQ_STREAM_CKPT_DIR=ckpt_dir,
                SQ_STREAM_CKPT_EVERY="2",
                SQ_OBS="0",
                # prefetch ON in the killed child: the SIGKILL lands
-               # mid-epoch AND mid-prefetch (workers mid-stall), and the
-               # resume must still be bit-for-bit
+               # mid-epoch AND mid-prefetch (workers mid-stall or
+               # mid-decode), and the resume must still be bit-for-bit
                SQ_OOC_PREFETCH_DEPTH="3",
                SQ_OOC_PREFETCH_THREADS="2",
                # every shard read stalls 0.1 s so the parent reliably
                # catches the child mid-epoch — the CI-scaled wedge
                SQ_FAULTS="read_stall:p=1,s=0.1,times=999")
     cmd = [sys.executable, "-m", "sq_learn_tpu.oocore.smoke", "--child",
-           store_path, out_path]
+           cstore_path, out_path]
     child = subprocess.Popen(cmd, env=env, stdout=subprocess.DEVNULL,
                              stderr=subprocess.DEVNULL)
     def _ckpts():
@@ -195,6 +217,7 @@ def main():
         "jsonl": by_type,
         "kill_cursor": cursor,
         "fault_events": len(rec.fault_events),
+        "codec_ratio": round(cstore.stored_nbytes / cstore.nbytes, 3),
         "errors": failures,
     }))
     return 1 if failures else 0

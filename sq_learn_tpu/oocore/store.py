@@ -26,7 +26,18 @@ This module is the substrate both problems share:
   under the transfer supervisor
   (:func:`sq_learn_tpu.resilience.supervisor.supervised_read` — retries,
   backoff, deadline, breaker) and the read-side fault injectors
-  (``SQ_FAULTS``: ``read_fail`` / ``read_stall`` / ``corrupt_shard``).
+  (``SQ_FAULTS``: ``read_fail`` / ``read_stall`` / ``corrupt_shard`` /
+  ``cold_tier``).
+- **compression** (``SQ_OOC_CODEC=lz4``, default ``none``): shards store
+  as LZ4-class payloads (:func:`sq_learn_tpu.native.compress_array` —
+  per-shard best of plain/byte-shuffled LZ4, raw when incompressible)
+  with the manifest carrying both sizes (``stored_bytes`` compressed /
+  ``nbytes`` raw) and the CRC computed over the **stored** bytes, so
+  corruption is caught BEFORE decompression and the verify pass scans
+  compressed-size, not raw-size, bytes. Decode errors after a clean CRC
+  surface as :class:`ShardCorruptionError` with shard provenance. Old
+  uncompressed stores carry no ``codec`` field and load through the
+  exact pre-codec path, bit-identically.
 - **no-egress generators**: :func:`create_synthetic_store` materializes
   the :func:`~sq_learn_tpu.datasets.synthetic_surrogate` distribution
   shard-by-shard (per-shard keyed RNG streams, identical rows for a
@@ -106,6 +117,17 @@ def reread_max():
     """Bounded re-read budget after a CRC mismatch
     (``SQ_OOC_REREAD_MAX``, default 2)."""
     return int(os.environ.get("SQ_OOC_REREAD_MAX", 2))
+
+
+def codec_default():
+    """Default codec for NEW store builds (``SQ_OOC_CODEC``: ``lz4`` |
+    ``none``, default ``none`` — existing byte-level contracts, manifests
+    and bench history stay untouched unless the operator opts in).
+    Opening a store always honors its manifest, never this knob."""
+    codec = os.environ.get("SQ_OOC_CODEC", "none")
+    if codec not in ("lz4", "none"):
+        raise ValueError(f"SQ_OOC_CODEC must be lz4|none, got {codec!r}")
+    return codec
 
 
 def _budget_check(nbytes, what):
@@ -190,6 +212,16 @@ class ShardStore:
         self._offsets = np.concatenate(
             [[0], np.cumsum(self.shard_sizes)]).astype(np.int64)
         self.fingerprint = manifest["fingerprint"]
+        #: shard codec (``"none"`` for pre-codec manifests — those load
+        #: through the exact pre-codec byte path)
+        self.codec = manifest.get("codec", "none")
+        row_bytes = self.shape[1] * self.dtype.itemsize
+        #: bytes each shard occupies ON DISK (== raw bytes for codec
+        #: "none"; the prefetcher's in-flight ledger claims these ON TOP
+        #: of the raw bytes while a compressed shard decodes)
+        self.shard_stored_sizes = [
+            int(s.get("stored_bytes", int(s["rows"]) * row_bytes))
+            for s in manifest["shards"]]
         #: shards currently failing CRC (cleared when a re-read recovers)
         self.quarantined = set()
         self._verified = set()
@@ -206,6 +238,12 @@ class ShardStore:
         return self.size * self.dtype.itemsize
 
     @property
+    def stored_nbytes(self):
+        """Total bytes on disk (== :attr:`nbytes` for codec ``none``;
+        the compressed store's bytes-on-disk claim reads off this)."""
+        return sum(self.shard_stored_sizes)
+
+    @property
     def n_shards(self):
         return len(self.shard_sizes)
 
@@ -216,15 +254,32 @@ class ShardStore:
         return os.path.join(self.path, self.manifest["shards"][i]["file"])
 
     def _materialize(self, i):
-        """One supervised, fault-injectable, CRC-unchecked shard read."""
+        """One supervised, fault-injectable, CRC-unchecked shard read.
+
+        Codec ``none`` returns the materialized shard array; a codec
+        store returns the STORED payload as a uint8 array — verification
+        and decode happen in :meth:`read_shard`, after the CRC pass, so
+        corruption never reaches the decoder. Both paths run the armed
+        ``cold_tier`` latency model (per-shard remote-storage profile)
+        inside the supervised timed attempt, where a slow cold read
+        counts toward the deadline/breaker exactly like a ``read_stall``.
+        """
         from ..resilience import faults as _faults
         from ..resilience import supervisor as _sup
 
+        stored = self.shard_stored_sizes[i]
+
         def attempt():
-            mm = np.load(self._shard_path(i), mmap_mode="r")
-            arr = np.array(mm)  # materialize, then drop the mapping
-            del mm
-            return arr
+            plan = _faults._active
+            if plan is not None:
+                plan.on_cold(i, stored)
+            if self.codec == "none":
+                mm = np.load(self._shard_path(i), mmap_mode="r")
+                arr = np.array(mm)  # materialize, then drop the mapping
+                del mm
+                return arr
+            with open(self._shard_path(i), "rb") as fh:
+                return np.frombuffer(fh.read(), np.uint8)
 
         arr = _sup.supervised_read(attempt, i, site="oocore.read_shard")
         plan = _faults._active
@@ -232,14 +287,40 @@ class ShardStore:
             arr = plan.corrupt_read(arr, i)
         return arr
 
+    def _decode(self, i, payload, meta):
+        """Stored payload → shard array (codec stores only). A decode
+        failure after a clean CRC pass is on-disk rot the verify policy
+        let through (``SQ_OOC_VERIFY=off``) or a writer bug — surface it
+        with shard provenance, never as a crash."""
+        from .. import native
+        from .. import obs as _obs
+
+        rows = int(meta["rows"])
+        try:
+            arr = native.decompress_array(
+                payload, self.dtype, (rows, self.shape[1]))
+        except ValueError as exc:
+            raise ShardCorruptionError(
+                f"shard {i} ({meta['file']}) of {self.path} failed "
+                f"{self.codec} decode: {exc}") from exc
+        _obs.counter_add("oocore.codec_bytes_in", int(payload.nbytes))
+        _obs.counter_add("oocore.codec_bytes_out", int(arr.nbytes))
+        return arr
+
     def read_shard(self, i):
         """Materialize shard ``i``: supervised read, CRC verification per
-        ``SQ_OOC_VERIFY``, quarantine + bounded re-read on mismatch."""
+        ``SQ_OOC_VERIFY`` (over the STORED bytes — compressed payloads
+        verify before they decode), quarantine + bounded re-read on
+        mismatch, then decode for codec stores."""
         from .. import obs as _obs
 
         meta = self.manifest["shards"][i]
-        _budget_check(int(meta["rows"]) * self.shape[1]
-                      * self.dtype.itemsize, f"shard {i} of {self.path}")
+        raw_nbytes = int(meta["rows"]) * self.shape[1] * self.dtype.itemsize
+        stored = self.shard_stored_sizes[i]
+        # a codec shard's true single-materialization peak is payload +
+        # decoded array, resident together while the decoder runs
+        _budget_check(raw_nbytes + (stored if self.codec != "none" else 0),
+                      f"shard {i} of {self.path}")
         arr = self._materialize(i)
         mode = verify_mode()
         if mode == "all" or (mode == "touch" and i not in self._verified):
@@ -262,6 +343,8 @@ class ShardStore:
                 arr = self._materialize(i)
             self.quarantined.discard(i)
             self._verified.add(i)
+        if self.codec != "none":
+            arr = self._decode(i, arr, meta)
         _obs.counter_add("oocore.shard_reads", 1)
         _obs.counter_add("oocore.shard_read_bytes", int(arr.nbytes))
         return arr
@@ -357,6 +440,11 @@ def open_store(path):
         manifest = json.load(fh)
     if manifest.get("format") != FORMAT:
         raise ValueError(f"not an oocore shard store: {path}")
+    codec = manifest.get("codec", "none")
+    if codec not in ("lz4", "none"):
+        raise ValueError(
+            f"store {path} uses unknown codec {codec!r} — refusing to "
+            f"misread its shard payloads")
     return ShardStore(path, manifest)
 
 
@@ -372,11 +460,14 @@ class _StoreWriter:
     serial composition of the two.
     """
 
-    def __init__(self, path, n_rows, n_features, dtype):
+    def __init__(self, path, n_rows, n_features, dtype, codec=None):
         self.path = str(path)
         os.makedirs(self.path, exist_ok=True)
         self.n_rows, self.n_features = int(n_rows), int(n_features)
         self.dtype = np.dtype(dtype)
+        self.codec = codec_default() if codec is None else str(codec)
+        if self.codec not in ("lz4", "none"):
+            raise ValueError(f"codec must be lz4|none, got {self.codec!r}")
         self.shards = []
         self.colsum = np.zeros(self.n_features, np.float64)
         self.sqsum = np.zeros(self.n_features, np.float64)
@@ -384,16 +475,35 @@ class _StoreWriter:
 
     def write_shard(self, i, block):
         """Write shard ``i``'s file (fsynced) and return
-        ``(meta, colsum_i, sqsum_i)`` for :meth:`commit`."""
+        ``(meta, colsum_i, sqsum_i)`` for :meth:`commit`. Codec stores
+        write the :func:`~sq_learn_tpu.native.compress_array` payload
+        (CRC over the STORED bytes — the read side verifies before it
+        decodes); codec ``none`` keeps the pre-codec ``.npy`` layout
+        byte-for-byte."""
         block = np.ascontiguousarray(block, self.dtype)
-        fname = f"shard_{i:05d}.npy"
-        fpath = os.path.join(self.path, fname)
-        with open(fpath, "wb") as fh:
-            np.save(fh, block)
-            fh.flush()
-            os.fsync(fh.fileno())
-        meta = {"file": fname, "rows": int(block.shape[0]),
-                "crc32": _crc(block), "nbytes": int(block.nbytes)}
+        if self.codec == "none":
+            fname = f"shard_{i:05d}.npy"
+            fpath = os.path.join(self.path, fname)
+            with open(fpath, "wb") as fh:
+                np.save(fh, block)
+                fh.flush()
+                os.fsync(fh.fileno())
+            meta = {"file": fname, "rows": int(block.shape[0]),
+                    "crc32": _crc(block), "nbytes": int(block.nbytes)}
+        else:
+            from .. import native
+
+            payload = native.compress_array(block)
+            fname = f"shard_{i:05d}.{self.codec}"
+            fpath = os.path.join(self.path, fname)
+            with open(fpath, "wb") as fh:
+                fh.write(payload)
+                fh.flush()
+                os.fsync(fh.fileno())
+            meta = {"file": fname, "rows": int(block.shape[0]),
+                    "crc32": _crc(np.frombuffer(payload, np.uint8)),
+                    "nbytes": int(block.nbytes),
+                    "stored_bytes": len(payload)}
         return (meta, block.sum(axis=0, dtype=np.float64),
                 (block.astype(np.float64) ** 2).sum(axis=0))
 
@@ -423,62 +533,33 @@ class _StoreWriter:
             "sqsum": [float(v) for v in self.sqsum],
             "provenance": provenance,
         }
+        if self.codec != "none":
+            manifest["codec"] = self.codec
         _atomic_json(os.path.join(self.path, MANIFEST), manifest)
         return ShardStore(self.path, manifest)
 
 
-def create_synthetic_store(path, n_samples, n_features, *, n_classes=10,
-                           seed=0, cluster_std=4.0, shard_bytes=None,
-                           dtype=np.float32):
-    """Materialize the :func:`~sq_learn_tpu.datasets.synthetic_surrogate`
-    distribution straight to a shard store — the no-egress path to a
-    dataset larger than host RAM.
-
-    Same geometry as the in-RAM surrogate (per-class Gaussian centroids,
-    per-feature scale decay); rows are generated per shard from an RNG
-    keyed on ``(seed, shard index)``, so shard ``i``'s bytes depend only
-    on the seed and the shard split — a rebuild with identical arguments
-    is bit-identical (and so is the manifest fingerprint), which is also
-    what makes the build PARALLEL: shards generate and write on a small
-    thread pool (``SQ_OOC_PREFETCH_THREADS``-wide; the fsyncs overlap the
-    generation of the next shards) while the manifest stats fold in shard
-    order on the caller's thread — the manifest is byte-identical to a
-    serial build's. Host RAM holds at most the in-flight window of shards
-    (bounded by the pool width, and by ``SQ_OOC_RAM_BUDGET_BYTES`` when
-    armed). Returns the opened :class:`ShardStore`."""
-    import jax
-
+def _parallel_build(writer, gen, n_shards, shard_nbytes, **span_attrs):
+    """Shard-by-shard store build on the PR 10 thread pool: workers run
+    ``writer.write_shard(i, gen(i))`` (file write + CRC + per-shard
+    stats — no shared state) while the caller's thread folds the stats
+    in shard order, so the manifest is BYTE-IDENTICAL to a serial
+    build's (test-pinned for both the synthetic generator and
+    :func:`store_from_array`). The in-flight window is one block per
+    worker plus one queued, shrunk further under an armed
+    ``SQ_OOC_RAM_BUDGET_BYTES`` (the f64 stats temp makes a building
+    shard ~3x its bytes)."""
     from .. import obs as _obs
     from .prefetch import prefetch_threads
 
-    dtype = jax.dtypes.canonicalize_dtype(np.dtype(dtype))
-    rows, n_shards = _plan_shards(
-        n_samples, int(n_features) * np.dtype(dtype).itemsize, shard_bytes)
-    shard_nbytes = rows * int(n_features) * np.dtype(dtype).itemsize
-    _budget_check(shard_nbytes, f"synthetic shard build of {path}")
-    rng0 = np.random.default_rng(seed)
-    centers = rng0.normal(scale=10.0, size=(n_classes, n_features))
-    scales = np.geomspace(1.0, 0.05, n_features)
-    writer = _StoreWriter(path, n_samples, n_features, dtype)
-
-    def gen(i):
-        r = min(rows, int(n_samples) - i * rows)
-        rng = np.random.default_rng((int(seed), i))
-        y = rng.integers(0, n_classes, size=r)
-        return (centers[y] + rng.normal(
-            scale=cluster_std, size=(r, n_features)) * scales)
-
     threads = max(1, min(prefetch_threads(), n_shards))
-    # in-flight window: one block per worker plus one queued; the f64
-    # stats temp makes a building shard ~3x its bytes, so a budget caps
-    # the window rather than trusting the pool width
     window = threads + 1
     budget = ram_budget_bytes()
     if budget:
         window = max(1, min(window, budget // max(1, 3 * shard_nbytes)))
-    with _obs.span("oocore.create_store", n=int(n_samples),
-                   m=int(n_features), shards=n_shards,
-                   threads=threads if window > 1 else 1):
+    with _obs.span("oocore.create_store", shards=n_shards,
+                   codec=writer.codec,
+                   threads=threads if window > 1 else 1, **span_attrs):
         if window <= 1 or n_shards <= 1:
             for i in range(n_shards):
                 writer.append(gen(i))
@@ -494,14 +575,95 @@ def create_synthetic_store(path, n_samples, n_features, *, n_classes=10,
                             lambda j: writer.write_shard(j, gen(j)), nxt)
                         nxt += 1
                     writer.commit(*pending.pop(i).result())
-    return writer.finish({"kind": "synthetic", "seed": int(seed),
+
+
+def create_synthetic_store(path, n_samples, n_features, *, n_classes=10,
+                           seed=0, cluster_std=4.0, shard_bytes=None,
+                           dtype=np.float32, codec=None, kind="gaussian"):
+    """Materialize a deterministic synthetic distribution straight to a
+    shard store — the no-egress path to a dataset larger than host RAM.
+
+    ``kind="gaussian"`` (default) is the
+    :func:`~sq_learn_tpu.datasets.synthetic_surrogate` geometry
+    (per-class Gaussian centroids, per-feature scale decay);
+    ``kind="pixels"`` generates MNIST-like rows — per-class blob
+    templates on a √m-side grid, per-sample intensity jitter + noise,
+    clipped, thresholded sparse, quantized to 256 levels — the
+    image-workload twin whose stores actually compress (the Gaussian
+    surrogate's float mantissas are near-incompressible by construction;
+    the codec bench leg measures its bytes-on-disk claim on this kind).
+
+    Rows are generated per shard from an RNG keyed on ``(seed, shard
+    index)``, so shard ``i``'s bytes depend only on the seed and the
+    shard split — a rebuild with identical arguments is bit-identical
+    (and so is the manifest fingerprint), which is also what makes the
+    build PARALLEL (:func:`_parallel_build`): shards generate, compress
+    (``codec`` — default ``SQ_OOC_CODEC``) and write on a small thread
+    pool while the manifest stats fold in shard order on the caller's
+    thread. Host RAM holds at most the in-flight window of shards
+    (bounded by the pool width, and by ``SQ_OOC_RAM_BUDGET_BYTES`` when
+    armed). Returns the opened :class:`ShardStore`."""
+    import jax
+
+    dtype = jax.dtypes.canonicalize_dtype(np.dtype(dtype))
+    rows, n_shards = _plan_shards(
+        n_samples, int(n_features) * np.dtype(dtype).itemsize, shard_bytes)
+    shard_nbytes = rows * int(n_features) * np.dtype(dtype).itemsize
+    _budget_check(shard_nbytes, f"synthetic shard build of {path}")
+    rng0 = np.random.default_rng(seed)
+    if kind == "gaussian":
+        centers = rng0.normal(scale=10.0, size=(n_classes, n_features))
+        scales = np.geomspace(1.0, 0.05, n_features)
+
+        def gen(i):
+            r = min(rows, int(n_samples) - i * rows)
+            rng = np.random.default_rng((int(seed), i))
+            y = rng.integers(0, n_classes, size=r)
+            return (centers[y] + rng.normal(
+                scale=cluster_std, size=(r, n_features)) * scales)
+    elif kind == "pixels":
+        side = max(2, int(np.sqrt(n_features)))
+        yy, xx = np.mgrid[0:side, 0:side]
+        templates = np.zeros((n_classes, side * side))
+        for c in range(n_classes):
+            acc = np.zeros((side, side))
+            for _ in range(4):
+                cx, cy = rng0.uniform(2.0, side - 2.0, 2)
+                s = rng0.uniform(1.5, 3.5)
+                acc += rng0.uniform(0.5, 1.0) * np.exp(
+                    -((xx - cx) ** 2 + (yy - cy) ** 2) / (2.0 * s * s))
+            templates[c] = acc.reshape(-1)
+        # tile/truncate the grid to the requested feature count
+        reps = -(-int(n_features) // templates.shape[1])
+        templates = np.tile(templates, (1, reps))[:, :int(n_features)]
+
+        def gen(i):
+            r = min(rows, int(n_samples) - i * rows)
+            rng = np.random.default_rng((int(seed), i))
+            y = rng.integers(0, n_classes, size=r)
+            block = (templates[y] * rng.uniform(0.7, 1.0, size=(r, 1))
+                     + rng.normal(scale=0.08, size=(r, int(n_features))))
+            block = np.clip(block, 0.0, 1.0)
+            block = np.where(block < 0.15, 0.0, block)
+            return np.round(block * 255.0) / 255.0
+    else:
+        raise ValueError(f"kind must be gaussian|pixels, got {kind!r}")
+
+    writer = _StoreWriter(path, n_samples, n_features, dtype, codec=codec)
+    _parallel_build(writer, gen, n_shards, shard_nbytes,
+                    n=int(n_samples), m=int(n_features))
+    return writer.finish({"kind": f"synthetic-{kind}", "seed": int(seed),
                           "n_classes": int(n_classes),
                           "cluster_std": float(cluster_std)})
 
 
-def store_from_array(path, X, *, shard_bytes=None):
+def store_from_array(path, X, *, shard_bytes=None, codec=None):
     """Shard an in-RAM array to disk — the test/bench bridge between the
-    resident world and the out-of-core one. Returns the opened store."""
+    resident world and the out-of-core one. Builds on the same thread
+    pool as :func:`create_synthetic_store` (shard slices are views — the
+    workers' file writes, CRCs and codec passes overlap; the manifest
+    folds in shard order and is byte-identical to a serial build's).
+    Returns the opened store."""
     import jax
 
     X = np.asarray(X)
@@ -510,9 +672,10 @@ def store_from_array(path, X, *, shard_bytes=None):
         X = X.astype(canonical)
     n, m = X.shape
     rows, n_shards = _plan_shards(n, X.nbytes // max(1, n), shard_bytes)
-    writer = _StoreWriter(path, n, m, X.dtype)
-    for i in range(n_shards):
-        writer.append(X[i * rows:(i + 1) * rows])
+    writer = _StoreWriter(path, n, m, X.dtype, codec=codec)
+    _parallel_build(writer, lambda i: X[i * rows:(i + 1) * rows],
+                    n_shards, rows * m * X.dtype.itemsize,
+                    n=int(n), m=int(m))
     return writer.finish({"kind": "array"})
 
 

@@ -66,7 +66,21 @@ Kinds and their params (every param optional unless noted):
     AFTER the read, so the manifest-CRC verification in
     :meth:`sq_learn_tpu.oocore.store.ShardStore.read_shard` must detect
     it, quarantine the shard, and recover through the bounded re-read
-    (``times=N`` injections, then clean reads).
+    (``times=N`` injections, then clean reads). On a compressed store
+    (``SQ_OOC_CODEC=lz4``) the flip lands on the STORED payload — the
+    compressed-CRC check must catch it before the decoder ever runs.
+``cold_tier``
+    Cold-tier storage latency model: each selected shard's read sleeps
+    ``s=0.05`` seconds plus ``per_mb=0`` seconds per MiB of its
+    STORED (on-disk) size — a deterministic remote-object-store
+    profile (request latency + bandwidth), scaled down to CI. The
+    default ``times=1`` makes it a first-touch model (the cold read
+    pays the tier, re-reads are page-cache warm); ``times=N`` keeps a
+    shard cold for N reads. The sleep runs inside the supervised timed
+    read attempt, so a cold read slower than ``SQ_TILE_DEADLINE_S``
+    feeds the breaker exactly like a ``read_stall`` — this is the knob
+    the out-of-core bench uses to test readahead depth/budget policy
+    against realistic remote-storage latencies.
 
 Example: ``SQ_FAULTS="put_fail:tiles=2,times=1;probe_timeout:n=2"``.
 
@@ -94,7 +108,7 @@ __all__ = [
 ]
 
 _KINDS = ("put_fail", "put_stall", "nan", "abort", "probe_timeout",
-          "read_fail", "read_stall", "corrupt_shard")
+          "read_fail", "read_stall", "corrupt_shard", "cold_tier")
 
 
 class FaultSpecError(ValueError):
@@ -142,7 +156,9 @@ class _Injector:
         self.p = params.pop("p", None)
         self.times = params.pop("times", 1)
         self.seed = params.pop("seed", 0)
-        self.stall_s = params.pop("s", 0.25)
+        self.stall_s = params.pop("s", 0.25 if kind != "cold_tier"
+                                  else 0.05)
+        self.per_mb = params.pop("per_mb", 0.0)
         self.count = params.pop("n", 1)
         if params:
             raise FaultSpecError(
@@ -187,7 +203,7 @@ def _parse_value(key, raw):
         return frozenset(int(t) for t in raw.split("/"))
     if key in ("tile", "times", "seed", "n"):
         return int(raw)
-    if key in ("p", "s"):
+    if key in ("p", "s", "per_mb"):
         return float(raw)
     raise FaultSpecError(f"unknown fault param {key!r}")
 
@@ -288,6 +304,19 @@ class FaultPlan:
                 raise InjectedReadError(
                     f"injected transient shard-read failure at shard "
                     f"{shard_index}")
+
+    def on_cold(self, shard_index, nbytes):
+        """Cold-tier latency hook inside the supervised timed read
+        attempt: selected shards sleep the configured per-shard profile
+        (``s`` base latency + ``per_mb`` x stored MiB). First-touch by
+        default (``times=1``): the cold read pays the tier, re-reads are
+        warm."""
+        for inj in self._by_kind("cold_tier"):
+            if inj.matches(shard_index):
+                delay = inj.stall_s + inj.per_mb * (int(nbytes) / 2**20)
+                self._record("cold_tier", shard_index,
+                             stall_s=round(delay, 6))
+                time.sleep(delay)
 
     def corrupt_read(self, arr, shard_index):
         """Flip the first bytes of a materialized shard (returns the

@@ -23,9 +23,30 @@ cache entirely; ``SQ_SERVE_CACHE_ENTRIES`` bounds the LRU (default 256
 request-sized results). Process-global, thread-safe; stored results are
 returned as copies so a caller mutating its response can never poison a
 later hit.
+
+**Disk spill tier** (``SQ_SERVE_CACHE_DIR``, ISSUE 13): with a spill
+directory set, results evicted from the RAM LRU land on disk as
+digest-keyed compressed entries (one file per key: a JSON header carrying
+the FULL cache key + result shape/dtype, then the
+:func:`sq_learn_tpu.native.compress_array` payload with a CRC over the
+stored bytes — the oocore recipe at request scale). A RAM miss falls
+through to the disk tier; a disk hit verifies the header key (including
+the content digest) AND the payload CRC before decoding, promotes the
+entry back into RAM, and counts as a hit. Anything wrong — key mismatch
+(a filename-hash collision), CRC mismatch, decode failure — is a miss,
+never an error: the dispatcher recomputes. Because the key is the model
+fingerprint plus the request's content digest, a large tenant working
+set survives process restarts and registry evictions: a fresh process
+pointed at the same directory serves digest-verified disk hits without
+touching a kernel. Writes are atomic (tmp + rename); the tier is
+bounded by ``SQ_SERVE_CACHE_DISK_ENTRIES`` (default 4096, oldest-mtime
+pruned). Spills and disk hits are obs counters
+(``serving.cache_spills`` / ``serving.cache_disk_hits``).
 """
 
 import collections
+import hashlib
+import json
 import os
 import threading
 
@@ -34,12 +55,22 @@ import numpy as np
 from .. import obs as _obs
 from ..sketch.cache import data_digest
 
-__all__ = ["clear", "enabled", "flush_counters", "key_for", "lookup",
-           "stats", "store"]
+__all__ = ["cache_dir", "clear", "enabled", "flush_counters", "key_for",
+           "lookup", "spill_all", "stats", "store"]
 
 
 def _max_entries():
     return int(os.environ.get("SQ_SERVE_CACHE_ENTRIES", 256))
+
+
+def _max_disk_entries():
+    return int(os.environ.get("SQ_SERVE_CACHE_DISK_ENTRIES", 4096))
+
+
+def cache_dir():
+    """The disk spill directory (``SQ_SERVE_CACHE_DIR``), or None when
+    the tier is off."""
+    return os.environ.get("SQ_SERVE_CACHE_DIR") or None
 
 
 _lock = threading.Lock()
@@ -54,49 +85,64 @@ _store = collections.OrderedDict()
 _FLUSH_EVERY = 256
 _hits = 0
 _misses = 0
-_pending_hits = 0
-_pending_misses = 0
+_disk_hits = 0
+_spills = 0
+_pending = {"hits": 0, "misses": 0, "disk_hits": 0, "spills": 0}
+
+#: obs counter name per pending tally key
+_COUNTERS = {"hits": "serving.cache_hits",
+             "misses": "serving.cache_misses",
+             "disk_hits": "serving.cache_disk_hits",
+             "spills": "serving.cache_spills"}
 
 
 def stats():
-    """Cumulative process-wide {hits, misses} (includes not-yet-flushed
-    events — the fine-grained view tests and smokes read)."""
+    """Cumulative process-wide {hits, misses, disk_hits, spills}
+    (includes not-yet-flushed events — the fine-grained view tests and
+    smokes read). ``hits`` includes the disk hits."""
     with _lock:
-        return {"hits": _hits, "misses": _misses}
+        return {"hits": _hits, "misses": _misses, "disk_hits": _disk_hits,
+                "spills": _spills}
 
 
-def _count(hit):
-    global _hits, _misses, _pending_hits, _pending_misses
+def _count(kind):
+    global _hits, _misses, _disk_hits, _spills
     with _lock:
-        if hit:
+        if kind == "hits":
             _hits += 1
-            _pending_hits += 1
-        else:
+        elif kind == "misses":
             _misses += 1
-            _pending_misses += 1
-        if _pending_hits + _pending_misses < _FLUSH_EVERY:
+        elif kind == "disk_hits":
+            _hits += 1  # a disk hit IS a hit — plus its own tier counter
+            _disk_hits += 1
+        else:
+            _spills += 1
+        _pending[kind] += 1
+        if kind == "disk_hits":
+            _pending["hits"] += 1
+        if sum(_pending.values()) < _FLUSH_EVERY:
             return
-        ph, pm = _pending_hits, _pending_misses
-        _pending_hits = _pending_misses = 0
-    _flush(ph, pm)
+        deltas = dict(_pending)
+        for k in _pending:
+            _pending[k] = 0
+    _flush(deltas)
 
 
-def _flush(ph, pm):
-    if ph:
-        _obs.counter_add("serving.cache_hits", ph)
-    if pm:
-        _obs.counter_add("serving.cache_misses", pm)
+def _flush(deltas):
+    for kind, delta in deltas.items():
+        if delta:
+            _obs.counter_add(_COUNTERS[kind], delta)
 
 
 def flush_counters():
-    """Push the pending hit/miss deltas into the obs counters (one JSONL
-    line per counter, not per event). Dispatchers call this at close so
-    bench ``obs`` objects and reports carry exact totals."""
-    global _pending_hits, _pending_misses
+    """Push the pending hit/miss/spill deltas into the obs counters (one
+    JSONL line per counter, not per event). Dispatchers call this at
+    close so bench ``obs`` objects and reports carry exact totals."""
     with _lock:
-        ph, pm = _pending_hits, _pending_misses
-        _pending_hits = _pending_misses = 0
-    _flush(ph, pm)
+        deltas = dict(_pending)
+        for k in _pending:
+            _pending[k] = 0
+    _flush(deltas)
 
 
 def enabled():
@@ -129,32 +175,176 @@ def key_for(fingerprint, op, X):
         return None  # exotic payloads: skip the cache, never the request
 
 
+# -- disk spill tier ---------------------------------------------------------
+
+
+def _key_json(key):
+    """Canonical JSON of a cache key (tuples → lists, stable order) —
+    both the spill filename input and the header the hit verifies."""
+    fingerprint, op, shape, dtype, digest = key
+    return json.dumps([str(fingerprint), str(op),
+                       [int(s) for s in shape], str(dtype), int(digest)],
+                      separators=(",", ":"))
+
+
+def _spill_path(root, kj):
+    return os.path.join(root, hashlib.sha1(kj.encode()).hexdigest() + ".sqc")
+
+
+def _spill(key, result):
+    """Write one evicted entry to the disk tier: JSON header line (full
+    key + result shape/dtype + stored-bytes CRC) then the compressed
+    payload. Atomic (tmp + rename); failures are swallowed — a cache
+    must never fail the serving path."""
+    root = cache_dir()
+    if root is None:
+        return
+    from .. import native
+
+    try:
+        os.makedirs(root, exist_ok=True)
+        kj = _key_json(key)
+        payload = native.compress_array(result)
+        header = json.dumps({
+            "key": json.loads(kj),
+            "shape": [int(s) for s in result.shape],
+            "dtype": str(result.dtype),
+            "stored": len(payload),
+            "crc": native.crc32(payload),
+        }, separators=(",", ":"))
+        path = _spill_path(root, kj)
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "wb") as fh:
+            fh.write(header.encode() + b"\n")
+            fh.write(payload)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+        _count("spills")
+        _prune(root)
+    except OSError:
+        return
+
+
+def _prune(root):
+    """Bound the disk tier at ``SQ_SERVE_CACHE_DISK_ENTRIES`` entries,
+    oldest mtime first (checked every 64 spills — a scandir per spill
+    would dominate small-result writes)."""
+    if _spills % 64:
+        return
+    cap = _max_disk_entries()
+    try:
+        entries = [e for e in os.scandir(root) if e.name.endswith(".sqc")]
+        if len(entries) <= cap:
+            return
+        entries.sort(key=lambda e: e.stat().st_mtime)
+        for e in entries[:len(entries) - cap]:
+            os.unlink(e.path)
+    except OSError:
+        return
+
+
+def _disk_lookup(key):
+    """Disk-tier lookup: parse the header, verify the FULL key (the
+    digest-verified claim — a filename-hash collision or stale file can
+    never serve wrong rows) and the payload CRC, then decode. Returns
+    the result array or None; every failure mode is a miss."""
+    root = cache_dir()
+    if root is None:
+        return None
+    from .. import native
+
+    kj = _key_json(key)
+    path = _spill_path(root, kj)
+    try:
+        with open(path, "rb") as fh:
+            header = json.loads(fh.readline().decode())
+            payload = fh.read()
+    except (OSError, ValueError):
+        return None
+    try:
+        if header["key"] != json.loads(kj):
+            return None
+        if len(payload) != int(header["stored"]):
+            return None
+        if native.crc32(payload) != int(header["crc"]):
+            return None
+        return native.decompress_array(
+            payload, np.dtype(header["dtype"]), tuple(header["shape"]))
+    except (KeyError, TypeError, ValueError):
+        return None
+
+
+# -- the public lookup/store surface -----------------------------------------
+
+
 def lookup(key):
     """Cached response rows for ``key`` (LRU-touch on hit; returns a
     copy), tallying the outcome into the pre-aggregated
-    ``serving.cache_hits`` / ``serving.cache_misses`` counters."""
+    ``serving.cache_hits`` / ``serving.cache_misses`` counters. A RAM
+    miss falls through to the disk spill tier when armed; a verified
+    disk hit is promoted back into the RAM LRU."""
     if key is None:
         return None
     with _lock:
         hit = _store.get(key)
         if hit is not None:
             _store.move_to_end(key)
-    _count(hit is not None)
-    return np.array(hit, copy=True) if hit is not None else None
+    if hit is not None:
+        _count("hits")
+        return np.array(hit, copy=True)
+    disk = _disk_lookup(key)
+    if disk is not None:
+        _insert(key, np.array(disk, copy=True))
+        _count("disk_hits")
+        return np.array(disk, copy=True)
+    _count("misses")
+    return None
 
 
-def store(key, result):
-    if key is None:
-        return
-    result = np.array(result, copy=True)
+def _insert(key, result):
+    """RAM-LRU insert; evictions spill to the disk tier when armed."""
+    evicted = []
     with _lock:
         _store[key] = result
         _store.move_to_end(key)
         cap = _max_entries()
         while len(_store) > cap:
-            _store.popitem(last=False)
+            evicted.append(_store.popitem(last=False))
+    for k, v in evicted:
+        _spill(k, v)
 
 
-def clear():
+def store(key, result):
+    if key is None:
+        return
+    _insert(key, np.array(result, copy=True))
+
+
+def spill_all():
+    """Flush every RAM-resident entry to the disk tier (no eviction) —
+    the warm-shutdown hook for operators who want the whole working set
+    to survive a restart, not just the evicted tail. No-op without
+    ``SQ_SERVE_CACHE_DIR``."""
+    if cache_dir() is None:
+        return 0
+    with _lock:
+        items = list(_store.items())
+    for k, v in items:
+        _spill(k, v)
+    return len(items)
+
+
+def clear(disk=False):
+    """Drop the RAM LRU (and, with ``disk=True``, the spill tier's
+    files — the default keeps them: surviving process restarts is the
+    tier's whole point)."""
     with _lock:
         _store.clear()
+    if disk and cache_dir() is not None:
+        try:
+            for e in os.scandir(cache_dir()):
+                if e.name.endswith(".sqc"):
+                    os.unlink(e.path)
+        except OSError:
+            pass

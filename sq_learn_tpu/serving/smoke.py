@@ -27,6 +27,13 @@ seconds.
 7. a **second process** re-warms a subset of the ladder against the
    same persistent cache directory and must report ≥1 persistent-cache
    hit — the restart-starts-warm claim;
+7b. a **feature-cache spill leg** (ISSUE 13): with ``SQ_SERVE_CACHE_DIR``
+   armed and a 2-entry RAM LRU, an eviction spills a transform result
+   to the compressed disk tier; re-requesting it serves a
+   digest-verified disk hit bit-equal to compute, and a FRESH process
+   (empty RAM cache, no AOT warm, budgets pinned 0) replays the same
+   bytes and serves ≥1 disk hit with ZERO jit compiles — the
+   working-set-survives-restart claim;
 8. a **forced SLO violation** (ISSUE 12): a tenant registered with an
    impossible p99 target must burn its error budget in every window —
    ``alerting`` budget records + an ``alert`` record land at close, a
@@ -63,6 +70,39 @@ def persistent_probe(ckpt_dir):
     stats = aot.persistent_cache_stats()
     print(json.dumps({"persistent_probe": stats,
                       "aot_executables": aot.cache_size()}))
+    return 0
+
+
+def spill_probe(ckpt_dir, rows_path):
+    """Second-process feature-cache leg (ISSUE 13): a FRESH process —
+    empty RAM cache, no AOT warm, compile budgets pinned to 0 under the
+    inherited ``SQ_OBS_STRICT=1`` — registers the same checkpoint,
+    replays the same request bytes, and must serve it as a
+    digest-verified disk hit from the parent's ``SQ_SERVE_CACHE_DIR``
+    without touching a kernel (zero jit compiles). Reports one JSON line
+    the parent asserts on."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from .. import native
+    from . import (MicroBatchDispatcher, ModelRegistry,
+                   kernel_cache_sizes, pin_compile_budgets)
+    from . import cache as serve_cache
+
+    pin_compile_budgets(0)
+    reg = ModelRegistry()
+    reg.register("probe", ckpt_dir)
+    rows = np.load(rows_path)
+    d = MicroBatchDispatcher(reg, background=False)
+    out = d.serve("probe", "transform", rows)
+    d.close()
+    print(json.dumps({"spill_probe": {
+        **serve_cache.stats(),
+        "jit_compiles": sum(kernel_cache_sizes().values()),
+        "out_crc": int(native.crc32(np.ascontiguousarray(out))),
+    }}))
     return 0
 
 
@@ -210,6 +250,61 @@ def main():
     dq.close()
     del os.environ["SQ_OBS_AUDIT_STRICT"]
 
+    # feature-cache spill leg (ISSUE 13): with a spill dir armed and a
+    # 2-entry RAM LRU, three distinct transform payloads force an
+    # eviction to disk; re-requesting the evicted payload must come back
+    # as a digest-verified DISK hit, bit-equal to the computed response.
+    # Then a FRESH process (empty RAM cache, no warm, budgets pinned 0)
+    # replays the same bytes against the same dir and must serve ≥1 disk
+    # hit with zero jit compiles — the survives-restart claim.
+    spill_dir = os.path.join(tmp, "feature_cache")
+    os.environ["SQ_SERVE_CACHE_DIR"] = spill_dir
+    os.environ["SQ_SERVE_CACHE_ENTRIES"] = "2"
+    serve_cache.clear()
+    spill_rows = [requests[1][2], requests[4][2], requests[7][2]]
+    dsp = MicroBatchDispatcher(reg, background=False)
+    spill_ref = [dsp.serve("alpha", "transform", r) for r in spill_rows]
+    check(serve_cache.stats()["spills"] >= 1,
+          "RAM-LRU eviction spilled nothing to the disk tier")
+    dh0 = serve_cache.stats()["disk_hits"]
+    again = dsp.serve("alpha", "transform", spill_rows[0])
+    dsp.close()
+    check(serve_cache.stats()["disk_hits"] == dh0 + 1,
+          "evicted payload did not come back as a disk hit")
+    check(np.array_equal(again, spill_ref[0]),
+          "disk hit diverged from the computed response")
+    check(get_recorder().counters.get("serving.cache_spills", 0) >= 1,
+          "close() did not flush the spill counter")
+    rows_path = os.path.join(tmp, "spill_probe_rows.npy")
+    np.save(rows_path, spill_rows[0])
+    sp = subprocess.run(
+        [sys.executable, "-m", "sq_learn_tpu.serving.smoke",
+         "--spill-probe", alpha_dir, rows_path],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "SQ_SERVE_CACHE_DIR": spill_dir,
+             "SQ_OBS": "0", "SQ_OBS_STRICT": "1"})
+    probe_stats = {}
+    for line in sp.stdout.splitlines():
+        try:
+            probe_stats = json.loads(line)["spill_probe"]
+            break
+        except (ValueError, KeyError):
+            continue
+    check(sp.returncode == 0,
+          f"spill probe failed rc={sp.returncode}: {sp.stderr[-500:]}")
+    check(probe_stats.get("disk_hits", 0) >= 1,
+          f"second process served no disk hit ({probe_stats})")
+    check(probe_stats.get("jit_compiles", -1) == 0,
+          f"second process minted jit compiles ({probe_stats})")
+    from ..native import crc32 as _crc32
+
+    check(probe_stats.get("out_crc")
+          == int(_crc32(np.ascontiguousarray(spill_ref[0]))),
+          "second process's disk-hit rows differ from the computed "
+          "response")
+    for knob in ("SQ_SERVE_CACHE_DIR", "SQ_SERVE_CACHE_ENTRIES"):
+        os.environ.pop(knob, None)
+
     # forced-violation leg (ISSUE 12): a tenant with an impossible p99
     # target burns its whole latency budget in every window — the close
     # must emit `alerting` budget records + an `alert` record, and
@@ -312,4 +407,6 @@ if __name__ == "__main__":
     argv = sys.argv[1:]
     if argv[:1] == ["--persistent-probe"]:
         raise SystemExit(persistent_probe(argv[1]))
+    if argv[:1] == ["--spill-probe"]:
+        raise SystemExit(spill_probe(argv[1], argv[2]))
     raise SystemExit(main())

@@ -543,3 +543,160 @@ class TestCrc32:
         buf = rng.integers(0, 255, size=1000, dtype=np.uint8)
         assert nat.crc32(buf) == (zlib.crc32(buf) & 0xFFFFFFFF)
         assert nat.crc32(buf, 7) == (zlib.crc32(buf, 7) & 0xFFFFFFFF)
+
+
+class TestLz4Codec:
+    """LZ4-class block codec (ISSUE 13 oracle class): round-trip bit
+    parity on every buffer shape, native-vs-portable-fallback stream
+    parity (a store written by either path re-opens under the other),
+    bounds-checked failure on malformed input, and the compressed-CRC
+    corruption -> quarantine -> bounded re-read path of the codec shard
+    store."""
+
+    def _cases(self):
+        rng = np.random.default_rng(0)
+        return [
+            b"",                                        # empty
+            b"a",                                       # single byte
+            b"abcd" * 200,                              # trivially periodic
+            bytes(2048),                                # constant zeros
+            bytes(rng.integers(0, 256, 13, dtype=np.uint8)),   # < MFLIMIT
+            bytes(rng.integers(0, 256, 100_000, dtype=np.uint8)),  # incompressible
+            bytes(rng.integers(0, 4, 3001, dtype=np.uint8)),   # low entropy,
+                                                               # unaligned len
+            bytes(rng.integers(0, 256, 65_537, dtype=np.uint8)),  # > offset
+                                                                  # window
+            b"The quick brown fox jumps over the lazy dog. " * 117,
+        ]
+
+    def test_round_trip_bit_parity(self):
+        for i, buf in enumerate(self._cases()):
+            comp = native.lz4_compress(buf)
+            assert len(comp) <= native.lz4_bound(len(buf)), f"case {i}"
+            back = native.lz4_decompress(comp, len(buf)).tobytes()
+            assert back == buf, f"case {i} round-trip"
+
+    def test_native_and_fallback_streams_are_identical(self, monkeypatch):
+        """The portable fallback must produce BYTE-IDENTICAL compressed
+        streams (same greedy matcher by construction) — and each side
+        must decompress the other's output."""
+        import sq_learn_tpu.native as nat
+
+        assert nat.native_available(), "native lib did not build"
+        for i, buf in enumerate(self._cases()):
+            comp_native = nat.lz4_compress(buf)
+            comp_py = nat._lz4_compress_py(buf)
+            assert comp_native == comp_py, f"case {i} streams differ"
+            assert nat._lz4_decompress_py(comp_native, len(buf)) == buf
+            assert nat.lz4_decompress(comp_py, len(buf)).tobytes() == buf
+
+    def test_fallback_path_round_trips(self, monkeypatch):
+        import sq_learn_tpu.native as nat
+
+        monkeypatch.setattr(nat, "_load", lambda: None)
+        rng = np.random.default_rng(5)
+        arr = (rng.integers(0, 16, (64, 9)) / 8.0).astype(np.float32)
+        payload = nat.compress_array(arr)
+        np.testing.assert_array_equal(
+            nat.decompress_array(payload, arr.dtype, arr.shape), arr)
+
+    def test_malformed_input_raises_never_overruns(self):
+        comp = native.lz4_compress(b"hello world, hello world, hello you")
+        for bad, n in [(comp[:-3], 36), (b"\xff\xff", 36), (b"", 36),
+                       (comp, 4), (comp, 400)]:
+            with pytest.raises(ValueError):
+                native.lz4_decompress(bad, n)
+        # flipped token/offset bytes: every prefix mutation must either
+        # raise or round-trip to the wrong bytes — never crash
+        for i in range(min(len(comp), 8)):
+            bad = bytearray(comp)
+            bad[i] ^= 0xFF
+            try:
+                native.lz4_decompress(bytes(bad), 36)
+            except ValueError:
+                pass
+
+    def test_array_codec_filters_and_round_trip(self):
+        rng = np.random.default_rng(6)
+        pixels = (rng.integers(0, 255, (300, 28)) / 255.0).astype(
+            np.float32)
+        pixels[rng.random(pixels.shape) < 0.7] = 0.0
+        gauss = rng.normal(size=(200, 33)).astype(np.float32)
+        noise_u8 = rng.integers(0, 256, (64, 127), dtype=np.uint8)
+        for arr in (pixels, gauss, noise_u8,
+                    np.zeros((100, 7), np.float32),
+                    np.empty((0, 5), np.float32),
+                    rng.normal(size=(100,)).astype(np.float64),
+                    rng.integers(0, 2**31, (50, 3)).astype(np.int32)):
+            payload = native.compress_array(arr)
+            assert payload[0] in (0, 1, 2)  # plain / shuffled / raw
+            back = native.decompress_array(payload, arr.dtype, arr.shape)
+            assert back.dtype == arr.dtype and back.shape == arr.shape
+            np.testing.assert_array_equal(back, arr)
+        # sparse quantized pixels must actually compress; iid noise must
+        # cost at most the 1-byte raw header
+        assert len(native.compress_array(pixels)) < 0.7 * pixels.nbytes
+        assert len(native.compress_array(noise_u8)) <= noise_u8.nbytes + 1
+
+    def test_byte_shuffle_inverse(self):
+        rng = np.random.default_rng(7)
+        arr = rng.normal(size=(41, 7)).astype(np.float32)
+        planes = native.byte_shuffle(arr)
+        assert planes.size == arr.nbytes
+        back = native.byte_unshuffle(planes, arr.dtype.itemsize)
+        np.testing.assert_array_equal(
+            back.view(arr.dtype).reshape(arr.shape), arr)
+        with pytest.raises(ValueError):
+            native.byte_unshuffle(np.zeros(7, np.uint8), 4)
+
+    def test_decompress_size_mismatch_raises(self):
+        arr = np.arange(64, dtype=np.float32).reshape(8, 8)
+        payload = native.compress_array(arr)
+        with pytest.raises(ValueError):
+            native.decompress_array(payload, np.float32, (9, 8))
+        with pytest.raises(ValueError):
+            native.decompress_array(b"", np.float32, (8, 8))
+        with pytest.raises(ValueError):
+            native.decompress_array(bytes([9]) + payload[1:],
+                                    np.float32, (8, 8))
+
+    def test_compressed_crc_corruption_quarantine_reread(self, tmp_path,
+                                                         monkeypatch):
+        """The ISSUE 13 store contract: a corrupted STORED payload is
+        caught by the compressed-bytes CRC BEFORE the decoder runs,
+        quarantined, and recovered through the bounded re-read; a
+        persistent corruption exhausts ``SQ_OOC_REREAD_MAX`` and
+        surfaces with provenance."""
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from sq_learn_tpu import oocore
+        from sq_learn_tpu.resilience import faults
+
+        rng = np.random.default_rng(8)
+        X = (rng.integers(0, 255, (600, 16)) / 255.0).astype(np.float32)
+        store = oocore.store_from_array(str(tmp_path / "s"), X,
+                                        shard_bytes=8 * 1024, codec="lz4")
+        plan = faults.arm("corrupt_shard:tiles=1,times=1")
+        try:
+            np.testing.assert_array_equal(
+                store.read_shard(1),
+                X[store.shard_sizes[0]:store.shard_sizes[0]
+                  + store.shard_sizes[1]])
+        finally:
+            faults.disarm()
+        assert any(e["kind"] == "corrupt_shard" for e in plan.events)
+        assert 1 not in store.quarantined  # re-read recovered
+        # persistent corruption: every re-read sees the flip -> exhaust
+        monkeypatch.setenv("SQ_OOC_REREAD_MAX", "2")
+        plan = faults.arm("corrupt_shard:tiles=2,times=99")
+        try:
+            with pytest.raises(oocore.ShardCorruptionError,
+                               match="shard 2"):
+                store.read_shard(2)
+        finally:
+            faults.disarm()
+        assert 2 in store.quarantined
+        # 1 initial + SQ_OOC_REREAD_MAX re-reads, all corrupted
+        assert sum(1 for e in plan.events
+                   if e["kind"] == "corrupt_shard") == 3

@@ -161,7 +161,7 @@ def test_schema_v5_envelope_and_new_types(run, tmp_path):
     finally:
         obs.disable()
     recs = [json.loads(l) for l in open(path)]
-    assert all(r["v"] == 6 and r["schema_version"] == 6 for r in recs)
+    assert all(r["v"] == 7 and r["schema_version"] == 7 for r in recs)
     summary = validate_jsonl(path)
     assert summary["errors"] == []
     assert summary["by_type"]["xla_cost"] == 1
@@ -177,20 +177,20 @@ def test_schema_validates_regression_records():
 
 
 def test_schema_rejects_unknown_version_and_mismatch():
-    assert validate_record({"v": 7, "schema_version": 7, "ts": 0.0,
+    assert validate_record({"v": 8, "schema_version": 8, "ts": 0.0,
                             "type": "gauge", "name": "g", "value": 1})
     assert validate_record({"v": 2, "schema_version": 1, "ts": 0.0,
                             "type": "gauge", "name": "g", "value": 1})
     # v2+ records must carry the schema_version alias
     assert validate_record({"v": 2, "ts": 0.0, "type": "gauge",
                             "name": "g", "value": 1})
-    assert validate_record({"v": 6, "ts": 0.0, "type": "gauge",
+    assert validate_record({"v": 7, "ts": 0.0, "type": "gauge",
                             "name": "g", "value": 1})
-    # v1 lines (pre-v2 files) still validate without it, and v2..v5
-    # lines (pre-v6 files) validate with it
+    # v1 lines (pre-v2 files) still validate without it, and v2..v6
+    # lines (pre-v7 files) validate with it
     assert validate_record({"v": 1, "ts": 0.0, "type": "gauge",
                             "name": "g", "value": 1}) == []
-    for v in (2, 3, 4, 5):
+    for v in (2, 3, 4, 5, 6):
         assert validate_record({"v": v, "schema_version": v, "ts": 0.0,
                                 "type": "gauge", "name": "g",
                                 "value": 1}) == []

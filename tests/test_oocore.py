@@ -758,3 +758,213 @@ class TestParallelStoreBuild:
         assert mp == ms
         np.testing.assert_array_equal(par.read_rows(0, 900),
                                       ser.read_rows(0, 900))
+
+
+class TestCodecStore:
+    """Compressed shard store (ISSUE 13): the disk representation
+    changes, nothing else does — every read surface, the prefetcher,
+    the fault matrix, and the fits must be bit-identical to the
+    uncompressed twin."""
+
+    @pytest.fixture()
+    def cstore(self, tmp_path):
+        return oocore.store_from_array(str(tmp_path / "cstore"), X_TALL,
+                                       shard_bytes=SHARD_BYTES,
+                                       codec="lz4")
+
+    def test_roundtrip_and_manifest(self, cstore, tmp_path):
+        assert cstore.codec == "lz4"
+        assert cstore.manifest["codec"] == "lz4"
+        assert cstore.stored_nbytes < cstore.nbytes
+        assert all("stored_bytes" in s for s in cstore.manifest["shards"])
+        for lo, hi in [(0, 2003), (250, 600), (700, 701), (1900, 2003)]:
+            np.testing.assert_array_equal(cstore.read_rows(lo, hi),
+                                          X_TALL[lo:hi])
+        idx = np.array([0, 255, 256, 1024, 2002])
+        np.testing.assert_array_equal(cstore.take(idx), X_TALL[idx])
+        re = oocore.open_store(cstore.path)
+        assert re.codec == "lz4"
+        np.testing.assert_array_equal(re.read_rows(0, 2003), X_TALL)
+
+    def test_env_default_codec(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("SQ_OOC_CODEC", "lz4")
+        st = oocore.store_from_array(str(tmp_path / "env"), X_TALL,
+                                     shard_bytes=SHARD_BYTES)
+        assert st.codec == "lz4" and st.stored_nbytes < st.nbytes
+        monkeypatch.setenv("SQ_OOC_CODEC", "zstd")
+        with pytest.raises(ValueError, match="SQ_OOC_CODEC"):
+            oocore.store_from_array(str(tmp_path / "bad"), X_TALL)
+
+    def test_uncompressed_manifest_has_no_codec_field(self, store):
+        # the pre-codec layout is untouched: codec "none" writes the
+        # exact old manifest (no codec key, no stored_bytes) and old
+        # stores keep loading bit-identically
+        assert store.codec == "none"
+        assert "codec" not in store.manifest
+        assert all("stored_bytes" not in s
+                   for s in store.manifest["shards"])
+        assert store.stored_nbytes == store.nbytes
+
+    def test_unknown_codec_refused(self, cstore):
+        import json
+
+        man = json.load(open(os.path.join(cstore.path, "manifest.json")))
+        man["codec"] = "zstd"
+        json.dump(man, open(os.path.join(cstore.path, "manifest.json"),
+                            "w"))
+        with pytest.raises(ValueError, match="unknown codec"):
+            oocore.open_store(cstore.path)
+
+    def test_engine_and_estimator_parity_vs_uncompressed(self, store,
+                                                         cstore):
+        from sq_learn_tpu.models import MiniBatchQKMeans
+
+        a = oocore.minibatch_epoch_fit(store, n_clusters=5,
+                                       batch_rows=256, max_epochs=2,
+                                       seed=3)
+        b = oocore.minibatch_epoch_fit(cstore, n_clusters=5,
+                                       batch_rows=256, max_epochs=2,
+                                       seed=3)
+        np.testing.assert_array_equal(a["centers"], b["centers"])
+        np.testing.assert_array_equal(a["counts"], b["counts"])
+        kw = dict(n_clusters=4, batch_size=512, max_iter=2, tol=0.0,
+                  n_init=1, max_no_improvement=None, compute_labels=False,
+                  random_state=0)
+        ea = MiniBatchQKMeans(**kw).fit(store)
+        eb = MiniBatchQKMeans(**kw).fit(cstore)
+        np.testing.assert_array_equal(ea.cluster_centers_,
+                                      eb.cluster_centers_)
+
+    def test_prefetched_fault_matrix_parity(self, store, cstore,
+                                            monkeypatch):
+        """read_fail + corrupt_shard over the compressed store at depth
+        3: retries, quarantine, bounded re-read and the decode all run
+        on worker threads, bit-identical to the serial uncompressed
+        walk."""
+        monkeypatch.setenv("SQ_RETRY_BACKOFF_S", "0.001")
+        monkeypatch.setenv("SQ_OOC_PREFETCH_DEPTH", "0")
+        ref = oocore.minibatch_epoch_fit(store, n_clusters=4,
+                                         batch_rows=256, max_epochs=2,
+                                         seed=1)
+        monkeypatch.setenv("SQ_OOC_PREFETCH_DEPTH", "3")
+        plan = faults.arm("read_fail:tiles=2,times=1;"
+                          "corrupt_shard:tiles=4,times=1")
+        try:
+            got = oocore.minibatch_epoch_fit(
+                oocore.open_store(cstore.path), n_clusters=4,
+                batch_rows=256, max_epochs=2, seed=1)
+        finally:
+            faults.disarm()
+        np.testing.assert_array_equal(ref["centers"], got["centers"])
+        kinds = {e["kind"] for e in plan.events}
+        assert {"read_fail", "corrupt_shard"} <= kinds
+
+    def test_qpca_gram_route_parity(self, store, cstore):
+        """The streamed Gram consumer (prefetched row walks) reads the
+        codec store bit-identically."""
+        from sq_learn_tpu.streaming import streamed_centered_gram
+
+        _, G_ref, _ = streamed_centered_gram(store, max_bytes=32 * 1024)
+        _, G, _ = streamed_centered_gram(cstore, max_bytes=32 * 1024)
+        np.testing.assert_array_equal(np.asarray(G), np.asarray(G_ref))
+
+    def test_budget_accounts_compressed_plus_raw(self, cstore,
+                                                 monkeypatch):
+        from sq_learn_tpu.oocore.prefetch import ShardPrefetcher
+
+        raw = max(int(s) * 16 * 4 for s in cstore.shard_sizes)
+        stored = max(cstore.shard_stored_sizes)
+        # budget: floor (2 raw shards) + one raw+stored claim, but NOT
+        # two — the ledger must stop the second worker's claim
+        budget = 2 * raw + (raw + stored) + stored // 2
+        monkeypatch.setenv("SQ_OOC_RAM_BUDGET_BYTES", str(budget))
+        pf = ShardPrefetcher(cstore, list(range(cstore.n_shards)),
+                             depth=4, threads=2)
+        try:
+            assert pf._extra[0] > 0  # codec stores claim stored+raw
+            out = [pf.get(i) for i in range(cstore.n_shards)]
+        finally:
+            pf.close()
+        np.testing.assert_array_equal(np.concatenate(out), X_TALL)
+
+    def test_single_materialization_budget_counts_payload(self, cstore,
+                                                          monkeypatch):
+        raw_shard = cstore.shard_sizes[0] * 16 * 4
+        # enough for the raw array alone but not payload + raw together
+        monkeypatch.setenv("SQ_OOC_RAM_BUDGET_BYTES", str(raw_shard + 16))
+        with pytest.raises(RamBudgetError):
+            cstore.read_shard(0)
+
+    def test_verify_off_decode_error_has_provenance(self, cstore,
+                                                    monkeypatch):
+        # flip bytes INSIDE the stored payload on disk; with CRC off the
+        # decoder is the last line of defense and must surface shard
+        # provenance, not crash
+        path = cstore._shard_path(1)
+        with open(path, "r+b") as fh:
+            fh.seek(-16, os.SEEK_END)
+            fh.write(b"\xff" * 16)
+        monkeypatch.setenv("SQ_OOC_VERIFY", "off")
+        with pytest.raises(ShardCorruptionError, match="shard 1"):
+            cstore.read_shard(1)
+
+    def test_store_from_array_parallel_build_manifest_parity(
+            self, tmp_path, monkeypatch):
+        """The ISSUE 13 satellite pin: store_from_array rides the same
+        build pool as create_synthetic_store, and its manifest is
+        byte-identical to a serial build's — for both codecs."""
+        import json
+
+        for codec in ("none", "lz4"):
+            monkeypatch.setenv("SQ_OOC_PREFETCH_THREADS", "3")
+            par = oocore.store_from_array(
+                str(tmp_path / f"par_{codec}"), X_TALL,
+                shard_bytes=SHARD_BYTES, codec=codec)
+            # window <= 1 forces the strictly serial loop
+            monkeypatch.setenv("SQ_OOC_RAM_BUDGET_BYTES",
+                               str(3 * SHARD_BYTES))
+            ser = oocore.store_from_array(
+                str(tmp_path / f"ser_{codec}"), X_TALL,
+                shard_bytes=SHARD_BYTES, codec=codec)
+            monkeypatch.delenv("SQ_OOC_RAM_BUDGET_BYTES")
+            assert par.fingerprint == ser.fingerprint
+            mp = json.load(open(os.path.join(par.path, "manifest.json")))
+            ms = json.load(open(os.path.join(ser.path, "manifest.json")))
+            assert mp == ms
+
+    def test_cold_tier_first_touch_and_bandwidth_model(self, cstore,
+                                                       recorder):
+        import time
+
+        plan = faults.arm("cold_tier:s=0.03,per_mb=0.5")
+        try:
+            t0 = time.perf_counter()
+            cstore.read_shard(0)
+            cold = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            cstore.read_shard(0)
+            warm = time.perf_counter() - t0
+        finally:
+            faults.disarm()
+        events = [e for e in plan.events if e["kind"] == "cold_tier"]
+        assert len(events) == 1  # times=1 default: first touch only
+        want = 0.03 + 0.5 * (cstore.shard_stored_sizes[0] / 2**20)
+        assert events[0]["stall_s"] == pytest.approx(want, rel=1e-4)
+        assert cold >= want and warm < want
+        assert any(e["kind"] == "cold_tier"
+                   for e in recorder.fault_events)
+
+    def test_cold_tier_spec_grammar(self):
+        plan = faults.FaultPlan("cold_tier:s=0.01,per_mb=0.2,times=3")
+        inj = plan.injectors[0]
+        assert (inj.kind, inj.stall_s, inj.per_mb, inj.times) == \
+            ("cold_tier", 0.01, 0.2, 3)
+        with pytest.raises(faults.FaultSpecError):
+            faults.FaultPlan("cold_tier:bad=1")
+
+    def test_codec_counters(self, cstore, recorder):
+        cstore.read_shard(0)
+        assert recorder.counters.get("oocore.codec_bytes_in", 0) == \
+            cstore.shard_stored_sizes[0]
+        assert recorder.counters.get("oocore.codec_bytes_out", 0) == \
+            cstore.shard_sizes[0] * 16 * 4

@@ -1,0 +1,201 @@
+"""Serving feature-cache disk spill tier (ISSUE 13).
+
+The tier's contract: results evicted from the RAM LRU land on disk as
+digest-keyed compressed entries; a RAM miss falls through, a disk hit
+verifies the FULL key (fingerprint + op + shape + dtype + content
+digest) AND the payload CRC before decoding, and every failure mode —
+tampered bytes, filename-hash collision, stale/foreign files — is a
+miss, never an error or wrong rows. Because keys are content-addressed,
+a fresh process (the registry-eviction / restart scenario) serves the
+same working set without touching a kernel.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from sq_learn_tpu import obs
+from sq_learn_tpu.models import QKMeans
+from sq_learn_tpu.serving import MicroBatchDispatcher, ModelRegistry
+from sq_learn_tpu.serving import cache as serve_cache
+from sq_learn_tpu.utils.checkpoint import save_estimator
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    serve_cache.clear()
+    yield
+    serve_cache.clear()
+
+
+@pytest.fixture()
+def spill_dir(tmp_path, monkeypatch):
+    d = str(tmp_path / "feature_cache")
+    monkeypatch.setenv("SQ_SERVE_CACHE_DIR", d)
+    return d
+
+
+def _entry(i, rows=6, cols=5, seed=None):
+    rng = np.random.default_rng(100 + i if seed is None else seed)
+    X = rng.normal(size=(rows, cols)).astype(np.float32)
+    key = serve_cache.key_for(f"fp{i % 2}", "transform", X)
+    val = rng.normal(size=(rows, 3)).astype(np.float32)
+    return key, val
+
+
+class TestSpillTier:
+    def test_eviction_spills_and_disk_hit_promotes(self, spill_dir,
+                                                   monkeypatch):
+        monkeypatch.setenv("SQ_SERVE_CACHE_ENTRIES", "2")
+        s0 = serve_cache.stats()
+        entries = [_entry(i) for i in range(4)]
+        for k, v in entries:
+            serve_cache.store(k, v)
+        s = serve_cache.stats()
+        # cap 2: the first two spilled on evict
+        assert s["spills"] - s0["spills"] == 2
+        assert len([f for f in os.listdir(spill_dir)
+                    if f.endswith(".sqc")]) == 2
+        got = serve_cache.lookup(entries[0][0])
+        np.testing.assert_array_equal(got, entries[0][1])
+        s = serve_cache.stats()
+        assert s["disk_hits"] - s0["disk_hits"] == 1
+        assert s["hits"] - s0["hits"] == 1
+        # promoted: the second lookup is a RAM hit
+        serve_cache.lookup(entries[0][0])
+        s = serve_cache.stats()
+        assert s["hits"] - s0["hits"] == 2
+        assert s["disk_hits"] - s0["disk_hits"] == 1
+
+    def test_restart_scenario_ram_cleared_disk_survives(self, spill_dir,
+                                                        monkeypatch):
+        monkeypatch.setenv("SQ_SERVE_CACHE_ENTRIES", "1")
+        entries = [_entry(i) for i in range(3)]
+        for k, v in entries:
+            serve_cache.store(k, v)
+        s0 = serve_cache.stats()
+        serve_cache.clear()  # the restart: RAM gone, disk intact
+        for k, v in entries[:2]:
+            got = serve_cache.lookup(k)
+            assert got is not None
+            np.testing.assert_array_equal(got, v)
+        assert serve_cache.stats()["disk_hits"] - s0["disk_hits"] >= 2
+
+    def test_digest_verification_tampered_payload_is_miss(self, spill_dir,
+                                                          monkeypatch):
+        monkeypatch.setenv("SQ_SERVE_CACHE_ENTRIES", "1")
+        (k0, v0), (k1, v1) = _entry(0), _entry(1)
+        serve_cache.store(k0, v0)
+        serve_cache.store(k1, v1)  # evicts + spills k0
+        path = serve_cache._spill_path(spill_dir, serve_cache._key_json(k0))
+        data = open(path, "rb").read()
+        with open(path, "wb") as fh:  # flip payload tail bytes
+            fh.write(data[:-4] + bytes(4))
+        s0 = serve_cache.stats()
+        serve_cache.clear()
+        assert serve_cache.lookup(k0) is None
+        assert serve_cache.stats()["disk_hits"] == s0["disk_hits"]
+
+    def test_header_key_mismatch_is_miss(self, spill_dir, monkeypatch):
+        """A file parked at the key's filename but carrying a different
+        full key (hash collision / stale tooling) must never serve."""
+        monkeypatch.setenv("SQ_SERVE_CACHE_ENTRIES", "1")
+        (k0, v0), (k1, v1) = _entry(0), _entry(1)
+        serve_cache.store(k0, v0)
+        serve_cache.store(k1, v1)  # spills k0
+        spilled = serve_cache._spill_path(spill_dir,
+                                          serve_cache._key_json(k0))
+        # park k0's file bytes at k1's filename: full-key check must miss
+        alias = serve_cache._spill_path(spill_dir,
+                                        serve_cache._key_json(k1))
+        os.replace(spilled, alias)
+        serve_cache.clear()
+        assert serve_cache.lookup(k1) is None
+        assert serve_cache.lookup(k0) is None
+
+    def test_no_dir_no_disk_tier(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("SQ_SERVE_CACHE_DIR", raising=False)
+        monkeypatch.setenv("SQ_SERVE_CACHE_ENTRIES", "1")
+        s0 = serve_cache.stats()
+        (k0, v0), (k1, v1) = _entry(0), _entry(1)
+        serve_cache.store(k0, v0)
+        serve_cache.store(k1, v1)
+        assert serve_cache.stats()["spills"] == s0["spills"]
+        assert serve_cache.lookup(k0) is None
+
+    def test_spill_all_persists_resident_entries(self, spill_dir):
+        entries = [_entry(i) for i in range(3)]
+        for k, v in entries:
+            serve_cache.store(k, v)
+        assert serve_cache.spill_all() == 3
+        serve_cache.clear()
+        for k, v in entries:
+            np.testing.assert_array_equal(serve_cache.lookup(k), v)
+
+    def test_clear_disk_true_drops_files(self, spill_dir, monkeypatch):
+        monkeypatch.setenv("SQ_SERVE_CACHE_ENTRIES", "1")
+        for i in range(3):
+            serve_cache.store(*_entry(i))
+        assert any(f.endswith(".sqc") for f in os.listdir(spill_dir))
+        serve_cache.clear(disk=True)
+        assert not any(f.endswith(".sqc") for f in os.listdir(spill_dir))
+
+    def test_counters_flush_to_recorder(self, spill_dir, tmp_path,
+                                        monkeypatch):
+        monkeypatch.setenv("SQ_SERVE_CACHE_ENTRIES", "1")
+        serve_cache.flush_counters()  # drain older tests' pendings
+        rec = obs.enable(str(tmp_path / "obs.jsonl"))
+        try:
+            (k0, v0), (k1, v1) = _entry(0), _entry(1)
+            serve_cache.store(k0, v0)
+            serve_cache.store(k1, v1)
+            serve_cache.lookup(k0)  # disk hit
+            serve_cache.flush_counters()
+            assert rec.counters.get("serving.cache_spills", 0) >= 1
+            assert rec.counters.get("serving.cache_disk_hits", 0) == 1
+            assert rec.counters.get("serving.cache_hits", 0) == 1
+        finally:
+            obs.disable()
+
+
+class TestDispatcherSpill:
+    def test_end_to_end_evict_then_disk_hit_bit_parity(self, spill_dir,
+                                                       monkeypatch,
+                                                       tmp_path):
+        """The smoke scenario in-process: tiny RAM LRU, distinct
+        transform payloads force an eviction, re-requesting the evicted
+        payload serves a digest-verified disk hit bit-equal to the
+        computed response — and a registry re-load (same checkpoint =
+        same fingerprint) still hits, because keys are content-
+        addressed, not tenant-addressed."""
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        monkeypatch.setenv("SQ_SERVE_CACHE_ENTRIES", "2")
+        rng = np.random.default_rng(0)
+        X = (rng.normal(size=(300, 8))
+             + 4.0 * rng.integers(0, 3, size=(300, 1))).astype(np.float32)
+        ckpt = save_estimator(QKMeans(n_clusters=3, random_state=0).fit(X),
+                              str(tmp_path / "ckpt"))
+        reg = ModelRegistry()
+        reg.register("t", ckpt)
+        payloads = [rng.normal(size=(4, 8)).astype(np.float32)
+                    for _ in range(3)]
+        d = MicroBatchDispatcher(reg, background=False)
+        ref = [d.serve("t", "transform", p) for p in payloads]
+        assert serve_cache.stats()["spills"] >= 1
+        dh0 = serve_cache.stats()["disk_hits"]
+        again = d.serve("t", "transform", payloads[0])
+        d.close()
+        assert serve_cache.stats()["disk_hits"] == dh0 + 1
+        np.testing.assert_array_equal(again, ref[0])
+        # fresh registry + RAM cache, same checkpoint: disk still serves
+        serve_cache.clear()
+        reg2 = ModelRegistry()
+        reg2.register("renamed", ckpt)
+        d2 = MicroBatchDispatcher(reg2, background=False)
+        out = d2.serve("renamed", "transform", payloads[1])
+        d2.close()
+        np.testing.assert_array_equal(out, ref[1])
+        assert serve_cache.stats()["disk_hits"] >= dh0 + 2
