@@ -7,7 +7,8 @@ PYTHON ?= python
 # Debian/Ubuntu CI runners) does not have
 SHELL := /bin/bash
 
-.PHONY: test test-fast test-timed test-fast-tier test-slow-tier lint bench \
+.PHONY: test test-fast test-timed test-fast-tier test-slow-tier lint \
+    lint-selftest bench \
     bench-smoke bench-suite multichip examples \
     hunt obs-smoke faults-smoke oocore-smoke serve-smoke regress-selftest \
     smoke obs-report obs-trace obs-frontier obs-audit obs-budget regress all
@@ -55,11 +56,20 @@ test-timed: test-fast-tier test-slow-tier
 test-fast:
 	$(PYTHON) -m pytest tests/ -q -m "not slow"
 
-# Syntax/bytecode check of every tree (no third-party linter is baked into
-# the runtime image; flake8 runs in CI where installable).
+# Bytecode-compile every tree, then sqcheck: the project-native invariant
+# rules (docs/static_analysis.md) + the generated-docs drift gate. flake8
+# still runs in CI where installable; sqcheck is stdlib-only and runs
+# everywhere.
 lint:
 	$(PYTHON) -m compileall -q sq_learn_tpu tests bench examples \
 	    bench.py __graft_entry__.py
+	$(PYTHON) -m sq_learn_tpu.analysis --check-docs
+
+# Prove every sqcheck rule still fires on its broken fixture (and stays
+# quiet on the good twin) — a rule that silently stopped matching is
+# worse than no rule.
+lint-selftest:
+	$(PYTHON) -m sq_learn_tpu.analysis --selftest
 
 # Headline benchmark (BASELINE.md config #1) — one JSON line.
 bench:
@@ -154,7 +164,8 @@ serve-smoke:
 
 # All contract smokes (observability + resilience + out-of-core +
 # serving + regression gate).
-smoke: obs-smoke faults-smoke oocore-smoke serve-smoke regress-selftest
+smoke: obs-smoke faults-smoke oocore-smoke serve-smoke regress-selftest \
+    lint-selftest
 
 # Render the human report / Chrome trace of an obs JSONL artifact
 # (default: the obs-smoke artifact; override with OBS=<path>).

@@ -6,9 +6,9 @@ extended with the ``device`` switch that BASELINE designates for TPU dispatch an
 default dtype knob (TPUs natively prefer float32/bfloat16).
 """
 
-import os
 import threading
 from contextlib import contextmanager
+from . import _knobs
 
 _global_config = {
     "device": "auto",  # 'auto' | 'tpu' | 'cpu'
@@ -171,7 +171,7 @@ def on_cpu_backend():
 #: ``bench/run_tpu_window.sh`` "chip_headline_unrouted", exists to do so
 #: in the first healthy tunnel window). Until that record lands, treat
 #: the cutoff as a conservative policy guess, not a measured constant.
-_TINY_FIT_ELEMENTS = int(os.environ.get("SQ_TINY_FIT_ELEMENTS", 1 << 18))
+_TINY_FIT_ELEMENTS = _knobs.get_int("SQ_TINY_FIT_ELEMENTS")
 
 
 def _default_backend_platform_no_init():
@@ -323,7 +323,7 @@ def enable_persistent_compilation_cache(path=None, min_entry_bytes=0,
     a fresh directory per run.
     """
     if path is None:
-        path = os.environ.get("SQ_COMPILE_CACHE_DIR")
+        path = _knobs.get_raw("SQ_COMPILE_CACHE_DIR")
     if not path:
         return None
     import jax
@@ -341,8 +341,7 @@ def enable_persistent_compilation_cache(path=None, min_entry_bytes=0,
 #: (never during small transfers), so keeping each relay transaction under
 #: 128 MB lets full-MNIST-sized operands (70k×784 f32 ≈ 220 MB) reach the
 #: chip as two transactions; the full array only ever exists in HBM.
-_TRANSFER_CHUNK_BYTES = int(
-    os.environ.get("SQ_TRANSFER_CHUNK_BYTES", 128 * 2 ** 20))
+_TRANSFER_CHUNK_BYTES = _knobs.get_int("SQ_TRANSFER_CHUNK_BYTES")
 
 
 def _put_host(x, device=None, max_bytes=None):

@@ -1,0 +1,395 @@
+"""Single source of truth for every environment knob the project reads.
+
+The paper's thesis makes (ε, δ) explicit *contracts*; this module does the
+same for the configuration surface. Every ``os.environ`` read in
+``sq_learn_tpu/`` (and the bench/test trees it ships with) goes through the
+typed accessors below, against a declarative registry entry carrying the
+knob's name, kind, default, owning scope, one-line doc, and the
+documentation anchor (the file whose prose describes it). The static
+checker (:mod:`sq_learn_tpu.analysis`, rule ``knob-registry``) enforces
+that no raw read exists outside this module, that every name passed to an
+accessor is registered, and that the registry and the knob tables in
+``CLAUDE.md`` / ``docs/`` cannot drift apart (``--check-docs``).
+
+Runtime contract:
+
+- Accessors validate the name against the registry and raise
+  :class:`UnknownKnobError` on a miss — a typo'd knob read fails loudly at
+  the call site instead of silently reading the default forever.
+- ``kind="flag"`` knobs follow the project's two historical spellings in
+  one rule: a knob whose registered default is **False** is enabled only
+  by ``"1"`` (``SQ_OBS_STRICT=1``); a knob whose default is **True** stays
+  enabled unless set to ``"0"`` (``SQ_SERVE_CACHE=0``). Both match the
+  pre-registry call sites bit-for-bit.
+- Family entries (name ending ``*``, e.g. ``SQ_REGRESS_TOL_*``) register a
+  whole prefix; dynamic reads like ``SQ_REGRESS_TOL_LATENCY`` resolve
+  through them.
+- This module imports nothing from the package and nothing heavy — it is
+  safe at interpreter start, inside sitecustomize'd processes, and from
+  worker threads.
+"""
+
+import os
+
+__all__ = [
+    "Knob",
+    "REGISTRY",
+    "UnknownKnobError",
+    "get_bool",
+    "get_float",
+    "get_int",
+    "get_raw",
+    "get_str",
+    "is_set",
+    "iter_knobs",
+    "knob",
+    "resolve",
+    "setdefault",
+    "snapshot",
+]
+
+_UNSET = object()
+
+
+class UnknownKnobError(KeyError):
+    """An environment knob was read that the registry does not declare."""
+
+
+class Knob:
+    """One declared environment knob (immutable value object)."""
+
+    __slots__ = ("name", "kind", "default", "scope", "doc", "anchor")
+
+    def __init__(self, name, kind, default, scope, doc, anchor):
+        object.__setattr__(self, "name", name)
+        object.__setattr__(self, "kind", kind)
+        object.__setattr__(self, "default", default)
+        object.__setattr__(self, "scope", scope)
+        object.__setattr__(self, "doc", doc)
+        object.__setattr__(self, "anchor", anchor)
+
+    def __setattr__(self, name, value):
+        raise AttributeError("Knob entries are immutable")
+
+    def __repr__(self):
+        return (f"Knob({self.name!r}, kind={self.kind!r}, "
+                f"default={self.default!r}, scope={self.scope!r})")
+
+    @property
+    def is_family(self):
+        return self.name.endswith("*")
+
+
+def _K(name, kind, default, scope, doc, anchor):
+    return Knob(name, kind, default, scope, doc, anchor)
+
+
+#: kinds: "flag" (bool, see module docstring), "int", "float", "str",
+#: "path" (a str naming a file/directory), "spec" (a str with its own
+#: mini-grammar parsed at the call site). scopes: "lib" (read inside
+#: sq_learn_tpu/), "bench", "test", "external" (owned by jax/XLA/the OS,
+#: read or written here but documented upstream).
+_ENTRIES = [
+    # -- observability (docs/observability.md) ---------------------------
+    _K("SQ_OBS", "flag", False, "lib",
+       "Enable the run-scoped recorder with a JSONL sink at SQ_OBS_PATH.",
+       "docs/observability.md"),
+    _K("SQ_OBS_PATH", "path", "sq_obs.jsonl", "lib",
+       "JSONL sink path for the SQ_OBS=1 auto-enabled recorder.",
+       "docs/observability.md"),
+    _K("SQ_OBS_STRICT", "flag", False, "lib",
+       "Retracing-watchdog compile-budget violations raise instead of "
+       "warning.", "docs/observability.md"),
+    _K("SQ_OBS_AUDIT_STRICT", "flag", False, "lib",
+       "A flagged (ε, δ)-guarantee audit site raises (Clopper-Pearson "
+       "lower bound above the declared δ/γ).", "docs/observability.md"),
+    _K("SQ_OBS_BUDGET_STRICT", "flag", False, "lib",
+       "A tripped multi-window error-budget burn alert raises "
+       "BudgetBurnError.", "docs/observability.md"),
+    _K("SQ_OBS_BUDGET_WINDOWS", "spec", "60,600", "lib",
+       "Comma-separated rolling error-budget windows in seconds.",
+       "docs/observability.md"),
+    _K("SQ_OBS_BUDGET_BURN", "float", 2.0, "lib",
+       "Multi-window burn-rate alert threshold (must hold in EVERY "
+       "window).", "docs/observability.md"),
+    _K("SQ_OBS_TRACE", "path", None, "lib",
+       "Render the closed run into Chrome trace-event JSON at this path.",
+       "docs/observability.md"),
+    _K("SQ_OBS_XLA_MEMORY", "flag", True, "lib",
+       "Compile-and-price memory stats in xla_cost records (0 skips the "
+       "compile).", "docs/observability.md"),
+    _K("SQ_REGRESS_TOL_*", "float", None, "lib",
+       "Per-gate tolerance override for the bench regression gate "
+       "(e.g. SQ_REGRESS_TOL_LATENCY).", "docs/observability.md"),
+    _K("SQ_REGRESS_SLACK_*", "float", None, "lib",
+       "Per-gate additive-slack override for the bench regression gate.",
+       "docs/observability.md"),
+    _K("SQ_CPU_PEAK_FLOPS", "float", None, "lib",
+       "Host peak-FLOPs override for MFU accounting.",
+       "docs/observability.md"),
+    _K("SQ_TPU_PEAK_FLOPS", "float", None, "lib",
+       "Accelerator peak-FLOPs override for MFU accounting.",
+       "docs/api.md"),
+    # -- resilience / probe (docs/resilience.md) -------------------------
+    _K("SQ_FAULTS", "spec", None, "lib",
+       "Deterministic fault-injection schedule (armed at import).",
+       "docs/resilience.md"),
+    _K("SQ_RESILIENCE_STRICT", "flag", False, "lib",
+       "Streamed passes raise on non-finite accumulators with tile "
+       "provenance.", "docs/resilience.md"),
+    _K("SQ_PROBE_TTL_S", "float", 300.0, "lib",
+       "TTL of a cached device-health probe result (0 disables caching).",
+       "docs/resilience.md"),
+    _K("SQ_PROBE_CACHE", "path", None, "lib",
+       "Cross-process probe-cache file (default: sq_probe_cache.json in "
+       "the temp dir).", "docs/observability.md"),
+    _K("SQ_RETRY_MAX", "int", 3, "lib",
+       "Supervised-put retry budget.", "docs/resilience.md"),
+    _K("SQ_RETRY_BACKOFF_S", "float", 0.05, "lib",
+       "Base backoff between supervised-put retries.",
+       "docs/resilience.md"),
+    _K("SQ_RETRY_SEED", "int", 0, "lib",
+       "Seed of the retry-jitter RNG.", "docs/resilience.md"),
+    _K("SQ_TILE_DEADLINE_S", "float", 30.0, "lib",
+       "Per-tile transfer deadline before a put counts as timed out.",
+       "docs/resilience.md"),
+    _K("SQ_BREAKER_K", "int", 3, "lib",
+       "Consecutive failures that trip the circuit breaker.",
+       "docs/resilience.md"),
+    _K("SQ_BREAKER_COOLDOWN_S", "float", 60.0, "lib",
+       "Open-state cooldown before the breaker half-opens.",
+       "docs/resilience.md"),
+    # -- streaming engine (docs/streaming.md) ----------------------------
+    _K("SQ_STREAM_TILE_BYTES", "int", None, "lib",
+       "Streamed-ingest tile size override (unset = auto-sized).",
+       "docs/streaming.md"),
+    _K("SQ_STREAM_MIN_BUCKET_ROWS", "int", 64, "lib",
+       "Smallest padded row bucket the streaming engine mints.",
+       "docs/streaming.md"),
+    _K("SQ_STREAM_CKPT_DIR", "path", None, "lib",
+       "Arm resumable streamed passes: checkpoint directory.",
+       "docs/resilience.md"),
+    _K("SQ_STREAM_CKPT_EVERY", "int", 8, "lib",
+       "Checkpoint cadence in tiles for resumable streamed passes.",
+       "docs/resilience.md"),
+    _K("SQ_TRANSFER_CHUNK_BYTES", "int", 128 * 2 ** 20, "lib",
+       "Largest single host→device transfer transaction.",
+       "docs/streaming.md"),
+    _K("SQ_TINY_FIT_ELEMENTS", "int", 1 << 18, "lib",
+       "Below this element count a fit skips the chip path (0 disables).",
+       "docs/api.md"),
+    _K("SQ_COMPILE_CACHE_DIR", "path", None, "lib",
+       "Persistent XLA compile-cache directory (AOT serving warm path).",
+       "docs/serving.md"),
+    # -- fit pipeline / sketch (docs/fit_pipeline.md) --------------------
+    _K("SQ_INIT_SUBSAMPLE", "int", None, "lib",
+       "D²-potential subsample target for k-means++ init (0 disables, "
+       "unset = auto).", "docs/fit_pipeline.md"),
+    _K("SQ_SKETCH_ROWS", "float", None, "lib",
+       "Row-sketch sample target for δ>0 spectral stats (0 disables, "
+       "unset = auto).", "docs/fit_pipeline.md"),
+    _K("SQ_SKETCH_DELTA", "float", None, "lib",
+       "δ_stat of the sketched spectral-stats bounds (0 = exact, unset = "
+       "0.05).", "docs/fit_pipeline.md"),
+    _K("SQ_SKETCH_AUDIT_ELEMS", "int", None, "lib",
+       "Cap on the sketch self-audit's ground-truth element count.",
+       "docs/fit_pipeline.md"),
+    _K("SQ_STATS_CACHE", "flag", True, "lib",
+       "Digest-keyed spectral-stats cache (0 disables).",
+       "docs/fit_pipeline.md"),
+    # -- out-of-core shard stores (docs/resilience.md §out-of-core) ------
+    _K("SQ_OOC_SHARD_BYTES", "int", 8 << 20, "lib",
+       "Shard split size for new out-of-core stores.",
+       "docs/resilience.md"),
+    _K("SQ_OOC_RAM_BUDGET_BYTES", "int", 0, "lib",
+       "Enforced single-materialization RAM budget (0 = off); also caps "
+       "readahead.", "docs/resilience.md"),
+    _K("SQ_OOC_VERIFY", "str", "all", "lib",
+       "Read-side CRC policy for shard stores: all | touch | off.",
+       "docs/resilience.md"),
+    _K("SQ_OOC_REREAD_MAX", "int", 2, "lib",
+       "Quarantine re-read budget after a CRC mismatch.",
+       "docs/resilience.md"),
+    _K("SQ_OOC_CODEC", "str", "none", "lib",
+       "Per-shard codec for NEW store builds (lz4 = native LZ4-class + "
+       "byte shuffle).", "docs/resilience.md"),
+    _K("SQ_OOC_PREFETCH_DEPTH", "int", None, "lib",
+       "Shard readahead depth (0 = serial bit-for-bit, unset = auto: 2 "
+       "multi-core / 0 single-core).", "docs/resilience.md"),
+    _K("SQ_OOC_PREFETCH_THREADS", "int", 2, "lib",
+       "Prefetch worker-pool width (also sizes parallel store builds).",
+       "docs/resilience.md"),
+    _K("SQ_OOC_ASYNC_CKPT", "flag", True, "lib",
+       "Async mid-epoch fit snapshots (0 = synchronous writes).",
+       "docs/resilience.md"),
+    # -- serving plane (docs/serving.md) ---------------------------------
+    _K("SQ_SERVE_MAX_WAIT_MS", "float", 2.0, "lib",
+       "Micro-batch coalescing window.", "docs/serving.md"),
+    _K("SQ_SERVE_MAX_BATCH_ROWS", "int", 512, "lib",
+       "Row cap of one padded serving batch.", "docs/serving.md"),
+    _K("SQ_SERVE_MIN_BUCKET_ROWS", "int", 8, "lib",
+       "Smallest padded pow2 serving bucket.", "docs/serving.md"),
+    _K("SQ_SERVE_REGISTRY_CAP", "int", 8, "lib",
+       "LRU capacity of the checkpoint-backed model registry.",
+       "docs/serving.md"),
+    _K("SQ_SERVE_AOT", "flag", True, "lib",
+       "AOT-compile the bucket ladder at registry warm (0 skips).",
+       "docs/serving.md"),
+    _K("SQ_SERVE_CACHE", "flag", True, "lib",
+       "Digest-keyed transform result cache (0 kills it).",
+       "docs/serving.md"),
+    _K("SQ_SERVE_CACHE_ENTRIES", "int", 256, "lib",
+       "RAM-LRU entry cap of the serving result cache.",
+       "docs/serving.md"),
+    _K("SQ_SERVE_CACHE_DIR", "path", None, "lib",
+       "Arm the serving cache's compressed disk-spill tier.",
+       "docs/serving.md"),
+    _K("SQ_SERVE_CACHE_DISK_ENTRIES", "int", 4096, "lib",
+       "Entry bound of the disk-spill tier.", "docs/serving.md"),
+    _K("SQ_SERVE_QUANTIZE", "str", None, "lib",
+       "Process-default serving quantization: bf16 | int8 | auto | "
+       "none.", "docs/serving.md"),
+    _K("SQ_SERVE_QUANT_DELTA", "float", 1e-3, "lib",
+       "Declared audit budget δ_q of the quantization fold.",
+       "docs/serving.md"),
+    _K("SQ_SERVE_AUDIT_EVERY", "int", 8, "lib",
+       "Quantization-fold guarantee-draw cadence in batches.",
+       "docs/serving.md"),
+    _K("SQ_SERVE_SLO_P50_MS", "float", None, "lib",
+       "Run-level p50 latency SLO target.", "docs/serving.md"),
+    _K("SQ_SERVE_SLO_P99_MS", "float", None, "lib",
+       "Run-level p99 latency SLO target.", "docs/serving.md"),
+    _K("SQ_SERVE_SLO_STRICT", "flag", False, "lib",
+       "A violated SLO raises at dispatcher close.", "docs/serving.md"),
+    _K("SQ_SERVE_SLO_FLUSH_BATCHES", "int", 256, "lib",
+       "Windowed slo/budget record flush stride in batches (0 "
+       "disables).", "docs/serving.md"),
+    # -- datasets --------------------------------------------------------
+    _K("CICIDS_CSV", "path", None, "lib",
+       "Path to a real CICIDS2017 CSV export (unset = deterministic "
+       "synthetic surrogate).", None),
+    # -- bench / test harness --------------------------------------------
+    _K("SQ_BENCH_SMOKE", "flag", False, "bench",
+       "Bench scripts run tiny CPU-safe shapes and skip accelerator "
+       "probes.", "docs/streaming.md"),
+    _K("SQ_OOC_BENCH_ARTIFACT_DIR", "path", None, "bench",
+       "Keep the out-of-core bench's store artifacts here (unset = "
+       "fresh temp dir).", None),
+    _K("SQ_TEST_CLEAR_CACHES", "flag", False, "test",
+       "Clear XLA caches between test modules (round-5 segfault "
+       "mitigation).", "docs/observability.md"),
+    _K("_SQ_SCALING_CHILD", "flag", False, "bench",
+       "Internal marker: this process is a sharded-scaling bench child.",
+       None),
+    # -- external (owned upstream; registered so reads are auditable) ----
+    _K("JAX_PLATFORMS", "str", None, "external",
+       "jax backend selection (axon tunnel vs cpu; see CLAUDE.md "
+       "gotchas).", "CLAUDE.md"),
+    _K("JAX_NUM_PROCESSES", "int", 0, "external",
+       "Multi-process mesh size for distributed initialization.", None),
+    _K("JAX_COMPILATION_CACHE_DIR", "path", None, "external",
+       "jax's own persistent compile-cache knob (bench suite).", None),
+    _K("XLA_FLAGS", "str", None, "external",
+       "XLA backend flags (the conftest's 8 virtual devices ride this).",
+       None),
+]
+
+#: name → Knob for exact entries; families keep their trailing ``*``
+REGISTRY = {e.name: e for e in _ENTRIES}
+
+_FAMILIES = tuple(e for e in _ENTRIES if e.is_family)
+
+if len(REGISTRY) != len(_ENTRIES):  # pragma: no cover - registry bug
+    raise RuntimeError("duplicate knob registration")
+
+
+def resolve(name):
+    """The :class:`Knob` entry governing ``name`` (exact match first,
+    then family prefix), or None when unregistered."""
+    e = REGISTRY.get(name)
+    if e is not None:
+        return e
+    for fam in _FAMILIES:
+        if name.startswith(fam.name[:-1]):
+            return fam
+    return None
+
+
+def knob(name):
+    """The :class:`Knob` entry for ``name``; raises
+    :class:`UnknownKnobError` when unregistered."""
+    e = resolve(name)
+    if e is None:
+        raise UnknownKnobError(
+            f"environment knob {name!r} is not in the sq_learn_tpu._knobs "
+            f"registry — register it there (one line) before reading it")
+    return e
+
+
+def iter_knobs():
+    """Every registry entry, name-sorted (the docs generator's input)."""
+    return sorted(_ENTRIES, key=lambda e: (e.scope != "lib", e.name))
+
+
+def is_set(name):
+    """True when the (registered) knob is present in the environment."""
+    knob(name)
+    return name in os.environ
+
+
+def get_raw(name, default=None):
+    """The raw string value of a registered knob, or ``default`` when
+    unset. The one accessor whose default is caller-supplied — use the
+    typed forms unless the call site owns a computed fallback."""
+    knob(name)
+    return os.environ.get(name, default)
+
+
+def _typed(name, default, conv):
+    e = knob(name)
+    raw = os.environ.get(name)
+    if raw is None:
+        return e.default if default is _UNSET else default
+    return conv(raw)
+
+
+def get_str(name, default=_UNSET):
+    """String knob value (registry default when unset)."""
+    return _typed(name, default, str)
+
+
+def get_int(name, default=_UNSET):
+    """Integer knob value (registry default when unset)."""
+    return _typed(name, default, int)
+
+
+def get_float(name, default=_UNSET):
+    """Float knob value (registry default when unset)."""
+    return _typed(name, default, float)
+
+
+def get_bool(name):
+    """Flag knob value under the project's two historical spellings:
+    default-False knobs enable only on ``"1"``; default-True knobs
+    disable only on ``"0"`` (any other non-empty value stays on)."""
+    e = knob(name)
+    raw = os.environ.get(name)
+    if raw is None:
+        return bool(e.default)
+    if e.default:
+        return raw != "0"
+    return raw == "1"
+
+
+def setdefault(name, value):
+    """``os.environ.setdefault`` for a registered knob (read+write —
+    smoke drivers pinning a default for child processes)."""
+    knob(name)
+    return os.environ.setdefault(name, str(value))
+
+
+def snapshot(names):
+    """{name: raw value or None} for registered knobs — the save half of
+    a smoke driver's save/mutate/restore dance. Restore with plain env
+    writes (writes are not registry-gated)."""
+    return {n: get_raw(n) for n in names}

@@ -1,0 +1,27 @@
+"""sqcheck — the project-native static invariant checker.
+
+The repo's code-level contracts (registry-gated env knobs, explicit PRNG
+key threading, jit purity, lock-guarded thread-pool state, one obs
+schema, the sklearn estimator contract) are enforced here as AST-level
+rules instead of reviewer memory. Run it as ``python -m
+sq_learn_tpu.analysis`` (``make lint``); the rule catalog and baseline
+workflow live in ``docs/static_analysis.md``.
+
+The package is dependency-free (stdlib ``ast`` only) and never imports
+the code it analyzes — rules that need project metadata (the knob
+registry, ``obs.schema.RECORD_TYPES``) parse it out of the source
+statically so the checker also works on fixture trees in tests.
+"""
+
+from .core import Finding, Rule, AnalysisContext, load_baseline, run
+from .rules import ALL_RULES, get_rules
+
+__all__ = [
+    "ALL_RULES",
+    "AnalysisContext",
+    "Finding",
+    "Rule",
+    "get_rules",
+    "load_baseline",
+    "run",
+]

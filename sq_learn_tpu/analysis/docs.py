@@ -1,0 +1,163 @@
+"""``--docs`` / ``--check-docs`` — the knob table is generated, not
+hand-maintained.
+
+``--docs`` renders the registry into markdown (committed as
+``docs/knobs.md``); ``--check-docs`` exits non-zero when (a) the
+committed table drifts from a fresh render, (b) a knob-shaped token in
+``CLAUDE.md``/``docs/*.md`` does not resolve in the registry (docs
+mention a knob the code never reads — the drift the paper-thesis
+contracts exist to prevent), or (c) a registry entry's declared doc
+anchor file never mentions it.
+"""
+
+import importlib.util
+import os
+import re
+
+__all__ = ["load_registry_module", "render_knob_table", "check_docs",
+           "DOCS_RELPATH"]
+
+DOCS_RELPATH = os.path.join("docs", "knobs.md")
+
+#: doc files scanned for knob-shaped tokens
+_DOC_GLOBS = ("CLAUDE.md",)
+
+_TOKEN_RE = re.compile(
+    r"\b(_?SQ_[A-Z0-9_]+\*?|JAX_[A-Z0-9_]+|XLA_FLAGS|CICIDS_CSV)\b")
+
+_SCOPE_TITLES = (
+    ("lib", "Library knobs"),
+    ("bench", "Bench-harness knobs"),
+    ("test", "Test-harness knobs"),
+    ("external", "External knobs (owned upstream, registered so reads "
+                 "are auditable)"),
+)
+
+
+def load_registry_module(root, relpath=None):
+    """Import ``_knobs.py`` standalone from its file (it only imports
+    ``os``, so this is safe without triggering the package — and works
+    on fixture trees)."""
+    path = os.path.join(root, relpath or os.path.join(
+        "sq_learn_tpu", "_knobs.py"))
+    spec = importlib.util.spec_from_file_location("_sqcheck_knobs", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _fmt_default(knob):
+    if knob.kind == "flag":
+        return "on" if knob.default else "off"
+    if knob.default is None:
+        return "unset"
+    return f"``{knob.default!r}``"
+
+
+def render_knob_table(knobs_mod):
+    """The committed ``docs/knobs.md``, rendered from the registry."""
+    lines = [
+        "# Environment knobs",
+        "",
+        "<!-- GENERATED FILE — do not edit. Regenerate with",
+        "     `python -m sq_learn_tpu.analysis --docs > docs/knobs.md`;",
+        "     `make lint` (`--check-docs`) fails on drift. -->",
+        "",
+        "Every environment knob the project reads, generated from the",
+        "single source of truth `sq_learn_tpu/_knobs.py`. All reads go",
+        "through the typed accessors there (`get_bool`/`get_int`/",
+        "`get_float`/`get_str`/`get_raw`); the static checker",
+        "(`make lint`, rule `knob-registry`) rejects raw `os.environ`",
+        "reads and unregistered names. Flag semantics: a default-off",
+        "flag enables only on `=1`; a default-on flag disables only on",
+        "`=0`. Names ending `*` register a whole prefix family.",
+        "",
+    ]
+    by_scope = {}
+    for k in knobs_mod.iter_knobs():
+        by_scope.setdefault(k.scope, []).append(k)
+    for scope, title in _SCOPE_TITLES:
+        entries = by_scope.pop(scope, [])
+        if not entries:
+            continue
+        lines += [f"## {title}", "",
+                  "| Knob | Kind | Default | Documented in |"
+                  " Description |",
+                  "|---|---|---|---|---|"]
+        for k in sorted(entries, key=lambda e: e.name):
+            anchor = f"`{k.anchor}`" if k.anchor else "—"
+            lines.append(
+                f"| `{k.name}` | {k.kind} | {_fmt_default(k)} | "
+                f"{anchor} | {k.doc} |")
+        lines.append("")
+    if by_scope:
+        raise ValueError(f"unrendered knob scopes: {sorted(by_scope)}")
+    return "\n".join(lines).rstrip() + "\n"
+
+
+def _doc_files(root):
+    files = [f for f in _DOC_GLOBS
+             if os.path.isfile(os.path.join(root, f))]
+    docdir = os.path.join(root, "docs")
+    if os.path.isdir(docdir):
+        files += sorted(os.path.join("docs", f)
+                        for f in os.listdir(docdir) if f.endswith(".md"))
+    return files
+
+
+def check_docs(root, knobs_mod=None):
+    """Run all three doc cross-checks; returns a list of problem
+    strings (empty = docs and registry agree)."""
+    problems = []
+    if knobs_mod is None:
+        try:
+            knobs_mod = load_registry_module(root)
+        except (OSError, SyntaxError) as exc:
+            return [f"cannot load knob registry: {exc}"]
+    # (a) committed generated table is fresh
+    want = render_knob_table(knobs_mod)
+    committed_path = os.path.join(root, DOCS_RELPATH)
+    try:
+        with open(committed_path) as fh:
+            have = fh.read()
+    except OSError:
+        have = None
+    if have is None:
+        problems.append(
+            f"{DOCS_RELPATH} is missing — generate it with "
+            f"`python -m sq_learn_tpu.analysis --docs > {DOCS_RELPATH}`")
+    elif have != want:
+        problems.append(
+            f"{DOCS_RELPATH} drifted from the registry — regenerate "
+            f"with `python -m sq_learn_tpu.analysis --docs > "
+            f"{DOCS_RELPATH}`")
+    # (b) every knob token in the prose docs resolves
+    for rel in _doc_files(root):
+        if rel.replace(os.sep, "/") == DOCS_RELPATH.replace(os.sep, "/"):
+            continue
+        with open(os.path.join(root, rel)) as fh:
+            text = fh.read()
+        for lineno, line in enumerate(text.splitlines(), 1):
+            for tok in _TOKEN_RE.findall(line):
+                if knobs_mod.resolve(tok.rstrip("*")) is None:
+                    problems.append(
+                        f"{rel}:{lineno}: knob-shaped token {tok!r} "
+                        f"does not resolve in the _knobs registry")
+    # (c) every anchored knob is mentioned by its anchor file
+    for k in knobs_mod.iter_knobs():
+        if not k.anchor:
+            continue
+        anchor_path = os.path.join(root, k.anchor)
+        try:
+            with open(anchor_path) as fh:
+                text = fh.read()
+        except OSError:
+            problems.append(
+                f"knob {k.name!r} declares missing anchor {k.anchor!r}")
+            continue
+        probe = k.name[:-1] if k.name.endswith("*") else k.name
+        if probe not in text:
+            problems.append(
+                f"knob {k.name!r} is not mentioned in its declared "
+                f"anchor {k.anchor!r}")
+    return problems

@@ -1,0 +1,37 @@
+"""Rule registry: one module per rule, all instantiable with no args.
+
+Adding a rule = write a :class:`~sq_learn_tpu.analysis.core.Rule`
+subclass in a new module here, append it to ``ALL_RULES``, give it a
+bad fixture in :mod:`sq_learn_tpu.analysis.selftest`, and document it
+in ``docs/static_analysis.md``.
+"""
+
+from .knobs import KnobRegistryRule
+from .rng import RngDisciplineRule
+from .jitpure import JitPurityRule
+from .locks import LockDisciplineRule
+from .obsschema import ObsSchemaRule
+from .estimator import EstimatorContractRule
+
+ALL_RULES = (
+    KnobRegistryRule,
+    RngDisciplineRule,
+    JitPurityRule,
+    LockDisciplineRule,
+    ObsSchemaRule,
+    EstimatorContractRule,
+)
+
+__all__ = ["ALL_RULES", "get_rules"] + [r.__name__ for r in ALL_RULES]
+
+
+def get_rules(names=None):
+    """Fresh rule instances (all, or the named subset)."""
+    by_name = {r.name: r for r in ALL_RULES}
+    if names is None:
+        return [r() for r in ALL_RULES]
+    unknown = sorted(set(names) - set(by_name))
+    if unknown:
+        raise KeyError(f"unknown rules {unknown}; "
+                       f"known: {sorted(by_name)}")
+    return [by_name[n]() for n in names]

@@ -1,0 +1,240 @@
+"""Rule ``jit-purity`` — no host-side control flow on traced values.
+
+Inside functions compiled by ``jax.jit`` (plain decorator,
+``functools.partial(jax.jit, ...)``, ``name = jax.jit(fn)`` wrapping,
+or ``instrument("site", fn)`` registration — the watchdog site table's
+producers), flag:
+
+- Python ``if``/``while`` whose condition reads a non-static traced
+  parameter (``.shape``/``.ndim``/``.dtype``/``.size``, ``len()``,
+  ``isinstance()`` and ``is None`` tests are host-safe and exempt);
+- ``for`` loops iterating a traced parameter directly;
+- host ``np.*`` calls fed a traced parameter;
+- ``.item()`` / ``float()`` / ``int()`` / ``bool()`` on a traced
+  parameter (concretization errors waiting to happen).
+
+Data-dependent control flow belongs in ``lax.while_loop``/``scan``
+(CLAUDE.md §Conventions).
+"""
+
+import ast
+
+from ..core import Finding, Rule, dotted_name, const_str
+
+#: attribute reads on a tracer that are static at trace time
+_SAFE_ATTRS = {"shape", "ndim", "dtype", "size", "nbytes", "itemsize",
+               "weak_type", "aval", "sharding"}
+
+_SAFE_CALLS = {"len", "isinstance", "type", "getattr", "hasattr"}
+
+_CONCRETIZERS = {"float", "int", "bool", "complex"}
+
+
+def import_aliases(tree):
+    """alias -> full module path for plain imports and from-imports
+    (``import numpy as np`` → np: numpy; ``from functools import
+    partial`` → partial: functools.partial)."""
+    aliases = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def _resolve(fn, aliases):
+    if not fn:
+        return None
+    parts = fn.split(".")
+    head = aliases.get(parts[0], parts[0])
+    return ".".join([head] + parts[1:])
+
+
+def _jit_decoration(func, aliases):
+    """(is_jit, static_names, static_nums) from the decorator list."""
+    for dec in func.decorator_list:
+        target, call = dec, None
+        if isinstance(dec, ast.Call):
+            call = dec
+            target = dec.func
+        resolved = _resolve(dotted_name(target), aliases)
+        if resolved in ("jax.jit", "jax.api.jit"):
+            return True, *(_statics(call) if call else (set(), set()))
+        if resolved == "functools.partial" and call and call.args:
+            inner = _resolve(dotted_name(call.args[0]), aliases)
+            if inner in ("jax.jit", "jax.api.jit"):
+                return True, *_statics(call)
+    return False, set(), set()
+
+
+def _statics(call):
+    names, nums = set(), set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            names |= _str_elems(kw.value)
+        elif kw.arg == "static_argnums":
+            nums |= _int_elems(kw.value)
+    return names, nums
+
+
+def _str_elems(node):
+    s = const_str(node)
+    if s is not None:
+        return {s}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return {e for e in (const_str(x) for x in node.elts)
+                if e is not None}
+    return set()
+
+
+def _int_elems(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return {x.value for x in node.elts
+                if isinstance(x, ast.Constant)
+                and isinstance(x.value, int)}
+    return set()
+
+
+def _wrapped_functions(tree, aliases):
+    """{fn_name: (static_names, static_nums)} for module-level
+    ``x = jax.jit(fn, ...)`` / ``instrument("site", fn)`` wrappings."""
+    out = {}
+    for node in ast.walk(tree):
+        value = None
+        if isinstance(node, ast.Assign):
+            value = node.value
+        elif isinstance(node, ast.Expr):
+            value = node.value
+        if not isinstance(value, ast.Call):
+            continue
+        resolved = _resolve(dotted_name(value.func), aliases) or ""
+        if resolved in ("jax.jit", "jax.api.jit") and value.args:
+            inner = value.args[0]
+            if isinstance(inner, ast.Name):
+                out[inner.id] = _statics(value)
+        elif (resolved.endswith("instrument") or resolved.endswith(
+                ".instrument")) and len(value.args) >= 2:
+            inner = value.args[1]
+            if isinstance(inner, ast.Name):
+                out.setdefault(inner.id, (set(), set()))
+    return out
+
+
+class JitPurityRule(Rule):
+    name = "jit-purity"
+    description = ("no Python control flow / host np calls / "
+                   "concretization over traced params inside jit")
+
+    def check_module(self, ctx, tree, relpath, source):
+        aliases = import_aliases(tree)
+        wrapped = _wrapped_functions(tree, aliases)
+        findings = []
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            is_jit, names, nums = _jit_decoration(node, aliases)
+            if not is_jit and node.name in wrapped:
+                is_jit = True
+                names, nums = wrapped[node.name]
+            if not is_jit:
+                continue
+            findings.extend(
+                self._check_body(node, relpath, names, nums, aliases))
+        return findings
+
+    def _traced_params(self, func, static_names, static_nums):
+        params = [a.arg for a in (func.args.posonlyargs + func.args.args)]
+        traced = {p for i, p in enumerate(params)
+                  if i not in static_nums and p not in static_names}
+        traced |= {a.arg for a in func.args.kwonlyargs
+                   if a.arg not in static_names}
+        traced.discard("self")
+        return traced
+
+    def _check_body(self, func, relpath, static_names, static_nums,
+                    aliases):
+        traced = self._traced_params(func, static_names, static_nums)
+        parents = {}
+        for node in ast.walk(func):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+
+        def unsafe_refs(expr):
+            for n in ast.walk(expr):
+                if not (isinstance(n, ast.Name) and n.id in traced):
+                    continue
+                parent = parents.get(n)
+                if (isinstance(parent, ast.Attribute)
+                        and parent.attr in _SAFE_ATTRS):
+                    continue
+                if (isinstance(parent, ast.Call)
+                    and dotted_name(parent.func) in _SAFE_CALLS
+                        and n in parent.args):
+                    continue
+                if isinstance(parent, ast.Call) and parent.func is n:
+                    continue  # the param is being called — not a tracer
+                # `x is None` is host-static; `"k" in stats` tests the
+                # pytree STRUCTURE, which is static at trace time
+                if isinstance(parent, ast.Compare) and all(
+                        isinstance(op, (ast.Is, ast.IsNot, ast.In,
+                                        ast.NotIn))
+                        for op in parent.ops):
+                    continue
+                yield n
+
+        for node in ast.walk(func):
+            if isinstance(node, (ast.If, ast.While)):
+                for ref in unsafe_refs(node.test):
+                    kind = ("if" if isinstance(node, ast.If) else "while")
+                    yield Finding(
+                        self.name, relpath, node.lineno,
+                        f"Python `{kind}` over traced param "
+                        f"{ref.id!r} in jit function {func.name}() — "
+                        f"use lax.cond/while_loop")
+                    break
+            elif isinstance(node, ast.For):
+                it = node.iter
+                if isinstance(it, ast.Name) and it.id in traced:
+                    yield Finding(
+                        self.name, relpath, node.lineno,
+                        f"Python `for` iterates traced param "
+                        f"{it.id!r} in jit function {func.name}() — "
+                        f"use lax.scan")
+            elif isinstance(node, ast.Call):
+                fn = _resolve(dotted_name(node.func), aliases) or ""
+                if fn.startswith("numpy."):
+                    direct = [a.id for a in node.args
+                              if isinstance(a, ast.Name)
+                              and a.id in traced]
+                    if direct:
+                        yield Finding(
+                            self.name, relpath, node.lineno,
+                            f"host numpy call {fn}() on traced param "
+                            f"{direct[0]!r} in jit function "
+                            f"{func.name}() — use jnp")
+                elif (isinstance(node.func, ast.Attribute)
+                      and node.func.attr == "item"
+                      and isinstance(node.func.value, ast.Name)
+                      and node.func.value.id in traced):
+                    yield Finding(
+                        self.name, relpath, node.lineno,
+                        f".item() concretizes traced param "
+                        f"{node.func.value.id!r} in jit function "
+                        f"{func.name}()")
+                elif (isinstance(node.func, ast.Name)
+                      and node.func.id in _CONCRETIZERS
+                      and node.args
+                      and isinstance(node.args[0], ast.Name)
+                      and node.args[0].id in traced):
+                    yield Finding(
+                        self.name, relpath, node.lineno,
+                        f"{node.func.id}() concretizes traced param "
+                        f"{node.args[0].id!r} in jit function "
+                        f"{func.name}()")

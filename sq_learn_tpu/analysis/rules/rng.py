@@ -1,0 +1,119 @@
+"""Rule ``rng-discipline`` — no module-global RNG, no hardcoded seeds.
+
+Every stochastic routine takes an explicit jax PRNG key or seeded
+NumPy generator (CLAUDE.md §Conventions). Flags (a) calls into the
+global NumPy/stdlib RNG state (``np.random.<draw>``, ``random.<draw>``,
+unseeded ``default_rng()``/``RandomState()``) outside the
+``utils/validation.py`` / ``utils/keys.py`` allowlist, and (b)
+``jax.random.PRNGKey(<literal>)`` hardcoded inside a public function
+that offers no ``key``/``seed``/``random_state`` parameter.
+"""
+
+import ast
+import os
+
+from ..core import Finding, Rule, dotted_name
+from .jitpure import import_aliases
+
+#: files allowed to touch the global RNG machinery (they manage it)
+ALLOWLIST = ("utils/validation.py", "utils/keys.py")
+
+#: numpy.random constructors that are fine WHEN SEEDED
+_SEEDED_OK = {"default_rng", "RandomState", "Generator", "SeedSequence",
+              "PCG64", "Philox", "MT19937", "SFC64", "BitGenerator"}
+
+#: stdlib ``random`` draws off the module-global state
+_STDLIB_DRAWS = {"random", "seed", "randint", "randrange", "choice",
+                 "choices", "shuffle", "sample", "uniform", "gauss",
+                 "normalvariate", "betavariate", "expovariate",
+                 "getrandbits", "triangular"}
+
+_KEYISH = ("key", "seed", "random_state", "rng")
+
+
+def _is_allowlisted(relpath):
+    rp = relpath.replace(os.sep, "/")
+    return any(rp.endswith(a) for a in ALLOWLIST)
+
+
+class RngDisciplineRule(Rule):
+    name = "rng-discipline"
+    description = ("no module-global RNG outside utils/validation.py & "
+                   "utils/keys.py; stochastic functions take an "
+                   "explicit key")
+
+    def check_module(self, ctx, tree, relpath, source):
+        if _is_allowlisted(relpath):
+            return ()
+        aliases = import_aliases(tree)
+        findings = []
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                findings.extend(self._global_rng(node, relpath, aliases))
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                findings.extend(
+                    self._hardcoded_key(node, relpath, aliases))
+        return findings
+
+    def _global_rng(self, node, relpath, aliases):
+        fn = dotted_name(node.func)
+        if not fn:
+            return
+        parts = fn.split(".")
+        root = aliases.get(parts[0], parts[0])
+        resolved = ".".join([root] + parts[1:])
+        if resolved.startswith("numpy.random."):
+            tail = resolved[len("numpy.random."):]
+            if tail in _SEEDED_OK:
+                if not node.args and not node.keywords:
+                    yield Finding(
+                        self.name, relpath, node.lineno,
+                        f"unseeded np.random.{tail}() draws entropy "
+                        f"from the OS — pass an explicit seed/key")
+            elif "." not in tail:
+                yield Finding(
+                    self.name, relpath, node.lineno,
+                    f"np.random.{tail}(...) uses the module-global RNG "
+                    f"— thread a seeded Generator or a jax key")
+        elif resolved.startswith("numpy.random.mtrand"):
+            yield Finding(
+                self.name, relpath, node.lineno,
+                "numpy.random.mtrand global state outside the "
+                "validation allowlist")
+        elif root == "random" and len(parts) == 2:
+            if parts[1] in _STDLIB_DRAWS:
+                yield Finding(
+                    self.name, relpath, node.lineno,
+                    f"random.{parts[1]}(...) uses the stdlib global "
+                    f"RNG — thread explicit randomness")
+            elif parts[1] == "Random" and not node.args:
+                yield Finding(
+                    self.name, relpath, node.lineno,
+                    "unseeded random.Random() — pass an explicit seed")
+
+    def _hardcoded_key(self, func, relpath, aliases):
+        if func.name.startswith("_"):
+            return
+        params = {a.arg for a in (func.args.posonlyargs + func.args.args
+                                  + func.args.kwonlyargs)}
+        if any(any(k in p for k in _KEYISH) for p in params):
+            return
+        for node in ast.walk(func):
+            if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and node is not func):
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            fn = dotted_name(node.func)
+            if not fn:
+                continue
+            parts = fn.split(".")
+            root = aliases.get(parts[0], parts[0])
+            resolved = ".".join([root] + parts[1:])
+            if (resolved in ("jax.random.PRNGKey", "jax.random.key")
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)):
+                yield Finding(
+                    self.name, relpath, node.lineno,
+                    f"public function {func.name}() hardcodes a PRNG "
+                    f"seed — accept a key/seed/random_state parameter")

@@ -13,6 +13,7 @@ import os
 import warnings
 
 import numpy as np
+from .. import _knobs
 
 
 def synthetic_surrogate(n_samples, n_features, n_classes, seed,
@@ -175,7 +176,7 @@ def load_cicids(path=None, n_samples=50_000, n_features=78):
     False for the surrogate.
     """
     if path is None:
-        env = os.environ.get("CICIDS_CSV")
+        env = _knobs.get_raw("CICIDS_CSV")
         path = env if env else None
     if path and os.path.exists(path):
         # fast path: stream the numeric columns through the native C++

@@ -50,11 +50,11 @@ a ledger under an active recorder (pinned by test).
 
 import collections
 import math
-import os
 import threading
 import time
 
 from .guarantees import clopper_pearson_lower
+from .. import _knobs
 
 __all__ = [
     "BudgetBurnError",
@@ -98,7 +98,7 @@ class BudgetBurnError(RuntimeError):
 def windows():
     """The configured rolling windows in seconds
     (``SQ_OBS_BUDGET_WINDOWS``, comma-separated, default ``60,600``)."""
-    raw = os.environ.get("SQ_OBS_BUDGET_WINDOWS")
+    raw = _knobs.get_raw("SQ_OBS_BUDGET_WINDOWS")
     if not raw:
         return DEFAULT_WINDOWS
     out = tuple(sorted(float(w) for w in raw.split(",") if w.strip()))
@@ -108,14 +108,13 @@ def windows():
 def burn_threshold():
     """The multi-window alert threshold (``SQ_OBS_BUDGET_BURN``,
     default 2.0): the burn rate that must hold in EVERY window."""
-    return float(os.environ.get("SQ_OBS_BUDGET_BURN",
-                                DEFAULT_BURN_THRESHOLD))
+    return _knobs.get_float("SQ_OBS_BUDGET_BURN")
 
 
 def strict():
     """True when a tripped alert must raise
     (``SQ_OBS_BUDGET_STRICT=1``)."""
-    return os.environ.get("SQ_OBS_BUDGET_STRICT") == "1"
+    return _knobs.get_bool("SQ_OBS_BUDGET_STRICT")
 
 
 def _percentile(values, q):
@@ -157,6 +156,12 @@ class BudgetLedger:
     epoch) so window arithmetic is immune to wall-clock steps; tests
     pass explicit ``ts``/``now`` for determinism.
     """
+
+    #: lock-discipline contract (``sq_learn_tpu.analysis``): tenant state
+    #: is only written under ``self._lock``; ``_state``/``_prune`` are
+    #: helpers invoked with the lock already held.
+    _GUARDED_BY = {"_lock": ("_tenants",)}
+    _ASSUMES_LOCK = ("_state", "_prune")
 
     def __init__(self, window_seconds=None, threshold=None,
                  site="serving.dispatcher"):

@@ -38,7 +38,7 @@ with PYTHONPATH cleared while the accelerator relay is wedged.
 """
 
 import math
-import os
+from .. import _knobs
 
 __all__ = [
     "GuaranteeViolationError",
@@ -75,7 +75,7 @@ def enabled():
 
 def strict():
     """True when flagged sites must raise (``SQ_OBS_AUDIT_STRICT=1``)."""
-    return os.environ.get("SQ_OBS_AUDIT_STRICT") == "1"
+    return _knobs.get_bool("SQ_OBS_AUDIT_STRICT")
 
 
 # ---------------------------------------------------------------------------

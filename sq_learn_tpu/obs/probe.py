@@ -50,6 +50,7 @@ import subprocess
 import sys
 import tempfile
 import time
+from .. import _knobs
 
 #: last probe result in this process (outcome, latency_s, platform) —
 #: readable even when no recorder was active at probe time
@@ -63,11 +64,11 @@ def probe_ttl_s():
     """TTL of a cached probe result. 300 s default: long enough that a
     bench suite's scripts share one probe, far shorter than any observed
     wedge (hours) or healthy window (~7-20 min). 0 disables caching."""
-    return float(os.environ.get("SQ_PROBE_TTL_S", 300.0))
+    return _knobs.get_float("SQ_PROBE_TTL_S")
 
 
 def _cache_path():
-    return os.environ.get(
+    return _knobs.get_raw(
         "SQ_PROBE_CACHE",
         os.path.join(tempfile.gettempdir(), "sq_probe_cache.json"))
 
@@ -170,7 +171,7 @@ def probe_device(timeout_s=60, platform=None, force=False):
     injector forces the outcome without spawning.
     """
     if platform is None:
-        platform = os.environ.get("JAX_PLATFORMS", "")
+        platform = _knobs.get_raw("JAX_PLATFORMS", "")
     if platform.split(",")[0].strip() == "cpu":
         return _record("cpu", 0.0, platform)
     if platform == "":

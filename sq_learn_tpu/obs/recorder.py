@@ -38,6 +38,7 @@ import json
 import os
 import threading
 import time
+from .. import _knobs
 
 # v2: +xla_cost / regression record types, +schema_version envelope field
 # v3: +guarantee / tradeoff record types (the statistical-observability
@@ -278,7 +279,7 @@ def disable():
         _active = None
         if rec is not None:
             rec.close()
-    trace_path = os.environ.get("SQ_OBS_TRACE")
+    trace_path = _knobs.get_raw("SQ_OBS_TRACE")
     if rec is not None and rec.path and trace_path:
         try:
             from .trace import write_trace
@@ -473,8 +474,8 @@ def snapshot():
 # The atexit disable flushes the sink and — with SQ_OBS_TRACE set —
 # renders the Chrome trace for runs that never call disable() themselves
 # (bench scripts, one-shot CLIs).
-if os.environ.get("SQ_OBS") == "1":
-    enable(os.environ.get("SQ_OBS_PATH", DEFAULT_PATH))
+if _knobs.get_bool("SQ_OBS"):
+    enable(_knobs.get_raw("SQ_OBS_PATH", DEFAULT_PATH))
     import atexit
 
     atexit.register(disable)

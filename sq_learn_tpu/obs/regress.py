@@ -56,6 +56,7 @@ import json
 import os
 import time
 from statistics import median
+from .. import _knobs
 
 SCHEMA_VERSION = 7  # keep in sync with recorder.SCHEMA_VERSION (no import:
 # this module must stay loadable from a bare checkout for CI tooling)
@@ -100,8 +101,8 @@ OBS_GATES = ("compile_count", "total_transfer_bytes", "peak_hbm_bytes")
 
 def _tolerance(gate):
     tol, slack = TOLERANCES[gate]
-    env_t = os.environ.get(f"SQ_REGRESS_TOL_{gate.upper()}")
-    env_s = os.environ.get(f"SQ_REGRESS_SLACK_{gate.upper()}")
+    env_t = _knobs.get_raw(f"SQ_REGRESS_TOL_{gate.upper()}")
+    env_s = _knobs.get_raw(f"SQ_REGRESS_SLACK_{gate.upper()}")
     return (float(env_t) if env_t else tol,
             float(env_s) if env_s else slack)
 

@@ -136,6 +136,16 @@ _NUM = (int, float)
 #: without the codec/spill counter conventions)
 KNOWN_VERSIONS = {1, 2, 3, 4, 5, 6, SCHEMA_VERSION}
 
+#: every record type the schema defines, machine-readable. The static
+#: checker (:mod:`sq_learn_tpu.analysis`, rule ``obs-schema``) and the
+#: smoke validator consume this tuple instead of re-parsing the
+#: validator's dispatch; keep it in lockstep with the table above.
+RECORD_TYPES = (
+    "meta", "span", "counter", "gauge", "ledger", "watchdog", "probe",
+    "fault", "breaker", "xla_cost", "regression", "guarantee", "tradeoff",
+    "slo", "budget", "alert",
+)
+
 _PROBE_OUTCOMES = {"ok", "timeout", "error", "cpu", "skipped"}
 
 _BREAKER_STATES = {"closed", "open", "half_open"}
@@ -404,7 +414,8 @@ def validate_record(rec):
             and not isinstance(vv, bool) for k, vv in obj.items()),
             errors, "alert.burn_rates object of str → number")
     else:
-        errors.append(f"unknown record type {t!r}")
+        errors.append(
+            f"unknown record type {t!r} (known: {sorted(RECORD_TYPES)})")
     return errors
 
 

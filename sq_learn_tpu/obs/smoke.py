@@ -18,6 +18,7 @@ health it reports.
 import json
 import os
 import sys
+from .. import _knobs
 
 
 def main():
@@ -30,7 +31,7 @@ def main():
     from . import disable, enable, ledger, watchdog
     from .schema import validate_jsonl
 
-    path = os.environ.get("SQ_OBS_PATH", "/tmp/sq_obs_smoke.jsonl")
+    path = _knobs.get_raw("SQ_OBS_PATH", "/tmp/sq_obs_smoke.jsonl")
     open(path, "w").close()  # truncate any previous smoke artifact
     enable(path)  # fresh run: resets the watchdog, reopens the sink
 

@@ -22,9 +22,9 @@ enforcement tool, not a metric); when a recorder is active, every
 observation also lands as a 'watchdog' JSONL record.
 """
 
-import os
 import threading
 import warnings
+from .. import _knobs
 
 
 class RetracingWarning(RuntimeWarning):
@@ -122,7 +122,7 @@ class RetracingWatchdog:
             msg = (f"retracing watchdog: call site {site!r} has {compiles} "
                    f"jit compiles, over its declared budget of {budget} — "
                    "a shape/dtype is leaking into the traced signature")
-            if os.environ.get("SQ_OBS_STRICT") == "1":
+            if _knobs.get_bool("SQ_OBS_STRICT"):
                 raise RetracingError(msg)
             warnings.warn(msg, RetracingWarning, stacklevel=2)
         return compiles

@@ -38,9 +38,9 @@ Costs, not free:
   instrumented computation.
 """
 
-import os
 
 from . import recorder
+from .. import _knobs
 
 __all__ = ["capture", "instrument", "flops_of", "peak_bytes", "records"]
 
@@ -158,7 +158,7 @@ def capture(site, fn, *args, _extra_key=None, **kwargs):
         rec.record(entry, kind="xla_cost_records")
         return entry
     entry.update(_cost_dict(lowered))
-    if os.environ.get("SQ_OBS_XLA_MEMORY") != "0":
+    if _knobs.get_bool("SQ_OBS_XLA_MEMORY"):
         try:
             entry.update(_memory_dict(lowered.compile()))
         except Exception:
@@ -197,7 +197,7 @@ def capture_compiled(site, lowered, compiled, *args, **kwargs):
     entry = {"type": "xla_cost", "site": site, "signature": sig,
              "flops": None, "bytes_accessed": None, "peak_bytes": None}
     entry.update(_cost_dict(lowered))
-    if os.environ.get("SQ_OBS_XLA_MEMORY") != "0":
+    if _knobs.get_bool("SQ_OBS_XLA_MEMORY"):
         entry.update(_memory_dict(compiled))
     try:
         import jax

@@ -35,6 +35,7 @@ import numpy as np
 from .. import obs as _obs
 from ..resilience import faults as _faults
 from .epochs import EpochPlan
+from .. import _knobs
 
 __all__ = ["assign_labels", "minibatch_epoch_fit"]
 
@@ -147,7 +148,7 @@ def minibatch_epoch_fit(source, *, n_clusters, batch_rows=1024,
     # serial write); the writer drains before checkpoint deletion AND on
     # the failure path, so an interrupt still leaves the newest snapshot
     writer = None
-    if every and os.environ.get("SQ_OOC_ASYNC_CKPT", "1") != "0":
+    if every and _knobs.get_bool("SQ_OOC_ASYNC_CKPT"):
         writer = AsyncStreamCheckpointer(ckpt.path)
     stop = False
     try:

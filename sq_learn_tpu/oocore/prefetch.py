@@ -58,6 +58,7 @@ import numpy as np
 
 from .. import obs as _obs
 from .store import _budget_check, ram_budget_bytes
+from .. import _knobs
 
 __all__ = [
     "PrefetchingSource",
@@ -76,7 +77,7 @@ def prefetch_depth():
     on (measured ~12% overhead on the dev container), so overlap is only
     worth buying when there is a second core (or real blocking I/O, at
     which point the operator sets the knob)."""
-    env = os.environ.get("SQ_OOC_PREFETCH_DEPTH")
+    env = _knobs.get_raw("SQ_OOC_PREFETCH_DEPTH")
     if env is not None:
         return int(env)
     return 2 if (os.cpu_count() or 1) > 1 else 0
@@ -86,7 +87,7 @@ def prefetch_threads():
     """Prefetch worker count (``SQ_OOC_PREFETCH_THREADS``, default 2 —
     enough to overlap one read with one CRC pass; the depth bound, not
     the thread count, is what limits memory)."""
-    return int(os.environ.get("SQ_OOC_PREFETCH_THREADS", 2))
+    return _knobs.get_int("SQ_OOC_PREFETCH_THREADS")
 
 
 class ShardPrefetcher:
@@ -98,6 +99,12 @@ class ShardPrefetcher:
     contract. ``resident_bytes`` declares the consumer's own residency
     for the RAM-budget ledger (default: two max-size shards).
     """
+
+    #: lock-discipline contract (``sq_learn_tpu.analysis``): shared
+    #: worker/consumer state is only written under ``self._cond``.
+    _GUARDED_BY = {"_cond": ("_results", "_claimed", "_consumed", "_held",
+                             "_closed", "_hits", "_stalls", "_occupancy",
+                             "_stall_s")}
 
     def __init__(self, source, order, *, depth=None, threads=None,
                  resident_bytes=None):

@@ -41,6 +41,7 @@ import subprocess
 import sys
 import tempfile
 import time
+from .. import _knobs
 
 #: one fit configuration, shared verbatim by every leg (reference,
 #: faulted, killed child, resumed child) — parity only means anything if
@@ -76,7 +77,7 @@ def main():
     from ..resilience import faults
     from . import create_synthetic_store, minibatch_epoch_fit, open_store
 
-    path = os.environ.get("SQ_OBS_PATH", "/tmp/sq_oocore_smoke.jsonl")
+    path = _knobs.get_raw("SQ_OBS_PATH", "/tmp/sq_oocore_smoke.jsonl")
     open(path, "w").close()
     enable(path)
 

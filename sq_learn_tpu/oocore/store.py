@@ -60,6 +60,7 @@ import os
 import zlib
 
 import numpy as np
+from .. import _knobs
 
 __all__ = [
     "ArraySource",
@@ -93,13 +94,13 @@ def shard_bytes_default():
     small enough that one shard plus a batch stays far under any
     realistic RAM budget, large enough that sequential read throughput
     dominates per-file overhead)."""
-    return int(os.environ.get("SQ_OOC_SHARD_BYTES", 8 << 20))
+    return _knobs.get_int("SQ_OOC_SHARD_BYTES")
 
 
 def ram_budget_bytes():
     """Enforced host-RAM budget for single materializations
     (``SQ_OOC_RAM_BUDGET_BYTES``; 0 = unenforced)."""
-    return int(os.environ.get("SQ_OOC_RAM_BUDGET_BYTES", 0))
+    return _knobs.get_int("SQ_OOC_RAM_BUDGET_BYTES")
 
 
 def verify_mode():
@@ -107,7 +108,7 @@ def verify_mode():
     ``all`` (default — every read verifies; the CRC pass is memory-
     bandwidth on bytes already read), ``touch`` (first read per shard
     per process), ``off``."""
-    mode = os.environ.get("SQ_OOC_VERIFY", "all")
+    mode = _knobs.get_str("SQ_OOC_VERIFY")
     if mode not in ("all", "touch", "off"):
         raise ValueError(f"SQ_OOC_VERIFY must be all|touch|off, got {mode!r}")
     return mode
@@ -116,7 +117,7 @@ def verify_mode():
 def reread_max():
     """Bounded re-read budget after a CRC mismatch
     (``SQ_OOC_REREAD_MAX``, default 2)."""
-    return int(os.environ.get("SQ_OOC_REREAD_MAX", 2))
+    return _knobs.get_int("SQ_OOC_REREAD_MAX")
 
 
 def codec_default():
@@ -124,7 +125,7 @@ def codec_default():
     ``none``, default ``none`` — existing byte-level contracts, manifests
     and bench history stay untouched unless the operator opts in).
     Opening a store always honors its manifest, never this knob."""
-    codec = os.environ.get("SQ_OOC_CODEC", "none")
+    codec = _knobs.get_str("SQ_OOC_CODEC")
     if codec not in ("lz4", "none"):
         raise ValueError(f"SQ_OOC_CODEC must be lz4|none, got {codec!r}")
     return codec

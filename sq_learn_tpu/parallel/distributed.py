@@ -16,12 +16,12 @@ Typical multi-host launch (same script on every host)::
     est = QKMeans(n_clusters=10, mesh=mesh, ...).fit(local_shard)
 """
 
-import os
 
 import numpy as np
 import jax
 
 from .mesh import DATA_AXIS
+from .. import _knobs
 
 
 def initialize(coordinator_address=None, num_processes=None, process_id=None,
@@ -48,7 +48,7 @@ def initialize(coordinator_address=None, num_processes=None, process_id=None,
     n_proc = num_processes
     if n_proc is None:
         try:
-            n_proc = int(os.environ.get("JAX_NUM_PROCESSES", "0"))
+            n_proc = _knobs.get_int("JAX_NUM_PROCESSES")
         except ValueError:
             n_proc = 0
     if n_proc and int(n_proc) > 1:

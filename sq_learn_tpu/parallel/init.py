@@ -32,7 +32,6 @@ cumsum sampler these kernels replace on the batched/sharded fit paths.
 
 import functools
 import math
-import os
 
 import numpy as np
 import jax
@@ -43,6 +42,7 @@ from jax.sharding import PartitionSpec as P
 from .. import obs as _obs
 from .._compat import axis_size, shard_map
 from .mesh import DATA_AXIS, pad_to_multiple
+from .. import _knobs
 
 __all__ = [
     "NBLOCKS",
@@ -67,7 +67,7 @@ def resolve_init_subsample(n_samples, n_clusters, setting="auto"):
     (0 disables). Explicit integers are used as given (0/None disables).
     """
     if setting == "auto":
-        env = os.environ.get("SQ_INIT_SUBSAMPLE")
+        env = _knobs.get_raw("SQ_INIT_SUBSAMPLE")
         if env is not None:
             setting = int(env)
     if setting == "auto":

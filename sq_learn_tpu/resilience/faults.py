@@ -90,9 +90,9 @@ injects the same faults on every run (the repo-wide explicit-key
 discipline, applied to failure).
 """
 
-import os
 import threading
 import time
+from .. import _knobs
 
 __all__ = [
     "FaultPlan",
@@ -392,5 +392,6 @@ def disarm():
 
 # SQ_FAULTS=<spec> arms at first import, mirroring SQ_OBS=1 — a subprocess
 # (bench config, CI smoke) opts into faults purely through its environment.
-if os.environ.get("SQ_FAULTS"):
-    arm(os.environ["SQ_FAULTS"])
+_env_spec = _knobs.get_raw("SQ_FAULTS")
+if _env_spec:
+    arm(_env_spec)

@@ -28,6 +28,7 @@ import json
 import os
 import sys
 import tempfile
+from .. import _knobs
 
 
 def main():
@@ -46,7 +47,7 @@ def main():
     from . import breaker, faults
     from .faults import InjectedInterrupt
 
-    path = os.environ.get("SQ_OBS_PATH", "/tmp/sq_faults_smoke.jsonl")
+    path = _knobs.get_raw("SQ_OBS_PATH", "/tmp/sq_faults_smoke.jsonl")
     open(path, "w").close()  # truncate any previous smoke artifact
     enable(path)  # fresh run: resets the watchdog, reopens the sink
 
@@ -62,7 +63,7 @@ def main():
         "SQ_BREAKER_COOLDOWN_S": "0",
         "SQ_RETRY_BACKOFF_S": "0.01",
     }
-    saved = {k: os.environ.get(k) for k in knobs}
+    saved = _knobs.snapshot(knobs)
     os.environ.update(knobs)
 
     failures = []

@@ -45,12 +45,12 @@ handling is never skipped: the fast path only skips injection hooks and
 per-attempt bookkeeping, not the retry/breaker machinery.
 """
 
-import os
 import threading
 import time
 
 from .faults import InjectedFault, InjectedTransferError, _u01
 from . import faults as _faults
+from .. import _knobs
 
 __all__ = [
     "CLOSED",
@@ -101,15 +101,15 @@ def _is_transient(exc):
 
 
 def _retries():
-    return int(os.environ.get("SQ_RETRY_MAX", 3))
+    return _knobs.get_int("SQ_RETRY_MAX")
 
 
 def _backoff_s():
-    return float(os.environ.get("SQ_RETRY_BACKOFF_S", 0.05))
+    return _knobs.get_float("SQ_RETRY_BACKOFF_S")
 
 
 def _deadline_s():
-    return float(os.environ.get("SQ_TILE_DEADLINE_S", 30.0))
+    return _knobs.get_float("SQ_TILE_DEADLINE_S")
 
 
 def backoff_delay(attempt, tile_index=0, seed=None):
@@ -117,7 +117,7 @@ def backoff_delay(attempt, tile_index=0, seed=None):
     deterministic keyed jitter in [1, 2) — doubling plus jitter decorrelates
     concurrent retriers without a global RNG."""
     if seed is None:
-        seed = int(os.environ.get("SQ_RETRY_SEED", 0))
+        seed = _knobs.get_int("SQ_RETRY_SEED")
     return (_backoff_s() * (2 ** attempt)
             * (1.0 + _u01(seed, tile_index, attempt)))
 
@@ -155,6 +155,14 @@ class CircuitBreaker:
     errors out).
     """
 
+    #: lock-discipline contract checked by the static analyzer
+    #: (``sq_learn_tpu.analysis``, rule ``lock-discipline``): these
+    #: attributes are only written under ``self._lock``.
+    _GUARDED_BY = {"_lock": ("_state", "_consecutive", "_opened_at",
+                             "trips", "transitions")}
+    #: methods invoked only while the caller already holds ``_lock``
+    _ASSUMES_LOCK = ("_transition",)
+
     def __init__(self, clock=time.monotonic, trip_action=_cpu_escape):
         self._clock = clock
         self.trip_action = trip_action
@@ -186,10 +194,10 @@ class CircuitBreaker:
             return self._state
 
     def _k(self):
-        return int(os.environ.get("SQ_BREAKER_K", 3))
+        return _knobs.get_int("SQ_BREAKER_K")
 
     def _cooldown_s(self):
-        return float(os.environ.get("SQ_BREAKER_COOLDOWN_S", 60.0))
+        return _knobs.get_float("SQ_BREAKER_COOLDOWN_S")
 
     def _transition(self, new, reason):
         prev, self._state = self._state, new

@@ -44,11 +44,11 @@ time), ``serving.aot_cache_hits`` / ``serving.aot_cache_misses``
 dispatcher and flushed at close).
 """
 
-import os
 import threading
 
 from .. import obs as _obs
 from ..obs import xla as _xla
+from .. import _knobs
 
 __all__ = ["bucket_ladder", "cache_size", "clear", "compile_cache_dir",
            "enable_persistent_cache", "lookup", "persistent_cache_stats",
@@ -69,7 +69,7 @@ _persistent = {"registered": False, "enabled": False, "hits": 0,
 def compile_cache_dir():
     """The persistent compilation cache directory (``SQ_COMPILE_CACHE_DIR``,
     unset = per-process compiles only)."""
-    return os.environ.get("SQ_COMPILE_CACHE_DIR") or None
+    return _knobs.get_raw("SQ_COMPILE_CACHE_DIR") or None
 
 
 def enable_persistent_cache(path=None):

@@ -54,23 +54,24 @@ import numpy as np
 
 from .. import obs as _obs
 from ..sketch.cache import data_digest
+from .. import _knobs
 
 __all__ = ["cache_dir", "clear", "enabled", "flush_counters", "key_for",
            "lookup", "spill_all", "stats", "store"]
 
 
 def _max_entries():
-    return int(os.environ.get("SQ_SERVE_CACHE_ENTRIES", 256))
+    return _knobs.get_int("SQ_SERVE_CACHE_ENTRIES")
 
 
 def _max_disk_entries():
-    return int(os.environ.get("SQ_SERVE_CACHE_DISK_ENTRIES", 4096))
+    return _knobs.get_int("SQ_SERVE_CACHE_DISK_ENTRIES")
 
 
 def cache_dir():
     """The disk spill directory (``SQ_SERVE_CACHE_DIR``), or None when
     the tier is off."""
-    return os.environ.get("SQ_SERVE_CACHE_DIR") or None
+    return _knobs.get_raw("SQ_SERVE_CACHE_DIR") or None
 
 
 _lock = threading.Lock()
@@ -147,7 +148,7 @@ def flush_counters():
 
 def enabled():
     """True unless ``SQ_SERVE_CACHE=0``."""
-    return os.environ.get("SQ_SERVE_CACHE", "1") != "0"
+    return _knobs.get_bool("SQ_SERVE_CACHE")
 
 
 def _request_digest(X, max_rows=64):

@@ -72,7 +72,6 @@ window for live traffic.
 """
 
 import collections
-import os
 import threading
 import time
 
@@ -88,6 +87,7 @@ from . import aot as _aot
 from . import cache as _cache
 from . import quantize as _quant
 from .slo import SloTracker, slo_flush_batches
+from .. import _knobs
 
 __all__ = ["MicroBatchDispatcher", "ServeFuture", "kernel_cache_sizes",
            "pin_compile_budgets", "serve_max_batch_rows",
@@ -98,13 +98,13 @@ def serve_max_wait_ms():
     """Coalescing window in milliseconds (``SQ_SERVE_MAX_WAIT_MS``,
     default 2.0): the longest a head-of-batch request waits for company
     before dispatching under-full."""
-    return float(os.environ.get("SQ_SERVE_MAX_WAIT_MS", 2.0))
+    return _knobs.get_float("SQ_SERVE_MAX_WAIT_MS")
 
 
 def serve_max_batch_rows():
     """Row cap per dispatched batch (``SQ_SERVE_MAX_BATCH_ROWS``,
     default 512) — also the largest serving bucket."""
-    return int(os.environ.get("SQ_SERVE_MAX_BATCH_ROWS", 512))
+    return _knobs.get_int("SQ_SERVE_MAX_BATCH_ROWS")
 
 
 def serve_min_bucket_rows():
@@ -113,7 +113,7 @@ def serve_min_bucket_rows():
     engine's 64-row ingest floor — passed per call to
     :func:`~sq_learn_tpu.streaming.bucket_rows`, never via env
     mutation."""
-    return int(os.environ.get("SQ_SERVE_MIN_BUCKET_ROWS", 8))
+    return _knobs.get_int("SQ_SERVE_MIN_BUCKET_ROWS")
 
 
 # ---------------------------------------------------------------------------
@@ -279,6 +279,13 @@ class MicroBatchDispatcher:
     or call :meth:`close`, which drains the queue, stops the worker, and
     emits the run's ``slo`` record.
     """
+
+    #: lock-discipline contract (``sq_learn_tpu.analysis``): queue and
+    #: batching state shared with the worker thread is only written under
+    #: ``self._cond`` (``*_locked`` helpers assume the lock is held).
+    _GUARDED_BY = {"_cond": ("_queue", "_by_key", "_key_rows",
+                             "_pending_count", "_stopping", "_batch_seq",
+                             "_aot_hits", "_aot_misses", "_sites_seen")}
 
     def __init__(self, registry, *, max_wait_ms=None, max_batch_rows=None,
                  min_bucket_rows=None, slo_p50_ms=None, slo_p99_ms=None,
@@ -885,5 +892,5 @@ class MicroBatchDispatcher:
             self.slo.flush_window()
             if self._budget is not None:
                 self._budget.emit()
-        if observing and os.environ.get("SQ_OBS_STRICT") == "1":
+        if observing and _knobs.get_bool("SQ_OBS_STRICT"):
             _obs.watchdog.observe(site)

@@ -66,7 +66,6 @@ via ``ModelRegistry.register(..., quantize=...)``; process default via
 """
 
 import math
-import os
 
 import numpy as np
 import jax
@@ -74,6 +73,7 @@ import jax.numpy as jnp
 
 from .. import obs as _obs
 from ..obs import xla as _xla
+from .. import _knobs
 
 __all__ = ["DEFAULT_QUANT_DELTA", "REL_STEP", "QuantFold", "audit_batch",
            "quant_delta", "quantize_params", "quantize_rows",
@@ -93,7 +93,7 @@ DEFAULT_QUANT_DELTA = 1e-3
 def serve_quantize():
     """Process-default serving quantization mode (``SQ_SERVE_QUANTIZE``:
     ``bf16`` | ``int8`` | ``auto`` | unset/``none``/``0`` = off)."""
-    return resolve_mode(os.environ.get("SQ_SERVE_QUANTIZE") or None)
+    return resolve_mode(_knobs.get_raw("SQ_SERVE_QUANTIZE") or None)
 
 
 def resolve_mode(quantize):
@@ -116,8 +116,7 @@ def resolve_mode(quantize):
 
 def quant_delta():
     """The fold's declared audit budget δ_q (``SQ_SERVE_QUANT_DELTA``)."""
-    return float(os.environ.get("SQ_SERVE_QUANT_DELTA",
-                                DEFAULT_QUANT_DELTA))
+    return _knobs.get_float("SQ_SERVE_QUANT_DELTA")
 
 
 def _bf16_dtype():
@@ -343,7 +342,7 @@ def _audit_every():
     every Nth dispatched quantized batch replays its head request
     through the f64 reference — a statistical check, not a census (the
     guarantee-record flood rules of ``serving.cache`` apply here too)."""
-    return max(1, int(os.environ.get("SQ_SERVE_AUDIT_EVERY", 8)))
+    return max(1, _knobs.get_int("SQ_SERVE_AUDIT_EVERY"))
 
 
 def reference(op_kind, rows, host_params):

@@ -57,6 +57,7 @@ import jax.numpy as jnp
 from .. import obs as _obs
 from ..utils.checkpoint import load_estimator
 from . import quantize as _quant
+from .. import _knobs
 
 __all__ = ["ModelRegistry", "ServingModel"]
 
@@ -229,7 +230,7 @@ class ModelRegistry:
     """tenant id → servable model, with bounded LRU residency."""
 
     def __init__(self, capacity=None):
-        self._capacity = (int(os.environ.get("SQ_SERVE_REGISTRY_CAP", 8))
+        self._capacity = (_knobs.get_int("SQ_SERVE_REGISTRY_CAP")
                           if capacity is None else int(capacity))
         if self._capacity < 1:
             raise ValueError(f"registry capacity must be >= 1, "
@@ -361,7 +362,7 @@ class ModelRegistry:
         from . import aot as _aot
 
         if aot is None:
-            aot = os.environ.get("SQ_SERVE_AOT", "1") != "0"
+            aot = _knobs.get_bool("SQ_SERVE_AOT")
         with self._lock:
             known = list(self._sources)
             resident = set(self._resident)

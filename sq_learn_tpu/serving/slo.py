@@ -51,11 +51,11 @@ statistic) — the conventional SLO read: p99 is an actually-observed
 latency, never an interpolation below the worst request.
 """
 
-import os
 import threading
 import time
 
 from .. import obs as _obs
+from .. import _knobs
 
 __all__ = ["SloTracker", "SloViolation", "percentile",
            "slo_flush_batches"]
@@ -76,8 +76,7 @@ def percentile(values, q):
     return ordered[rank - 1]
 
 
-def _env_target(name):
-    raw = os.environ.get(name)
+def _env_target(raw):
     return float(raw) if raw else None
 
 
@@ -87,7 +86,7 @@ def slo_flush_batches():
     batch the dispatcher emits a windowed ``slo`` record and the
     tenant ``budget`` records, so long-running servers emit windows and
     a crash doesn't lose the history."""
-    return int(os.environ.get("SQ_SERVE_SLO_FLUSH_BATCHES", 256))
+    return _knobs.get_int("SQ_SERVE_SLO_FLUSH_BATCHES")
 
 
 class _Accum:
@@ -136,9 +135,9 @@ class SloTracker:
                  slo_p99_ms=None):
         self.site = site
         self.slo_p50_ms = (slo_p50_ms if slo_p50_ms is not None
-                           else _env_target("SQ_SERVE_SLO_P50_MS"))
+                           else _env_target(_knobs.get_raw("SQ_SERVE_SLO_P50_MS")))
         self.slo_p99_ms = (slo_p99_ms if slo_p99_ms is not None
-                           else _env_target("SQ_SERVE_SLO_P99_MS"))
+                           else _env_target(_knobs.get_raw("SQ_SERVE_SLO_P99_MS")))
         self._lock = threading.Lock()
         self._run = _Accum()
         #: since-last-flush window + per-tenant accumulators: populated
@@ -333,7 +332,7 @@ class SloTracker:
                            kind="slo_records")
             rec.record(dict(summary, type="slo"), kind="slo_records")
         if summary["violated"] and \
-                os.environ.get("SQ_SERVE_SLO_STRICT") == "1":
+                _knobs.get_bool("SQ_SERVE_SLO_STRICT"):
             raise SloViolation(
                 f"serving SLO violated at {self.site}: realized "
                 f"p50={summary['p50_ms']}ms p99={summary['p99_ms']}ms "

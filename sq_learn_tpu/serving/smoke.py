@@ -53,6 +53,7 @@ import os
 import subprocess
 import sys
 import tempfile
+from .. import _knobs
 
 
 def persistent_probe(ckpt_dir):
@@ -123,7 +124,7 @@ def main():
     from . import cache as serve_cache
     from . import quantize as quant
 
-    path = os.environ.get("SQ_OBS_PATH", "/tmp/sq_serve_smoke.jsonl")
+    path = _knobs.get_raw("SQ_OBS_PATH", "/tmp/sq_serve_smoke.jsonl")
     open(path, "w").close()
     enable(path)
 
@@ -151,7 +152,7 @@ def main():
 
     # -- AOT warm FIRST (fresh persistent cache dir), then the zero-
     # compile contract is armed for everything that follows
-    cache_dir = os.environ.setdefault(
+    cache_dir = _knobs.setdefault(
         "SQ_COMPILE_CACHE_DIR", os.path.join(tmp, "compile_cache"))
     warm = reg.warm(buckets=aot.bucket_ladder(8, 512))
     check(all(v == "loaded" for v in warm.values()),

@@ -21,13 +21,13 @@ datasets, not rows), thread-safe.
 """
 
 import collections
-import os
 import threading
 import zlib
 
 import numpy as np
 
 from .. import obs as _obs
+from .. import _knobs
 
 __all__ = ["clear", "enabled", "key_for", "lookup", "store"]
 
@@ -40,7 +40,7 @@ _store = collections.OrderedDict()
 
 def enabled():
     """True unless ``SQ_STATS_CACHE=0``."""
-    return os.environ.get("SQ_STATS_CACHE", "1") != "0"
+    return _knobs.get_bool("SQ_STATS_CACHE")
 
 
 def data_digest(X, max_rows=64):

@@ -60,7 +60,6 @@ with observability on, the short-circuit emits one zero-violation
 import dataclasses
 import functools
 import math
-import os
 
 import numpy as np
 import jax
@@ -68,6 +67,7 @@ import jax.numpy as jnp
 
 from .. import obs as _obs
 from ..ops.quantum.norms import _grid_exponents, _power_sweep
+from .. import _knobs
 
 __all__ = [
     "SpectralStats",
@@ -94,7 +94,7 @@ DEFAULT_AUDIT_ELEMS = 8_000_000
 def sketch_delta_stat():
     """The sketch engine's failure budget δ_stat (``SQ_SKETCH_DELTA``,
     default 0.05). 0 disables sketching entirely (zero-budget = exact)."""
-    env = os.environ.get("SQ_SKETCH_DELTA")
+    env = _knobs.get_raw("SQ_SKETCH_DELTA")
     return float(env) if env else DEFAULT_DELTA_STAT
 
 
@@ -112,7 +112,7 @@ def resolve_sketch_rows(n_samples, n_features, setting="auto"):
     :func:`sketch_delta_stat`.
     """
     if setting == "auto":
-        env = os.environ.get("SQ_SKETCH_ROWS")
+        env = _knobs.get_raw("SQ_SKETCH_ROWS")
         if env is not None:
             setting = int(env)
     if setting == "auto":
@@ -525,7 +525,7 @@ def audit_sketch(stats, X):
     if not _obs.guarantees.enabled() or not stats.sketched:
         return
     n, m = stats.shape
-    cap = int(os.environ.get("SQ_SKETCH_AUDIT_ELEMS", DEFAULT_AUDIT_ELEMS))
+    cap = _knobs.get_int("SQ_SKETCH_AUDIT_ELEMS", DEFAULT_AUDIT_ELEMS)
     if n * m > cap:
         return
     try:

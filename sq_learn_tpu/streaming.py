@@ -64,6 +64,7 @@ from jax import lax
 from . import obs as _obs
 from .resilience import faults as _faults
 from .resilience import supervisor as _sup
+from . import _knobs
 
 __all__ = [
     "StreamCheckpoint",
@@ -85,7 +86,7 @@ __all__ = [
 
 #: tail tiles are padded up to power-of-two row buckets no smaller than
 #: this, bounding the bucket set to ~log2(rows_per_tile) compiled shapes
-_MIN_BUCKET_ROWS = int(os.environ.get("SQ_STREAM_MIN_BUCKET_ROWS", 64))
+_MIN_BUCKET_ROWS = _knobs.get_int("SQ_STREAM_MIN_BUCKET_ROWS")
 
 
 def stream_tile_bytes():
@@ -93,7 +94,7 @@ def stream_tile_bytes():
     the default is the relay-safe ``SQ_TRANSFER_CHUNK_BYTES`` from
     :mod:`sq_learn_tpu._config` (every observed relay wedge hit during a
     single ≥200 MB upload, never during small transfers)."""
-    env = os.environ.get("SQ_STREAM_TILE_BYTES")
+    env = _knobs.get_raw("SQ_STREAM_TILE_BYTES")
     if env is not None:
         return int(env)
     from ._config import _TRANSFER_CHUNK_BYTES
@@ -289,7 +290,7 @@ class StreamCheckpoint:
 
     def __init__(self, path, every=None):
         self.path = str(path)
-        self.every = int(os.environ.get("SQ_STREAM_CKPT_EVERY", 8)
+        self.every = int(_knobs.get_int("SQ_STREAM_CKPT_EVERY")
                          if every is None else every)
         if self.every < 1:
             raise ValueError(f"checkpoint every must be >= 1, got {every}")
@@ -331,7 +332,7 @@ def _resolve_checkpoint(checkpoint, site):
         if isinstance(checkpoint, StreamCheckpoint):
             return checkpoint
         return StreamCheckpoint(checkpoint)
-    ckpt_dir = os.environ.get("SQ_STREAM_CKPT_DIR")
+    ckpt_dir = _knobs.get_raw("SQ_STREAM_CKPT_DIR")
     if not ckpt_dir or site is None:
         return None
     os.makedirs(ckpt_dir, exist_ok=True)
@@ -340,7 +341,7 @@ def _resolve_checkpoint(checkpoint, site):
 
 
 def _strict_guard():
-    return os.environ.get("SQ_RESILIENCE_STRICT") == "1"
+    return _knobs.get_bool("SQ_RESILIENCE_STRICT")
 
 
 def _check_finite(acc, site, tile_index, start, n_valid):

@@ -270,6 +270,11 @@ class AsyncStreamCheckpointer:
       re-raised on the next :meth:`submit`/:meth:`close`.
     """
 
+    #: lock-discipline contract (``sq_learn_tpu.analysis``): writer/
+    #: caller shared state is only written under ``self._cond``.
+    _GUARDED_BY = {"_cond": ("_pending", "_writing", "_error", "_stop",
+                             "writes", "dropped")}
+
     def __init__(self, path):
         import threading
 

@@ -35,6 +35,7 @@ from contextlib import contextmanager
 import jax
 
 from .. import obs as _obs
+from .. import _knobs
 
 #: bf16 matmul peak FLOP/s per chip generation (public spec sheets /
 #: the jax-ml scaling book). The MXU's native rate; f32 MFU reported
@@ -92,7 +93,7 @@ def host_cpu_peak_flops():
     modeling) — it exists so CPU-backend runs report a finite MFU
     instead of ``None`` + an ``unknown_chip`` gauge, which left
     ``bench_pallas_mfu`` blind off-TPU."""
-    env = os.environ.get("SQ_CPU_PEAK_FLOPS")
+    env = _knobs.get_raw("SQ_CPU_PEAK_FLOPS")
     if env:
         return float(env)
     return (os.cpu_count() or 1) * _host_cpu_hz() * CPU_FLOPS_PER_CORE_CYCLE
@@ -110,7 +111,7 @@ def device_peak_flops(device=None):
     acceptable only because a CPU "MFU" is a roofline orientation, not a
     hardware-utilization claim of record).
     """
-    env = os.environ.get("SQ_TPU_PEAK_FLOPS")
+    env = _knobs.get_raw("SQ_TPU_PEAK_FLOPS")
     if env:
         return float(env)
     if device is None:
@@ -165,7 +166,7 @@ def mfu(flops, seconds, device=None, site=None):
     try:
         d = device if device is not None else jax.devices()[0]
         if getattr(d, "platform", "") == "cpu" \
-                and not os.environ.get("SQ_TPU_PEAK_FLOPS"):
+                and not _knobs.get_raw("SQ_TPU_PEAK_FLOPS"):
             attrs["cpu_estimate"] = True
     except Exception:
         pass

@@ -1,0 +1,223 @@
+"""sqcheck (``sq_learn_tpu.analysis``) — rules, baseline semantics,
+knob-registry round-trip, docs generation, and the self-run gate
+asserting the shipped tree is lint-clean against the committed
+baseline."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from sq_learn_tpu import _knobs
+from sq_learn_tpu.analysis import (
+    Finding, load_baseline, run, get_rules, ALL_RULES)
+from sq_learn_tpu.analysis.core import match_baseline
+from sq_learn_tpu.analysis.docs import (
+    check_docs, load_registry_module, render_knob_table, DOCS_RELPATH)
+from sq_learn_tpu.analysis.selftest import FIXTURES, run_fixture
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------- rules
+
+@pytest.mark.parametrize("rule_name", sorted(FIXTURES))
+def test_rule_fires_on_bad_fixture(rule_name, tmp_path):
+    bad, expected, _ = FIXTURES[rule_name]
+    findings = run_fixture(rule_name, bad, base=str(tmp_path))
+    assert findings, f"{rule_name} silent on its bad fixture"
+    text = "\n".join(f.message for f in findings)
+    for fragment in expected:
+        assert fragment in text
+    assert all(f.rule == rule_name for f in findings)
+
+
+@pytest.mark.parametrize("rule_name", sorted(FIXTURES))
+def test_rule_quiet_on_good_fixture(rule_name, tmp_path):
+    _, _, good = FIXTURES[rule_name]
+    findings = run_fixture(rule_name, good, base=str(tmp_path))
+    # the shared fixture registry carries one intentionally-dead knob
+    # (exercised by the knob-registry bad case)
+    real = [f for f in findings if "SQ_DEAD" not in f.message]
+    assert real == [], [f.message for f in real]
+
+
+def test_all_rules_have_selftest_fixtures():
+    assert {r.name for r in ALL_RULES} == set(FIXTURES)
+
+
+def test_unknown_rule_name_raises():
+    with pytest.raises(KeyError):
+        get_rules(["no-such-rule"])
+
+
+def test_syntax_error_is_reported_not_raised(tmp_path):
+    bad = tmp_path / "broken.py"
+    bad.write_text("def f(:\n")
+    findings, errors = run([str(bad)], get_rules(["rng-discipline"]),
+                           root=str(tmp_path))
+    assert findings == []
+    assert len(errors) == 1 and "broken.py" in errors[0]
+
+
+# ------------------------------------------------------------- baseline
+
+def _mk(rule, path, message):
+    return Finding(rule, path, 7, message)
+
+
+def test_baseline_split_fresh_suppressed_stale():
+    findings = [_mk("r1", "a.py", "m1"), _mk("r1", "a.py", "m2")]
+    baseline = [
+        {"rule": "r1", "path": "a.py", "message": "m1",
+         "justification": "known"},
+        {"rule": "r9", "path": "gone.py", "message": "old",
+         "justification": "stale"},
+    ]
+    fresh, suppressed, stale = match_baseline(findings, baseline)
+    assert [f.message for f in fresh] == ["m2"]
+    assert [f.message for f in suppressed] == ["m1"]
+    assert [e["message"] for e in stale] == ["old"]
+
+
+def test_baseline_key_is_line_free():
+    # two findings at different lines share one baseline entry
+    findings = [_mk("r", "p.py", "m"),
+                Finding("r", "p.py", 99, "m")]
+    baseline = [{"rule": "r", "path": "p.py", "message": "m",
+                 "justification": "both"}]
+    fresh, suppressed, stale = match_baseline(findings, baseline)
+    assert fresh == [] and stale == [] and len(suppressed) == 2
+
+
+def test_load_baseline_rejects_missing_justification(tmp_path):
+    p = tmp_path / "b.json"
+    p.write_text(json.dumps(
+        [{"rule": "r", "path": "p", "message": "m"}]))
+    with pytest.raises(ValueError):
+        load_baseline(str(p))
+
+
+def test_committed_baseline_entries_are_justified():
+    entries = load_baseline(os.path.join(
+        REPO, "sq_learn_tpu", "analysis", "baseline.json"))
+    assert 0 < len(entries) <= 10  # acceptance ceiling
+    for e in entries:
+        assert e["justification"] and "TODO" not in e["justification"]
+
+
+# -------------------------------------------------- knob registry
+
+def test_registry_round_trip(monkeypatch):
+    monkeypatch.setenv("SQ_OOC_SHARD_BYTES", "1024")
+    assert _knobs.get_int("SQ_OOC_SHARD_BYTES") == 1024
+    monkeypatch.delenv("SQ_OOC_SHARD_BYTES")
+    assert _knobs.get_int("SQ_OOC_SHARD_BYTES") == (8 << 20)  # registry
+    assert _knobs.get_int("SQ_OOC_SHARD_BYTES", 5) == 5  # caller default
+
+
+def test_flag_semantics(monkeypatch):
+    # default-off flag: only "1" enables
+    monkeypatch.delenv("SQ_OBS", raising=False)
+    assert _knobs.get_bool("SQ_OBS") is False
+    monkeypatch.setenv("SQ_OBS", "1")
+    assert _knobs.get_bool("SQ_OBS") is True
+    monkeypatch.setenv("SQ_OBS", "yes")
+    assert _knobs.get_bool("SQ_OBS") is False
+    # default-on flag: only "0" disables
+    monkeypatch.delenv("SQ_SERVE_CACHE", raising=False)
+    assert _knobs.get_bool("SQ_SERVE_CACHE") is True
+    monkeypatch.setenv("SQ_SERVE_CACHE", "0")
+    assert _knobs.get_bool("SQ_SERVE_CACHE") is False
+
+
+def test_unregistered_knob_read_raises():
+    with pytest.raises(_knobs.UnknownKnobError):
+        _knobs.get_raw("SQ_NOT_A_KNOB")
+
+
+def test_family_resolution():
+    e = _knobs.resolve("SQ_REGRESS_TOL_LATENCY")
+    assert e is not None and e.name == "SQ_REGRESS_TOL_*"
+    assert _knobs.resolve("SQ_NOPE") is None
+
+
+def test_no_raw_env_reads_outside_registry():
+    """The PR's conversion invariant, asserted directly: zero fresh
+    knob-registry findings over the package."""
+    findings, errors = run(
+        [os.path.join(REPO, "sq_learn_tpu")],
+        get_rules(["knob-registry"]), root=REPO)
+    assert errors == []
+    assert findings == [], [str(f) for f in findings]
+
+
+# ------------------------------------------------------------ docs
+
+def test_knob_table_render_and_drift_gate():
+    mod = load_registry_module(REPO)
+    rendered = render_knob_table(mod)
+    with open(os.path.join(REPO, DOCS_RELPATH)) as fh:
+        committed = fh.read()
+    assert rendered == committed, (
+        "docs/knobs.md drifted — regenerate with "
+        "`python -m sq_learn_tpu.analysis --docs > docs/knobs.md`")
+    for k in mod.iter_knobs():
+        assert f"`{k.name}`" in rendered
+
+
+def test_check_docs_clean_at_head():
+    assert check_docs(REPO) == []
+
+
+def test_check_docs_flags_unregistered_token(tmp_path):
+    root = tmp_path
+    (root / "sq_learn_tpu").mkdir()
+    src = open(os.path.join(
+        REPO, "sq_learn_tpu", "_knobs.py")).read()
+    (root / "sq_learn_tpu" / "_knobs.py").write_text(src)
+    (root / "CLAUDE.md").write_text("set SQ_IMAGINARY_KNOB=1 to win\n")
+    problems = check_docs(str(root))
+    assert any("SQ_IMAGINARY_KNOB" in p for p in problems)
+
+
+# --------------------------------------------------------- self-run
+
+def test_shipped_tree_is_lint_clean():
+    """`make lint`'s core contract: the committed tree + committed
+    baseline produce zero fresh and zero stale findings."""
+    baseline = load_baseline(os.path.join(
+        REPO, "sq_learn_tpu", "analysis", "baseline.json"))
+    findings, errors = run(
+        [os.path.join(REPO, "sq_learn_tpu")], get_rules(), root=REPO)
+    assert errors == []
+    fresh, _suppressed, stale = match_baseline(findings, baseline)
+    assert fresh == [], [str(f) for f in fresh]
+    assert stale == [], [e["message"] for e in stale]
+
+
+def test_obs_schema_record_types_export():
+    from sq_learn_tpu.obs import schema
+    assert isinstance(schema.RECORD_TYPES, tuple)
+    assert "counter" in schema.RECORD_TYPES
+    assert len(schema.RECORD_TYPES) == len(set(schema.RECORD_TYPES))
+
+
+def test_cli_exit_codes(tmp_path):
+    env = dict(os.environ, PYTHONPATH=REPO)
+    env.pop("JAX_PLATFORMS", None)
+    ok = subprocess.run(
+        [sys.executable, "-m", "sq_learn_tpu.analysis",
+         "--root", REPO], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=300)
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    bad = tmp_path / "bad.py"
+    bad.write_text("import os\nV = os.environ.get('SQ_X')\n")
+    red = subprocess.run(
+        [sys.executable, "-m", "sq_learn_tpu.analysis",
+         "--root", REPO, "--no-baseline", str(bad)],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert red.returncode == 1, red.stdout + red.stderr
+    assert "raw environment read" in red.stdout
