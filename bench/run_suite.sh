@@ -171,7 +171,7 @@ env -u PYTHONPATH timeout 60 python -m sq_learn_tpu.obs frontier \
   || echo "# (no tradeoff records this run)" >> "$obs_dir/frontier.txt"
 
 # BASELINE acceptance gate (bench/_gate.py: vs_baseline >= 0.5 on every
-# line, 14 measured + 2 derived lines expected — the sixth measured line
+# line, 15 measured + 2 derived lines expected — the sixth measured line
 # is the streaming-ingest smoke config, whose baseline is the monolithic
 # ingest of the same fit; the seventh is the PR 6 fused-fit config
 # (classical 70k×784 q-means vs sklearn on the SAME δ=0 configuration);
@@ -188,7 +188,11 @@ env -u PYTHONPATH timeout 60 python -m sq_learn_tpu.obs frontier \
 # p99 vs the same, the AOT-warmed cold-start-p99 ratio vs the unwarmed
 # arm — its own floor is 5.0 via the vs_baseline regression gate — and
 # the bf16 bytes ratio vs the f32 arm, floor 1.8 ⇔ "quantized moves
-# ≤ 0.55× the bytes"); the derived pair is bench_ipe_digits and the
+# ≤ 0.55× the bytes"); the fifteenth is the PR 16 megabatch line from
+# the same bench (the 12k mix spread over 48 same-fingerprint alias
+# tenants, native+megabatch arm QPS vs the tenant-scoped PR 11 arm,
+# floor 1.5 via the vs_baseline regression gate);
+# the derived pair is bench_ipe_digits and the
 # sharded-scaling smoke config; missing/null = fail). This
 # script is where the bar is enforced — the unit suite only warns, since
 # wall-clock there is subject to arbitrary host load.
@@ -196,7 +200,7 @@ env -u PYTHONPATH timeout 60 python -m sq_learn_tpu.obs frontier \
 # pre-imports jax via the axon sitecustomize and would hang on a wedged
 # relay even though this step only parses JSON; -m bench._gate resolves
 # via cwd, which is the repo root here)
-env -u PYTHONPATH timeout 60 python -m bench._gate "$out" 14 2
+env -u PYTHONPATH timeout 60 python -m bench._gate "$out" 15 2
 gate_rc=$?
 echo "# acceptance gate rc=$gate_rc" >> "$out"
 echo "done: $out"

@@ -264,6 +264,12 @@ _ENTRIES = [
     _K("SQ_SERVE_SLO_FLUSH_BATCHES", "int", 256, "lib",
        "Windowed slo/budget record flush stride in batches (0 "
        "disables).", "docs/serving.md"),
+    _K("SQ_SERVE_NATIVE", "flag", True, "lib",
+       "Native gather/scatter fast path + pooled assembly buffers (0 = "
+       "the per-request numpy path, bit-identical).", "docs/serving.md"),
+    _K("SQ_SERVE_MEGABATCH", "flag", True, "lib",
+       "Cross-tenant coalescing of same-fingerprint tenants into one "
+       "kernel launch (0 = tenant-scoped batches).", "docs/serving.md"),
     # -- datasets --------------------------------------------------------
     _K("CICIDS_CSV", "path", None, "lib",
        "Path to a real CICIDS2017 CSV export (unset = deterministic "

@@ -1457,3 +1457,62 @@ extern "C" int64_t lz4_decompress(const uint8_t* src, int64_t n,
   }
   return op == raw_n ? op : -1;
 }
+
+// ---------------------------------------------------------------------------
+// Serving-plane batch assembly / scatter (PR 16)
+// ---------------------------------------------------------------------------
+//
+// The micro-batching dispatcher's hot path is pure byte movement: gather N
+// request payloads into one padded pow2 bucket buffer before the dispatch,
+// slice the result buffer back per request after the fetch. Per-request
+// numpy slice assignment pays the full ufunc/indexing machinery (~µs each)
+// for what is a memcpy; these two entry points do the whole batch in one
+// ctypes call. Pointer arrays arrive as uint64 element addresses (the
+// caller passes numpy arrays' .ctypes.data) with per-block byte counts —
+// the C side cannot see shapes, so every copy is bounds-checked against
+// the destination and rc -1 rejects the whole call (the Python wrapper
+// then falls back to the byte-identical numpy path).
+
+// gather: copy n blocks consecutively into dst[0..dst_bytes), zero the
+// padding tail. rc 0 on success, -1 on any overrun/null.
+extern "C" int serve_gather(const uint64_t* src_ptrs, const int64_t* src_bytes,
+                            int64_t n, uint8_t* dst, int64_t dst_bytes) {
+  if (n < 0 || dst_bytes < 0) return -1;
+  if (n > 0 && (src_ptrs == nullptr || src_bytes == nullptr)) return -1;
+  if (dst_bytes > 0 && dst == nullptr) return -1;
+  int64_t off = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t sz = src_bytes[i];
+    if (sz < 0 || sz > dst_bytes - off) return -1;
+    if (sz > 0) {
+      const uint8_t* src = (const uint8_t*)(uintptr_t)src_ptrs[i];
+      if (src == nullptr) return -1;
+      std::memcpy(dst + off, src, (size_t)sz);
+    }
+    off += sz;
+  }
+  if (off < dst_bytes) std::memset(dst + off, 0, (size_t)(dst_bytes - off));
+  return 0;
+}
+
+// scatter: copy consecutive slices of src back into n per-request result
+// buffers (submission order). rc 0 on success, -1 on any overrun/null.
+extern "C" int serve_scatter(const uint8_t* src, int64_t src_bytes,
+                             const uint64_t* dst_ptrs,
+                             const int64_t* dst_bytes, int64_t n) {
+  if (n < 0 || src_bytes < 0) return -1;
+  if (n > 0 && (dst_ptrs == nullptr || dst_bytes == nullptr)) return -1;
+  int64_t off = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t sz = dst_bytes[i];
+    if (sz < 0 || sz > src_bytes - off) return -1;
+    if (sz > 0) {
+      if (src == nullptr) return -1;
+      uint8_t* dst = (uint8_t*)(uintptr_t)dst_ptrs[i];
+      if (dst == nullptr) return -1;
+      std::memcpy(dst, src + off, (size_t)sz);
+    }
+    off += sz;
+  }
+  return 0;
+}

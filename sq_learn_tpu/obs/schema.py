@@ -23,7 +23,13 @@ the shard codec, :mod:`sq_learn_tpu.oocore.store`), the
 ``serving.cache_spills`` / ``serving.cache_disk_hits`` counters (the
 feature-cache disk tier, :mod:`sq_learn_tpu.serving.cache`), the
 ``cold_tier`` fault kind (per-shard remote-storage latency model), and
-the ``codec`` attr on ``oocore.create_store`` spans. Older versions
+the ``codec`` attr on ``oocore.create_store`` spans. PR 16 adds one
+more counter convention on the same generic type (still v7): the
+``serving.megabatches`` counter — kernel launches that coalesced
+requests from MORE than one tenant (cross-tenant megabatching,
+:mod:`sq_learn_tpu.serving.dispatcher`); each such launch still lands
+exactly one set of per-tenant ``slo``/``budget`` records whose request
+counts sum to the run aggregate. Older versions
 still validate (their types are a strict subset), any other version is
 rejected — an unknown version means a reader that would silently
 misinterpret fields, so it must fail loudly.

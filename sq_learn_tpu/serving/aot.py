@@ -59,7 +59,13 @@ _lock = threading.Lock()
 #: (kernel name, ((shape, dtype), ...)) → compiled executable. Keyed by
 #: the full abstract call signature, so two tenants with equal shapes
 #: share one executable and a re-registered tenant with new shapes can
-#: never hit its predecessor's.
+#: never hit its predecessor's. This signature sharing is what makes
+#: cross-tenant megabatching (PR 16) free at the compile layer: a
+#: megabatch of same-fingerprint tenants resolves to the SAME executable
+#: a single-tenant batch would — one launch, zero extra compiles. (The
+#: rejected alternative — stacking per-tenant params into the call —
+#: would mint a signature per tenant-count and break the zero-compile
+#: contract.)
 _executables = {}
 
 _persistent = {"registered": False, "enabled": False, "hits": 0,

@@ -47,11 +47,39 @@ bucketed shapes:
   lost and the queue never stalls behind a wedged relay. Degrades count
   into the SLO record and the ``serving.degraded_batches`` counter.
 
+- **Native fast path (PR 16).** The per-batch host work — gathering N
+  request payloads into the padded bucket and slicing the result back
+  per request — runs through :func:`sq_learn_tpu.native.serve_gather` /
+  :func:`~sq_learn_tpu.native.serve_scatter` (one ctypes call per
+  batch instead of one numpy slice op per request) over a pool of
+  reused assembly buffers (no per-batch allocation; a buffer is only
+  released back AFTER the result fetch, because a CPU-backend
+  ``device_put`` may zero-copy alias the host memory). The pure-Python
+  fallback — and the ``SQ_SERVE_NATIVE=0`` opt-out, which takes the
+  pre-PR 16 per-request path verbatim — is byte-identical (pinned by
+  test).
+- **Cross-tenant megabatching (PR 16).** The group key rides the model
+  fingerprint, and tenants sharing a fingerprint serve byte-identical
+  params (registry contract) — so their requests coalesce into ONE
+  kernel launch (fuller buckets, fewer dispatches; the AOT executable
+  is shared by abstract signature, so the zero-compile contract holds
+  untouched). Attribution stays exact: a batch spanning tenants is
+  billed per tenant — each tenant's requests, rows, row-share of the
+  payload bytes, and split of the stage decomposition land on its OWN
+  slo/budget records, and Σ per-tenant requests == the run aggregate
+  (the PR 12 reconciliation gate). Batches that span tenants count
+  into the ``serving.megabatches`` counter. ``SQ_SERVE_MEGABATCH=0``
+  prefixes the group key with the tenant, forcing single-tenant
+  batches. Tenants with different transfer dtypes (e.g. a bf16
+  tenant next to an f32 one) can never merge — the key carries the
+  dtype and the fingerprint carries the quantize mode.
+
 - **Per-tenant attribution (PR 12).** Under an active recorder every
   request's latency, every batch's queue-wait / coalesce / assemble /
   transfer / compute / scatter decomposition (``_Request`` carries the
-  monotonic timestamps; batches are single-tenant by construction —
-  the group key rides the model fingerprint), and every live fold-audit
+  monotonic timestamps; a batch spans tenants only on the
+  same-fingerprint megabatch path above, which bills per tenant), and
+  every live fold-audit
   draw is attributed to its tenant: the
   :class:`~sq_learn_tpu.obs.budget.BudgetLedger` tracks each tenant's
   latency-SLO and (ε, δ) burn over rolling windows, per-tenant ``slo``
@@ -79,6 +107,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import native as _native
 from .. import obs as _obs
 from ..obs import xla as _xla
 from ..resilience import supervisor as _sup
@@ -242,15 +271,63 @@ def _canonical(dtype):
     return got
 
 
-class _Request:
-    __slots__ = ("tenant", "op", "rows", "n_rows", "future", "submitted",
-                 "cache_key", "model", "group_key", "consumed", "collected")
+class _BufferPool:
+    """Reusable padded assembly buffers, keyed by exact (rows, features,
+    dtype). The dispatcher acquires one per batch and releases it only
+    AFTER the batch's result fetch completes — a CPU-backend
+    ``device_put``/``jnp.asarray`` may zero-copy alias the host memory,
+    so releasing earlier could let the next batch's gather overwrite an
+    in-flight computation's input. Error paths simply drop the buffer
+    (the pool refills on demand). Bounded per key: the double-buffered
+    worker holds at most two batches in flight, plus a concurrent
+    flush."""
 
-    def __init__(self, tenant, op, rows, model, cache_key, submitted):
+    #: lock-discipline contract (``sq_learn_tpu.analysis``): the free
+    #: lists are shared between the worker thread and flushing callers
+    _GUARDED_BY = {"_lock": ("_free",)}
+
+    def __init__(self, per_key=4):
+        self._lock = threading.Lock()
+        self._free = {}
+        self._per_key = int(per_key)
+
+    def acquire(self, rows, cols, dtype):
+        key = (rows, cols, dtype)
+        with self._lock:
+            stack = self._free.get(key)
+            if stack:
+                return stack.pop()
+        # np.empty, not zeros: every acquirer overwrites the full rows
+        # region and zeroes the tail itself (gather contract)
+        return np.empty((rows, cols), dtype)
+
+    def release(self, buf):
+        key = (buf.shape[0], buf.shape[1], buf.dtype)
+        with self._lock:
+            stack = self._free.get(key)
+            if stack is None:
+                stack = self._free[key] = []
+            if len(stack) < self._per_key:
+                stack.append(buf)
+
+
+class _Request:
+    __slots__ = ("tenant", "op", "rows", "n_rows", "addr", "future",
+                 "submitted", "cache_key", "model", "group_key",
+                 "consumed", "collected")
+
+    def __init__(self, tenant, op, rows, model, cache_key, submitted,
+                 group_key):
         self.tenant = tenant
         self.op = op
         self.rows = rows
         self.n_rows = rows.shape[0]
+        #: payload base address, captured ONCE on the submitting client
+        #: thread — an `.ctypes.data` read mints a fresh ctypes object
+        #: (~1.5 µs), which the single-threaded worker must not pay per
+        #: request per batch. `rows` is held by this request, so the
+        #: address stays valid until the batch is assembled.
+        self.addr = rows.ctypes.data
         self.model = model
         self.cache_key = cache_key
         self.submitted = submitted
@@ -259,11 +336,12 @@ class _Request:
         #: recorder (the disabled path takes no extra clock reads)
         self.collected = None
         self.future = ServeFuture()
-        # the memoized model token: tenant identity rides the content
-        # fingerprint (a re-registered tenant gets a new one), and a
-        # quantized model folds f32/f64 streams into ONE transfer-dtype
-        # group — fewer, fuller buckets
-        self.group_key = model.group_key(op, rows.dtype)
+        # the memoized model token (computed by the dispatcher — it may
+        # prefix the tenant under SQ_SERVE_MEGABATCH=0): tenant identity
+        # rides the content fingerprint (a re-registered tenant gets a
+        # new one), and a quantized model folds f32/f64 streams into ONE
+        # transfer-dtype group — fewer, fuller buckets
+        self.group_key = group_key
         self.consumed = False
 
 
@@ -285,12 +363,13 @@ class MicroBatchDispatcher:
     #: ``self._cond`` (``*_locked`` helpers assume the lock is held).
     _GUARDED_BY = {"_cond": ("_queue", "_by_key", "_key_rows",
                              "_pending_count", "_stopping", "_batch_seq",
-                             "_aot_hits", "_aot_misses", "_sites_seen")}
+                             "_aot_hits", "_aot_misses", "_sites_seen",
+                             "_megabatches")}
 
     def __init__(self, registry, *, max_wait_ms=None, max_batch_rows=None,
                  min_bucket_rows=None, slo_p50_ms=None, slo_p99_ms=None,
-                 background=True, coalesce=True,
-                 site="serving.dispatcher"):
+                 background=True, coalesce=True, native=None,
+                 megabatch=None, site="serving.dispatcher"):
         self.registry = registry
         #: coalesce=False is the sequential per-request baseline: every
         #: dispatch serves exactly one request (no companions, no
@@ -306,6 +385,16 @@ class MicroBatchDispatcher:
                             if min_bucket_rows is None
                             else int(min_bucket_rows))
         self._site = site
+        #: the PR 16 fast-path switches, latched per dispatcher (knob
+        #: process defaults, constructor override — the bench arms and
+        #: the bit-identity tests toggle per instance, never via env
+        #: mutation): native gather/scatter + pooled buffers, and
+        #: fingerprint-keyed cross-tenant coalescing
+        self._native = (_knobs.get_bool("SQ_SERVE_NATIVE")
+                        if native is None else bool(native))
+        self._megabatch = (_knobs.get_bool("SQ_SERVE_MEGABATCH")
+                           if megabatch is None else bool(megabatch))
+        self._pool = _BufferPool()
         self.slo = SloTracker(site, slo_p50_ms=slo_p50_ms,
                               slo_p99_ms=slo_p99_ms)
         self._cond = threading.Condition()
@@ -330,6 +419,9 @@ class MicroBatchDispatcher:
         #: flush at close, not a JSONL line per batch)
         self._aot_hits = 0
         self._aot_misses = 0
+        #: dispatched batches spanning >1 tenant (same-fingerprint
+        #: co-batching) — flushed into ``serving.megabatches`` at close
+        self._megabatches = 0
         self._worker = None
         if background:
             self._worker = threading.Thread(
@@ -348,15 +440,22 @@ class MicroBatchDispatcher:
             buckets=_aot.bucket_ladder(self._min_bucket,
                                        self._max_batch_rows))
 
-    def _prepare(self, tenant, op, X):
+    def _prepare(self, tenant, op, X, submitted=None, models=None):
         """Validate and normalize one request. Returns a queued-ready
         :class:`_Request`, or an already-resolved :class:`ServeFuture`
         on a result-cache hit. Shape, dtype, tenant, and op problems
         raise HERE, synchronously — a malformed request must never
-        occupy the queue."""
+        occupy the queue. ``submitted``/``models`` are the burst path's
+        amortizers: one shared submit stamp and one registry resolve per
+        tenant per burst instead of per request."""
         if self._closed:
             raise RuntimeError("dispatcher is closed")
-        model = self.registry.resolve(tenant)
+        if models is None:
+            model = self.registry.resolve(tenant)
+        else:
+            model = models.get(tenant)
+            if model is None:
+                model = models[tenant] = self.registry.resolve(tenant)
         model.op(op)  # validates the op against the model, raises KeyError
         rows = np.asarray(X)
         if rows.ndim == 1:
@@ -376,7 +475,8 @@ class MicroBatchDispatcher:
                 rows = rows.astype(canonical)
         if not rows.flags.c_contiguous:
             rows = np.ascontiguousarray(rows)
-        submitted = self.slo.note_submit()
+        if submitted is None:
+            submitted = self.slo.note_submit()
         cache_key = None
         if op in model.cacheable:
             cache_key = _cache.key_for(model.fingerprint, op, rows)
@@ -397,7 +497,14 @@ class MicroBatchDispatcher:
                 else:
                     self.slo.note_request_done(submitted)
                 return fut
-        return _Request(str(tenant), op, rows, model, cache_key, submitted)
+        tenant = str(tenant)
+        group_key = model.group_key(op, rows.dtype)
+        if not self._megabatch:
+            # tenant-scoped batches: the opt-out prefixes the memoized
+            # fingerprint key so equal-fingerprint tenants never merge
+            group_key = (tenant,) + group_key
+        return _Request(tenant, op, rows, model, cache_key, submitted,
+                        group_key)
 
     def _targets_for(self, model):
         """The (p50, p99) targets a tenant's requests burn against: its
@@ -443,13 +550,26 @@ class MicroBatchDispatcher:
         order. This is the client-side half of the amortization story:
         a serving frontend reads requests off its transport in bursts,
         and per-request lock/notify traffic at 10⁴ QPS is measurable —
-        the load bench's clients submit their windows through here."""
-        prepared = [self._prepare(t, op, X) for t, op, X in requests]
-        with self._cond:
-            for req in prepared:
-                if not isinstance(req, ServeFuture):
-                    self._enqueue_locked(req)
-            self._cond.notify()
+        the load bench's clients submit their windows through here.
+        PR 16 amortizes the rest of the per-request fixed costs too:
+        the burst takes ONE monotonic-clock stamp (every request in it
+        arrived in the same transport read, so the shared submit
+        timestamp keeps the stage-decomposition semantics identical
+        while dropping N−1 clock reads per burst), resolves each tenant
+        against the registry once, and extends the group subqueues in
+        one pre-sized pass per key instead of growing them per
+        append."""
+        requests = list(requests)
+        submitted = self.slo.note_submit()
+        models = {}
+        prepared = [self._prepare(t, op, X, submitted=submitted,
+                                  models=models)
+                    for t, op, X in requests]
+        to_queue = [r for r in prepared if not isinstance(r, ServeFuture)]
+        if to_queue:
+            with self._cond:
+                self._enqueue_many_locked(to_queue)
+                self._cond.notify()
         return [r if isinstance(r, ServeFuture) else r.future
                 for r in prepared]
 
@@ -464,6 +584,29 @@ class MicroBatchDispatcher:
         # rescans the queue (the scan was quadratic in queue depth)
         self._key_rows[key] = self._key_rows.get(key, 0) + req.n_rows
         self._pending_count += 1
+
+    def _enqueue_many_locked(self, reqs):
+        """Burst enqueue: one arrival-order extend, then one pre-sized
+        extend + one row-count update per group key — the per-append
+        dict lookup and deque growth of N ``_enqueue_locked`` calls
+        collapse to one pass per key (C-speed extends over sized
+        lists)."""
+        self._queue.extend(reqs)
+        by_key = {}
+        for req in reqs:
+            lst = by_key.get(req.group_key)
+            if lst is None:
+                lst = by_key[req.group_key] = []
+            lst.append(req)
+        for key, lst in by_key.items():
+            kq = self._by_key.get(key)
+            if kq is None:
+                self._by_key[key] = collections.deque(lst)
+            else:
+                kq.extend(lst)
+            self._key_rows[key] = (self._key_rows.get(key, 0)
+                                   + sum(r.n_rows for r in lst))
+        self._pending_count += len(reqs)
 
     def serve(self, tenant, op, X):
         """Blocking convenience: submit, flush when deterministic, and
@@ -511,6 +654,9 @@ class MicroBatchDispatcher:
             if self._aot_misses:
                 _obs.counter_add("serving.aot_cache_misses",
                                  self._aot_misses)
+            if self._megabatches:
+                _obs.counter_add("serving.megabatches",
+                                 self._megabatches)
             nbytes = self.slo.transfer_bytes()
             if nbytes:
                 _obs.counter_add("serving.transfer_bytes", nbytes)
@@ -526,6 +672,13 @@ class MicroBatchDispatcher:
         (pre-aggregation view — the counters flush at close)."""
         with self._cond:
             return {"hits": self._aot_hits, "misses": self._aot_misses}
+
+    def megabatches(self):
+        """Dispatched batches that spanned more than one tenant
+        (same-fingerprint co-batching; flushed into the
+        ``serving.megabatches`` counter at close)."""
+        with self._cond:
+            return self._megabatches
 
     def __enter__(self):
         return self
@@ -681,25 +834,43 @@ class MicroBatchDispatcher:
         the request rows verbatim (exact route), or quantized to the
         model's mode — ONE rounding pass on the host, so the supervised
         and degraded placements carry byte-identical payloads. Returns
-        ``(padded, extra_args, amax_x)`` where ``extra_args`` is the
-        int8 route's () f32 batch scale and ``amax_x`` the batch dynamic
-        range the declared fold is evaluated at (None when no audit can
-        consume it)."""
+        ``(padded, extra_args, amax_x, pooled)`` where ``extra_args`` is
+        the int8 route's () f32 batch scale, ``amax_x`` the batch
+        dynamic range the declared fold is evaluated at (None when no
+        audit can consume it), and ``pooled`` whether ``padded`` came
+        from the buffer pool (release after the result fetch). With the
+        native path on, the exact route is one :func:`sq_learn_tpu.
+        native.serve_gather` call into a pooled buffer; with
+        ``SQ_SERVE_NATIVE=0`` this is the pre-PR 16 code verbatim
+        (fresh ``np.zeros`` + per-request slice assignment) — both
+        produce byte-identical payloads (pinned by test)."""
         head = group[0]
         mode = model.quantize
         m = head.rows.shape[1]
         if mode is None:
+            if self._native:
+                padded = self._pool.acquire(bucket, m, head.rows.dtype)
+                _native.serve_gather([r.rows for r in group], padded,
+                                     addrs=[r.addr for r in group],
+                                     counts=[r.n_rows for r in group],
+                                     trusted=True)
+                return padded, (), None, True
             padded = np.zeros((bucket, m), head.rows.dtype)
             off = 0
             for r in group:
                 padded[off:off + r.n_rows] = r.rows
                 off += r.n_rows
-            return padded, (), None
+            return padded, (), None, False
         amax_x = None
         if mode == "int8" or _obs.guarantees.enabled():
             amax_x = max((float(np.max(np.abs(r.rows))) if r.rows.size
                           else 0.0) for r in group)
-        padded = np.zeros((bucket, m), _quant.transfer_dtype(mode))
+        pooled = self._native
+        if pooled:
+            padded = self._pool.acquire(bucket, m,
+                                        _quant.transfer_dtype(mode))
+        else:
+            padded = np.zeros((bucket, m), _quant.transfer_dtype(mode))
         extra = ()
         scale = None
         if mode == "int8":
@@ -711,7 +882,9 @@ class MicroBatchDispatcher:
                                  out=padded[off:off + r.n_rows],
                                  scale=scale)
             off += r.n_rows
-        return padded, extra, amax_x
+        if pooled:
+            padded[off:] = 0  # pooled buffers carry stale tail bytes
+        return padded, extra, amax_x, pooled
 
     def _launch(self, group):
         """Stage 1: pad (quantizing when the model says so), place
@@ -737,7 +910,8 @@ class MicroBatchDispatcher:
         if observing:
             for r in group:
                 r.collected = t_collect
-        padded, extra, amax_x = self._assemble(group, bucket, model)
+        padded, extra, amax_x, pooled = self._assemble(group, bucket,
+                                                       model)
         t_assembled = time.perf_counter() if observing else 0.0
         if observing:
             kernel_fn = _KERNELS[kernel_name]
@@ -749,6 +923,14 @@ class MicroBatchDispatcher:
 
         compiled = _aot.lookup(model, head.op, bucket, padded.dtype)
 
+        # same-fingerprint tenants co-batch (the megabatch path): note
+        # it for the honesty counter and the per-tenant billing split
+        multi = False
+        for r in group:
+            if r.tenant != head.tenant:
+                multi = True
+                break
+
         with self._cond:
             seq = self._batch_seq
             self._batch_seq += 1
@@ -756,6 +938,8 @@ class MicroBatchDispatcher:
                 self._aot_hits += 1
             else:
                 self._aot_misses += 1
+            if multi:
+                self._megabatches += 1
 
         degraded = False
         dev = None
@@ -804,17 +988,19 @@ class MicroBatchDispatcher:
         stamps = ((t_collect, t_assembled, t_placed) if observing
                   else None)
         return (group, out_dev, n, bucket, degraded, site, observing,
-                padded.nbytes, amax_x, seq, stamps)
+                padded.nbytes, amax_x, seq, stamps, padded, pooled,
+                multi)
 
     def _resolve(self, state):
         """Stage 2: fetch the batch's device result and scatter it back
         per request (cache store, future resolution, SLO accounting —
         per tenant under an active recorder, with the batch's latency
-        decomposition — and, for a quantized batch under observability,
-        the strided live guarantee draw against the declared fold, fed
-        into the tenant's error-budget ledger)."""
+        decomposition split per tenant when the batch is a megabatch —
+        and, for a quantized batch under observability, the strided live
+        guarantee draw against the declared fold, fed into the tenant's
+        error-budget ledger)."""
         (group, out_dev, n, bucket, degraded, site, observing,
-         nbytes, amax_x, seq, stamps) = state
+         nbytes, amax_x, seq, stamps, padded, pooled, multi) = state
         try:
             out = np.asarray(out_dev)
         except Exception as exc:
@@ -825,19 +1011,33 @@ class MicroBatchDispatcher:
             if observing:
                 _obs.watchdog.observe(site)
             raise
+        if pooled:
+            # the fetch above proves the batch's compute is done reading
+            # its input — only now is the (possibly device-aliased)
+            # assembly buffer safe to hand to the next batch
+            self._pool.release(padded)
         done = time.perf_counter()
-        off = 0
-        head_res = None
-        for r in group:
-            res = np.array(out[off:off + r.n_rows], copy=True)
-            off += r.n_rows
-            if head_res is None:
-                head_res = res
-            if r.cache_key is not None:
-                _cache.store(r.cache_key, res)
-            r.future.set_result(res)
+        if self._native:
+            results = _native.serve_scatter(out,
+                                            [r.n_rows for r in group])
+            head_res = results[0] if results else None
+            for r, res in zip(group, results):
+                if r.cache_key is not None:
+                    _cache.store(r.cache_key, res)
+                r.future.set_result(res)
+        else:
+            off = 0
+            head_res = None
+            for r in group:
+                res = np.array(out[off:off + r.n_rows], copy=True)
+                off += r.n_rows
+                if head_res is None:
+                    head_res = res
+                if r.cache_key is not None:
+                    _cache.store(r.cache_key, res)
+                r.future.set_result(res)
         head = group[0]
-        tenant = targets = stages = None
+        tenant = targets = stages = parts = None
         if observing:
             tenant = head.tenant
             targets = self._targets_for(head.model)
@@ -859,15 +1059,27 @@ class MicroBatchDispatcher:
                     "compute": max(0.0, done - t_placed),
                     "scatter": max(0.0, t_scatter - done),
                 }
+            if multi:
+                parts = self._tenant_parts(group, head, n, nbytes,
+                                           stamps, stages)
         self.slo.note_batch_done([r.submitted for r in group], done, n,
                                  bucket, degraded, nbytes=nbytes,
-                                 tenant=tenant, targets=targets,
-                                 stages=stages)
+                                 tenant=None if parts else tenant,
+                                 targets=None if parts else targets,
+                                 stages=stages, parts=parts)
         if observing:
-            self._budget_ledger().note_requests(
-                tenant, [done - r.submitted for r in group],
-                p50_ms=targets[0], p99_ms=targets[1], ts=done)
-        if observing and head.model.quant_folds and amax_x is not None:
+            led = self._budget_ledger()
+            if parts is not None:
+                for (t, ts_list, _rows, _nb, tgt, _st) in parts:
+                    led.note_requests(t, [done - ts for ts in ts_list],
+                                      p50_ms=tgt[0], p99_ms=tgt[1],
+                                      ts=done)
+            else:
+                led.note_requests(
+                    tenant, [done - r.submitted for r in group],
+                    p50_ms=targets[0], p99_ms=targets[1], ts=done)
+        if observing and head.model.quant_folds and amax_x is not None \
+                and head_res is not None:
             # one live draw per audited batch: the head request replayed
             # against the exact f64 reference, realized error vs the
             # declared fold (strided; see quantize._audit_every),
@@ -894,3 +1106,43 @@ class MicroBatchDispatcher:
                 self._budget.emit()
         if observing and _knobs.get_bool("SQ_OBS_STRICT"):
             _obs.watchdog.observe(site)
+
+    def _tenant_parts(self, group, head, n, nbytes, stamps, stages):
+        """Per-tenant billing split of one megabatch, submission order:
+        one ``(tenant, submit_ts_list, rows, nbytes_share, targets,
+        stage_split)`` tuple per tenant. Each tenant's queue wait is
+        the sum over ITS non-head requests (exact, from the real
+        timestamps), the coalescing window bills to the head's tenant
+        (it was the head's wait), and the batch-level device stages
+        (assemble/transfer/compute/scatter) split by row share — one
+        launch served everyone, so row-proportional is the exact
+        marginal attribution. Σ parts reproduces the batch totals and
+        Σ per-tenant requests == the run aggregate (the reconciliation
+        gate the bench asserts)."""
+        by_tenant = {}
+        for r in group:
+            lst = by_tenant.get(r.tenant)
+            if lst is None:
+                lst = by_tenant[r.tenant] = []
+            lst.append(r)
+        parts = []
+        for t, reqs in by_tenant.items():
+            rows_t = sum(r.n_rows for r in reqs)
+            st = None
+            if stages is not None:
+                frac = rows_t / n if n else 0.0
+                t_collect = stamps[0]
+                st = {
+                    "queue": sum(max(0.0, t_collect - r.submitted)
+                                 for r in reqs if r is not head),
+                    "coalesce": (stages["coalesce"]
+                                 if reqs[0] is head else 0.0),
+                    "assemble": stages["assemble"] * frac,
+                    "transfer": stages["transfer"] * frac,
+                    "compute": stages["compute"] * frac,
+                    "scatter": stages["scatter"] * frac,
+                }
+            parts.append((t, [r.submitted for r in reqs], rows_t,
+                          (nbytes * rows_t) // n if n else 0,
+                          self._targets_for(reqs[0].model), st))
+        return parts

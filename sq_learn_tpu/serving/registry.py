@@ -192,7 +192,14 @@ class ServingModel:
         tuple rebuild (the model-shape portion is invariant per model,
         carried by the content fingerprint). Two tenants sharing a
         fingerprint serve byte-identical params, so co-batching them is
-        sound by construction."""
+        sound by construction — the PR 16 megabatch path rides exactly
+        this key (one kernel launch per fingerprint, never per tenant),
+        with per-tenant attribution handled downstream by the
+        dispatcher; ``SQ_SERVE_MEGABATCH=0`` makes the dispatcher prefix
+        the key with the tenant name, forcing single-tenant batches.
+        Tenants with different quantize modes can never merge: the
+        fingerprint carries the mode and the key carries the transfer
+        dtype."""
         got = self._group_keys.get((op, request_dtype))
         if got is None:
             got = (self.fingerprint, op,

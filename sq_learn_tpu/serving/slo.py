@@ -11,9 +11,11 @@ lands in the p99). This module is the dispatcher's scoreboard:
   dispatch, measured submit→result on the host clock) and one entry per
   dispatched batch (valid rows vs bucket rows — the padding-efficiency
   number — plus whether the batch degraded to the host route). Under an
-  active recorder the same inputs ALSO accumulate per tenant (batches
-  are single-tenant by construction — the group key carries the model
-  fingerprint) and into a since-last-flush window; with ``SQ_OBS``
+  active recorder the same inputs ALSO accumulate per tenant (the group
+  key carries the model fingerprint, so a batch spans tenants only when
+  they serve byte-identical params — a PR 16 megabatch; the dispatcher
+  then passes per-tenant ``parts`` so each tenant is billed for exactly
+  its own rows) and into a since-last-flush window; with ``SQ_OBS``
   unset neither exists, so the disabled hot path is byte-identical to
   the pre-tenant tracker.
 - :meth:`SloTracker.emit` folds the run into ``slo`` obs records
@@ -194,24 +196,31 @@ class SloTracker:
 
     def note_batch_done(self, submit_timestamps, done_ts, valid_rows,
                         bucket_rows, degraded, nbytes=0, tenant=None,
-                        targets=None, stages=None):
+                        targets=None, stages=None, parts=None):
         """One dispatched batch's whole scoreboard update under a single
         lock — the scatter path runs per batch, not per request (the
         per-request lock traffic was a measurable slice of the
         micro-batching amortization floor). ``nbytes`` is the padded
         payload the batch moved host→device — the quantized route's
         bytes-halved claim is read off this tally. ``tenant`` attributes
-        the batch (batches are single-tenant: the group key carries the
-        model fingerprint), ``targets`` the tenant's resolved (p50, p99)
-        targets, ``stages`` the batch's latency decomposition in seconds
-        — all three passed only under an active recorder, so the
-        disabled path stays byte-identical."""
+        a single-tenant batch, ``targets`` the tenant's resolved
+        (p50, p99) targets, ``stages`` the batch's latency decomposition
+        in seconds. A cross-tenant megabatch passes ``parts`` instead:
+        one ``(tenant, submit_ts_list, rows, part_nbytes, targets,
+        part_stages)`` tuple per tenant, in submission order — the run
+        and window scopes still count the batch ONCE (Σ per-tenant
+        requests == the run aggregate is the PR 12 reconciliation gate),
+        while each tenant accumulator is billed exactly its own rows,
+        its row-share of the payload bytes, and its split of the stage
+        decomposition. All attribution arguments are passed only under
+        an active recorder, so the disabled path stays byte-identical."""
         with self._lock:
             run = self._run
             for ts in submit_timestamps:
                 run.note_request(ts, done_ts)
             run.note_batch(valid_rows, bucket_rows, degraded, nbytes)
-            if tenant is None and stages is None and not _obs.enabled():
+            if (tenant is None and stages is None and parts is None
+                    and not _obs.enabled()):
                 return
             if _obs.enabled():
                 win = self._win
@@ -220,7 +229,16 @@ class SloTracker:
                 win.note_batch(valid_rows, bucket_rows, degraded, nbytes)
             if stages:
                 run.add_stages(stages)
-            if tenant is not None:
+            if parts is not None:
+                for (t, ts_list, rows, part_nbytes, tgt, st) in parts:
+                    acc = self._tenant_accum(str(t), tgt)
+                    for ts in ts_list:
+                        acc.note_request(ts, done_ts)
+                    acc.note_batch(rows, bucket_rows, degraded,
+                                   part_nbytes)
+                    if st:
+                        acc.add_stages(st)
+            elif tenant is not None:
                 acc = self._tenant_accum(str(tenant), targets)
                 for ts in submit_timestamps:
                     acc.note_request(ts, done_ts)

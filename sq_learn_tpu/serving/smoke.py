@@ -24,6 +24,13 @@ seconds.
 6. a quantized leg under ``SQ_OBS_AUDIT_STRICT=1``: bf16/int8 responses
    within the declared fold of the exact f64 reference on EVERY
    request (not just the audited draws), zero jit compiles still;
+6b. a **cross-tenant megabatch leg** (ISSUE 16): a second tenant
+   registered from the SAME checkpoint (equal fingerprint) submits
+   interleaved with the first — the dispatcher must coalesce them into
+   shared kernel launches (``serving.megabatches`` ≥ 1), every response
+   must match the single-tenant run bit-for-bit, the per-tenant slo
+   records must sum EXACTLY to the run aggregate (requests), and the
+   zero-compile contract must hold through the whole leg;
 7. a **second process** re-warms a subset of the ladder against the
    same persistent cache directory and must report ≥1 persistent-cache
    hit — the restart-starts-warm claim;
@@ -250,6 +257,38 @@ def main():
                       f"{realized} exceeds declared fold {fold.tol(amax)}")
     dq.close()
     del os.environ["SQ_OBS_AUDIT_STRICT"]
+
+    # cross-tenant megabatch leg (ISSUE 16): "alpha2" serves the SAME
+    # checkpoint as "alpha" (equal fingerprint), so interleaved traffic
+    # from both must coalesce into shared launches with exact per-tenant
+    # attribution — and the shared AOT executables keep the zero-compile
+    # contract armed throughout.
+    reg.register("alpha2", alpha_dir)
+    mega_reqs = [("alpha" if i % 2 else "alpha2", "predict", rows)
+                 for i, (_t, _op, rows) in enumerate(requests[:24])]
+    serve_cache.clear()
+    dm = MicroBatchDispatcher(reg, background=False, max_batch_rows=128)
+    mega_futs = dm.submit_many(mega_reqs)
+    dm.flush()
+    mega_outs = [f.result(timeout=30) for f in mega_futs]
+    tenant_sums = dm.slo.tenant_summaries()
+    mega_slo = dm.close()
+    check(dm.megabatches() >= 1,
+          "equal-fingerprint tenants never shared a kernel launch")
+    check(get_recorder().counters.get("serving.megabatches", 0) >= 1,
+          "close() did not flush the serving.megabatches counter")
+    for (t, op, rows), out in zip(mega_reqs, mega_outs):
+        ref = qkm.predict(rows.astype(np.float32))
+        check(np.array_equal(out, ref),
+              f"megabatched {t} response != estimator predict")
+    check(set(tenant_sums) >= {"alpha", "alpha2"},
+          f"per-tenant attribution missing a tenant: {set(tenant_sums)}")
+    check(sum(s["requests"] for s in tenant_sums.values())
+          == mega_slo["requests"] == len(mega_reqs),
+          "per-tenant slo records do not reconcile to the run aggregate")
+    check(sum(s["transfer_bytes"] for s in tenant_sums.values())
+          <= mega_slo["transfer_bytes"],
+          "per-tenant transfer bytes exceed the aggregate")
 
     # feature-cache spill leg (ISSUE 13): with a spill dir armed and a
     # 2-entry RAM LRU, three distinct transform payloads force an

@@ -1,0 +1,307 @@
+"""Native dispatch fast path + cross-tenant megabatching (ISSUE 16).
+
+The load-bearing claims: the native gather/scatter entry points are
+byte-identical to the pure-Python path (so ``SQ_SERVE_NATIVE=0`` and a
+host without a toolchain serve the same bits); pooled assembly buffers
+never leak stale bytes between batches; same-fingerprint tenants
+co-batch into one kernel launch with EXACT per-tenant attribution
+(Σ per-tenant requests == run aggregate — the PR 12 reconciliation
+gate); and the two opt-out knobs fall back to the PR 11 behavior.
+All deterministic legs run ``background=False`` (submission-order
+batching), so the parity claims are exact.
+"""
+
+import numpy as np
+import pytest
+
+from sq_learn_tpu import native, obs
+from sq_learn_tpu.models import QKMeans, TruncatedSVD
+from sq_learn_tpu.resilience import faults
+from sq_learn_tpu.resilience.supervisor import breaker
+from sq_learn_tpu.serving import MicroBatchDispatcher, ModelRegistry
+from sq_learn_tpu.serving import cache as serve_cache
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    rng = np.random.default_rng(0)
+    m = 12
+    X = (rng.normal(size=(400, m))
+         + 5.0 * rng.integers(0, 3, size=(400, 1))).astype(np.float32)
+    qkm = QKMeans(n_clusters=3, random_state=0, n_init=1).fit(X)
+    svd = TruncatedSVD(n_components=3, random_state=0).fit(X)
+    return {"X": X, "m": m, "qkm": qkm, "svd": svd}
+
+
+@pytest.fixture(autouse=True)
+def _serving_hygiene():
+    serve_cache.clear()
+    yield
+    serve_cache.clear()
+    faults.disarm()
+    breaker.reset("test teardown")
+    if obs.enabled():
+        obs.disable()
+
+
+def _requests(fitted, n=24, sizes=(1, 5, 17, 40), seed=7):
+    rng = np.random.default_rng(seed)
+    return [rng.normal(size=(sizes[i % len(sizes)], fitted["m"]))
+            .astype(np.float32) for i in range(n)]
+
+
+# -- native gather/scatter bit parity ----------------------------------------
+
+
+def test_serve_gather_scatter_bit_parity():
+    """Native vs manual-numpy byte equality across shapes × dtypes ×
+    bucket boundaries, including a stale (pooled) destination buffer and
+    the exactly-full bucket."""
+    rng = np.random.default_rng(3)
+    dtypes = [np.float32, np.float64, np.int8, np.uint32]
+    size_sets = [[1], [3, 5, 1], [8], [2, 2, 2, 2], [7, 1]]
+    for dtype in dtypes:
+        for sizes in size_sets:
+            total = sum(sizes)
+            for bucket in (total, 1 << (total - 1).bit_length() or 1):
+                m = 6
+                blocks = [rng.integers(0, 100, (s, m)).astype(dtype)
+                          for s in sizes]
+                # stale destination: the pool hands back used buffers
+                got = np.full((bucket, m), 111, dtype)
+                native.serve_gather(blocks, got)
+                ref = np.zeros((bucket, m), dtype)
+                off = 0
+                for b in blocks:
+                    ref[off:off + b.shape[0]] = b
+                    off += b.shape[0]
+                assert got.tobytes() == ref.tobytes(), (dtype, sizes,
+                                                        bucket)
+                # the dispatcher's trusted fast path (precomputed
+                # addresses + counts) must write the same bytes
+                got2 = np.full((bucket, m), 55, dtype)
+                native.serve_gather(blocks, got2,
+                                    addrs=[b.ctypes.data for b in blocks],
+                                    counts=[b.shape[0] for b in blocks],
+                                    trusted=True)
+                assert got2.tobytes() == ref.tobytes()
+                # scatter: 2D result and 1D result (predict labels),
+                # default one-copy route AND the forced C route
+                for src in (rng.integers(0, 9, (bucket, 4)).astype(dtype),
+                            rng.integers(0, 9, (bucket,)).astype(dtype)):
+                    for via_native in (False, True):
+                        outs = native.serve_scatter(
+                            src, sizes, via_native=via_native)
+                        off = 0
+                        for o, s in zip(outs, sizes):
+                            legacy = np.array(src[off:off + s], copy=True)
+                            off += s
+                            assert o.dtype == legacy.dtype
+                            assert o.shape == legacy.shape
+                            assert o.flags.c_contiguous
+                            assert o.tobytes() == legacy.tobytes()
+
+
+def test_serve_gather_rejects_mismatch():
+    out = np.zeros((8, 4), np.float32)
+    with pytest.raises(ValueError):
+        native.serve_gather([np.zeros((2, 5), np.float32)], out)
+    with pytest.raises(ValueError):
+        native.serve_gather([np.zeros((2, 4), np.float64)], out)
+    with pytest.raises(ValueError):
+        native.serve_gather([np.zeros((9, 4), np.float32)], out)
+    with pytest.raises(ValueError):
+        native.serve_scatter(np.zeros((4, 2), np.float32), [3, 2])
+
+
+# -- dispatcher-level bit identity across the knob matrix --------------------
+
+
+def _serve_all(reg, reqs, tenants_ops, **kw):
+    """Serve the request list round-robin over (tenant, op) pairs on a
+    fresh deterministic dispatcher; returns the responses + the closed
+    dispatcher's aggregate summary + the dispatcher itself."""
+    serve_cache.clear()
+    d = MicroBatchDispatcher(reg, background=False, max_batch_rows=64,
+                             **kw)
+    futs = []
+    for i, r in enumerate(reqs):
+        t, op = tenants_ops[i % len(tenants_ops)]
+        futs.append(d.submit(t, op, r))
+    d.flush()
+    outs = [f.result(timeout=30) for f in futs]
+    slo = d.close()
+    return outs, slo, d
+
+
+def test_native_off_bit_identical_responses(fitted):
+    """SQ_SERVE_NATIVE=0 (the PR 11 per-request numpy path) and the
+    native pooled path serve bit-identical bytes — exact AND quantized
+    routes, across several flush cycles so pooled buffers get reused."""
+    reg = ModelRegistry()
+    reg.register("a", fitted["qkm"])
+    reg.register("b", fitted["svd"])
+    reg.register("qa", fitted["qkm"], quantize="bf16")
+    reg.register("ia", fitted["qkm"], quantize="int8")
+    mix = [("a", "predict"), ("b", "transform"), ("qa", "predict"),
+           ("ia", "transform"), ("a", "transform")]
+    reqs = _requests(fitted, n=40)
+    on, slo_on, _ = _serve_all(reg, reqs, mix, native=True)
+    off, slo_off, _ = _serve_all(reg, reqs, mix, native=False)
+    assert len(on) == len(off) == len(reqs)
+    for x, y in zip(on, off):
+        assert x.dtype == y.dtype and x.shape == y.shape
+        assert x.tobytes() == y.tobytes()
+    assert slo_on["requests"] == slo_off["requests"] == len(reqs)
+    assert slo_on["batches"] == slo_off["batches"]
+
+
+def test_native_knob_and_megabatch_knob_latch(monkeypatch, fitted):
+    reg = ModelRegistry()
+    reg.register("a", fitted["qkm"])
+    monkeypatch.setenv("SQ_SERVE_NATIVE", "0")
+    monkeypatch.setenv("SQ_SERVE_MEGABATCH", "0")
+    d = MicroBatchDispatcher(reg, background=False)
+    assert d._native is False and d._megabatch is False
+    d.close()
+    monkeypatch.delenv("SQ_SERVE_NATIVE")
+    monkeypatch.delenv("SQ_SERVE_MEGABATCH")
+    d = MicroBatchDispatcher(reg, background=False)
+    assert d._native is True and d._megabatch is True
+    d.close()
+
+
+def test_degraded_route_native_bit_equal(fitted, monkeypatch):
+    """An OPEN breaker degrades the batch to the host route reusing the
+    SAME pooled, natively-assembled payload — responses stay bit-equal
+    to the supervised run."""
+    reg = ModelRegistry()
+    reg.register("a", fitted["qkm"])
+    reqs = _requests(fitted, n=12)
+    clean, slo_clean, _ = _serve_all(reg, reqs, [("a", "predict")],
+                                     native=True)
+    assert slo_clean["degraded"] == 0
+    monkeypatch.setenv("SQ_BREAKER_COOLDOWN_S", "3600")
+    breaker.reset("test setup")
+    for _ in range(3):
+        breaker.record_failure("test wedge")
+    assert breaker.state() == "open"
+    degraded, slo_deg, _ = _serve_all(reg, reqs, [("a", "predict")],
+                                      native=True)
+    breaker.reset("test: degrade leg done")
+    assert slo_deg["degraded"] >= 1
+    assert all(np.array_equal(a, b) for a, b in zip(clean, degraded))
+
+
+# -- cross-tenant megabatching ----------------------------------------------
+
+
+def test_megabatch_cobatches_same_fingerprint_tenants(fitted):
+    """Two tenants registered from the same estimator share a
+    fingerprint; their interleaved requests coalesce into shared
+    launches (``megabatches() >= 1``) and every response is row-aligned
+    with its own request — per-tenant scatter isolation."""
+    reg = ModelRegistry()
+    reg.register("alpha", fitted["qkm"])
+    reg.register("beta", fitted["qkm"])
+    reqs = _requests(fitted, n=24)
+    outs, slo, d = _serve_all(reg, reqs, [("alpha", "predict"),
+                                          ("beta", "predict")])
+    assert d.megabatches() >= 1
+    assert slo["batches"] < len(reqs)
+    qkm = fitted["qkm"]
+    for r, o in zip(reqs, outs):
+        assert np.array_equal(o, qkm.predict(r))
+
+
+def test_megabatch_off_is_tenant_scoped_and_bit_identical(fitted):
+    """SQ_SERVE_MEGABATCH=0 prefixes the group key with the tenant:
+    equal-fingerprint tenants never share a launch, and responses stay
+    bit-identical to the megabatched run (same params by construction)."""
+    reg = ModelRegistry()
+    reg.register("alpha", fitted["qkm"])
+    reg.register("beta", fitted["qkm"])
+    reqs = _requests(fitted, n=24)
+    mix = [("alpha", "predict"), ("beta", "predict")]
+    mega, slo_mega, d_mega = _serve_all(reg, reqs, mix, megabatch=True)
+    solo, slo_solo, d_solo = _serve_all(reg, reqs, mix, megabatch=False)
+    assert d_mega.megabatches() >= 1
+    assert d_solo.megabatches() == 0
+    # tenant-scoped batching really split the launches
+    assert slo_solo["batches"] > slo_mega["batches"]
+    for x, y in zip(mega, solo):
+        assert x.tobytes() == y.tobytes()
+
+
+def test_quantized_and_exact_tenants_never_merge(fitted):
+    """A bf16 tenant and an exact-f32 tenant of the same estimator have
+    different fingerprints (quantize mode suffix) AND transfer dtypes —
+    they must never land in one launch."""
+    reg = ModelRegistry()
+    reg.register("exact", fitted["qkm"])
+    reg.register("quant", fitted["qkm"], quantize="bf16")
+    reqs = _requests(fitted, n=16)
+    _, _, d = _serve_all(reg, reqs, [("exact", "predict"),
+                                     ("quant", "predict")])
+    assert d.megabatches() == 0
+
+
+def test_megabatch_per_tenant_attribution_reconciles(fitted):
+    """The honesty gate: under an active recorder a megabatched run's
+    per-tenant slo records sum EXACTLY to the run aggregate (requests),
+    each tenant's stages/bytes are its own share, and the
+    ``serving.megabatches`` counter lands in the artifact."""
+    reg = ModelRegistry()
+    reg.register("alpha", fitted["qkm"], slo_p99_ms=10_000.0)
+    reg.register("beta", fitted["qkm"], slo_p99_ms=20_000.0)
+    obs.enable()
+    reqs = _requests(fitted, n=30)
+    outs, slo, d = _serve_all(reg, reqs, [("alpha", "predict"),
+                                          ("beta", "predict"),
+                                          ("alpha", "predict")])
+    tenants = d.slo.tenant_summaries()
+    rec = obs.disable()
+    assert d.megabatches() >= 1
+    assert set(tenants) == {"alpha", "beta"}
+    assert sum(t["requests"] for t in tenants.values()) == slo["requests"]
+    assert sum(t["transfer_bytes"] for t in tenants.values()) \
+        <= slo["transfer_bytes"]
+    # each tenant burned against its OWN declared target
+    assert tenants["alpha"]["targets"]["p99_ms"] == 10_000.0
+    assert tenants["beta"]["targets"]["p99_ms"] == 20_000.0
+    # stage decomposition present per tenant and sums to ~the aggregate
+    # (each summarize() rounds to 1e-6, so allow a few ulps of that)
+    for key in ("assemble", "transfer", "compute", "scatter", "queue"):
+        agg = slo["stages"][key]
+        split = sum(t["stages"].get(key, 0.0) for t in tenants.values())
+        assert abs(split - agg) <= 1e-5, (key, split, agg)
+    assert rec.counters.get("serving.megabatches", 0) == d.megabatches()
+    # the error-budget ledger billed each tenant its OWN rows and the
+    # run-scoped counts reconcile too
+    led = d.budget_ledger()
+    assert led is not None
+    assert {"alpha", "beta"} <= set(led.tenants())
+    assert sum(led.total_requests(t) for t in ("alpha", "beta")) \
+        == slo["requests"]
+
+
+def test_submit_many_burst_shares_stamp_and_reconciles(fitted):
+    """The burst path (one clock stamp, one resolve per tenant, pre-
+    sized subqueue extends) still answers every request correctly and
+    keeps the SLO request count exact."""
+    reg = ModelRegistry()
+    reg.register("alpha", fitted["qkm"])
+    reg.register("beta", fitted["qkm"])
+    reqs = _requests(fitted, n=20)
+    d = MicroBatchDispatcher(reg, background=False, max_batch_rows=64)
+    burst = [("alpha" if i % 2 else "beta", "predict", r)
+             for i, r in enumerate(reqs)]
+    futs = d.submit_many(burst)
+    d.flush()
+    outs = [f.result(timeout=30) for f in futs]
+    slo = d.close()
+    qkm = fitted["qkm"]
+    for (_, _, r), o in zip(burst, outs):
+        assert np.array_equal(o, qkm.predict(r))
+    assert slo["requests"] == len(reqs)
+    assert d.megabatches() >= 1
