@@ -10,8 +10,10 @@ SHELL := /bin/bash
 .PHONY: test test-fast test-timed test-fast-tier test-slow-tier lint \
     lint-selftest bench \
     bench-smoke bench-suite multichip examples \
-    hunt obs-smoke faults-smoke oocore-smoke serve-smoke regress-selftest \
-    smoke obs-report obs-trace obs-frontier obs-audit obs-budget regress all
+    hunt obs-smoke faults-smoke oocore-smoke serve-smoke control-smoke \
+    regress-selftest \
+    smoke obs-report obs-trace obs-frontier obs-audit obs-budget \
+    obs-control regress all
 
 all: lint test
 
@@ -162,10 +164,24 @@ serve-smoke:
 	env SQ_OBS=1 SQ_OBS_PATH=/tmp/sq_serve_smoke.jsonl \
 	    $(PYTHON) -m sq_learn_tpu.serving.smoke
 
+# Control-plane smoke: the SLO-driven (ε, δ) autotuner + admission
+# control contract end to end — register-time frontier plan (int8 for
+# the ε-headroom tenant), forced burn under SQ_OBS_BUDGET_STRICT=1
+# (the controller must renegotiate BEFORE the multi-window alert can
+# trip: zero alert records, no raise), cheapest-first ladder order
+# (widen before host) with zero lost requests and estimator-parity
+# responses through the host rung, a relaxed δ-headroom tenant banking
+# theoretical runtime, and schema-v8 validation of the ≥1 `control`
+# records plus the stdlib read side rendering the predicted/realized
+# loop. The CI-runnable contract check for sq_learn_tpu.serving.control.
+control-smoke:
+	env SQ_OBS=1 SQ_OBS_PATH=/tmp/sq_control_smoke.jsonl \
+	    $(PYTHON) -m sq_learn_tpu.serving.control_smoke
+
 # All contract smokes (observability + resilience + out-of-core +
-# serving + regression gate).
-smoke: obs-smoke faults-smoke oocore-smoke serve-smoke regress-selftest \
-    lint-selftest
+# serving + control plane + regression gate).
+smoke: obs-smoke faults-smoke oocore-smoke serve-smoke control-smoke \
+    regress-selftest lint-selftest
 
 # Render the human report / Chrome trace of an obs JSONL artifact
 # (default: the obs-smoke artifact; override with OBS=<path>).
@@ -190,6 +206,13 @@ obs-frontier:
 # multi-window burn alert fired — the CI-friendly burn check).
 obs-budget:
 	$(PYTHON) -m sq_learn_tpu.obs budget $(OBS)
+
+# Controller-decision view of the same artifact: per-tenant autotuner /
+# admission-control history with the predicted-vs-realized loop (exit 2
+# when the artifact carries zero control records — "no telemetry" must
+# never read as "nothing to decide").
+obs-control:
+	$(PYTHON) -m sq_learn_tpu.obs control $(OBS)
 
 # Perf-regression gate, standalone: run the headline bench, the PR 6
 # fused-fit bench (classical 70k×784 q-means), the PR 7 δ=0.5

@@ -59,6 +59,23 @@ Four JSON lines land in the record (all banded by ``make regress``):
   (best-of-5 enqueue wall-clock of one pre-sized burst vs per-request
   submits of the same stream).
 
+- ``*_autotune_cost_ratio`` (PR 17; lands only under ``SQ_OBS=1`` — the
+  controller exists only under an active recorder): the same mixed
+  stream served twice against fresh registries whose tenants declare
+  DELIBERATELY over-tight p99 targets plus (ε, δ) headroom
+  (``slo_eps``/``slo_delta``). The static arm (``autotune=False``, the
+  PR 16 plane) burns its declared budget and trips ≥1 multi-window
+  alert; the controller arm must serve the identical load with ZERO
+  tripped alerts (degrade + renegotiate before the alert can fire) and
+  a LOWER summed theoretical runtime cost — the plan-time frontier pick
+  routes the ε-headroom tenants int8 (cost × 0.25) and the underspent
+  δ-headroom tenant is relaxed toward the cap (cost ∝ 1/δ²). value =
+  autotuned / static summed cost, ``vs_baseline`` = static / autotuned
+  with a declared floor of 1.2 (the ISSUE 17 acceptance, banded
+  history-free by the ``vs_baseline`` gate; the bench also hard-fails
+  below it). Σ per-tenant requests == run aggregate is asserted for the
+  controller arm like every other obs-armed arm.
+
 Per-request parity is spot-checked against the estimators' own
 predict/transform surfaces. SQ_BENCH_SMOKE=1 shrinks the stream (600
 requests) while keeping every code path.
@@ -472,6 +489,97 @@ def main():
                 "aggregate": mega["requests"]}), file=sys.stderr)
             return 1
 
+    # -- autotune leg (PR 17): the same stream under deliberately
+    # over-tight declared SLOs, controller arm vs static arm. Runs only
+    # under SQ_OBS=1 (the regress run): the controller follows the
+    # disabled-path rule — with no recorder there is nothing to compare.
+    autotune = autotune_static = None
+    at_cost = st_cost = cost_ratio = None
+    at_actions = {}
+    if _obs.enabled():
+        from sq_learn_tpu.obs import get_recorder
+        from sq_learn_tpu.serving.control import theoretical_cost
+
+        tight_ms, delta_slo, eps_slo = 0.01, 1e-3, 0.01
+        reg_at = ModelRegistry(capacity=16)
+        # per-call override (never env mutation): patience 1 so the
+        # relax/recover cycle fits the bench window
+        ctl_at = reg_at.controller(patience=1)
+        reg_st = ModelRegistry(capacity=16)
+        for prefix, r in (("at", reg_at), ("st", reg_st)):
+            # alpha/beta: over-tight p99 — the burn the controller must
+            # absorb; gamma: generous p99 — the underspend it must bank
+            r.register(f"{prefix}_alpha", alpha, quantize=None,
+                       slo_p99_ms=tight_ms, slo_eps=eps_slo,
+                       slo_delta=delta_slo)
+            r.register(f"{prefix}_beta", beta, quantize=None,
+                       slo_p99_ms=tight_ms, slo_eps=eps_slo,
+                       slo_delta=delta_slo)
+            r.register(f"{prefix}_gamma", gamma, quantize=None,
+                       slo_p99_ms=5000.0, slo_eps=eps_slo,
+                       slo_delta=delta_slo)
+        # the plan already re-routed the at_* tenants (int8), so the
+        # warm mints their quantized executables before the timed arm
+        reg_at.warm(buckets=aot.bucket_ladder(8, max_batch_rows))
+        requests_at = [(f"at_{t}", op, rows) for t, op, rows in requests]
+        requests_st = [(f"st_{t}", op, rows) for t, op, rows in requests]
+        serve_cache.clear()
+        autotune = _run_arm(reg_at, requests_at, coalesce=True,
+                            threads=threads,
+                            max_batch_rows=max_batch_rows,
+                            max_wait_ms=max_wait_ms,
+                            autotune=True, autotune_every=8)
+        serve_cache.clear()
+        autotune_static = _run_arm(reg_st, requests_st, coalesce=True,
+                                   threads=threads,
+                                   max_batch_rows=max_batch_rows,
+                                   max_wait_ms=max_wait_ms,
+                                   autotune=False)
+        arec = get_recorder()
+        at_alerts = [a for a in arec.alert_records
+                     if str(a.get("tenant", "")).startswith("at_")]
+        st_alerts = [a for a in arec.alert_records
+                     if str(a.get("tenant", "")).startswith("st_")]
+        for r_ in arec.control_records:
+            if str(r_.get("tenant", "")).startswith("at_"):
+                a_ = r_.get("action")
+                at_actions[a_] = at_actions.get(a_, 0) + 1
+        contracts = ctl_at.contracts()
+        at_cost = sum(c["cost_served"] for c in contracts.values())
+        st_cost = len(contracts) * theoretical_cost(delta_slo, None)
+        cost_ratio = (st_cost / at_cost) if at_cost else None
+        at_counts = autotune.get("tenant_requests") or {}
+        if at_alerts:
+            print(json.dumps({"error": "the controller arm tripped a "
+                              "burn alert", "alerts": at_alerts[:2]}),
+                  file=sys.stderr)
+            return 1
+        if not st_alerts:
+            print(json.dumps({"error": "the static arm never tripped an "
+                              "alert — the declared SLOs were not "
+                              "over-tight"}), file=sys.stderr)
+            return 1
+        if at_actions.get("degrade", 0) < 1 \
+                or at_actions.get("relax", 0) < 1:
+            print(json.dumps({"error": "the controller never acted on "
+                              "the burn/underspend",
+                              "actions": at_actions}), file=sys.stderr)
+            return 1
+        if (len(at_counts) != 3
+                or sum(at_counts.values()) != autotune["requests"]):
+            print(json.dumps({
+                "error": "controller-arm per-tenant counts do not "
+                         "reconcile with the run aggregate",
+                "tenant_requests": at_counts,
+                "aggregate": autotune["requests"]}), file=sys.stderr)
+            return 1
+        if cost_ratio is None or cost_ratio < 1.2:
+            print(json.dumps({"error": "the controller banked less than "
+                              "the 1.2x summed-cost acceptance",
+                              "cost_ratio": cost_ratio,
+                              "contracts": contracts}), file=sys.stderr)
+            return 1
+
     qps_ratio = (batched["qps"] / sequential["qps"]
                  if sequential["qps"] else None)
     p99_ratio = (sequential["p99_ms"] / batched["p99_ms"]
@@ -507,6 +615,16 @@ def main():
          burst_speedup=(round(burst_speedup, 3) if burst_speedup else None),
          burst_s=round(burst_s, 5), per_request_s=round(per_req_s, 5),
          native_available=native_ok)
+    if cost_ratio is not None:
+        emit(f"{tag}_autotune_cost_ratio",
+             round(at_cost / st_cost, 6), unit="ratio",
+             vs_baseline=round(cost_ratio, 4), vs_baseline_floor=1.2,
+             cost_autotuned=at_cost, cost_static=st_cost,
+             autotune_qps=autotune["qps"],
+             autotune_p99_ms=autotune["p99_ms"],
+             static_qps=autotune_static["qps"],
+             static_p99_ms=autotune_static["p99_ms"],
+             control_actions=at_actions)
     if not parity:
         print(json.dumps({"error": "serving parity violated"}),
               file=sys.stderr)

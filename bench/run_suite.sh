@@ -95,6 +95,33 @@ run_and_record() {  # run_and_record <timeout_s> <header> <cmd...>; returns the 
         "$obs_dir/${slug}.jsonl" > "$obs_dir/${slug}_budget.txt" \
         2>/dev/null || true
     fi
+    # controller-decision view (serving configs emit `control` records
+    # under the PR 17 autotuner) — every plan/degrade/relax that shaped
+    # a number is committed next to it
+    if grep -aq '"type": "control"' "$obs_dir/${slug}.jsonl" \
+        2>/dev/null; then
+      env -u PYTHONPATH timeout 60 python -m sq_learn_tpu.obs control \
+        "$obs_dir/${slug}.jsonl" > "$obs_dir/${slug}_control.txt" \
+        2>/dev/null || true
+    fi
+  fi
+  # compression (PR 17): the per-config JSONL commits gzipped — every
+  # obs reader (trace/report/regress/frontier/budget/control) opens
+  # .jsonl.gz transparently — and any rendered view over the cap is
+  # gzipped in place (Perfetto loads .json.gz directly). PR 16 committed
+  # two ~5 MB plain-text artifact sets; the evidence stays committed,
+  # just not as megabytes of text. The tiny resilience extract stays
+  # plain so `grep` over the records tree keeps working.
+  local view_cap=262144
+  for view in "$obs_dir/${slug}_trace.json" "$obs_dir/${slug}_report.txt" \
+              "$obs_dir/${slug}_budget.txt" "$obs_dir/${slug}_control.txt"
+  do
+    if [ -f "$view" ] && [ "$(wc -c < "$view")" -gt "$view_cap" ]; then
+      gzip -9 -f "$view"
+    fi
+  done
+  if [ -s "$obs_dir/${slug}.jsonl" ]; then
+    gzip -9 -f "$obs_dir/${slug}.jsonl"
   fi
   return $rc
 }
@@ -167,11 +194,11 @@ env -u PYTHONPATH timeout 60 python -m sq_learn_tpu.obs regress "$out" \
 # into one committed table next to the obs artifacts that carry them —
 # the thesis artifact stays traceable like every other number.
 env -u PYTHONPATH timeout 60 python -m sq_learn_tpu.obs frontier \
-  "$obs_dir"/*.jsonl > "$obs_dir/frontier.txt" 2>/dev/null \
+  "$obs_dir"/*.jsonl* > "$obs_dir/frontier.txt" 2>/dev/null \
   || echo "# (no tradeoff records this run)" >> "$obs_dir/frontier.txt"
 
 # BASELINE acceptance gate (bench/_gate.py: vs_baseline >= 0.5 on every
-# line, 15 measured + 2 derived lines expected — the sixth measured line
+# line, 16 measured + 2 derived lines expected — the sixth measured line
 # is the streaming-ingest smoke config, whose baseline is the monolithic
 # ingest of the same fit; the seventh is the PR 6 fused-fit config
 # (classical 70k×784 q-means vs sklearn on the SAME δ=0 configuration);
@@ -191,7 +218,11 @@ env -u PYTHONPATH timeout 60 python -m sq_learn_tpu.obs frontier \
 # ≤ 0.55× the bytes"); the fifteenth is the PR 16 megabatch line from
 # the same bench (the 12k mix spread over 48 same-fingerprint alias
 # tenants, native+megabatch arm QPS vs the tenant-scoped PR 11 arm,
-# floor 1.5 via the vs_baseline regression gate);
+# floor 1.5 via the vs_baseline regression gate); the sixteenth is the
+# PR 17 autotune cost line from the same bench (summed theoretical
+# quantum cost of the controller-tuned tenant set vs the statically
+# declared set, floor 1.2 via the vs_baseline regression gate — emitted
+# only under SQ_OBS=1, which this suite always sets);
 # the derived pair is bench_ipe_digits and the
 # sharded-scaling smoke config; missing/null = fail). This
 # script is where the bar is enforced — the unit suite only warns, since
@@ -200,7 +231,7 @@ env -u PYTHONPATH timeout 60 python -m sq_learn_tpu.obs frontier \
 # pre-imports jax via the axon sitecustomize and would hang on a wedged
 # relay even though this step only parses JSON; -m bench._gate resolves
 # via cwd, which is the repo root here)
-env -u PYTHONPATH timeout 60 python -m bench._gate "$out" 15 2
+env -u PYTHONPATH timeout 60 python -m bench._gate "$out" 16 2
 gate_rc=$?
 echo "# acceptance gate rc=$gate_rc" >> "$out"
 echo "done: $out"

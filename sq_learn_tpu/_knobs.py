@@ -270,6 +270,25 @@ _ENTRIES = [
     _K("SQ_SERVE_MEGABATCH", "flag", True, "lib",
        "Cross-tenant coalescing of same-fingerprint tenants into one "
        "kernel launch (0 = tenant-scoped batches).", "docs/serving.md"),
+    _K("SQ_SERVE_AUTOTUNE", "flag", True, "lib",
+       "SLO-driven (ε, δ) autotuner + admission control (0 pins the "
+       "static serving plane bit-identically).", "docs/serving.md"),
+    _K("SQ_SERVE_AUTOTUNE_EVERY", "int", 32, "lib",
+       "Controller evaluation cadence in dispatched batches.",
+       "docs/serving.md"),
+    _K("SQ_SERVE_AUTOTUNE_BURN", "float", 1.5, "lib",
+       "Burn rate at which the controller degrades a tenant (below the "
+       "alert threshold: act BEFORE the SLO gate trips).",
+       "docs/serving.md"),
+    _K("SQ_SERVE_AUTOTUNE_RELAX", "float", 0.25, "lib",
+       "Burn rate below which a budget counts as underspent (relax "
+       "candidate).", "docs/serving.md"),
+    _K("SQ_SERVE_AUTOTUNE_PATIENCE", "int", 3, "lib",
+       "Consecutive underspent evaluations before the controller "
+       "relaxes a tenant's served (ε, δ).", "docs/serving.md"),
+    _K("SQ_SERVE_AUTOTUNE_DELTA_CAP", "float", 4.0, "lib",
+       "Largest served-δ multiple of the declared δ the relax ladder "
+       "may bank.", "docs/serving.md"),
     # -- datasets --------------------------------------------------------
     _K("CICIDS_CSV", "path", None, "lib",
        "Path to a real CICIDS2017 CSV export (unset = deterministic "

@@ -23,14 +23,14 @@ guarantee-audit flags raise (:mod:`~sq_learn_tpu.obs.guarantees`);
 burn alerts raise (:mod:`~sq_learn_tpu.obs.budget`, with
 ``SQ_OBS_BUDGET_WINDOWS``/``SQ_OBS_BUDGET_BURN`` tuning);
 ``SQ_OBS_TRACE=<path>`` renders the closing run's JSONL into Chrome
-trace-event JSON. Analysis tooling:
-``python -m sq_learn_tpu.obs {trace,report,regress,audit,frontier,budget}``
+trace-event JSON. Analysis tooling: ``python -m sq_learn_tpu.obs
+{trace,report,regress,audit,frontier,budget,control}``
 and :mod:`~sq_learn_tpu.obs.xla` (per-compilation FLOP/byte/peak-HBM
 accounting). Full docs: ``docs/observability.md``.
 """
 
-from . import (budget, frontier, guarantees, ledger, probe, regress, report,
-               schema, trace, xla)
+from . import (budget, control, frontier, guarantees, ledger, probe, regress,
+               report, schema, trace, xla)
 from .recorder import (NULL_SPAN, Recorder, counter_add, disable, enable,
                        enabled, gauge, get_recorder, record_span, snapshot,
                        span)
@@ -47,6 +47,7 @@ __all__ = [
     "RetracingWarning",
     "RetracingWatchdog",
     "budget",
+    "control",
     "counter_add",
     "disable",
     "enable",

@@ -1,6 +1,6 @@
 """CLI dispatcher:
 ``python -m sq_learn_tpu.obs
-<trace|report|regress|audit|frontier|budget>``.
+<trace|report|regress|audit|frontier|budget|control>``.
 
 - ``trace <jsonl> [...] [-o out.json]`` — render a run's JSONL into
   Chrome trace-event JSON (Perfetto-viewable), merging multiple files
@@ -21,8 +21,14 @@
   (:mod:`~sq_learn_tpu.obs.frontier`).
 - ``budget <jsonl> [...] [--json]`` — the per-tenant error-budget
   table (rolling-window latency-SLO and statistical burn rates); exits
-  1 when any tenant's multi-window burn alert fired
+  1 when any tenant's multi-window burn alert fired, 2 when the
+  artifacts carry zero budget records
   (:mod:`~sq_learn_tpu.obs.budget`).
+- ``control <jsonl> [...] [--json]`` — the serving control plane's
+  decision history (one line per autotuner evaluation: inputs consumed,
+  action taken, predicted vs realized effect); exits 2 when the
+  artifacts carry zero control records
+  (:mod:`~sq_learn_tpu.obs.control`).
 
 All subcommands are dependency-free file tools (no jax import on the
 comparison/render paths), safe to run with PYTHONPATH cleared while the
@@ -50,9 +56,12 @@ def main(argv=None):
         from .frontier import main as run
     elif cmd == "budget":
         from .budget import main as run
+    elif cmd == "control":
+        from .control import main as run
     else:
         print(f"unknown subcommand {cmd!r} (expected trace, report, "
-              "regress, audit, frontier, or budget)", file=sys.stderr)
+              "regress, audit, frontier, budget, or control)",
+              file=sys.stderr)
         return 2
     return run(rest)
 

@@ -34,12 +34,15 @@ and ``SQ_OBS_BUDGET_STRICT=1`` escalates the alert to a raised
 :class:`BudgetBurnError`, the same strict-mode pattern as the watchdog
 (``SQ_OBS_STRICT``) and the guarantee audit (``SQ_OBS_AUDIT_STRICT``).
 
-Every evaluation lands as schema-v6 ``budget`` JSONL records (one per
+Every evaluation lands as ``budget`` JSONL records (one per
 tenant × window: ``slo_burn``, ``stat_burn``, ``cp_lower_bound``,
 ``burn_rate``, ``alerting``, window p50/p99) plus ``alert`` records for
 tripped tenants — the dispatcher emits them on its periodic SLO flush
 (``SQ_SERVE_SLO_FLUSH_BATCHES``) and at close, so a long-running server
 telemeters burn continuously and a crashed process keeps its history.
+Since schema v8 each emitted line also carries a ledger-scoped
+monotonic ``seq``, so trace-export merge order stays deterministic when
+two emissions land on the same wall-clock millisecond.
 
 Import-safe without jax and numpy (stdlib only), like
 :mod:`~sq_learn_tpu.obs.guarantees`: the collect/render/CLI half runs
@@ -158,9 +161,10 @@ class BudgetLedger:
     """
 
     #: lock-discipline contract (``sq_learn_tpu.analysis``): tenant state
-    #: is only written under ``self._lock``; ``_state``/``_prune`` are
-    #: helpers invoked with the lock already held.
-    _GUARDED_BY = {"_lock": ("_tenants",)}
+    #: and the emit counter are only written under ``self._lock``;
+    #: ``_state``/``_prune`` are helpers invoked with the lock already
+    #: held.
+    _GUARDED_BY = {"_lock": ("_tenants", "_emit_seq")}
     _ASSUMES_LOCK = ("_state", "_prune")
 
     def __init__(self, window_seconds=None, threshold=None,
@@ -176,6 +180,7 @@ class BudgetLedger:
         self.site = site
         self._lock = threading.Lock()
         self._tenants = {}
+        self._emit_seq = 0
 
     # -- inputs ------------------------------------------------------------
 
@@ -361,6 +366,16 @@ class BudgetLedger:
 
     # -- emission ----------------------------------------------------------
 
+    def _next_emit_seq(self):
+        """Ledger-scoped monotonic counter stamped on every emitted
+        ``budget``/``alert`` line (schema v8): wall-clock ``ts`` values
+        collide at millisecond resolution, so the trace exporter breaks
+        ties on this instead of file order."""
+        with self._lock:
+            seq = self._emit_seq
+            self._emit_seq = seq + 1
+        return seq
+
     def emit(self, now=None):
         """Record one ``budget`` line per tenant × window plus ``alert``
         lines for tripped tenants; returns ``(summary, alerts)``. Under
@@ -376,7 +391,8 @@ class BudgetLedger:
             for tenant in sorted(summary):
                 for w in self.windows:
                     s = summary[tenant][w]
-                    entry = {"type": "budget", "site": self.site}
+                    entry = {"type": "budget", "site": self.site,
+                             "seq": self._next_emit_seq()}
                     entry.update(
                         (k, v) for k, v in s.items()
                         if (v is not None and not (k == "targets"
@@ -385,7 +401,8 @@ class BudgetLedger:
                                  "cp_lower_bound", "burn_rate"))
                     rec.record(entry, kind="budget_records")
             for a in alerts:
-                rec.record(dict(a, type="alert", site=self.site),
+                rec.record(dict(a, type="alert", site=self.site,
+                                seq=self._next_emit_seq()),
                            kind="alert_records")
         if alerts and strict():
             worst = alerts[0]
@@ -458,7 +475,9 @@ def main(argv):
     """``budget <jsonl> [more.jsonl ...] [--json]`` — render the
     per-tenant error-budget table of one or more obs JSONL artifacts;
     exits 1 when any alert fired or any budget record is alerting (the
-    CI-friendly burn check), 0 otherwise."""
+    CI-friendly burn check), 0 when budgets are healthy, and 2 when the
+    artifacts carry ZERO budget records — "no telemetry" must never
+    read as "no burn" in CI."""
     import json
     import sys
 
@@ -474,6 +493,13 @@ def main(argv):
     for p in paths:
         records.extend(load_jsonl(p))
     view = collect(records)
+    if not view["tenants"] and not view["alerts"]:
+        if as_json:
+            print(json.dumps(dict(view, burning=False,
+                                  error="no budget telemetry")))
+        print(f"no budget telemetry: zero budget records in "
+              f"{', '.join(paths)}", file=sys.stderr)
+        return 2
     burning = bool(view["alerts"]) or any(
         r.get("alerting") for per_w in view["tenants"].values()
         for r in per_w.values())

@@ -26,7 +26,7 @@ Design constraints, in order:
    arrays, so their spans are synced by construction.
 
 JSONL schema: one JSON object per line, every line carrying
-``{"v": 6, "schema_version": 6, "ts": <unix seconds>, "type": <record
+``{"v": 8, "schema_version": 8, "ts": <unix seconds>, "type": <record
 type>}`` plus per-type fields — see :mod:`sq_learn_tpu.obs.schema` (the
 validator) and ``docs/observability.md`` (the prose). ``v`` is the
 original envelope key (kept so pre-2 readers don't break);
@@ -58,7 +58,13 @@ from .. import _knobs
 #     feature-cache disk tier), the cold_tier fault kind, and the
 #     oocore.create_store span's codec attr; snapshot grows the matching
 #     codec/spill fields
-SCHEMA_VERSION = 7
+# v8: +control record type (the serving control plane: one SLO-driven
+#     autotuner evaluation/action per record — inputs consumed, decision
+#     taken, predicted vs realized effect,
+#     sq_learn_tpu.serving.control), and the optional monotonic
+#     budget.seq / alert.seq fields (deterministic trace-export merge
+#     order when timestamps collide)
+SCHEMA_VERSION = 8
 
 #: default sink path when SQ_OBS=1 and SQ_OBS_PATH is unset
 DEFAULT_PATH = "sq_obs.jsonl"
@@ -173,8 +179,8 @@ class Recorder:
     ``watchdog_events``, ``probe_events``, ``fault_events``,
     ``breaker_events``, ``xla_cost_records``, ``guarantee_records``,
     ``tradeoff_records``, ``slo_records``, ``budget_records``,
-    ``alert_records`` — all plain Python containers, safe to read at any
-    point in the run.
+    ``alert_records``, ``control_records`` — all plain Python
+    containers, safe to read at any point in the run.
     """
 
     def __init__(self, path=None):
@@ -193,6 +199,7 @@ class Recorder:
         self.slo_records = []
         self.budget_records = []
         self.alert_records = []
+        self.control_records = []
         self._xla_seen = set()  # (site, signature) dedup for obs.xla
         self.path = path
         self._seq = 0
@@ -466,6 +473,14 @@ def snapshot():
         "budget_alerts": len(rec.alert_records),
         "budget_alerting_tenants": sorted(
             {str(a.get("tenant")) for a in rec.alert_records}),
+        # serving control plane (serving.control, PR 17): autotuner
+        # evaluations recorded and the subset that changed a tenant's
+        # route/coalescing/targets — the bench lines' evidence that a
+        # zero-alert run got there by decisions, not by luck
+        "control_records": len(rec.control_records),
+        "control_actions": sum(
+            1 for c in rec.control_records
+            if c.get("action") not in (None, "plan", "hold")),
     }
 
 

@@ -16,6 +16,7 @@ accelerator relay is wedged.
 import json
 
 from . import budget as _budget
+from . import control as _control
 from . import frontier as _frontier
 from . import guarantees as _guarantees
 from .trace import load_jsonl
@@ -198,6 +199,10 @@ def summarize(records):
         # tenant's live draws say it was actually served
         "budgets": _budget.collect(records),
         "effective": _frontier.effective_contracts(records),
+        # the control-plane section (v8): the autotuner's per-tenant
+        # decision history — every route/coalescing/target change with
+        # the telemetry that justified it
+        "control": _control.collect(records),
     }
 
 
@@ -365,6 +370,10 @@ def render(summary, top=12):
     out("")
     out("-- effective (eps, delta) per tenant (live draws) --")
     out(_frontier.render_effective(summary.get("effective") or {}))
+
+    out("")
+    out("-- controller decisions (SLO-driven (eps, delta) autotuner) --")
+    out(_control.render(summary.get("control") or {}))
 
     srv = summary.get("serving") or {}
     if (srv.get("aot_compiles") or srv.get("aot_cache_hits")

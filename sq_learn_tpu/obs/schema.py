@@ -1,7 +1,7 @@
 """JSONL schema for obs records, and a dependency-free validator.
 
 Every line of an obs JSONL file is one JSON object carrying the common
-envelope ``{"v": 6, "schema_version": 6, "ts": <unix seconds>,
+envelope ``{"v": 8, "schema_version": 8, "ts": <unix seconds>,
 "type": <t>}`` plus per-type required fields. Version history: v1 (PR 2)
 had neither the ``schema_version`` alias nor the ``xla_cost`` /
 ``regression`` types; v2 (PR 4) added those; v3 (PR 5) adds the
@@ -29,7 +29,13 @@ more counter convention on the same generic type (still v7): the
 requests from MORE than one tenant (cross-tenant megabatching,
 :mod:`sq_learn_tpu.serving.dispatcher`); each such launch still lands
 exactly one set of per-tenant ``slo``/``budget`` records whose request
-counts sum to the run aggregate. Older versions
+counts sum to the run aggregate; v8 (PR 17) adds the serving control
+plane's ``control`` type (one SLO-driven autotuner evaluation from
+:mod:`sq_learn_tpu.serving.control` — the telemetry inputs it consumed,
+the decision it took, and the predicted vs realized effect) plus the
+optional monotonic ``budget.seq`` / ``alert.seq`` fields (ledger-scoped
+counters making trace-export merge order deterministic when timestamps
+collide). Older versions
 still validate (their types are a strict subset), any other version is
 rejected — an unknown version means a reader that would silently
 misinterpret fields, so it must fail loudly.
@@ -106,11 +112,26 @@ budget     tenant (str), window_s (number > 0), slo_burn (number in
            over_p50 / over_p99 / draws / draw_violations (int ≥ 0),
            p50_ms / p99_ms (number ≥ 0), slo_burn_rate /
            stat_burn_rate (number ≥ 0), fail_prob (number in [0, 1]),
-           targets (object: str → number), site (str), attrs (object)
+           targets (object: str → number), site (str),
+           seq (int ≥ 0 — ledger-scoped monotonic emit counter, v8),
+           attrs (object)
 alert      tenant (str), kind (str), threshold (number ≥ 0),
            burn_rates (object: str → number) — one tripped
            multi-window burn-rate alert (every configured window at or
-           past the threshold); optional site (str), attrs (object)
+           past the threshold); optional site (str),
+           seq (int ≥ 0 — ledger-scoped monotonic emit counter, v8),
+           attrs (object)
+control    tenant (str), action (str ∈ {plan, hold, relax, tighten,
+           degrade, recover}), seq (int ≥ 0), inputs (object),
+           decision (object) — one serving-control-plane autotuner
+           evaluation (:mod:`sq_learn_tpu.serving.control`): the burn/
+           CP-bound/frontier telemetry consumed, the decision taken
+           (route, coalescing floor, renegotiated targets, served
+           (ε, δ)); optional site (str), level (int ≥ 0 — position on
+           the degrade ladder), predicted (object — the decision's
+           expected effect), realized (object | null — the measured
+           effect of the PREVIOUS decision, closing the loop),
+           attrs (object)
 =========  ==============================================================
 
 The out-of-core layer (PR 8) rides the generic types rather than minting
@@ -139,8 +160,9 @@ _NUM = (int, float)
 #: without schema_version/xla_cost/regression; v2 = PR 4's, without
 #: guarantee/tradeoff; v3 = PR 5's, without slo; v4 = PR 9's, without
 #: slo.transfer_bytes; v5 = PR 11's, without budget/alert; v6 = PR 12's,
-#: without the codec/spill counter conventions)
-KNOWN_VERSIONS = {1, 2, 3, 4, 5, 6, SCHEMA_VERSION}
+#: without the codec/spill counter conventions; v7 = PR 13's, without
+#: control or the budget/alert seq fields)
+KNOWN_VERSIONS = {1, 2, 3, 4, 5, 6, 7, SCHEMA_VERSION}
 
 #: every record type the schema defines, machine-readable. The static
 #: checker (:mod:`sq_learn_tpu.analysis`, rule ``obs-schema``) and the
@@ -149,8 +171,11 @@ KNOWN_VERSIONS = {1, 2, 3, 4, 5, 6, SCHEMA_VERSION}
 RECORD_TYPES = (
     "meta", "span", "counter", "gauge", "ledger", "watchdog", "probe",
     "fault", "breaker", "xla_cost", "regression", "guarantee", "tradeoff",
-    "slo", "budget", "alert",
+    "slo", "budget", "alert", "control",
 )
+
+_CONTROL_ACTIONS = {"plan", "hold", "relax", "tighten", "degrade",
+                    "recover"}
 
 _PROBE_OUTCOMES = {"ok", "timeout", "error", "cpu", "skipped"}
 
@@ -407,6 +432,11 @@ def validate_record(rec):
                 isinstance(k, str) and isinstance(vv, _NUM)
                 for k, vv in obj.items()), errors,
                 "budget.targets object of str → number")
+        if "seq" in rec:
+            _check(isinstance(rec["seq"], int)
+                   and not isinstance(rec["seq"], bool)
+                   and rec["seq"] >= 0, errors,
+                   "budget.seq non-negative int")
     elif t == "alert":
         _check(isinstance(rec.get("tenant"), str), errors,
                "alert.tenant str")
@@ -419,6 +449,38 @@ def validate_record(rec):
             isinstance(k, str) and isinstance(vv, _NUM)
             and not isinstance(vv, bool) for k, vv in obj.items()),
             errors, "alert.burn_rates object of str → number")
+        if "seq" in rec:
+            _check(isinstance(rec["seq"], int)
+                   and not isinstance(rec["seq"], bool)
+                   and rec["seq"] >= 0, errors,
+                   "alert.seq non-negative int")
+    elif t == "control":
+        _check(isinstance(rec.get("tenant"), str), errors,
+               "control.tenant str")
+        _check(rec.get("action") in _CONTROL_ACTIONS, errors,
+               f"control.action in {sorted(_CONTROL_ACTIONS)}")
+        _check(isinstance(rec.get("seq"), int)
+               and not isinstance(rec.get("seq"), bool)
+               and rec.get("seq", -1) >= 0, errors,
+               "control.seq non-negative int")
+        for field in ("inputs", "decision"):
+            _check(isinstance(rec.get(field), dict), errors,
+                   f"control.{field} object")
+        if "level" in rec:
+            _check(isinstance(rec["level"], int)
+                   and not isinstance(rec["level"], bool)
+                   and rec["level"] >= 0, errors,
+                   "control.level non-negative int")
+        if "predicted" in rec:
+            _check(isinstance(rec["predicted"], dict), errors,
+                   "control.predicted object")
+        if "realized" in rec:
+            _check(rec["realized"] is None
+                   or isinstance(rec["realized"], dict), errors,
+                   "control.realized object or null")
+        if "site" in rec:
+            _check(isinstance(rec["site"], str), errors,
+                   "control.site str")
     else:
         errors.append(
             f"unknown record type {t!r} (known: {sorted(RECORD_TYPES)})")
@@ -431,13 +493,19 @@ def validate_jsonl(path, max_errors=20):
     Returns a summary dict {lines, by_type, errors} where ``errors`` is a
     list of "line N: message" strings (truncated at ``max_errors``). An
     empty or missing file is an error — a run that recorded nothing is a
-    broken run, not a valid one.
+    broken run, not a valid one. ``.jsonl.gz`` archives (the bench
+    suite's compressed per-config artifacts) open transparently.
     """
     lines = 0
     by_type = {}
     errors = []
     try:
-        fh = open(path)
+        if str(path).endswith(".gz"):
+            import gzip
+
+            fh = gzip.open(path, "rt")
+        else:
+            fh = open(path)
     except OSError as exc:
         return {"lines": 0, "by_type": {}, "errors": [str(exc)]}
     with fh:

@@ -3,9 +3,10 @@
 ``make obs-smoke`` runs this module: a streamed qPCA Gram fit (streaming
 counters + retracing watchdog), a quantum top-k extraction (nonzero
 tomography shots in the ledger), and a tiny served tenant with a
-declared SLO (per-tenant ``slo`` + error-budget ``budget`` records,
-schema v7) under an active recorder, then validates the emitted JSONL
-against :mod:`sq_learn_tpu.obs.schema` (legacy v1–v6 records must keep
+declared SLO (per-tenant ``slo`` + error-budget ``budget`` records, plus
+the control plane's close-time ``control`` records, schema v8) under an
+active recorder, then validates the emitted JSONL against
+:mod:`sq_learn_tpu.obs.schema` (legacy v1–v7 records must keep
 validating) and asserts the run artifact carries the signals the layer
 exists for. Exit code 0 = contract holds; 1 = schema or content
 violation (printed).
@@ -133,6 +134,20 @@ def main():
     if any(a for a in rec.alert_records):
         failures.append(f"burn alert fired under a generous declared "
                         f"SLO: {rec.alert_records}")
+    # v8 contract: the serving close runs the control plane's final
+    # evaluation — a quiet controller still lands records (a plan plus
+    # a hold per tenant: silence is indistinguishable from death), every
+    # budget line carries the monotonic emit seq, and legacy v7 budget
+    # records (no seq yet) still validate below
+    if summary["by_type"].get("control", 0) <= 0:
+        failures.append("no control records from the serving close")
+    if not any(r.get("tenant") == "smoke_tenant"
+               and r.get("action") == "plan"
+               for r in rec.control_records):
+        failures.append("the controller never planned the served tenant")
+    if not all(isinstance(r.get("seq"), int)
+               for r in rec.budget_records):
+        failures.append("a budget record landed without its emit seq")
     from .schema import validate_record
 
     legacy = [
@@ -146,6 +161,10 @@ def main():
          "tenant": "t", "window_s": 60.0, "slo_burn": 0.1,
          "stat_burn": None, "cp_lower_bound": None, "burn_rate": 0.2,
          "alerting": False},
+        # v7 (pre-control-plane): budget/alert lines carried no emit seq
+        {"v": 7, "schema_version": 7, "ts": 0.0, "type": "alert",
+         "tenant": "t", "kind": "slo_burn",
+         "burn_rates": {"60": 2.5, "600": 2.1}, "threshold": 2.0},
     ]
     for r_ in legacy:
         errs = validate_record(r_)

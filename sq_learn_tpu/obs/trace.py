@@ -48,12 +48,14 @@ _LANES = {
     "slo": (10, "serving slo"),
     "budget": (11, "error budgets"),
     "alert": (12, "budget alerts"),
+    "control": (13, "controller decisions"),
 }
 
 #: records that move onto a per-tenant lane when they carry a tenant
 #: (the serving plane's per-tenant telemetry reads as one lane per
-#: tenant: its slo windows, budget evaluations, and alerts together)
-_TENANT_TYPES = ("slo", "budget", "alert")
+#: tenant: its slo windows, budget evaluations, alerts, and controller
+#: decisions together)
+_TENANT_TYPES = ("slo", "budget", "alert", "control")
 
 #: first tid of the dynamically-allocated per-tenant lanes
 _TENANT_TID0 = 16
@@ -62,9 +64,16 @@ _TENANT_TID0 = 16
 def load_jsonl(path):
     """Decode one obs JSONL file into a list of record dicts (bad lines
     skipped — the trace view of a partially-written run is still a
-    view)."""
+    view). ``.jsonl.gz`` archives — the bench suite compresses each
+    config's artifact after rendering — open transparently."""
+    if str(path).endswith(".gz"):
+        import gzip
+
+        opener = gzip.open(path, "rt")
+    else:
+        opener = open(path)
     records = []
-    with open(path) as fh:
+    with opener as fh:
         for raw in fh:
             raw = raw.strip()
             if not raw:
@@ -127,6 +136,9 @@ def _instant_name(rec):
                 f"burn={rec.get('burn_rate')} {state}")
     if t == "alert":
         return f"ALERT {rec.get('tenant')}:{rec.get('kind')}"
+    if t == "control":
+        return (f"control {rec.get('tenant')}:{rec.get('action')}"
+                f"@L{rec.get('level', 0)}")
     return t
 
 
@@ -211,7 +223,17 @@ def to_chrome_trace(record_groups):
                     "ts": us, "pid": pid, "tid": tid, "args": _args_of(rec),
                 })
             # unknown types: skipped — the trace is a view, not a validator
-    events.sort(key=lambda e: (e["ph"] != "M", e.get("ts", 0.0)))
+    def _order(e):
+        # ts collides at millisecond resolution when a flush emits many
+        # lines at once; the v8 monotonic seq (budget/alert/control —
+        # spans carry their own) breaks the tie deterministically, and
+        # the stable sort preserves file order for records without one
+        seq = e.get("args", {}).get("seq")
+        return (e["ph"] != "M", e.get("ts", 0.0),
+                seq if isinstance(seq, int) and not isinstance(seq, bool)
+                else -1)
+
+    events.sort(key=_order)
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 

@@ -36,6 +36,14 @@ actually happens. This package turns fitted estimators into a service:
   folded CONSERVATIVELY into the tenant's declared (ε, δ) (the PR 7
   sketch-fold rule), live-audited via guarantee draws;
   ``quantize=None`` stays bit-identical to the f32 route.
+- :mod:`~.control` — the telemetry-closed control plane: an SLO-driven
+  (ε, δ) autotuner + admission controller that consumes the
+  error-budget ledger's burn telemetry and degrades burning tenants
+  cheapest-first (quantized route → wider coalescing → host route,
+  renegotiating targets before the alert trips) while relaxing
+  persistently-underspent δ-headroom contracts; every decision lands
+  as a v8 ``control`` record (``python -m sq_learn_tpu.obs control``).
+  ``SQ_SERVE_AUTOTUNE=0`` pins the static plane bit-identically.
 
 Quickstart::
 
@@ -56,12 +64,15 @@ Env knobs: ``SQ_SERVE_MAX_WAIT_MS`` (2.0) coalescing window,
 ``SQ_COMPILE_CACHE_DIR`` persistent compile cache,
 ``SQ_SERVE_QUANTIZE`` (unset) process-default quantized route,
 ``SQ_SERVE_QUANT_DELTA`` (1e-3) fold audit budget,
-``SQ_SERVE_AUDIT_EVERY`` (8) live-audit batch stride.
+``SQ_SERVE_AUDIT_EVERY`` (8) live-audit batch stride,
+``SQ_SERVE_AUTOTUNE`` (1) control-plane latch with its
+``SQ_SERVE_AUTOTUNE_{EVERY,BURN,RELAX,PATIENCE,DELTA_CAP}`` tuning.
 Full docs: ``docs/serving.md``; load bench:
 ``bench/bench_serving_load.py``; contract smoke: ``make serve-smoke``.
 """
 
-from . import aot, cache, dispatcher, quantize, registry, slo
+from . import aot, cache, control, dispatcher, quantize, registry, slo
+from .control import Controller
 from .dispatcher import (MicroBatchDispatcher, kernel_cache_sizes,
                          pin_compile_budgets, serve_max_batch_rows,
                          serve_max_wait_ms, serve_min_bucket_rows)
@@ -69,6 +80,7 @@ from .registry import ModelRegistry, ServingModel
 from .slo import SloTracker, SloViolation
 
 __all__ = [
+    "Controller",
     "MicroBatchDispatcher",
     "ModelRegistry",
     "ServingModel",
@@ -76,6 +88,7 @@ __all__ = [
     "SloViolation",
     "aot",
     "cache",
+    "control",
     "dispatcher",
     "kernel_cache_sizes",
     "pin_compile_budgets",

@@ -91,6 +91,19 @@ bucketed shapes:
   allocated, no timestamps are taken, the hot path is byte-identical
   (pinned by test).
 
+- **Telemetry-closed control plane (PR 17).** With the autotune latch
+  on (``SQ_SERVE_AUTOTUNE``, default; ``autotune=`` per instance) and a
+  recorder active, every ``SQ_SERVE_AUTOTUNE_EVERY`` batches the
+  dispatcher hands its error-budget ledger to the registry's
+  :class:`~sq_learn_tpu.serving.control.Controller`, which degrades
+  burning tenants cheapest-first (quantized route → wider coalescing →
+  host route, renegotiating their ledger targets before the burn alert
+  can trip) and relaxes persistently-underspent δ-headroom tenants'
+  served contracts — every evaluation landing as a v8 ``control``
+  record. ``SQ_SERVE_AUTOTUNE=0`` (or ``autotune=False``) pins the
+  static serving plane bit-identically, and with ``SQ_OBS`` unset no
+  controller state exists at all (both pinned by test).
+
 Determinism: with ``background=False`` the dispatcher never starts a
 worker thread — callers submit and then :meth:`~MicroBatchDispatcher.
 flush`, and grouping depends only on submission order and sizes, never
@@ -114,6 +127,7 @@ from ..resilience import supervisor as _sup
 from ..streaming import bucket_rows
 from . import aot as _aot
 from . import cache as _cache
+from . import control as _ctl_mod
 from . import quantize as _quant
 from .slo import SloTracker, slo_flush_batches
 from .. import _knobs
@@ -369,7 +383,8 @@ class MicroBatchDispatcher:
     def __init__(self, registry, *, max_wait_ms=None, max_batch_rows=None,
                  min_bucket_rows=None, slo_p50_ms=None, slo_p99_ms=None,
                  background=True, coalesce=True, native=None,
-                 megabatch=None, site="serving.dispatcher"):
+                 megabatch=None, autotune=None, autotune_every=None,
+                 site="serving.dispatcher"):
         self.registry = registry
         #: coalesce=False is the sequential per-request baseline: every
         #: dispatch serves exactly one request (no companions, no
@@ -394,6 +409,16 @@ class MicroBatchDispatcher:
                         if native is None else bool(native))
         self._megabatch = (_knobs.get_bool("SQ_SERVE_MEGABATCH")
                            if megabatch is None else bool(megabatch))
+        #: the PR 17 control-plane latch (serving.control): with the
+        #: latch off — or SQ_OBS unset — no controller is ever created
+        #: or consulted and the serving plane is bit-identical to the
+        #: static PR 16 behavior (pinned by test)
+        self._autotune = (_ctl_mod.autotune_enabled()
+                          if autotune is None else bool(autotune))
+        self._autotune_every = (_ctl_mod.autotune_every()
+                                if autotune_every is None
+                                else int(autotune_every))
+        self._ctl = None
         self._pool = _BufferPool()
         self.slo = SloTracker(site, slo_p50_ms=slo_p50_ms,
                               slo_p99_ms=slo_p99_ms)
@@ -433,8 +458,13 @@ class MicroBatchDispatcher:
     def warm(self, tenants=None, aot=None):
         """Warm the registry AND the AOT ladder for THIS dispatcher's
         bucket configuration (``min_bucket_rows``..``max_batch_rows`` —
-        the env-derived defaults may differ). Returns the registry's
+        the env-derived defaults may differ). With the autotune latch
+        on (and a recorder active) this also materializes the registry's
+        controller first, so every warmed tenant gets its plan-time
+        frontier pick and its ``plan`` record. Returns the registry's
         per-tenant warm statuses."""
+        if self._autotune and _obs.enabled():
+            self._controller()
         return self.registry.warm(
             tenants, aot=aot,
             buckets=_aot.bucket_ladder(self._min_bucket,
@@ -486,8 +516,8 @@ class MicroBatchDispatcher:
                 fut.set_result(hit)
                 if _obs.enabled():
                     done = time.perf_counter()
-                    p50_t, p99_t = self._targets_for(model)
                     tenant = str(tenant)
+                    p50_t, p99_t = self._targets_for(model, tenant)
                     self.slo.note_request_done(
                         submitted, ts=done, tenant=tenant,
                         targets=(p50_t, p99_t))
@@ -499,21 +529,44 @@ class MicroBatchDispatcher:
                 return fut
         tenant = str(tenant)
         group_key = model.group_key(op, rows.dtype)
-        if not self._megabatch:
+        ctl = self._ctl
+        if not self._megabatch or (ctl is not None
+                                   and ctl.host_route(tenant)):
             # tenant-scoped batches: the opt-out prefixes the memoized
-            # fingerprint key so equal-fingerprint tenants never merge
+            # fingerprint key so equal-fingerprint tenants never merge;
+            # a host-routed tenant (admission control) is ALSO keyed on
+            # its own so its degraded batches never drag a healthy
+            # same-fingerprint tenant onto the host route with it
             group_key = (tenant,) + group_key
         return _Request(tenant, op, rows, model, cache_key, submitted,
                         group_key)
 
-    def _targets_for(self, model):
-        """The (p50, p99) targets a tenant's requests burn against: its
-        own declared registration targets, falling back per percentile
-        to the dispatcher's run-level ones."""
+    def _targets_for(self, model, tenant=None):
+        """The (p50, p99) targets a tenant's requests burn against: the
+        controller's renegotiated targets when admission control
+        re-based them (serving.control — declared-vs-renegotiated is in
+        the ``control`` records), else its own declared registration
+        targets, falling back per percentile to the dispatcher's
+        run-level ones."""
+        ctl = self._ctl
+        if ctl is not None and tenant is not None:
+            renegotiated = ctl.targets_for(tenant)
+            if renegotiated is not None:
+                return renegotiated
         return (model.slo_p50_ms if model.slo_p50_ms is not None
                 else self.slo.slo_p50_ms,
                 model.slo_p99_ms if model.slo_p99_ms is not None
                 else self.slo.slo_p99_ms)
+
+    def _controller(self):
+        """The registry's shared :class:`~sq_learn_tpu.serving.control.
+        Controller`, materialized on first use — only with the autotune
+        latch on AND a recorder active (the registry enforces the
+        latter): the disabled path never allocates controller state."""
+        ctl = self._ctl
+        if ctl is None and self._autotune:
+            ctl = self._ctl = self.registry.controller()
+        return ctl
 
     def _budget_ledger(self):
         """The per-tenant :class:`~sq_learn_tpu.obs.budget.BudgetLedger`,
@@ -648,6 +701,13 @@ class MicroBatchDispatcher:
         self.flush()  # anything the worker left behind
         self._closed = True
         if _obs.enabled():
+            if self._autotune and self._budget is not None:
+                # one final controller pass BEFORE the close-time slo /
+                # budget emits: the last window's burn gets its decision
+                # (and its record) before the gates judge the run
+                ctl = self._controller()
+                if ctl is not None:
+                    ctl.evaluate(self, final=True)
             _cache.flush_counters()
             if self._aot_hits:
                 _obs.counter_add("serving.aot_cache_hits", self._aot_hits)
@@ -903,7 +963,14 @@ class MicroBatchDispatcher:
         full = self._max_batch_rows
         if n > full:  # one oversized request: pad to its own pow2 bucket
             full = 1 << max(0, int(n - 1).bit_length())
-        bucket = bucket_rows(max(n, 1), full, min_rows=self._min_bucket)
+        min_rows = self._min_bucket
+        ctl = self._ctl
+        if ctl is not None:
+            # admission control's "wider coalescing" rung: a raised
+            # per-tenant bucket floor (the group is single-tenant or
+            # same-fingerprint — the head's override is the batch's)
+            min_rows = ctl.min_rows_for(head.tenant, min_rows)
+        bucket = bucket_rows(max(n, 1), full, min_rows=min_rows)
 
         observing = _obs.enabled()
         t_collect = time.perf_counter() if observing else 0.0
@@ -943,8 +1010,12 @@ class MicroBatchDispatcher:
 
         degraded = False
         dev = None
-        state = _sup.breaker.preflight(site=self._site)
-        if state != _sup.CLOSED:
+        if ctl is not None and ctl.host_route(head.tenant):
+            # admission control pinned this tenant to the host route
+            # (the ladder's last rung): same kernel, same pre-quantized
+            # payload, uncommitted placement — zero requests lost
+            degraded = True
+        elif _sup.breaker.preflight(site=self._site) != _sup.CLOSED:
             # OPEN breaker: the backend is known-wedged and the trip
             # action already repinned the process to CPU — go straight
             # to the host route instead of stalling the queue on
@@ -1040,7 +1111,7 @@ class MicroBatchDispatcher:
         tenant = targets = stages = parts = None
         if observing:
             tenant = head.tenant
-            targets = self._targets_for(head.model)
+            targets = self._targets_for(head.model, tenant)
             if stamps is not None:
                 # the decomposition the budget telemetry reports: where
                 # a request's submit→response time actually went.
@@ -1095,6 +1166,16 @@ class MicroBatchDispatcher:
         # artifact (measured: ~75k lines per load-bench run), so budget
         # enforcement is per-batch only under SQ_OBS_STRICT and every
         # tracked site gets its one watchdog observation at close().
+        # The control-plane cadence rides the batch seq too, and runs
+        # BEFORE the windowed budget flush below: a degrade's target
+        # renegotiation re-bases the ledger's burn before the flush can
+        # emit (or strict-raise) an alert on the old targets — acting
+        # "before the SLO gate trips" is the controller's contract
+        if observing and self._autotune and self._autotune_every > 0 \
+                and (seq + 1) % self._autotune_every == 0:
+            ctl = self._controller()
+            if ctl is not None and self._budget is not None:
+                ctl.evaluate(self)
         # The windowed flush rides the batch seq: every Nth batch emits
         # the since-last-flush slo window plus the tenant budget/alert
         # records (a strict budget alert raises from here on the
@@ -1144,5 +1225,5 @@ class MicroBatchDispatcher:
                 }
             parts.append((t, [r.submitted for r in reqs], rows_t,
                           (nbytes * rows_t) // n if n else 0,
-                          self._targets_for(reqs[0].model), st))
+                          self._targets_for(reqs[0].model, t), st))
         return parts

@@ -246,10 +246,22 @@ class ModelRegistry:
         self._sources = {}
         self._quantize = {}
         self._slo_targets = {}
+        #: tenant → declared accuracy headroom (slo_eps, slo_delta) —
+        #: the controller's opt-in: route changes need ε headroom,
+        #: (ε, δ) relaxation needs δ headroom (serving.control)
+        self._contracts = {}
+        #: tenant → controller-applied quantize mode (admission
+        #: control's route step; absent = serve the registered route)
+        self._route_override = {}
+        #: the registry's one Controller, created lazily by
+        #: :meth:`controller` and ONLY under an active recorder — with
+        #: SQ_OBS unset this stays None (the disabled-path pin)
+        self._controller = None
         self._resident = collections.OrderedDict()
 
     def register(self, tenant, source, quantize="env", *,
-                 slo_p50_ms=None, slo_p99_ms=None):
+                 slo_p50_ms=None, slo_p99_ms=None, slo_eps=None,
+                 slo_delta=None):
         """Bind ``tenant`` to a checkpoint directory or fitted estimator.
         Replaces any previous binding and evicts the resident copy.
 
@@ -263,7 +275,18 @@ class ModelRegistry:
         its per-tenant ``slo`` records and its error-budget burn
         (:mod:`sq_learn_tpu.obs.budget`) are judged against these
         instead of the dispatcher's run-level targets (None = inherit
-        them)."""
+        them).
+
+        ``slo_eps``/``slo_delta`` DECLARE accuracy headroom for the
+        autotuner (:mod:`~sq_learn_tpu.serving.control`): ``slo_eps``
+        is the tolerated relative per-element representation error
+        (route changes — the plan-time frontier pick and the quantize
+        degrade step — happen only inside it), ``slo_delta`` the
+        declared failure budget δ the controller may relax toward its
+        cap when the tenant's error budget is persistently underspent.
+        Both default to None: a tenant that declares nothing is never
+        re-routed and never re-contracted — its responses are
+        controller-invariant by construction."""
         tenant = str(tenant)
         if quantize != "env":
             _quant.resolve_mode(quantize)  # validate eagerly, at bind time
@@ -274,7 +297,16 @@ class ModelRegistry:
             self._sources[tenant] = source
             self._quantize[tenant] = quantize
             self._slo_targets[tenant] = (slo_p50_ms, slo_p99_ms)
+            self._contracts[tenant] = (
+                None if slo_eps is None else float(slo_eps),
+                None if slo_delta is None else float(slo_delta))
+            self._route_override.pop(tenant, None)
             self._resident.pop(tenant, None)
+            ctl = self._controller
+        if ctl is not None:
+            # re-registration replans: the binding (and possibly the
+            # declared headroom) changed under the controller
+            ctl.plan(tenant, replan=True)
         return self
 
     def unregister(self, tenant):
@@ -282,6 +314,8 @@ class ModelRegistry:
             self._sources.pop(str(tenant), None)
             self._quantize.pop(str(tenant), None)
             self._slo_targets.pop(str(tenant), None)
+            self._contracts.pop(str(tenant), None)
+            self._route_override.pop(str(tenant), None)
             self._resident.pop(str(tenant), None)
 
     def tenants(self):
@@ -313,9 +347,14 @@ class ModelRegistry:
                 raise KeyError(f"tenant {tenant!r} is not registered "
                                f"(known: {sorted(self._sources)})") from None
             quantize = self._quantize.get(tenant, "env")
+            override = self._route_override.get(tenant)
             slo_p50_ms, slo_p99_ms = self._slo_targets.get(tenant,
                                                            (None, None))
-        if quantize == "env":
+        if override is not None:
+            # admission control re-routed the tenant (serving.control):
+            # the override wins over the registration and the env
+            quantize = override
+        elif quantize == "env":
             quantize = _quant.serve_quantize()
         # load OUTSIDE the lock: a cold checkpoint read must not stall
         # every concurrent resolve of already-resident tenants
@@ -341,6 +380,75 @@ class ModelRegistry:
                 _obs.counter_add("serving.registry_evictions", 1)
                 _obs.gauge("serving.registry_evicted", evicted)
         return model
+
+    def contract(self, tenant):
+        """The tenant's declared accuracy headroom ``(slo_eps,
+        slo_delta)`` — (None, None) when nothing was declared (the
+        controller then never touches its route or its contract)."""
+        with self._lock:
+            return self._contracts.get(str(tenant), (None, None))
+
+    def declared_targets(self, tenant):
+        """The tenant's REGISTERED latency targets ``(slo_p50_ms,
+        slo_p99_ms)`` — the declaration, not the controller's
+        renegotiation (that lives on the controller state and in the
+        ``control`` records)."""
+        with self._lock:
+            return self._slo_targets.get(str(tenant), (None, None))
+
+    def current_route(self, tenant):
+        """The quantize mode the tenant currently serves under:
+        the controller's route override when one is applied, else the
+        registration (``"env"`` resolved through ``SQ_SERVE_QUANTIZE``).
+        Normalized: ``'bf16' | 'int8' | None`` (exact)."""
+        with self._lock:
+            override = self._route_override.get(str(tenant))
+            quantize = self._quantize.get(str(tenant), "env")
+        if override is not None:
+            return _quant.resolve_mode(override)
+        if quantize == "env":
+            return _quant.serve_quantize()
+        return _quant.resolve_mode(quantize)
+
+    def set_route_override(self, tenant, mode):
+        """Apply (or with ``mode=None`` clear) the controller's route
+        override and evict the resident model — the next resolve
+        rebuilds with the new quantize mode, minting a NEW fingerprint,
+        so the result cache and the megabatch group keys can never mix
+        routes. Counted into ``serving.control_reroutes``."""
+        tenant = str(tenant)
+        if mode is not None:
+            mode = _quant.resolve_mode(mode)
+        with self._lock:
+            if mode is None:
+                self._route_override.pop(tenant, None)
+            else:
+                self._route_override[tenant] = mode
+            self._resident.pop(tenant, None)
+        _obs.counter_add("serving.control_reroutes", 1)
+        _obs.gauge("serving.control_route",
+                   {"tenant": tenant, "mode": mode or "registered"})
+
+    def controller(self, create=True, **opts):
+        """The registry's one :class:`~sq_learn_tpu.serving.control.
+        Controller`, created lazily — and ONLY under an active recorder:
+        with ``SQ_OBS`` unset this always returns None and allocates
+        nothing (the PR 12 disabled-path rule, pinned by test).
+        ``opts`` configure the controller on FIRST creation (the bench
+        and the tests tune thresholds per instance, never via env
+        mutation); ``create=False`` only peeks."""
+        with self._lock:
+            ctl = self._controller
+        if ctl is not None or not create:
+            return ctl
+        if not _obs.enabled():
+            return None
+        from . import control as _control
+
+        with self._lock:
+            if self._controller is None:
+                self._controller = _control.Controller(self, **opts)
+            return self._controller
 
     def warm(self, tenants=None, threads=None, aot=None, buckets=None):
         """Prefetch cold checkpoint loads on a bounded thread pool — the
@@ -403,6 +511,14 @@ class ModelRegistry:
                         thread_name_prefix="sq-serve-warm") as ex:
                     results = list(ex.map(load, sel))
         out.update(dict(results))
+        ctl = self.controller(create=False)
+        if ctl is not None:
+            # plan at warm time: every successfully warmed tenant gets
+            # its frontier pick (and its ``plan`` record) before the
+            # first request — the ISSUE's register/warm-time half
+            for t, status in out.items():
+                if status in ("resident", "loaded"):
+                    ctl.plan(t)
         return out
 
     @staticmethod

@@ -354,8 +354,12 @@ def main():
     # shared and the zero-compile contract stays armed throughout.
     from ..obs.budget import BudgetBurnError
 
+    # autotune=False: this leg asserts the alert FIRES; the control
+    # plane exists to prevent exactly that (its own contract is
+    # `make control-smoke`), so the static plane is pinned here
     reg.register("hot", alpha_dir, slo_p99_ms=1e-6)
-    dv = MicroBatchDispatcher(reg, background=False, max_batch_rows=128)
+    dv = MicroBatchDispatcher(reg, background=False, max_batch_rows=128,
+                              autotune=False)
     for _ in range(6):
         dv.serve("hot", "predict", requests[0][2])
     dv.close()
@@ -370,7 +374,8 @@ def main():
           "forced violation left no violated per-tenant slo record")
     os.environ["SQ_OBS_BUDGET_STRICT"] = "1"
     alerts_before = len(rec2.alert_records)
-    dv2 = MicroBatchDispatcher(reg, background=False, max_batch_rows=128)
+    dv2 = MicroBatchDispatcher(reg, background=False, max_batch_rows=128,
+                               autotune=False)
     dv2.serve("hot", "predict", requests[0][2])
     raised = False
     try:

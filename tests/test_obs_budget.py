@@ -217,7 +217,7 @@ def test_legacy_versions_still_validate_and_v6_slo_fields():
         dict(v6, stages={"queue": -1.0})))
     assert any("tenant" in e for e in validate_record(dict(v6, tenant=3)))
     assert any("unknown schema version" in e
-               for e in validate_record(dict(v5, v=8, schema_version=8)))
+               for e in validate_record(dict(v5, v=9, schema_version=9)))
 
 
 # -- SloTracker: per-tenant records, windowed flush ---------------------------
@@ -280,7 +280,9 @@ def test_dispatcher_attributes_tenants_and_burns(fitted, monkeypatch):
     reg = ModelRegistry()
     reg.register("ok", fitted["qkm"], slo_p50_ms=5e3, slo_p99_ms=1e4)
     reg.register("hot", fitted["qkm"], slo_p99_ms=1e-6)  # impossible
-    d = MicroBatchDispatcher(reg, background=False)
+    # static plane (autotune off): this test pins the strict-raise
+    # alert path the PR 17 controller exists to prevent
+    d = MicroBatchDispatcher(reg, background=False, autotune=False)
     for i in range(4):
         d.serve("ok", "predict", fitted["X"][: 2 + i])
         d.serve("hot", "predict", fitted["X"][:3])
@@ -402,7 +404,11 @@ def _forced_burn_artifact(tmp_path, fitted):
     obs.enable(path)
     reg = ModelRegistry()
     reg.register("hot", fitted["qkm"], slo_p99_ms=1e-6)
-    d = MicroBatchDispatcher(reg, background=False)
+    # static plane: these tests pin the alert machinery itself — the
+    # PR 17 controller exists to renegotiate BEFORE the alert fires
+    # (its own contract is tests/test_serving_control.py), so it is
+    # pinned off here
+    d = MicroBatchDispatcher(reg, background=False, autotune=False)
     for _ in range(3):
         d.serve("hot", "predict", fitted["X"][:3])
     d.close()

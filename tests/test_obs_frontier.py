@@ -164,6 +164,65 @@ class TestRegressAccuracyGate:
             assert validate_record(v) == [], v
 
 
+class TestEffectiveContracts:
+    """Edge cases of the per-tenant effective-(ε, δ) table — the
+    controller's plan-time input (ISSUE 17)."""
+
+    def _draw(self, tenant, site, violated, fail_prob, tol, realized):
+        return {"type": "guarantee", "site": site, "violated": violated,
+                "fail_prob": fail_prob, "tol": tol, "realized": realized,
+                "attrs": {"tenant": tenant}}
+
+    def test_untenanted_draws_yield_empty_table(self):
+        recs = [{"type": "guarantee", "site": "qpca.tomography",
+                 "violated": False, "fail_prob": 0.1},
+                {"type": "counter", "name": "x", "value": 1}]
+        assert frontier.effective_contracts(recs) == {}
+        assert "no tenant-attributed" in frontier.render_effective({})
+
+    def test_single_draw_no_alarm(self):
+        recs = [self._draw("t", "serving.quant.t", False, 1e-3, 0.01,
+                           0.004)]
+        e = frontier.effective_contracts(recs)["t"]
+        assert e["draws"] == 1 and e["violations"] == 0
+        # one clean draw: the exact binomial lower bound stays at zero —
+        # a single observation must never alarm a declared δ
+        assert e["delta_lower_bound"] == 0.0
+        assert e["delta_lower_bound"] < e["delta_declared"]
+        assert e["eps_effective"] == 0.004 and e["eps_max"] == 0.004
+
+    def test_mixed_quantized_and_exact_streams_conservative(self):
+        # one tenant served by a quantized site (tight δ_q) and an
+        # exact-model site (loose δ): the table must keep the LOOSEST
+        # declaration per axis (the auditor's conservative rule) and
+        # pool the realized draws across both sites
+        recs = ([self._draw("t", "serving.quant.t", False, 1e-3,
+                            0.004, 0.001 * (i + 1)) for i in range(8)]
+                + [self._draw("t", "qkmeans.dist_estimate", i == 0, 0.5,
+                              0.1, 0.01 * (i + 1)) for i in range(8)])
+        e = frontier.effective_contracts(recs)["t"]
+        assert e["draws"] == 16 and e["violations"] == 1
+        assert e["delta_declared"] == 0.5  # loosest contract wins
+        assert e["eps_declared"] == 0.1
+        assert sorted(e["sites"]) == ["qkmeans.dist_estimate",
+                                      "serving.quant.t"]
+        # realized pool sorted: 0.001..0.008 then 0.01..0.08; the
+        # (1 − 0.5)-quantile is the nearest-rank 8th of 16 → 0.008
+        assert e["eps_effective"] == pytest.approx(0.008)
+        assert e["eps_max"] == pytest.approx(0.08)
+        # the hand-computed CP bound for 1/16 stays under the declared δ
+        assert 0.0 < e["delta_lower_bound"] < 0.5
+
+    def test_non_numeric_fields_skipped_not_fatal(self):
+        recs = [self._draw("t", "s", False, True, "nan", None),
+                self._draw("t", "s", False, 0.2, 0.01, 0.005)]
+        e = frontier.effective_contracts(recs)["t"]
+        assert e["draws"] == 2
+        assert e["delta_declared"] == 0.2  # bool/str declarations skipped
+        assert e["eps_declared"] == 0.01
+        assert e["eps_max"] == 0.005
+
+
 class TestModelJoin:
     """The acceptance wiring: the runtime models' fit-time output is
     consumed by a non-test caller — here exercised the way the sweep

@@ -1,0 +1,456 @@
+"""Control-plane contract tests (ISSUE 17).
+
+The load-bearing ones: ``autotune=False`` / ``SQ_SERVE_AUTOTUNE=0`` pin
+the static PR 16 serving plane bit-identically (same responses, no
+route overrides, zero ``control`` records); with ``SQ_OBS`` unset the
+registry allocates NO controller state at all (the PR 12 disabled-path
+rule); the plan-time frontier pick lands the cheapest route inside the
+declared ε; the degrade ladder steps cheapest-first with renegotiated
+ledger targets that re-base the burn; relax/tighten move the served δ
+only inside the declared headroom; and every decision is a schema-v8
+``control`` record with a per-tenant monotonic seq and a realized
+follow-up one evaluation later.
+"""
+
+import gzip
+import json
+import shutil
+
+import numpy as np
+import pytest
+
+from sq_learn_tpu import obs
+from sq_learn_tpu.models import QKMeans
+from sq_learn_tpu.obs.budget import BudgetLedger
+from sq_learn_tpu.obs.schema import validate_jsonl, validate_record
+from sq_learn_tpu.obs.trace import load_jsonl
+from sq_learn_tpu.serving import MicroBatchDispatcher, ModelRegistry
+from sq_learn_tpu.serving import cache as serve_cache
+from sq_learn_tpu.serving import control
+from sq_learn_tpu.serving.control import Controller, theoretical_cost
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    rng = np.random.default_rng(0)
+    m = 12
+    X = (rng.normal(size=(300, m))
+         + 6.0 * rng.integers(0, 3, size=(300, 1))).astype(np.float32)
+    qkm = QKMeans(n_clusters=3, random_state=0, n_init=1).fit(X)
+    return {"X": X, "m": m, "qkm": qkm}
+
+
+@pytest.fixture(autouse=True)
+def _hygiene():
+    serve_cache.clear()
+    yield
+    serve_cache.clear()
+    if obs.enabled():
+        obs.disable()
+
+
+class _StubDispatcher:
+    """The two geometry attributes + ledger accessor the controller
+    reads — unit tests drive `evaluate` without a serving stack."""
+
+    _min_bucket = 8
+    _max_batch_rows = 128
+
+    def __init__(self, led):
+        self._led = led
+
+    def budget_ledger(self):
+        return self._led
+
+
+def _reqs(fitted, n=12, sizes=(1, 5, 17)):
+    rng = np.random.default_rng(3)
+    return [rng.normal(size=(sizes[i % len(sizes)], fitted["m"]))
+            .astype(np.float32) for i in range(n)]
+
+
+# -- cost model --------------------------------------------------------------
+
+
+def test_theoretical_cost_scales_inverse_delta_squared():
+    assert theoretical_cost(None) is None
+    assert theoretical_cost(0.0) is None
+    assert theoretical_cost(-1.0) is None
+    assert theoretical_cost(1e-3) == pytest.approx(1e6)
+    # halving δ quadruples the theoretical runtime (the runtime model's
+    # non-well-clusterable 1/δ² terms)
+    assert theoretical_cost(5e-4) == pytest.approx(4e6)
+    # quantized routes scale by their transfer weight
+    assert theoretical_cost(1e-3, "bf16") == pytest.approx(0.5e6)
+    assert theoretical_cost(1e-3, "int8") == pytest.approx(0.25e6)
+
+
+# -- plan: the register/warm-time frontier pick ------------------------------
+
+
+def test_plan_picks_cheapest_route_inside_eps(fitted):
+    rec = obs.enable()
+    reg = ModelRegistry()
+    reg.register("wide", fitted["qkm"], quantize=None, slo_eps=0.01)
+    reg.register("narrow", fitted["qkm"], quantize=None, slo_eps=0.00392)
+    reg.register("tight", fitted["qkm"], quantize=None, slo_eps=1e-4)
+    reg.register("blank", fitted["qkm"], quantize=None)
+    ctl = reg.controller()
+    for t in ("wide", "narrow", "tight", "blank"):
+        ctl.plan(t)
+    # int8 (cost 0.25) fits 0.01; only bf16 fits the narrow window;
+    # nothing quantized fits 1e-4; no declared ε = route untouched
+    assert reg.current_route("wide") == "int8"
+    assert reg.current_route("narrow") == "bf16"
+    assert reg.current_route("tight") is None
+    assert reg.current_route("blank") is None
+    plans = {r["tenant"]: r for r in rec.control_records
+             if r["action"] == "plan"}
+    # a silent controller is indistinguishable from a dead one: the
+    # no-headroom tenant still lands its (no-op) plan record
+    assert set(plans) == {"wide", "narrow", "tight", "blank"}
+    assert plans["wide"]["decision"]["route"] == "int8"
+    assert plans["blank"]["decision"]["route"] == "exact"
+    for r in rec.control_records:
+        assert validate_record(r) == [], r
+    obs.disable()
+
+
+def test_plan_idempotent_until_replan(fitted):
+    rec = obs.enable()
+    reg = ModelRegistry()
+    reg.register("t", fitted["qkm"], quantize=None, slo_eps=0.01)
+    ctl = reg.controller()
+    ctl.plan("t")
+    ctl.plan("t")  # second call: no new record, no seq burn
+    assert len([r for r in rec.control_records
+                if r["action"] == "plan"]) == 1
+    # a re-register re-contracts: the registry itself replans (the
+    # binding changed under the controller), re-reading the declaration
+    reg.register("t", fitted["qkm"], quantize=None, slo_eps=1e-4)
+    plans = [r for r in rec.control_records if r["action"] == "plan"]
+    assert len(plans) == 2
+    assert plans[-1]["decision"]["route"] == "exact"
+    assert reg.current_route("t") is None
+    obs.disable()
+
+
+# -- evaluate: the cadence ladder --------------------------------------------
+
+
+def test_degrade_ladder_widen_host_and_renegotiation(fitted):
+    """An exact-route tenant with no ε headroom burns: the ladder must
+    step widen → host (the quantize rung needs declared ε), each rung
+    renegotiating the ledger targets so the re-based burn lands under
+    the relax threshold."""
+    rec = obs.enable()
+    reg = ModelRegistry()
+    reg.register("t", fitted["qkm"], quantize=None, slo_p99_ms=1.0)
+    ctl = Controller(reg, patience=1)
+    led = BudgetLedger(window_seconds=(1.0,), site="test")
+    d = _StubDispatcher(led)
+
+    led.note_requests("t", [0.5] * 10, p99_ms=1.0, ts=100.0)
+    acts = dict(ctl.evaluate(d, now=100.0))
+    assert acts["t"] == "degrade"
+    assert ctl.min_rows_for("t", 8) == 64  # max(8*4, min(128, 64))
+    assert not ctl.host_route("t")
+    p50_t, p99_t = ctl.targets_for("t")
+    assert p50_t is None
+    assert p99_t == pytest.approx(500.0 * control.RENEGOTIATE_MARGIN)
+
+    # renegotiated targets re-base the ledger burn: the same 500 ms
+    # latencies now sit inside the 1000 ms target
+    led.note_requests("t", [0.5] * 10, ts=100.5)
+    stats = led.window_stats("t", 1.0, now=101.4)
+    assert stats["slo_burn_rate"] == 0.0
+
+    # a second burn (fresh window, tiny renegotiated target restored by
+    # noting an over-target batch) takes the last rung: host
+    led.note_requests("t", [5.0] * 10, ts=102.0)
+    acts = dict(ctl.evaluate(d, now=102.0))
+    assert acts["t"] == "degrade"
+    assert ctl.host_route("t")
+
+    records = [r for r in rec.control_records if r["tenant"] == "t"]
+    degrades = [r for r in records if r["action"] == "degrade"]
+    assert [r["level"] for r in degrades] == [1, 2]
+    assert degrades[0]["decision"]["min_rows"] == 64
+    assert degrades[1]["decision"]["route"] == "host"
+    # predicted effect of a renegotiation: burn at 1/margin
+    assert degrades[0]["predicted"]["burn_rate"] == pytest.approx(
+        1.0 / control.RENEGOTIATE_MARGIN)
+    seqs = [r["seq"] for r in records]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+    for r in records:
+        assert validate_record(r) == [], r
+    obs.disable()
+
+
+def test_quantize_rung_inside_declared_eps(fitted):
+    """The cheapest rung: an ε-headroom tenant whose route override was
+    cleared (operator action) degrades into the quantized route before
+    any coalescing or host fallback."""
+    obs.enable()
+    reg = ModelRegistry()
+    reg.register("q", fitted["qkm"], quantize=None, slo_eps=0.01,
+                 slo_p99_ms=1.0)
+    ctl = Controller(reg, patience=1)
+    ctl.plan("q")
+    assert reg.current_route("q") == "int8"
+    reg.set_route_override("q", None)  # operator cleared the pick
+    led = BudgetLedger(window_seconds=(1.0,), site="test")
+    led.note_requests("q", [0.5] * 10, p99_ms=1.0, ts=10.0)
+    acts = dict(ctl.evaluate(_StubDispatcher(led), now=10.0))
+    assert acts["q"] == "degrade"
+    assert reg.current_route("q") == "bf16"  # exact → bf16, not host
+    assert ctl.min_rows_for("q", 8) == 8
+    assert not ctl.host_route("q")
+    obs.disable()
+
+
+def test_recover_steps_back_most_recent_first(fitted):
+    rec = obs.enable()
+    reg = ModelRegistry()
+    reg.register("t", fitted["qkm"], quantize=None, slo_p99_ms=1.0)
+    ctl = Controller(reg, patience=1)
+    led = BudgetLedger(window_seconds=(1.0,), site="test")
+    d = _StubDispatcher(led)
+    led.note_requests("t", [0.5] * 10, p99_ms=1.0, ts=0.0)
+    assert dict(ctl.evaluate(d, now=0.0))["t"] == "degrade"  # widen
+    led.note_requests("t", [5.0] * 10, ts=2.0)
+    assert dict(ctl.evaluate(d, now=2.0))["t"] == "degrade"  # host
+    assert ctl.host_route("t")
+    # healthy traffic inside the renegotiated target, old window pruned
+    led.note_requests("t", [0.5] * 10, ts=4.0)
+    assert dict(ctl.evaluate(d, now=4.0))["t"] == "recover"
+    assert not ctl.host_route("t")  # most recent rung undone first
+    assert ctl.min_rows_for("t", 8) == 64  # widen still applied
+    led.note_requests("t", [0.5] * 10, ts=6.0)
+    assert dict(ctl.evaluate(d, now=6.0))["t"] == "recover"
+    assert ctl.min_rows_for("t", 8) == 8
+    # fully recovered: renegotiated targets dropped
+    assert ctl.targets_for("t") is None
+    # the realized follow-up closed the loop on the first degrade
+    realized = [r for r in rec.control_records
+                if r["tenant"] == "t" and isinstance(r.get("realized"),
+                                                     dict)]
+    assert realized and all(
+        isinstance(r["realized"].get("burn_rate"), (int, float))
+        for r in realized)
+    obs.disable()
+
+
+def test_relax_banks_delta_then_tighten_walks_back(fitted):
+    obs.enable()
+    reg = ModelRegistry()
+    reg.register("b", fitted["qkm"], quantize=None, slo_delta=1e-3,
+                 slo_p99_ms=1e6)
+    ctl = Controller(reg, patience=1)
+    led = BudgetLedger(window_seconds=(1.0,), site="test")
+    d = _StubDispatcher(led)
+    # persistently underspent: relax doubles δ toward the 4× cap
+    led.note_requests("b", [1e-6], p99_ms=1e6, ts=0.0)
+    assert dict(ctl.evaluate(d, now=0.0))["b"] == "relax"
+    led.note_requests("b", [1e-6], ts=0.2)
+    assert dict(ctl.evaluate(d, now=0.2))["b"] == "relax"
+    c = ctl.contracts()["b"]
+    assert c["delta_declared"] == pytest.approx(1e-3)
+    assert c["delta_served"] == pytest.approx(4e-3)  # at the cap
+    # banked theoretical runtime: cost_served is 16× under cost_declared
+    assert c["cost_declared"] / c["cost_served"] == pytest.approx(16.0)
+    # at the cap: no further relax
+    led.note_requests("b", [1e-6], ts=0.4)
+    assert dict(ctl.evaluate(d, now=0.4))["b"] == "hold"
+    # the draw stream turns statistically inconsistent: tighten halves
+    # δ back toward the declaration before the audit can flag it
+    for i in range(20):
+        led.note_draw("b", True, fail_prob=1e-3, ts=0.5)
+    led.note_requests("b", [1e-6], ts=0.5)
+    assert dict(ctl.evaluate(d, now=0.5))["b"] == "tighten"
+    assert ctl.contracts()["b"]["delta_served"] == pytest.approx(2e-3)
+    obs.disable()
+
+
+def test_no_headroom_tenant_never_recontracted(fitted):
+    """A tenant that declared nothing gets hold records only — its δ
+    and route are controller-invariant by construction."""
+    rec = obs.enable()
+    reg = ModelRegistry()
+    reg.register("p", fitted["qkm"], quantize=None, slo_p99_ms=1e6)
+    ctl = Controller(reg, patience=1)
+    led = BudgetLedger(window_seconds=(1.0,), site="test")
+    d = _StubDispatcher(led)
+    for i in range(4):
+        led.note_requests("p", [1e-6], p99_ms=1e6, ts=float(i) / 10)
+        ctl.evaluate(d, now=float(i) / 10)
+    c = ctl.contracts()["p"]
+    assert c["delta_served"] is None and c["cost_served"] is None
+    assert c["route"] == "exact" and c["level"] == 0
+    acts = {r["action"] for r in rec.control_records
+            if r["tenant"] == "p"}
+    assert acts == {"plan", "hold"}
+    obs.disable()
+
+
+# -- the static-plane pins ---------------------------------------------------
+
+
+def test_autotune_off_is_bit_identical_and_silent(fitted, monkeypatch):
+    """``autotune=False`` (and ``SQ_SERVE_AUTOTUNE=0``) pin the PR 16
+    plane: responses bit-equal to a no-obs run, no route override on an
+    ε-headroom tenant, zero control records."""
+    reqs = _reqs(fitted)
+
+    def run(autotune, observe):
+        serve_cache.clear()
+        reg = ModelRegistry()
+        reg.register("t", fitted["qkm"], quantize=None, slo_eps=0.01,
+                     slo_p99_ms=1e-6)  # would burn AND re-route if tuned
+        if observe:
+            obs.enable()
+        d = MicroBatchDispatcher(reg, background=False,
+                                 max_batch_rows=64, autotune=autotune,
+                                 autotune_every=1)
+        outs = [d.serve("t", "predict", r) for r in reqs]
+        d.close()
+        rec = obs.disable() if observe else None
+        return outs, reg, rec
+
+    base, reg0, _ = run(autotune=False, observe=False)
+    off, reg1, rec1 = run(autotune=False, observe=True)
+    assert all(np.array_equal(a, b) for a, b in zip(base, off))
+    assert reg1.current_route("t") is None
+    assert rec1.control_records == []
+    assert reg1.controller(create=False) is None
+
+    # the env kill switch latches the same static plane
+    monkeypatch.setenv("SQ_SERVE_AUTOTUNE", "0")
+    env_off, reg2, rec2 = run(autotune=None, observe=True)
+    assert all(np.array_equal(a, b) for a, b in zip(base, env_off))
+    assert rec2.control_records == []
+    monkeypatch.delenv("SQ_SERVE_AUTOTUNE")
+
+    # tuned run on the same traffic: the plan re-routes the tenant
+    on, reg3, rec3 = run(autotune=True, observe=True)
+    assert len(on) == len(base)  # zero requests lost either way
+    assert any(r["action"] == "plan" for r in rec3.control_records)
+    assert reg3.current_route("t") == "int8"
+
+
+def test_disabled_path_allocates_no_controller(fitted):
+    """With SQ_OBS unset the controller must not exist at all: the
+    registry returns None, the dispatcher never materializes one."""
+    assert not obs.enabled()
+    reg = ModelRegistry()
+    reg.register("t", fitted["qkm"], quantize=None, slo_eps=0.01)
+    assert reg.controller() is None
+    assert reg.controller(create=False) is None
+    d = MicroBatchDispatcher(reg, background=False, autotune=True,
+                             autotune_every=1)
+    for r in _reqs(fitted, n=4):
+        d.serve("t", "predict", r)
+    d.close()
+    assert d._ctl is None
+    assert reg.controller(create=False) is None
+    assert reg.current_route("t") is None  # no plan ever ran
+
+
+# -- schema v8 + gzip artifacts ----------------------------------------------
+
+
+def test_control_record_schema_v8():
+    good = {"v": 8, "schema_version": 8, "ts": 0.0, "type": "control",
+            "tenant": "t",
+            "action": "degrade", "seq": 3, "level": 1,
+            "inputs": {"burn_rate": 2.0}, "decision": {"route": "host"},
+            "predicted": {"burn_rate": 0.5},
+            "realized": {"burn_rate": 0.4}}
+    assert validate_record(good) == []
+    bad_action = dict(good, action="explode")
+    assert any("action" in e for e in validate_record(bad_action))
+    bad_seq = dict(good, seq=-1)
+    assert validate_record(bad_seq) != []
+    missing = {k: v for k, v in good.items() if k != "inputs"}
+    assert validate_record(missing) != []
+
+
+def test_budget_and_alert_seq_optional_but_typed():
+    budget = {"v": 7, "schema_version": 7, "ts": 0.0, "type": "budget",
+              "tenant": "t", "window_s": 60.0, "slo_burn": 0.1,
+              "stat_burn": None, "cp_lower_bound": None,
+              "burn_rate": 0.2, "alerting": False}
+    assert validate_record(budget) == []  # v7 shape: no seq yet
+    v8 = dict(budget, v=8, schema_version=8)
+    assert validate_record(dict(v8, seq=4)) == []
+    assert validate_record(dict(v8, seq="x")) != []
+    alert = {"v": 7, "schema_version": 7, "ts": 0.0, "type": "alert",
+             "tenant": "t", "kind": "slo_burn",
+             "burn_rates": {"60": 2.5}, "threshold": 2.0}
+    assert validate_record(alert) == []
+    a8 = dict(alert, v=8, schema_version=8)
+    assert validate_record(dict(a8, seq=1)) == []
+    assert validate_record(dict(a8, seq=-2)) != []
+
+
+def test_budget_emit_stamps_monotonic_seq():
+    rec = obs.enable()
+    led = BudgetLedger(window_seconds=(1.0,), site="test")
+    led.note_requests("t", [1e-6], p99_ms=1e3, ts=0.0)
+    led.emit(now=0.1)
+    led.note_requests("t", [1e-6], ts=0.2)
+    led.emit(now=0.3)
+    obs.disable()
+    seqs = [r["seq"] for r in rec.budget_records]
+    assert all(isinstance(s, int) for s in seqs)
+    assert seqs == sorted(seqs)
+    # strictly increasing across emits (per-emit batches share a seq
+    # epoch only if the recorder says so — assert per-record uniqueness
+    # within a tenant+window stream, the replay-order key)
+    stream = [(r["tenant"], r["window_s"], r["seq"])
+              for r in rec.budget_records]
+    assert len(set(stream)) == len(stream)
+
+
+def test_jsonl_readers_open_gzip_transparently(tmp_path, fitted):
+    path = str(tmp_path / "run.jsonl")
+    obs.enable(path)
+    reg = ModelRegistry()
+    reg.register("t", fitted["qkm"], quantize=None, slo_eps=0.01,
+                 slo_p99_ms=1e6)
+    d = MicroBatchDispatcher(reg, background=False, autotune=True,
+                             autotune_every=2)
+    for r in _reqs(fitted, n=6):
+        d.serve("t", "predict", r)
+    d.close()
+    obs.disable()
+
+    gz = str(tmp_path / "run.jsonl.gz")
+    with open(path, "rb") as src, gzip.open(gz, "wb") as dst:
+        shutil.copyfileobj(src, dst)
+
+    plain = validate_jsonl(path)
+    packed = validate_jsonl(gz)
+    assert plain["errors"] == [] and packed["errors"] == []
+    assert packed["by_type"] == plain["by_type"]
+    assert packed["by_type"].get("control", 0) >= 1
+    assert load_jsonl(gz) == load_jsonl(path)
+
+
+def test_control_cli_renders_and_exits(tmp_path, capsys, fitted):
+    from sq_learn_tpu.obs import control as obs_control
+
+    path = str(tmp_path / "c.jsonl")
+    obs.enable(path)
+    reg = ModelRegistry()
+    reg.register("t", fitted["qkm"], quantize=None, slo_eps=0.01)
+    reg.controller().plan("t")
+    obs.disable()
+    assert obs_control.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "t" in out and "plan" in out
+    empty = str(tmp_path / "empty.jsonl")
+    with open(empty, "w") as fh:
+        fh.write(json.dumps({"ts": 0.0, "type": "counter", "name": "x",
+                             "value": 1, "delta": 1}) + "\n")
+    assert obs_control.main([empty]) == 2
