@@ -11,7 +11,7 @@ SHELL := /bin/bash
     lint-selftest bench \
     bench-smoke bench-suite multichip examples \
     hunt obs-smoke faults-smoke oocore-smoke serve-smoke control-smoke \
-    regress-selftest \
+    elastic-smoke regress-selftest \
     smoke obs-report obs-trace obs-frontier obs-audit obs-budget \
     obs-control regress all
 
@@ -178,10 +178,23 @@ control-smoke:
 	env SQ_OBS=1 SQ_OBS_PATH=/tmp/sq_control_smoke.jsonl \
 	    $(PYTHON) -m sq_learn_tpu.serving.control_smoke
 
+# Elastic-mesh smoke: topology-invariant fold parity at 1/2/3 logical
+# hosts, then a REAL 2-worker multi-process fit (gloo collectives,
+# coordinator-hosted KV service) bit-equal to the simulator, then a
+# REAL 3-worker fit with one worker SIGKILLed mid-epoch — lease-layer
+# detection, generation-bumping shrink to 2 hosts, resume from the
+# committed checkpoint, final state bit-identical to the uninterrupted
+# run with every shard folded exactly `epochs` times (zero lost, zero
+# double-folded), plus schema-v9 validation of every worker's elastic
+# transition records. The CI-runnable contract check for
+# sq_learn_tpu.parallel.elastic.
+elastic-smoke:
+	$(PYTHON) -m sq_learn_tpu.parallel.elastic_smoke
+
 # All contract smokes (observability + resilience + out-of-core +
-# serving + control plane + regression gate).
+# serving + control plane + elastic mesh + regression gate).
 smoke: obs-smoke faults-smoke oocore-smoke serve-smoke control-smoke \
-    regress-selftest lint-selftest
+    elastic-smoke regress-selftest lint-selftest
 
 # Render the human report / Chrome trace of an obs JSONL artifact
 # (default: the obs-smoke artifact; override with OBS=<path>).
@@ -243,6 +256,9 @@ regress:
 	    >> /tmp/sq_regress_bench.json
 	env SQ_OBS=1 SQ_OBS_PATH=/tmp/sq_regress_serving_obs.jsonl \
 	    $(PYTHON) -m bench.bench_serving_load \
+	    >> /tmp/sq_regress_bench.json
+	env SQ_OBS=1 SQ_OBS_PATH=/tmp/sq_regress_elastic_obs.jsonl \
+	    $(PYTHON) -m bench.bench_elastic_fit \
 	    >> /tmp/sq_regress_bench.json
 	cat /tmp/sq_regress_bench.json
 	$(PYTHON) -m sq_learn_tpu.obs regress /tmp/sq_regress_bench.json --root .

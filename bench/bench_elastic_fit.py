@@ -1,0 +1,182 @@
+"""Elastic-mesh fit bench (ISSUE 18): what does surviving a host death
+mid-fit actually cost?
+
+Two REAL multi-process runs of the same window-synchronous fold plan
+(separate worker processes, gloo collectives, coordinator-hosted KV
+service — the ``make elastic-smoke`` flow, sized up):
+
+- **kill leg** (the JSON line's value): a 3-worker fit whose worker 2
+  is SIGKILLed mid-epoch by the coordinator once committed progress
+  passes the kill cursor. The survivors must detect the death through
+  the lease layer, shrink to a generation-1 2-host world, resume from
+  the committed checkpoint, and finish — the value is that run's TOTAL
+  wall-clock including detection, shrink and the recomputed voided
+  window.
+- **naive-restart baseline**: the same kill with the shrink budget at
+  zero — the fit dies (:class:`HostFailure`, every joule of pre-death
+  work wasted) — plus a fresh uninterrupted 2-worker run from epoch 0
+  (``t2``). That sum is what the death costs WITHOUT elasticity: both
+  terms are measured wall-clocks of real multi-process runs, nothing
+  modeled.
+
+``vs_baseline = (t_dead + t2) / t3k`` therefore reads "shrink-and-
+resume recovers a host death at most 1/vs_baseline× the cost of
+restarting from scratch". At this deliberately small scale the two
+are near break-even (the shrink pays fixed detection ~2×``lease_s`` +
+world re-form against the few seconds of salvaged work); every larger
+fit moves the ratio up, since the salvaged work grows linearly while
+the shrink overhead is lease-bounded and constant. The declared
+``vs_baseline_floor`` of 0.6 guards exactly that fixed overhead; the
+extras carry the full decomposition — ``uninterrupted_2host_s``,
+``dead_run_s``, per-survivor detection latency and shrink wall-clock
+mined from the run's schema-v9 ``elastic`` records via
+:func:`~sq_learn_tpu.parallel.elastic.collect_elastic_records` — so
+the record shows where every second of the recovery went.
+
+Bit parity is asserted in-bench, not just claimed: both real runs must
+equal the in-process :func:`elastic_fit_local` reference (the
+topology-invariance contract), and the killed run's per-shard fold
+ledger must show every shard folded exactly ``epochs`` times — a bench
+that times a wrong answer fails instead of emitting.
+
+SQ_BENCH_SMOKE=1 shrinks the store to the smoke scale (seconds) while
+keeping every leg, including the real SIGKILL.
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from bench._common import emit, smoke_mode  # noqa: E402
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from sq_learn_tpu.oocore import create_synthetic_store
+    from sq_learn_tpu.parallel import elastic
+
+    smoke = smoke_mode()
+    if smoke:
+        n, m, k = 2_400, 8, 4
+        shard_bytes, epochs, window = 8 * 8 * 120, 2, 4
+        heartbeat_s, lease_s = 0.2, 1.0
+    else:
+        n, m, k = 200_000, 128, 16
+        shard_bytes, epochs, window = 128 * 4 * 4000, 2, 4
+        heartbeat_s, lease_s = 0.2, 1.0
+    seed = 5
+
+    tmp = tempfile.mkdtemp(prefix="sq_elastic_bench_")
+    try:
+        store_path = os.path.join(tmp, "store")
+        store = create_synthetic_store(store_path, n, m, n_classes=k,
+                                       seed=0, shard_bytes=shard_bytes)
+        n_shards = int(store.n_shards)
+
+        # in-process topology-invariant reference (numpy-only, fast)
+        ref = elastic.elastic_fit_local(store, k, n_hosts=2, seed=seed,
+                                        epochs=epochs, window=window)
+
+        common = dict(n_clusters=k, seed=seed, epochs=epochs,
+                      window=window, devices_per_host=2,
+                      heartbeat_s=heartbeat_s, lease_s=lease_s)
+
+        # -- baseline leg: uninterrupted 2-worker world ------------------
+        co2 = elastic.ElasticCoordinator(
+            os.path.join(tmp, "run2"), store_path, n_workers=2, **common)
+        t0 = time.perf_counter()
+        r2 = co2.run(timeout_s=600)
+        t2 = time.perf_counter() - t0
+
+        # -- kill leg: 3 workers, one SIGKILLed mid-epoch ----------------
+        run3 = os.path.join(tmp, "run3")
+        co3 = elastic.ElasticCoordinator(
+            run3, store_path, n_workers=3,
+            kill=(2, 2 * window), **common)
+        t0 = time.perf_counter()
+        r3 = co3.run(timeout_s=600)
+        t3k = time.perf_counter() - t0
+
+        # -- naive-restart baseline: same kill, zero shrink budget -------
+        cof = elastic.ElasticCoordinator(
+            os.path.join(tmp, "run3f"), store_path, n_workers=3,
+            kill=(2, 2 * window), max_shrinks=0, **common)
+        t0 = time.perf_counter()
+        try:
+            cof.run(timeout_s=600)
+            print(json.dumps({"error": "budget-0 kill run did not die"}),
+                  file=sys.stderr)
+            return 1
+        except elastic.HostFailure:
+            t_dead = time.perf_counter() - t0
+        naive_s = t_dead + t2
+
+        parity2 = bool(np.array_equal(r2["centers"], ref["centers"])
+                       and np.array_equal(r2["counts"], ref["counts"]))
+        parity3 = bool(np.array_equal(r3["centers"], ref["centers"])
+                       and np.array_equal(r3["counts"], ref["counts"]))
+        ledger_ok = bool((r3["folds"] == epochs).all())
+        shrink_ok = (r3["generation"] == 1 and r3["n_hosts"] == 2
+                     and r3["shrinks"] == 1
+                     and r3["exit_codes"].get(2) == -9)
+
+        recs = elastic.collect_elastic_records(run3)
+        detect = [r["detect_s"] for r in recs
+                  if r["event"] == "host_fail" and "detect_s" in r]
+        shrink = [r["shrink_s"] for r in recs
+                  if r["event"] == "world_up" and r["generation"] == 1
+                  and "shrink_s" in r]
+
+        emit(f"elastic_fit_{n // 1000}kx{m}_k{k}_kill_resume_wallclock",
+             t3k, vs_baseline=(naive_s / t3k), vs_baseline_floor=0.6,
+             naive_restart_s=round(naive_s, 3),
+             dead_run_s=round(t_dead, 3),
+             uninterrupted_2host_s=round(t2, 3),
+             death_overhead_s=round(t3k - t2, 3),
+             detect_s=[round(d, 3) for d in detect],
+             shrink_s=[round(s, 3) for s in shrink],
+             lease_s=lease_s, heartbeat_s=heartbeat_s,
+             epochs=epochs, window=window, n_shards=n_shards,
+             generation=int(r3["generation"]),
+             n_hosts_final=int(r3["n_hosts"]),
+             parity_uninterrupted=parity2, parity_killed=parity3,
+             fold_ledger_ok=ledger_ok, smoke=smoke)
+
+        errors = []
+        if not parity2:
+            errors.append("uninterrupted run diverges from the reference")
+        if not parity3:
+            errors.append("killed run diverges from the reference "
+                          "(bit parity broken)")
+        if not ledger_ok:
+            errors.append(f"shards lost or double-folded: "
+                          f"{r3['folds'].tolist()}")
+        if not shrink_ok:
+            errors.append(f"kill leg did not shrink 3->2 exactly once: "
+                          f"gen={r3['generation']} n={r3['n_hosts']} "
+                          f"shrinks={r3['shrinks']} "
+                          f"exits={r3['exit_codes']}")
+        if not detect or not all(d > 0 for d in detect):
+            errors.append(f"no positive detection latency: {detect}")
+        if not shrink or not all(s > 0 for s in shrink):
+            errors.append(f"no positive shrink wall-clock: {shrink}")
+        if errors:
+            print(json.dumps({"error": "; ".join(errors)}),
+                  file=sys.stderr)
+            return 1
+        return 0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -157,6 +157,11 @@ run_and_record() {  # run_and_record <timeout_s> <header> <cmd...>; returns the 
 # resilience-record extraction below captures its injected read faults —
 # so the committed record stays traceable to the exact shard split and
 # fault schedule it measured.
+# bench_elastic_fit (PR 18) is likewise CPU/loopback-only (real worker
+# processes over localhost gloo — nothing for the relay to wedge) and
+# rides at the very end: its kill leg SIGKILLs one of its own workers,
+# so any stray process it could leave on a crash must not precede the
+# configs that share the machine.
 export SQ_OOC_BENCH_ARTIFACT_DIR="$obs_dir"
 for cmd in "python bench.py" \
            "python -m bench.bench_ipe_digits" \
@@ -168,7 +173,8 @@ for cmd in "python bench.py" \
            "python -m bench.bench_qkmeans_mnist" \
            "python -m bench.bench_qkmeans_fused_fit" \
            "python -m bench.bench_oocore_fit" \
-           "python -m bench.bench_serving_load"; do
+           "python -m bench.bench_serving_load" \
+           "python -m bench.bench_elastic_fit"; do
   if ! run_and_record 600 "$cmd" $cmd; then
     # mid-run tunnel wedge (or any accelerator failure): record the CPU
     # fallback number instead of nothing. PYTHONPATH is cleared so the
@@ -223,6 +229,11 @@ env -u PYTHONPATH timeout 60 python -m sq_learn_tpu.obs frontier \
 # quantum cost of the controller-tuned tenant set vs the statically
 # declared set, floor 1.2 via the vs_baseline regression gate — emitted
 # only under SQ_OBS=1, which this suite always sets);
+# the seventeenth is the PR 18 elastic-mesh line (total wall-clock of a
+# real 3-worker fit that loses a worker to SIGKILL mid-epoch and
+# shrink-resumes, vs the measured naive-restart pair — dead run + full
+# 2-worker rerun — floor 0.6 via the vs_baseline regression gate, bit
+# parity and the fold ledger asserted in-bench);
 # the derived pair is bench_ipe_digits and the
 # sharded-scaling smoke config; missing/null = fail). This
 # script is where the bar is enforced — the unit suite only warns, since
@@ -231,7 +242,7 @@ env -u PYTHONPATH timeout 60 python -m sq_learn_tpu.obs frontier \
 # pre-imports jax via the axon sitecustomize and would hang on a wedged
 # relay even though this step only parses JSON; -m bench._gate resolves
 # via cwd, which is the repo root here)
-env -u PYTHONPATH timeout 60 python -m bench._gate "$out" 16 2
+env -u PYTHONPATH timeout 60 python -m bench._gate "$out" 17 2
 gate_rc=$?
 echo "# acceptance gate rc=$gate_rc" >> "$out"
 echo "done: $out"

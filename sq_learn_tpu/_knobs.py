@@ -222,6 +222,22 @@ _ENTRIES = [
     _K("SQ_OOC_ASYNC_CKPT", "flag", True, "lib",
        "Async mid-epoch fit snapshots (0 = synchronous writes).",
        "docs/resilience.md"),
+    # -- elastic multi-host mesh (docs/resilience.md §elastic) -----------
+    _K("SQ_ELASTIC_HEARTBEAT_S", "float", 0.5, "lib",
+       "Lease-supervisor heartbeat publish cadence (KV keys, per "
+       "worker).", "docs/resilience.md"),
+    _K("SQ_ELASTIC_LEASE_S", "float", 3.0, "lib",
+       "Lease length: a peer silent for one lease is declared dead.",
+       "docs/resilience.md"),
+    _K("SQ_ELASTIC_MAX_SHRINKS", "int", 1, "lib",
+       "Host-failure budget: shrinks tolerated before the fit aborts.",
+       "docs/resilience.md"),
+    _K("SQ_ELASTIC_WINDOW", "int", 4, "lib",
+       "Commit-window width in visit-order positions (atomic fold+commit "
+       "unit).", "docs/resilience.md"),
+    _K("SQ_ELASTIC_PORT", "int", 0, "lib",
+       "Coordination-service TCP port (0 = pick a free port per "
+       "generation).", "docs/resilience.md"),
     # -- serving plane (docs/serving.md) ---------------------------------
     _K("SQ_SERVE_MAX_WAIT_MS", "float", 2.0, "lib",
        "Micro-batch coalescing window.", "docs/serving.md"),

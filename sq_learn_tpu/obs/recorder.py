@@ -26,7 +26,7 @@ Design constraints, in order:
    arrays, so their spans are synced by construction.
 
 JSONL schema: one JSON object per line, every line carrying
-``{"v": 8, "schema_version": 8, "ts": <unix seconds>, "type": <record
+``{"v": 9, "schema_version": 9, "ts": <unix seconds>, "type": <record
 type>}`` plus per-type fields — see :mod:`sq_learn_tpu.obs.schema` (the
 validator) and ``docs/observability.md`` (the prose). ``v`` is the
 original envelope key (kept so pre-2 readers don't break);
@@ -64,7 +64,14 @@ from .. import _knobs
 #     sq_learn_tpu.serving.control), and the optional monotonic
 #     budget.seq / alert.seq fields (deterministic trace-export merge
 #     order when timestamps collide)
-SCHEMA_VERSION = 8
+# v9: +elastic record type (the elastic multi-host mesh, PR 18: one
+#     record per transition — world_up / resume / host_fail /
+#     host_stall / shrink / commit_refused / stale_exit / done — with
+#     generation, host counts, failed host, detection latency, shrink
+#     wall-clock and resumed cursor; sq_learn_tpu.parallel.elastic),
+#     and the host_fail / host_stall fault kinds' optional
+#     fault.host / fault.stall_s fields
+SCHEMA_VERSION = 9
 
 #: default sink path when SQ_OBS=1 and SQ_OBS_PATH is unset
 DEFAULT_PATH = "sq_obs.jsonl"
@@ -179,8 +186,8 @@ class Recorder:
     ``watchdog_events``, ``probe_events``, ``fault_events``,
     ``breaker_events``, ``xla_cost_records``, ``guarantee_records``,
     ``tradeoff_records``, ``slo_records``, ``budget_records``,
-    ``alert_records``, ``control_records`` — all plain Python
-    containers, safe to read at any point in the run.
+    ``alert_records``, ``control_records``, ``elastic_records`` — all
+    plain Python containers, safe to read at any point in the run.
     """
 
     def __init__(self, path=None):
@@ -200,6 +207,7 @@ class Recorder:
         self.budget_records = []
         self.alert_records = []
         self.control_records = []
+        self.elastic_records = []
         self._xla_seen = set()  # (site, signature) dedup for obs.xla
         self.path = path
         self._seq = 0
@@ -481,6 +489,17 @@ def snapshot():
         "control_actions": sum(
             1 for c in rec.control_records
             if c.get("action") not in (None, "plan", "hold")),
+        # elastic mesh (parallel.elastic, PR 18): transitions recorded,
+        # host failures declared, and the highest generation reached —
+        # a kill-mid-fit bench line's evidence that its wall-clock
+        # includes a real detect → shrink → resume cycle
+        "elastic_records": len(rec.elastic_records),
+        "elastic_host_failures": sum(
+            1 for e in rec.elastic_records
+            if e.get("event") == "host_fail"),
+        "elastic_generation": max(
+            (int(e["generation"]) for e in rec.elastic_records
+             if isinstance(e.get("generation"), int)), default=None),
     }
 
 

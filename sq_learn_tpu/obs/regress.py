@@ -58,7 +58,7 @@ import time
 from statistics import median
 from .. import _knobs
 
-SCHEMA_VERSION = 8  # keep in sync with recorder.SCHEMA_VERSION (no import:
+SCHEMA_VERSION = 9  # keep in sync with recorder.SCHEMA_VERSION (no import:
 # this module must stay loadable from a bare checkout for CI tooling)
 
 __all__ = ["load_history", "check_record", "check_file", "selftest", "main"]

@@ -35,7 +35,14 @@ plane's ``control`` type (one SLO-driven autotuner evaluation from
 the decision it took, and the predicted vs realized effect) plus the
 optional monotonic ``budget.seq`` / ``alert.seq`` fields (ledger-scoped
 counters making trace-export merge order deterministic when timestamps
-collide). Older versions
+collide); v9 (PR 18) adds the elastic-mesh ``elastic`` type (one
+multi-host world transition from
+:mod:`sq_learn_tpu.parallel.elastic` — world formation, resume,
+detected host failure/stall, generation-bumping shrink, refused
+stale-generation commit, stale-worker exit, completion) plus the
+``host_fail`` / ``host_stall`` fault kinds' optional ``fault.host`` /
+``fault.stall_s`` fields (which worker index the injector targeted,
+and the injected stall length). Older versions
 still validate (their types are a strict subset), any other version is
 rejected — an unknown version means a reader that would silently
 misinterpret fields, so it must fail loudly.
@@ -60,7 +67,11 @@ fault      kind (str), tile (int | null) — one injected fault from the
            ``SQ_FAULTS`` harness (:mod:`sq_learn_tpu.resilience.faults`);
            for the read-side kinds (``read_fail`` / ``read_stall`` /
            ``corrupt_shard`` / ``cold_tier``) ``tile`` carries the SHARD
-           index of the out-of-core store (:mod:`sq_learn_tpu.oocore`)
+           index of the out-of-core store (:mod:`sq_learn_tpu.oocore`);
+           for the elastic kinds (``host_fail`` / ``host_stall``, v9)
+           ``tile`` carries the fold-WINDOW index and the optional
+           host (int) / stall_s (number ≥ 0) name the targeted worker
+           and the injected stall
 breaker    state (str ∈ {closed, open, half_open}), prev (str),
            reason (str), consecutive (int ≥ 0) — one circuit-breaker
            transition (:mod:`sq_learn_tpu.resilience.supervisor`)
@@ -132,6 +143,13 @@ control    tenant (str), action (str ∈ {plan, hold, relax, tighten,
            expected effect), realized (object | null — the measured
            effect of the PREVIOUS decision, closing the loop),
            attrs (object)
+elastic    event (str ∈ {world_up, resume, host_fail, host_stall,
+           shrink, commit_refused, stale_exit, done}),
+           generation (int ≥ 0), n_hosts (int ≥ 0) — one elastic-mesh
+           world transition (:mod:`sq_learn_tpu.parallel.elastic`);
+           optional host / failed_host / cursor / window /
+           manifest_generation (int), detect_s / shrink_s / stall_s
+           (number ≥ 0), attrs (object)
 =========  ==============================================================
 
 The out-of-core layer (PR 8) rides the generic types rather than minting
@@ -161,8 +179,9 @@ _NUM = (int, float)
 #: guarantee/tradeoff; v3 = PR 5's, without slo; v4 = PR 9's, without
 #: slo.transfer_bytes; v5 = PR 11's, without budget/alert; v6 = PR 12's,
 #: without the codec/spill counter conventions; v7 = PR 13's, without
-#: control or the budget/alert seq fields)
-KNOWN_VERSIONS = {1, 2, 3, 4, 5, 6, 7, SCHEMA_VERSION}
+#: control or the budget/alert seq fields; v8 = PR 17's, without the
+#: elastic type or the fault.host/fault.stall_s fields)
+KNOWN_VERSIONS = {1, 2, 3, 4, 5, 6, 7, 8, SCHEMA_VERSION}
 
 #: every record type the schema defines, machine-readable. The static
 #: checker (:mod:`sq_learn_tpu.analysis`, rule ``obs-schema``) and the
@@ -171,8 +190,11 @@ KNOWN_VERSIONS = {1, 2, 3, 4, 5, 6, 7, SCHEMA_VERSION}
 RECORD_TYPES = (
     "meta", "span", "counter", "gauge", "ledger", "watchdog", "probe",
     "fault", "breaker", "xla_cost", "regression", "guarantee", "tradeoff",
-    "slo", "budget", "alert", "control",
+    "slo", "budget", "alert", "control", "elastic",
 )
+
+_ELASTIC_EVENTS = {"world_up", "resume", "host_fail", "host_stall",
+                   "shrink", "commit_refused", "stale_exit", "done"}
 
 _CONTROL_ACTIONS = {"plan", "hold", "relax", "tighten", "degrade",
                     "recover"}
@@ -267,6 +289,15 @@ def validate_record(rec):
         _check(isinstance(rec.get("kind"), str), errors, "fault.kind str")
         _check(rec.get("tile") is None or isinstance(rec["tile"], int),
                errors, "fault.tile int or null")
+        if "host" in rec:
+            _check(isinstance(rec["host"], int)
+                   and not isinstance(rec["host"], bool), errors,
+                   "fault.host int")
+        if "stall_s" in rec:
+            _check(isinstance(rec["stall_s"], _NUM)
+                   and not isinstance(rec["stall_s"], bool)
+                   and rec["stall_s"] >= 0, errors,
+                   "fault.stall_s non-negative number")
     elif t == "breaker":
         _check(rec.get("state") in _BREAKER_STATES, errors,
                f"breaker.state in {sorted(_BREAKER_STATES)}")
@@ -481,6 +512,29 @@ def validate_record(rec):
         if "site" in rec:
             _check(isinstance(rec["site"], str), errors,
                    "control.site str")
+    elif t == "elastic":
+        _check(rec.get("event") in _ELASTIC_EVENTS, errors,
+               f"elastic.event in {sorted(_ELASTIC_EVENTS)}")
+        for field in ("generation", "n_hosts"):
+            _check(isinstance(rec.get(field), int)
+                   and not isinstance(rec.get(field), bool)
+                   and rec.get(field, -1) >= 0, errors,
+                   f"elastic.{field} non-negative int")
+        for field in ("host", "failed_host", "cursor", "window",
+                      "manifest_generation"):
+            if field in rec:
+                _check(isinstance(rec[field], int)
+                       and not isinstance(rec[field], bool), errors,
+                       f"elastic.{field} int")
+        for field in ("detect_s", "shrink_s", "stall_s"):
+            if field in rec:
+                _check(isinstance(rec[field], _NUM)
+                       and not isinstance(rec[field], bool)
+                       and rec[field] >= 0, errors,
+                       f"elastic.{field} non-negative number")
+        if "attrs" in rec:
+            _check(isinstance(rec["attrs"], dict), errors,
+                   "elastic.attrs object")
     else:
         errors.append(
             f"unknown record type {t!r} (known: {sorted(RECORD_TYPES)})")

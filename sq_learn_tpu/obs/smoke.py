@@ -2,11 +2,13 @@
 
 ``make obs-smoke`` runs this module: a streamed qPCA Gram fit (streaming
 counters + retracing watchdog), a quantum top-k extraction (nonzero
-tomography shots in the ledger), and a tiny served tenant with a
+tomography shots in the ledger), a tiny served tenant with a
 declared SLO (per-tenant ``slo`` + error-budget ``budget`` records, plus
-the control plane's close-time ``control`` records, schema v8) under an
-active recorder, then validates the emitted JSONL against
-:mod:`sq_learn_tpu.obs.schema` (legacy v1–v7 records must keep
+the control plane's close-time ``control`` records), and a
+fault-injected shrink of the elastic mesh's in-process simulator
+(``elastic`` transition records + host-targeted ``fault`` records,
+schema v9) under an active recorder, then validates the emitted JSONL
+against :mod:`sq_learn_tpu.obs.schema` (legacy v1–v8 records must keep
 validating) and asserts the run artifact carries the signals the layer
 exists for. Exit code 0 = contract holds; 1 = schema or content
 violation (printed).
@@ -81,6 +83,25 @@ def main():
         sd.serve("smoke_tenant", "predict", X[: 4 + i])
     sd.close()
 
+    # v9 contract: a fault-injected shrink of the elastic mesh's
+    # in-process simulator lands the elastic transition records
+    # (world_up → host_fail → shrink → resume → done) and the fault
+    # records carry their host targets — the timeline of a survived
+    # host death is in the artifact, not just the return value
+    from ..oocore.store import ArraySource
+    from ..parallel import elastic
+    from ..resilience import faults
+
+    esrc = ArraySource(
+        np.asarray(rng.normal(size=(96, 5)), np.float64), shard_rows=8)
+    faults.arm("host_stall:window=0,host=1,times=1,s=0.0;"
+               "host_fail:window=1,host=2,times=1")
+    try:
+        eres = elastic.elastic_fit_local(esrc, 3, n_hosts=3, seed=0,
+                                         epochs=1, window=4)
+    finally:
+        faults.disarm()
+
     report = watchdog.report()
     totals = ledger.totals()
     audit = guarantees.audit()
@@ -148,6 +169,22 @@ def main():
     if not all(isinstance(r.get("seq"), int)
                for r in rec.budget_records):
         failures.append("a budget record landed without its emit seq")
+    # v9 contract: the elastic leg survived exactly one host death, the
+    # transition records landed schema-valid (validate_jsonl above saw
+    # them), and the injected faults carry their host targets
+    if eres["shrinks"] != 1 or eres["generation"] != 1:
+        failures.append(f"elastic sim did not shrink exactly once: "
+                        f"{eres['shrinks']}/{eres['generation']}")
+    e_events = [r.get("event") for r in rec.elastic_records]
+    for ev in ("world_up", "host_stall", "host_fail", "shrink",
+               "resume", "done"):
+        if ev not in e_events:
+            failures.append(f"no elastic {ev} record from the sim leg")
+    if not any(r.get("kind") in ("host_fail", "host_stall")
+               and isinstance(r.get("host"), int)
+               for r in rec.fault_events):
+        failures.append("no host-targeted fault records from the "
+                        "elastic leg")
     from .schema import validate_record
 
     legacy = [
@@ -165,6 +202,10 @@ def main():
         {"v": 7, "schema_version": 7, "ts": 0.0, "type": "alert",
          "tenant": "t", "kind": "slo_burn",
          "burn_rates": {"60": 2.5, "600": 2.1}, "threshold": 2.0},
+        # v8 (pre-elastic): the control plane's record type
+        {"v": 8, "schema_version": 8, "ts": 0.0, "type": "control",
+         "tenant": "t", "action": "hold", "seq": 0, "level": 0,
+         "inputs": {"burn": 0.1}, "decision": {"route": "device"}},
     ]
     for r_ in legacy:
         errs = validate_record(r_)

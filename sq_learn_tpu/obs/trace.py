@@ -49,6 +49,7 @@ _LANES = {
     "budget": (11, "error budgets"),
     "alert": (12, "budget alerts"),
     "control": (13, "controller decisions"),
+    "elastic": (14, "elastic mesh"),
 }
 
 #: records that move onto a per-tenant lane when they carry a tenant
@@ -139,6 +140,9 @@ def _instant_name(rec):
     if t == "control":
         return (f"control {rec.get('tenant')}:{rec.get('action')}"
                 f"@L{rec.get('level', 0)}")
+    if t == "elastic":
+        return (f"elastic {rec.get('event')} g{rec.get('generation')} "
+                f"n={rec.get('n_hosts')}")
     return t
 
 
@@ -204,17 +208,24 @@ def to_chrome_trace(record_groups):
                     "pid": pid, "tid": 0, "args": {"value": val},
                 })
             elif t in _LANES:
-                tenant = (rec.get("tenant") if t in _TENANT_TYPES
-                          else None)
-                if tenant is not None:
+                dyn = None  # label of a dynamically-allocated lane
+                if t in _TENANT_TYPES and rec.get("tenant") is not None:
                     # per-tenant lane: a tenant's slo windows, budget
                     # evaluations, and alerts read as one timeline
-                    key = (pid, str(tenant))
+                    dyn = f"tenant:{rec['tenant']}"
+                elif t == "elastic" \
+                        and isinstance(rec.get("generation"), int) \
+                        and not isinstance(rec.get("generation"), bool):
+                    # per-generation lane: each shrink's new world reads
+                    # as its own timeline (v9)
+                    dyn = f"elastic:g{rec['generation']}"
+                if dyn is not None:
+                    key = (pid, dyn)
                     tid = tenant_tids.get(key)
                     if tid is None:
                         tid = _TENANT_TID0 + len(tenant_tids)
                         tenant_tids[key] = tid
-                    name_lane(pid, tid, f"tenant:{tenant}")
+                    name_lane(pid, tid, dyn)
                 else:
                     tid, lane = _LANES[t]
                     name_lane(pid, tid, lane)

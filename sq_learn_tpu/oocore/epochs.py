@@ -46,6 +46,34 @@ class EpochPlan:
         rng = np.random.default_rng((self.seed, int(epoch), 0xE90C))
         return rng.permutation(source.n_shards)
 
+    def host_partition(self, source, epoch, n_hosts, host_id, *,
+                       start_pos=0):
+        """``(position, shard)`` pairs of this epoch's visit order owned
+        by ``host_id`` of an ``n_hosts`` world: position ``p`` of
+        :meth:`shard_order` belongs to host ``p % n_hosts``.
+
+        Ownership is a pure function of ``(seed, epoch, n_hosts)`` — no
+        state, no registry — so after an elastic shrink
+        (:mod:`sq_learn_tpu.parallel.elastic`) the survivors repartition
+        the SAME canonical visit order among fewer hosts
+        deterministically, and the union over hosts is always exactly
+        the canonical order: partitions are disjoint and complete by
+        construction. ``start_pos`` (the resumed cursor, a visit-order
+        position) excludes already-folded positions, so a resume never
+        re-reads — and never prefetches — a shard the committed state
+        already folded (spy-pinned in ``tests/test_oocore.py``)."""
+        n_hosts = int(n_hosts)
+        host_id = int(host_id)
+        if n_hosts < 1:
+            raise ValueError(f"n_hosts must be >= 1, got {n_hosts}")
+        if not 0 <= host_id < n_hosts:
+            raise ValueError(
+                f"host_id must be in [0, {n_hosts}), got {host_id}")
+        order = self.shard_order(source, epoch)
+        return [(p, int(order[p]))
+                for p in range(int(start_pos), len(order))
+                if p % n_hosts == host_id]
+
     def shard_perm(self, source, epoch, shard):
         rng = np.random.default_rng(
             (self.seed, int(epoch), int(shard), 0x5E0))

@@ -23,16 +23,106 @@ import jax
 from .mesh import DATA_AXIS
 from .. import _knobs
 
+#: this process's live world, if any. ``generation`` is the monotonic
+#: elastic-mesh epoch (bumped on every shrink); ``client``/``service``
+#: are only populated on the raw elastic path — the native
+#: ``jax.distributed`` path leaves them None and records the generation
+#: so re-init discipline is uniform across both paths.
+_WORLD = {"generation": None, "elastic": False, "client": None,
+          "service": None, "num_processes": None, "process_id": None,
+          "address": None}
+
+#: XLA's own missed-heartbeat machinery is deliberately parked far out of
+#: the way (interval x tolerance ~ 3 h): the coordination service must
+#: never declare a host dead on its own — a Python missed-heartbeat
+#: callback is invoked off-thread by XLA and dies in std::bad_cast
+#: (observed std::terminate), and the default callback QFATALs the
+#: survivors. Failure detection belongs to the lease layer in
+#: :mod:`sq_learn_tpu.parallel.elastic`, which owns the timeline.
+_HEARTBEAT_S = 10
+_MAX_MISSED_HEARTBEATS = 1000
+
+
+#: raw clients retired by :func:`shutdown` / a refused handshake — kept
+#: alive FOREVER, on purpose (see :func:`_retire_client`).
+_CLIENT_GRAVEYARD = []
+
+
+def _retire_client(client):
+    """Park a retired raw client instead of ever destroying it.
+
+    A client whose peer vanished WITHOUT disconnecting (SIGKILL, or
+    ``os._exit`` after a generation-mismatch refusal) blocks its C++
+    destructor on the coordination service *indefinitely* — the
+    service never evicts the ghost peer (heartbeat detection is parked,
+    above), so whichever thread drops the last reference hangs, not
+    cleans up (observed: the mismatch-refusal survivor wedged in
+    ``del client`` for minutes). Holding the reference here means the
+    destructor simply never runs: the leak is deliberate and bounded
+    (one client per world generation, generations are bounded by the
+    shrink budget), the parked heartbeat loop fails quietly for ~3 h
+    before XLA's machinery would care, and worker processes exit via
+    ``os._exit`` so no leaked destructor ever races interpreter
+    teardown."""
+    if client is not None:
+        _CLIENT_GRAVEYARD.append(client)
+
+
+class GenerationMismatchError(RuntimeError):
+    """A worker tried to join a world whose agreed generation differs
+    from its own — the stale-worker shape that would otherwise present
+    as a silent gloo hang at the first collective."""
+
+
+def _xla_extension():
+    try:
+        from jax._src.lib import xla_extension as xe
+    except ImportError:  # pragma: no cover - jaxlib layout drift
+        from jaxlib import xla_extension as xe
+    return xe
+
+
+def start_coordinator_service(address, num_processes):
+    """Start the distributed KV/coordination service in THIS process and
+    return its handle (keep it referenced for the life of the world; let
+    it be garbage-collected only after every client is gone — destroying
+    it under live client poll threads QFATALs them).
+
+    The elastic coordinator (:class:`sq_learn_tpu.parallel.elastic.
+    ElasticCoordinator`) hosts one service per generation in the parent
+    process — OUTSIDE the mesh — so any worker, including node 0, may
+    die without taking the control plane with it."""
+    xe = _xla_extension()
+    return xe.get_distributed_runtime_service(
+        address, num_nodes=int(num_processes),
+        heartbeat_interval=_HEARTBEAT_S,
+        max_missing_heartbeats=_MAX_MISSED_HEARTBEATS)
+
 
 def initialize(coordinator_address=None, num_processes=None, process_id=None,
-               **kwargs):
+               *, generation=None, elastic=False, **kwargs):
     """Initialize :mod:`jax.distributed` for multi-host execution.
 
     On TPU pods every argument auto-detects from the environment; on other
     platforms pass the coordinator host:port and process indices. Safe to
-    call once per process, before any backend use. No-op if the runtime is
-    already initialized (re-initialization raises in JAX; this wrapper
-    makes idempotent use possible in launcher scripts).
+    call once per process, before any backend use. Re-calling with the
+    SAME ``generation`` (or with no generation at all — the legacy
+    launcher-script contract) is an idempotent no-op; re-calling with a
+    DIFFERENT generation while a world is live raises — call
+    :func:`shutdown` first. That replaces the old wrapper's silent
+    swallow of "already initialized", which let a stale-generation worker
+    limp into a mixed-generation world and hang in gloo.
+
+    ``elastic=True`` takes the raw-client path: instead of
+    ``jax.distributed.initialize`` (whose client is process-global and
+    cannot be re-created), it builds the pybind distributed-runtime
+    client directly, connects it to a coordinator service hosted
+    elsewhere (see :func:`start_coordinator_service`), and installs it
+    into jax's global state — the only route that supports tearing a
+    world down and re-forming a smaller one in the same process. The
+    joining worker then runs a generation handshake through the KV store
+    and refuses a mixed-generation world with
+    :class:`GenerationMismatchError` instead of a hang.
 
     Multi-process runs on the **CPU backend** (the hardware-free DCN
     rehearsal, ``tests/test_distributed_multiprocess.py``) additionally
@@ -53,6 +143,23 @@ def initialize(coordinator_address=None, num_processes=None, process_id=None,
             n_proc = 0
     if n_proc and int(n_proc) > 1:
         _select_cpu_collectives("gloo")
+    if _WORLD["generation"] is not None:
+        if generation is None or generation == _WORLD["generation"]:
+            return
+        raise RuntimeError(
+            f"a generation-{_WORLD['generation']} world is live in this "
+            f"process; call shutdown() before re-initializing as "
+            f"generation {generation}")
+    if elastic:
+        if (coordinator_address is None or num_processes is None
+                or process_id is None or generation is None):
+            raise ValueError(
+                "elastic initialize needs explicit coordinator_address, "
+                "num_processes, process_id and generation")
+        _init_elastic(coordinator_address, int(num_processes),
+                      int(process_id), int(generation),
+                      init_timeout=kwargs.pop("init_timeout", 30))
+        return
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
@@ -63,6 +170,103 @@ def initialize(coordinator_address=None, num_processes=None, process_id=None,
         msg = str(exc)
         if "only be called once" not in msg and "already initialized" not in msg:
             raise
+    _WORLD.update(generation=generation if generation is not None else 0,
+                  elastic=False, client=None, service=None,
+                  num_processes=num_processes, process_id=process_id,
+                  address=coordinator_address)
+
+
+def _init_elastic(address, num_processes, process_id, generation,
+                  init_timeout=30):
+    """Form (or join) one generation of an elastic world: raw pybind
+    client -> connect -> install into jax global state -> generation
+    handshake. On handshake mismatch the half-joined client is torn down
+    before raising, so the process can go on to join the right world."""
+    xe = _xla_extension()
+    from jax._src import distributed as _jdist
+
+    client = xe.get_distributed_runtime_client(
+        address, node_id=process_id, heartbeat_interval=_HEARTBEAT_S,
+        max_missing_heartbeats=_MAX_MISSED_HEARTBEATS,
+        shutdown_on_destruction=False, init_timeout=int(init_timeout))
+    client.connect()
+    gen_key = "elastic/generation"
+    try:
+        client.key_value_set(gen_key, str(int(generation)))
+    except Exception:
+        pass  # a peer set it first; the get below arbitrates
+    agreed = int(client.blocking_key_value_get(
+        gen_key, int(init_timeout) * 1000))
+    if agreed != int(generation):
+        _retire_client(client)
+        del client
+        raise GenerationMismatchError(
+            f"this worker carries generation {generation} but the world "
+            f"at {address} agreed on generation {agreed}; refusing to "
+            f"join (a stale worker in a live mesh hangs gloo)")
+    st = _jdist.global_state
+    st.client = client
+    st.process_id = int(process_id)
+    st.num_processes = int(num_processes)
+    st.coordinator_address = address
+    _WORLD.update(generation=int(generation), elastic=True, client=client,
+                  service=None, num_processes=int(num_processes),
+                  process_id=int(process_id), address=address)
+
+
+def shutdown(*, barrier=True):
+    """Tear down this process's world so a new generation can form.
+
+    ``barrier=True`` (the orderly path) rendezvouses the survivors at a
+    named KV barrier before dropping the client, so no peer's in-flight
+    KV call sees the world half-gone; the abort path
+    (``barrier=False``, taken after a detected host failure — the dead
+    peer can never reach a barrier) drops straight away. Either way the
+    XLA backend caches are cleared: the old world's CPU client pinned
+    the gloo topology at creation, and the next :func:`initialize` must
+    mint a fresh one."""
+    if _WORLD["generation"] is None:
+        return
+    client = _WORLD["client"]
+    if _WORLD["elastic"]:
+        if client is not None and barrier:
+            try:
+                client.wait_at_barrier(
+                    f"elastic/shutdown/g{_WORLD['generation']}", 10_000)
+            except Exception:
+                pass  # a dead peer never reaches the barrier
+        from jax._src import distributed as _jdist
+
+        st = _jdist.global_state
+        st.client = None
+        st.process_id = None
+        st.num_processes = None
+        st.coordinator_address = None
+    else:
+        try:
+            jax.distributed.shutdown()
+        except Exception:
+            pass
+    _WORLD.update(generation=None, elastic=False, client=None,
+                  service=None, num_processes=None, process_id=None,
+                  address=None)
+    _retire_client(client)
+    del client
+    # plain `jax.extend.backend` attribute access raises on jax 0.4.x —
+    # import the submodule explicitly
+    __import__("jax.extend.backend",
+               fromlist=["clear_backends"]).clear_backends()
+
+
+def generation():
+    """The live world's generation, or None when no world is up."""
+    return _WORLD["generation"]
+
+
+def world_client():
+    """The raw distributed-runtime client of the live elastic world (its
+    KV store is the elastic control plane's transport), or None."""
+    return _WORLD["client"]
 
 
 def _select_cpu_collectives(impl):

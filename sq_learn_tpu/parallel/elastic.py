@@ -1,0 +1,1037 @@
+"""Elastic multi-host training plane: survive a host death mid-fit.
+
+ROADMAP item 2. The single-process half of the durability story already
+exists — breaker (PR 3), bit-for-bit stream/epoch resume (PR 8),
+SIGKILL-survivable fits — but the training plane itself ran on one
+process with virtual devices: a dead host meant a dead fit. This module
+makes host failure a *handled, observable, resumable* event:
+
+- an :class:`ElasticCoordinator` (parent process, OUTSIDE the mesh)
+  launches N workers and hosts one distributed KV/coordination service
+  per **generation** (:func:`sq_learn_tpu.parallel.distributed.
+  start_coordinator_service`) — any worker, including node 0, may die
+  without taking the control plane with it;
+- each worker joins via the raw-client path of
+  :func:`~sq_learn_tpu.parallel.distributed.initialize`
+  (``elastic=True``), certifies the mesh by running the existing
+  shard_map Lloyd kernel across it, and publishes **heartbeats** to the
+  KV store from a :class:`LeaseSupervisor` thread;
+- the fit itself is the **window-synchronous q-means fold** (below):
+  host failure is detected when a peer's window partial never lands
+  inside its lease, the survivors abort the generation, the coordinator
+  re-forms an (N-1)-world on a fresh port with a bumped generation, and
+  the fit resumes from the committed checkpoint — **bit-for-bit equal**
+  to an uninterrupted (N-1)-host run of the same plan;
+- every transition lands a schema-v9 ``elastic`` obs record
+  (generation, failed host, detection latency, shrink wall-clock,
+  resumed cursor) with a per-generation trace lane.
+
+Topology-invariant state (the parity argument)
+----------------------------------------------
+One epoch visits the shards in the canonical order of
+:meth:`~sq_learn_tpu.oocore.epochs.EpochPlan.shard_order`; position
+``p`` of that order is *owned* by host ``p % n_hosts``
+(:meth:`~sq_learn_tpu.oocore.epochs.EpochPlan.host_partition`). Work
+advances in **windows** of ``SQ_ELASTIC_WINDOW`` consecutive positions:
+at a window boundary every host holds identical state; each host
+computes, for its owned positions only, the shard's minibatch partial
+(cluster counts / sums / inertia, all float64) **against the centers
+frozen at the window start**; partials are exchanged through the KV
+store; then every host folds ALL of the window's partials in canonical
+position order. The folded state is therefore a pure function of
+``(data, seed, k, epochs, window)`` — ownership decides only *who
+computes* a partial, never its value or its fold position — so a fit
+that shrinks from N to N-1 hosts mid-run lands on exactly the bytes an
+uninterrupted N-1-host (or 1-host) run produces. The in-process
+:func:`elastic_fit_local` simulator shares this core and is the parity
+reference the smoke/bench assert against.
+
+Failure model
+-------------
+Worker death (SIGKILL, injected ``host_fail``) and worker stall
+(``host_stall``) are handled for ANY worker; windows are atomic (a
+window folds only when every partial landed, so a death voids the
+in-flight window and the next generation recomputes it from the frozen
+state — zero shards lost or double-folded, pinned by the per-shard
+``folds`` counter carried in the state). Death of the *coordinator
+process* (which holds the KV services and the run manifest) is
+restart-the-world territory, out of scope here: it is the analogue of
+losing the TPU pod's coordinator VM.
+
+Generations and commits
+-----------------------
+The run directory's newest ``manifest.g<G>.json`` names the live
+generation, its service port, and its surviving members. Checkpoints
+commit under :func:`commit_fingerprint` — the topology-free base
+fingerprint plus ``|gen=G`` — and only node 0 of the live generation
+commits, after re-reading the manifest: a stale-generation worker gets
+:class:`StaleGenerationError` (and a ``commit_refused`` record), never
+a silent overwrite. Resume tries generations newest-first, so a
+survivor of generation G loads the last commit of G or any ancestor.
+"""
+
+import io
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+
+from .. import _knobs
+from ..obs import recorder as _recorder
+from ..oocore.epochs import EpochPlan
+from ..resilience import faults as _faults
+
+__all__ = [
+    "ElasticCoordinator",
+    "ElasticError",
+    "GenerationAbort",
+    "HostFailure",
+    "LeaseSupervisor",
+    "StaleGenerationError",
+    "base_fingerprint",
+    "collect_elastic_records",
+    "commit_fingerprint",
+    "elastic_fit_local",
+    "fold_partial",
+    "init_centers",
+    "load_state",
+    "new_state",
+    "shard_partial",
+]
+
+_FMT = "elastic-qkm-v1"
+
+#: worker exit codes: a stale worker (excluded from the new generation)
+#: exits STALE without committing anything; an injected ``host_fail``
+#: exits INJECTED so logs distinguish the scripted death from a crash
+EXIT_OK, EXIT_STALE, EXIT_INJECTED = 0, 3, 17
+
+#: the ``elastic`` obs record's event vocabulary (schema v9)
+EVENTS = ("world_up", "resume", "host_fail", "host_stall", "shrink",
+          "commit_refused", "stale_exit", "done")
+
+
+class ElasticError(RuntimeError):
+    """Base of the elastic plane's failures."""
+
+
+class HostFailure(ElasticError):
+    """A host died and the shrink budget (``SQ_ELASTIC_MAX_SHRINKS``)
+    is exhausted — the run cannot continue."""
+
+
+class GenerationAbort(ElasticError):
+    """Internal control flow: this generation's world is dead; tear
+    down and re-join the next one."""
+
+
+class StaleGenerationError(ElasticError):
+    """A worker of a superseded generation tried to commit."""
+
+
+def _heartbeat_s():
+    return _knobs.get_float("SQ_ELASTIC_HEARTBEAT_S")
+
+
+def _lease_s():
+    return _knobs.get_float("SQ_ELASTIC_LEASE_S")
+
+
+def _max_shrinks():
+    return _knobs.get_int("SQ_ELASTIC_MAX_SHRINKS")
+
+
+def _default_window():
+    return max(1, _knobs.get_int("SQ_ELASTIC_WINDOW"))
+
+
+def _emit(event, generation, n_hosts, **fields):
+    rec = _recorder.get_recorder()
+    if rec is None:
+        return
+    rec.record(dict({"type": "elastic", "event": str(event),
+                     "generation": int(generation),
+                     "n_hosts": int(n_hosts)}, **fields),
+               kind="elastic_records")
+
+
+# ---------------------------------------------------------------------------
+# pure fold-window core (numpy-only, bitwise deterministic: no BLAS in
+# the distance/fold path — reductions are numpy's own, so two processes
+# computing the same partial produce the same bytes)
+# ---------------------------------------------------------------------------
+
+
+def base_fingerprint(source, n_clusters, seed, epochs, window):
+    """Topology-free identity of the fit: data content + plan. Host
+    count is deliberately absent — the whole point is that a shrunk
+    world resumes the SAME pass."""
+    return (f"{_FMT}|data={source.fingerprint}|shards={source.n_shards}"
+            f"|k={int(n_clusters)}|seed={int(seed)}|epochs={int(epochs)}"
+            f"|window={int(window)}")
+
+
+def commit_fingerprint(base, generation):
+    """The checkpoint fingerprint a generation commits under: stale
+    generations fail the fingerprint match instead of resuming the
+    wrong world's pass."""
+    return f"{base}|gen={int(generation)}"
+
+
+def init_centers(source, n_clusters, seed):
+    """Deterministic k distinct seed rows (keyed RNG, sorted for read
+    locality)."""
+    rng = np.random.default_rng((int(seed), 0xE1A5))
+    rows = np.sort(rng.choice(len(source), size=int(n_clusters),
+                              replace=False))
+    return np.asarray(source.take(rows), np.float64)
+
+
+def new_state(n_clusters, n_features, n_shards, centers):
+    """The fold state pytree: centers/counts/inertia plus the per-shard
+    ``folds`` counter — the ledger that lets the end of the fit assert
+    every shard folded exactly ``epochs`` times (zero lost, zero
+    double-folded)."""
+    return {"centers": np.array(centers, np.float64).reshape(
+                int(n_clusters), int(n_features)),
+            "counts": np.zeros(int(n_clusters), np.float64),
+            "folds": np.zeros(int(n_shards), np.int64),
+            "inertia": np.zeros((), np.float64)}
+
+
+def shard_partial(centers, X):
+    """One shard's minibatch partial against frozen ``centers``:
+    ``(counts, sums, inertia)`` in float64. Chunked broadcast distances
+    + ``np.add.at`` scatter — no matmul, so the result is bitwise
+    reproducible across processes regardless of BLAS threading."""
+    X = np.asarray(X, np.float64)
+    k = centers.shape[0]
+    counts = np.zeros(k, np.float64)
+    sums = np.zeros_like(centers)
+    inertia = 0.0
+    for lo in range(0, X.shape[0], 1024):
+        blk = X[lo:lo + 1024]
+        d2 = ((blk[:, None, :] - centers[None, :, :]) ** 2).sum(axis=2)
+        lab = np.argmin(d2, axis=1)
+        counts += np.bincount(lab, minlength=k).astype(np.float64)
+        np.add.at(sums, lab, blk)
+        inertia += float(d2[np.arange(blk.shape[0]), lab].sum())
+    return counts, sums, inertia
+
+
+def fold_partial(state, shard, partial):
+    """Fold one position's partial into the state (the minibatch
+    k-means center update, reference Utility.py's incremental mean kept
+    in float64). MUST be called in canonical position order — that is
+    what makes the state topology-invariant."""
+    counts_p, sums_p, inertia_p = partial
+    counts_p = np.asarray(counts_p, np.float64)
+    sums_p = np.asarray(sums_p, np.float64)
+    C = state["centers"]
+    newv = state["counts"] + counts_p
+    nz = counts_p > 0
+    C[nz] += (sums_p[nz] - counts_p[nz, None] * C[nz]) / newv[nz, None]
+    state["counts"] = newv
+    state["inertia"] = state["inertia"] + np.float64(inertia_p)
+    state["folds"][int(shard)] += 1
+
+
+def load_state(path, template, base, generation):
+    """Resume from the newest usable commit: try ``generation`` down to
+    0 (a survivor of generation G accepts its own or any ancestor's
+    commit; a FUTURE generation's commit never matches, so a stale
+    worker cannot resume past its world). Returns ``(state, cursor)``
+    or None."""
+    from ..utils.checkpoint import load_stream_state
+
+    if path is None:
+        return None
+    for g in range(int(generation), -1, -1):
+        out = load_stream_state(path, template, commit_fingerprint(base, g))
+        if out is not None:
+            state, cursor = out
+            return ({k: np.array(v) for k, v in state.items()}, int(cursor))
+    return None
+
+
+def _window_index(epoch, w_lo, n_shards, window):
+    return int(epoch) * (-(-int(n_shards) // int(window))) \
+        + int(w_lo) // int(window)
+
+
+# ---------------------------------------------------------------------------
+# in-process simulator (the deterministic parity reference + test rig)
+# ---------------------------------------------------------------------------
+
+
+def elastic_fit_local(source, n_clusters, *, n_hosts=1, seed=0, epochs=1,
+                      window=None, ckpt_path=None, generation=0,
+                      max_shrinks=None):
+    """Run the window-synchronous fold with ``n_hosts`` *logical* hosts
+    in one process. Shares the exact pure core the real workers run —
+    and because the state is topology-invariant, its result for ANY
+    ``n_hosts`` is the bit-parity reference for a real multi-process
+    run (interrupted or not) of the same plan.
+
+    Armed ``host_fail``/``host_stall`` faults fire through
+    :meth:`~sq_learn_tpu.resilience.faults.FaultPlan.host_event` at
+    each window boundary (hosts queried in id order): a fail removes
+    the host, bumps the generation, and recomputes the voided window
+    with the survivors; a stall is recorded and the fit continues —
+    both without any real process or clock, which is what makes the
+    test matrix deterministic and fast."""
+    W = int(window) if window else _default_window()
+    budget = _max_shrinks() if max_shrinks is None else int(max_shrinks)
+    plan = EpochPlan(seed=seed)
+    k, m = int(n_clusters), int(source.shape[1])
+    n_shards = int(source.n_shards)
+    base = base_fingerprint(source, k, seed, epochs, W)
+    template = new_state(k, m, n_shards, np.zeros((k, m)))
+    gen = int(generation)
+    loaded = load_state(ckpt_path, template, base, gen) if ckpt_path \
+        else None
+    if loaded is not None:
+        state, cursor = loaded
+    else:
+        state, cursor = new_state(k, m, n_shards,
+                                  init_centers(source, k, seed)), 0
+    hosts = list(range(int(n_hosts)))
+    _emit("world_up", gen, len(hosts))
+    _emit("resume", gen, len(hosts), cursor=int(cursor))
+    total = int(epochs) * n_shards
+    shrinks = 0
+    while cursor < total:
+        epoch, pos = divmod(cursor, n_shards)
+        order = plan.shard_order(source, epoch)
+        w_lo, w_hi = pos, min(pos + W, n_shards)
+        w_idx = _window_index(epoch, w_lo, n_shards, W)
+        fplan = _faults._active
+        dead = None
+        if fplan is not None:
+            for h in hosts:
+                ev = fplan.host_event(w_idx, h)
+                if ev is not None and ev[0] == "fail":
+                    dead = h
+                    break
+                if ev is not None and ev[0] == "stall":
+                    _emit("host_stall", gen, len(hosts), failed_host=h,
+                          window=w_idx, stall_s=float(ev[1]))
+        if dead is not None:
+            _emit("host_fail", gen, len(hosts), failed_host=dead,
+                  window=w_idx, detect_s=0.0)
+            if shrinks >= budget or len(hosts) <= 1:
+                raise HostFailure(
+                    f"host {dead} failed at window {w_idx} with the "
+                    f"shrink budget exhausted ({shrinks}/{budget})")
+            hosts.remove(dead)
+            shrinks += 1
+            gen += 1
+            _emit("shrink", gen, len(hosts), failed_host=dead,
+                  shrink_s=0.0)
+            _emit("world_up", gen, len(hosts))
+            _emit("resume", gen, len(hosts), cursor=int(cursor))
+            continue  # the voided window recomputes under the new world
+        partials = {}
+        for rank in range(len(hosts)):
+            for p, s in plan.host_partition(source, epoch, len(hosts),
+                                            rank, start_pos=w_lo):
+                if p >= w_hi:
+                    break
+                partials[p] = shard_partial(state["centers"],
+                                            source.read_shard(s))
+        for p in range(w_lo, w_hi):
+            fold_partial(state, int(order[p]), partials[p])
+        cursor = epoch * n_shards + w_hi
+        if ckpt_path:
+            from ..utils.checkpoint import save_stream_state
+
+            save_stream_state(ckpt_path, state, cursor,
+                              commit_fingerprint(base, gen))
+    assert (state["folds"] == int(epochs)).all(), state["folds"]
+    _emit("done", gen, len(hosts), cursor=int(cursor))
+    return {"centers": state["centers"], "counts": state["counts"],
+            "inertia": float(state["inertia"]), "folds": state["folds"],
+            "generation": gen, "n_hosts": len(hosts), "shrinks": shrinks}
+
+
+# ---------------------------------------------------------------------------
+# real transport: KV exchange, leases, worker runtime, coordinator
+# ---------------------------------------------------------------------------
+
+
+def _kv_put_bytes(client, key, payload):
+    if hasattr(client, "key_value_set_bytes"):
+        client.key_value_set_bytes(key, payload)
+        return
+    import base64
+
+    client.key_value_set(key, base64.b64encode(payload).decode("ascii"))
+
+
+def _kv_get_bytes(client, key, timeout_ms):
+    if hasattr(client, "blocking_key_value_get_bytes"):
+        return client.blocking_key_value_get_bytes(key, int(timeout_ms))
+    import base64
+
+    return base64.b64decode(client.blocking_key_value_get(
+        key, int(timeout_ms)))
+
+
+def _pack_partial(counts, sums, inertia):
+    buf = io.BytesIO()
+    np.savez(buf, c=np.asarray(counts, np.float64),
+             s=np.asarray(sums, np.float64), i=np.float64(inertia))
+    return buf.getvalue()
+
+
+def _unpack_partial(raw):
+    with np.load(io.BytesIO(raw), allow_pickle=False) as npz:
+        return (np.array(npz["c"]), np.array(npz["s"]),
+                float(npz["i"]))
+
+
+def _write_json_atomic(path, payload):
+    tmp = str(path) + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(payload, fh)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, str(path))
+
+
+def _read_manifest(run_dir):
+    """The newest ``manifest.g<G>.json`` of the run, or None."""
+    best = None
+    for name in os.listdir(run_dir):
+        if name.startswith("manifest.g") and name.endswith(".json"):
+            try:
+                g = int(name[len("manifest.g"):-len(".json")])
+            except ValueError:
+                continue
+            if best is None or g > best[0]:
+                best = (g, name)
+    if best is None:
+        return None
+    try:
+        with open(os.path.join(run_dir, best[1])) as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None  # racing the coordinator's atomic replace
+
+
+def _await_manifest(run_dir, min_generation, timeout_s=120.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout_s:
+        man = _read_manifest(run_dir)
+        if man is not None and int(man["generation"]) >= int(min_generation):
+            return man
+        time.sleep(0.05)
+    raise ElasticError(
+        f"no generation >= {min_generation} manifest appeared in "
+        f"{run_dir} within {timeout_s}s")
+
+
+def check_commit_generation(run_dir, generation):
+    """The commit guard: re-read the run manifest and refuse to commit
+    from a superseded generation (``commit_refused`` record +
+    :class:`StaleGenerationError`) — a stale worker can never clobber
+    the live world's checkpoint."""
+    man = _read_manifest(run_dir)
+    live = None if man is None else int(man["generation"])
+    if live != int(generation):
+        _emit("commit_refused", int(generation), 0,
+              manifest_generation=live)
+        raise StaleGenerationError(
+            f"worker of generation {generation} refusing to commit: the "
+            f"run manifest is at generation {live}")
+
+
+class LeaseSupervisor:
+    """Heartbeat publisher + peer-lease arbiter of one worker.
+
+    A daemon thread publishes sequence-numbered heartbeat keys
+    (``elastic/g<G>/hb/<worker>/<seq>``) every ``SQ_ELASTIC_HEARTBEAT_S``
+    seconds; :meth:`peer_alive` blocks on a peer's NEXT sequence number
+    for one ``SQ_ELASTIC_LEASE_S`` lease — a timeout is the lease
+    expiring, i.e. the peer is declared dead. XLA's own
+    missed-heartbeat machinery is parked out of the way (see
+    :mod:`.distributed`); this layer owns the failure timeline and
+    feeds the PR 3 circuit breaker at every declaration."""
+
+    #: lock-discipline contract (``sq_learn_tpu.analysis``): the
+    #: publisher thread and the fit thread share only these, written
+    #: under the lock.
+    _GUARDED_BY = {"_lock": ("_stop", "_seq")}
+
+    def __init__(self, client, generation, host_id, heartbeat_s=None):
+        self._client = client
+        self._gen = int(generation)
+        self._host = int(host_id)
+        self._hb_s = float(heartbeat_s if heartbeat_s is not None
+                           else _heartbeat_s())
+        self._lock = threading.Lock()
+        self._stop = False
+        self._seq = 0
+        self._last_seen = {}  # fit-thread-only: peer -> last seen seq
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"sq-elastic-lease-w{self._host}")
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def _run(self):
+        while True:
+            with self._lock:
+                if self._stop:
+                    return
+                self._seq += 1
+                seq = self._seq
+            try:
+                self._client.key_value_set(
+                    f"elastic/g{self._gen}/hb/{self._host}/{seq}", "1")
+            except Exception:
+                return  # world tearing down: never crash the fit thread
+            time.sleep(self._hb_s)
+
+    def stop(self):
+        with self._lock:
+            self._stop = True
+
+    def peer_alive(self, peer, lease_s=None):
+        """Block until ``peer`` publishes a FRESH heartbeat or the lease
+        expires. True = alive (late-but-publishing peers are stalls, not
+        deaths); False = the lease expired.
+
+        Already-published heartbeats are drained first with a tiny
+        timeout — catch-up over a dead peer's backlog is not liveness,
+        and without the drain a peer that heartbeat for a while before
+        dying would look alive for backlog x lease (observed 31 s
+        detection at a 1.5 s lease). Liveness is only the NEXT key,
+        the one the peer must still be running to publish."""
+        lz = float(lease_s if lease_s is not None else _lease_s())
+        peer = int(peer)
+        nxt = self._last_seen.get(peer, 0) + 1
+        while True:
+            key = f"elastic/g{self._gen}/hb/{peer}/{nxt}"
+            try:
+                self._client.blocking_key_value_get(key, 50)
+            except Exception:
+                break  # frontier found: key nxt does not exist yet
+            self._last_seen[peer] = nxt
+            nxt += 1
+        key = f"elastic/g{self._gen}/hb/{peer}/{nxt}"
+        try:
+            self._client.blocking_key_value_get(key, max(1, int(lz * 1000)))
+        except Exception:
+            return False
+        self._last_seen[peer] = nxt
+        return True
+
+
+def _write_failure_file(run_dir, generation, failed, by, detect_s):
+    path = os.path.join(run_dir, f"failed.g{int(generation)}.w{int(failed)}"
+                                 ".json")
+    try:
+        with open(path, "x") as fh:
+            json.dump({"generation": int(generation), "failed": int(failed),
+                       "by": int(by), "detect_s": float(detect_s)}, fh)
+    except FileExistsError:
+        pass  # both survivors detected; first writer wins
+
+
+def _await_partial(client, lease, key, peer, lease_s, *, run_dir, gen,
+                   n_hosts, worker, stall_budget=20):
+    """Wait for a peer's window partial under the lease protocol: a KV
+    timeout with the peer still heartbeating is a ``host_stall`` (keep
+    waiting, bounded); a timeout with the lease expired is a
+    ``host_fail`` — record it, feed the breaker, leave the failure file
+    for the coordinator, abort the generation."""
+    from ..resilience.supervisor import breaker
+
+    t0 = time.monotonic()
+    stalls = 0
+    while True:
+        try:
+            return _kv_get_bytes(client, key,
+                                 max(1, int(float(lease_s) * 1000)))
+        except Exception:
+            pass
+        if lease.peer_alive(peer, lease_s) and stalls < stall_budget:
+            stalls += 1
+            if stalls == 1:
+                _emit("host_stall", gen, n_hosts, host=int(worker),
+                      failed_host=int(peer))
+                breaker.record_failure("elastic_host_stall",
+                                       site=f"elastic.g{gen}.w{peer}")
+            continue
+        detect_s = time.monotonic() - t0
+        _emit("host_fail", gen, n_hosts, host=int(worker),
+              failed_host=int(peer), detect_s=round(detect_s, 6))
+        breaker.record_failure("elastic_host_fail",
+                               site=f"elastic.g{gen}.w{peer}")
+        _write_failure_file(run_dir, gen, peer, worker, detect_s)
+        raise GenerationAbort(
+            f"host {peer} lease expired after {detect_s:.3f}s waiting "
+            f"for {key}")
+
+
+def _certify_world(mesh, seed, generation):
+    """Run the existing shard_map Lloyd kernel across the fresh world —
+    the mesh is certified by a real cross-host collective, not a
+    handshake. Deterministic tiny problem keyed on (seed, generation)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from . import distributed as dist
+    from .lloyd import lloyd_single_sharded
+    from .mesh import DATA_AXIS
+
+    n_dev = int(mesh.devices.size)
+    rows, m = 4 * n_dev, 5
+    rng = np.random.default_rng((int(seed), int(generation), 0xCE27))
+    X = rng.normal(size=(rows, m)).astype(np.float32)
+    lo, hi, per = dist.host_shard_bounds(rows)
+    shard = np.zeros((per, m), np.float32)
+    shard[:hi - lo] = X[lo:hi]
+    w = np.zeros((per,), np.float32)
+    w[:hi - lo] = 1.0
+    sharding = NamedSharding(mesh, P(DATA_AXIS))
+    Xg = jax.make_array_from_process_local_data(sharding, shard)
+    wg = jax.make_array_from_process_local_data(sharding, w)
+    xsqg = jax.make_array_from_process_local_data(
+        sharding, (shard * shard).sum(axis=1))
+    _, inertia, centers, n_iter, _ = lloyd_single_sharded(
+        mesh, jax.random.PRNGKey(0), Xg, wg, X[:3], xsqg,
+        delta=0.4, mode="delta", max_iter=2, tol=0.0)
+    if not np.isfinite(float(inertia)):
+        raise ElasticError(
+            f"mesh certification produced non-finite inertia at "
+            f"generation {generation}")
+    return float(inertia)
+
+
+# ---------------------------------------------------------------------------
+# worker runtime
+# ---------------------------------------------------------------------------
+
+
+def _flush_obs():
+    rec = _recorder.get_recorder()
+    if rec is not None:
+        flush = getattr(rec, "flush", None)
+        if callable(flush):
+            flush()
+
+
+def _run_generation(run_dir, source, plan, state, cursor, *, gen, members,
+                    node_id, worker_index, client, lease, cfg, base, ckpt):
+    """One generation's share of the fit: window loop from ``cursor``
+    until done or :class:`GenerationAbort`. Returns the final cursor."""
+    from ..oocore.prefetch import iter_shards
+    from ..utils.checkpoint import save_stream_state
+
+    n = len(members)
+    n_shards = int(source.n_shards)
+    W = int(cfg["window"])
+    epochs = int(cfg["epochs"])
+    lz_s = float(cfg["lease_s"])
+    total = epochs * n_shards
+    while cursor < total:
+        epoch, pos = divmod(cursor, n_shards)
+        order = plan.shard_order(source, epoch)
+        w_lo, w_hi = pos, min(pos + W, n_shards)
+        w_idx = _window_index(epoch, w_lo, n_shards, W)
+        fplan = _faults._active
+        if fplan is not None:
+            ev = fplan.host_event(w_idx, worker_index)
+            if ev is not None and ev[0] == "fail":
+                _flush_obs()
+                sys.stdout.flush()
+                os._exit(EXIT_INJECTED)
+            if ev is not None and ev[0] == "stall":
+                time.sleep(float(ev[1]))
+        mine = [(p, s)
+                for p, s in plan.host_partition(source, epoch, n, node_id,
+                                                start_pos=w_lo)
+                if p < w_hi]
+        partials = {}
+        shards_iter = iter_shards(source, [s for _, s in mine])
+        try:
+            for (p, s), raw in zip(mine, shards_iter):
+                prt = shard_partial(state["centers"], raw)
+                partials[p] = prt
+                _kv_put_bytes(client,
+                              f"elastic/g{gen}/x/{epoch * n_shards + p}",
+                              _pack_partial(*prt))
+        finally:
+            shards_iter.close()
+        for p in range(w_lo, w_hi):
+            if p in partials:
+                continue
+            peer = members[p % n]
+            raw = _await_partial(
+                client, lease, f"elastic/g{gen}/x/{epoch * n_shards + p}",
+                peer, lz_s, run_dir=run_dir, gen=gen, n_hosts=n,
+                worker=worker_index)
+            partials[p] = _unpack_partial(raw)
+        for p in range(w_lo, w_hi):
+            fold_partial(state, int(order[p]), partials[p])
+        cursor = epoch * n_shards + w_hi
+        if node_id == 0:
+            check_commit_generation(run_dir, gen)
+            save_stream_state(ckpt, state, cursor,
+                              commit_fingerprint(base, gen))
+            _write_json_atomic(
+                os.path.join(run_dir, "progress.json"),
+                {"cursor": int(cursor), "generation": int(gen),
+                 "epoch": int(epoch)})
+    return cursor
+
+
+def _worker_main(run_dir, worker_index):
+    """The ``--worker`` entrypoint: join generations until the fit is
+    done (or this worker is superseded), re-forming the world after
+    every :class:`GenerationAbort`."""
+    from ..oocore.store import open_store
+    from . import distributed as dist
+
+    with open(os.path.join(run_dir, "config.json")) as fh:
+        cfg = json.load(fh)
+    source = open_store(cfg["store"])
+    k, m = int(cfg["n_clusters"]), int(source.shape[1])
+    seed, epochs, W = int(cfg["seed"]), int(cfg["epochs"]), \
+        int(cfg["window"])
+    n_shards = int(source.n_shards)
+    total = epochs * n_shards
+    plan = EpochPlan(seed=seed)
+    base = base_fingerprint(source, k, seed, epochs, W)
+    ckpt = os.path.join(run_dir, "ckpt.npz")
+    template = new_state(k, m, n_shards, np.zeros((k, m)))
+    last_gen, abort_t = -1, None
+    while True:
+        man = _await_manifest(run_dir, last_gen + 1)
+        gen = int(man["generation"])
+        members = [int(x) for x in man["members"]]
+        if worker_index not in members:
+            _emit("stale_exit", gen, len(members), host=worker_index)
+            return EXIT_STALE
+        node_id = members.index(worker_index)
+        n = len(members)
+        dist.initialize(f"127.0.0.1:{man['port']}", n, node_id,
+                        generation=gen, elastic=True)
+        client = dist.world_client()
+        lease = LeaseSupervisor(client, gen, worker_index,
+                                cfg["heartbeat_s"]).start()
+        _certify_world(dist.global_mesh(), seed, gen)
+        shrink_s = (time.monotonic() - abort_t) if abort_t is not None \
+            else 0.0
+        _emit("world_up", gen, n, host=worker_index,
+              shrink_s=round(shrink_s, 6))
+        loaded = load_state(ckpt, template, base, gen)
+        if loaded is not None:
+            state, cursor = loaded
+        else:
+            state, cursor = new_state(k, m, n_shards,
+                                      init_centers(source, k, seed)), 0
+        _emit("resume", gen, n, host=worker_index, cursor=int(cursor))
+        try:
+            cursor = _run_generation(
+                run_dir, source, plan, state, cursor, gen=gen,
+                members=members, node_id=node_id,
+                worker_index=worker_index, client=client, lease=lease,
+                cfg=cfg, base=base, ckpt=ckpt)
+        except (GenerationAbort, StaleGenerationError):
+            # a stale-commit refusal re-forms exactly like an abort: the
+            # next manifest decides whether this worker is still a member
+            abort_t = time.monotonic()
+            lease.stop()
+            dist.shutdown(barrier=False)
+            last_gen = gen
+            continue
+        assert cursor == total, (cursor, total)
+        assert (state["folds"] == epochs).all(), state["folds"]
+        if node_id == 0:
+            check_commit_generation(run_dir, gen)
+            np.savez(os.path.join(run_dir, "result.npz"),
+                     centers=state["centers"], counts=state["counts"],
+                     inertia=state["inertia"], folds=state["folds"])
+            _write_json_atomic(
+                os.path.join(run_dir, "result.json"),
+                {"generation": int(gen), "n_hosts": n,
+                 "cursor": int(cursor),
+                 "inertia": float(state["inertia"])})
+        _emit("done", gen, n, host=worker_index, cursor=int(cursor))
+        lease.stop()
+        try:
+            client.wait_at_barrier(f"elastic/done/g{gen}", 10_000)
+        except Exception:
+            pass  # peers may already be gone; the fit is committed
+        return EXIT_OK
+
+
+# ---------------------------------------------------------------------------
+# coordinator
+# ---------------------------------------------------------------------------
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _pick_port():
+    port = _knobs.get_int("SQ_ELASTIC_PORT")
+    return int(port) if port else _free_port()
+
+
+def _xla_device_flags(devices_per_host):
+    """Compose the child's XLA_FLAGS: strip any inherited virtual-device
+    forcing, add ours."""
+    flags = [f for f in (_knobs.get_raw("XLA_FLAGS") or "").split()
+             if not f.startswith("--xla_force_host_platform_device_count")]
+    flags.append("--xla_force_host_platform_device_count="
+                 f"{int(devices_per_host)}")
+    return " ".join(flags)
+
+
+def collect_elastic_records(run_dir):
+    """All ``elastic`` obs records of a run's workers, in file order —
+    what the smoke/bench mine for detection latency and shrink
+    wall-clock."""
+    out = []
+    for name in sorted(os.listdir(run_dir)):
+        if not (name.startswith("obs.w") and name.endswith(".jsonl")):
+            continue
+        with open(os.path.join(run_dir, name)) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue  # torn tail of a SIGKILLed worker
+                if rec.get("type") == "elastic":
+                    rec["_worker"] = name[len("obs.w"):-len(".jsonl")]
+                    out.append(rec)
+    return out
+
+
+class ElasticCoordinator:
+    """Parent-process control plane of one elastic fit.
+
+    Owns the run directory (config + per-generation manifests), hosts
+    one KV/coordination service per generation (outside the mesh, so no
+    worker death can take it down), spawns the N workers, and reacts to
+    deaths: a member process exiting before the result lands — or a
+    survivor's lease-detection failure file — triggers a shrink (new
+    port, new service, ``manifest.g<G+1>.json`` with the survivors),
+    bounded by ``SQ_ELASTIC_MAX_SHRINKS``. The optional ``kill`` leg
+    SIGKILLs a chosen worker once the committed cursor passes a
+    threshold — the smoke/bench's scripted mid-epoch host death.
+
+    Single-threaded poll loop; the services it holds stay referenced
+    until the run object dies (destroying a service under live client
+    poll threads QFATALs them)."""
+
+    def __init__(self, run_dir, store_path, *, n_workers=3, n_clusters=8,
+                 seed=0, epochs=2, window=None, devices_per_host=2,
+                 max_shrinks=None, kill=None, worker_env=None,
+                 heartbeat_s=None, lease_s=None, obs=True):
+        self.run_dir = str(run_dir)
+        self.store_path = str(store_path)
+        self.n_workers = int(n_workers)
+        self.n_clusters = int(n_clusters)
+        self.seed = int(seed)
+        self.epochs = int(epochs)
+        self.window = int(window) if window else _default_window()
+        self.devices_per_host = int(devices_per_host)
+        self.max_shrinks = (_max_shrinks() if max_shrinks is None
+                            else int(max_shrinks))
+        self.kill = kill  # (worker_index, min_committed_cursor) or None
+        self.worker_env = dict(worker_env or {})
+        self.heartbeat_s = float(heartbeat_s if heartbeat_s is not None
+                                 else _heartbeat_s())
+        self.lease_s = float(lease_s if lease_s is not None else _lease_s())
+        self.obs = bool(obs)
+        self.procs = {}
+        self.timeline = []
+
+    def _mark(self, event, **fields):
+        self.timeline.append(dict({"t": time.monotonic(),
+                                   "event": event}, **fields))
+
+    def _spawn(self, worker_index):
+        repo = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env = dict(os.environ)
+        env.pop("PYTHONSTARTUP", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        # repo root ONLY: dropping any sitecustomize dir from PYTHONPATH
+        # keeps the axon preimport (and a wedged relay) out of workers
+        env["PYTHONPATH"] = repo
+        env["XLA_FLAGS"] = _xla_device_flags(self.devices_per_host)
+        if self.obs:
+            env["SQ_OBS"] = "1"
+            env["SQ_OBS_PATH"] = os.path.join(
+                self.run_dir, f"obs.w{worker_index}.jsonl")
+            env.pop("SQ_OBS_TRACE", None)
+        env.update(self.worker_env)
+        log = open(os.path.join(self.run_dir,
+                                f"worker{worker_index}.log"), "ab")
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "sq_learn_tpu.parallel.elastic",
+                 "--worker", self.run_dir, str(worker_index)],
+                env=env, stdout=log, stderr=subprocess.STDOUT)
+        finally:
+            log.close()
+        return proc
+
+    def _shrink(self, generation, members, dead):
+        from . import distributed as dist
+
+        gen = generation + 1
+        members = [i for i in members if i not in dead]
+        port = _pick_port()
+        self._services.append(dist.start_coordinator_service(
+            f"127.0.0.1:{port}", len(members)))
+        _write_json_atomic(
+            os.path.join(self.run_dir, f"manifest.g{gen}.json"),
+            {"generation": gen, "port": port, "members": members})
+        _emit("shrink", gen, len(members), failed_host=int(dead[0]))
+        self._mark("shrink", generation=gen, members=members, dead=dead)
+        return gen, members
+
+    def run(self, timeout_s=300.0):
+        from . import distributed as dist
+
+        os.makedirs(self.run_dir, exist_ok=True)
+        _write_json_atomic(
+            os.path.join(self.run_dir, "config.json"),
+            {"store": self.store_path, "n_clusters": self.n_clusters,
+             "seed": self.seed, "epochs": self.epochs,
+             "window": self.window, "heartbeat_s": self.heartbeat_s,
+             "lease_s": self.lease_s})
+        self._services = []
+        members = list(range(self.n_workers))
+        gen = 0
+        port = _pick_port()
+        self._services.append(dist.start_coordinator_service(
+            f"127.0.0.1:{port}", len(members)))
+        _write_json_atomic(
+            os.path.join(self.run_dir, "manifest.g0.json"),
+            {"generation": 0, "port": port, "members": members})
+        for i in members:
+            self.procs[i] = self._spawn(i)
+        self._mark("launched", members=list(members))
+        result_json = os.path.join(self.run_dir, "result.json")
+        shrinks, killed, kill_done = 0, [], self.kill is None
+        t0 = time.monotonic()
+        try:
+            while True:
+                if time.monotonic() - t0 > timeout_s:
+                    raise ElasticError(
+                        f"elastic run did not finish in {timeout_s}s "
+                        f"(gen {gen}, members {members})")
+                if not kill_done:
+                    prog = None
+                    try:
+                        with open(os.path.join(self.run_dir,
+                                               "progress.json")) as fh:
+                            prog = json.load(fh)
+                    except (OSError, ValueError):
+                        pass
+                    if prog and prog["cursor"] >= int(self.kill[1]):
+                        victim = int(self.kill[0])
+                        os.kill(self.procs[victim].pid, signal.SIGKILL)
+                        killed.append(victim)
+                        kill_done = True
+                        self._mark("sigkill", worker=victim,
+                                   cursor=prog["cursor"])
+                done = os.path.exists(result_json)
+                dead = [i for i in members
+                        if self.procs[i].poll() is not None]
+                for name in os.listdir(self.run_dir):
+                    if name.startswith(f"failed.g{gen}.w"):
+                        w = int(name[len(f"failed.g{gen}.w"):-len(".json")])
+                        if w in members and w not in dead:
+                            dead.append(w)
+                if dead and not done:
+                    shrinks += len(dead)
+                    if shrinks > self.max_shrinks or len(members) - \
+                            len(dead) < 1:
+                        raise HostFailure(
+                            f"worker(s) {dead} died with the shrink "
+                            f"budget exhausted "
+                            f"({shrinks}/{self.max_shrinks})")
+                    gen, members = self._shrink(gen, members, dead)
+                if done and all(p.poll() is not None
+                                for p in self.procs.values()):
+                    break
+                time.sleep(0.05)
+        finally:
+            for p in self.procs.values():
+                if p.poll() is None:
+                    p.kill()
+            for p in self.procs.values():
+                p.wait(timeout=30)
+        with open(result_json) as fh:
+            summary = json.load(fh)
+        with np.load(os.path.join(self.run_dir, "result.npz")) as npz:
+            result = {k: np.array(npz[k]) for k in npz.files}
+        self._mark("done", generation=summary["generation"])
+        return {"centers": result["centers"], "counts": result["counts"],
+                "inertia": float(result["inertia"]),
+                "folds": result["folds"],
+                "generation": int(summary["generation"]),
+                "n_hosts": int(summary["n_hosts"]), "shrinks": shrinks,
+                "killed": killed, "timeline": list(self.timeline),
+                "exit_codes": {i: p.returncode
+                               for i, p in self.procs.items()}}
+
+
+def _main(argv):
+    if len(argv) >= 4 and argv[1] == "--worker":
+        import jax
+
+        # in-process platform pin: with a sitecustomize that preimported
+        # jax, env vars are too late (CLAUDE.md environment gotchas)
+        jax.config.update("jax_platforms", "cpu")
+        run_dir, widx = argv[2], int(argv[3])
+        try:
+            rc = _worker_main(run_dir, widx)
+        except Exception:
+            import traceback
+
+            traceback.print_exc()
+            try:
+                with open(os.path.join(run_dir, f"error.w{widx}.json"),
+                          "w") as fh:
+                    json.dump({"worker": widx,
+                               "error": traceback.format_exc()}, fh)
+            except OSError:
+                pass
+            rc = 1
+        # never return through interpreter teardown with a live client
+        # poll thread (observed QFATAL at xla client.h:80)
+        _flush_obs()
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(int(rc))
+    print("usage: python -m sq_learn_tpu.parallel.elastic "
+          "--worker <run_dir> <worker_index>", file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(_main(sys.argv))

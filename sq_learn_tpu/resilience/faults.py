@@ -82,6 +82,23 @@ Kinds and their params (every param optional unless noted):
     the out-of-core bench uses to test readahead depth/budget policy
     against realistic remote-storage latencies.
 
+``host_fail``
+    Elastic-mesh host death (:mod:`sq_learn_tpu.parallel.elastic`): the
+    selected host dies at the selected fold-window boundary —
+    ``host=H`` picks the victim host id, ``window=K`` (an alias of
+    ``tile=K``; the window index is the tile index of these hooks)
+    picks the boundary. An in-process sim removes the host from the
+    world; a real elastic worker ``os._exit``\\ s, so survivors exercise
+    the full lease-expiry → shrink → resume path deterministically.
+    Clauses without ``host=`` match any host (in-process sims query
+    hosts in id order — pin ``host=`` for cross-topology determinism).
+``host_stall``
+    Elastic-mesh host stall: the selected host sleeps ``s=0.25``
+    seconds at the selected window boundary before publishing its
+    partials — late-but-alive, the shape the lease layer must classify
+    as a stall (heartbeats still flowing) rather than a death.
+    Selection params as for ``host_fail``.
+
 Example: ``SQ_FAULTS="put_fail:tiles=2,times=1;probe_timeout:n=2"``.
 
 Determinism: probabilistic selection (``p=``) draws from a splitmix64 hash
@@ -108,7 +125,8 @@ __all__ = [
 ]
 
 _KINDS = ("put_fail", "put_stall", "nan", "abort", "probe_timeout",
-          "read_fail", "read_stall", "corrupt_shard", "cold_tier")
+          "read_fail", "read_stall", "corrupt_shard", "cold_tier",
+          "host_fail", "host_stall")
 
 
 class FaultSpecError(ValueError):
@@ -152,7 +170,11 @@ class _Injector:
         self.index = index
         self.kind = kind
         self.tiles = params.pop("tiles", None)
-        self.tile = params.pop("tile", None)
+        # window= is the elastic-mesh spelling of tile= (the host hooks'
+        # tile index is a fold-window index)
+        win = params.pop("window", None)
+        self.tile = params.pop("tile", win)
+        self.host = params.pop("host", None)
         self.p = params.pop("p", None)
         self.times = params.pop("times", 1)
         self.seed = params.pop("seed", 0)
@@ -201,7 +223,7 @@ class _Injector:
 def _parse_value(key, raw):
     if key == "tiles":
         return frozenset(int(t) for t in raw.split("/"))
-    if key in ("tile", "times", "seed", "n"):
+    if key in ("tile", "times", "seed", "n", "host", "window"):
         return int(raw)
     if key in ("p", "s", "per_mb"):
         return float(raw)
@@ -349,6 +371,25 @@ class FaultPlan:
                 tile = np.array(tile, copy=True)
                 tile.reshape(-1)[:1] = np.nan
         return tile
+
+    def host_event(self, window_index, host_id):
+        """Elastic-mesh hook at a fold-window boundary: the first armed
+        ``host_fail``/``host_stall`` clause targeting ``host_id`` at this
+        window wins — returns ``("fail", 0.0)`` or ``("stall", s)``, else
+        None. The host filter runs BEFORE the tile countdown so a
+        ``host=H`` clause spends no countdown on other hosts' queries."""
+        for inj in self._by_kind("host_fail"):
+            if ((inj.host is None or inj.host == int(host_id))
+                    and inj.matches(window_index)):
+                self._record("host_fail", window_index, host=int(host_id))
+                return ("fail", 0.0)
+        for inj in self._by_kind("host_stall"):
+            if ((inj.host is None or inj.host == int(host_id))
+                    and inj.matches(window_index)):
+                self._record("host_stall", window_index,
+                             host=int(host_id), stall_s=inj.stall_s)
+                return ("stall", inj.stall_s)
+        return None
 
     def on_probe(self):
         """Probe hook: a forced outcome string, or None to probe for

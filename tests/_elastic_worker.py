@@ -1,0 +1,106 @@
+"""Worker for the elastic generation-lifecycle multi-process tests.
+
+Launched by tests/test_distributed_multiprocess.py with::
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=2 \
+        python tests/_elastic_worker.py reinit <pid> <port_g0> <port_g1> <repo>
+    python tests/_elastic_worker.py mismatch <pid> <port> <repo>
+
+``reinit`` pins the ISSUE 18 idempotency contract: join generation 0
+(raw-client path, coordinator service hosted by the parent test), prove
+same-generation re-initialize is a no-op and a DIFFERENT generation
+while live raises, run a cross-process psum, ``shutdown()``, re-form as
+generation 1 on a fresh service in the SAME process, psum again.
+
+``mismatch`` pins the refusal: two workers carry generations 0 and 1 to
+one service — whichever publishes the generation key first wins and the
+other gets :class:`GenerationMismatchError` (never a gloo hang).
+
+Workers exit via ``os._exit``: the raw distributed-runtime client must
+not run its destructor concurrently with interpreter teardown (see
+sq_learn_tpu/parallel/elastic.py on the QFATAL race).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, sys.argv[-1])  # repo root
+
+import numpy as np  # noqa: E402
+
+
+def psum_total(nproc):
+    """One real cross-process collective on the CURRENT world: psum of
+    per-host ones over the global mesh (rebuilt fresh — the previous
+    generation's backend was cleared)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from sq_learn_tpu._compat import shard_map
+    from sq_learn_tpu.parallel import distributed as dist
+    from sq_learn_tpu.parallel.mesh import DATA_AXIS
+
+    mesh = dist.global_mesh()
+    assert mesh.devices.size == 2 * nproc, mesh
+    wg = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P(DATA_AXIS)), np.ones((4,), np.float32))
+
+    @jax.jit
+    def total(wg):
+        return shard_map(
+            lambda w: jax.lax.psum(jnp.sum(w), DATA_AXIS),
+            mesh=mesh, in_specs=P(DATA_AXIS), out_specs=P())(wg)
+
+    return float(total(wg))
+
+
+def main():
+    mode, pid = sys.argv[1], int(sys.argv[2])
+    from sq_learn_tpu.parallel import distributed as dist
+
+    if mode == "reinit":
+        addr0 = f"localhost:{sys.argv[3]}"
+        addr1 = f"localhost:{sys.argv[4]}"
+        dist.initialize(addr0, 2, pid, generation=0, elastic=True)
+        # same generation again: idempotent no-op
+        dist.initialize(addr0, 2, pid, generation=0, elastic=True)
+        try:
+            dist.initialize(addr1, 2, pid, generation=1, elastic=True)
+        except RuntimeError as exc:
+            assert "shutdown" in str(exc), exc
+        else:
+            print(f"worker {pid} FAIL: live-world re-init did not raise",
+                  flush=True)
+            os._exit(1)
+        assert dist.generation() == 0
+        assert psum_total(2) == 8.0
+        dist.shutdown()
+        assert dist.generation() is None
+        # the SAME process re-forms as the next generation
+        dist.initialize(addr1, 2, pid, generation=1, elastic=True)
+        assert dist.generation() == 1
+        assert psum_total(2) == 8.0
+        dist.shutdown()
+        print(f"worker {pid} REINIT OK", flush=True)
+        os._exit(0)
+
+    if mode == "mismatch":
+        addr = f"localhost:{sys.argv[3]}"
+        try:
+            dist.initialize(addr, 2, pid, generation=pid, elastic=True)
+        except dist.GenerationMismatchError as exc:
+            assert "refusing" in str(exc), exc
+            print(f"worker {pid} MISMATCH", flush=True)
+            os._exit(0)
+        assert dist.generation() == pid
+        dist.shutdown(barrier=False)  # the refused peer reaches no barrier
+        print(f"worker {pid} JOINED", flush=True)
+        os._exit(0)
+
+    print(f"worker {pid} FAIL: unknown mode {mode!r}", flush=True)
+    os._exit(2)
+
+
+if __name__ == "__main__":
+    main()

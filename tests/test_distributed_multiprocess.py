@@ -18,12 +18,43 @@ pytestmark = pytest.mark.slow
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
 WORKER = REPO / "tests" / "_dist_worker.py"
+ELASTIC_WORKER = REPO / "tests" / "_elastic_worker.py"
 
 
 def _free_port():
     with socket.socket() as s:
         s.bind(("localhost", 0))
         return s.getsockname()[1]
+
+
+def _worker_env():
+    env = dict(os.environ)
+    env.pop("PYTHONSTARTUP", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["PYTHONPATH"] = str(REPO)
+    return env
+
+
+def _run_elastic_workers(mode, ports, n=2, timeout=240):
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(ELASTIC_WORKER), mode, str(pid)]
+            + [str(p) for p in ports] + [str(REPO)],
+            env=_worker_env(), stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        for pid in range(n)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("elastic workers timed out:\n" + "\n".join(outs))
+    return procs, outs
 
 
 
@@ -55,3 +86,43 @@ def test_two_process_initialize_mesh_and_psum():
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {pid} failed:\n{out}"
         assert f"worker {pid} OK" in out
+
+
+def test_elastic_shutdown_and_reinit_next_generation():
+    """ISSUE 18 satellite: the raw-client elastic path tears a world
+    down and re-forms the next generation IN THE SAME PROCESSES — join
+    g0 (service hosted here, outside the mesh), prove same-generation
+    re-init is a no-op and a different generation while live raises,
+    psum, shutdown, join g1 on a fresh service, psum again."""
+    from sq_learn_tpu.parallel import distributed as dist
+
+    p0, p1 = _free_port(), _free_port()
+    services = [dist.start_coordinator_service(f"localhost:{p0}", 2),
+                dist.start_coordinator_service(f"localhost:{p1}", 2)]
+    try:
+        procs, outs = _run_elastic_workers("reinit", [p0, p1])
+        for pid, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"worker {pid} failed:\n{out}"
+            assert f"worker {pid} REINIT OK" in out
+    finally:
+        del services  # after every client is gone (workers exited)
+
+
+def test_elastic_mixed_generation_join_refused():
+    """Two workers carry generations 0 and 1 to one service: whichever
+    publishes first wins the handshake, the other must get
+    GenerationMismatchError — a refusal, never a gloo hang."""
+    from sq_learn_tpu.parallel import distributed as dist
+
+    port = _free_port()
+    services = [dist.start_coordinator_service(f"localhost:{port}", 2)]
+    try:
+        procs, outs = _run_elastic_workers("mismatch", [port])
+        for pid, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"worker {pid} failed:\n{out}"
+        verdicts = sorted(line.split()[-1] for out in outs
+                          for line in out.splitlines()
+                          if line.startswith("worker "))
+        assert verdicts == ["JOINED", "MISMATCH"], (verdicts, outs)
+    finally:
+        del services

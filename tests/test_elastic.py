@@ -1,0 +1,325 @@
+"""Elastic multi-host mesh (sq_learn_tpu.parallel.elastic — ISSUE 18).
+
+The contract under test: the window-synchronous fold's state is a pure
+function of (data, seed, k, epochs, window) — NEVER of the host count —
+so a fit that loses a host mid-pass, shrinks, and resumes from the
+committed checkpoint is bit-identical to an uninterrupted run; the
+per-shard fold ledger proves zero shards lost or double-folded. The
+real multi-process flow is certified by ``make elastic-smoke`` and the
+slow-tier test in ``test_distributed_multiprocess.py``; everything
+here runs the same pure core in-process, deterministically.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from sq_learn_tpu import obs
+from sq_learn_tpu.oocore import ArraySource, EpochPlan
+from sq_learn_tpu.parallel import elastic
+from sq_learn_tpu.parallel.elastic import (HostFailure,
+                                           StaleGenerationError)
+from sq_learn_tpu.resilience import faults
+from sq_learn_tpu.resilience.faults import FaultSpecError
+
+RNG = np.random.default_rng(19)
+X = np.asarray(RNG.normal(size=(230, 7)), np.float64)
+
+
+@pytest.fixture()
+def src():
+    return ArraySource(X, shard_rows=16)  # 15 shards, ragged tail
+
+
+@pytest.fixture()
+def recorder(tmp_path):
+    rec = obs.enable(str(tmp_path / "obs.jsonl"))
+    yield rec
+    obs.disable()
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+class TestHostPartition:
+    def test_disjoint_and_complete(self, src):
+        plan = EpochPlan(seed=3)
+        for epoch in (0, 1):
+            order = plan.shard_order(src, epoch)
+            for n_hosts in (1, 2, 3, 5, src.n_shards + 2):
+                parts = [plan.host_partition(src, epoch, n_hosts, h)
+                         for h in range(n_hosts)]
+                seen = {}
+                for h, part in enumerate(parts):
+                    for p, s in part:
+                        assert p not in seen, f"position {p} owned twice"
+                        seen[p] = (h, s)
+                assert sorted(seen) == list(range(len(order)))
+                for p, (h, s) in seen.items():
+                    assert h == p % n_hosts
+                    assert s == int(order[p])
+
+    def test_deterministic(self, src):
+        plan = EpochPlan(seed=3)
+        a = plan.host_partition(src, 1, 3, 2)
+        b = plan.host_partition(src, 1, 3, 2)
+        assert a == b
+
+    def test_start_pos_excludes_folded_positions(self, src):
+        plan = EpochPlan(seed=3)
+        full = plan.host_partition(src, 0, 2, 1)
+        resumed = plan.host_partition(src, 0, 2, 1, start_pos=6)
+        assert resumed == [(p, s) for p, s in full if p >= 6]
+
+    def test_shrink_repartition_covers_remainder(self, src):
+        """After a shrink the survivors repartition the SAME canonical
+        order from the committed cursor — union is exactly the
+        remaining positions, no overlap with the folded prefix."""
+        plan = EpochPlan(seed=3)
+        cursor = 7
+        parts = [plan.host_partition(src, 0, 2, h, start_pos=cursor)
+                 for h in range(2)]
+        positions = sorted(p for part in parts for p, _ in part)
+        assert positions == list(range(cursor, src.n_shards))
+
+    def test_validation(self, src):
+        plan = EpochPlan(seed=0)
+        with pytest.raises(ValueError, match="n_hosts"):
+            plan.host_partition(src, 0, 0, 0)
+        with pytest.raises(ValueError, match="host_id"):
+            plan.host_partition(src, 0, 2, 2)
+        with pytest.raises(ValueError, match="host_id"):
+            plan.host_partition(src, 0, 2, -1)
+
+
+class TestLocalSimParity:
+    def test_topology_invariance(self, src):
+        runs = [elastic.elastic_fit_local(src, 4, n_hosts=n, seed=7,
+                                          epochs=2, window=4)
+                for n in (1, 2, 3, 4)]
+        ref = runs[0]
+        assert (ref["folds"] == 2).all()
+        for r in runs[1:]:
+            np.testing.assert_array_equal(ref["centers"], r["centers"])
+            np.testing.assert_array_equal(ref["counts"], r["counts"])
+            assert ref["inertia"] == r["inertia"]
+
+    def test_window_size_changes_nothing_but_commit_grain(self, src):
+        a = elastic.elastic_fit_local(src, 4, n_hosts=2, seed=7,
+                                      epochs=1, window=4)
+        b = elastic.elastic_fit_local(src, 4, n_hosts=2, seed=7,
+                                      epochs=1, window=4,
+                                      max_shrinks=3)
+        np.testing.assert_array_equal(a["centers"], b["centers"])
+
+    def test_injected_death_is_bit_invisible(self, src):
+        ref = elastic.elastic_fit_local(src, 4, n_hosts=2, seed=7,
+                                        epochs=2, window=4)
+        faults.arm("host_fail:window=3,host=1,times=1")
+        r = elastic.elastic_fit_local(src, 4, n_hosts=3, seed=7,
+                                      epochs=2, window=4)
+        assert r["shrinks"] == 1 and r["generation"] == 1
+        assert r["n_hosts"] == 2
+        np.testing.assert_array_equal(ref["centers"], r["centers"])
+        np.testing.assert_array_equal(ref["counts"], r["counts"])
+        assert (r["folds"] == 2).all()
+
+    def test_stall_is_recorded_not_fatal(self, src, recorder):
+        ref = elastic.elastic_fit_local(src, 4, n_hosts=2, seed=7,
+                                        epochs=1, window=4)
+        faults.arm("host_stall:window=1,host=0,times=1,s=0.0")
+        r = elastic.elastic_fit_local(src, 4, n_hosts=2, seed=7,
+                                      epochs=1, window=4)
+        assert r["shrinks"] == 0
+        np.testing.assert_array_equal(ref["centers"], r["centers"])
+        assert any(e["event"] == "host_stall"
+                   for e in recorder.elastic_records)
+
+    def test_budget_exhaustion_raises(self, src):
+        faults.arm("host_fail:window=0,host=0,times=1;"
+                   "host_fail:window=1,host=1,times=1")
+        with pytest.raises(HostFailure, match="budget exhausted"):
+            elastic.elastic_fit_local(src, 4, n_hosts=3, seed=7,
+                                      epochs=1, window=4, max_shrinks=1)
+
+    def test_last_host_death_raises(self, src):
+        faults.arm("host_fail:window=0,host=0,times=1")
+        with pytest.raises(HostFailure):
+            elastic.elastic_fit_local(src, 4, n_hosts=1, seed=7,
+                                      epochs=1, window=4, max_shrinks=5)
+
+    def test_ckpt_resume_across_generations(self, src, tmp_path):
+        ref = elastic.elastic_fit_local(src, 4, n_hosts=2, seed=7,
+                                        epochs=2, window=4)
+        ck = str(tmp_path / "ck.npz")
+        faults.arm("host_fail:window=2,host=2,times=1")
+        r = elastic.elastic_fit_local(src, 4, n_hosts=3, seed=7,
+                                      epochs=2, window=4, ckpt_path=ck)
+        assert r["generation"] == 1
+        np.testing.assert_array_equal(ref["centers"], r["centers"])
+        # a fresh process of the surviving world resumes the COMMITTED
+        # checkpoint and finishes identically (instantly: cursor==total)
+        rr = elastic.elastic_fit_local(src, 4, n_hosts=2, seed=7,
+                                       epochs=2, window=4, ckpt_path=ck,
+                                       generation=1)
+        np.testing.assert_array_equal(ref["centers"], rr["centers"])
+        np.testing.assert_array_equal(ref["counts"], rr["counts"])
+
+    def test_emits_timeline(self, src, recorder):
+        faults.arm("host_fail:window=1,host=0,times=1")
+        elastic.elastic_fit_local(src, 3, n_hosts=3, seed=1, epochs=1,
+                                  window=4)
+        events = [e["event"] for e in recorder.elastic_records]
+        for needed in ("world_up", "resume", "host_fail", "shrink",
+                       "done"):
+            assert needed in events, (needed, events)
+        gens = {e["generation"] for e in recorder.elastic_records}
+        assert gens == {0, 1}
+
+
+class TestFaultGrammar:
+    def test_unarmed_is_none(self):
+        assert faults.get_plan() is None
+        assert faults._active is None  # the single-attribute hot read
+
+    def test_host_filter_spends_no_countdown_on_other_hosts(self):
+        plan = faults.arm("host_fail:window=0,host=2,times=1")
+        for _ in range(5):
+            assert plan.host_event(0, 0) is None
+            assert plan.host_event(0, 1) is None
+        assert plan.host_event(0, 2) == ("fail", 0.0)
+        assert plan.host_event(0, 2) is None  # times=1 spent
+
+    def test_stall_carries_duration(self):
+        plan = faults.arm("host_stall:window=2,host=1,times=1,s=0.125")
+        assert plan.host_event(2, 1) == ("stall", 0.125)
+        assert plan.events[-1]["kind"] == "host_stall"
+        assert plan.events[-1]["host"] == 1
+        assert plan.events[-1]["stall_s"] == 0.125
+
+    def test_untargeted_clause_hits_any_host(self):
+        plan = faults.arm("host_fail:window=1,times=1")
+        assert plan.host_event(1, 4) == ("fail", 0.0)
+        assert plan.host_event(1, 0) is None  # countdown spent globally
+
+    def test_determinism(self):
+        spec = ("host_stall:window=0,host=0,times=2,s=0.0;"
+                "host_fail:window=2,host=1,times=1")
+        logs = []
+        for _ in range(2):
+            plan = faults.arm(spec)
+            out = [plan.host_event(w, h)
+                   for w in range(4) for h in range(3)]
+            logs.append((out, [dict(e) for e in plan.events]))
+        assert logs[0] == logs[1]
+
+    def test_bad_param_refused(self):
+        with pytest.raises(FaultSpecError):
+            faults.arm("host_fail:bogus=1")
+
+
+class TestGenerations:
+    def test_commit_fingerprint_is_generation_scoped(self, src):
+        base = elastic.base_fingerprint(src, 4, 7, 2, 4)
+        assert "n_hosts" not in base  # topology-free by design
+        f0 = elastic.commit_fingerprint(base, 0)
+        f1 = elastic.commit_fingerprint(base, 1)
+        assert f0 != f1 and f0.startswith(base) and f1.startswith(base)
+
+    def test_load_state_walks_generations_down(self, src, tmp_path):
+        from sq_learn_tpu.utils.checkpoint import save_stream_state
+
+        base = elastic.base_fingerprint(src, 3, 1, 1, 4)
+        tmpl = elastic.new_state(3, X.shape[1], src.n_shards,
+                                 np.zeros((3, X.shape[1])))
+        st = elastic.new_state(3, X.shape[1], src.n_shards,
+                               elastic.init_centers(src, 3, 1))
+        ck = str(tmp_path / "ck.npz")
+        save_stream_state(ck, st, 5, elastic.commit_fingerprint(base, 1))
+        # a generation-3 survivor accepts an ancestor's (g1) commit
+        loaded = elastic.load_state(ck, tmpl, base, 3)
+        assert loaded is not None and loaded[1] == 5
+        np.testing.assert_array_equal(loaded[0]["centers"],
+                                      st["centers"])
+        # a generation-0 (stale) worker can never resume past its world
+        assert elastic.load_state(ck, tmpl, base, 0) is None
+        assert elastic.load_state(None, tmpl, base, 3) is None
+
+    def test_stale_commit_refused(self, src, tmp_path, recorder):
+        run_dir = str(tmp_path)
+        with open(os.path.join(run_dir, "manifest.g2.json"), "w") as fh:
+            json.dump({"generation": 2, "port": 1, "members": [0, 1]}, fh)
+        elastic.check_commit_generation(run_dir, 2)  # live gen: fine
+        with pytest.raises(StaleGenerationError, match="generation 2"):
+            elastic.check_commit_generation(run_dir, 1)
+        refused = [e for e in recorder.elastic_records
+                   if e["event"] == "commit_refused"]
+        assert refused and refused[0]["manifest_generation"] == 2
+        assert refused[0]["generation"] == 1
+
+    def test_reinit_requires_shutdown(self, monkeypatch):
+        from sq_learn_tpu.parallel import distributed as dist
+
+        monkeypatch.setitem(dist._WORLD, "generation", 0)
+        dist.initialize()  # no generation: legacy idempotent no-op
+        dist.initialize(generation=0)  # same generation: no-op
+        with pytest.raises(RuntimeError, match="call shutdown"):
+            dist.initialize(generation=1)
+
+    def test_elastic_init_needs_full_coordinates(self):
+        from sq_learn_tpu.parallel import distributed as dist
+
+        with pytest.raises(ValueError, match="elastic initialize"):
+            dist.initialize("127.0.0.1:1", 1, 0, elastic=True)
+
+
+class TestSchemaV9:
+    def test_elastic_records_validate(self):
+        from sq_learn_tpu.obs.schema import validate_record
+
+        good = {"v": 9, "schema_version": 9, "ts": 0.0,
+                "type": "elastic", "event": "host_fail", "generation": 0,
+                "n_hosts": 3, "host": 0, "failed_host": 2,
+                "detect_s": 3.1, "window": 4}
+        assert validate_record(good) == []
+        bad_event = dict(good, event="exploded")
+        assert any("elastic.event" in e
+                   for e in validate_record(bad_event))
+        bad_gen = dict(good, generation=-1)
+        assert any("elastic.generation" in e
+                   for e in validate_record(bad_gen))
+        bad_detect = dict(good, detect_s=-0.5)
+        assert any("elastic.detect_s" in e
+                   for e in validate_record(bad_detect))
+
+    def test_fault_host_fields_validate(self):
+        from sq_learn_tpu.obs.schema import validate_record
+
+        good = {"v": 9, "schema_version": 9, "ts": 0.0, "type": "fault",
+                "kind": "host_fail", "tile": 3, "host": 1}
+        assert validate_record(good) == []
+        assert any("fault.host" in e
+                   for e in validate_record(dict(good, host="w1")))
+        assert any("fault.stall_s" in e
+                   for e in validate_record(dict(good, stall_s=-1.0)))
+
+    def test_legacy_v8_still_validates(self):
+        from sq_learn_tpu.obs.schema import validate_record
+
+        v8 = {"v": 8, "schema_version": 8, "ts": 0.0, "type": "control",
+              "tenant": "t", "action": "hold", "seq": 3,
+              "inputs": {}, "decision": {}}
+        assert validate_record(v8) == []
+
+    def test_unknown_version_rejected(self):
+        from sq_learn_tpu.obs.schema import validate_record
+
+        assert any("unknown schema version" in e for e in validate_record(
+            {"v": 10, "schema_version": 10, "ts": 0.0, "type": "meta",
+             "pid": 1, "schema": 10}))

@@ -217,7 +217,7 @@ def test_legacy_versions_still_validate_and_v6_slo_fields():
         dict(v6, stages={"queue": -1.0})))
     assert any("tenant" in e for e in validate_record(dict(v6, tenant=3)))
     assert any("unknown schema version" in e
-               for e in validate_record(dict(v5, v=9, schema_version=9)))
+               for e in validate_record(dict(v5, v=10, schema_version=10)))
 
 
 # -- SloTracker: per-tenant records, windowed flush ---------------------------

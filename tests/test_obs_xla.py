@@ -161,7 +161,7 @@ def test_schema_v5_envelope_and_new_types(run, tmp_path):
     finally:
         obs.disable()
     recs = [json.loads(l) for l in open(path)]
-    assert all(r["v"] == 8 and r["schema_version"] == 8 for r in recs)
+    assert all(r["v"] == 9 and r["schema_version"] == 9 for r in recs)
     summary = validate_jsonl(path)
     assert summary["errors"] == []
     assert summary["by_type"]["xla_cost"] == 1
@@ -177,7 +177,7 @@ def test_schema_validates_regression_records():
 
 
 def test_schema_rejects_unknown_version_and_mismatch():
-    assert validate_record({"v": 9, "schema_version": 9, "ts": 0.0,
+    assert validate_record({"v": 10, "schema_version": 10, "ts": 0.0,
                             "type": "gauge", "name": "g", "value": 1})
     assert validate_record({"v": 2, "schema_version": 1, "ts": 0.0,
                             "type": "gauge", "name": "g", "value": 1})
@@ -186,11 +186,11 @@ def test_schema_rejects_unknown_version_and_mismatch():
                             "name": "g", "value": 1})
     assert validate_record({"v": 7, "ts": 0.0, "type": "gauge",
                             "name": "g", "value": 1})
-    # v1 lines (pre-v2 files) still validate without it, and v2..v6
-    # lines (pre-v7 files) validate with it
+    # v1 lines (pre-v2 files) still validate without it, and v2..v8
+    # lines (pre-v9 files) validate with it
     assert validate_record({"v": 1, "ts": 0.0, "type": "gauge",
                             "name": "g", "value": 1}) == []
-    for v in (2, 3, 4, 5, 6):
+    for v in (2, 3, 4, 5, 6, 7, 8):
         assert validate_record({"v": v, "schema_version": v, "ts": 0.0,
                                 "type": "gauge", "name": "g",
                                 "value": 1}) == []

@@ -608,6 +608,47 @@ class TestPrefetch:
         assert not (set(reads) & set(skipped)), (
             f"prefetcher read skipped shards {set(reads) & set(skipped)}")
 
+    def test_host_partition_never_reads_foreign_shards(self, store,
+                                                       monkeypatch):
+        """ISSUE 18: a host walking its ``host_partition`` slice with
+        readahead armed touches ONLY its owned shards — peers' shards
+        and (on a post-shrink resume) the already-folded prefix are
+        never read, not even speculatively by the prefetch workers."""
+        from sq_learn_tpu.oocore.prefetch import iter_shards
+
+        self._depth(monkeypatch, 3)
+        plan = EpochPlan(seed=5)
+        mine = plan.host_partition(store, 1, 3, 2)
+        foreign = set(range(store.n_shards)) - {s for _, s in mine}
+
+        reads = []
+        real = oocore.ShardStore.read_shard
+
+        def spy_read(self, i):
+            reads.append(int(i))
+            return real(self, i)
+
+        monkeypatch.setattr(oocore.ShardStore, "read_shard", spy_read)
+        arrs = list(iter_shards(store, [s for _, s in mine]))
+        for (_, s), arr in zip(mine, arrs):  # right shards, right order
+            lo = int(store._offsets[s])
+            np.testing.assert_array_equal(
+                arr, X_TALL[lo:lo + store.shard_sizes[s]])
+        assert set(reads) == {s for _, s in mine}
+        assert not (set(reads) & foreign)
+
+        # resume-after-shrink: repartition at 2 hosts from a committed
+        # cursor — the folded prefix's shards stay untouched
+        reads.clear()
+        cursor = 4
+        resumed = plan.host_partition(store, 1, 2, 1, start_pos=cursor)
+        folded = {int(plan.shard_order(store, 1)[p])
+                  for p in range(cursor)}
+        list(iter_shards(store, [s for _, s in resumed]))
+        assert reads, "spy never saw a read"
+        assert not (set(reads) & (folded - {s for _, s in resumed})), (
+            "prefetcher re-read folded shards across the shrink")
+
     def test_ram_budget_bounds_readahead(self, store, monkeypatch):
         """With a budget barely above two shards, readahead degrades
         toward serial but still completes with parity (the consumer's
