@@ -13,7 +13,7 @@ SHELL := /bin/bash
     hunt obs-smoke faults-smoke oocore-smoke serve-smoke control-smoke \
     elastic-smoke regress-selftest \
     smoke obs-report obs-trace obs-frontier obs-audit obs-budget \
-    obs-control regress all
+    obs-control obs-fleet regress all
 
 all: lint test
 
@@ -185,9 +185,15 @@ control-smoke:
 # detection, generation-bumping shrink to 2 hosts, resume from the
 # committed checkpoint, final state bit-identical to the uninterrupted
 # run with every shard folded exactly `epochs` times (zero lost, zero
-# double-folded), plus schema-v9 validation of every worker's elastic
-# transition records. The CI-runnable contract check for
-# sq_learn_tpu.parallel.elastic.
+# double-folded), plus schema-v10 validation of every worker's elastic
+# transition records AND of the run's merged fleet timeline: one
+# coordinator-minted run_id across every per-process shard, monotone
+# clock-aligned merge, the SIGKILLed worker's fold progress up to its
+# last pre-kill flush, commit-ledger reconciliation (every committed
+# window exactly once) and a generation-1 detect→shrink→resume
+# critical path — the merged artifact is archived outside the scratch
+# dir. The CI-runnable contract check for sq_learn_tpu.parallel.elastic
+# + sq_learn_tpu.obs.fleet.
 elastic-smoke:
 	$(PYTHON) -m sq_learn_tpu.parallel.elastic_smoke
 
@@ -226,6 +232,16 @@ obs-budget:
 # never read as "nothing to decide").
 obs-control:
 	$(PYTHON) -m sq_learn_tpu.obs control $(OBS)
+
+# Fleet view: merge one elastic run's per-process obs shards (a run
+# directory of obs.*.jsonl files, or explicit shard paths via
+# FLEET=<src>) into one clock-aligned timeline — per-host/per-generation
+# rollups, the detect→shrink→re-init→resume critical path per shrink,
+# and the commit-ledger reconciliation (exit 1 when a committed window
+# is missing or duplicated, exit 2 when the source holds no shards).
+FLEET ?= /tmp/sq_obs_smoke.jsonl
+obs-fleet:
+	$(PYTHON) -m sq_learn_tpu.obs fleet $(FLEET)
 
 # Perf-regression gate, standalone: run the headline bench, the PR 6
 # fused-fit bench (classical 70k×784 q-means), the PR 7 δ=0.5

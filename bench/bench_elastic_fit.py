@@ -29,9 +29,16 @@ the shrink overhead is lease-bounded and constant. The declared
 ``vs_baseline_floor`` of 0.6 guards exactly that fixed overhead; the
 extras carry the full decomposition — ``uninterrupted_2host_s``,
 ``dead_run_s``, per-survivor detection latency and shrink wall-clock
-mined from the run's schema-v9 ``elastic`` records via
+mined from the run's schema-v10 ``elastic`` records via
 :func:`~sq_learn_tpu.parallel.elastic.collect_elastic_records` — so
-the record shows where every second of the recovery went.
+the record shows where every second of the recovery went. The kill
+run's per-process obs shards are additionally merged into ONE
+clock-aligned fleet timeline (:mod:`sq_learn_tpu.obs.fleet`): the
+extras gain the generation-1 detect→shrink→re-init→resume critical
+path and the commit-ledger reconciliation verdict, and when
+``SQ_OOC_BENCH_ARTIFACT_DIR`` is set (the suite sets it) the merged
+timeline lands there as ``elastic_fleet_merged.jsonl`` next to the
+per-host shards.
 
 Bit parity is asserted in-bench, not just claimed: both real runs must
 equal the in-process :func:`elastic_fit_local` reference (the
@@ -136,6 +143,23 @@ def main():
                   if r["event"] == "world_up" and r["generation"] == 1
                   and "shrink_s" in r]
 
+        # one mesh-wide fleet timeline: critical path + commit ledger
+        from sq_learn_tpu.obs import fleet
+
+        shards = fleet.load_shards(run3)
+        fsum = fleet.summarize(shards)
+        cp1 = [p for p in fsum["critical_path"] if p["generation"] == 1]
+        recon = fsum["reconciliation"]
+        art_dir = os.environ.get("SQ_OOC_BENCH_ARTIFACT_DIR")
+        if art_dir:
+            os.makedirs(art_dir, exist_ok=True)
+            for fname in sorted(os.listdir(run3)):
+                if fname.startswith("obs.") and fname.endswith(".jsonl"):
+                    shutil.copy2(os.path.join(run3, fname),
+                                 os.path.join(art_dir, f"elastic_{fname}"))
+            fleet.write_merged(
+                shards, os.path.join(art_dir, "elastic_fleet_merged.jsonl"))
+
         emit(f"elastic_fit_{n // 1000}kx{m}_k{k}_kill_resume_wallclock",
              t3k, vs_baseline=(naive_s / t3k), vs_baseline_floor=0.6,
              naive_restart_s=round(naive_s, 3),
@@ -149,7 +173,13 @@ def main():
              generation=int(r3["generation"]),
              n_hosts_final=int(r3["n_hosts"]),
              parity_uninterrupted=parity2, parity_killed=parity3,
-             fold_ledger_ok=ledger_ok, smoke=smoke)
+             fold_ledger_ok=ledger_ok,
+             fleet_run_id=(fsum["run_ids"][0] if fsum["run_ids"]
+                           else None),
+             fleet_hosts=sorted(fsum["hosts"]),
+             critical_path_gen1=(cp1[0] if cp1 else None),
+             commit_reconciliation_ok=bool(recon["ok"]),
+             committed_windows=int(recon["windows"]), smoke=smoke)
 
         errors = []
         if not parity2:
@@ -169,6 +199,14 @@ def main():
             errors.append(f"no positive detection latency: {detect}")
         if not shrink or not all(s > 0 for s in shrink):
             errors.append(f"no positive shrink wall-clock: {shrink}")
+        n_windows = epochs * (-(-n_shards // window))
+        if not recon["ok"] or recon["windows"] != n_windows:
+            errors.append(f"fleet commit-ledger reconciliation broken "
+                          f"(want {n_windows} windows): {recon}")
+        if not cp1 or not isinstance(cp1[0].get("total_s"), (int, float)) \
+                or cp1[0]["total_s"] <= 0:
+            errors.append(f"no generation-1 fleet critical path: "
+                          f"{fsum['critical_path']}")
         if errors:
             print(json.dumps({"error": "; ".join(errors)}),
                   file=sys.stderr)

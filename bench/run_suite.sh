@@ -203,6 +203,23 @@ env -u PYTHONPATH timeout 60 python -m sq_learn_tpu.obs frontier \
   "$obs_dir"/*.jsonl* > "$obs_dir/frontier.txt" 2>/dev/null \
   || echo "# (no tradeoff records this run)" >> "$obs_dir/frontier.txt"
 
+# Fleet timeline (PR 19): the elastic bench copies its kill run's
+# per-process obs shards (coordinator + every worker, incl. the
+# SIGKILLed one) and the merged clock-aligned timeline into the
+# artifact dir; render the fleet view (per-host rollups, shrink
+# critical path, commit-ledger reconciliation) next to them, then put
+# the shards on the same gzip diet as every per-config JSONL — every
+# fleet reader opens .jsonl.gz transparently.
+if ls "$obs_dir"/elastic_obs.*.jsonl >/dev/null 2>&1; then
+  env -u PYTHONPATH timeout 60 python -m sq_learn_tpu.obs fleet \
+    "$obs_dir"/elastic_obs.*.jsonl > "$obs_dir/elastic_fleet.txt" \
+    2>/dev/null || true
+  gzip -9 -f "$obs_dir"/elastic_obs.*.jsonl
+fi
+if [ -s "$obs_dir/elastic_fleet_merged.jsonl" ]; then
+  gzip -9 -f "$obs_dir/elastic_fleet_merged.jsonl"
+fi
+
 # BASELINE acceptance gate (bench/_gate.py: vs_baseline >= 0.5 on every
 # line, 16 measured + 2 derived lines expected — the sixth measured line
 # is the streaming-ingest smoke config, whose baseline is the monolithic

@@ -115,6 +115,19 @@ _ENTRIES = [
     _K("SQ_OBS_TRACE", "path", None, "lib",
        "Render the closed run into Chrome trace-event JSON at this path.",
        "docs/observability.md"),
+    _K("SQ_OBS_FLEET_RUN_ID", "str", None, "lib",
+       "Coordinator-minted fleet run id; when set every record carries "
+       "the fleet envelope (run_id/host/pid/gen).",
+       "docs/observability.md"),
+    _K("SQ_OBS_FLEET_HOST", "str", None, "lib",
+       "Stable per-process host label in the fleet envelope (default "
+       "pid<pid>).", "docs/observability.md"),
+    _K("SQ_OBS_FLEET_DIR", "path", None, "lib",
+       "Fleet shard directory: with SQ_OBS=1 and SQ_OBS_PATH unset the "
+       "sink lands at <dir>/obs.<host>.jsonl.", "docs/observability.md"),
+    _K("SQ_OBS_FLEET_CLOCK_SAMPLES", "int", 64, "lib",
+       "Max clock samples recorded per peer per generation from the KV "
+       "heartbeat exchanges.", "docs/observability.md"),
     _K("SQ_OBS_XLA_MEMORY", "flag", True, "lib",
        "Compile-and-price memory stats in xla_cost records (0 skips the "
        "compile).", "docs/observability.md"),

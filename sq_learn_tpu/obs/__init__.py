@@ -23,17 +23,20 @@ guarantee-audit flags raise (:mod:`~sq_learn_tpu.obs.guarantees`);
 burn alerts raise (:mod:`~sq_learn_tpu.obs.budget`, with
 ``SQ_OBS_BUDGET_WINDOWS``/``SQ_OBS_BUDGET_BURN`` tuning);
 ``SQ_OBS_TRACE=<path>`` renders the closing run's JSONL into Chrome
-trace-event JSON. Analysis tooling: ``python -m sq_learn_tpu.obs
-{trace,report,regress,audit,frontier,budget,control}``
+trace-event JSON; ``SQ_OBS_FLEET_RUN_ID`` / ``SQ_OBS_FLEET_HOST`` /
+``SQ_OBS_FLEET_DIR`` stamp the fleet envelope and shard layout for
+multi-process runs (:mod:`~sq_learn_tpu.obs.fleet`). Analysis tooling:
+``python -m sq_learn_tpu.obs
+{trace,report,regress,audit,frontier,budget,control,fleet}``
 and :mod:`~sq_learn_tpu.obs.xla` (per-compilation FLOP/byte/peak-HBM
 accounting). Full docs: ``docs/observability.md``.
 """
 
-from . import (budget, control, frontier, guarantees, ledger, probe, regress,
-               report, schema, trace, xla)
+from . import (budget, control, fleet, frontier, guarantees, ledger, probe,
+               regress, report, schema, trace, xla)
 from .recorder import (NULL_SPAN, Recorder, counter_add, disable, enable,
-                       enabled, gauge, get_recorder, record_span, snapshot,
-                       span)
+                       enabled, flush, gauge, get_recorder, record_span,
+                       set_fleet, set_generation, snapshot, span)
 from .watchdog import (RetracingError, RetracingWarning, RetracingWatchdog,
                        watchdog)
 
@@ -52,6 +55,8 @@ __all__ = [
     "disable",
     "enable",
     "enabled",
+    "fleet",
+    "flush",
     "frontier",
     "gauge",
     "get_recorder",
@@ -63,6 +68,8 @@ __all__ = [
     "regress",
     "report",
     "schema",
+    "set_fleet",
+    "set_generation",
     "snapshot",
     "span",
     "trace",

@@ -29,6 +29,12 @@
   action taken, predicted vs realized effect); exits 2 when the
   artifacts carry zero control records
   (:mod:`~sq_learn_tpu.obs.control`).
+- ``fleet <run_dir | shard.jsonl ...> [--json] [-o trace.json]
+  [--merged merged.jsonl]`` — merge an elastic run's per-process obs
+  shards into one clock-aligned mesh timeline: per-host rollups,
+  per-generation detect→shrink→re-init→resume critical paths, and the
+  committed-window reconciliation; exits 1 when the commit ledger
+  disagrees with itself (:mod:`~sq_learn_tpu.obs.fleet`).
 
 All subcommands are dependency-free file tools (no jax import on the
 comparison/render paths), safe to run with PYTHONPATH cleared while the
@@ -58,9 +64,11 @@ def main(argv=None):
         from .budget import main as run
     elif cmd == "control":
         from .control import main as run
+    elif cmd == "fleet":
+        from .fleet import main as run
     else:
         print(f"unknown subcommand {cmd!r} (expected trace, report, "
-              "regress, audit, frontier, budget, or control)",
+              "regress, audit, frontier, budget, control, or fleet)",
               file=sys.stderr)
         return 2
     return run(rest)

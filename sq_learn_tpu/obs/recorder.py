@@ -26,7 +26,7 @@ Design constraints, in order:
    arrays, so their spans are synced by construction.
 
 JSONL schema: one JSON object per line, every line carrying
-``{"v": 9, "schema_version": 9, "ts": <unix seconds>, "type": <record
+``{"v": 10, "schema_version": 10, "ts": <unix seconds>, "type": <record
 type>}`` plus per-type fields — see :mod:`sq_learn_tpu.obs.schema` (the
 validator) and ``docs/observability.md`` (the prose). ``v`` is the
 original envelope key (kept so pre-2 readers don't break);
@@ -71,7 +71,16 @@ from .. import _knobs
 #     wall-clock and resumed cursor; sq_learn_tpu.parallel.elastic),
 #     and the host_fail / host_stall fault kinds' optional
 #     fault.host / fault.stall_s fields
-SCHEMA_VERSION = 9
+# v10: +the fleet envelope (PR 19: an optional per-record ``fleet``
+#      sub-object — coordinator-minted run_id, host label, pid, live
+#      generation — stamped on every record when SQ_OBS_FLEET_RUN_ID is
+#      set, so N workers' shards merge into one mesh-wide timeline),
+#      +clock record type (one KV-carried clock sample per heartbeat /
+#      manifest / progress exchange; obs.fleet estimates per-host
+#      offsets from them), and the elastic ``window`` / ``commit``
+#      events (per-host fold progress + node-0 commit ledger — the
+#      fold ledger's obs twin that obs.fleet reconciles)
+SCHEMA_VERSION = 10
 
 #: default sink path when SQ_OBS=1 and SQ_OBS_PATH is unset
 DEFAULT_PATH = "sq_obs.jsonl"
@@ -190,7 +199,25 @@ class Recorder:
     plain Python containers, safe to read at any point in the run.
     """
 
-    def __init__(self, path=None):
+    def __init__(self, path=None, run_id=None, host=None):
+        # fleet identity (PR 19): when a coordinator minted a run_id —
+        # via SQ_OBS_FLEET_RUN_ID in a spawned worker's env, or passed
+        # explicitly for a private (non-global) recorder — every record
+        # carries a ``fleet`` envelope so N processes' shards merge into
+        # one causally-ordered mesh timeline (obs.fleet). Without a
+        # run_id the envelope is absent and records stay byte-identical
+        # to a single-process run.
+        rid = (run_id if run_id is not None
+               else _knobs.get_str("SQ_OBS_FLEET_RUN_ID", ""))
+        if rid:
+            self.fleet_run_id = str(rid)
+            self.fleet_host = str(
+                host or _knobs.get_str("SQ_OBS_FLEET_HOST", "")
+                or f"pid{os.getpid()}")
+        else:
+            self.fleet_run_id = None
+            self.fleet_host = str(host) if host else None
+        self.fleet_generation = None
         self.spans = []
         self.counters = {}
         self.gauges = {}
@@ -228,6 +255,11 @@ class Recorder:
         rec.setdefault("v", SCHEMA_VERSION)
         rec.setdefault("schema_version", SCHEMA_VERSION)
         rec.setdefault("ts", round(time.time(), 3))
+        if self.fleet_run_id is not None and "fleet" not in rec:
+            rec["fleet"] = {"run_id": self.fleet_run_id,
+                            "host": self.fleet_host,
+                            "pid": os.getpid(),
+                            "gen": self.fleet_generation}
         with _lock:
             if kind is not None:
                 getattr(self, kind).append(rec)
@@ -236,6 +268,26 @@ class Recorder:
                     self._sink.write(json.dumps(rec) + "\n")
                 except Exception:
                     pass  # a full disk must not kill the fit
+
+    def flush(self, fsync=True):
+        """Flush the JSONL sink to the OS — and, with ``fsync`` (the
+        default), to disk — so a SIGKILL right after loses at most the
+        line currently being written. Elastic workers call this at every
+        commit-window boundary and immediately before ``os._exit``
+        (`docs/resilience.md` §elastic). Returns True when a sink was
+        durably flushed; best-effort like the write path (a full disk
+        must not kill the fit)."""
+        with _lock:
+            sink = self._sink
+            if sink is None:
+                return False
+            try:
+                sink.flush()
+                if fsync:
+                    os.fsync(sink.fileno())
+            except Exception:
+                return False
+            return True
 
     def close(self):
         with _lock:
@@ -302,6 +354,51 @@ def disable():
             write_trace([rec.path], trace_path)
         except Exception:
             pass
+    return rec
+
+
+def flush(fsync=True):
+    """Durably flush the active run's JSONL sink (see
+    :meth:`Recorder.flush`). No-op (False) when disabled or in-memory."""
+    rec = _active
+    if rec is None:
+        return False
+    return rec.flush(fsync=fsync)
+
+
+def set_fleet(run_id=None, host=None):
+    """Adopt (or override) the active recorder's fleet identity.
+
+    The elastic plane threads the coordinator-minted run_id two ways:
+    spawned workers inherit ``SQ_OBS_FLEET_RUN_ID`` via env (picked up
+    at :class:`Recorder` creation), and mesh members that joined through
+    ``distributed.initialize(..., elastic=True)`` adopt it from the KV
+    service through this call — late adoption stamps every *subsequent*
+    record. Returns the recorder, or None when disabled.
+    """
+    rec = _active
+    if rec is None:
+        return None
+    with _lock:
+        if run_id:
+            rec.fleet_run_id = str(run_id)
+        if host:
+            rec.fleet_host = str(host)
+        if rec.fleet_run_id is not None and rec.fleet_host is None:
+            rec.fleet_host = f"pid{os.getpid()}"
+    return rec
+
+
+def set_generation(generation):
+    """Stamp the live elastic generation into the active recorder's
+    fleet envelope (workers call this at every world join, the local
+    sim at every shrink). None clears it; no-op when disabled."""
+    rec = _active
+    if rec is None:
+        return None
+    with _lock:
+        rec.fleet_generation = (None if generation is None
+                                else int(generation))
     return rec
 
 
@@ -508,8 +605,27 @@ def snapshot():
 # The atexit disable flushes the sink and — with SQ_OBS_TRACE set —
 # renders the Chrome trace for runs that never call disable() themselves
 # (bench scripts, one-shot CLIs).
+def _default_path():
+    """Sink path for the auto-enabled run: SQ_OBS_PATH wins; with a
+    fleet directory set instead, this process's shard lands there as
+    ``obs.<host>.jsonl`` (the obs.fleet merge-by-glob layout)."""
+    path = _knobs.get_raw("SQ_OBS_PATH")
+    if path:
+        return path
+    fleet_dir = _knobs.get_str("SQ_OBS_FLEET_DIR", "")
+    if fleet_dir:
+        host = (_knobs.get_str("SQ_OBS_FLEET_HOST", "")
+                or f"pid{os.getpid()}")
+        try:
+            os.makedirs(fleet_dir, exist_ok=True)
+            return os.path.join(fleet_dir, f"obs.{host}.jsonl")
+        except OSError:
+            pass  # unwritable fleet dir degrades to the CWD default
+    return DEFAULT_PATH
+
+
 if _knobs.get_bool("SQ_OBS"):
-    enable(_knobs.get_raw("SQ_OBS_PATH", DEFAULT_PATH))
+    enable(_default_path())
     import atexit
 
     atexit.register(disable)

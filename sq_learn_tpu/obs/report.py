@@ -131,6 +131,30 @@ def summarize(records):
 
     slo = [r for r in records if r.get("type") == "slo"]
 
+    # fleet correlation (v10): which run/hosts/generations the artifact's
+    # records came from — stamped by the recorder's fleet envelope, plus
+    # the elastic window/commit ledger and clock-sample traffic
+    fleet_hosts = {}
+    fleet_runs = set()
+    for r in records:
+        env = r.get("fleet")
+        if isinstance(env, dict):
+            fleet_runs.add(env.get("run_id"))
+            h = env.get("host")
+            fleet_hosts[h] = fleet_hosts.get(h, 0) + 1
+    elastic_recs = [r for r in records if r.get("type") == "elastic"]
+    fleet = {
+        "run_ids": sorted(str(x) for x in fleet_runs if x is not None),
+        "hosts": fleet_hosts,
+        "generations": sorted({r.get("generation") for r in elastic_recs
+                               if isinstance(r.get("generation"), int)}),
+        "commits": sum(1 for r in elastic_recs
+                       if r.get("event") == "commit"),
+        "windows": sum(1 for r in elastic_recs
+                       if r.get("event") == "window"),
+        "clock_samples": by_type.get("clock", 0),
+    }
+
     # AOT-warmed / quantized serving (PR 11): executables minted at warm
     # time vs dispatch-time executable-cache traffic (hits at 100% =
     # zero serving-path compiles), persistent compile-cache reloads, the
@@ -189,6 +213,11 @@ def summarize(records):
         "sketch": sketch,
         "prefetch": prefetch,
         "codec": codec,
+        # the fleet-correlation section (v10): run_id / per-host record
+        # counts from the fleet envelope, the elastic window/commit
+        # ledger, and the clock-sample traffic behind the merged
+        # timeline (full mesh view: python -m sq_learn_tpu.obs fleet)
+        "fleet": fleet,
         # the statistical-observability sections (v3): per-site
         # Clopper–Pearson audit of the (ε, δ) guarantee draws, and the
         # run's accuracy-vs-theoretical-runtime sweep points
@@ -402,6 +431,25 @@ def render(summary, top=12):
                     f"tol = {fold.get('coef_const')} + "
                     f"{fold.get('coef_amax')}*amax_x ({fold.get('kind')}), "
                     f"delta_q {fold.get('delta')}")
+
+    fl = summary.get("fleet") or {}
+    if fl.get("run_ids") or fl.get("hosts") or fl.get("generations"):
+        out("")
+        out("-- fleet (cross-process correlation) --")
+        if fl.get("run_ids"):
+            out("  run_id: " + ", ".join(fl["run_ids"]))
+        if fl.get("hosts"):
+            out("  hosts: " + ", ".join(
+                f"{h}={n}" for h, n in sorted(fl["hosts"].items(),
+                                              key=lambda kv: str(kv[0]))))
+        if fl.get("generations"):
+            gens = ", ".join(f"g{g}" for g in fl["generations"])
+            out(f"  generations: {gens}  "
+                f"({fl.get('windows', 0)} window fold(s), "
+                f"{fl.get('commits', 0)} commit(s))")
+        if fl.get("clock_samples"):
+            out(f"  {fl['clock_samples']} clock sample(s) "
+                f"(merged view: python -m sq_learn_tpu.obs fleet)")
 
     out("")
     out("-- fault / breaker / regression timeline --")

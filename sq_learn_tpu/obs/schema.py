@@ -42,7 +42,18 @@ detected host failure/stall, generation-bumping shrink, refused
 stale-generation commit, stale-worker exit, completion) plus the
 ``host_fail`` / ``host_stall`` fault kinds' optional ``fault.host`` /
 ``fault.stall_s`` fields (which worker index the injector targeted,
-and the injected stall length). Older versions
+and the injected stall length); v10 (PR 19) adds fleet observability
+(:mod:`sq_learn_tpu.obs.fleet`): the optional per-record ``fleet``
+envelope sub-object (``run_id`` str — coordinator-minted, shared by
+every process of one elastic run; ``host`` str — stable per-process
+label, e.g. ``coord`` / ``w0``; ``pid`` int; ``gen`` int | null — the
+live elastic generation), the ``clock`` record type (one KV-carried
+clock sample — a peer's send timestamp paired with the local receive
+timestamp — from which per-host offsets are estimated), and the
+elastic ``window`` / ``commit`` events (per-host fold progress at
+every commit-window boundary, and node 0's committed-window ledger —
+the obs twin of the fold ledger that the fleet merge reconciles).
+Older versions
 still validate (their types are a strict subset), any other version is
 rejected — an unknown version means a reader that would silently
 misinterpret fields, so it must fail loudly.
@@ -144,13 +155,29 @@ control    tenant (str), action (str ∈ {plan, hold, relax, tighten,
            effect of the PREVIOUS decision, closing the loop),
            attrs (object)
 elastic    event (str ∈ {world_up, resume, host_fail, host_stall,
-           shrink, commit_refused, stale_exit, done}),
+           shrink, commit_refused, stale_exit, done, window, commit}),
            generation (int ≥ 0), n_hosts (int ≥ 0) — one elastic-mesh
            world transition (:mod:`sq_learn_tpu.parallel.elastic`);
            optional host / failed_host / cursor / window /
            manifest_generation (int), detect_s / shrink_s / stall_s
-           (number ≥ 0), attrs (object)
+           (number ≥ 0), attrs (object). ``window`` (v10) is one
+           host's folded commit window (host, window, cursor);
+           ``commit`` (v10) is node 0's committed window (window,
+           cursor) — exactly one per window across the whole fleet
+clock      peer (str), sent_ts (number), recv_ts (number) — one clock
+           sample carried over an existing KV exchange (heartbeat /
+           manifest / progress): ``sent_ts`` is the peer's clock when
+           it published, ``recv_ts`` the local clock at observation;
+           ``recv_ts − sent_ts`` upper-bounds the local−peer offset
+           (one-way), pairs of opposite-direction minima give the
+           midpoint estimate (:mod:`sq_learn_tpu.obs.fleet`); optional
+           generation (int ≥ 0), via (str)
 =========  ==============================================================
+
+Every record may additionally carry the v10 ``fleet`` envelope
+sub-object (run_id str, host str, pid int, gen int | null) — stamped by
+the recorder when a fleet identity is active, validated whenever
+present.
 
 The out-of-core layer (PR 8) rides the generic types rather than minting
 new ones: shard-store reads surface as ``counter`` records
@@ -180,8 +207,10 @@ _NUM = (int, float)
 #: slo.transfer_bytes; v5 = PR 11's, without budget/alert; v6 = PR 12's,
 #: without the codec/spill counter conventions; v7 = PR 13's, without
 #: control or the budget/alert seq fields; v8 = PR 17's, without the
-#: elastic type or the fault.host/fault.stall_s fields)
-KNOWN_VERSIONS = {1, 2, 3, 4, 5, 6, 7, 8, SCHEMA_VERSION}
+#: elastic type or the fault.host/fault.stall_s fields; v9 = PR 18's,
+#: without the fleet envelope, the clock type, or the elastic
+#: window/commit events)
+KNOWN_VERSIONS = {1, 2, 3, 4, 5, 6, 7, 8, 9, SCHEMA_VERSION}
 
 #: every record type the schema defines, machine-readable. The static
 #: checker (:mod:`sq_learn_tpu.analysis`, rule ``obs-schema``) and the
@@ -190,11 +219,12 @@ KNOWN_VERSIONS = {1, 2, 3, 4, 5, 6, 7, 8, SCHEMA_VERSION}
 RECORD_TYPES = (
     "meta", "span", "counter", "gauge", "ledger", "watchdog", "probe",
     "fault", "breaker", "xla_cost", "regression", "guarantee", "tradeoff",
-    "slo", "budget", "alert", "control", "elastic",
+    "slo", "budget", "alert", "control", "elastic", "clock",
 )
 
 _ELASTIC_EVENTS = {"world_up", "resume", "host_fail", "host_stall",
-                   "shrink", "commit_refused", "stale_exit", "done"}
+                   "shrink", "commit_refused", "stale_exit", "done",
+                   "window", "commit"}
 
 _CONTROL_ACTIONS = {"plan", "hold", "relax", "tighten", "degrade",
                     "recover"}
@@ -535,9 +565,39 @@ def validate_record(rec):
         if "attrs" in rec:
             _check(isinstance(rec["attrs"], dict), errors,
                    "elastic.attrs object")
+    elif t == "clock":
+        _check(isinstance(rec.get("peer"), str), errors, "clock.peer str")
+        for field in ("sent_ts", "recv_ts"):
+            _check(isinstance(rec.get(field), _NUM)
+                   and not isinstance(rec.get(field), bool), errors,
+                   f"clock.{field} number")
+        if "generation" in rec:
+            _check(isinstance(rec["generation"], int)
+                   and not isinstance(rec["generation"], bool)
+                   and rec["generation"] >= 0, errors,
+                   "clock.generation non-negative int")
+        if "via" in rec:
+            _check(isinstance(rec["via"], str), errors, "clock.via str")
     else:
         errors.append(
             f"unknown record type {t!r} (known: {sorted(RECORD_TYPES)})")
+    if "fleet" in rec:
+        fl = rec["fleet"]
+        if not isinstance(fl, dict):
+            errors.append("fleet envelope must be an object")
+        else:
+            _check(isinstance(fl.get("run_id"), str), errors,
+                   "fleet.run_id str")
+            _check(isinstance(fl.get("host"), str), errors,
+                   "fleet.host str")
+            _check(isinstance(fl.get("pid"), int)
+                   and not isinstance(fl.get("pid"), bool), errors,
+                   "fleet.pid int")
+            g = fl.get("gen", None)
+            _check(g is None or (isinstance(g, int)
+                                 and not isinstance(g, bool)
+                                 and g >= 0), errors,
+                   "fleet.gen non-negative int or null")
     return errors
 
 

@@ -6,9 +6,13 @@ tomography shots in the ledger), a tiny served tenant with a
 declared SLO (per-tenant ``slo`` + error-budget ``budget`` records, plus
 the control plane's close-time ``control`` records), and a
 fault-injected shrink of the elastic mesh's in-process simulator
-(``elastic`` transition records + host-targeted ``fault`` records,
-schema v9) under an active recorder, then validates the emitted JSONL
-against :mod:`sq_learn_tpu.obs.schema` (legacy v1–v8 records must keep
+(``elastic`` transition records — including the v10 ``window`` /
+``commit`` fold-ledger events — plus host-targeted ``fault`` records)
+under an active recorder carrying a fleet identity (schema v10: every
+record gains the ``fleet`` envelope, a ``clock`` sample lands, and
+:mod:`sq_learn_tpu.obs.fleet` must reconcile the artifact's commit
+ledger), then validates the emitted JSONL against
+:mod:`sq_learn_tpu.obs.schema` (legacy v1–v9 records must keep
 validating) and asserts the run artifact carries the signals the layer
 exists for. Exit code 0 = contract holds; 1 = schema or content
 violation (printed).
@@ -31,12 +35,15 @@ def main():
 
     import numpy as np
 
-    from . import disable, enable, ledger, watchdog
+    from . import disable, enable, ledger, set_fleet, watchdog
     from .schema import validate_jsonl
 
     path = _knobs.get_raw("SQ_OBS_PATH", "/tmp/sq_obs_smoke.jsonl")
     open(path, "w").close()  # truncate any previous smoke artifact
     enable(path)  # fresh run: resets the watchdog, reopens the sink
+    # v10 contract: a fleet identity stamps every subsequent record with
+    # the envelope the mesh-timeline merge correlates shards by
+    set_fleet("obs-smoke-fleet", host="sim")
 
     rng = np.random.default_rng(0)
     X = rng.normal(size=(2048, 64)).astype(np.float32)
@@ -101,6 +108,13 @@ def main():
                                          epochs=1, window=4)
     finally:
         faults.disarm()
+
+    # v10 contract: one clock sample through the elastic plane's
+    # emitter — the record type obs.fleet aligns mesh timelines with
+    import time as _time
+
+    _now = _time.time()
+    elastic._emit_clock("w1", _now - 1e-3, _now, 0, "hb")
 
     report = watchdog.report()
     totals = ledger.totals()
@@ -177,7 +191,7 @@ def main():
                         f"{eres['shrinks']}/{eres['generation']}")
     e_events = [r.get("event") for r in rec.elastic_records]
     for ev in ("world_up", "host_stall", "host_fail", "shrink",
-               "resume", "done"):
+               "resume", "done", "window", "commit"):
         if ev not in e_events:
             failures.append(f"no elastic {ev} record from the sim leg")
     if not any(r.get("kind") in ("host_fail", "host_stall")
@@ -185,6 +199,26 @@ def main():
                for r in rec.fault_events):
         failures.append("no host-targeted fault records from the "
                         "elastic leg")
+    # v10 contract: every elastic record carries the fleet envelope
+    # (run_id + live generation), a clock sample landed, and the fleet
+    # merge reconciles the artifact's commit ledger against itself
+    if summary["by_type"].get("clock", 0) <= 0:
+        failures.append("no clock records in the artifact")
+    if not any(isinstance(r.get("fleet"), dict)
+               and r["fleet"].get("run_id") == "obs-smoke-fleet"
+               and r["fleet"].get("gen") == 1
+               for r in rec.elastic_records):
+        failures.append("no elastic record carries the fleet envelope "
+                        "with the post-shrink generation")
+    from .fleet import summarize as fleet_summarize
+
+    fsum = fleet_summarize([path])
+    if fsum["run_ids"] != ["obs-smoke-fleet"]:
+        failures.append(f"fleet merge lost the run_id: {fsum['run_ids']}")
+    frc = fsum["reconciliation"]
+    if not frc["ok"] or frc["windows"] != 3:
+        failures.append(f"fleet commit-ledger reconciliation broken: "
+                        f"{frc}")
     from .schema import validate_record
 
     legacy = [
@@ -206,6 +240,11 @@ def main():
         {"v": 8, "schema_version": 8, "ts": 0.0, "type": "control",
          "tenant": "t", "action": "hold", "seq": 0, "level": 0,
          "inputs": {"burn": 0.1}, "decision": {"route": "device"}},
+        # v9 (pre-fleet): elastic records without the fleet envelope,
+        # the clock type, or the window/commit events
+        {"v": 9, "schema_version": 9, "ts": 0.0, "type": "elastic",
+         "event": "host_fail", "generation": 0, "n_hosts": 3,
+         "failed_host": 2, "window": 3, "detect_s": 0.5},
     ]
     for r_ in legacy:
         errs = validate_record(r_)

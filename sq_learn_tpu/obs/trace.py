@@ -50,6 +50,7 @@ _LANES = {
     "alert": (12, "budget alerts"),
     "control": (13, "controller decisions"),
     "elastic": (14, "elastic mesh"),
+    "clock": (15, "clock samples"),
 }
 
 #: records that move onto a per-tenant lane when they carry a tenant
@@ -143,6 +144,8 @@ def _instant_name(rec):
     if t == "elastic":
         return (f"elastic {rec.get('event')} g{rec.get('generation')} "
                 f"n={rec.get('n_hosts')}")
+    if t == "clock":
+        return f"clock {rec.get('peer')} via {rec.get('via', '?')}"
     return t
 
 

@@ -212,6 +212,38 @@ def _init_elastic(address, num_processes, process_id, generation,
     _WORLD.update(generation=int(generation), elastic=True, client=client,
                   service=None, num_processes=int(num_processes),
                   process_id=int(process_id), address=address)
+    _adopt_fleet_run_id(client, int(generation))
+
+
+def _adopt_fleet_run_id(client, generation, timeout_ms=1000):
+    """Thread the fleet run_id through the world's KV store (PR 19):
+    a member that already carries one (spawned with
+    ``SQ_OBS_FLEET_RUN_ID``) publishes it; a member that joined without
+    (a hand-launched replacement, or a bench harness driving
+    ``initialize(..., elastic=True)`` directly) adopts the first
+    publisher's via :func:`sq_learn_tpu.obs.recorder.set_fleet` — so
+    every shard of the mesh correlates under ONE id regardless of how
+    its process was started. Best-effort by design: telemetry plumbing
+    must never fail a world join."""
+    try:
+        from ..obs import recorder as _obs_recorder
+
+        rec = _obs_recorder.get_recorder()
+        if rec is None:
+            return  # obs off: nothing to stamp, don't wait on the KV
+        own = rec.fleet_run_id
+        key = "fleet/run_id"
+        if own:
+            try:
+                client.key_value_set(key, str(own))
+            except Exception:
+                pass  # a peer published first; the get below adopts
+        agreed = client.blocking_key_value_get(key, int(timeout_ms))
+        if agreed:
+            _obs_recorder.set_fleet(run_id=agreed)
+            _obs_recorder.set_generation(int(generation))
+    except Exception:
+        pass  # no recorder / no publisher inside the timeout: stay local
 
 
 def shutdown(*, barrier=True):

@@ -22,9 +22,17 @@ makes host failure a *handled, observable, resumable* event:
   re-forms an (N-1)-world on a fresh port with a bumped generation, and
   the fit resumes from the committed checkpoint — **bit-for-bit equal**
   to an uninterrupted (N-1)-host run of the same plan;
-- every transition lands a schema-v9 ``elastic`` obs record
+- every transition lands a schema-v10 ``elastic`` obs record
   (generation, failed host, detection latency, shrink wall-clock,
-  resumed cursor) with a per-generation trace lane.
+  resumed cursor) with a per-generation trace lane; under the PR 19
+  fleet contract every process's records additionally carry the
+  coordinator-minted ``fleet`` envelope (run_id / host / pid / live
+  generation), clock samples piggyback on the existing KV exchanges
+  (heartbeats, manifests, progress commits), per-host ``window`` and
+  node-0 ``commit`` events mirror the fold ledger, and each worker
+  durably flushes its shard at every commit-window boundary and before
+  ``os._exit`` — :mod:`sq_learn_tpu.obs.fleet` merges the shards into
+  one clock-aligned mesh timeline and reconciles the commit ledger.
 
 Topology-invariant state (the parity argument)
 ----------------------------------------------
@@ -112,9 +120,11 @@ _FMT = "elastic-qkm-v1"
 #: exits INJECTED so logs distinguish the scripted death from a crash
 EXIT_OK, EXIT_STALE, EXIT_INJECTED = 0, 3, 17
 
-#: the ``elastic`` obs record's event vocabulary (schema v9)
+#: the ``elastic`` obs record's event vocabulary (schema v10: v9's
+#: transitions plus the per-host ``window`` fold-progress events and
+#: node 0's ``commit`` ledger — the obs twin obs.fleet reconciles)
 EVENTS = ("world_up", "resume", "host_fail", "host_stall", "shrink",
-          "commit_refused", "stale_exit", "done")
+          "commit_refused", "stale_exit", "done", "window", "commit")
 
 
 class ElasticError(RuntimeError):
@@ -151,14 +161,30 @@ def _default_window():
     return max(1, _knobs.get_int("SQ_ELASTIC_WINDOW"))
 
 
-def _emit(event, generation, n_hosts, **fields):
-    rec = _recorder.get_recorder()
+def _emit(event, generation, n_hosts, rec=None, **fields):
+    rec = rec if rec is not None else _recorder.get_recorder()
     if rec is None:
         return
     rec.record(dict({"type": "elastic", "event": str(event),
                      "generation": int(generation),
                      "n_hosts": int(n_hosts)}, **fields),
                kind="elastic_records")
+
+
+def _emit_clock(peer, sent_ts, recv_ts, generation, via, rec=None):
+    """One KV-carried clock sample (schema-v10 ``clock`` record): a
+    value stamped ``time.time()`` by ``peer`` was observed locally at
+    ``recv_ts``, so ``recv_ts - sent_ts`` upper-bounds how far this
+    process's clock runs ahead of the peer's (the message can only age
+    in flight). :func:`sq_learn_tpu.obs.fleet.clock_offsets` takes the
+    minimum over samples and pairs the two directions — no extra
+    messages beyond the exchanges the elastic plane already does."""
+    rec = rec if rec is not None else _recorder.get_recorder()
+    if rec is None:
+        return
+    rec.record({"type": "clock", "peer": str(peer),
+                "sent_ts": float(sent_ts), "recv_ts": float(recv_ts),
+                "generation": int(generation), "via": str(via)})
 
 
 # ---------------------------------------------------------------------------
@@ -302,6 +328,7 @@ def elastic_fit_local(source, n_clusters, *, n_hosts=1, seed=0, epochs=1,
         state, cursor = new_state(k, m, n_shards,
                                   init_centers(source, k, seed)), 0
     hosts = list(range(int(n_hosts)))
+    _recorder.set_generation(gen)
     _emit("world_up", gen, len(hosts))
     _emit("resume", gen, len(hosts), cursor=int(cursor))
     total = int(epochs) * n_shards
@@ -332,6 +359,7 @@ def elastic_fit_local(source, n_clusters, *, n_hosts=1, seed=0, epochs=1,
             hosts.remove(dead)
             shrinks += 1
             gen += 1
+            _recorder.set_generation(gen)
             _emit("shrink", gen, len(hosts), failed_host=dead,
                   shrink_s=0.0)
             _emit("world_up", gen, len(hosts))
@@ -348,6 +376,8 @@ def elastic_fit_local(source, n_clusters, *, n_hosts=1, seed=0, epochs=1,
         for p in range(w_lo, w_hi):
             fold_partial(state, int(order[p]), partials[p])
         cursor = epoch * n_shards + w_hi
+        _emit("window", gen, len(hosts), window=w_idx, cursor=int(cursor))
+        _emit("commit", gen, len(hosts), window=w_idx, cursor=int(cursor))
         if ckpt_path:
             from ..utils.checkpoint import save_stream_state
 
@@ -355,6 +385,7 @@ def elastic_fit_local(source, n_clusters, *, n_hosts=1, seed=0, epochs=1,
                               commit_fingerprint(base, gen))
     assert (state["folds"] == int(epochs)).all(), state["folds"]
     _emit("done", gen, len(hosts), cursor=int(cursor))
+    _recorder.set_generation(None)
     return {"centers": state["centers"], "counts": state["counts"],
             "inertia": float(state["inertia"]), "folds": state["folds"],
             "generation": gen, "n_hosts": len(hosts), "shrinks": shrinks}
@@ -462,14 +493,22 @@ class LeaseSupervisor:
     expiring, i.e. the peer is declared dead. XLA's own
     missed-heartbeat machinery is parked out of the way (see
     :mod:`.distributed`); this layer owns the failure timeline and
-    feeds the PR 3 circuit breaker at every declaration."""
+    feeds the PR 3 circuit breaker at every declaration.
+
+    Heartbeat values carry the publisher's ``time.time()`` (PR 19):
+    liveness still only checks key EXISTENCE, but the publisher thread
+    also reads its ``peers``' fresh heartbeats with a tiny timeout and
+    turns each into a ``clock`` record — the samples
+    :func:`sq_learn_tpu.obs.fleet.clock_offsets` aligns the mesh
+    timeline with, at zero extra protocol messages."""
 
     #: lock-discipline contract (``sq_learn_tpu.analysis``): the
     #: publisher thread and the fit thread share only these, written
     #: under the lock.
     _GUARDED_BY = {"_lock": ("_stop", "_seq")}
 
-    def __init__(self, client, generation, host_id, heartbeat_s=None):
+    def __init__(self, client, generation, host_id, heartbeat_s=None,
+                 peers=()):
         self._client = client
         self._gen = int(generation)
         self._host = int(host_id)
@@ -479,6 +518,15 @@ class LeaseSupervisor:
         self._stop = False
         self._seq = 0
         self._last_seen = {}  # fit-thread-only: peer -> last seen seq
+        # publisher-thread-only (like _last_seen is fit-thread-only; KV
+        # reads are idempotent so the two frontiers never interfere):
+        # per-peer heartbeat read frontier + remaining clock-sample
+        # budget (SQ_OBS_FLEET_CLOCK_SAMPLES per peer per generation)
+        self._clock_peers = [int(p) for p in peers
+                             if int(p) != self._host]
+        self._clock_next = {p: 1 for p in self._clock_peers}
+        budget = max(0, _knobs.get_int("SQ_OBS_FLEET_CLOCK_SAMPLES"))
+        self._clock_left = {p: budget for p in self._clock_peers}
         self._thread = threading.Thread(
             target=self._run, daemon=True,
             name=f"sq-elastic-lease-w{self._host}")
@@ -496,10 +544,37 @@ class LeaseSupervisor:
                 seq = self._seq
             try:
                 self._client.key_value_set(
-                    f"elastic/g{self._gen}/hb/{self._host}/{seq}", "1")
+                    f"elastic/g{self._gen}/hb/{self._host}/{seq}",
+                    str(time.time()))
             except Exception:
                 return  # world tearing down: never crash the fit thread
+            try:
+                self._sample_peer_clocks()
+            except Exception:
+                pass  # clock sampling is best-effort telemetry
             time.sleep(self._hb_s)
+
+    def _sample_peer_clocks(self):
+        """Drain each peer's already-published heartbeats (tiny timeout
+        — the publisher must never block on a dead peer) and emit one
+        ``clock`` record per fresh key, up to the per-peer budget."""
+        for peer in self._clock_peers:
+            nxt = self._clock_next[peer]
+            while self._clock_left[peer] > 0:
+                key = f"elastic/g{self._gen}/hb/{peer}/{nxt}"
+                try:
+                    val = self._client.blocking_key_value_get(key, 5)
+                except Exception:
+                    break  # frontier: the peer hasn't published nxt yet
+                recv = time.time()
+                nxt += 1
+                try:
+                    sent = float(val)
+                except (TypeError, ValueError):
+                    continue  # unparsable value: count it seen, no sample
+                self._clock_left[peer] -= 1
+                _emit_clock(f"w{peer}", sent, recv, self._gen, "hb")
+            self._clock_next[peer] = nxt
 
     def stop(self):
         with self._lock:
@@ -685,14 +760,25 @@ def _run_generation(run_dir, source, plan, state, cursor, *, gen, members,
         for p in range(w_lo, w_hi):
             fold_partial(state, int(order[p]), partials[p])
         cursor = epoch * n_shards + w_hi
+        _emit("window", gen, n, host=int(worker_index), window=w_idx,
+              cursor=int(cursor))
         if node_id == 0:
             check_commit_generation(run_dir, gen)
             save_stream_state(ckpt, state, cursor,
                               commit_fingerprint(base, gen))
+            # the ts doubles as a coordinator-side clock sample
+            # (via="progress"): the parent reads it at its next poll
             _write_json_atomic(
                 os.path.join(run_dir, "progress.json"),
                 {"cursor": int(cursor), "generation": int(gen),
-                 "epoch": int(epoch)})
+                 "epoch": int(epoch), "ts": time.time()})
+            _emit("commit", gen, n, host=int(worker_index),
+                  window=w_idx, cursor=int(cursor))
+        # crash-safe telemetry: durably flush this worker's shard at
+        # every commit-window boundary, so a SIGKILL loses at most the
+        # in-flight window's lines — the victim's last flushed
+        # ``window`` record is its provable progress
+        _flush_obs()
     return cursor
 
 
@@ -719,6 +805,11 @@ def _worker_main(run_dir, worker_index):
     while True:
         man = _await_manifest(run_dir, last_gen + 1)
         gen = int(man["generation"])
+        _recorder.set_generation(gen)
+        if isinstance(man.get("ts"), (int, float)):
+            # the coordinator stamped the manifest at write time: its
+            # first observation here is a worker->coord clock sample
+            _emit_clock("coord", man["ts"], time.time(), gen, "manifest")
         members = [int(x) for x in man["members"]]
         if worker_index not in members:
             _emit("stale_exit", gen, len(members), host=worker_index)
@@ -729,7 +820,8 @@ def _worker_main(run_dir, worker_index):
                         generation=gen, elastic=True)
         client = dist.world_client()
         lease = LeaseSupervisor(client, gen, worker_index,
-                                cfg["heartbeat_s"]).start()
+                                cfg["heartbeat_s"],
+                                peers=members).start()
         _certify_world(dist.global_mesh(), seed, gen)
         shrink_s = (time.monotonic() - abort_t) if abort_t is not None \
             else 0.0
@@ -804,25 +896,23 @@ def _xla_device_flags(devices_per_host):
 
 
 def collect_elastic_records(run_dir):
-    """All ``elastic`` obs records of a run's workers, in file order —
+    """All ``elastic`` obs records of a run's workers, in worker order —
     what the smoke/bench mine for detection latency and shrink
-    wall-clock."""
+    wall-clock. A thin view over the PR 19 fleet loader (which subsumes
+    it: :func:`sq_learn_tpu.obs.fleet.summarize` has the merged,
+    clock-aligned picture); the coordinator shard is deliberately
+    excluded so the mined latencies stay worker-observed."""
+    from ..obs import fleet as _fleet
+
     out = []
-    for name in sorted(os.listdir(run_dir)):
-        if not (name.startswith("obs.w") and name.endswith(".jsonl")):
+    for host, records in _fleet.load_shards(run_dir):
+        if not (host.startswith("w") and host[1:].isdigit()):
             continue
-        with open(os.path.join(run_dir, name)) as fh:
-            for line in fh:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rec = json.loads(line)
-                except ValueError:
-                    continue  # torn tail of a SIGKILLed worker
-                if rec.get("type") == "elastic":
-                    rec["_worker"] = name[len("obs.w"):-len(".jsonl")]
-                    out.append(rec)
+        for rec in records:
+            if rec.get("type") == "elastic":
+                rec = dict(rec)
+                rec["_worker"] = host[1:]
+                out.append(rec)
     return out
 
 
@@ -863,6 +953,13 @@ class ElasticCoordinator:
                                  else _heartbeat_s())
         self.lease_s = float(lease_s if lease_s is not None else _lease_s())
         self.obs = bool(obs)
+        # the fleet run_id (PR 19): minted here, inherited by every
+        # spawned worker via env — an outer SQ_OBS_FLEET_RUN_ID (e.g. a
+        # bench parent already inside a fleet) wins so nested runs stay
+        # correlated under one id
+        self.run_id = (_knobs.get_str("SQ_OBS_FLEET_RUN_ID", "")
+                       or f"elastic-{os.urandom(4).hex()}")
+        self._obs_rec = None
         self.procs = {}
         self.timeline = []
 
@@ -884,6 +981,10 @@ class ElasticCoordinator:
             env["SQ_OBS"] = "1"
             env["SQ_OBS_PATH"] = os.path.join(
                 self.run_dir, f"obs.w{worker_index}.jsonl")
+            # fleet correlation (PR 19): the worker's recorder stamps
+            # the coordinator-minted run_id + host label on every record
+            env["SQ_OBS_FLEET_RUN_ID"] = str(self.run_id)
+            env["SQ_OBS_FLEET_HOST"] = f"w{worker_index}"
             env.pop("SQ_OBS_TRACE", None)
         env.update(self.worker_env)
         log = open(os.path.join(self.run_dir,
@@ -907,8 +1008,10 @@ class ElasticCoordinator:
             f"127.0.0.1:{port}", len(members)))
         _write_json_atomic(
             os.path.join(self.run_dir, f"manifest.g{gen}.json"),
-            {"generation": gen, "port": port, "members": members})
-        _emit("shrink", gen, len(members), failed_host=int(dead[0]))
+            {"generation": gen, "port": port, "members": members,
+             "ts": time.time()})
+        _emit("shrink", gen, len(members), rec=self._obs_rec,
+              failed_host=int(dead[0]))
         self._mark("shrink", generation=gen, members=members, dead=dead)
         return gen, members
 
@@ -916,6 +1019,13 @@ class ElasticCoordinator:
         from . import distributed as dist
 
         os.makedirs(self.run_dir, exist_ok=True)
+        if self.obs and self._obs_rec is None:
+            # PRIVATE recorder, never the global enable(): a bench
+            # parent owns the process-global sink, and the coordinator
+            # shard must land in the run directory next to the workers'
+            self._obs_rec = _recorder.Recorder(
+                os.path.join(self.run_dir, "obs.coord.jsonl"),
+                run_id=self.run_id, host="coord")
         _write_json_atomic(
             os.path.join(self.run_dir, "config.json"),
             {"store": self.store_path, "n_clusters": self.n_clusters,
@@ -930,12 +1040,14 @@ class ElasticCoordinator:
             f"127.0.0.1:{port}", len(members)))
         _write_json_atomic(
             os.path.join(self.run_dir, "manifest.g0.json"),
-            {"generation": 0, "port": port, "members": members})
+            {"generation": 0, "port": port, "members": members,
+             "ts": time.time()})
         for i in members:
             self.procs[i] = self._spawn(i)
         self._mark("launched", members=list(members))
         result_json = os.path.join(self.run_dir, "result.json")
         shrinks, killed, kill_done = 0, [], self.kill is None
+        last_prog_ts = 0.0
         t0 = time.monotonic()
         try:
             while True:
@@ -943,14 +1055,22 @@ class ElasticCoordinator:
                     raise ElasticError(
                         f"elastic run did not finish in {timeout_s}s "
                         f"(gen {gen}, members {members})")
+                prog = None
+                try:
+                    with open(os.path.join(self.run_dir,
+                                           "progress.json")) as fh:
+                        prog = json.load(fh)
+                except (OSError, ValueError):
+                    pass
+                if prog and isinstance(prog.get("ts"), (int, float)) \
+                        and prog["ts"] > last_prog_ts:
+                    # node 0's commit stamp, first observed here: a
+                    # coord->node0 clock sample at zero extra messages
+                    last_prog_ts = float(prog["ts"])
+                    _emit_clock(f"w{members[0]}", prog["ts"], time.time(),
+                                prog.get("generation", gen), "progress",
+                                rec=self._obs_rec)
                 if not kill_done:
-                    prog = None
-                    try:
-                        with open(os.path.join(self.run_dir,
-                                               "progress.json")) as fh:
-                            prog = json.load(fh)
-                    except (OSError, ValueError):
-                        pass
                     if prog and prog["cursor"] >= int(self.kill[1]):
                         victim = int(self.kill[0])
                         os.kill(self.procs[victim].pid, signal.SIGKILL)
@@ -985,6 +1105,10 @@ class ElasticCoordinator:
                     p.kill()
             for p in self.procs.values():
                 p.wait(timeout=30)
+            if self._obs_rec is not None:
+                self._obs_rec.flush()
+                self._obs_rec.close()
+                self._obs_rec = None
         with open(result_json) as fh:
             summary = json.load(fh)
         with np.load(os.path.join(self.run_dir, "result.npz")) as npz:

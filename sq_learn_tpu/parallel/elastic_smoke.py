@@ -18,10 +18,20 @@
    committed checkpoint, and finish **bit-identical to the
    uninterrupted run** with every shard folded exactly ``epochs`` times
    (zero lost, zero double-folded);
-5. validate every worker's obs JSONL against schema v9 and assert the
+5. validate every worker's obs JSONL against schema v10 and assert the
    elastic transition records (``world_up``/``host_fail``/``resume``/
    ``done`` across generations 0 and 1) carry the detection latency
-   and shrink wall-clock the bench mines.
+   and shrink wall-clock the bench mines;
+6. merge the run's per-process shards (coordinator + all three
+   workers) into ONE fleet timeline (:mod:`sq_learn_tpu.obs.fleet`):
+   every shard carries the same coordinator-minted run_id, the merged
+   ``ts_fleet`` is monotone (clock-aligned from the KV-piggybacked
+   samples), the SIGKILLed worker's shard still holds its fold
+   progress up to its last pre-kill flush (crash-safe telemetry), the
+   commit ledger reconciles (every committed window exactly once, no
+   gaps), generation 1 has a detect→shrink→re-init→resume critical
+   path — and the merged timeline is archived (schema-v10-valid)
+   outside the scratch dir before it is removed.
 
 Prints one JSON summary line; exit 0 = contract holds, 1 = violation.
 """
@@ -33,6 +43,8 @@ import sys
 import tempfile
 
 import numpy as np
+
+from .. import _knobs
 
 
 def main():
@@ -144,6 +156,60 @@ def main():
             if s["errors"]:
                 failures.append(f"worker {w} JSONL schema errors: "
                                 f"{s['errors'][:3]}")
+
+        # -- 5) one mesh-wide fleet timeline -----------------------------
+        from ..obs import fleet
+
+        shards = fleet.load_shards(run3)
+        fsum = fleet.summarize(shards)
+        summary["fleet"] = {
+            "run_ids": fsum["run_ids"], "hosts": fsum["hosts"],
+            "generations": fsum["generations"],
+            "clock_offsets_s": fsum["clock_offsets_s"],
+            "critical_path": fsum["critical_path"],
+            "reconciliation": fsum["reconciliation"]}
+        if len(fsum["run_ids"]) != 1:
+            failures.append(f"shards disagree on the fleet run_id: "
+                            f"{fsum['run_ids']}")
+        if set(fsum["hosts"]) != {"coord", "w0", "w1", "w2"}:
+            failures.append(f"fleet merge does not cover coordinator + "
+                            f"all workers: {fsum['hosts']}")
+        merged = fleet.merge(shards)
+        ts_fleet = [r["ts_fleet"] for r in merged]
+        if ts_fleet != sorted(ts_fleet):
+            failures.append("merged timeline not monotone in ts_fleet")
+        # crash-safe telemetry: the SIGKILLed worker's shard must still
+        # hold its fold progress up to the last pre-kill flush
+        if not any(r["_host"] == "w2" and r.get("type") == "elastic"
+                   and r.get("event") == "window" for r in merged):
+            failures.append("the victim's shard lost its flushed "
+                            "window records")
+        # the commit ledger's obs twin: every committed window exactly
+        # once across hosts and generations, no gaps
+        n_windows = epochs * (-(-n_shards // window))
+        frc = fsum["reconciliation"]
+        if not frc["ok"] or frc["windows"] != n_windows:
+            failures.append(f"commit-ledger reconciliation broken "
+                            f"(want {n_windows} windows): {frc}")
+        cp = [p for p in fsum["critical_path"] if p["generation"] == 1]
+        if not cp or not isinstance(cp[0]["total_s"], (int, float)) \
+                or cp[0]["total_s"] <= 0:
+            failures.append(f"no generation-1 shrink critical path: "
+                            f"{fsum['critical_path']}")
+        if not any(r.get("type") == "clock" and r["_host"] in
+                   ("w0", "w1") for r in merged):
+            failures.append("no survivor recorded a clock sample")
+        # archive the merged, clock-aligned timeline before the scratch
+        # dir goes away (CI keeps it as the run's fleet artifact)
+        out_dir = (_knobs.get_raw("SQ_OOC_BENCH_ARTIFACT_DIR")
+                   or tempfile.gettempdir())
+        merged_path = os.path.join(out_dir, "elastic_fleet_merged.jsonl")
+        fleet.write_merged(shards, merged_path)
+        sm = validate_jsonl(merged_path)
+        if sm["errors"]:
+            failures.append(f"merged fleet timeline schema errors: "
+                            f"{sm['errors'][:3]}")
+        summary["merged"] = merged_path
     finally:
         shutil.rmtree(base, ignore_errors=True)
 

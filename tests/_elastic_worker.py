@@ -21,6 +21,7 @@ not run its destructor concurrently with interpreter teardown (see
 sq_learn_tpu/parallel/elastic.py on the QFATAL race).
 """
 
+import json
 import os
 import sys
 
@@ -60,9 +61,26 @@ def main():
     from sq_learn_tpu.parallel import distributed as dist
 
     if mode == "reinit":
+        import tempfile
+
+        from sq_learn_tpu import obs
+        from sq_learn_tpu.obs import recorder as obs_recorder
+
         addr0 = f"localhost:{sys.argv[3]}"
         addr1 = f"localhost:{sys.argv[4]}"
+        # fleet correlation (ISSUE 19): worker 0 carries a run_id, worker
+        # 1 joins without one — the KV adoption in initialize() must land
+        # them on ONE id, and every world join must stamp the generation
+        obs_path = os.path.join(
+            tempfile.mkdtemp(prefix=f"sq_fleet_w{pid}_"),
+            f"obs.w{pid}.jsonl")
+        obs.enable(obs_path)
+        obs_recorder.set_fleet("fleet-mp-test" if pid == 0 else None,
+                               host=f"w{pid}")
         dist.initialize(addr0, 2, pid, generation=0, elastic=True)
+        rec = obs_recorder.get_recorder()
+        assert rec.fleet_run_id == "fleet-mp-test", rec.fleet_run_id
+        assert rec.fleet_generation == 0, rec.fleet_generation
         # same generation again: idempotent no-op
         dist.initialize(addr0, 2, pid, generation=0, elastic=True)
         try:
@@ -80,8 +98,21 @@ def main():
         # the SAME process re-forms as the next generation
         dist.initialize(addr1, 2, pid, generation=1, elastic=True)
         assert dist.generation() == 1
+        assert obs_recorder.get_recorder().fleet_generation == 1
         assert psum_total(2) == 8.0
         dist.shutdown()
+        # crash-safe barrier: durably flush the shard before os._exit,
+        # then prove the envelope landed on disk (the meta record
+        # predates adoption on worker 1, so filter to stamped records)
+        obs_recorder.record_span("fleet_mp_probe", 0.0)
+        assert obs_recorder.flush(fsync=True) is True
+        obs.disable()
+        with open(obs_path) as f:
+            envs = [json.loads(line).get("fleet") for line in f]
+        stamped = [e for e in envs if e]
+        assert stamped and all(e["run_id"] == "fleet-mp-test" and
+                               e["host"] == f"w{pid}"
+                               for e in stamped), envs
         print(f"worker {pid} REINIT OK", flush=True)
         os._exit(0)
 

@@ -93,7 +93,11 @@ def test_elastic_shutdown_and_reinit_next_generation():
     down and re-forms the next generation IN THE SAME PROCESSES — join
     g0 (service hosted here, outside the mesh), prove same-generation
     re-init is a no-op and a different generation while live raises,
-    psum, shutdown, join g1 on a fresh service, psum again."""
+    psum, shutdown, join g1 on a fresh service, psum again. ISSUE 19
+    rides along inside the worker: worker 1 joins without a fleet
+    run_id and must adopt worker 0's through the world's KV store,
+    both re-stamp the generation at every join, and the fsync'd shard
+    carries the envelope on disk before ``os._exit``."""
     from sq_learn_tpu.parallel import distributed as dist
 
     p0, p1 = _free_port(), _free_port()

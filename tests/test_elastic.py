@@ -321,5 +321,72 @@ class TestSchemaV9:
         from sq_learn_tpu.obs.schema import validate_record
 
         assert any("unknown schema version" in e for e in validate_record(
-            {"v": 10, "schema_version": 10, "ts": 0.0, "type": "meta",
-             "pid": 1, "schema": 10}))
+            {"v": 99, "schema_version": 99, "ts": 0.0, "type": "meta",
+             "pid": 1, "schema": 99}))
+
+
+class TestSchemaV10Fleet:
+    def test_window_commit_events_validate(self):
+        from sq_learn_tpu.obs.schema import validate_record
+
+        window = {"v": 10, "schema_version": 10, "ts": 0.0,
+                  "type": "elastic", "event": "window", "generation": 0,
+                  "n_hosts": 3, "host": 1, "window": 2, "cursor": 12}
+        assert validate_record(window) == []
+        commit = dict(window, event="commit", host=0)
+        assert validate_record(commit) == []
+
+    def test_clock_record_validates(self):
+        from sq_learn_tpu.obs.schema import validate_record
+
+        good = {"v": 10, "schema_version": 10, "ts": 0.0,
+                "type": "clock", "peer": "w1", "sent_ts": 100.0,
+                "recv_ts": 100.1, "generation": 0, "via": "hb"}
+        assert validate_record(good) == []
+        assert any("clock.peer" in e
+                   for e in validate_record(dict(good, peer=1)))
+        assert any("clock.sent_ts" in e
+                   for e in validate_record(dict(good, sent_ts="now")))
+        assert any("clock.generation" in e
+                   for e in validate_record(dict(good, generation=-1)))
+
+    def test_fleet_envelope_validates(self):
+        from sq_learn_tpu.obs.schema import validate_record
+
+        good = {"v": 10, "schema_version": 10, "ts": 0.0,
+                "type": "elastic", "event": "world_up", "generation": 0,
+                "n_hosts": 2,
+                "fleet": {"run_id": "elastic-ab12", "host": "w0",
+                          "pid": 123, "gen": 0}}
+        assert validate_record(good) == []
+        null_gen = dict(good)
+        null_gen["fleet"] = dict(good["fleet"], gen=None)
+        assert validate_record(null_gen) == []
+        bad = dict(good)
+        bad["fleet"] = dict(good["fleet"], run_id=7)
+        assert any("fleet.run_id" in e for e in validate_record(bad))
+        bad = dict(good)
+        bad["fleet"] = dict(good["fleet"], pid="123")
+        assert any("fleet.pid" in e for e in validate_record(bad))
+
+    def test_legacy_v9_still_validates(self):
+        from sq_learn_tpu.obs.schema import validate_record
+
+        v9 = {"v": 9, "schema_version": 9, "ts": 0.0, "type": "elastic",
+              "event": "host_fail", "generation": 0, "n_hosts": 3,
+              "failed_host": 2, "detect_s": 0.5}
+        assert validate_record(v9) == []
+
+    def test_sim_emits_window_commit_with_generation(self, src,
+                                                     recorder):
+        faults.arm("host_fail:window=1,host=0,times=1")
+        elastic.elastic_fit_local(src, 3, n_hosts=3, seed=1, epochs=1,
+                                  window=4)
+        events = [e["event"] for e in recorder.elastic_records]
+        assert "window" in events and "commit" in events
+        # the sim runs all hosts in one process: exactly one commit per
+        # committed window ordinal, across both generations
+        commits = [e for e in recorder.elastic_records
+                   if e["event"] == "commit"]
+        ordinals = sorted(e["window"] for e in commits)
+        assert ordinals == list(range(len(ordinals)))

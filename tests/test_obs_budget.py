@@ -217,7 +217,7 @@ def test_legacy_versions_still_validate_and_v6_slo_fields():
         dict(v6, stages={"queue": -1.0})))
     assert any("tenant" in e for e in validate_record(dict(v6, tenant=3)))
     assert any("unknown schema version" in e
-               for e in validate_record(dict(v5, v=10, schema_version=10)))
+               for e in validate_record(dict(v5, v=99, schema_version=99)))
 
 
 # -- SloTracker: per-tenant records, windowed flush ---------------------------

@@ -161,7 +161,8 @@ def test_schema_v5_envelope_and_new_types(run, tmp_path):
     finally:
         obs.disable()
     recs = [json.loads(l) for l in open(path)]
-    assert all(r["v"] == 9 and r["schema_version"] == 9 for r in recs)
+    assert all(r["v"] == 10 and r["schema_version"] == 10
+               for r in recs)
     summary = validate_jsonl(path)
     assert summary["errors"] == []
     assert summary["by_type"]["xla_cost"] == 1
@@ -177,7 +178,7 @@ def test_schema_validates_regression_records():
 
 
 def test_schema_rejects_unknown_version_and_mismatch():
-    assert validate_record({"v": 10, "schema_version": 10, "ts": 0.0,
+    assert validate_record({"v": 99, "schema_version": 99, "ts": 0.0,
                             "type": "gauge", "name": "g", "value": 1})
     assert validate_record({"v": 2, "schema_version": 1, "ts": 0.0,
                             "type": "gauge", "name": "g", "value": 1})
