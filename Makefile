@@ -13,7 +13,7 @@ SHELL := /bin/bash
     hunt obs-smoke faults-smoke oocore-smoke serve-smoke control-smoke \
     elastic-smoke regress-selftest \
     smoke obs-report obs-trace obs-frontier obs-audit obs-budget \
-    obs-control obs-fleet regress all
+    obs-control obs-fleet obs-storage regress all
 
 all: lint test
 
@@ -199,8 +199,11 @@ elastic-smoke:
 
 # All contract smokes (observability + resilience + out-of-core +
 # serving + control plane + elastic mesh + regression gate).
-smoke: obs-smoke faults-smoke oocore-smoke serve-smoke control-smoke \
-    elastic-smoke regress-selftest lint-selftest
+# obs-storage rides right after oocore-smoke: it renders that smoke's
+# artifact and exits 2 if the faulted compressed fit left zero io
+# records — the storage-plane ledger's CI presence check.
+smoke: obs-smoke faults-smoke oocore-smoke obs-storage serve-smoke \
+    control-smoke elastic-smoke regress-selftest lint-selftest
 
 # Render the human report / Chrome trace of an obs JSONL artifact
 # (default: the obs-smoke artifact; override with OBS=<path>).
@@ -242,6 +245,18 @@ obs-control:
 FLEET ?= /tmp/sq_obs_smoke.jsonl
 obs-fleet:
 	$(PYTHON) -m sq_learn_tpu.obs fleet $(FLEET)
+
+# Storage-plane view: per-surface accounting (oocore shards / serving
+# feature cache / persistent compile cache) + the per-shard heat×bytes
+# table from the artifact's io records, with the tiering advisor's
+# compress/decompress/leave recommendations projected from the run's
+# own measured codec ratio and latencies (exit 2 when the artifact
+# carries zero io records — "no telemetry" must never read as "healthy
+# storage"). Default artifact: the oocore smoke's, whose faulted
+# compressed prefetched fit feeds every ledger path.
+STORAGE ?= /tmp/sq_oocore_smoke.jsonl
+obs-storage:
+	$(PYTHON) -m sq_learn_tpu.obs storage $(STORAGE) --advise
 
 # Perf-regression gate, standalone: run the headline bench, the PR 6
 # fused-fit bench (classical 70k×784 q-means), the PR 7 δ=0.5

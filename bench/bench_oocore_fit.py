@@ -52,6 +52,16 @@ SAME pixel data (codec none / lz4), two warm fits, bit-parity asserted:
   on a warm cache the decode is pure extra CPU — a single-core host
   (this dev container, noted in the record like PR 10's) pays it
   serially; multi-core hosts hide it on the worker pool.
+
+Storage-ledger leg (v11, under ``SQ_OBS=1``): after the fits the bench
+flushes :mod:`sq_learn_tpu.obs.storage` and hard-fails unless (a) every
+fitted store's per-shard ledger byte totals reconcile exactly with its
+manifest, (b) no shard emitted more ``io`` lines than ledger flushes
+(pre-aggregation: O(#shards) records, never O(#reads)), and (c) the
+tiering advisor replayed over the run's own records recommends
+compressing the pixel store the cold-tier pair measured as winning, at
+a projected ratio within 20% of the committed ``bytes_ratio``. The
+``io_*``/``advice_*`` extras land on the codec wallclock line.
 """
 
 import json
@@ -214,6 +224,99 @@ def main():
         ram_s, _ = timed(lambda: MiniBatchQKMeans(**est_kw).fit(X),
                          warmup=0, reps=1)
 
+        # storage-plane ledger (v11, `make regress` runs this bench
+        # under SQ_OBS=1): flush the per-shard io aggregates, reconcile
+        # them byte-for-byte against each store's manifest (hard-fail —
+        # a ledger that disagrees with the manifest is lying about the
+        # bytes it moved), pin the pre-aggregation invariant (a key
+        # emits at most one line per flush, never one per read), and
+        # replay the tiering advisor over the run's own records: the
+        # cold-tier pair above is exactly the experiment the advisor
+        # must read back from telemetry alone — compress the pixel
+        # store, at a projected ratio consistent with the measured
+        # bytes_ratio this bench commits.
+        from sq_learn_tpu import obs
+        from sq_learn_tpu.obs import storage as obs_storage
+
+        io_extras = {}
+        if obs.enabled():
+            obs_storage.flush("pass_end")
+            orec = obs.get_recorder()
+            io_recs = list(orec.io_records)
+            view = obs_storage.collect(io_recs)
+            ooc_view = view["surfaces"].get("oocore", {})
+            for st in (store, pstore, cstore):
+                led = ooc_view.get(st.fingerprint, {})
+                if not led:
+                    print(json.dumps(
+                        {"error": "no io records for a fitted store",
+                         "store": st.fingerprint}), file=sys.stderr)
+                    return 1
+                row_nbytes = st.shape[1] * st.dtype.itemsize
+                for i, r in led.items():
+                    reads = int(r.get("reads", 0))
+                    want_raw = st.shard_sizes[i] * row_nbytes * reads
+                    want_stored = st.shard_stored_sizes[i] * reads
+                    if (int(r.get("bytes_raw", 0)) != want_raw
+                            or int(r.get("bytes_stored", 0))
+                            != want_stored):
+                        print(json.dumps(
+                            {"error": "io ledger does not reconcile "
+                                      "with the store manifest",
+                             "store": st.fingerprint, "shard": i,
+                             "ledger": {k: r.get(k) for k in
+                                        ("reads", "bytes_raw",
+                                         "bytes_stored")},
+                             "manifest_raw": want_raw,
+                             "manifest_stored": want_stored}),
+                            file=sys.stderr)
+                        return 1
+            per_key = {}
+            for r in io_recs:
+                kk = (r.get("surface"), r.get("store"), r.get("shard"))
+                per_key[kk] = per_key.get(kk, 0) + 1
+            flushes = orec._storage._flushes
+            if max(per_key.values(), default=0) > flushes:
+                print(json.dumps(
+                    {"error": "io records flood the sink (more lines "
+                              "for one shard than flushes — per-read "
+                              "emission, not pre-aggregation)",
+                     "worst": max(per_key.values()),
+                     "flushes": flushes}), file=sys.stderr)
+                return 1
+            advice = obs_storage.advise(view)
+            aratio = advice.get("ratio")
+            if aratio is None or abs(aratio - bytes_ratio) \
+                    > 0.2 * bytes_ratio:
+                print(json.dumps(
+                    {"error": "advisor's measured codec ratio is not "
+                              "consistent with the manifest bytes "
+                              "ratio", "advice_ratio": aratio,
+                     "bytes_ratio": round(bytes_ratio, 3)}),
+                    file=sys.stderr)
+                return 1
+            pshards = [s for s in advice["shards"]
+                       if s["store"] == pstore.fingerprint]
+            n_compress = sum(1 for s in pshards
+                             if s["action"] == "compress")
+            if not n_compress:
+                print(json.dumps(
+                    {"error": "advisor did not recommend compressing "
+                              "the pixel store the cold-tier pair "
+                              "measured as winning",
+                     "actions": sorted({s["action"] for s in pshards})}),
+                    file=sys.stderr)
+                return 1
+            io_extras = dict(
+                io_records=len(io_recs),
+                io_flushes=int(flushes),
+                io_shards_tracked=len(per_key),
+                advice_ratio=round(aratio, 3),
+                advice_compress_recs=n_compress,
+                advice_top_heat=round(
+                    advice["shards"][0]["heat"], 3)
+                if advice["shards"] else None)
+
         art_dir = os.environ.get("SQ_OOC_BENCH_ARTIFACT_DIR")
         if art_dir:
             # run_suite.sh archives the store manifest next to the
@@ -266,7 +369,8 @@ def main():
              warm_fit_compressed_s=round(cfit_s, 3),
              warm_decode_overhead=round(cfit_s / pfit_s, 3),
              codec_parity=codec_parity,
-             single_core_host=(os.cpu_count() or 1) <= 1, smoke=smoke)
+             single_core_host=(os.cpu_count() or 1) <= 1, smoke=smoke,
+             **io_extras)
         if not parity:
             print(json.dumps({"error": "resume parity violated"}),
                   file=sys.stderr)

@@ -104,6 +104,16 @@ run_and_record() {  # run_and_record <timeout_s> <header> <cmd...>; returns the 
         "$obs_dir/${slug}.jsonl" > "$obs_dir/${slug}_control.txt" \
         2>/dev/null || true
     fi
+    # storage-plane view (v11 `io` records: per-shard heat/latency over
+    # the oocore + serving disk surfaces) with the tiering advice — the
+    # per-shard evidence behind an out-of-core number is committed next
+    # to it
+    if grep -aq '"type": "io"' "$obs_dir/${slug}.jsonl" \
+        2>/dev/null; then
+      env -u PYTHONPATH timeout 60 python -m sq_learn_tpu.obs storage \
+        "$obs_dir/${slug}.jsonl" --advise \
+        > "$obs_dir/${slug}_storage.txt" 2>/dev/null || true
+    fi
   fi
   # compression (PR 17): the per-config JSONL commits gzipped — every
   # obs reader (trace/report/regress/frontier/budget/control) opens
@@ -114,7 +124,8 @@ run_and_record() {  # run_and_record <timeout_s> <header> <cmd...>; returns the 
   # plain so `grep` over the records tree keeps working.
   local view_cap=262144
   for view in "$obs_dir/${slug}_trace.json" "$obs_dir/${slug}_report.txt" \
-              "$obs_dir/${slug}_budget.txt" "$obs_dir/${slug}_control.txt"
+              "$obs_dir/${slug}_budget.txt" "$obs_dir/${slug}_control.txt" \
+              "$obs_dir/${slug}_storage.txt"
   do
     if [ -f "$view" ] && [ "$(wc -c < "$view")" -gt "$view_cap" ]; then
       gzip -9 -f "$view"

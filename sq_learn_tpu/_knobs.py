@@ -115,6 +115,9 @@ _ENTRIES = [
     _K("SQ_OBS_TRACE", "path", None, "lib",
        "Render the closed run into Chrome trace-event JSON at this path.",
        "docs/observability.md"),
+    _K("SQ_OBS_ROTATE_BYTES", "int", 0, "lib",
+       "Rotate the JSONL sink to gzipped <path>.<n>.gz segments at this "
+       "many written bytes (0 = off).", "docs/observability.md"),
     _K("SQ_OBS_FLEET_RUN_ID", "str", None, "lib",
        "Coordinator-minted fleet run id; when set every record carries "
        "the fleet envelope (run_id/host/pid/gen).",

@@ -23,17 +23,18 @@ guarantee-audit flags raise (:mod:`~sq_learn_tpu.obs.guarantees`);
 burn alerts raise (:mod:`~sq_learn_tpu.obs.budget`, with
 ``SQ_OBS_BUDGET_WINDOWS``/``SQ_OBS_BUDGET_BURN`` tuning);
 ``SQ_OBS_TRACE=<path>`` renders the closing run's JSONL into Chrome
-trace-event JSON; ``SQ_OBS_FLEET_RUN_ID`` / ``SQ_OBS_FLEET_HOST`` /
+trace-event JSON; ``SQ_OBS_ROTATE_BYTES`` rotates the sink to gzipped
+segments mid-run; ``SQ_OBS_FLEET_RUN_ID`` / ``SQ_OBS_FLEET_HOST`` /
 ``SQ_OBS_FLEET_DIR`` stamp the fleet envelope and shard layout for
 multi-process runs (:mod:`~sq_learn_tpu.obs.fleet`). Analysis tooling:
 ``python -m sq_learn_tpu.obs
-{trace,report,regress,audit,frontier,budget,control,fleet}``
+{trace,report,regress,audit,frontier,budget,control,fleet,storage}``
 and :mod:`~sq_learn_tpu.obs.xla` (per-compilation FLOP/byte/peak-HBM
 accounting). Full docs: ``docs/observability.md``.
 """
 
 from . import (budget, control, fleet, frontier, guarantees, ledger, probe,
-               regress, report, schema, trace, xla)
+               regress, report, schema, storage, trace, xla)
 from .recorder import (NULL_SPAN, Recorder, counter_add, disable, enable,
                        enabled, flush, gauge, get_recorder, record_span,
                        set_fleet, set_generation, snapshot, span)
@@ -72,6 +73,7 @@ __all__ = [
     "set_generation",
     "snapshot",
     "span",
+    "storage",
     "trace",
     "watchdog",
     "xla",

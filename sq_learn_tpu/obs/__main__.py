@@ -1,6 +1,6 @@
 """CLI dispatcher:
 ``python -m sq_learn_tpu.obs
-<trace|report|regress|audit|frontier|budget|control>``.
+<trace|report|regress|audit|frontier|budget|control|fleet|storage>``.
 
 - ``trace <jsonl> [...] [-o out.json]`` — render a run's JSONL into
   Chrome trace-event JSON (Perfetto-viewable), merging multiple files
@@ -35,6 +35,13 @@
   per-generation detect→shrink→re-init→resume critical paths, and the
   committed-window reconciliation; exits 1 when the commit ledger
   disagrees with itself (:mod:`~sq_learn_tpu.obs.fleet`).
+- ``storage <jsonl> [...] [--json] [--advise] [--top N]`` — the
+  storage-plane ledger: per-surface accounting and the per-shard
+  heat×bytes table from the run's ``io`` records, with ``--advise``
+  adding compress/decompress/leave placement recommendations projected
+  from the run's own measured codec ratio and latencies; exits 2 when
+  the artifacts carry zero ``io`` records
+  (:mod:`~sq_learn_tpu.obs.storage`).
 
 All subcommands are dependency-free file tools (no jax import on the
 comparison/render paths), safe to run with PYTHONPATH cleared while the
@@ -66,10 +73,12 @@ def main(argv=None):
         from .control import main as run
     elif cmd == "fleet":
         from .fleet import main as run
+    elif cmd == "storage":
+        from .storage import main as run
     else:
         print(f"unknown subcommand {cmd!r} (expected trace, report, "
-              "regress, audit, frontier, budget, control, or fleet)",
-              file=sys.stderr)
+              "regress, audit, frontier, budget, control, fleet, or "
+              "storage)", file=sys.stderr)
         return 2
     return run(rest)
 

@@ -26,7 +26,7 @@ Design constraints, in order:
    arrays, so their spans are synced by construction.
 
 JSONL schema: one JSON object per line, every line carrying
-``{"v": 10, "schema_version": 10, "ts": <unix seconds>, "type": <record
+``{"v": 11, "schema_version": 11, "ts": <unix seconds>, "type": <record
 type>}`` plus per-type fields — see :mod:`sq_learn_tpu.obs.schema` (the
 validator) and ``docs/observability.md`` (the prose). ``v`` is the
 original envelope key (kept so pre-2 readers don't break);
@@ -80,7 +80,17 @@ from .. import _knobs
 #      offsets from them), and the elastic ``window`` / ``commit``
 #      events (per-host fold progress + node-0 commit ledger — the
 #      fold ledger's obs twin that obs.fleet reconciles)
-SCHEMA_VERSION = 10
+# v11: +io record type (the storage-plane ledger, obs.storage: one
+#      CUMULATIVE per-(surface, store, shard) aggregate per flush —
+#      stored vs raw bytes, read/CRC/decode/cold latency decomposition,
+#      prefetch hit/stall/serial split, retry/quarantine counts,
+#      spill/disk-hit/promote traffic for the serving surfaces, EWMA
+#      heat — flushed at pass end and recorder close, never per read),
+#      +size-based sink rotation (SQ_OBS_ROTATE_BYTES gzips the live
+#      sink to ``<path>.<n>.gz`` segments mid-run; the optional
+#      meta.segment field stamps each reopened segment), and the
+#      snapshot's per-surface storage gauges
+SCHEMA_VERSION = 11
 
 #: default sink path when SQ_OBS=1 and SQ_OBS_PATH is unset
 DEFAULT_PATH = "sq_obs.jsonl"
@@ -195,8 +205,9 @@ class Recorder:
     ``watchdog_events``, ``probe_events``, ``fault_events``,
     ``breaker_events``, ``xla_cost_records``, ``guarantee_records``,
     ``tradeoff_records``, ``slo_records``, ``budget_records``,
-    ``alert_records``, ``control_records``, ``elastic_records`` — all
-    plain Python containers, safe to read at any point in the run.
+    ``alert_records``, ``control_records``, ``elastic_records``,
+    ``io_records`` — all plain Python containers, safe to read at any
+    point in the run.
     """
 
     def __init__(self, path=None, run_id=None, host=None):
@@ -235,10 +246,21 @@ class Recorder:
         self.alert_records = []
         self.control_records = []
         self.elastic_records = []
+        self.io_records = []
+        # storage-plane ledger (obs.storage, v11): attached lazily at the
+        # first instrumented shard/cache access, flushed by close()
+        self._storage = None
         self._xla_seen = set()  # (site, signature) dedup for obs.xla
         self.path = path
         self._seq = 0
         self._sink = None
+        # size-based sink rotation (v11): at SQ_OBS_ROTATE_BYTES written
+        # bytes the live sink gzips to <path>.<n>.gz and reopens fresh —
+        # long fleet runs stay bounded on disk; readers are
+        # gzip-transparent. 0 (the default) disables.
+        self._rotate_bytes = _knobs.get_int("SQ_OBS_ROTATE_BYTES")
+        self._sink_bytes = 0
+        self._segments = 0
         if path:
             self._sink = open(path, "a", buffering=1)
             self.record({"type": "meta", "pid": os.getpid(),
@@ -265,9 +287,52 @@ class Recorder:
                 getattr(self, kind).append(rec)
             if self._sink is not None:
                 try:
-                    self._sink.write(json.dumps(rec) + "\n")
+                    line = json.dumps(rec) + "\n"
+                    self._sink.write(line)
+                    self._sink_bytes += len(line)
                 except Exception:
                     pass  # a full disk must not kill the fit
+                else:
+                    if (self._rotate_bytes
+                            and self._sink_bytes >= self._rotate_bytes):
+                        self._rotate_locked()
+
+    def _rotate_locked(self):
+        """Rotate the live sink: gzip its contents to the next
+        ``<path>.<n>.gz`` segment and reopen the path fresh (with a new
+        meta line stamping the segment ordinal). Best-effort — rotation
+        trouble degrades to an unrotated sink, never a dead run."""
+        try:
+            import gzip
+            import shutil
+
+            self._sink.flush()
+            self._sink.close()
+            self._segments += 1
+            seg = f"{self.path}.{self._segments}.gz"
+            with open(self.path, "rb") as src, \
+                    gzip.open(seg, "wb") as dst:
+                shutil.copyfileobj(src, dst)
+            self._sink = open(self.path, "w", buffering=1)
+            meta = {"type": "meta", "pid": os.getpid(),
+                    "schema": SCHEMA_VERSION, "segment": self._segments,
+                    "v": SCHEMA_VERSION, "schema_version": SCHEMA_VERSION,
+                    "ts": round(time.time(), 3)}
+            if self.fleet_run_id is not None:
+                meta["fleet"] = {"run_id": self.fleet_run_id,
+                                 "host": self.fleet_host,
+                                 "pid": os.getpid(),
+                                 "gen": self.fleet_generation}
+            line = json.dumps(meta) + "\n"
+            self._sink.write(line)
+            self._sink_bytes = len(line)
+        except Exception:
+            try:
+                if self._sink is None or self._sink.closed:
+                    self._sink = open(self.path, "a", buffering=1)
+                self._rotate_bytes = 0  # stop retrying on every write
+            except Exception:
+                self._sink = None
 
     def flush(self, fsync=True):
         """Flush the JSONL sink to the OS — and, with ``fsync`` (the
@@ -291,6 +356,14 @@ class Recorder:
 
     def close(self):
         with _lock:
+            # drain the storage ledger's dirty aggregates first so a run
+            # that never hit a pass-end flush still lands its io records
+            # (the RLock makes the nested record() calls safe here)
+            if self._storage is not None:
+                try:
+                    self._storage.flush("close")
+                except Exception:
+                    pass  # obs must never mask the run it observed
             if self._sink is not None:
                 try:
                     self._sink.close()
@@ -597,7 +670,21 @@ def snapshot():
         "elastic_generation": max(
             (int(e["generation"]) for e in rec.elastic_records
              if isinstance(e.get("generation"), int)), default=None),
+        # storage-plane ledger (obs.storage, v11): io aggregates flushed
+        # so far plus the per-surface resident-traffic-vs-budget gauges
+        # (ledger rollups joined with the configured caps/budgets)
+        "io_records": len(rec.io_records),
+        "storage_surfaces": _storage_surfaces(rec),
     }
+
+
+def _storage_surfaces(rec):
+    try:
+        from .storage import surfaces_snapshot
+
+        return surfaces_snapshot(rec)
+    except Exception:  # obs must never die on a half-imported package
+        return None
 
 
 # SQ_OBS=1 auto-enables at first import, sink at SQ_OBS_PATH (CLAUDE.md

@@ -19,6 +19,7 @@ from . import budget as _budget
 from . import control as _control
 from . import frontier as _frontier
 from . import guarantees as _guarantees
+from . import storage as _storage
 from .trace import load_jsonl
 
 __all__ = ["summarize", "render", "main"]
@@ -197,6 +198,38 @@ def summarize(records):
         "cache_disk_hits": counters.get("serving.cache_disk_hits", 0),
     }
 
+    # storage surfaces (v11): one rollup per disk surface, built from
+    # counters every schema version has carried — so the section renders
+    # on pre-v11 artifacts too. When the run DOES carry v11 ``io``
+    # records, the per-shard ledger rollup rides along (full view:
+    # python -m sq_learn_tpu.obs storage).
+    cache_gets = (counters.get("serving.cache_hits", 0)
+                  + counters.get("serving.cache_misses", 0))
+    storage = {
+        "oocore": {
+            "shard_reads": counters.get("oocore.shard_reads", 0),
+            "shard_read_bytes": counters.get("oocore.shard_read_bytes", 0),
+            "codec_bytes_in": counters.get("oocore.codec_bytes_in", 0),
+            "codec_bytes_out": counters.get("oocore.codec_bytes_out", 0),
+            "rereads": counters.get("oocore.rereads", 0),
+            "crc_failures": counters.get("oocore.crc_failures", 0),
+            "prefetch_hits": counters.get("oocore.prefetch_hits", 0),
+            "prefetch_stalls": counters.get("oocore.prefetch_stalls", 0),
+        },
+        "serve_cache": {
+            "gets": cache_gets,
+            "spills": counters.get("serving.cache_spills", 0),
+            "disk_hits": counters.get("serving.cache_disk_hits", 0),
+        },
+        "compile_cache": {
+            "hits": counters.get("serving.persistent_cache_hits", 0),
+            "misses": counters.get("serving.persistent_cache_misses", 0),
+        },
+        "io_records": by_type.get("io", 0),
+        "ledger": (_storage.surface_rollup(_storage.collect(records))
+                   if by_type.get("io") else {}),
+    }
+
     return {
         "by_type": by_type,
         "spans": by_name,
@@ -213,6 +246,10 @@ def summarize(records):
         "sketch": sketch,
         "prefetch": prefetch,
         "codec": codec,
+        # the storage-surfaces section (v11-aware, counter-backed): one
+        # rollup per disk surface; "ledger" is populated only when the
+        # artifact carries io records (pre-v11 runs still render)
+        "storage": storage,
         # the fleet-correlation section (v10): run_id / per-host record
         # counts from the fleet envelope, the elastic window/commit
         # ledger, and the clock-sample traffic behind the merged
@@ -362,6 +399,49 @@ def render(summary, top=12):
             out(f"  feature cache: {cd.get('cache_spills', 0)} spill(s) "
                 f"to disk, {cd.get('cache_disk_hits', 0)} digest-verified "
                 f"disk hit(s)")
+
+    out("")
+    out("-- storage surfaces (oocore / feature cache / compile cache) --")
+    st = summary.get("storage") or {}
+    ooc = st.get("oocore") or {}
+    sc = st.get("serve_cache") or {}
+    cc = st.get("compile_cache") or {}
+    pf_gets = ooc.get("prefetch_hits", 0) + ooc.get("prefetch_stalls", 0)
+    cc_gets = cc.get("hits", 0) + cc.get("misses", 0)
+    if not (ooc.get("shard_reads") or sc.get("gets") or sc.get("spills")
+            or cc_gets):
+        out("  (no storage-surface activity)")
+    else:
+        if ooc.get("shard_reads"):
+            ratio_s = ""
+            if ooc.get("codec_bytes_out"):
+                r = ooc.get("codec_bytes_in", 0) / ooc["codec_bytes_out"]
+                ratio_s = f", codec ratio {r:.3f} stored/raw"
+            pf_s = (f", prefetch {ooc.get('prefetch_hits', 0) / pf_gets:.0%}"
+                    f" hit rate" if pf_gets else "")
+            out(f"  oocore: {ooc.get('shard_reads', 0)} shard read(s), "
+                f"{_fmt_bytes(ooc.get('shard_read_bytes', 0))} moved"
+                f"{ratio_s}{pf_s}, {ooc.get('rereads', 0)} reread(s), "
+                f"{ooc.get('crc_failures', 0)} CRC failure(s)")
+        if sc.get("gets") or sc.get("spills"):
+            hit_s = (f" ({sc.get('disk_hits', 0) / sc['gets']:.0%} of "
+                     f"lookups served off disk)" if sc.get("gets") else "")
+            out(f"  feature cache: {sc.get('spills', 0)} spill(s), "
+                f"{sc.get('disk_hits', 0)} disk hit(s){hit_s}")
+        if cc_gets:
+            out(f"  compile cache: {cc.get('hits', 0)} reload(s), "
+                f"{cc.get('misses', 0)} cold compile(s) "
+                f"({cc.get('hits', 0) / cc_gets:.0%} warm)")
+        if st.get("io_records"):
+            for surface, a in sorted((st.get("ledger") or {}).items()):
+                out(f"  ledger[{surface}]: {a.get('entries', 0)} "
+                    f"entr{'y' if a.get('entries') == 1 else 'ies'} over "
+                    f"{a.get('stores', 0)} store(s), "
+                    f"{a.get('reads', 0)} read(s), "
+                    f"{_fmt_bytes(a.get('bytes_raw', 0))} raw / "
+                    f"{_fmt_bytes(a.get('bytes_stored', 0))} stored")
+            out(f"  {st['io_records']} io record(s) — per-shard heat "
+                f"table: python -m sq_learn_tpu.obs storage")
 
     out("")
     out("-- serving SLOs (p50/p99 latency, sustained QPS) --")

@@ -52,7 +52,19 @@ clock sample — a peer's send timestamp paired with the local receive
 timestamp — from which per-host offsets are estimated), and the
 elastic ``window`` / ``commit`` events (per-host fold progress at
 every commit-window boundary, and node 0's committed-window ledger —
-the obs twin of the fold ledger that the fleet merge reconciles).
+the obs twin of the fold ledger that the fleet merge reconciles);
+v11 (PR 20) adds the storage-plane ``io`` type
+(:mod:`sq_learn_tpu.obs.storage`): one CUMULATIVE
+per-``(surface, store, shard)`` ledger aggregate per flush — stored vs
+raw bytes, the read/CRC/decode/cold-tier latency decomposition,
+prefetch hit/stall/serial attribution, retry/quarantine counts, the
+serving surfaces' spill/disk-hit/promote traffic, and the time-decayed
+EWMA heat — flushed at pass end and recorder close (never one line per
+read: a reader takes the NEWEST record per key, exactly like
+counters), plus the size-based sink-rotation convention
+(``SQ_OBS_ROTATE_BYTES`` gzips the live sink to ``<path>.<n>.gz``
+segments; the optional ``meta.segment`` int stamps each reopened
+segment).
 Older versions
 still validate (their types are a strict subset), any other version is
 rejected — an unknown version means a reader that would silently
@@ -172,6 +184,18 @@ clock      peer (str), sent_ts (number), recv_ts (number) — one clock
            (one-way), pairs of opposite-direction minima give the
            midpoint estimate (:mod:`sq_learn_tpu.obs.fleet`); optional
            generation (int ≥ 0), via (str)
+io         surface (str — ``oocore`` | ``serve_cache`` |
+           ``compile_cache``), store (str — store fingerprint or
+           backing directory), shard (int ≥ 0 | null — shard ordinal;
+           null for the whole-store serving surfaces), reads
+           (int ≥ 0), bytes_stored (int ≥ 0), bytes_raw (int ≥ 0) —
+           one CUMULATIVE storage-ledger aggregate
+           (:mod:`sq_learn_tpu.obs.storage`; newest record per key
+           wins, like counters); optional hits / stalls / serial /
+           retries / quarantined / spills / disk_hits / promotes /
+           misses (int ≥ 0), read_s / crc_s / decode_s / cold_s /
+           stall_s / heat (number ≥ 0), codec (str), reason (str —
+           what triggered the flush)
 =========  ==============================================================
 
 Every record may additionally carry the v10 ``fleet`` envelope
@@ -209,8 +233,9 @@ _NUM = (int, float)
 #: control or the budget/alert seq fields; v8 = PR 17's, without the
 #: elastic type or the fault.host/fault.stall_s fields; v9 = PR 18's,
 #: without the fleet envelope, the clock type, or the elastic
-#: window/commit events)
-KNOWN_VERSIONS = {1, 2, 3, 4, 5, 6, 7, 8, 9, SCHEMA_VERSION}
+#: window/commit events; v10 = PR 19's, without the io type or sink
+#: rotation)
+KNOWN_VERSIONS = {1, 2, 3, 4, 5, 6, 7, 8, 9, 10, SCHEMA_VERSION}
 
 #: every record type the schema defines, machine-readable. The static
 #: checker (:mod:`sq_learn_tpu.analysis`, rule ``obs-schema``) and the
@@ -219,7 +244,7 @@ KNOWN_VERSIONS = {1, 2, 3, 4, 5, 6, 7, 8, 9, SCHEMA_VERSION}
 RECORD_TYPES = (
     "meta", "span", "counter", "gauge", "ledger", "watchdog", "probe",
     "fault", "breaker", "xla_cost", "regression", "guarantee", "tradeoff",
-    "slo", "budget", "alert", "control", "elastic", "clock",
+    "slo", "budget", "alert", "control", "elastic", "clock", "io",
 )
 
 _ELASTIC_EVENTS = {"world_up", "resume", "host_fail", "host_stall",
@@ -578,6 +603,38 @@ def validate_record(rec):
                    "clock.generation non-negative int")
         if "via" in rec:
             _check(isinstance(rec["via"], str), errors, "clock.via str")
+    elif t == "io":
+        _check(isinstance(rec.get("surface"), str), errors,
+               "io.surface str")
+        _check(isinstance(rec.get("store"), str), errors, "io.store str")
+        sh = rec.get("shard", -1)
+        _check(sh is None or (isinstance(sh, int)
+                              and not isinstance(sh, bool) and sh >= 0),
+               errors, "io.shard non-negative int or null")
+        for field in ("reads", "bytes_stored", "bytes_raw"):
+            _check(isinstance(rec.get(field), int)
+                   and not isinstance(rec.get(field), bool)
+                   and rec.get(field, -1) >= 0, errors,
+                   f"io.{field} non-negative int")
+        for field in ("hits", "stalls", "serial", "retries",
+                      "quarantined", "spills", "disk_hits", "promotes",
+                      "misses"):
+            if field in rec:
+                _check(isinstance(rec[field], int)
+                       and not isinstance(rec[field], bool)
+                       and rec[field] >= 0, errors,
+                       f"io.{field} non-negative int")
+        for field in ("read_s", "crc_s", "decode_s", "cold_s",
+                      "stall_s", "heat"):
+            if field in rec:
+                _check(isinstance(rec[field], _NUM)
+                       and not isinstance(rec[field], bool)
+                       and rec[field] >= 0, errors,
+                       f"io.{field} non-negative number")
+        for field in ("codec", "reason"):
+            if field in rec:
+                _check(isinstance(rec[field], str), errors,
+                       f"io.{field} str")
     else:
         errors.append(
             f"unknown record type {t!r} (known: {sorted(RECORD_TYPES)})")

@@ -11,11 +11,13 @@ fault-injected shrink of the elastic mesh's in-process simulator
 under an active recorder carrying a fleet identity (schema v10: every
 record gains the ``fleet`` envelope, a ``clock`` sample lands, and
 :mod:`sq_learn_tpu.obs.fleet` must reconcile the artifact's commit
-ledger), then validates the emitted JSONL against
-:mod:`sq_learn_tpu.obs.schema` (legacy v1–v9 records must keep
-validating) and asserts the run artifact carries the signals the layer
-exists for. Exit code 0 = contract holds; 1 = schema or content
-violation (printed).
+ledger), plus a tiny shard-store pass feeding the storage-plane ledger
+(schema v11: per-shard ``io`` records land at flush, cumulative like
+counters — :mod:`sq_learn_tpu.obs.storage`), then validates the emitted
+JSONL against :mod:`sq_learn_tpu.obs.schema` (legacy v1–v10 records
+must keep validating) and asserts the run artifact carries the signals
+the layer exists for. Exit code 0 = contract holds; 1 = schema or
+content violation (printed).
 
 Pins the CPU backend in-process first (the documented wedge-proof
 override, CLAUDE.md) — a health check must never hang on the thing whose
@@ -115,6 +117,24 @@ def main():
 
     _now = _time.time()
     elastic._emit_clock("w1", _now - 1e-3, _now, 0, "hb")
+
+    # v11 contract: a tiny shard-store pass feeds the storage-plane
+    # ledger — every read lands in the per-(store, shard) aggregates and
+    # the pass-end flush emits cumulative io records (O(#shards), never
+    # O(#reads))
+    import tempfile
+
+    from . import storage as obs_storage
+    from ..oocore import store_from_array
+
+    stmp = tempfile.mkdtemp(prefix="sq_obs_smoke_store_")
+    sstore = store_from_array(os.path.join(stmp, "store"),
+                              np.asarray(X[:256], np.float32),
+                              shard_bytes=16 * 1024)
+    for i in range(sstore.n_shards):
+        sstore.read_shard(i)
+        sstore.read_shard(i)  # second touch: reads must aggregate
+    io_flushed = obs_storage.flush("pass_end")
 
     report = watchdog.report()
     totals = ledger.totals()
@@ -219,6 +239,28 @@ def main():
     if not frc["ok"] or frc["windows"] != 3:
         failures.append(f"fleet commit-ledger reconciliation broken: "
                         f"{frc}")
+    # v11 contract: the shard-store pass landed one cumulative io record
+    # per shard (pre-aggregated — two touches per shard, one line), and
+    # the storage CLI's collect/advise run over the artifact
+    if io_flushed != sstore.n_shards:
+        failures.append(f"storage flush emitted {io_flushed} io records "
+                        f"for {sstore.n_shards} shards")
+    if summary["by_type"].get("io", 0) < sstore.n_shards:
+        failures.append(f"artifact carries "
+                        f"{summary['by_type'].get('io', 0)} io records; "
+                        f"expected >= {sstore.n_shards}")
+    from . import storage as _st
+
+    sview = _st.collect(rec.io_records)
+    ooc_led = sview["surfaces"].get("oocore", {}).get(
+        sstore.fingerprint, {})
+    if sorted(ooc_led) != list(range(sstore.n_shards)):
+        failures.append(f"io records missed shards: {sorted(ooc_led)}")
+    elif not all(r.get("reads") == 2 for r in ooc_led.values()):
+        failures.append("io records did not aggregate both touches "
+                        "per shard")
+    if _st.advise(sview)["shards"] == []:
+        failures.append("storage advisor returned no per-shard rows")
     from .schema import validate_record
 
     legacy = [
@@ -245,6 +287,12 @@ def main():
         {"v": 9, "schema_version": 9, "ts": 0.0, "type": "elastic",
          "event": "host_fail", "generation": 0, "n_hosts": 3,
          "failed_host": 2, "window": 3, "detect_s": 0.5},
+        # v10 (pre-storage-ledger): fleet-enveloped clock samples, no io
+        # record type yet
+        {"v": 10, "schema_version": 10, "ts": 0.0, "type": "clock",
+         "peer": "w1", "sent_ts": 0.0, "recv_ts": 0.001, "via": "hb",
+         "generation": 0,
+         "fleet": {"run_id": "r", "host": "w1", "gen": 0, "pid": 1}},
     ]
     for r_ in legacy:
         errs = validate_record(r_)

@@ -51,6 +51,7 @@ _LANES = {
     "control": (13, "controller decisions"),
     "elastic": (14, "elastic mesh"),
     "clock": (15, "clock samples"),
+    "io": (16, "storage io"),
 }
 
 #: records that move onto a per-tenant lane when they carry a tenant
@@ -60,7 +61,7 @@ _LANES = {
 _TENANT_TYPES = ("slo", "budget", "alert", "control")
 
 #: first tid of the dynamically-allocated per-tenant lanes
-_TENANT_TID0 = 16
+_TENANT_TID0 = 17
 
 
 def load_jsonl(path):
@@ -146,6 +147,12 @@ def _instant_name(rec):
                 f"n={rec.get('n_hosts')}")
     if t == "clock":
         return f"clock {rec.get('peer')} via {rec.get('via', '?')}"
+    if t == "io":
+        shard = rec.get("shard")
+        where = (f"{rec.get('store')}"
+                 if shard is None else f"{rec.get('store')}[{shard}]")
+        return (f"io {rec.get('surface')} {where}: "
+                f"reads={rec.get('reads')} heat={rec.get('heat')}")
     return t
 
 

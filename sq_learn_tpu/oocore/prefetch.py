@@ -57,6 +57,7 @@ import time
 import numpy as np
 
 from .. import obs as _obs
+from ..obs import storage as _storage
 from .store import _budget_check, ram_budget_bytes
 from .. import _knobs
 
@@ -194,6 +195,8 @@ class ShardPrefetcher:
         next unconsumed position. Blocks until the worker read lands;
         re-raises a worker-side failure at the position it belongs to."""
         pos = int(pos)
+        was_hit = True
+        waited_s = 0.0
         with self._cond:
             if pos != self._consumed:
                 raise RuntimeError(
@@ -203,11 +206,13 @@ class ShardPrefetcher:
             if pos in self._results:
                 self._hits += 1
             else:
+                was_hit = False
                 self._stalls += 1
                 t0 = time.perf_counter()
                 while pos not in self._results and not self._closed:
                     self._cond.wait()
-                self._stall_s += time.perf_counter() - t0
+                waited_s = time.perf_counter() - t0
+                self._stall_s += waited_s
                 if pos not in self._results:
                     raise RuntimeError(
                         "ShardPrefetcher closed while waiting for shard "
@@ -216,6 +221,15 @@ class ShardPrefetcher:
             self._consumed = pos + 1
             self._held -= self._sz[pos]
             self._cond.notify_all()
+        # storage-ledger attribution (obs.storage), outside the lock: the
+        # hit/stall lands on the OWNING shard's aggregate — the worker's
+        # read_shard already recorded the read itself, from its thread.
+        # A failed read still records its stall before re-raising.
+        led = _storage.active()
+        if led is not None:
+            led.record_prefetch(
+                getattr(self.source, "fingerprint", "?"),
+                self.order[pos], hit=was_hit, stall_s=waited_s)
         if kind == "err":
             raise payload
         return payload
@@ -241,6 +255,9 @@ class ShardPrefetcher:
                        consumed=self._consumed)
         self._span.__exit__(None, None, None)
         self._results.clear()
+        # pass-end ledger flush (obs.storage): one cumulative io record
+        # per shard this pass touched — O(#shards), never O(#reads)
+        _storage.flush("pass_end")
 
     def __enter__(self):
         return self

@@ -28,7 +28,12 @@
 5. schema validation of the emitted JSONL: the read-side ``fault``
    records, the ``oocore.*`` counters (including the v7 codec byte
    pair), and the prefetch hit/stall counters must be present and
-   valid.
+   valid — plus the v11 storage-plane ledger
+   (:mod:`sq_learn_tpu.obs.storage`): cumulative per-shard ``io``
+   records covering every compressed shard, the ``corrupt_shard``
+   quarantine attributed to its owning shard even though it fired on a
+   prefetch worker thread, and O(#shards) lines per flush, never
+   O(#reads).
 
 Exit code 0 = contract holds; 1 = violation (printed as JSON). Pins the
 CPU backend in-process first, like every resilience check.
@@ -211,6 +216,34 @@ def main():
     by_type = summary["by_type"]
     if by_type.get("fault", 0) < 2:
         failures.append(f"expected >=2 fault records, got {by_type}")
+
+    # v11 contract: the storage-plane ledger saw every compressed shard,
+    # aggregated the whole fit into cumulative io records (one line per
+    # shard per flush, NOT per read), and the worker-thread quarantine
+    # landed on the shard that owns it
+    from ..obs import storage as obs_storage
+
+    sview = obs_storage.collect(rec.io_records)
+    cshards = (sview["surfaces"].get("oocore", {})
+               .get(cstore.fingerprint, {}))
+    check(sorted(cshards) == list(range(cstore.n_shards)),
+          f"io records did not cover the compressed store's shards: "
+          f"{sorted(cshards)}")
+    check(all(r.get("codec") == "lz4" for r in cshards.values()),
+          "compressed-store io records lost their codec tag")
+    check(all(r.get("reads", 0) >= FIT["max_epochs"]
+              for r in cshards.values()),
+          "io records did not aggregate every epoch's reads")
+    check(any(r.get("quarantined", 0) >= 1 for r in cshards.values()),
+          "corrupt_shard quarantine not attributed to its owning shard")
+    per_key = {}
+    for r in rec.io_records:
+        k = (r.get("surface"), r.get("store"), r.get("shard"))
+        per_key[k] = per_key.get(k, 0) + 1
+    worst = max(per_key.values(), default=0)
+    check(worst <= FIT["max_epochs"] + 2,
+          f"io records flood the sink ({worst} lines for one shard — "
+          f"per-read emission, not pre-aggregation)")
 
     print(json.dumps({
         "oocore_smoke": "fail" if failures else "ok",

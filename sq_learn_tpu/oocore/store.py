@@ -254,7 +254,7 @@ class ShardStore:
     def _shard_path(self, i):
         return os.path.join(self.path, self.manifest["shards"][i]["file"])
 
-    def _materialize(self, i):
+    def _materialize(self, i, timing=None):
         """One supervised, fault-injectable, CRC-unchecked shard read.
 
         Codec ``none`` returns the materialized shard array; a codec
@@ -264,7 +264,13 @@ class ShardStore:
         ``cold_tier`` latency model (per-shard remote-storage profile)
         inside the supervised timed attempt, where a slow cold read
         counts toward the deadline/breaker exactly like a ``read_stall``.
+
+        ``timing`` is the storage ledger's latency-decomposition dict
+        (obs.storage) or None when the ledger is off — the None path
+        touches no clock and allocates nothing, and retried attempts
+        ACCUMULATE so a record's seconds cover the whole supervised read.
         """
+        from ..obs import storage as _storage
         from ..resilience import faults as _faults
         from ..resilience import supervisor as _sup
 
@@ -273,14 +279,23 @@ class ShardStore:
         def attempt():
             plan = _faults._active
             if plan is not None:
-                plan.on_cold(i, stored)
+                if timing is None:
+                    plan.on_cold(i, stored)
+                else:
+                    t0 = _storage._now()
+                    plan.on_cold(i, stored)
+                    timing["cold_s"] += _storage._now() - t0
+            t0 = None if timing is None else _storage._now()
             if self.codec == "none":
                 mm = np.load(self._shard_path(i), mmap_mode="r")
                 arr = np.array(mm)  # materialize, then drop the mapping
                 del mm
-                return arr
-            with open(self._shard_path(i), "rb") as fh:
-                return np.frombuffer(fh.read(), np.uint8)
+            else:
+                with open(self._shard_path(i), "rb") as fh:
+                    arr = np.frombuffer(fh.read(), np.uint8)
+            if timing is not None:
+                timing["read_s"] += _storage._now() - t0
+            return arr
 
         arr = _sup.supervised_read(attempt, i, site="oocore.read_shard")
         plan = _faults._active
@@ -312,9 +327,19 @@ class ShardStore:
         """Materialize shard ``i``: supervised read, CRC verification per
         ``SQ_OOC_VERIFY`` (over the STORED bytes — compressed payloads
         verify before they decode), quarantine + bounded re-read on
-        mismatch, then decode for codec stores."""
+        mismatch, then decode for codec stores. With the storage ledger
+        active (obs.storage) the whole access lands as one
+        per-``(store, shard)`` aggregate update — read/CRC/decode/cold
+        seconds, retries, quarantine — attributed to THIS shard no
+        matter which thread ran the read."""
         from .. import obs as _obs
+        from ..obs import storage as _storage
 
+        led = _storage.active()
+        # the disabled path allocates nothing and never reads a clock
+        timing = (None if led is None else
+                  {"read_s": 0.0, "crc_s": 0.0, "decode_s": 0.0,
+                   "cold_s": 0.0})
         meta = self.manifest["shards"][i]
         raw_nbytes = int(meta["rows"]) * self.shape[1] * self.dtype.itemsize
         stored = self.shard_stored_sizes[i]
@@ -322,32 +347,54 @@ class ShardStore:
         # decoded array, resident together while the decoder runs
         _budget_check(raw_nbytes + (stored if self.codec != "none" else 0),
                       f"shard {i} of {self.path}")
-        arr = self._materialize(i)
+        arr = self._materialize(i, timing)
         mode = verify_mode()
+        rereads = 0
+        was_quarantined = 0
         if mode == "all" or (mode == "touch" and i not in self._verified):
             want = int(meta["crc32"])
-            rereads = 0
-            while _crc(arr) != want:
+            while True:
+                if timing is None:
+                    got = _crc(arr)
+                else:
+                    t0 = _storage._now()
+                    got = _crc(arr)
+                    timing["crc_s"] += _storage._now() - t0
+                if got == want:
+                    break
                 # quarantine, then spend the bounded re-read budget — a
                 # transient corruption (page-cache flake, injected fault)
                 # recovers; persistent on-disk rot surfaces with
                 # provenance instead of flowing into an accumulator
                 self.quarantined.add(i)
+                was_quarantined = 1
                 _obs.counter_add("oocore.crc_failures", 1)
                 if rereads >= reread_max():
                     raise ShardCorruptionError(
                         f"shard {i} ({meta['file']}) of {self.path} failed "
                         f"CRC {rereads + 1}x after quarantine: expected "
-                        f"{want:08x}, got {_crc(arr):08x}")
+                        f"{want:08x}, got {got:08x}")
                 rereads += 1
                 _obs.counter_add("oocore.rereads", 1)
-                arr = self._materialize(i)
+                arr = self._materialize(i, timing)
             self.quarantined.discard(i)
             self._verified.add(i)
         if self.codec != "none":
-            arr = self._decode(i, arr, meta)
+            if timing is None:
+                arr = self._decode(i, arr, meta)
+            else:
+                t0 = _storage._now()
+                arr = self._decode(i, arr, meta)
+                timing["decode_s"] += _storage._now() - t0
         _obs.counter_add("oocore.shard_reads", 1)
         _obs.counter_add("oocore.shard_read_bytes", int(arr.nbytes))
+        if led is not None:
+            led.record_read(
+                "oocore", self.fingerprint, i, stored_bytes=stored,
+                raw_bytes=int(arr.nbytes), read_s=timing["read_s"],
+                crc_s=timing["crc_s"], decode_s=timing["decode_s"],
+                cold_s=timing["cold_s"], retries=rereads,
+                quarantined=was_quarantined, codec=self.codec)
         return arr
 
     def _shard_cached(self, i):

@@ -47,6 +47,7 @@ dispatcher and flushed at close).
 import threading
 
 from .. import obs as _obs
+from ..obs import storage as _storage
 from ..obs import xla as _xla
 from .. import _knobs
 
@@ -123,9 +124,21 @@ def _register_listener():
                 if event == "/jax/compilation_cache/cache_hits":
                     _persistent["hits"] += 1
                     _obs.counter_add("serving.persistent_cache_hits", 1)
+                    led = _storage.active()
+                    if led is not None:
+                        # third disk surface (obs.storage): executable
+                        # reloads off the persistent compile cache
+                        led.record_cache_event(
+                            "compile_cache",
+                            compile_cache_dir() or "?", "hit")
                 elif event == "/jax/compilation_cache/cache_misses":
                     _persistent["misses"] += 1
                     _obs.counter_add("serving.persistent_cache_misses", 1)
+                    led = _storage.active()
+                    if led is not None:
+                        led.record_cache_event(
+                            "compile_cache",
+                            compile_cache_dir() or "?", "miss")
             except Exception:
                 pass
 

@@ -53,6 +53,7 @@ import threading
 import numpy as np
 
 from .. import obs as _obs
+from ..obs import storage as _storage
 from ..sketch.cache import data_digest
 from .. import _knobs
 
@@ -144,6 +145,9 @@ def flush_counters():
         for k in _pending:
             _pending[k] = 0
     _flush(deltas)
+    # the serving surfaces' pass-end ledger flush (obs.storage) rides
+    # the same dispatcher-close hook as the counter flush
+    _storage.flush("pass_end")
 
 
 def enabled():
@@ -222,6 +226,13 @@ def _spill(key, result):
             os.fsync(fh.fileno())
         os.replace(tmp, path)
         _count("spills")
+        led = _storage.active()
+        if led is not None:
+            # storage-ledger surface tag (obs.storage): the spill's
+            # stored-vs-raw byte pair is the disk tier's codec evidence
+            led.record_cache_event(
+                "serve_cache", root, "spill", stored_bytes=len(payload),
+                raw_bytes=int(result.nbytes))
         _prune(root)
     except OSError:
         return
@@ -249,10 +260,27 @@ def _disk_lookup(key):
     """Disk-tier lookup: parse the header, verify the FULL key (the
     digest-verified claim — a filename-hash collision or stale file can
     never serve wrong rows) and the payload CRC, then decode. Returns
-    the result array or None; every failure mode is a miss."""
+    the result array or None; every failure mode is a miss. With the
+    storage ledger active the timed read lands on the ``serve_cache``
+    surface (disk_hit with raw bytes, or miss)."""
     root = cache_dir()
     if root is None:
         return None
+    led = _storage.active()
+    if led is None:
+        return _disk_read(root, key)
+    t0 = _storage._now()
+    out = _disk_read(root, key)
+    dur = _storage._now() - t0
+    if out is None:
+        led.record_cache_event("serve_cache", root, "miss", dur_s=dur)
+    else:
+        led.record_cache_event("serve_cache", root, "disk_hit",
+                               raw_bytes=int(out.nbytes), dur_s=dur)
+    return out
+
+
+def _disk_read(root, key):
     from .. import native
 
     kj = _key_json(key)
@@ -298,6 +326,10 @@ def lookup(key):
     if disk is not None:
         _insert(key, np.array(disk, copy=True))
         _count("disk_hits")
+        led = _storage.active()
+        if led is not None:
+            led.record_cache_event("serve_cache", cache_dir() or "?",
+                                   "promote")
         return np.array(disk, copy=True)
     _count("misses")
     return None

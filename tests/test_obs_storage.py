@@ -1,0 +1,415 @@
+"""Storage-plane ledger (ISSUE 20, ``sq_learn_tpu.obs.storage``).
+
+The load-bearing contracts: cumulative per-(surface, store, shard)
+``io`` records with last-wins reader semantics (pre-aggregation — never
+one line per read); worker-thread fault attribution (a retry/quarantine
+or injected ``cold_tier`` stall that fired on a prefetch worker lands on
+the shard that owns it); the disabled-path pin (with ``SQ_OBS`` unset
+the instrumented read paths never touch the ledger clock and allocate
+no ledger); hand-computed EWMA heat decay; the serving-surface event
+shapes; ``SQ_OBS_ROTATE_BYTES`` sink rotation with segment-transparent
+collection; the advisor's hand-computed projection math and its honest
+no-ratio-measured refusal; schema-v11 validation (v10 legacy records
+keep validating); and the CLI's exit-code convention (2 on zero ``io``
+records — no telemetry must never read as healthy storage).
+"""
+
+import gzip
+import json
+
+import numpy as np
+import pytest
+
+from sq_learn_tpu import obs
+from sq_learn_tpu.obs import report as report_mod
+from sq_learn_tpu.obs import storage
+from sq_learn_tpu.obs.schema import (SCHEMA_VERSION, validate_jsonl,
+                                     validate_record)
+from sq_learn_tpu.obs.trace import load_jsonl
+from sq_learn_tpu.oocore import open_store, store_from_array
+from sq_learn_tpu.oocore.prefetch import ShardPrefetcher
+from sq_learn_tpu.resilience import faults
+
+
+@pytest.fixture(autouse=True)
+def _hygiene():
+    yield
+    faults.disarm()
+    if obs.enabled():
+        obs.disable()
+
+
+def _tiny_store(tmp_path, rows=48, cols=8, shard_bytes=512, name="store"):
+    """Deterministic tiny store: 48x8 f32 rows, 512 B shards -> 3 shards
+    of 16 rows each (row = 32 B)."""
+    X = np.arange(rows * cols, dtype=np.float32).reshape(rows, cols)
+    return store_from_array(str(tmp_path / name), X,
+                            shard_bytes=shard_bytes), X
+
+
+class _FakeRec:
+    """Minimal recorder stand-in for direct StorageLedger math tests."""
+
+    def __init__(self):
+        self.io_records = []
+
+    def record(self, rec, kind=None):
+        self.io_records.append(dict(rec))
+
+
+# -- cumulative aggregation / last-wins --------------------------------------
+
+
+def test_shard_reads_aggregate_cumulatively(tmp_path):
+    rec = obs.enable(str(tmp_path / "run.jsonl"))
+    store, X = _tiny_store(tmp_path)
+    row_bytes = X.shape[1] * X.dtype.itemsize
+    for i in range(store.n_shards):
+        store.read_shard(i)
+        store.read_shard(i)
+    flushed = storage.flush("pass_end")
+    assert flushed == store.n_shards
+    view = storage.collect(rec.io_records)
+    shards = view["surfaces"]["oocore"][store.fingerprint]
+    assert sorted(shards) == list(range(store.n_shards))
+    for i, r in shards.items():
+        rows = int(store.shard_sizes[i])
+        assert r["reads"] == 2
+        assert r["bytes_raw"] == 2 * rows * row_bytes
+        assert r["bytes_stored"] == 2 * int(store.shard_stored_sizes[i])
+        # no prefetcher ran: every read is a serial one
+        assert r["serial"] == 2 and r["hits"] == 0 and r["stalls"] == 0
+        assert r["reason"] == "pass_end"
+    # nothing dirty -> a flush emits nothing (O(dirty), not O(entries))
+    assert storage.flush("pass_end") == 0
+    # a third read supersedes, counter-style: collect stays last-wins
+    store.read_shard(0)
+    assert storage.flush("pass_end") == 1
+    view = storage.collect(rec.io_records)
+    assert view["surfaces"]["oocore"][store.fingerprint][0]["reads"] == 3
+    # the sink carries one line per flush per dirty shard, never per read
+    per_key = {}
+    for r in rec.io_records:
+        k = (r["surface"], r["store"], r["shard"])
+        per_key[k] = per_key.get(k, 0) + 1
+    assert max(per_key.values()) <= rec._storage._flushes
+
+
+def test_recorder_close_drains_dirty_aggregates(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    obs.enable(path)
+    store, _ = _tiny_store(tmp_path)
+    store.read_shard(0)  # dirty, never explicitly flushed
+    rec = obs.disable()
+    assert [r for r in rec.io_records if r["reason"] == "close"]
+    summary = validate_jsonl(path)
+    assert summary["errors"] == []
+    assert summary["by_type"]["io"] >= 1
+
+
+# -- fault matrix: worker-thread attribution ---------------------------------
+
+
+def test_fault_matrix_attributes_to_owning_shard(tmp_path):
+    """read_fail retries, corrupt_shard quarantine and the injected
+    cold_tier latency all fire on prefetch WORKER threads — and must
+    land on the owning shard's aggregate, with the prefetch hit/stall
+    split accounted on the same key."""
+    rec = obs.enable(str(tmp_path / "run.jsonl"))
+    store, X = _tiny_store(tmp_path)
+    plan = faults.arm("read_fail:tiles=1,times=1;"
+                      "corrupt_shard:tiles=2,times=1;"
+                      "cold_tier:s=0.01,per_mb=0")
+    pf = ShardPrefetcher(store, range(store.n_shards), depth=3, threads=2)
+    got = [pf.get(p) for p in range(store.n_shards)]
+    pf.close()  # pass-end flush
+    faults.disarm()
+    assert np.array_equal(np.concatenate(got), X)
+    assert any(ev["kind"] == "read_fail" for ev in plan.events)
+    assert any(ev["kind"] == "corrupt_shard" for ev in plan.events)
+    shards = (storage.collect(rec.io_records)
+              ["surfaces"]["oocore"][store.fingerprint])
+    assert sorted(shards) == list(range(store.n_shards))
+    # the corruption quarantined shard 2 and spent one re-read on it
+    assert shards[2]["quarantined"] >= 1
+    assert shards[2]["retries"] >= 1
+    assert shards[2]["reads"] == 1
+    for i, r in shards.items():
+        # first-touch cold tier: every shard paid >= the 10 ms base
+        # inside ITS OWN timed read, no matter which worker ran it
+        assert r["cold_s"] >= 0.01 - 1e-4, (i, r)
+        # every consumed position was either a readahead hit or a stall
+        assert r["hits"] + r["stalls"] == 1
+        assert r["serial"] == 0
+
+
+# -- disabled path ------------------------------------------------------------
+
+
+def test_disabled_path_touches_no_clock_and_no_ledger(tmp_path, monkeypatch):
+    assert not obs.enabled()
+    calls = []
+    real_now = storage._now
+    monkeypatch.setattr(storage, "_now",
+                        lambda: calls.append(1) or real_now())
+    store, _ = _tiny_store(tmp_path)
+    for i in range(store.n_shards):
+        store.read_shard(i)
+    pf = ShardPrefetcher(store, range(store.n_shards), depth=2, threads=1)
+    for p in range(store.n_shards):
+        pf.get(p)
+    pf.close()
+    assert calls == []  # zero ledger-clock reads on the disabled path
+    assert storage.active() is None
+    assert storage.flush() == 0
+
+
+def test_ledger_attaches_lazily_on_first_access(tmp_path):
+    rec = obs.enable(None)
+    assert rec._storage is None  # enabling alone allocates no ledger
+    store, _ = _tiny_store(tmp_path)
+    store.read_shard(0)
+    assert isinstance(rec._storage, storage.StorageLedger)
+
+
+# -- EWMA heat (hand-computed) ------------------------------------------------
+
+
+def test_heat_ewma_hand_computed(monkeypatch):
+    clock = {"t": 0.0}
+    monkeypatch.setattr(storage, "_now", lambda: clock["t"])
+    led = storage.StorageLedger(_FakeRec())
+    led.record_read("oocore", "s", 0, stored_bytes=1, raw_bytes=1)
+    clock["t"] = 60.0  # one half-life later: 1*0.5 + 1
+    led.record_read("oocore", "s", 0, stored_bytes=1, raw_bytes=1)
+    clock["t"] = 120.0  # flush decays to the flush instant: 1.5*0.5
+    led.flush("pass_end")
+    (rec,) = led._rec.io_records
+    assert rec["heat"] == pytest.approx(0.75, abs=1e-6)
+    assert rec["reads"] == 2
+
+
+# -- serving surfaces ---------------------------------------------------------
+
+
+def test_cache_event_surfaces_and_snapshot(tmp_path):
+    rec = obs.enable(str(tmp_path / "run.jsonl"))
+    store, _ = _tiny_store(tmp_path)
+    store.read_shard(0)
+    led = storage.active()
+    led.record_cache_event("serve_cache", "featcache", "spill",
+                           stored_bytes=100, raw_bytes=200)
+    led.record_cache_event("serve_cache", "featcache", "disk_hit",
+                           raw_bytes=200, dur_s=0.01)
+    led.record_cache_event("serve_cache", "featcache", "promote")
+    led.record_cache_event("compile_cache", "xla", "hit")
+    led.record_cache_event("compile_cache", "xla", "miss", dur_s=0.02)
+    assert storage.flush("flush") == 3  # shard 0 + the two cache keys
+    for r in rec.io_records:
+        assert validate_record(r) == []
+    view = storage.collect(rec.io_records)
+    serve = view["surfaces"]["serve_cache"]["featcache"][None]
+    assert serve["spills"] == 1 and serve["disk_hits"] == 1
+    assert serve["promotes"] == 1 and serve["bytes_stored"] == 100
+    compile_ = view["surfaces"]["compile_cache"]["xla"][None]
+    assert compile_["hits"] == 1 and compile_["misses"] == 1
+    roll = storage.surface_rollup(view)
+    assert set(roll) == {"oocore", "serve_cache", "compile_cache"}
+    assert roll["serve_cache"]["disk_hits"] == 1
+    snap = storage.surfaces_snapshot(rec)
+    assert "ram_budget_bytes" in snap["oocore"]
+    assert "disk_entry_cap" in snap["serve_cache"]
+    assert snap["serve_cache"]["spills"] == 1
+    assert obs.snapshot()["io_records"] == len(rec.io_records)
+
+
+# -- sink rotation ------------------------------------------------------------
+
+
+def test_rotation_segments_validate_and_merge_last_wins(tmp_path,
+                                                       monkeypatch):
+    monkeypatch.setenv("SQ_OBS_ROTATE_BYTES", "2048")
+    path = str(tmp_path / "rot.jsonl")
+    rec = obs.enable(path)
+    store, _ = _tiny_store(tmp_path)
+    store.read_shard(0)
+    storage.flush("pass_end")
+    for _ in range(60):  # pad past the threshold between the two flushes
+        obs.counter_add("rot.pad", 1)
+    store.read_shard(0)
+    store.read_shard(0)
+    storage.flush("pass_end")
+    for _ in range(60):
+        obs.counter_add("rot.pad", 1)
+    obs.disable()
+    segments = storage._with_segments([path])
+    assert len(segments) > 1, "no rotation happened below the threshold"
+    assert segments[0].endswith(".1.gz") and segments[-1] == path
+    records = []
+    for seg in segments:
+        seg_records = load_jsonl(seg)
+        assert seg_records, f"empty segment {seg}"
+        for r in seg_records:
+            assert validate_record(r) == [], (seg, r)
+        records.extend(seg_records)
+    # a reopened segment's meta line stamps its ordinal
+    assert any(r.get("segment") for r in records if r["type"] == "meta")
+    # last-wins across segments: the merged view holds the final totals
+    view = storage.collect(records)
+    assert view["surfaces"]["oocore"][store.fingerprint][0]["reads"] == 3
+    # the live in-memory recorder saw everything regardless of rotation
+    assert rec.counters["rot.pad"] == 120
+
+
+def test_rotation_failure_degrades_to_unrotated_sink(tmp_path,
+                                                     monkeypatch):
+    monkeypatch.setenv("SQ_OBS_ROTATE_BYTES", "512")
+    path = str(tmp_path / "rot.jsonl")
+    rec = obs.enable(path)
+    monkeypatch.setattr(rec, "_rotate_locked",
+                        lambda *a, **k: (_ for _ in ()).throw(OSError()))
+    # a raising rotation must never lose records or kill the run
+    with pytest.raises(OSError):
+        rec._rotate_locked()
+    monkeypatch.undo()
+    rec._rotate_bytes = 0  # what the real failure path does
+    for _ in range(60):
+        obs.counter_add("rot.pad", 1)
+    obs.disable()
+    assert validate_jsonl(path)["errors"] == []
+
+
+# -- advisor (hand-computed) --------------------------------------------------
+
+
+def _io(store, shard, *, stored, raw, reads=1, read_s=0.0, decode_s=0.0,
+        codec=None, heat=1.0):
+    r = {"type": "io", "surface": "oocore", "store": store,
+         "shard": shard, "reads": reads, "bytes_stored": stored,
+         "bytes_raw": raw, "read_s": read_s, "decode_s": decode_s,
+         "heat": heat}
+    if codec:
+        r["codec"] = codec
+    return r
+
+
+def test_advise_hand_computed_projection():
+    """s1 (compressed) measures ratio 0.5, t_io 2e-5 s/stored-byte and
+    t_dec 1e-6 s/raw-byte; s2's raw shard then projects
+    dbytes = 1000*0.5 - 1000 = -500 and
+    dt = -500*2e-5 + 1000*1e-6 = -9 ms/access -> compress, scaled by the
+    2 observed reads. s1's own shard gains 500*2e-5 = 10 ms of IO by
+    decompressing but only saves 1 ms of decode -> leave. The records
+    are CUMULATIVE (2 reads carry 2x the bytes), like real flushes."""
+    records = [
+        _io("s1", 0, stored=500, raw=1000, read_s=0.01, decode_s=0.001,
+            codec="lz4"),
+        _io("s2", 0, stored=2000, raw=2000, reads=2, read_s=0.04,
+            heat=2.0),
+    ]
+    adv = storage.advise(storage.collect(records))
+    assert adv["ratio"] == pytest.approx(0.5)
+    assert adv["t_dec_per_byte"] == pytest.approx(1e-6)
+    assert adv["t_io_per_byte"]["s2"] == pytest.approx(2e-5)
+    by_store = {s["store"]: s for s in adv["shards"]}
+    s2 = by_store["s2"]
+    assert s2["action"] == "compress"
+    assert s2["projected_bytes_delta"] == -500
+    assert s2["projected_wallclock_delta_s"] == pytest.approx(-0.018)
+    assert by_store["s1"]["action"] == "leave"
+    # hottest first: s2 (heat 2.0) outranks s1
+    assert adv["shards"][0]["store"] == "s2"
+    assert adv["notes"] == []
+
+
+def test_advise_refuses_to_invent_a_ratio():
+    adv = storage.advise(storage.collect(
+        [_io("s", 0, stored=1000, raw=1000, read_s=0.1)]))
+    assert adv["ratio"] is None
+    assert adv["notes"], "missing the unmeasured-ratio note"
+    assert all(s["action"] == "leave" for s in adv["shards"])
+
+
+def test_advise_decompress_when_decode_dominates():
+    """A compressed shard whose decode costs more than the IO it saves:
+    dbytes*t_io - dec_s = 100*1e-6 - 0.01 < 0 -> decompress."""
+    records = [_io("s", 0, stored=900, raw=1000, read_s=0.0009,
+                   decode_s=0.01, codec="lz4")]
+    adv = storage.advise(storage.collect(records))
+    (rec,) = adv["shards"]
+    assert rec["action"] == "decompress"
+    assert rec["projected_bytes_delta"] == 100
+    assert rec["projected_wallclock_delta_s"] < 0
+
+
+# -- schema -------------------------------------------------------------------
+
+
+def test_io_record_schema_v11_and_v10_legacy():
+    good = dict(_io("s", 0, stored=10, raw=20, read_s=0.1), v=SCHEMA_VERSION,
+                schema_version=SCHEMA_VERSION, ts=0.0)
+    assert validate_record(good) == []
+    whole_surface = dict(good, shard=None)  # cache surfaces use null
+    assert validate_record(whole_surface) == []
+    bad = dict(good, reads=-1, bytes_raw="x")
+    errs = validate_record(bad)
+    assert any("io.reads" in e for e in errs)
+    assert any("io.bytes_raw" in e for e in errs)
+    # a v10 artifact (no io records) keeps validating untouched
+    legacy = {"v": 10, "schema_version": 10, "ts": 0.0, "type": "counter",
+              "name": "c", "value": 1, "delta": 1}
+    assert validate_record(legacy) == []
+
+
+# -- CLI / report surfacing ---------------------------------------------------
+
+
+def _ledger_artifact(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    obs.enable(path)
+    store, _ = _tiny_store(tmp_path)
+    for i in range(store.n_shards):
+        store.read_shard(i)
+    storage.flush("pass_end")
+    obs.disable()
+    return path, store
+
+
+def test_cli_exit_codes_and_json(tmp_path, capsys):
+    empty = str(tmp_path / "empty.jsonl")
+    with open(empty, "w") as fh:
+        fh.write(json.dumps({"v": SCHEMA_VERSION,
+                             "schema_version": SCHEMA_VERSION, "ts": 0.0,
+                             "type": "meta", "pid": 1,
+                             "schema": SCHEMA_VERSION}) + "\n")
+    assert storage.main([empty]) == 2  # zero io records must not pass
+    capsys.readouterr()
+    path, store = _ledger_artifact(tmp_path)
+    assert storage.main([path, "--json", "--advise"]) == 0
+    doc = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert doc["records"] == store.n_shards
+    assert store.fingerprint in doc["surfaces"]["oocore"]
+    assert len(doc["advice"]["shards"]) == store.n_shards
+    assert storage.main([path]) == 0
+    text = capsys.readouterr().out
+    assert "hottest shards" in text
+    assert storage.main([]) == 2  # usage
+
+
+def test_report_storage_section_renders_with_and_without_io(tmp_path,
+                                                            capsys):
+    path, store = _ledger_artifact(tmp_path)
+    records = load_jsonl(path)
+    summary = report_mod.summarize(records)
+    assert summary["storage"]["io_records"] == store.n_shards
+    assert summary["storage"]["ledger"]["oocore"]["reads"] >= store.n_shards
+    text = report_mod.render(summary)
+    assert "storage surfaces" in text
+    # pre-v11 artifact: counters only, no io lines — the section must
+    # still render from the generic counters alone
+    legacy = [r for r in records if r["type"] != "io"]
+    summary = report_mod.summarize(legacy)
+    assert summary["storage"]["io_records"] == 0
+    assert summary["storage"]["ledger"] == {}
+    assert summary["storage"]["oocore"]["shard_reads"] >= store.n_shards
+    assert "storage surfaces" in report_mod.render(summary)

@@ -10,7 +10,8 @@ import jax.numpy as jnp
 import pytest
 
 from sq_learn_tpu import obs
-from sq_learn_tpu.obs.schema import validate_jsonl, validate_record
+from sq_learn_tpu.obs.schema import (SCHEMA_VERSION, validate_jsonl,
+                                     validate_record)
 from sq_learn_tpu.utils.profiling import matmul_flops
 
 
@@ -161,7 +162,8 @@ def test_schema_v5_envelope_and_new_types(run, tmp_path):
     finally:
         obs.disable()
     recs = [json.loads(l) for l in open(path)]
-    assert all(r["v"] == 10 and r["schema_version"] == 10
+    assert all(r["v"] == SCHEMA_VERSION
+               and r["schema_version"] == SCHEMA_VERSION
                for r in recs)
     summary = validate_jsonl(path)
     assert summary["errors"] == []
